@@ -1,0 +1,41 @@
+"""Root pytest conftest: force the CPU oracle environment.
+
+The container pins ``JAX_PLATFORMS=axon`` (one real TPU chip behind a
+single-client tunnel) via a sitecustomize on ``PYTHONPATH``; that
+registration happens at interpreter start and can hang jax init even when
+tests only want CPU. Tests must run on the virtual 8-device CPU mesh
+(SURVEY.md §4: CPU is the oracle device; the fake cluster is
+``--xla_force_host_platform_device_count``), so we re-exec pytest once with
+a clean environment. The re-exec lives in ``pytest_configure`` so pytest's
+fd-level capture can be stopped first (otherwise the new process writes
+into the old capture temp file and the output vanishes).
+"""
+import os
+import sys
+
+
+def _needs_reexec() -> bool:
+    if os.environ.get("MXNET_TPU_TEST_NO_REEXEC"):
+        return False
+    return os.environ.get("JAX_PLATFORMS") != "cpu" or bool(os.environ.get("PYTHONPATH"))
+
+
+def pytest_configure(config):
+    if not _needs_reexec():
+        return
+    capman = config.pluginmanager.getplugin("capturemanager")
+    if capman is not None:
+        try:
+            capman.stop_global_capturing()
+        except Exception:
+            pass
+    env = dict(os.environ)
+    env["JAX_PLATFORMS"] = "cpu"
+    env["PYTHONPATH"] = ""
+    flags = env.get("XLA_FLAGS", "")
+    if "xla_force_host_platform_device_count" not in flags:
+        env["XLA_FLAGS"] = (flags + " --xla_force_host_platform_device_count=8").strip()
+    env["MXNET_TPU_TEST_NO_REEXEC"] = "1"
+    sys.stdout.flush()
+    sys.stderr.flush()
+    os.execve(sys.executable, [sys.executable, "-m", "pytest"] + sys.argv[1:], env)

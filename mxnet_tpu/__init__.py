@@ -1,0 +1,48 @@
+"""mxnet_tpu: a TPU-native deep-learning framework with MXNet's capabilities.
+
+Built new on JAX/XLA/Pallas — NOT a port. See SURVEY.md for the reference
+analysis (`532416645/mxnet`, an Apache MXNet 1.x fork) and the layer-by-layer
+mapping. Import as::
+
+    import mxnet_tpu as mx
+    x = mx.nd.ones((2, 3), ctx=mx.tpu())
+
+Layer map (reference → here):
+  Engine/Storage/NDArray (C++)  → JAX async dispatch + mxnet_tpu.ndarray
+  CachedOp / GraphExecutor      → jax.jit via HybridBlock.hybridize / Symbol
+  KVStore nccl/dist_sync        → kvstore 'tpu_sync' (XLA collectives, ICI)
+  Gluon / Module / optimizers   → mxnet_tpu.gluon / module / optimizer
+"""
+from __future__ import annotations
+
+__version__ = "0.1.0"
+
+import jax as _jax
+
+# MXNet treats int64/float64 as first-class dtypes; JAX defaults to 32-bit.
+# Enable x64 so explicit 64-bit dtypes round-trip (TPU compute stays in the
+# dtype the user asked for; bf16/f32 remain the perf path).
+_jax.config.update("jax_enable_x64", True)
+
+from . import base
+from .base import MXNetError
+from .context import (
+    Context,
+    cpu,
+    cpu_pinned,
+    cpu_shared,
+    gpu,
+    tpu,
+    current_context,
+    num_gpus,
+    num_tpus,
+    num_devices,
+)
+from . import engine
+from . import ndarray
+from . import ndarray as nd
+from . import autograd
+from . import random
+from . import random_state
+
+from .ndarray import NDArray

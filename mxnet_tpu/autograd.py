@@ -1,0 +1,355 @@
+"""Autograd: imperative tape + reverse-mode differentiation.
+
+Reference: ``src/imperative/imperative.cc :: Imperative::RecordOp`` /
+``::Backward`` and ``python/mxnet/autograd.py``. MXNet records an nnvm graph
+on a tape and composes per-op ``FGradient`` attrs into a backward graph that
+is executed imperatively.
+
+TPU-native design: every recorded op is a **pure JAX function**; at record
+time we obtain the op's VJP via ``jax.vjp`` (XLA derives the backward — no
+per-op hand-written gradients), and ``backward()`` walks the tape in reverse
+accumulating cotangents. Because the VJP closes over the *captured* primal
+values, later in-place mutation of an input NDArray cannot corrupt the
+gradient — stronger than the reference's aliasing rules.
+
+The tape is thread-local, like MXNet's `Imperative::AGInfo` state.
+"""
+from __future__ import annotations
+
+import contextlib
+import threading
+from typing import List, Optional, Sequence
+
+import numpy as _np
+
+from .base import MXNetError
+
+__all__ = [
+    "record",
+    "pause",
+    "train_mode",
+    "predict_mode",
+    "is_recording",
+    "is_training",
+    "set_recording",
+    "set_training",
+    "mark_variables",
+    "backward",
+    "grad",
+    "get_symbol",
+    "Function",
+]
+
+_state = threading.local()
+
+
+def _st():
+    if not hasattr(_state, "recording"):
+        _state.recording = False
+        _state.training = False
+    return _state
+
+
+def is_recording() -> bool:
+    return _st().recording
+
+
+def is_training() -> bool:
+    return _st().training
+
+
+def set_recording(is_record: bool) -> bool:
+    st = _st()
+    prev = st.recording
+    st.recording = bool(is_record)
+    return prev
+
+
+def set_training(train: bool) -> bool:
+    st = _st()
+    prev = st.training
+    st.training = bool(train)
+    return prev
+
+
+class _RecordingStateScope:
+    def __init__(self, is_record: Optional[bool], train: Optional[bool]):
+        self._enter_record = is_record
+        self._enter_train = train
+        self._prev_record = None
+        self._prev_train = None
+
+    def __enter__(self):
+        if self._enter_record is not None:
+            self._prev_record = set_recording(self._enter_record)
+        if self._enter_train is not None:
+            self._prev_train = set_training(self._enter_train)
+        return self
+
+    def __exit__(self, *exc):
+        if self._enter_record is not None:
+            set_recording(self._prev_record)
+        if self._enter_train is not None:
+            set_training(self._prev_train)
+
+
+def record(train_mode: bool = True):
+    """Scope enabling tape recording (reference: autograd.record)."""
+    return _RecordingStateScope(True, train_mode)
+
+
+def pause(train_mode: bool = False):
+    return _RecordingStateScope(False, train_mode)
+
+
+def train_mode():
+    return _RecordingStateScope(None, True)
+
+
+def predict_mode():
+    return _RecordingStateScope(None, False)
+
+
+# ---------------------------------------------------------------------------
+# tape
+# ---------------------------------------------------------------------------
+
+
+class TapeNode:
+    """One recorded op (reference: nnvm::Node on the autograd tape)."""
+
+    __slots__ = ("vjp_fn", "inputs", "outputs", "out_avals", "name")
+
+    def __init__(self, vjp_fn, inputs, outputs, out_avals, name=""):
+        self.vjp_fn = vjp_fn  # cotangents(tuple matching outputs) -> input cotangents
+        self.inputs = inputs  # list[NDArray] — all tensor inputs
+        self.outputs = outputs  # list[NDArray] — produced arrays
+        self.out_avals = out_avals  # list[(shape, dtype)]
+        self.name = name
+
+
+def _mark_output(arr, node: TapeNode, index: int) -> None:
+    arr._ag_node = node
+    arr._ag_index = index
+
+
+def is_on_tape(arr) -> bool:
+    return getattr(arr, "_ag_node", None) is not None or getattr(arr, "_grad_req", "null") != "null"
+
+
+def record_node(vjp_fn, inputs, outputs, name="") -> None:
+    avals = [(o.shape, o.dtype) for o in outputs]
+    node = TapeNode(vjp_fn, list(inputs), list(outputs), avals, name)
+    for i, o in enumerate(outputs):
+        _mark_output(o, node, i)
+
+
+def mark_variables(variables, gradients, grad_reqs="write") -> None:
+    """Attach grad buffers (reference: autograd.mark_variables /
+    MXAutogradMarkVariables)."""
+    if isinstance(grad_reqs, str):
+        grad_reqs = [grad_reqs] * len(variables)
+    for v, g, req in zip(variables, gradients, grad_reqs):
+        v._grad = g
+        v._grad_req = req
+
+
+# ---------------------------------------------------------------------------
+# backward
+# ---------------------------------------------------------------------------
+
+
+def _zeros_cotangent(shape, dtype):
+    import jax
+    import jax.numpy as jnp
+
+    if jnp.issubdtype(dtype, jnp.floating) or jnp.issubdtype(dtype, jnp.complexfloating):
+        return jnp.zeros(shape, dtype)
+    return _np.zeros(shape, jax.dtypes.float0)
+
+
+def backward(heads, head_grads=None, retain_graph: bool = False,
+             train_mode: bool = True) -> None:
+    """Run reverse accumulation from ``heads`` into attached ``.grad``
+    buffers (reference: Imperative::Backward)."""
+    from .ndarray.ndarray import NDArray
+
+    if isinstance(heads, NDArray):
+        heads = [heads]
+        if head_grads is not None and not isinstance(head_grads, (list, tuple)):
+            head_grads = [head_grads]
+    grads = _run_backward(heads, head_grads)
+    # _run_backward already wrote into attached .grad buffers
+    del grads
+
+
+def grad(heads, variables, head_grads=None, retain_graph=None,
+         create_graph: bool = False, train_mode: bool = True):
+    """Return gradients of heads w.r.t. variables (reference: autograd.grad)."""
+    from .ndarray.ndarray import NDArray, _wrap_jax
+
+    if create_graph:
+        raise NotImplementedError(
+            "create_graph=True (higher-order autograd) is not supported yet")
+    single = isinstance(variables, NDArray)
+    if isinstance(heads, NDArray):
+        heads = [heads]
+        if head_grads is not None and not isinstance(head_grads, (list, tuple)):
+            head_grads = [head_grads]
+    if single:
+        variables = [variables]
+    acc = _run_backward(heads, head_grads, collect=variables, write_attached=False)
+    out = []
+    for v in variables:
+        g = acc.get(id(v))
+        if g is None:
+            raise MXNetError(
+                "cannot differentiate: one of the requested variables is not "
+                "part of the recorded graph")
+        out.append(_wrap_jax(g, v.context))
+    return out[0] if single else out
+
+
+def _run_backward(heads, head_grads, collect=None, write_attached=True):
+    import jax.numpy as jnp
+
+    # grad accumulator keyed by array object identity
+    acc = {}
+    keep = {}  # keep NDArray objects alive so ids stay unique
+
+    def add_grad(arr, g):
+        if g is None or (hasattr(g, "dtype") and g.dtype == "float0"):
+            return
+        k = id(arr)
+        if k in acc:
+            acc[k] = acc[k] + g
+        else:
+            acc[k] = g
+            keep[k] = arr
+
+    # seed heads
+    for i, h in enumerate(heads):
+        if getattr(h, "_ag_node", None) is None and getattr(h, "_grad_req", "null") == "null":
+            raise MXNetError(
+                "cannot differentiate a head that is not on the tape; "
+                "call .attach_grad() and compute inside autograd.record()")
+        if head_grads is None or head_grads[i] is None:
+            hg = jnp.ones(h.shape, dtype=h.dtype)
+        else:
+            hg = head_grads[i].data
+        add_grad(h, hg)
+
+    # collect reachable nodes (reverse topological via iterative DFS
+    # postorder — deep tapes, e.g. long unrolled RNNs, must not hit the
+    # Python recursion limit)
+    visited = set()
+    order: List[TapeNode] = []
+    stack = []
+    for h in heads:
+        n = getattr(h, "_ag_node", None)
+        if n is not None:
+            stack.append((n, False))
+    while stack:
+        node, expanded = stack.pop()
+        if expanded:
+            order.append(node)
+            continue
+        if id(node) in visited:
+            continue
+        visited.add(id(node))
+        stack.append((node, True))
+        for inp in node.inputs:
+            child = getattr(inp, "_ag_node", None)
+            if child is not None and id(child) not in visited:
+                stack.append((child, False))
+
+    # reverse sweep
+    for node in reversed(order):
+        cotangents = []
+        any_grad = False
+        for o, (shape, dtype) in zip(node.outputs, node.out_avals):
+            g = acc.get(id(o))
+            if g is None:
+                cotangents.append(_zeros_cotangent(shape, dtype))
+            else:
+                any_grad = True
+                cotangents.append(g.astype(dtype) if hasattr(g, "astype") and g.dtype != dtype else g)
+        if not any_grad:
+            continue
+        ct = tuple(cotangents) if len(cotangents) > 1 else cotangents[0]
+        in_grads = node.vjp_fn(ct)
+        for inp, g in zip(node.inputs, in_grads):
+            if g is None:
+                continue
+            dt = getattr(g, "dtype", None)
+            if dt is not None and str(dt) == "float0":
+                continue
+            add_grad(inp, g)
+
+    # write attached grads (reference: grads written per grad_req write/add)
+    if write_attached:
+        for k, arr in keep.items():
+            req = getattr(arr, "_grad_req", "null")
+            if req == "null" or getattr(arr, "_grad", None) is None:
+                continue
+            g = acc[k]
+            gbuf = arr._grad
+            if req == "add":
+                gbuf._set_data(gbuf.data + g.astype(gbuf.dtype))
+            else:
+                gbuf._set_data(g.astype(gbuf.dtype))
+    return acc
+
+
+def get_symbol(x):
+    raise NotImplementedError(
+        "autograd.get_symbol: tape-to-Symbol export is not supported; "
+        "use HybridBlock.export for deployable graphs")
+
+
+# ---------------------------------------------------------------------------
+# custom Function (reference: python/mxnet/autograd.py :: Function +
+# src/c_api/c_api_function.cc)
+# ---------------------------------------------------------------------------
+
+
+class Function:
+    """User-defined differentiable function with explicit forward/backward."""
+
+    def __init__(self):
+        self._saved = ()
+
+    def save_for_backward(self, *args):
+        self._saved = args
+
+    @property
+    def saved_tensors(self):
+        return self._saved
+
+    def forward(self, *inputs):
+        raise NotImplementedError
+
+    def backward(self, *output_grads):
+        raise NotImplementedError
+
+    def __call__(self, *inputs):
+        from .ndarray.ndarray import NDArray, _wrap_jax
+
+        with pause():
+            outputs = self.forward(*inputs)
+        single = not isinstance(outputs, (list, tuple))
+        outs = [outputs] if single else list(outputs)
+        if is_recording():
+            fn_self = self
+
+            def vjp_fn(cotangents):
+                cts = cotangents if isinstance(cotangents, tuple) else (cotangents,)
+                ndcts = [_wrap_jax(c, outs[0].context) for c in cts]
+                with pause():
+                    in_grads = fn_self.backward(*ndcts)
+                if not isinstance(in_grads, (list, tuple)):
+                    in_grads = [in_grads]
+                return tuple(g.data if g is not None else None for g in in_grads)
+
+            record_node(vjp_fn, list(inputs), outs, name=type(self).__name__)
+        return outs[0] if single else outs

@@ -1,0 +1,97 @@
+"""Execution engine facade.
+
+Reference: ``src/engine/threaded_engine.cc :: ThreadedEngine::PushAsync`` —
+MXNet's dependency engine makes every op asynchronous: ops are pushed with
+read/write variable lists and execute on worker threads; Python blocks only
+at explicit sync points (``WaitToRead`` / ``asnumpy`` / ``WaitForAll``).
+
+XLA/PjRt gives the same contract natively: every dispatched computation
+returns a future-backed buffer immediately and ordering is guaranteed by
+data dependence, so the heavy ThreadedEngine machinery (vars, dependency
+counters, per-device worker pools — src/engine/threaded_engine_perdevice.cc)
+collapses to a thin facade whose job is:
+
+* the **Naive mode** switch (``MXNET_ENGINE_TYPE=NaiveEngine`` in the
+  reference, ``set_engine_type('NaiveEngine')`` / env here): block after
+  every op for debugging/de-flaking;
+* ``wait_for_all`` / per-array ``wait_to_read`` sync points, which also
+  re-raise any exception captured during async execution (reference:
+  ThreadedVar ExceptionRef rethrow at WaitToRead);
+* the ``bulk`` hint (reference: ``python/mxnet/engine.py :: bulk``) — a
+  no-op here because XLA fuses, kept for API compat.
+"""
+from __future__ import annotations
+
+import contextlib
+import os
+import threading
+
+__all__ = ["set_engine_type", "engine_type", "is_naive", "wait_for_all", "bulk"]
+
+_state = threading.local()
+_VALID = ("ThreadedEnginePerDevice", "ThreadedEngine", "NaiveEngine")
+
+
+def _default_type() -> str:
+    env = os.environ.get("MXNET_ENGINE_TYPE", "ThreadedEnginePerDevice")
+    return env if env in _VALID else "ThreadedEnginePerDevice"
+
+
+def engine_type() -> str:
+    return getattr(_state, "engine_type", None) or _default_type()
+
+
+def set_engine_type(name: str) -> None:
+    if name not in _VALID:
+        raise ValueError(f"unknown engine type {name!r}; one of {_VALID}")
+    _state.engine_type = name
+
+
+def is_naive() -> bool:
+    return engine_type() == "NaiveEngine"
+
+
+# Arrays whose async computation may still be in flight.  JAX tracks
+# readiness itself; we only keep a registry so wait_for_all() can block on
+# everything outstanding (reference: Engine::WaitForAll).
+_live_arrays = []
+_live_lock = threading.Lock()
+_MAX_LIVE = 8192
+
+
+def track(jax_array) -> None:
+    # weak references only: the registry must never pin device buffers
+    import weakref
+
+    try:
+        ref = weakref.ref(jax_array)
+    except TypeError:  # non-weakrefable (plain scalar) — nothing async
+        return
+    with _live_lock:
+        _live_arrays.append(ref)
+        if len(_live_arrays) > _MAX_LIVE:
+            # compact collected entries first; halve only if still over
+            _live_arrays[:] = [r for r in _live_arrays if r() is not None]
+            if len(_live_arrays) > _MAX_LIVE:
+                del _live_arrays[: len(_live_arrays) // 2]
+
+
+def wait_for_all() -> None:
+    """Block until all outstanding async work is done; re-raises any
+    exception captured during async execution (reference:
+    ThreadedEngine::WaitForAll + exception rethrow)."""
+    import jax
+
+    with _live_lock:
+        pending = [r() for r in _live_arrays]
+        _live_arrays.clear()
+    for arr in pending:
+        if arr is not None:
+            jax.block_until_ready(arr)
+
+
+@contextlib.contextmanager
+def bulk(size: int):
+    """Bulked execution hint (reference: mx.engine.bulk). XLA fuses ops
+    inside a jitted graph already, so this is semantics-only."""
+    yield

@@ -1,0 +1,814 @@
+"""NDArray: the imperative, asynchronous tensor.
+
+Reference: ``include/mxnet/ndarray.h :: NDArray`` and
+``src/ndarray/ndarray.cc`` — a ref-counted async tensor with in-place
+mutation, view/slice aliasing, deferred allocation and engine-ordered
+execution.
+
+TPU-native design (SURVEY.md §7.3.1 — the riskiest seam):
+
+* the payload is an immutable ``jax.Array``; *mutation* is a functional
+  swap of the payload plus a **version counter** bump;
+* *views* (``x[1:3]``, ``reshape``) hold a read/write lens onto their base
+  array — reads recompute lazily when the base version moved, writes go
+  through ``base.at[...]`` (copy-on-write, XLA fuses the scatter);
+* *async*: JAX dispatch is async-by-default, so every op returns
+  immediately and ``wait_to_read`` / ``asnumpy`` are the sync points where
+  captured exceptions surface (reference: ThreadedVar ExceptionRef);
+* under ``autograd.record()``, view-producing methods route through real
+  ops so the tape sees pure functions.
+"""
+from __future__ import annotations
+
+from typing import Optional
+
+import numpy as _np
+
+from .. import autograd, engine, random_state
+from ..base import MXNetError, numeric_types, integer_types
+from ..context import Context, current_context
+from ..ops.registry import OpDef, eager_call, get_op
+
+__all__ = ["NDArray", "array", "empty", "_wrap_jax", "imperative_invoke", "waitall"]
+
+
+def _jnp():
+    import jax.numpy as jnp
+
+    return jnp
+
+
+def _resolve_dtype(dtype):
+    import ml_dtypes
+
+    if dtype is None:
+        return _np.float32
+    if dtype == "bfloat16" or dtype is ml_dtypes.bfloat16:
+        return ml_dtypes.bfloat16
+    return _np.dtype(dtype)
+
+
+class NDArray:
+    """Multi-dimensional array on a device context."""
+
+    __array_priority__ = 100.0
+
+    def __init__(self, data=None, ctx: Optional[Context] = None, base=None,
+                 view_read=None, view_write=None, shape=None, dtype=None):
+        self._ctx = ctx or current_context()
+        self._base = base
+        self._view_read = view_read
+        self._view_write = view_write
+        self._cached_version = -1
+        self._version = 0
+        self._data = data
+        if base is not None:
+            self._shape = shape
+            self._dtype = dtype
+        elif data is not None:
+            self._shape = tuple(data.shape)
+            self._dtype = _np.dtype(data.dtype) if data.dtype != "bfloat16" else data.dtype
+        else:
+            self._shape, self._dtype = shape, dtype
+        # autograd state
+        self._grad = None
+        self._grad_req = "null"
+        self._ag_node = None
+        self._ag_index = 0
+
+    # ------------------------------------------------------------------
+    # payload access / mutation
+    # ------------------------------------------------------------------
+    @property
+    def data(self):
+        """The underlying jax.Array (recomputed for stale views)."""
+        if self._base is not None:
+            if self._cached_version != self._base._version or self._data is None:
+                self._data = self._view_read(self._base.data)
+                self._cached_version = self._base._version
+        if self._data is None:
+            raise MXNetError("NDArray payload not yet materialized")
+        return self._data
+
+    def _set_data(self, new_jax) -> None:
+        """Functionally replace the payload (an in-place write in API terms)."""
+        if self._base is not None:
+            self._base._set_data(self._view_write(self._base.data, new_jax))
+            self._data = new_jax
+            self._cached_version = self._base._version
+        else:
+            self._data = new_jax
+            self._version += 1
+        engine.track(new_jax)
+
+    # ------------------------------------------------------------------
+    # basic properties
+    # ------------------------------------------------------------------
+    @property
+    def shape(self):
+        if self._shape is None:
+            self._shape = tuple(self.data.shape)
+        return self._shape
+
+    @property
+    def dtype(self):
+        if self._dtype is None:
+            d = self.data.dtype
+            self._dtype = d if str(d) == "bfloat16" else _np.dtype(d)
+        return self._dtype
+
+    @property
+    def size(self):
+        n = 1
+        for d in self.shape:
+            n *= d
+        return n
+
+    @property
+    def ndim(self):
+        return len(self.shape)
+
+    @property
+    def context(self) -> Context:
+        return self._ctx
+
+    ctx = context
+
+    @property
+    def stype(self):
+        return "default"
+
+    @property
+    def T(self):
+        return imperative_invoke(get_op("transpose"), [self], {})
+
+    @property
+    def grad(self):
+        return self._grad
+
+    @property
+    def handle(self):
+        # reference: NDArrayHandle — opaque identity for C-API parity
+        return id(self)
+
+    # ------------------------------------------------------------------
+    # sync / host transfer
+    # ------------------------------------------------------------------
+    def wait_to_read(self) -> None:
+        import jax
+
+        jax.block_until_ready(self.data)
+
+    def asnumpy(self) -> _np.ndarray:
+        return _np.asarray(self.data)
+
+    def asscalar(self):
+        if self.size != 1:
+            raise ValueError("The current array is not a scalar")
+        return self.asnumpy().reshape(())[()]
+
+    def item(self):
+        return self.asscalar()
+
+    def tolist(self):
+        return self.asnumpy().tolist()
+
+    # ------------------------------------------------------------------
+    # context / dtype movement
+    # ------------------------------------------------------------------
+    def as_in_context(self, ctx: Context) -> "NDArray":
+        if ctx == self._ctx:
+            return self
+        return self.copyto(ctx)
+
+    as_in_ctx = as_in_context
+
+    def copyto(self, other) -> "NDArray":
+        import jax
+
+        if isinstance(other, NDArray):
+            other._set_data(jax.device_put(self.data, other._ctx.jax_device()))
+            return other
+        if isinstance(other, Context):
+            val = jax.device_put(self.data, other.jax_device())
+            return _wrap_jax(val, other)
+        raise TypeError(f"copyto does not support type {type(other)}")
+
+    def copy(self) -> "NDArray":
+        # stays on device and non-blocking (async copy via XLA)
+        return _wrap_jax(_jnp().array(self.data, copy=True), self._ctx)
+
+    def astype(self, dtype, copy: bool = True) -> "NDArray":
+        dt = _resolve_dtype(dtype)
+        if not copy and self.dtype == dt:
+            return self
+        return imperative_invoke(get_op("Cast"), [self], {"dtype": str(dt)})
+
+    def as_np_ndarray(self):
+        from ..numpy import ndarray as np_ndarray
+
+        return np_ndarray(data=self.data, ctx=self._ctx)
+
+    def as_nd_ndarray(self):
+        return self
+
+    # ------------------------------------------------------------------
+    # autograd
+    # ------------------------------------------------------------------
+    def attach_grad(self, grad_req: str = "write", stype=None) -> None:
+        jnp = _jnp()
+        self._grad = _wrap_jax(jnp.zeros(self.shape, self.data.dtype), self._ctx)
+        self._grad_req = grad_req
+
+    def drop_grad(self) -> None:
+        self._grad = None
+        self._grad_req = "null"
+
+    def detach(self) -> "NDArray":
+        out = _wrap_jax(self.data, self._ctx)
+        return out
+
+    def backward(self, out_grad=None, retain_graph=False, train_mode=True) -> None:
+        autograd.backward([self], [out_grad] if out_grad is not None else None,
+                          retain_graph=retain_graph, train_mode=train_mode)
+
+    # ------------------------------------------------------------------
+    # shape ops (views outside autograd; real ops when recording)
+    # ------------------------------------------------------------------
+    def reshape(self, *shape, **kwargs):
+        if len(shape) == 1 and isinstance(shape[0], (list, tuple)):
+            shape = tuple(shape[0])
+        if kwargs.get("shape"):
+            shape = tuple(kwargs["shape"])
+        if autograd.is_recording():
+            return imperative_invoke(get_op("Reshape"), [self], {"shape": shape})
+        from ..ops.tensor import _reshape_with_magic
+
+        new_shape = _reshape_with_magic(self.shape, tuple(shape))
+        new_shape = _np.empty(self.shape, dtype=_np.int8).reshape(new_shape).shape
+        return NDArray(
+            base=self._root_base(),
+            view_read=_compose_read(self, lambda d: d.reshape(new_shape)),
+            view_write=_compose_write(self, lambda d, v: v.reshape(d.shape)),
+            ctx=self._ctx, shape=tuple(new_shape), dtype=self.dtype,
+        )
+
+    def reshape_like(self, other):
+        return self.reshape(other.shape)
+
+    def _root_base(self):
+        return self if self._base is None else self._base
+
+    def expand_dims(self, axis):
+        return imperative_invoke(get_op("expand_dims"), [self], {"axis": axis})
+
+    def squeeze(self, axis=None):
+        return imperative_invoke(get_op("squeeze"), [self], {"axis": axis})
+
+    def flatten(self):
+        return imperative_invoke(get_op("Flatten"), [self], {})
+
+    def transpose(self, *axes):
+        if len(axes) == 1 and isinstance(axes[0], (list, tuple)):
+            axes = tuple(axes[0])
+        return imperative_invoke(get_op("transpose"), [self], {"axes": axes})
+
+    def swapaxes(self, dim1, dim2):
+        return imperative_invoke(get_op("swapaxes"), [self], {"dim1": dim1, "dim2": dim2})
+
+    def flip(self, axis):
+        return imperative_invoke(get_op("flip"), [self], {"axis": axis})
+
+    def tile(self, reps):
+        return imperative_invoke(get_op("tile"), [self], {"reps": reps})
+
+    def slice(self, begin, end, step=None):
+        return imperative_invoke(get_op("slice"), [self],
+                                 {"begin": begin, "end": end, "step": step or ()})
+
+    def slice_axis(self, axis, begin, end):
+        return imperative_invoke(get_op("slice_axis"), [self],
+                                 {"axis": axis, "begin": begin, "end": end})
+
+    def take(self, indices, axis=0, mode="clip"):
+        return imperative_invoke(get_op("take"), [self, indices], {"axis": axis, "mode": mode})
+
+    def one_hot(self, depth, **kw):
+        return imperative_invoke(get_op("one_hot"), [self], {"depth": depth, **kw})
+
+    def clip(self, a_min, a_max):
+        return imperative_invoke(get_op("clip"), [self], {"a_min": a_min, "a_max": a_max})
+
+    def abs(self):
+        return imperative_invoke(get_op("abs"), [self], {})
+
+    def sign(self):
+        return imperative_invoke(get_op("sign"), [self], {})
+
+    def sqrt(self):
+        return imperative_invoke(get_op("sqrt"), [self], {})
+
+    def square(self):
+        return imperative_invoke(get_op("square"), [self], {})
+
+    def exp(self):
+        return imperative_invoke(get_op("exp"), [self], {})
+
+    def log(self):
+        return imperative_invoke(get_op("log"), [self], {})
+
+    def sum(self, axis=None, keepdims=False):
+        return imperative_invoke(get_op("sum"), [self], {"axis": axis, "keepdims": keepdims})
+
+    def mean(self, axis=None, keepdims=False):
+        return imperative_invoke(get_op("mean"), [self], {"axis": axis, "keepdims": keepdims})
+
+    def max(self, axis=None, keepdims=False):
+        return imperative_invoke(get_op("max"), [self], {"axis": axis, "keepdims": keepdims})
+
+    def min(self, axis=None, keepdims=False):
+        return imperative_invoke(get_op("min"), [self], {"axis": axis, "keepdims": keepdims})
+
+    def prod(self, axis=None, keepdims=False):
+        return imperative_invoke(get_op("prod"), [self], {"axis": axis, "keepdims": keepdims})
+
+    def norm(self, ord=2, axis=None, keepdims=False):
+        return imperative_invoke(get_op("norm"), [self],
+                                 {"ord": ord, "axis": axis, "keepdims": keepdims})
+
+    def argmax(self, axis=None, keepdims=False):
+        return imperative_invoke(get_op("argmax"), [self], {"axis": axis, "keepdims": keepdims})
+
+    def argmin(self, axis=None, keepdims=False):
+        return imperative_invoke(get_op("argmin"), [self], {"axis": axis, "keepdims": keepdims})
+
+    def argsort(self, axis=-1, is_ascend=True):
+        return imperative_invoke(get_op("argsort"), [self], {"axis": axis, "is_ascend": is_ascend})
+
+    def topk(self, axis=-1, k=1, ret_typ="indices", is_ascend=False):
+        return imperative_invoke(get_op("topk"), [self],
+                                 {"axis": axis, "k": k, "ret_typ": ret_typ,
+                                  "is_ascend": is_ascend})
+
+    def softmax(self, axis=-1):
+        return imperative_invoke(get_op("softmax"), [self], {"axis": axis})
+
+    def log_softmax(self, axis=-1):
+        return imperative_invoke(get_op("log_softmax"), [self], {"axis": axis})
+
+    def dot(self, other, transpose_a=False, transpose_b=False):
+        return imperative_invoke(get_op("dot"), [self, other],
+                                 {"transpose_a": transpose_a, "transpose_b": transpose_b})
+
+    def tostype(self, stype):
+        if stype != "default":
+            raise NotImplementedError(
+                "sparse storage types are dense-backed in mxnet_tpu (SURVEY.md §7.3.5)")
+        return self
+
+    # ------------------------------------------------------------------
+    # indexing
+    # ------------------------------------------------------------------
+    def __getitem__(self, key):
+        jnp = _jnp()
+        key = _clean_index(key)
+        if autograd.is_recording():
+            data = self.data
+
+            def pure(d):
+                return d[key] if not isinstance(key, NDArray) else d[key.data]
+
+            return imperative_invoke(_lambda_op(pure, "getitem"), [self], {})
+        if isinstance(key, NDArray):
+            return _wrap_jax(jnp.take(self.data, key.data.astype("int32"), axis=0), self._ctx)
+        idx = key
+        sub = self.data[idx]
+        return NDArray(
+            base=self._root_base(),
+            view_read=_compose_read(self, lambda d: d[idx]),
+            view_write=_compose_write(self, lambda d, v: d.at[idx].set(v)),
+            ctx=self._ctx, shape=tuple(sub.shape), dtype=self.dtype,
+        )
+
+    def __setitem__(self, key, value):
+        jnp = _jnp()
+        self._check_inplace_during_record()
+        key = _clean_index(key)
+        if isinstance(value, NDArray):
+            v = value.data
+        elif isinstance(value, numeric_types):
+            v = value
+        else:
+            v = jnp.asarray(_np.asarray(value), dtype=self.data.dtype)
+        if isinstance(key, NDArray):
+            key = key.data.astype("int32")
+        if key is Ellipsis or (isinstance(key, slice) and key == slice(None)):
+            if isinstance(v, numeric_types):
+                self._set_data(jnp.full(self.shape, v, dtype=self.data.dtype))
+            else:
+                self._set_data(jnp.broadcast_to(v, self.shape).astype(self.data.dtype))
+            return
+        self._set_data(self.data.at[key].set(v))
+
+    # ------------------------------------------------------------------
+    # python protocol
+    # ------------------------------------------------------------------
+    def __len__(self):
+        if not self.shape:
+            raise TypeError("len() of unsized object")
+        return self.shape[0]
+
+    def __iter__(self):
+        for i in range(len(self)):
+            yield self[i]
+
+    def __bool__(self):
+        if self.size == 1:
+            return bool(self.asscalar())
+        raise ValueError("The truth value of an NDArray with multiple elements is ambiguous")
+
+    def __float__(self):
+        return float(self.asscalar())
+
+    def __int__(self):
+        return int(self.asscalar())
+
+    def __index__(self):
+        return int(self.asscalar())
+
+    def __repr__(self):
+        try:
+            arr = self.asnumpy()
+            return f"\n{arr}\n<NDArray {'x'.join(map(str, self.shape))} @{self._ctx}>"
+        except Exception as e:  # async error surfaces here (sync point)
+            raise
+
+    def __array__(self, dtype=None):
+        a = self.asnumpy()
+        return a.astype(dtype) if dtype is not None else a
+
+    # DLPack interchange (reference: NDArray::ToDLPack / FromDLPack)
+    def __dlpack__(self, stream=None):
+        return self.data.__dlpack__()
+
+    def __dlpack_device__(self):
+        return self.data.__dlpack_device__()
+
+    # ------------------------------------------------------------------
+    # arithmetic
+    # ------------------------------------------------------------------
+    def _binop(self, other, opname, scalar_opname, reverse=False):
+        if isinstance(other, NDArray):
+            args = [other, self] if reverse else [self, other]
+            return imperative_invoke(get_op(opname), args, {})
+        if isinstance(other, numeric_types):
+            return imperative_invoke(get_op(scalar_opname), [self], {"scalar": float(other)})
+        if isinstance(other, _np.ndarray):
+            return self._binop(array(other, ctx=self._ctx), opname, scalar_opname, reverse)
+        return NotImplemented
+
+    def __add__(self, o):
+        return self._binop(o, "broadcast_add", "_plus_scalar")
+
+    __radd__ = __add__
+
+    def __sub__(self, o):
+        return self._binop(o, "broadcast_sub", "_minus_scalar")
+
+    def __rsub__(self, o):
+        if isinstance(o, numeric_types):
+            return imperative_invoke(get_op("_rminus_scalar"), [self], {"scalar": float(o)})
+        return self._binop(o, "broadcast_sub", "_minus_scalar", reverse=True)
+
+    def __mul__(self, o):
+        return self._binop(o, "broadcast_mul", "_mul_scalar")
+
+    __rmul__ = __mul__
+
+    def __truediv__(self, o):
+        return self._binop(o, "broadcast_div", "_div_scalar")
+
+    def __rtruediv__(self, o):
+        if isinstance(o, numeric_types):
+            return imperative_invoke(get_op("_rdiv_scalar"), [self], {"scalar": float(o)})
+        return self._binop(o, "broadcast_div", "_div_scalar", reverse=True)
+
+    def __mod__(self, o):
+        return self._binop(o, "broadcast_mod", "_mod_scalar")
+
+    def __rmod__(self, o):
+        if isinstance(o, numeric_types):
+            return imperative_invoke(get_op("_rmod_scalar"), [self], {"scalar": float(o)})
+        return self._binop(o, "broadcast_mod", "_mod_scalar", reverse=True)
+
+    def __pow__(self, o):
+        return self._binop(o, "broadcast_power", "_power_scalar")
+
+    def __rpow__(self, o):
+        if isinstance(o, numeric_types):
+            return imperative_invoke(get_op("_rpower_scalar"), [self], {"scalar": float(o)})
+        return self._binop(o, "broadcast_power", "_power_scalar", reverse=True)
+
+    def __neg__(self):
+        return imperative_invoke(get_op("negative"), [self], {})
+
+    def __abs__(self):
+        return imperative_invoke(get_op("abs"), [self], {})
+
+    def __eq__(self, o):
+        if o is None:
+            return False
+        return self._binop(o, "broadcast_equal", "_equal_scalar")
+
+    def __ne__(self, o):
+        if o is None:
+            return True
+        return self._binop(o, "broadcast_not_equal", "_not_equal_scalar")
+
+    def __gt__(self, o):
+        return self._binop(o, "broadcast_greater", "_greater_scalar")
+
+    def __ge__(self, o):
+        return self._binop(o, "broadcast_greater_equal", "_greater_equal_scalar")
+
+    def __lt__(self, o):
+        return self._binop(o, "broadcast_lesser", "_lesser_scalar")
+
+    def __le__(self, o):
+        return self._binop(o, "broadcast_lesser_equal", "_lesser_equal_scalar")
+
+    def __hash__(self):
+        return id(self)
+
+    # in-place variants mutate the payload (engine-ordered like MXNet's +=)
+    def _ibinop(self, other, opname, scalar_opname):
+        self._check_inplace_during_record()
+        with autograd.pause():
+            out = self._binop(other, opname, scalar_opname)
+        if out is NotImplemented:
+            return out
+        self._set_data(out.data.astype(self.data.dtype))
+        return self
+
+    def _check_inplace_during_record(self):
+        # reference parity: MXNet forbids in-place writes to arrays that
+        # participate in the autograd graph while recording — a silent
+        # stale-tape gradient otherwise (the tape keeps the pre-mutation
+        # producer node).
+        if autograd.is_recording() and autograd.is_on_tape(self):
+            raise MXNetError(
+                "in-place operation on an array held by the autograd tape "
+                "inside autograd.record() is not allowed; use out-of-place "
+                "ops or move the mutation outside the record scope")
+
+    def __iadd__(self, o):
+        return self._ibinop(o, "broadcast_add", "_plus_scalar")
+
+    def __isub__(self, o):
+        return self._ibinop(o, "broadcast_sub", "_minus_scalar")
+
+    def __imul__(self, o):
+        return self._ibinop(o, "broadcast_mul", "_mul_scalar")
+
+    def __itruediv__(self, o):
+        return self._ibinop(o, "broadcast_div", "_div_scalar")
+
+    # ------------------------------------------------------------------
+    # serialization hooks (full format lives in ndarray/utils.py)
+    # ------------------------------------------------------------------
+    def save(self, fname):
+        from .serialization import save
+
+        save(fname, self)
+
+    def __getstate__(self):
+        return {"data": self.asnumpy(), "ctx": str(self._ctx.device_type), "id": self._ctx.device_id}
+
+    def __setstate__(self, state):
+        import jax
+
+        self.__init__()
+        self._ctx = Context(state["ctx"], state["id"])
+        try:
+            dev = self._ctx.jax_device()
+        except Exception:
+            self._ctx = Context("cpu", 0)
+            dev = self._ctx.jax_device()
+        self._data = jax.device_put(state["data"], dev)
+        self._shape = tuple(self._data.shape)
+        self._dtype = state["data"].dtype
+
+
+def _clean_index(key):
+    if isinstance(key, tuple):
+        return tuple(k.data.astype("int32") if isinstance(k, NDArray) else k for k in key)
+    return key
+
+
+def _compose_read(view_or_base, read):
+    if view_or_base._base is None:
+        return read
+    outer = view_or_base._view_read
+    return lambda d: read(outer(d))
+
+
+def _compose_write(view_or_base, write):
+    if view_or_base._base is None:
+        return write
+    outer_read = view_or_base._view_read
+    outer_write = view_or_base._view_write
+
+    def composed(d, v):
+        inner = outer_read(d)
+        return outer_write(d, write(inner, v))
+
+    return composed
+
+
+class _LambdaOp:
+    """Ad-hoc OpDef-alike for closures (getitem under autograd)."""
+
+    def __init__(self, fn, name):
+        self.fn = fn
+        self.name = name
+        self.tensor_params = ("data",)
+        self.optional_tensor_params = frozenset()
+        self.attr_params = ()
+        self.needs_rng = False
+        self.num_outputs = None
+        self.pass_training_flag = False
+        self.variadic = False
+        self.eager_only = False
+
+
+def _lambda_op(fn, name):
+    return _LambdaOp(fn, name)
+
+
+# ---------------------------------------------------------------------------
+# the imperative invoke path (reference: SURVEY.md §3.1 call stack)
+# ---------------------------------------------------------------------------
+
+
+def imperative_invoke(opdef, tensor_args, attrs, out=None, ctx=None):
+    """Execute a registered op on NDArrays.
+
+    This is the TPU equivalent of ``MXImperativeInvokeEx →
+    Imperative::Invoke → Engine::PushAsync``: resolve inputs, execute
+    asynchronously via the cached per-op executable, record on the autograd
+    tape if needed, and wrap outputs. Returns immediately; JAX's async
+    dispatch provides the engine's non-blocking contract.
+    """
+    import jax
+
+    if ctx is None:
+        for a in tensor_args:
+            if isinstance(a, NDArray):
+                ctx = a.context
+                break
+    if ctx is None:
+        ctx = current_context()
+
+    vals = []
+    for a in tensor_args:
+        if a is None:
+            vals.append(None)
+        elif isinstance(a, NDArray):
+            vals.append(a.data)
+        elif isinstance(a, numeric_types):
+            vals.append(a)
+        else:
+            vals.append(jax.device_put(_np.asarray(a), ctx.jax_device()))
+
+    attrs = {k: _canon_attr(v) for k, v in attrs.items() if v is not None or k in ("axis",)}
+    if opdef.pass_training_flag:
+        attrs["_training"] = autograd.is_training()
+    rng = random_state.next_key() if opdef.needs_rng else None
+
+    recording = autograd.is_recording() and any(
+        isinstance(a, NDArray) and autograd.is_on_tape(a) for a in tensor_args
+    )
+
+    if recording:
+        fixed_attrs = dict(attrs)
+        fn = opdef.fn
+        if rng is not None:
+            def pure(*tensors):
+                return fn(rng, *tensors, **fixed_attrs)
+        else:
+            def pure(*tensors):
+                return fn(*tensors, **fixed_attrs)
+        result, vjp_fn = jax.vjp(pure, *vals)
+    else:
+        result = eager_call(opdef, vals, attrs, rng=rng) if isinstance(opdef, OpDef) \
+            else opdef.fn(*vals, **{k: v for k, v in attrs.items()})
+        vjp_fn = None
+
+    multi = isinstance(result, (tuple, list))
+    results = list(result) if multi else [result]
+    if not any(isinstance(a, NDArray) for a in tensor_args):
+        # creation-style op: commit outputs to the requested context
+        dev = ctx.jax_device()
+        results = [jax.device_put(r, dev) for r in results]
+    outputs = [_wrap_jax(r, ctx) for r in results]
+
+    if recording:
+        nd_inputs = [a for a in tensor_args]
+
+        def tape_vjp(cotangents):
+            grads = vjp_fn(cotangents)
+            return grads
+
+        # tape inputs must align with vjp's positional grads
+        autograd.record_node(_TapeVjp(vjp_fn),
+                             [a if isinstance(a, NDArray) else _DUMMY for a in nd_inputs],
+                             outputs, name=getattr(opdef, "name", "op"))
+
+    if engine.is_naive():
+        for o in outputs:
+            o.wait_to_read()
+
+    if out is not None:
+        outs = out if isinstance(out, (list, tuple)) else [out]
+        for o_dst, o_src in zip(outs, outputs):
+            o_dst._set_data(o_src.data.astype(o_dst.data.dtype)
+                            if o_dst.data.dtype != o_src.data.dtype else o_src.data)
+        return out
+    if multi:
+        return outputs
+    return outputs[0]
+
+
+class _TapeVjp:
+    __slots__ = ("vjp_fn",)
+
+    def __init__(self, vjp_fn):
+        self.vjp_fn = vjp_fn
+
+    def __call__(self, cotangents):
+        return self.vjp_fn(cotangents)
+
+
+class _Dummy:
+    """Placeholder tape input for non-NDArray args (never accumulates)."""
+    _ag_node = None
+    _grad_req = "null"
+
+
+_DUMMY = _Dummy()
+
+
+def _canon_attr(v):
+    if isinstance(v, list):
+        return tuple(v)
+    if isinstance(v, _np.integer):
+        return int(v)
+    if isinstance(v, _np.floating):
+        return float(v)
+    return v
+
+
+def _wrap_jax(value, ctx: Context, copy: bool = False) -> NDArray:
+    import jax
+
+    if not hasattr(value, "shape"):
+        value = _jnp().asarray(value)
+    if copy:
+        value = jax.device_put(_np.asarray(value), ctx.jax_device())
+    engine.track(value)
+    return NDArray(data=value, ctx=ctx)
+
+
+# ---------------------------------------------------------------------------
+# creation
+# ---------------------------------------------------------------------------
+
+
+def array(source_array, ctx: Optional[Context] = None, dtype=None) -> NDArray:
+    """Create an NDArray from any array-like (reference: mx.nd.array)."""
+    import jax
+
+    ctx = ctx or current_context()
+    if isinstance(source_array, NDArray):
+        src = source_array.asnumpy()
+    else:
+        src = _np.asarray(source_array)
+    if dtype is None:
+        dtype = _np.float32 if src.dtype == _np.float64 else src.dtype
+    dt = _resolve_dtype(dtype)
+    src = src.astype(dt) if src.dtype != dt else src
+    val = jax.device_put(src, ctx.jax_device())
+    return NDArray(data=val, ctx=ctx)
+
+
+def empty(shape, ctx: Optional[Context] = None, dtype=None) -> NDArray:
+    import jax
+
+    ctx = ctx or current_context()
+    dt = _resolve_dtype(dtype)
+    val = jax.device_put(_np.empty(shape, dtype=dt), ctx.jax_device())
+    return NDArray(data=val, ctx=ctx)
+
+
+def waitall() -> None:
+    engine.wait_for_all()

@@ -1,0 +1,153 @@
+"""NDArray binary serialization — the ``.params`` format.
+
+Reference: ``src/ndarray/ndarray.cc :: NDArray::Save`` / ``::Load``
+(magic-numbered, versioned ``NDARRAY_V1/V2/V3``) and
+``src/c_api/c_api.cc :: MXNDArraySave`` / ``MXNDArrayLoad`` (the
+dict-of-arrays list format used by ``Block.save_parameters`` and the model
+zoos). Layout follows upstream MXNet 1.x defaults (dense storage,
+32-bit dim_t):
+
+list file   : u64 kMXAPINDListMagic(0x112) | u64 reserved(0)
+              | u64 n | n × NDArray | u64 m | m × (u64 len, bytes) names
+NDArray (V2): u32 0xF993FAC9 | i32 stype(0=dense) | i32 ndim | i32×ndim
+              | i32 dev_type | i32 dev_id | i32 dtype_id | raw data (LE)
+
+The loader also accepts the V1/legacy layouts and, as a pragmatic escape
+hatch, NumPy ``.npz`` archives (so fixtures can be produced anywhere).
+"""
+from __future__ import annotations
+
+import struct
+from typing import Dict, List, Sequence, Tuple, Union
+
+import numpy as _np
+
+from ..base import MXNetError, dtype_id_to_np, dtype_np_to_id
+from ..context import Context, cpu, current_context
+
+_LIST_MAGIC = 0x112
+_V1_MAGIC = 0xF993FAC8
+_V2_MAGIC = 0xF993FAC9
+_V3_MAGIC = 0xF993FACA
+
+
+def _save_one(buf: bytearray, arr_np: _np.ndarray) -> None:
+    dtype_id = dtype_np_to_id(arr_np.dtype)
+    magic = _V3_MAGIC if dtype_id == 12 else _V2_MAGIC
+    buf += struct.pack("<I", magic)
+    buf += struct.pack("<i", 0)  # kDefaultStorage
+    buf += struct.pack("<i", arr_np.ndim)
+    for d in arr_np.shape:
+        buf += struct.pack("<i", d)
+    buf += struct.pack("<ii", 1, 0)  # Context: kCPU, dev_id 0
+    buf += struct.pack("<i", dtype_id)
+    buf += arr_np.tobytes(order="C")
+
+
+def _load_one(data: bytes, off: int) -> Tuple[_np.ndarray, int]:
+    (magic,) = struct.unpack_from("<I", data, off)
+    off += 4
+    if magic in (_V2_MAGIC, _V3_MAGIC):
+        (stype,) = struct.unpack_from("<i", data, off)
+        off += 4
+        if stype != 0:
+            raise MXNetError(
+                "sparse NDArray storage in .params files is not supported "
+                "(dense fallback framework; SURVEY.md §7.3.5)")
+        (ndim,) = struct.unpack_from("<i", data, off)
+        off += 4
+        shape = struct.unpack_from(f"<{ndim}i", data, off) if ndim else ()
+        off += 4 * ndim
+    elif magic == _V1_MAGIC:
+        (ndim,) = struct.unpack_from("<I", data, off)
+        off += 4
+        shape = struct.unpack_from(f"<{ndim}I", data, off) if ndim else ()
+        off += 4 * ndim
+    else:
+        # oldest layout: the magic word itself is ndim
+        ndim = magic
+        if ndim > 32:
+            raise MXNetError("unrecognized NDArray file magic")
+        shape = struct.unpack_from(f"<{ndim}I", data, off) if ndim else ()
+        off += 4 * ndim
+    dev_type, dev_id = struct.unpack_from("<ii", data, off)
+    off += 8
+    (dtype_id,) = struct.unpack_from("<i", data, off)
+    off += 4
+    dt = _np.dtype(dtype_id_to_np(dtype_id))
+    n = 1
+    for d in shape:
+        n *= d
+    nbytes = dt.itemsize * n
+    arr = _np.frombuffer(data, dtype=dt, count=n, offset=off).reshape(shape)
+    off += nbytes
+    return arr.copy(), off
+
+
+def save(fname: str, data) -> None:
+    """Save NDArray(s) (reference: mx.nd.save / MXNDArraySave)."""
+    from .ndarray import NDArray
+
+    if isinstance(data, NDArray):
+        arrays, names = [data], []
+    elif isinstance(data, (list, tuple)):
+        arrays, names = list(data), []
+    elif isinstance(data, dict):
+        names = list(data.keys())
+        arrays = [data[k] for k in names]
+    else:
+        raise TypeError("save requires NDArray, list of NDArray, or dict")
+
+    buf = bytearray()
+    buf += struct.pack("<QQ", _LIST_MAGIC, 0)
+    buf += struct.pack("<Q", len(arrays))
+    for a in arrays:
+        _save_one(buf, a.asnumpy())
+    buf += struct.pack("<Q", len(names))
+    for n in names:
+        nb = n.encode("utf-8")
+        buf += struct.pack("<Q", len(nb))
+        buf += nb
+    with open(fname, "wb") as f:
+        f.write(bytes(buf))
+
+
+def load(fname: str, ctx: Context = None):
+    """Load NDArray(s) (reference: mx.nd.load / MXNDArrayLoad)."""
+    from .ndarray import array
+
+    ctx = ctx or cpu(0)
+    with open(fname, "rb") as f:
+        data = f.read()
+    if data[:6] == b"PK\x03\x04" + b"\x14\x00" or data[:2] == b"PK":
+        # NumPy .npz escape hatch for externally produced fixtures
+        npz = _np.load(fname)
+        return {k: array(npz[k], ctx=ctx) for k in npz.files}
+    return loads(data, ctx=ctx)
+
+
+def loads(data: bytes, ctx: Context = None):
+    from .ndarray import array
+
+    ctx = ctx or cpu(0)
+    magic, _reserved = struct.unpack_from("<QQ", data, 0)
+    if magic != _LIST_MAGIC:
+        raise MXNetError("invalid NDArray list file magic")
+    off = 16
+    (n,) = struct.unpack_from("<Q", data, off)
+    off += 8
+    arrays: List = []
+    for _ in range(n):
+        arr, off = _load_one(data, off)
+        arrays.append(array(arr, ctx=ctx, dtype=arr.dtype))
+    (m,) = struct.unpack_from("<Q", data, off)
+    off += 8
+    names: List[str] = []
+    for _ in range(m):
+        (ln,) = struct.unpack_from("<Q", data, off)
+        off += 8
+        names.append(data[off : off + ln].decode("utf-8"))
+        off += ln
+    if m == 0:
+        return arrays
+    return dict(zip(names, arrays))

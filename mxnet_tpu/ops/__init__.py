@@ -1,0 +1,8 @@
+"""Operator library (L4): pure-JAX implementations behind the op registry.
+
+Reference: ``src/operator/`` — see SURVEY.md §2.1. Modules here register
+ops by MXNet name; both ``mx.nd`` and ``mx.sym`` dispatch through
+``mxnet_tpu.ops.registry``.
+"""
+from . import registry  # noqa: F401
+from .registry import get_op, has_op, list_ops  # noqa: F401

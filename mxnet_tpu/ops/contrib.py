@@ -1,0 +1,161 @@
+"""Contrib operators.
+
+Reference: ``src/operator/contrib/`` — ``transformer.cc`` (interleaved
+attention matmuls used by GluonNLP BERT), ``gelu`` (via LeakyReLU gelu),
+``adamw.cc`` (in optimizer_op.py here), ``index_copy.cc``, ``roi_align.cc``.
+
+The fused attention ops are implemented as single jit-able compositions;
+on TPU the flash-attention Pallas kernel in ``mxnet_tpu/ops/attention.py``
+supersedes them for long sequences (SURVEY.md §5.7).
+"""
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+
+from .registry import register
+
+
+@register("_contrib_div_sqrt_dim", aliases=["div_sqrt_dim"])
+def div_sqrt_dim(data):
+    return data / jnp.sqrt(jnp.asarray(data.shape[-1], dtype=data.dtype))
+
+
+@register("_contrib_gelu")
+def gelu_op(data):
+    return jax.nn.gelu(data, approximate=False)
+
+
+@register("_contrib_interleaved_matmul_selfatt_qk")
+def interleaved_matmul_selfatt_qk(queries_keys_values, *, heads=1):
+    """reference: src/operator/contrib/transformer.cc ::
+    InterleavedMatMulSelfAttQK — input (seq, batch, 3*proj) with q/k/v
+    interleaved per head; output (batch*heads, seq, seq) of scaled q·kᵀ."""
+    seq, batch, _ = queries_keys_values.shape
+    x = queries_keys_values.reshape(seq, batch, heads, 3, -1)
+    q = x[:, :, :, 0]  # (seq, batch, heads, head_dim)
+    k = x[:, :, :, 1]
+    head_dim = q.shape[-1]
+    scale = 1.0 / jnp.sqrt(jnp.asarray(head_dim, dtype=q.dtype))
+    qk = jnp.einsum("sbhd,tbhd->bhst", q * scale, k)
+    return qk.reshape(batch * heads, seq, seq)
+
+
+@register("_contrib_interleaved_matmul_selfatt_valatt")
+def interleaved_matmul_selfatt_valatt(queries_keys_values, attention, *, heads=1):
+    seq, batch, _ = queries_keys_values.shape
+    x = queries_keys_values.reshape(seq, batch, heads, 3, -1)
+    v = x[:, :, :, 2]  # (seq, batch, heads, head_dim)
+    att = attention.reshape(batch, heads, seq, seq)
+    out = jnp.einsum("bhst,tbhd->sbhd", att, v)
+    return out.reshape(seq, batch, -1)
+
+
+@register("_contrib_interleaved_matmul_encdec_qk")
+def interleaved_matmul_encdec_qk(queries, keys_values, *, heads=1):
+    qseq, batch, _ = queries.shape
+    kseq = keys_values.shape[0]
+    q = queries.reshape(qseq, batch, heads, -1)
+    kv = keys_values.reshape(kseq, batch, heads, 2, -1)
+    k = kv[:, :, :, 0]
+    head_dim = q.shape[-1]
+    scale = 1.0 / jnp.sqrt(jnp.asarray(head_dim, dtype=q.dtype))
+    qk = jnp.einsum("sbhd,tbhd->bhst", q * scale, k)
+    return qk.reshape(batch * heads, qseq, kseq)
+
+
+@register("_contrib_interleaved_matmul_encdec_valatt")
+def interleaved_matmul_encdec_valatt(keys_values, attention, *, heads=1):
+    kseq, batch, _ = keys_values.shape
+    kv = keys_values.reshape(kseq, batch, heads, 2, -1)
+    v = kv[:, :, :, 1]
+    qseq = attention.shape[1]
+    att = attention.reshape(batch, heads, qseq, kseq)
+    out = jnp.einsum("bhst,tbhd->sbhd", att, v)
+    return out.reshape(qseq, batch, -1)
+
+
+@register("_contrib_index_copy")
+def index_copy(old_tensor, index_vector, new_tensor):
+    return old_tensor.at[index_vector.astype(jnp.int32)].set(new_tensor)
+
+
+@register("_contrib_index_array")
+def index_array(data, *, axes=None):
+    shape = data.shape
+    axes_ = tuple(axes) if axes else tuple(range(len(shape)))
+    grids = jnp.meshgrid(*[jnp.arange(shape[a]) for a in range(len(shape))], indexing="ij")
+    sel = jnp.stack([grids[a] for a in axes_], axis=-1)
+    return sel.astype(jnp.int64)
+
+
+@register("_contrib_ROIAlign", aliases=["ROIAlign"])
+def roi_align(data, rois, *, pooled_size=(7, 7), spatial_scale=1.0,
+              sample_ratio=-1, position_sensitive=False, aligned=False):
+    """reference: src/operator/contrib/roi_align.cc — bilinear ROI pooling.
+    Vectorized gather-based implementation (jit-friendly, static shapes)."""
+    n, c, h, w = data.shape
+    num_rois = rois.shape[0]
+    ph, pw = pooled_size
+    sratio = sample_ratio if sample_ratio > 0 else 2
+    offset = 0.5 if aligned else 0.0
+    batch_idx = rois[:, 0].astype(jnp.int32)
+    x1 = rois[:, 1] * spatial_scale - offset
+    y1 = rois[:, 2] * spatial_scale - offset
+    x2 = rois[:, 3] * spatial_scale - offset
+    y2 = rois[:, 4] * spatial_scale - offset
+    roi_w = jnp.maximum(x2 - x1, 1.0 if not aligned else 1e-6)
+    roi_h = jnp.maximum(y2 - y1, 1.0 if not aligned else 1e-6)
+    bin_w = roi_w / pw
+    bin_h = roi_h / ph
+    # sample grid: (num_rois, ph, pw, sratio, sratio)
+    iy = (jnp.arange(sratio) + 0.5) / sratio
+    ix = (jnp.arange(sratio) + 0.5) / sratio
+    py = jnp.arange(ph)
+    px = jnp.arange(pw)
+    ys = y1[:, None, None] + (py[None, :, None] + iy[None, None, :]) * bin_h[:, None, None]
+    xs = x1[:, None, None] + (px[None, :, None] + ix[None, None, :]) * bin_w[:, None, None]
+
+    def bilinear(img, yy, xx):
+        # img: (c, h, w); yy/xx: (...,)
+        y0 = jnp.clip(jnp.floor(yy), 0, h - 1)
+        x0 = jnp.clip(jnp.floor(xx), 0, w - 1)
+        y1_ = jnp.clip(y0 + 1, 0, h - 1)
+        x1_ = jnp.clip(x0 + 1, 0, w - 1)
+        wy1 = jnp.clip(yy - y0, 0.0, 1.0)
+        wx1 = jnp.clip(xx - x0, 0.0, 1.0)
+        y0i, x0i, y1i, x1i = (a.astype(jnp.int32) for a in (y0, x0, y1_, x1_))
+        v00 = img[:, y0i, x0i]
+        v01 = img[:, y0i, x1i]
+        v10 = img[:, y1i, x0i]
+        v11 = img[:, y1i, x1i]
+        return (v00 * (1 - wy1) * (1 - wx1) + v01 * (1 - wy1) * wx1
+                + v10 * wy1 * (1 - wx1) + v11 * wy1 * wx1)
+
+    def per_roi(b, ys_r, xs_r):
+        img = data[b]  # (c,h,w)
+        yy = ys_r[:, None, :, None]  # (ph,1,sr,1)
+        xx = xs_r[None, :, None, :]  # (1,pw,1,sr)
+        yy = jnp.broadcast_to(yy, (ph, pw, sratio, sratio))
+        xx = jnp.broadcast_to(xx, (ph, pw, sratio, sratio))
+        vals = bilinear(img, yy, xx)  # (c, ph, pw, sr, sr)
+        return jnp.mean(vals, axis=(-1, -2))
+
+    out = jax.vmap(per_roi)(batch_idx, ys, xs)  # (num_rois, c, ph, pw)
+    return out
+
+
+@register("_contrib_quantize_v2")
+def quantize_v2(data, *, out_type="int8", min_calib_range=None, max_calib_range=None):
+    if min_calib_range is None:
+        min_calib_range = float(-1.0)
+        max_calib_range = float(1.0)
+    scale = 127.0 / jnp.maximum(jnp.abs(min_calib_range), jnp.abs(max_calib_range))
+    q = jnp.clip(jnp.round(data * scale), -127, 127).astype(jnp.int8)
+    return q, jnp.asarray(min_calib_range, jnp.float32), jnp.asarray(max_calib_range, jnp.float32)
+
+
+@register("_contrib_dequantize")
+def dequantize(data, min_range, max_range, *, out_type="float32"):
+    scale = jnp.maximum(jnp.abs(min_range), jnp.abs(max_range)) / 127.0
+    return data.astype(jnp.float32) * scale

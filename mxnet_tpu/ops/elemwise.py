@@ -1,0 +1,239 @@
+"""Elementwise and broadcast operators.
+
+Reference: ``src/operator/tensor/elemwise_binary_op_basic.cc``,
+``elemwise_binary_broadcast_op_basic.cc``, ``elemwise_unary_op_basic.cc``,
+``src/operator/tensor/elemwise_binary_scalar_op*.cc``.
+
+MXNet distinguishes ``elemwise_*`` (strict same-shape) from ``broadcast_*``
+(numpy broadcasting). XLA broadcasts natively, so both families share one
+implementation; the ``elemwise_`` registrations keep the strictness check
+for API parity.
+"""
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+
+from .registry import register, alias
+
+# ---------------------------------------------------------------------------
+# binary arithmetic
+# ---------------------------------------------------------------------------
+
+
+def _binary(name, aliases, fn, strict_shape=False):
+    def impl(lhs, rhs):
+        if strict_shape and lhs.shape != rhs.shape:
+            raise ValueError(
+                f"{name}: shapes {lhs.shape} and {rhs.shape} must match "
+                f"(use broadcast_{name.replace('elemwise_', '')} for broadcasting)"
+            )
+        return fn(lhs, rhs)
+
+    impl.__name__ = name
+    return register(name, aliases=aliases)(impl)
+
+
+_binary("broadcast_add", ["broadcast_plus"], jnp.add)
+_binary("broadcast_sub", ["broadcast_minus"], jnp.subtract)
+_binary("broadcast_mul", [], jnp.multiply)
+_binary("broadcast_div", [], jnp.divide)
+_binary("broadcast_mod", [], jnp.mod)
+_binary("broadcast_power", ["broadcast_pow"], jnp.power)
+_binary("broadcast_maximum", [], jnp.maximum)
+_binary("broadcast_minimum", [], jnp.minimum)
+_binary("broadcast_hypot", [], jnp.hypot)
+_binary("elemwise_add", ["_plus", "_add"], jnp.add, strict_shape=True)
+_binary("elemwise_sub", ["_minus", "_sub"], jnp.subtract, strict_shape=True)
+_binary("elemwise_mul", ["_mul"], jnp.multiply, strict_shape=True)
+_binary("elemwise_div", ["_div"], jnp.divide, strict_shape=True)
+
+# comparisons (outputs follow MXNet: same dtype as inputs, 0/1 values)
+
+
+def _cmp(name, fn):
+    def impl(lhs, rhs):
+        return fn(lhs, rhs).astype(jnp.result_type(lhs))
+
+    impl.__name__ = name
+    register(name, aliases=[name.replace("broadcast_", "_")])(impl)
+
+
+_cmp("broadcast_equal", jnp.equal)
+_cmp("broadcast_not_equal", jnp.not_equal)
+_cmp("broadcast_greater", jnp.greater)
+_cmp("broadcast_greater_equal", jnp.greater_equal)
+_cmp("broadcast_lesser", jnp.less)
+_cmp("broadcast_lesser_equal", jnp.less_equal)
+
+
+@register("broadcast_logical_and")
+def broadcast_logical_and(lhs, rhs):
+    return (jnp.logical_and(lhs != 0, rhs != 0)).astype(jnp.result_type(lhs))
+
+
+@register("broadcast_logical_or")
+def broadcast_logical_or(lhs, rhs):
+    return (jnp.logical_or(lhs != 0, rhs != 0)).astype(jnp.result_type(lhs))
+
+
+@register("broadcast_logical_xor")
+def broadcast_logical_xor(lhs, rhs):
+    return (jnp.logical_xor(lhs != 0, rhs != 0)).astype(jnp.result_type(lhs))
+
+
+@register("logical_not")
+def logical_not(data):
+    return (data == 0).astype(jnp.result_type(data))
+
+
+# scalar ops (reference: elemwise_binary_scalar_op — attrs carry the scalar)
+
+
+def _scalar_op(name, fn):
+    def impl(data, *, scalar=1.0):
+        return fn(data, jnp.asarray(scalar, dtype=data.dtype))
+
+    impl.__name__ = name
+    register(name)(impl)
+
+
+_scalar_op("_plus_scalar", jnp.add)
+_scalar_op("_minus_scalar", jnp.subtract)
+_scalar_op("_rminus_scalar", lambda d, s: s - d)
+_scalar_op("_mul_scalar", jnp.multiply)
+_scalar_op("_div_scalar", jnp.divide)
+_scalar_op("_rdiv_scalar", lambda d, s: s / d)
+_scalar_op("_mod_scalar", jnp.mod)
+_scalar_op("_rmod_scalar", lambda d, s: jnp.mod(s, d))
+_scalar_op("_power_scalar", jnp.power)
+_scalar_op("_rpower_scalar", lambda d, s: jnp.power(s, d))
+_scalar_op("_maximum_scalar", jnp.maximum)
+_scalar_op("_minimum_scalar", jnp.minimum)
+_scalar_op("_equal_scalar", lambda d, s: (d == s).astype(d.dtype))
+_scalar_op("_not_equal_scalar", lambda d, s: (d != s).astype(d.dtype))
+_scalar_op("_greater_scalar", lambda d, s: (d > s).astype(d.dtype))
+_scalar_op("_greater_equal_scalar", lambda d, s: (d >= s).astype(d.dtype))
+_scalar_op("_lesser_scalar", lambda d, s: (d < s).astype(d.dtype))
+_scalar_op("_lesser_equal_scalar", lambda d, s: (d <= s).astype(d.dtype))
+
+
+@register("_hypot_scalar")
+def _hypot_scalar(data, *, scalar=1.0):
+    return jnp.hypot(data, jnp.asarray(scalar, dtype=data.dtype))
+
+
+# ---------------------------------------------------------------------------
+# unary math (reference: elemwise_unary_op_basic.cc, *_trig.cc, *_pow.cc,
+# *_logexp.cc)
+# ---------------------------------------------------------------------------
+
+
+def _unary(name, fn, aliases=()):
+    def impl(data):
+        return fn(data)
+
+    impl.__name__ = name
+    register(name, aliases=list(aliases))(impl)
+
+
+_unary("abs", jnp.abs)
+_unary("sign", jnp.sign)
+_unary("round", jnp.round)
+_unary("rint", jnp.rint)
+_unary("ceil", jnp.ceil)
+_unary("floor", jnp.floor)
+_unary("trunc", jnp.trunc)
+_unary("fix", jnp.trunc)
+_unary("square", jnp.square)
+_unary("sqrt", jnp.sqrt)
+_unary("rsqrt", lambda x: jax.lax.rsqrt(x))
+_unary("cbrt", jnp.cbrt)
+_unary("rcbrt", lambda x: 1.0 / jnp.cbrt(x))
+_unary("exp", jnp.exp)
+_unary("log", jnp.log)
+_unary("log10", jnp.log10)
+_unary("log2", jnp.log2)
+_unary("log1p", jnp.log1p)
+_unary("expm1", jnp.expm1)
+_unary("sin", jnp.sin)
+_unary("cos", jnp.cos)
+_unary("tan", jnp.tan)
+_unary("arcsin", jnp.arcsin)
+_unary("arccos", jnp.arccos)
+_unary("arctan", jnp.arctan)
+_unary("sinh", jnp.sinh)
+_unary("cosh", jnp.cosh)
+_unary("tanh", jnp.tanh)
+_unary("arcsinh", jnp.arcsinh)
+_unary("arccosh", jnp.arccosh)
+_unary("arctanh", jnp.arctanh)
+_unary("degrees", jnp.degrees)
+_unary("radians", jnp.radians)
+_unary("sigmoid", jax.nn.sigmoid)
+_unary("softsign", jax.nn.soft_sign)
+_unary("relu", jax.nn.relu)
+_unary("erf", jax.lax.erf)
+_unary("erfinv", jax.lax.erf_inv)
+_unary("gamma", lambda x: jnp.exp(jax.lax.lgamma(x)))
+_unary("gammaln", jax.lax.lgamma)
+_unary("reciprocal", lambda x: 1.0 / x)
+_unary("negative", jnp.negative, aliases=["_np_negative"])
+_unary("identity", lambda x: x, aliases=["_copy"])
+
+
+@register("clip")
+def clip(data, *, a_min=None, a_max=None):
+    return jnp.clip(data, a_min, a_max)
+
+
+@register("Cast", aliases=["cast"])
+def cast(data, *, dtype="float32"):
+    from ..base import MXNetError  # noqa: F401  (kept for parity w/ checks)
+    import ml_dtypes
+
+    if dtype == "bfloat16":
+        return data.astype(ml_dtypes.bfloat16)
+    return data.astype(dtype)
+
+
+@register("amp_cast")
+def amp_cast(data, *, dtype="float32"):
+    # reference: src/operator/tensor/amp_cast.cc — dtype cast that the AMP
+    # pass inserts; identical to Cast at execution level.
+    return cast.__wrapped__(data, dtype=dtype) if hasattr(cast, "__wrapped__") else cast(data, dtype=dtype)
+
+
+@register("amp_multicast", variadic=True)
+def amp_multicast(*data, num_outputs=1):
+    # cast all inputs to the widest dtype among them
+    wide = jnp.result_type(*[d.dtype for d in data])
+    return tuple(d.astype(wide) for d in data)
+
+
+@register("where")
+def where(condition, x, y):
+    return jnp.where(condition != 0, x, y)
+
+
+@register("add_n", aliases=["ElementWiseSum", "_sum"], variadic=True)
+def add_n(*args):
+    out = args[0]
+    for a in args[1:]:
+        out = out + a
+    return out
+
+
+@register("isnan")
+def isnan(data):
+    return jnp.isnan(data).astype(jnp.float32)
+
+
+@register("isinf")
+def isinf(data):
+    return jnp.isinf(data).astype(jnp.float32)
+
+
+@register("isfinite")
+def isfinite(data):
+    return jnp.isfinite(data).astype(jnp.float32)

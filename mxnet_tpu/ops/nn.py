@@ -1,0 +1,620 @@
+"""Neural-network operators.
+
+Reference: ``src/operator/nn/`` — ``convolution.cc``, ``fully_connected.cc``,
+``batch_norm.cc``, ``layer_norm.cc``, ``pooling.cc``, ``activation.cc``,
+``softmax.cc``, ``dropout.cc``, ``deconvolution.cc``; plus
+``src/operator/softmax_output.cc``, ``leaky_relu.cc``, ``instance_norm.cc``,
+``l2_normalization.cc``, ``embedding`` from ``indexing_op.cc``.
+
+TPU mapping: Convolution/FullyConnected lower to ``lax.conv_general_dilated``
+/ ``lax.dot_general`` which XLA tiles onto the MXU; elementwise epilogues
+(bias, activation) fuse into the matmul automatically under jit.
+"""
+from __future__ import annotations
+
+from typing import Optional
+
+import jax
+import jax.numpy as jnp
+
+from .registry import register
+
+# ---------------------------------------------------------------------------
+# dense / conv
+# ---------------------------------------------------------------------------
+
+
+@register("FullyConnected", aliases=["fully_connected"])
+def fully_connected(data, weight, bias=None, *, num_hidden=0, no_bias=False, flatten=True):
+    # reference: src/operator/nn/fully_connected.cc :: FullyConnectedCompute
+    if flatten and data.ndim > 2:
+        data = data.reshape(data.shape[0], -1)
+    out = jnp.matmul(data, weight.T.astype(data.dtype))
+    if not no_bias and bias is not None:
+        out = out + bias.astype(out.dtype)
+    return out
+
+
+def _tuplize(v, n):
+    if isinstance(v, int):
+        return (v,) * n
+    v = tuple(v)
+    if len(v) == 1:
+        return v * n
+    return v
+
+
+def _conv_dnums(nd):
+    # MXNet default layouts: NCW / NCHW / NCDHW with OIHW-style weights.
+    spatial = "DHW"[-nd:] if nd <= 3 else None
+    lhs = "NC" + spatial
+    rhs = "OI" + spatial
+    return jax.lax.conv_dimension_numbers((1, 1) + (1,) * nd, (1, 1) + (1,) * nd, (lhs, rhs, lhs))
+
+
+@register("Convolution", aliases=["convolution"])
+def convolution(data, weight, bias=None, *, kernel=(), stride=(), dilate=(),
+                pad=(), num_filter=1, num_group=1, no_bias=False,
+                layout=None, workspace=1024, cudnn_tune=None, cudnn_off=False):
+    # reference: src/operator/nn/convolution.cc :: ConvolutionCompute
+    nd = len(kernel)
+    stride = _tuplize(stride or 1, nd)
+    dilate = _tuplize(dilate or 1, nd)
+    pad = _tuplize(pad or 0, nd)
+    dnums = _conv_dnums(nd)
+    out = jax.lax.conv_general_dilated(
+        data,
+        weight.astype(data.dtype),
+        window_strides=stride,
+        padding=[(p, p) for p in pad],
+        rhs_dilation=dilate,
+        dimension_numbers=dnums,
+        feature_group_count=num_group,
+        preferred_element_type=jnp.float32 if data.dtype == jnp.bfloat16 else None,
+    )
+    out = out.astype(data.dtype)
+    if not no_bias and bias is not None:
+        out = out + bias.astype(out.dtype).reshape((1, -1) + (1,) * nd)
+    return out
+
+
+@register("Deconvolution", aliases=["deconvolution"])
+def deconvolution(data, weight, bias=None, *, kernel=(), stride=(), dilate=(),
+                  pad=(), adj=(), num_filter=1, num_group=1, no_bias=True,
+                  target_shape=(), layout=None, workspace=1024,
+                  cudnn_tune=None, cudnn_off=False):
+    # reference: src/operator/nn/deconvolution.cc — conv transpose.
+    nd = len(kernel)
+    stride = _tuplize(stride or 1, nd)
+    dilate = _tuplize(dilate or 1, nd)
+    pad = _tuplize(pad or 0, nd)
+    adj = _tuplize(adj or 0, nd)
+    spatial = "DHW"[-nd:]
+    dn = jax.lax.conv_dimension_numbers(
+        data.shape, weight.shape, ("NC" + spatial, "IO" + spatial, "NC" + spatial)
+    )
+    # conv_transpose with MXNet padding semantics:
+    # out = (in-1)*stride - 2*pad + dilate*(k-1) + 1 + adj
+    padding = []
+    for i in range(nd):
+        k_eff = dilate[i] * (kernel[i] - 1) + 1
+        lo = k_eff - 1 - pad[i]
+        hi = k_eff - 1 - pad[i] + adj[i]
+        padding.append((lo, hi))
+    out = jax.lax.conv_transpose(
+        data, weight.astype(data.dtype), strides=stride, padding=padding,
+        rhs_dilation=dilate, dimension_numbers=dn, transpose_kernel=False,
+    )
+    out = out.astype(data.dtype)
+    if not no_bias and bias is not None:
+        out = out + bias.astype(out.dtype).reshape((1, -1) + (1,) * nd)
+    return out
+
+
+# ---------------------------------------------------------------------------
+# pooling
+# ---------------------------------------------------------------------------
+
+
+@register("Pooling", aliases=["pooling"])
+def pooling(data, *, kernel=(), pool_type="max", stride=(), pad=(),
+            global_pool=False, pooling_convention="valid", count_include_pad=True,
+            cudnn_off=False, p_value=2, layout=None):
+    # reference: src/operator/nn/pooling.cc :: PoolingCompute
+    nd = data.ndim - 2
+    if global_pool:
+        ax = tuple(range(2, data.ndim))
+        if pool_type == "max":
+            return jnp.max(data, axis=ax, keepdims=True)
+        if pool_type in ("avg", "sum"):
+            r = jnp.mean if pool_type == "avg" else jnp.sum
+            return r(data, axis=ax, keepdims=True)
+        if pool_type == "lp":
+            return jnp.power(
+                jnp.sum(jnp.power(jnp.abs(data), p_value), axis=ax, keepdims=True),
+                1.0 / p_value)
+    kernel = _tuplize(kernel, nd)
+    stride = _tuplize(stride or 1, nd)
+    pad = _tuplize(pad or 0, nd)
+    window = (1, 1) + kernel
+    strides = (1, 1) + stride
+
+    def pads_for(convention):
+        out = [(0, 0), (0, 0)]
+        for i in range(nd):
+            lo = hi = pad[i]
+            if convention == "full":
+                # ceil instead of floor output size: add extra hi padding
+                size = data.shape[2 + i] + 2 * pad[i] - kernel[i]
+                rem = size % stride[i]
+                if rem != 0:
+                    hi += stride[i] - rem
+            out.append((lo, hi))
+        return out
+
+    padding = pads_for(pooling_convention)
+    if pool_type == "max":
+        init = -jnp.inf if jnp.issubdtype(data.dtype, jnp.floating) else jnp.iinfo(data.dtype).min
+        return jax.lax.reduce_window(data, init, jax.lax.max, window, strides, padding)
+    if pool_type in ("avg", "sum"):
+        summed = jax.lax.reduce_window(data, 0.0, jax.lax.add, window, strides, padding)
+        if pool_type == "sum":
+            return summed
+        if count_include_pad:
+            denom = 1
+            for k in kernel:
+                denom *= k
+            return summed / denom
+        ones = jnp.ones_like(data)
+        counts = jax.lax.reduce_window(ones, 0.0, jax.lax.add, window, strides, padding)
+        return summed / counts
+    if pool_type == "lp":
+        powed = jax.lax.reduce_window(
+            jnp.power(jnp.abs(data), p_value), 0.0, jax.lax.add, window, strides, padding)
+        return jnp.power(powed, 1.0 / p_value)
+    raise ValueError(f"unknown pool_type {pool_type}")
+
+
+@register("ROIPooling")
+def roi_pooling(data, rois, *, pooled_size=(), spatial_scale=1.0):
+    # reference: src/operator/roi_pooling.cc — simplified dense version
+    raise NotImplementedError("ROIPooling: use _contrib_ROIAlign")
+
+
+# ---------------------------------------------------------------------------
+# normalization
+# ---------------------------------------------------------------------------
+
+
+@register("BatchNorm", aliases=["batch_norm"], pass_training_flag=True)
+def batch_norm(data, gamma, beta, moving_mean, moving_var, *, eps=1e-3,
+               momentum=0.9, fix_gamma=True, use_global_stats=False,
+               output_mean_var=False, axis=1, cudnn_off=False,
+               min_calib_range=None, max_calib_range=None, _training=False):
+    """reference: src/operator/nn/batch_norm.cc :: BatchNormCompute.
+
+    In training mode returns (out, batch_mean, batch_var) so the caller
+    (gluon BatchNorm block / CachedOp aux-state threading) can update the
+    moving statistics functionally — the TPU-native replacement for MXNet's
+    in-place aux-state mutation. In inference mode returns just `out`
+    (matching mx.nd.BatchNorm's single visible output).
+    """
+    reduce_axes = tuple(i for i in range(data.ndim) if i != axis)
+    bshape = [1] * data.ndim
+    bshape[axis] = data.shape[axis]
+    g = jnp.ones_like(gamma) if fix_gamma else gamma
+    use_batch_stats = _training and not use_global_stats
+    x32 = data.astype(jnp.float32)
+    if use_batch_stats:
+        mean = jnp.mean(x32, axis=reduce_axes)
+        var = jnp.var(x32, axis=reduce_axes)
+    else:
+        mean, var = moving_mean.astype(jnp.float32), moving_var.astype(jnp.float32)
+    inv = jax.lax.rsqrt(var + eps)
+    out = (x32 - mean.reshape(bshape)) * inv.reshape(bshape)
+    out = out * g.astype(jnp.float32).reshape(bshape) + beta.astype(jnp.float32).reshape(bshape)
+    out = out.astype(data.dtype)
+    if use_batch_stats or output_mean_var:
+        return out, mean, var
+    return out
+
+
+@register("LayerNorm", aliases=["layer_norm"])
+def layer_norm(data, gamma, beta, *, axis=-1, eps=1e-5, output_mean_var=False):
+    # reference: src/operator/nn/layer_norm.cc
+    x32 = data.astype(jnp.float32)
+    mean = jnp.mean(x32, axis=axis, keepdims=True)
+    var = jnp.var(x32, axis=axis, keepdims=True)
+    inv = jax.lax.rsqrt(var + eps)
+    out = (x32 - mean) * inv
+    bshape = [1] * data.ndim
+    bshape[axis] = data.shape[axis]
+    out = out * gamma.astype(jnp.float32).reshape(bshape) + beta.astype(jnp.float32).reshape(bshape)
+    out = out.astype(data.dtype)
+    if output_mean_var:
+        return out, jnp.squeeze(mean, axis), jnp.squeeze(var, axis)
+    return out
+
+
+@register("InstanceNorm")
+def instance_norm(data, gamma, beta, *, eps=1e-3):
+    ax = tuple(range(2, data.ndim))
+    mean = jnp.mean(data, axis=ax, keepdims=True)
+    var = jnp.var(data, axis=ax, keepdims=True)
+    out = (data - mean) * jax.lax.rsqrt(var + eps)
+    bshape = (1, -1) + (1,) * (data.ndim - 2)
+    return out * gamma.reshape(bshape) + beta.reshape(bshape)
+
+
+@register("GroupNorm")
+def group_norm(data, gamma, beta, *, num_groups=1, eps=1e-5):
+    n, c = data.shape[:2]
+    rest = data.shape[2:]
+    x = data.reshape((n, num_groups, c // num_groups) + rest)
+    ax = tuple(range(2, x.ndim))
+    mean = jnp.mean(x, axis=ax, keepdims=True)
+    var = jnp.var(x, axis=ax, keepdims=True)
+    x = (x - mean) * jax.lax.rsqrt(var + eps)
+    x = x.reshape(data.shape)
+    bshape = (1, -1) + (1,) * (data.ndim - 2)
+    return x * gamma.reshape(bshape) + beta.reshape(bshape)
+
+
+@register("LRN")
+def lrn(data, *, alpha=1e-4, beta=0.75, knorm=2.0, nsize=5):
+    # reference: src/operator/nn/lrn.cc — cross-channel local response norm
+    sq = jnp.square(data)
+    half = nsize // 2
+    padded = jnp.pad(sq, ((0, 0), (half, half), (0, 0), (0, 0)))
+    acc = jnp.zeros_like(data)
+    for i in range(nsize):
+        acc = acc + padded[:, i : i + data.shape[1]]
+    return data / jnp.power(knorm + alpha * acc / nsize, beta)
+
+
+# ---------------------------------------------------------------------------
+# activations / softmax
+# ---------------------------------------------------------------------------
+
+
+@register("Activation", aliases=["activation"])
+def activation(data, *, act_type="relu"):
+    # reference: src/operator/nn/activation.cc
+    fns = {
+        "relu": jax.nn.relu,
+        "sigmoid": jax.nn.sigmoid,
+        "tanh": jnp.tanh,
+        "softrelu": jax.nn.softplus,
+        "softsign": jax.nn.soft_sign,
+    }
+    return fns[act_type](data)
+
+
+@register("LeakyReLU")
+def leaky_relu(data, gamma=None, *, act_type="leaky", slope=0.25,
+               lower_bound=0.125, upper_bound=0.334, _training=False):
+    # reference: src/operator/leaky_relu.cc
+    if act_type == "leaky":
+        return jnp.where(data > 0, data, slope * data)
+    if act_type == "prelu":
+        g = gamma
+        if g.ndim < data.ndim and g.size > 1:
+            g = g.reshape((1, -1) + (1,) * (data.ndim - 2))
+        return jnp.where(data > 0, data, g * data)
+    if act_type == "elu":
+        return jnp.where(data > 0, data, slope * jnp.expm1(data))
+    if act_type == "selu":
+        scale, alpha = 1.0507009873554805, 1.6732632423543772
+        return scale * jnp.where(data > 0, data, alpha * jnp.expm1(data))
+    if act_type == "gelu":
+        return jax.nn.gelu(data, approximate=False)
+    if act_type == "rrelu":
+        s = (lower_bound + upper_bound) / 2.0
+        return jnp.where(data > 0, data, s * data)
+    raise ValueError(act_type)
+
+
+@register("softmax")
+def softmax_op(data, length=None, *, axis=-1, temperature=None, dtype=None, use_length=False):
+    # reference: src/operator/nn/softmax.cc
+    x = data if temperature in (None, 1.0) else data / temperature
+    if use_length and length is not None:
+        pos = jnp.arange(x.shape[axis])
+        shape = [1] * x.ndim
+        shape[axis] = x.shape[axis]
+        mask = pos.reshape(shape) < length.reshape(
+            [x.shape[i] if i == 0 else 1 for i in range(x.ndim)])
+        x = jnp.where(mask, x, -jnp.inf)
+    out = jax.nn.softmax(x, axis=axis)
+    if use_length and length is not None:
+        out = jnp.where(jnp.isnan(out), 0.0, out)
+    return out.astype(jnp.dtype(dtype)) if dtype else out
+
+
+@register("log_softmax")
+def log_softmax_op(data, *, axis=-1, temperature=None, dtype=None, use_length=False):
+    x = data if temperature in (None, 1.0) else data / temperature
+    out = jax.nn.log_softmax(x, axis=axis)
+    return out.astype(jnp.dtype(dtype)) if dtype else out
+
+
+@register("softmin")
+def softmin(data, *, axis=-1, temperature=None, dtype=None):
+    return softmax_op(-data, axis=axis, temperature=temperature, dtype=dtype)
+
+
+def _make_softmax_output(grad_scale, ignore_label, use_ignore, smooth_alpha,
+                         normalization):
+    """Fused softmax + cross-entropy-gradient head. The backward IGNORES the
+    incoming gradient and emits (prob - one_hot(label)) * grad_scale,
+    normalized per the `normalization` attr ('null' | 'batch' | 'valid') —
+    reference: src/operator/softmax_output-inl.h :: SoftmaxOutputBackward."""
+
+    @jax.custom_vjp
+    def _so(data, label):
+        return jax.nn.softmax(data, axis=-1)
+
+    def fwd(data, label):
+        prob = jax.nn.softmax(data, axis=-1)
+        return prob, (prob, label)
+
+    def bwd(res, g):
+        prob, label = res
+        n_class = prob.shape[-1]
+        onehot = jax.nn.one_hot(label.astype(jnp.int32), n_class, dtype=prob.dtype)
+        if smooth_alpha:
+            onehot = onehot * (1 - smooth_alpha) + smooth_alpha / (n_class - 1) * (1 - onehot)
+        grad = prob - onehot
+        valid = None
+        if use_ignore:
+            mask = (label != ignore_label).astype(prob.dtype)
+            grad = grad * mask[..., None]
+            valid = jnp.maximum(jnp.sum(mask), 1.0)
+        if normalization == "valid":
+            denom = valid if valid is not None else float(_np_prod(prob.shape[:-1]))
+            grad = grad / denom
+        elif normalization == "batch":
+            grad = grad / float(prob.shape[0])
+        grad = grad * grad_scale
+        lgrad = (jnp.zeros_like(label, dtype=jax.dtypes.float0)
+                 if jnp.issubdtype(label.dtype, jnp.integer) else jnp.zeros_like(label))
+        return grad, lgrad
+
+    _so.defvjp(fwd, bwd)
+    return _so
+
+
+def _np_prod(shape):
+    n = 1
+    for d in shape:
+        n *= d
+    return n
+
+
+@register("SoftmaxOutput", aliases=["Softmax"])
+def softmax_output(data, label, *, grad_scale=1.0, ignore_label=-1.0,
+                   multi_output=False, use_ignore=False, preserve_shape=False,
+                   normalization="null", out_grad=False, smooth_alpha=0.0):
+    _so = _make_softmax_output(grad_scale, ignore_label, use_ignore,
+                               smooth_alpha, normalization)
+    if multi_output:
+        # (n, c, d1, ...) -> softmax over axis 1
+        x = jnp.moveaxis(data, 1, -1)
+        return jnp.moveaxis(_so(x, label), -1, 1)
+    if data.ndim > 2 and not preserve_shape:
+        flat = data.reshape(data.shape[0], -1)
+        return _so(flat, label).reshape(data.shape)
+    return _so(data, label)
+
+
+@register("make_loss", aliases=["MakeLoss"])
+def make_loss(data, *, grad_scale=1.0, valid_thresh=0.0, normalization="null"):
+    # reference: src/operator/make_loss.cc — identity fwd, grad = grad_scale
+    @jax.custom_vjp
+    def _ml(x):
+        return x
+
+    def fwd(x):
+        return x, x.shape
+
+    def bwd(shape, g):
+        return (jnp.full(shape, grad_scale, dtype=jnp.float32),)
+
+    _ml.defvjp(fwd, bwd)
+    return _ml(data)
+
+
+@register("BlockGrad", aliases=["stop_gradient"])
+def block_grad(data):
+    return jax.lax.stop_gradient(data)
+
+
+# ---------------------------------------------------------------------------
+# embedding / dropout
+# ---------------------------------------------------------------------------
+
+
+@register("Embedding")
+def embedding(data, weight, *, input_dim=0, output_dim=0, dtype="float32",
+              sparse_grad=False):
+    # reference: src/operator/tensor/indexing_op.cc :: EmbeddingOpForward
+    idx = data.astype(jnp.int32)
+    return jnp.take(weight, idx, axis=0)
+
+
+@register("Dropout", aliases=["dropout"], needs_rng=True, pass_training_flag=True)
+def dropout_op(rng, data, *, p=0.5, mode="training", axes=(), cudnn_off=False,
+               _training=False):
+    # reference: src/operator/nn/dropout.cc
+    apply = _training or mode == "always"
+    if not apply or p == 0.0:
+        return data
+    shape = list(data.shape)
+    for a in axes:
+        shape[a] = 1
+    keep = 1.0 - p
+    mask = jax.random.bernoulli(rng, keep, tuple(shape))
+    return jnp.where(mask, data / keep, jnp.zeros_like(data))
+
+
+# ---------------------------------------------------------------------------
+# losses / misc heads
+# ---------------------------------------------------------------------------
+
+
+@register("LinearRegressionOutput")
+def linear_regression_output(data, label, *, grad_scale=1.0):
+    @jax.custom_vjp
+    def _lr(x, y):
+        return x
+
+    def fwd(x, y):
+        return x, (x, y)
+
+    def bwd(res, g):
+        x, y = res
+        n = x.shape[0]
+        return ((x - y) * grad_scale / 1.0, jnp.zeros_like(y))
+
+    _lr.defvjp(fwd, bwd)
+    return _lr(data, label.reshape(data.shape))
+
+
+@register("MAERegressionOutput")
+def mae_regression_output(data, label, *, grad_scale=1.0):
+    @jax.custom_vjp
+    def _mae(x, y):
+        return x
+
+    def fwd(x, y):
+        return x, (x, y)
+
+    def bwd(res, g):
+        x, y = res
+        return (jnp.sign(x - y) * grad_scale, jnp.zeros_like(y))
+
+    _mae.defvjp(fwd, bwd)
+    return _mae(data, label.reshape(data.shape))
+
+
+@register("LogisticRegressionOutput")
+def logistic_regression_output(data, label, *, grad_scale=1.0):
+    @jax.custom_vjp
+    def _log(x, y):
+        return jax.nn.sigmoid(x)
+
+    def fwd(x, y):
+        out = jax.nn.sigmoid(x)
+        return out, (out, y)
+
+    def bwd(res, g):
+        out, y = res
+        return ((out - y) * grad_scale, jnp.zeros_like(y))
+
+    _log.defvjp(fwd, bwd)
+    return _log(data, label.reshape(data.shape))
+
+
+@register("smooth_l1")
+def smooth_l1(data, *, scalar=1.0):
+    s2 = scalar * scalar
+    return jnp.where(jnp.abs(data) < 1.0 / s2,
+                     0.5 * s2 * jnp.square(data),
+                     jnp.abs(data) - 0.5 / s2)
+
+
+@register("CTCLoss", aliases=["ctc_loss"])
+def ctc_loss(data, label, data_lengths=None, label_lengths=None, *,
+             use_data_lengths=False, use_label_lengths=False, blank_label="first"):
+    # reference: src/operator/nn/ctc_loss.cc.  Forward-backward in log space
+    # via lax.scan over time — compiler-friendly control flow.
+    # data: (seq, batch, alphabet) unnormalized; label: (batch, L) padded with
+    # -1 (or 0 when blank_label='last').
+    seq_len, batch, alphabet = data.shape
+    logprob = jax.nn.log_softmax(data, axis=-1)
+    L = label.shape[1]
+    blank = 0 if blank_label == "first" else alphabet - 1
+    lab = label.astype(jnp.int32)
+    if blank_label == "first":
+        valid = lab > 0 if not use_label_lengths else (
+            jnp.arange(L)[None, :] < label_lengths.astype(jnp.int32)[:, None])
+    else:
+        valid = lab >= 0 if not use_label_lengths else (
+            jnp.arange(L)[None, :] < label_lengths.astype(jnp.int32)[:, None])
+    lab_len = jnp.sum(valid.astype(jnp.int32), axis=1)
+    # extended label sequence with interleaved blanks: length 2L+1
+    S = 2 * L + 1
+    ext = jnp.full((batch, S), blank, dtype=jnp.int32)
+    ext = ext.at[:, 1::2].set(jnp.where(valid, lab, blank))
+    ext_len = 2 * lab_len + 1
+    neg_inf = -1e30
+
+    def emit(t):
+        # (batch, S) log p of emitting ext symbol at time t
+        return jnp.take_along_axis(logprob[t], ext, axis=1)
+
+    alpha0 = jnp.full((batch, S), neg_inf)
+    alpha0 = alpha0.at[:, 0].set(logprob[0, :, blank])
+    alpha0 = alpha0.at[:, 1].set(jnp.take_along_axis(logprob[0], ext[:, 1:2], axis=1)[:, 0])
+
+    same = jnp.pad(ext[:, 2:] == ext[:, :-2], ((0, 0), (2, 0)), constant_values=True)
+
+    def step(alpha, t):
+        a = alpha
+        a1 = jnp.pad(alpha[:, :-1], ((0, 0), (1, 0)), constant_values=neg_inf)
+        a2 = jnp.pad(alpha[:, :-2], ((0, 0), (2, 0)), constant_values=neg_inf)
+        a2 = jnp.where(same, neg_inf, a2)
+        new = jnp.logaddexp(jnp.logaddexp(a, a1), a2) + emit(t)
+        if use_data_lengths and data_lengths is not None:
+            live = (t < data_lengths.astype(jnp.int32))[:, None]
+            new = jnp.where(live, new, alpha)
+        return new, None
+
+    alphaT, _ = jax.lax.scan(step, alpha0, jnp.arange(1, seq_len))
+    idx_last = (ext_len - 1)[:, None]
+    last2 = jnp.concatenate([
+        jnp.take_along_axis(alphaT, idx_last, axis=1),
+        jnp.take_along_axis(alphaT, jnp.maximum(idx_last - 1, 0), axis=1),
+    ], axis=1)
+    ll = jnp.logaddexp(last2[:, 0], last2[:, 1])
+    return -ll
+
+
+# ---------------------------------------------------------------------------
+# upsampling / image-ish nn ops
+# ---------------------------------------------------------------------------
+
+
+@register("UpSampling", variadic=True)
+def upsampling(*data, scale=1, sample_type="nearest", num_args=1,
+               num_filter=0, multi_input_mode="concat", workspace=512):
+    x = data[0]
+    if sample_type == "nearest":
+        n, c, h, w = x.shape
+        out = jnp.repeat(jnp.repeat(x, scale, axis=2), scale, axis=3)
+        return out
+    raise NotImplementedError("UpSampling bilinear: use contrib.BilinearResize2D")
+
+
+@register("_contrib_BilinearResize2D", aliases=["BilinearResize2D"])
+def bilinear_resize_2d(data, *, height=0, width=0, scale_height=None,
+                       scale_width=None, mode="size", align_corners=True):
+    n, c, h, w = data.shape
+    out_h = int(height or round(h * (scale_height or 1)))
+    out_w = int(width or round(w * (scale_width or 1)))
+    x = jnp.moveaxis(data, 1, -1)
+    x = jax.image.resize(x, (n, out_h, out_w, c), method="bilinear")
+    return jnp.moveaxis(x, -1, 1)
+
+
+@register("GridGenerator")
+def grid_generator(data, *, transform_type="affine", target_shape=(0, 0)):
+    h, w = target_shape
+    ys = jnp.linspace(-1.0, 1.0, h)
+    xs = jnp.linspace(-1.0, 1.0, w)
+    gy, gx = jnp.meshgrid(ys, xs, indexing="ij")
+    ones = jnp.ones_like(gx)
+    base = jnp.stack([gx.ravel(), gy.ravel(), ones.ravel()], axis=0)  # (3, h*w)
+    theta = data.reshape(-1, 2, 3)
+    out = jnp.einsum("nij,jk->nik", theta, base)  # (n, 2, h*w)
+    return out.reshape(-1, 2, h, w)

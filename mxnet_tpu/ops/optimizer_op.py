@@ -1,0 +1,217 @@
+"""Optimizer update operators.
+
+Reference: ``src/operator/optimizer_op.cc`` — `sgd_update`, `sgd_mom_update`,
+`adam_update`, `nag_mom_update`, `rmsprop_update`, `rmspropalex_update`,
+`ftrl_update`, `signsgd_update`, `signum_update`, `lamb_update_phase1/2`,
+multi-precision (`mp_*`) and multi-tensor (`multi_sgd_*`) variants;
+``src/operator/contrib/adamw.cc`` for AdamW.
+
+These are pure functions returning the updated tensors; the imperative
+wrapper writes results back through the ``out=`` mechanism, giving MXNet's
+in-place update semantics, while hybridized/Module training fuses them into
+the jitted step (the SURVEY.md §3.5 "whole step is ONE executable" design).
+All state math runs in fp32 even for fp16/bf16 weights when the `mp_`
+variants are used, matching MXNet's multi-precision contract.
+"""
+from __future__ import annotations
+
+import jax.numpy as jnp
+
+from .registry import register
+
+
+def _apply_wd(grad, weight, wd, rescale_grad, clip_gradient):
+    g = grad.astype(jnp.float32) * rescale_grad
+    if clip_gradient is not None and clip_gradient >= 0:
+        g = jnp.clip(g, -clip_gradient, clip_gradient)
+    return g + wd * weight.astype(jnp.float32)
+
+
+@register("sgd_update")
+def sgd_update(weight, grad, *, lr, wd=0.0, rescale_grad=1.0,
+               clip_gradient=-1.0, lazy_update=True):
+    g = _apply_wd(grad, weight, wd, rescale_grad, clip_gradient)
+    return (weight.astype(jnp.float32) - lr * g).astype(weight.dtype)
+
+
+@register("sgd_mom_update")
+def sgd_mom_update(weight, grad, mom, *, lr, momentum=0.0, wd=0.0,
+                   rescale_grad=1.0, clip_gradient=-1.0, lazy_update=True):
+    g = _apply_wd(grad, weight, wd, rescale_grad, clip_gradient)
+    new_mom = momentum * mom.astype(jnp.float32) - lr * g
+    new_w = weight.astype(jnp.float32) + new_mom
+    return new_w.astype(weight.dtype), new_mom.astype(mom.dtype)
+
+
+@register("mp_sgd_update")
+def mp_sgd_update(weight, grad, weight32, *, lr, wd=0.0, rescale_grad=1.0,
+                  clip_gradient=-1.0, lazy_update=True):
+    g = _apply_wd(grad, weight32, wd, rescale_grad, clip_gradient)
+    new_w32 = weight32 - lr * g
+    return new_w32.astype(weight.dtype), new_w32
+
+
+@register("mp_sgd_mom_update")
+def mp_sgd_mom_update(weight, grad, mom, weight32, *, lr, momentum=0.0,
+                      wd=0.0, rescale_grad=1.0, clip_gradient=-1.0,
+                      lazy_update=True):
+    g = _apply_wd(grad, weight32, wd, rescale_grad, clip_gradient)
+    new_mom = momentum * mom - lr * g
+    new_w32 = weight32 + new_mom
+    return new_w32.astype(weight.dtype), new_mom, new_w32
+
+
+@register("nag_mom_update")
+def nag_mom_update(weight, grad, mom, *, lr, momentum=0.0, wd=0.0,
+                   rescale_grad=1.0, clip_gradient=-1.0):
+    g = _apply_wd(grad, weight, wd, rescale_grad, clip_gradient)
+    new_mom = momentum * mom.astype(jnp.float32) + g
+    new_w = weight.astype(jnp.float32) - lr * (g + momentum * new_mom)
+    return new_w.astype(weight.dtype), new_mom.astype(mom.dtype)
+
+
+@register("adam_update")
+def adam_update(weight, grad, mean, var, *, lr, beta1=0.9, beta2=0.999,
+                epsilon=1e-8, wd=0.0, rescale_grad=1.0, clip_gradient=-1.0,
+                lazy_update=True):
+    g = _apply_wd(grad, weight, wd, rescale_grad, clip_gradient)
+    new_mean = beta1 * mean.astype(jnp.float32) + (1 - beta1) * g
+    new_var = beta2 * var.astype(jnp.float32) + (1 - beta2) * jnp.square(g)
+    new_w = weight.astype(jnp.float32) - lr * new_mean / (jnp.sqrt(new_var) + epsilon)
+    return new_w.astype(weight.dtype), new_mean.astype(mean.dtype), new_var.astype(var.dtype)
+
+
+@register("_contrib_adamw_update", aliases=["adamw_update"])
+def adamw_update(weight, grad, mean, var, rescale_grad_t=None, *, lr, beta1=0.9,
+                 beta2=0.999, epsilon=1e-8, wd=0.0, eta=1.0,
+                 clip_gradient=-1.0, rescale_grad=1.0):
+    # reference: src/operator/contrib/adamw.cc — decoupled weight decay;
+    # rescale_grad may arrive as a tensor (NaN-check for AMP loss scaling).
+    rs = rescale_grad_t if rescale_grad_t is not None else rescale_grad
+    g = grad.astype(jnp.float32) * rs
+    if clip_gradient is not None and clip_gradient >= 0:
+        g = jnp.clip(g, -clip_gradient, clip_gradient)
+    new_mean = beta1 * mean + (1 - beta1) * g
+    new_var = beta2 * var + (1 - beta2) * jnp.square(g)
+    w32 = weight.astype(jnp.float32)
+    new_w = w32 - eta * (lr * new_mean / (jnp.sqrt(new_var) + epsilon) + wd * lr * w32)
+    # skip update if grads were non-finite (AMP overflow step)
+    ok = jnp.isfinite(g).all()
+    new_w = jnp.where(ok, new_w, w32)
+    new_mean = jnp.where(ok, new_mean, mean)
+    new_var = jnp.where(ok, new_var, var)
+    return new_w.astype(weight.dtype), new_mean, new_var
+
+
+@register("rmsprop_update")
+def rmsprop_update(weight, grad, n, *, lr, gamma1=0.95, epsilon=1e-8, wd=0.0,
+                   rescale_grad=1.0, clip_gradient=-1.0, clip_weights=-1.0):
+    g = _apply_wd(grad, weight, wd, rescale_grad, clip_gradient)
+    new_n = gamma1 * n + (1 - gamma1) * jnp.square(g)
+    new_w = weight.astype(jnp.float32) - lr * g / jnp.sqrt(new_n + epsilon)
+    if clip_weights is not None and clip_weights > 0:
+        new_w = jnp.clip(new_w, -clip_weights, clip_weights)
+    return new_w.astype(weight.dtype), new_n
+
+
+@register("rmspropalex_update")
+def rmspropalex_update(weight, grad, n, g_acc, delta, *, lr, gamma1=0.95,
+                       gamma2=0.9, epsilon=1e-8, wd=0.0, rescale_grad=1.0,
+                       clip_gradient=-1.0, clip_weights=-1.0):
+    g = _apply_wd(grad, weight, wd, rescale_grad, clip_gradient)
+    new_n = gamma1 * n + (1 - gamma1) * jnp.square(g)
+    new_g = gamma1 * g_acc + (1 - gamma1) * g
+    new_delta = gamma2 * delta - lr * g / jnp.sqrt(new_n - jnp.square(new_g) + epsilon)
+    new_w = weight.astype(jnp.float32) + new_delta
+    if clip_weights is not None and clip_weights > 0:
+        new_w = jnp.clip(new_w, -clip_weights, clip_weights)
+    return new_w.astype(weight.dtype), new_n, new_g, new_delta
+
+
+@register("ftrl_update")
+def ftrl_update(weight, grad, z, n, *, lr, lamda1=0.01, beta=1.0, wd=0.0,
+                rescale_grad=1.0, clip_gradient=-1.0):
+    g = grad.astype(jnp.float32) * rescale_grad
+    if clip_gradient is not None and clip_gradient >= 0:
+        g = jnp.clip(g, -clip_gradient, clip_gradient)
+    new_n = n + jnp.square(g)
+    sigma = (jnp.sqrt(new_n) - jnp.sqrt(n)) / lr
+    new_z = z + g - sigma * weight.astype(jnp.float32)
+    new_w = jnp.where(
+        jnp.abs(new_z) <= lamda1,
+        jnp.zeros_like(new_z),
+        -(new_z - jnp.sign(new_z) * lamda1) / ((beta + jnp.sqrt(new_n)) / lr + wd),
+    )
+    return new_w.astype(weight.dtype), new_z, new_n
+
+
+@register("signsgd_update")
+def signsgd_update(weight, grad, *, lr, wd=0.0, rescale_grad=1.0,
+                   clip_gradient=-1.0):
+    g = grad.astype(jnp.float32) * rescale_grad
+    if clip_gradient is not None and clip_gradient >= 0:
+        g = jnp.clip(g, -clip_gradient, clip_gradient)
+    new_w = (1 - lr * wd) * weight.astype(jnp.float32) - lr * jnp.sign(g)
+    return new_w.astype(weight.dtype)
+
+
+@register("signum_update")
+def signum_update(weight, grad, mom, *, lr, momentum=0.0, wd=0.0,
+                  rescale_grad=1.0, clip_gradient=-1.0, wd_lh=0.0):
+    g = grad.astype(jnp.float32) * rescale_grad
+    if clip_gradient is not None and clip_gradient >= 0:
+        g = jnp.clip(g, -clip_gradient, clip_gradient)
+    new_mom = momentum * mom - (1 - momentum) * (g + wd * weight.astype(jnp.float32))
+    new_w = (1 - lr * wd_lh) * weight.astype(jnp.float32) + lr * jnp.sign(new_mom)
+    return new_w.astype(weight.dtype), new_mom
+
+
+@register("adagrad_update", aliases=["_sparse_adagrad_update"])
+def adagrad_update(weight, grad, history, *, lr, epsilon=1e-7, wd=0.0,
+                   rescale_grad=1.0, clip_gradient=-1.0):
+    g = _apply_wd(grad, weight, wd, rescale_grad, clip_gradient)
+    new_h = history + jnp.square(g)
+    new_w = weight.astype(jnp.float32) - lr * g / (jnp.sqrt(new_h) + epsilon)
+    return new_w.astype(weight.dtype), new_h
+
+
+@register("adadelta_update")
+def adadelta_update(weight, grad, acc_g, acc_delta, *, rho=0.9, epsilon=1e-5,
+                    wd=0.0, rescale_grad=1.0, clip_gradient=-1.0):
+    g = _apply_wd(grad, weight, wd, rescale_grad, clip_gradient)
+    new_acc_g = rho * acc_g + (1 - rho) * jnp.square(g)
+    delta = jnp.sqrt(acc_delta + epsilon) / jnp.sqrt(new_acc_g + epsilon) * g
+    new_acc_delta = rho * acc_delta + (1 - rho) * jnp.square(delta)
+    new_w = weight.astype(jnp.float32) - delta
+    return new_w.astype(weight.dtype), new_acc_g, new_acc_delta
+
+
+@register("lamb_update_phase1")
+def lamb_update_phase1(weight, grad, mean, var, *, beta1=0.9, beta2=0.999,
+                       epsilon=1e-6, t=1, bias_correction=True, wd=0.0,
+                       rescale_grad=1.0, clip_gradient=-1.0):
+    g = grad.astype(jnp.float32) * rescale_grad
+    if clip_gradient is not None and clip_gradient >= 0:
+        g = jnp.clip(g, -clip_gradient, clip_gradient)
+    new_mean = beta1 * mean + (1 - beta1) * g
+    new_var = beta2 * var + (1 - beta2) * jnp.square(g)
+    m, v = new_mean, new_var
+    if bias_correction:
+        m = m / (1 - beta1 ** t)
+        v = v / (1 - beta2 ** t)
+    update = m / (jnp.sqrt(v) + epsilon) + wd * weight.astype(jnp.float32)
+    return update, new_mean, new_var
+
+
+@register("lamb_update_phase2")
+def lamb_update_phase2(weight, g_update, r1, r2, *, lr, lower_bound=-1.0,
+                       upper_bound=-1.0):
+    r1v = r1.reshape(())
+    r2v = r2.reshape(())
+    if lower_bound is not None and lower_bound >= 0:
+        r1v = jnp.maximum(r1v, lower_bound)
+    if upper_bound is not None and upper_bound >= 0:
+        r1v = jnp.minimum(r1v, upper_bound)
+    ratio = jnp.where((r1v > 0) & (r2v > 0), r1v / r2v, 1.0)
+    new_w = weight.astype(jnp.float32) - lr * ratio * g_update
+    return new_w.astype(weight.dtype)

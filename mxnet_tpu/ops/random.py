@@ -1,0 +1,110 @@
+"""Random sampling operators.
+
+Reference: ``src/operator/random/sample_op.cc`` (`_random_uniform`,
+`_random_normal`, `_random_gamma`, ...), ``multisample_op.cc``,
+``unique_sample_op.cc``.
+
+MXNet keeps stateful per-device RNG resources (``ResourceRequest::kRandom``,
+``src/resource.cc``). The TPU-native design is counter-based: a global
+stateful key in ``mxnet_tpu.random_state`` is split per call in eager mode,
+and hybridized graphs receive an explicit key input (threaded by the
+CachedOp wrapper) so the same executable produces fresh randomness per call.
+"""
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+
+from .registry import register
+
+
+@register("_random_uniform", aliases=["uniform", "random_uniform"], needs_rng=True)
+def random_uniform(rng, *, low=0.0, high=1.0, shape=(), dtype="float32"):
+    return jax.random.uniform(rng, tuple(shape), minval=low, maxval=high,
+                              dtype=jnp.dtype(dtype))
+
+
+@register("_random_normal", aliases=["normal", "random_normal"], needs_rng=True)
+def random_normal(rng, *, loc=0.0, scale=1.0, shape=(), dtype="float32"):
+    return loc + scale * jax.random.normal(rng, tuple(shape), dtype=jnp.dtype(dtype))
+
+
+@register("_random_gamma", aliases=["random_gamma"], needs_rng=True)
+def random_gamma(rng, *, alpha=1.0, beta=1.0, shape=(), dtype="float32"):
+    return beta * jax.random.gamma(rng, alpha, tuple(shape), dtype=jnp.dtype(dtype))
+
+
+@register("_random_exponential", aliases=["random_exponential"], needs_rng=True)
+def random_exponential(rng, *, lam=1.0, shape=(), dtype="float32"):
+    return jax.random.exponential(rng, tuple(shape), dtype=jnp.dtype(dtype)) / lam
+
+
+@register("_random_poisson", aliases=["random_poisson"], needs_rng=True)
+def random_poisson(rng, *, lam=1.0, shape=(), dtype="float32"):
+    return jax.random.poisson(rng, lam, tuple(shape)).astype(jnp.dtype(dtype))
+
+
+@register("_random_negative_binomial", aliases=["random_negative_binomial"], needs_rng=True)
+def random_negative_binomial(rng, *, k=1, p=1.0, shape=(), dtype="float32"):
+    k1, k2 = jax.random.split(rng)
+    lam = jax.random.gamma(k1, k, tuple(shape)) * ((1 - p) / p)
+    return jax.random.poisson(k2, lam, tuple(shape)).astype(jnp.dtype(dtype))
+
+
+@register("_random_randint", aliases=["random_randint"], needs_rng=True)
+def random_randint(rng, *, low=0, high=1, shape=(), dtype="int32"):
+    return jax.random.randint(rng, tuple(shape), low, high, dtype=jnp.dtype(dtype))
+
+
+@register("_sample_uniform", aliases=["sample_uniform"], needs_rng=True)
+def sample_uniform(rng, low, high, *, shape=(), dtype="float32"):
+    s = tuple(low.shape) + tuple(shape)
+    u = jax.random.uniform(rng, s, dtype=jnp.dtype(dtype))
+    bshape = low.shape + (1,) * len(tuple(shape))
+    return low.reshape(bshape) + u * (high - low).reshape(bshape)
+
+
+@register("_sample_normal", aliases=["sample_normal"], needs_rng=True)
+def sample_normal(rng, mu, sigma, *, shape=(), dtype="float32"):
+    s = tuple(mu.shape) + tuple(shape)
+    n = jax.random.normal(rng, s, dtype=jnp.dtype(dtype))
+    bshape = mu.shape + (1,) * len(tuple(shape))
+    return mu.reshape(bshape) + n * sigma.reshape(bshape)
+
+
+@register("_sample_gamma", aliases=["sample_gamma"], needs_rng=True)
+def sample_gamma(rng, alpha, beta, *, shape=(), dtype="float32"):
+    s = tuple(alpha.shape) + tuple(shape)
+    bshape = alpha.shape + (1,) * len(tuple(shape))
+    g = jax.random.gamma(rng, jnp.broadcast_to(alpha.reshape(bshape), s), dtype=jnp.dtype(dtype))
+    return g * beta.reshape(bshape)
+
+
+@register("_sample_multinomial", aliases=["sample_multinomial"], needs_rng=True)
+def sample_multinomial(rng, data, *, shape=(), get_prob=False, dtype="int32"):
+    # data: (..., k) probabilities. Draw `shape` samples per distribution.
+    n = 1
+    for d in tuple(shape) or ():
+        n *= d
+    n = max(n, 1)
+    logits = jnp.log(jnp.maximum(data, 1e-30))
+    out = jax.random.categorical(rng, logits, axis=-1,
+                                 shape=(n,) + data.shape[:-1])
+    out = jnp.moveaxis(out, 0, -1)
+    if tuple(shape) == ():
+        out = out[..., 0]
+    else:
+        out = out.reshape(data.shape[:-1] + tuple(shape))
+    out = out.astype(jnp.dtype(dtype))
+    if get_prob:
+        lp = jnp.take_along_axis(
+            jax.nn.log_softmax(logits, axis=-1),
+            out.astype(jnp.int32).reshape(data.shape[:-1] + (-1,)), axis=-1
+        ).reshape(out.shape)
+        return out, lp
+    return out
+
+
+@register("_random_bernoulli", aliases=["sample_bernoulli"], needs_rng=True)
+def random_bernoulli(rng, *, p=0.5, shape=(), dtype="float32"):
+    return jax.random.bernoulli(rng, p, tuple(shape)).astype(jnp.dtype(dtype))

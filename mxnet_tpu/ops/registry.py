@@ -1,0 +1,185 @@
+"""Operator registry.
+
+Reference: the nnvm op registry (``3rdparty/tvm/nnvm/include/nnvm/op.h``)
+plus MXNet's per-op registration pattern
+(``src/operator/... :: NNVM_REGISTER_OP(x).set_attr<FCompute>(...)``).
+
+In the TPU-native build an operator is a **pure JAX function**
+``fn(*tensors, **attrs) -> array | tuple`` registered by its MXNet name.
+The same registry serves:
+
+* the imperative frontend (``mx.nd.*`` wrappers dispatch here, with an
+  eager per-op executable cache — the equivalent of MXNet pushing one op
+  to the ThreadedEngine, see §7.3.2 of SURVEY.md);
+* the symbolic frontend (``mx.sym.*`` records the op name + attrs into a
+  graph; the Executor looks implementations up here at jit time);
+* autograd (``jax.vjp`` over the pure function replaces per-op FGradient
+  attrs — XLA derives the backward, no hand-written grads needed except
+  where MXNet defines *non-mathematical* gradients, e.g. SoftmaxOutput,
+  which use ``jax.custom_vjp`` in their impl).
+
+Attr convention: tensor inputs are positional parameters; attributes are
+keyword(-only) parameters with defaults. The wrapper generators use
+``inspect`` to split the two.
+"""
+from __future__ import annotations
+
+import functools
+import inspect
+from typing import Callable, Dict, List, NamedTuple, Optional, Sequence
+
+__all__ = ["OpDef", "register", "get_op", "list_ops", "alias"]
+
+
+class OpDef(NamedTuple):
+    name: str
+    fn: Callable
+    # names of tensor (array) parameters, in order
+    tensor_params: tuple
+    # tensor params that may be None (optional inputs like bias)
+    optional_tensor_params: frozenset
+    # attr param names
+    attr_params: tuple
+    # whether the fn consumes a PRNG key as first argument (random ops)
+    needs_rng: bool
+    # number of outputs; None = infer from returned tuple
+    num_outputs: Optional[int]
+    # if True, the imperative wrapper resolves autograd.is_training() and
+    # passes it as the `_training` attr
+    pass_training_flag: bool
+    # accepts variable number of tensor inputs as a leading list
+    variadic: bool
+    # op must run untraced (dynamic output shapes — e.g. boolean_mask)
+    eager_only: bool
+
+
+_REGISTRY: Dict[str, OpDef] = {}
+
+
+def register(
+    name: Optional[str] = None,
+    aliases: Sequence[str] = (),
+    needs_rng: bool = False,
+    num_outputs: Optional[int] = None,
+    pass_training_flag: bool = False,
+    variadic: bool = False,
+    eager_only: bool = False,
+):
+    """Decorator registering a pure-JAX op implementation."""
+
+    def deco(fn):
+        opname = name or fn.__name__
+        sig = inspect.signature(fn)
+        tensor_params: List[str] = []
+        optional: List[str] = []
+        attr_params: List[str] = []
+        for pname, p in sig.parameters.items():
+            if needs_rng and pname == "rng":
+                continue
+            if pname == "_training":
+                continue
+            if p.kind in (p.POSITIONAL_ONLY, p.POSITIONAL_OR_KEYWORD):
+                if p.kind == p.POSITIONAL_OR_KEYWORD and p.default is not inspect.Parameter.empty and not _is_tensor_default(p.default):
+                    attr_params.append(pname)
+                else:
+                    tensor_params.append(pname)
+                    if p.default is None:
+                        optional.append(pname)
+            elif p.kind == p.KEYWORD_ONLY:
+                attr_params.append(pname)
+            elif p.kind == p.VAR_POSITIONAL:
+                # variadic tensor inputs (e.g. Concat, add_n)
+                tensor_params.append(pname)
+        opdef = OpDef(
+            name=opname,
+            fn=fn,
+            tensor_params=tuple(tensor_params),
+            optional_tensor_params=frozenset(optional),
+            attr_params=tuple(attr_params),
+            needs_rng=needs_rng,
+            num_outputs=num_outputs,
+            pass_training_flag=pass_training_flag,
+            variadic=variadic or any(
+                p.kind == p.VAR_POSITIONAL for p in sig.parameters.values()
+            ),
+            eager_only=eager_only,
+        )
+        _REGISTRY[opname] = opdef
+        for a in aliases:
+            _REGISTRY[a] = opdef
+        fn.__opdef__ = opdef
+        return fn
+
+    return deco
+
+
+def _is_tensor_default(default):
+    # positional params whose default is None are optional tensors (bias=None)
+    return default is None
+
+
+def alias(new_name: str, existing: str) -> None:
+    _REGISTRY[new_name] = _REGISTRY[existing]
+
+
+def get_op(name: str) -> OpDef:
+    try:
+        return _REGISTRY[name]
+    except KeyError:
+        raise NotImplementedError(
+            f"operator {name!r} is not implemented in mxnet_tpu "
+            f"(see SURVEY.md §2.1 op families for the porting roadmap)"
+        ) from None
+
+
+def has_op(name: str) -> bool:
+    return name in _REGISTRY
+
+
+def list_ops() -> List[str]:
+    return sorted(_REGISTRY)
+
+
+# ---------------------------------------------------------------------------
+# Eager single-op executable cache.
+#
+# Reference analogue: MXNet's imperative path pays ~µs dispatch per op
+# (SURVEY.md §3.1); ours pays a jit-cache lookup. Executables are cached by
+# (op name, attr values); XLA itself caches by input shape/dtype underneath.
+# ---------------------------------------------------------------------------
+
+
+@functools.lru_cache(maxsize=4096)
+def _cached_call(opname: str, attr_items: tuple, n_tensors: int, has_rng: bool):
+    import jax
+
+    opdef = _REGISTRY[opname]
+    attrs = dict(attr_items)
+
+    if has_rng:
+        def pure(rng, *tensors):
+            return opdef.fn(rng, *tensors, **attrs)
+    else:
+        def pure(*tensors):
+            return opdef.fn(*tensors, **attrs)
+
+    pure.__name__ = opname
+    return jax.jit(pure)
+
+
+def eager_call(opdef: OpDef, tensors, attrs, rng=None):
+    """Execute an op eagerly through the per-op executable cache."""
+    attr_items = tuple(sorted(attrs.items(), key=lambda kv: kv[0]))
+    try:
+        hash(attr_items)
+        uncached = opdef.eager_only
+    except TypeError:  # unhashable attr (e.g. list) — run uncached
+        uncached = True
+    if uncached:
+        if rng is not None:
+            return opdef.fn(rng, *tensors, **attrs)
+        return opdef.fn(*tensors, **attrs)
+    fn = _cached_call(opdef.name, attr_items, len(tensors), rng is not None)
+    if rng is not None:
+        return fn(rng, *tensors)
+    return fn(*tensors)

@@ -1,0 +1,592 @@
+"""Shape/indexing/reduction/linalg operators.
+
+Reference: ``src/operator/tensor/matrix_op.cc`` (reshape/transpose/slice/
+concat/stack/...), ``broadcast_reduce_op_value.cc`` (sum/mean/...),
+``indexing_op.cc`` (take/one_hot/gather_nd/scatter_nd), ``ordering_op.cc``
+(topk/sort/argsort), ``init_op.cc`` (zeros/ones/arange), ``dot.cc``,
+``la_op.cc`` (linalg).
+"""
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from .registry import register, alias
+
+# ---------------------------------------------------------------------------
+# shape manipulation
+# ---------------------------------------------------------------------------
+
+
+def _reshape_with_magic(shape_in, target):
+    """MXNet Reshape supports magic values 0 (copy dim), -1 (infer),
+    -2 (copy rest), -3 (merge two dims), -4 (split dim).
+    Reference: src/operator/tensor/matrix_op.cc :: ReshapeShape."""
+    target = list(target)
+    out = []
+    src = list(shape_in)
+    i = 0  # index into src
+    j = 0  # index into target
+    while j < len(target):
+        t = target[j]
+        if t == 0:
+            out.append(src[i]); i += 1
+        elif t == -1:
+            out.append(-1); i += 1
+        elif t == -2:
+            out.extend(src[i:]); i = len(src)
+        elif t == -3:
+            out.append(src[i] * src[i + 1]); i += 2
+        elif t == -4:
+            d1, d2 = target[j + 1], target[j + 2]
+            if d1 == -1:
+                d1 = src[i] // d2
+            if d2 == -1:
+                d2 = src[i] // d1
+            out.extend([d1, d2]); i += 1; j += 2
+        else:
+            out.append(t); i += 1
+        j += 1
+    # resolve a single -1
+    if out.count(-1) == 1:
+        known = 1
+        for d in out:
+            if d != -1:
+                known *= d
+        total = 1
+        for d in shape_in:
+            total *= d
+        out[out.index(-1)] = total // max(known, 1)
+    return tuple(out)
+
+
+@register("Reshape", aliases=["reshape"])
+def reshape_op(data, *, shape=(), reverse=False):
+    tgt = _reshape_with_magic(data.shape[::-1] if reverse else data.shape,
+                              tuple(shape)[::-1] if reverse else tuple(shape))
+    if reverse:
+        tgt = tgt[::-1]
+    return jnp.reshape(data, tgt)
+
+
+@register("reshape_like")
+def reshape_like(lhs, rhs):
+    return jnp.reshape(lhs, rhs.shape)
+
+
+@register("Flatten", aliases=["flatten"])
+def flatten_op(data):
+    return jnp.reshape(data, (data.shape[0], -1))
+
+
+@register("transpose")
+def transpose(data, *, axes=()):
+    axes = tuple(axes) if axes else None
+    return jnp.transpose(data, axes)
+
+
+@register("expand_dims")
+def expand_dims(data, *, axis=0):
+    return jnp.expand_dims(data, axis)
+
+
+@register("squeeze")
+def squeeze(data, *, axis=None):
+    if axis is None:
+        return jnp.squeeze(data)
+    return jnp.squeeze(data, axis if isinstance(axis, int) else tuple(axis))
+
+
+@register("broadcast_to")
+def broadcast_to(data, *, shape=()):
+    tgt = tuple(s if t == 0 else t for s, t in zip(data.shape, shape))
+    return jnp.broadcast_to(data, tgt)
+
+
+@register("broadcast_like")
+def broadcast_like(lhs, rhs, *, lhs_axes=None, rhs_axes=None):
+    if lhs_axes is None:
+        return jnp.broadcast_to(lhs, rhs.shape)
+    tgt = list(lhs.shape)
+    for la, ra in zip(lhs_axes, rhs_axes):
+        tgt[la] = rhs.shape[ra]
+    return jnp.broadcast_to(lhs, tuple(tgt))
+
+
+@register("broadcast_axis", aliases=["broadcast_axes"])
+def broadcast_axis(data, *, axis=(), size=()):
+    if isinstance(axis, int):
+        axis, size = (axis,), (size,)
+    tgt = list(data.shape)
+    for a, s in zip(axis, size):
+        tgt[a] = s
+    return jnp.broadcast_to(data, tuple(tgt))
+
+
+@register("slice")
+def slice_op(data, *, begin=(), end=(), step=()):
+    slices = []
+    step = step or (None,) * len(begin)
+    for i, (b, e) in enumerate(zip(begin, end)):
+        s = step[i] if i < len(step) else None
+        slices.append(slice(b, e, s))
+    return data[tuple(slices)]
+
+
+@register("slice_axis")
+def slice_axis(data, *, axis=0, begin=0, end=None):
+    idx = [slice(None)] * data.ndim
+    idx[axis] = slice(begin, end)
+    return data[tuple(idx)]
+
+
+@register("slice_like")
+def slice_like(data, shape_like, *, axes=()):
+    axes = tuple(axes) if axes else tuple(range(shape_like.ndim))
+    idx = [slice(None)] * data.ndim
+    for a in axes:
+        idx[a] = slice(0, shape_like.shape[a])
+    return data[tuple(idx)]
+
+
+@register("Concat", aliases=["concat"], variadic=True)
+def concat(*data, dim=1, num_args=None):
+    return jnp.concatenate(data, axis=dim)
+
+
+@register("stack", variadic=True)
+def stack(*data, axis=0, num_args=None):
+    return jnp.stack(data, axis=axis)
+
+
+@register("split", aliases=["SliceChannel"])
+def split(data, *, num_outputs=1, axis=1, squeeze_axis=False):
+    parts = jnp.split(data, num_outputs, axis=axis)
+    if squeeze_axis:
+        parts = [jnp.squeeze(p, axis=axis) for p in parts]
+    return tuple(parts)
+
+
+@register("split_v2")
+def split_v2(data, *, indices=(), axis=0, squeeze_axis=False, sections=0):
+    if sections > 0:
+        parts = jnp.split(data, sections, axis=axis)
+    else:
+        parts = jnp.split(data, list(indices), axis=axis)
+    if squeeze_axis:
+        parts = [jnp.squeeze(p, axis=axis) for p in parts]
+    return tuple(parts)
+
+
+@register("tile")
+def tile(data, *, reps=()):
+    return jnp.tile(data, tuple(reps))
+
+
+@register("repeat")
+def repeat(data, *, repeats=1, axis=None):
+    return jnp.repeat(data, repeats, axis=axis)
+
+
+@register("Pad", aliases=["pad"])
+def pad_op(data, *, mode="constant", pad_width=(), constant_value=0.0):
+    pw = [(pad_width[2 * i], pad_width[2 * i + 1]) for i in range(len(pad_width) // 2)]
+    jmode = {"constant": "constant", "edge": "edge", "reflect": "reflect"}[mode]
+    if jmode == "constant":
+        return jnp.pad(data, pw, mode="constant", constant_values=constant_value)
+    return jnp.pad(data, pw, mode=jmode)
+
+
+@register("flip", aliases=["reverse"])
+def flip(data, *, axis=()):
+    ax = (axis,) if isinstance(axis, int) else tuple(axis)
+    return jnp.flip(data, ax)
+
+
+@register("swapaxes", aliases=["SwapAxis"])
+def swapaxes(data, *, dim1=0, dim2=0):
+    return jnp.swapaxes(data, dim1, dim2)
+
+
+@register("depth_to_space")
+def depth_to_space(data, *, block_size=1):
+    n, c, h, w = data.shape
+    b = block_size
+    x = data.reshape(n, b, b, c // (b * b), h, w)
+    x = x.transpose(0, 3, 4, 1, 5, 2)
+    return x.reshape(n, c // (b * b), h * b, w * b)
+
+
+@register("space_to_depth")
+def space_to_depth(data, *, block_size=1):
+    n, c, h, w = data.shape
+    b = block_size
+    x = data.reshape(n, c, h // b, b, w // b, b)
+    x = x.transpose(0, 3, 5, 1, 2, 4)
+    return x.reshape(n, c * b * b, h // b, w // b)
+
+
+# ---------------------------------------------------------------------------
+# indexing
+# ---------------------------------------------------------------------------
+
+
+@register("take")
+def take(a, indices, *, axis=0, mode="clip"):
+    jmode = {"clip": "clip", "wrap": "wrap", "raise": "clip"}[mode]
+    return jnp.take(a, indices.astype(jnp.int32), axis=axis, mode=jmode)
+
+
+@register("batch_take")
+def batch_take(a, indices):
+    flat = a.reshape(-1)
+    offs = jnp.arange(a.shape[0]) * a.shape[1]
+    return flat[indices.astype(jnp.int32) + offs.astype(jnp.int32)]
+
+
+@register("pick")
+def pick(data, index, *, axis=-1, keepdims=False, mode="clip"):
+    idx = jnp.clip(index.astype(jnp.int32), 0, data.shape[axis] - 1)
+    picked = jnp.take_along_axis(data, jnp.expand_dims(idx, axis), axis=axis)
+    if not keepdims:
+        picked = jnp.squeeze(picked, axis=axis)
+    return picked
+
+
+@register("one_hot")
+def one_hot(indices, *, depth=1, on_value=1.0, off_value=0.0, dtype="float32"):
+    oh = jax.nn.one_hot(indices.astype(jnp.int32), depth, dtype=jnp.dtype(dtype))
+    return oh * on_value + (1.0 - oh) * off_value
+
+
+@register("gather_nd")
+def gather_nd(data, indices):
+    idx = tuple(indices.astype(jnp.int32)[i] for i in range(indices.shape[0]))
+    return data[idx]
+
+
+@register("scatter_nd")
+def scatter_nd(data, indices, *, shape=()):
+    out = jnp.zeros(tuple(shape), dtype=data.dtype)
+    idx = tuple(indices.astype(jnp.int32)[i] for i in range(indices.shape[0]))
+    return out.at[idx].add(data)
+
+
+@register("where_nd", aliases=["_np_where"])
+def where_nd(condition, x, y):
+    return jnp.where(condition != 0, x, y)
+
+
+@register("boolean_mask", aliases=["_contrib_boolean_mask"], eager_only=True)
+def boolean_mask(data, index, *, axis=0):
+    # Dynamic-shape op: TPU-hostile under jit; registered eager_only so the
+    # imperative path runs it untraced (host-side shape computation).
+    mask = np.asarray(index) != 0
+    return jnp.compress(mask, data, axis=axis)
+
+
+@register("SequenceMask", aliases=["sequence_mask"])
+def sequence_mask(data, sequence_length=None, *, use_sequence_length=False, value=0.0, axis=0):
+    if not use_sequence_length or sequence_length is None:
+        return data
+    # data: (seq, batch, ...) for axis=0 or (batch, seq, ...) for axis=1
+    seq_len = data.shape[axis]
+    pos = jnp.arange(seq_len)
+    if axis == 0:
+        mask = pos[:, None] < sequence_length[None, :].astype(jnp.int32)
+    else:
+        mask = pos[None, :] < sequence_length[:, None].astype(jnp.int32)
+    mask = mask.reshape(mask.shape + (1,) * (data.ndim - 2))
+    return jnp.where(mask, data, jnp.asarray(value, dtype=data.dtype))
+
+
+@register("SequenceLast")
+def sequence_last(data, sequence_length=None, *, use_sequence_length=False, axis=0):
+    if not use_sequence_length or sequence_length is None:
+        idx = data.shape[axis] - 1
+        return jnp.take(data, idx, axis=axis)
+    last = (sequence_length.astype(jnp.int32) - 1)
+    if axis == 0:
+        return jnp.take_along_axis(
+            data, last.reshape((1, -1) + (1,) * (data.ndim - 2)), axis=0
+        ).squeeze(0)
+    return jnp.take_along_axis(
+        data, last.reshape((-1, 1) + (1,) * (data.ndim - 2)), axis=1
+    ).squeeze(1)
+
+
+@register("SequenceReverse")
+def sequence_reverse(data, sequence_length=None, *, use_sequence_length=False, axis=0):
+    if not use_sequence_length or sequence_length is None:
+        return jnp.flip(data, axis=0)
+    seq_len = data.shape[0]
+    pos = jnp.arange(seq_len)[:, None]
+    sl = sequence_length.astype(jnp.int32)[None, :]
+    src = jnp.where(pos < sl, sl - 1 - pos, pos)  # (seq, batch)
+    src = src.reshape(src.shape + (1,) * (data.ndim - 2))
+    return jnp.take_along_axis(data, jnp.broadcast_to(src, data.shape), axis=0)
+
+
+# ---------------------------------------------------------------------------
+# reductions
+# ---------------------------------------------------------------------------
+
+
+def _norm_axis(axis):
+    if axis is None or axis == ():
+        return None
+    if isinstance(axis, int):
+        return (axis,)
+    return tuple(axis)
+
+
+def _reduce(name, fn, aliases=()):
+    def impl(data, *, axis=None, keepdims=False, exclude=False):
+        ax = _norm_axis(axis)
+        if exclude and ax is not None:
+            ax = tuple(i for i in range(data.ndim) if i not in ax)
+        return fn(data, axis=ax, keepdims=keepdims)
+
+    impl.__name__ = name
+    register(name, aliases=list(aliases))(impl)
+
+
+_reduce("sum", jnp.sum, aliases=["sum_axis"])
+_reduce("mean", jnp.mean)
+_reduce("prod", jnp.prod)
+_reduce("max", jnp.max, aliases=["max_axis"])
+_reduce("min", jnp.min, aliases=["min_axis"])
+_reduce("nansum", jnp.nansum)
+_reduce("nanprod", jnp.nanprod)
+
+
+@register("norm")
+def norm(data, *, ord=2, axis=None, keepdims=False):
+    ax = _norm_axis(axis)
+    if ord == 1:
+        return jnp.sum(jnp.abs(data), axis=ax, keepdims=keepdims)
+    return jnp.sqrt(jnp.sum(jnp.square(data), axis=ax, keepdims=keepdims))
+
+
+@register("L2Normalization")
+def l2_normalization(data, *, eps=1e-10, mode="instance"):
+    if mode == "instance":
+        ax = tuple(range(1, data.ndim))
+    elif mode == "channel":
+        ax = (1,)
+    else:  # spatial
+        ax = tuple(range(2, data.ndim))
+    denom = jnp.sqrt(jnp.sum(jnp.square(data), axis=ax, keepdims=True) + eps)
+    return data / denom
+
+
+@register("argmax")
+def argmax(data, *, axis=None, keepdims=False):
+    out = jnp.argmax(data, axis=axis)
+    if keepdims and axis is not None:
+        out = jnp.expand_dims(out, axis)
+    return out.astype(jnp.float32)
+
+
+@register("argmin")
+def argmin(data, *, axis=None, keepdims=False):
+    out = jnp.argmin(data, axis=axis)
+    if keepdims and axis is not None:
+        out = jnp.expand_dims(out, axis)
+    return out.astype(jnp.float32)
+
+
+@register("argmax_channel")
+def argmax_channel(data):
+    return jnp.argmax(data, axis=1).astype(jnp.float32)
+
+
+# ---------------------------------------------------------------------------
+# ordering (reference: src/operator/tensor/ordering_op.cc)
+# ---------------------------------------------------------------------------
+
+
+@register("topk")
+def topk(data, *, axis=-1, k=1, ret_typ="indices", is_ascend=False, dtype="float32"):
+    d = -data if is_ascend else data
+    sel_vals, idx = jax.lax.top_k(jnp.moveaxis(d, axis, -1), k)
+    vals = -sel_vals if is_ascend else sel_vals
+    vals = jnp.moveaxis(vals, -1, axis)
+    idx = jnp.moveaxis(idx, -1, axis).astype(jnp.dtype(dtype))
+    if ret_typ == "indices":
+        return idx
+    if ret_typ == "value":
+        return vals
+    if ret_typ == "both":
+        return vals, idx
+    if ret_typ == "mask":
+        raise NotImplementedError("topk ret_typ='mask'")
+    raise ValueError(ret_typ)
+
+
+@register("sort")
+def sort(data, *, axis=-1, is_ascend=True):
+    s = jnp.sort(data, axis=axis)
+    return s if is_ascend else jnp.flip(s, axis=axis)
+
+
+@register("argsort")
+def argsort(data, *, axis=-1, is_ascend=True, dtype="float32"):
+    s = jnp.argsort(data, axis=axis)
+    if not is_ascend:
+        s = jnp.flip(s, axis=axis)
+    return s.astype(jnp.dtype(dtype))
+
+
+@register("shuffle", aliases=["_shuffle"], needs_rng=True)
+def shuffle(rng, data):
+    return jax.random.permutation(rng, data, axis=0)
+
+
+# ---------------------------------------------------------------------------
+# linalg (reference: src/operator/tensor/dot.cc, la_op.cc)
+# ---------------------------------------------------------------------------
+
+
+@register("dot")
+def dot(lhs, rhs, *, transpose_a=False, transpose_b=False):
+    a = lhs.T if transpose_a else lhs
+    b = rhs.T if transpose_b else rhs
+    if a.ndim == 1 and b.ndim == 1:
+        return jnp.dot(a, b)
+    # MXNet dot: contract last axis of a with first axis of b
+    return jnp.tensordot(a, b, axes=([a.ndim - 1], [0]))
+
+
+@register("batch_dot")
+def batch_dot(lhs, rhs, *, transpose_a=False, transpose_b=False):
+    a = jnp.swapaxes(lhs, -1, -2) if transpose_a else lhs
+    b = jnp.swapaxes(rhs, -1, -2) if transpose_b else rhs
+    return jnp.matmul(a, b)
+
+
+@register("khatri_rao", variadic=True)
+def khatri_rao(*args):
+    out = args[0]
+    for m in args[1:]:
+        out = jnp.einsum("i...,j...->ij...", out, m).reshape(-1, out.shape[-1])
+    return out
+
+
+@register("_linalg_gemm2", aliases=["linalg_gemm2"])
+def linalg_gemm2(A, B, *, transpose_a=False, transpose_b=False, alpha=1.0, axis=-2):
+    a = jnp.swapaxes(A, -1, -2) if transpose_a else A
+    b = jnp.swapaxes(B, -1, -2) if transpose_b else B
+    return alpha * jnp.matmul(a, b)
+
+
+@register("_linalg_gemm", aliases=["linalg_gemm"])
+def linalg_gemm(A, B, C, *, transpose_a=False, transpose_b=False, alpha=1.0, beta=1.0, axis=-2):
+    a = jnp.swapaxes(A, -1, -2) if transpose_a else A
+    b = jnp.swapaxes(B, -1, -2) if transpose_b else B
+    return alpha * jnp.matmul(a, b) + beta * C
+
+
+@register("_linalg_potrf", aliases=["linalg_potrf"])
+def linalg_potrf(A):
+    return jnp.linalg.cholesky(A)
+
+
+@register("_linalg_trsm", aliases=["linalg_trsm"])
+def linalg_trsm(A, B, *, transpose=False, rightside=False, lower=True, alpha=1.0):
+    a = jnp.swapaxes(A, -1, -2) if transpose else A
+    if rightside:
+        out = jnp.swapaxes(
+            jax.scipy.linalg.solve_triangular(
+                jnp.swapaxes(a, -1, -2), jnp.swapaxes(alpha * B, -1, -2),
+                lower=not lower if transpose else lower,
+            ), -1, -2)
+        return out
+    return jax.scipy.linalg.solve_triangular(a, alpha * B, lower=lower != transpose)
+
+
+@register("_linalg_syrk", aliases=["linalg_syrk"])
+def linalg_syrk(A, *, transpose=False, alpha=1.0):
+    a = jnp.swapaxes(A, -1, -2) if transpose else A
+    return alpha * jnp.matmul(a, jnp.swapaxes(a, -1, -2))
+
+
+# ---------------------------------------------------------------------------
+# init ops (reference: src/operator/tensor/init_op.cc)
+# ---------------------------------------------------------------------------
+
+
+@register("zeros_like")
+def zeros_like(data):
+    return jnp.zeros_like(data)
+
+
+@register("ones_like")
+def ones_like(data):
+    return jnp.ones_like(data)
+
+
+@register("_zeros", aliases=["zeros"])
+def _zeros(*, shape=(), dtype="float32"):
+    return jnp.zeros(tuple(shape), dtype=jnp.dtype(dtype))
+
+
+@register("_ones", aliases=["ones"])
+def _ones(*, shape=(), dtype="float32"):
+    return jnp.ones(tuple(shape), dtype=jnp.dtype(dtype))
+
+
+@register("_full", aliases=["full"])
+def _full(*, shape=(), value=0.0, dtype="float32"):
+    return jnp.full(tuple(shape), value, dtype=jnp.dtype(dtype))
+
+
+@register("_arange", aliases=["arange"])
+def _arange(*, start=0.0, stop=None, step=1.0, repeat=1, dtype="float32"):
+    out = jnp.arange(start, stop, step, dtype=jnp.dtype(dtype))
+    if repeat > 1:
+        out = jnp.repeat(out, repeat)
+    return out
+
+
+@register("_linspace", aliases=["linspace"])
+def _linspace(*, start=0.0, stop=1.0, num=50, endpoint=True, dtype="float32"):
+    return jnp.linspace(start, stop, num, endpoint=endpoint, dtype=jnp.dtype(dtype))
+
+
+@register("_eye", aliases=["eye"])
+def _eye(*, N=1, M=0, k=0, dtype="float32"):
+    return jnp.eye(N, M if M > 0 else None, k=k, dtype=jnp.dtype(dtype))
+
+
+@register("_contrib_arange_like", aliases=["arange_like"])
+def arange_like(data, *, start=0.0, step=1.0, repeat=1, axis=None):
+    if axis is None:
+        n = data.size
+        out = start + step * jnp.arange(n, dtype=jnp.float32)
+        return out.reshape(data.shape)
+    n = data.shape[axis]
+    return start + step * jnp.arange(n, dtype=jnp.float32)
+
+
+@register("diag")
+def diag(data, *, k=0, axis1=0, axis2=1):
+    if data.ndim == 1:
+        return jnp.diag(data, k=k)
+    return jnp.diagonal(data, offset=k, axis1=axis1, axis2=axis2)
+
+
+@register("shape_array")
+def shape_array(data):
+    return jnp.asarray(data.shape, dtype=jnp.int64)
+
+
+@register("size_array")
+def size_array(data):
+    return jnp.asarray([data.size], dtype=jnp.int64)
+
+
+@register("zeros_without_dtype", aliases=["_zeros_without_dtype"])
+def zeros_without_dtype(*, shape=(), dtype=-1):
+    return jnp.zeros(tuple(shape), dtype=jnp.float32)

@@ -1,0 +1,39 @@
+"""``mx.random`` namespace.
+
+Reference: ``python/mxnet/random.py`` — seed + module-level sampling
+functions delegating to the random ops.
+"""
+from __future__ import annotations
+
+from . import ndarray as nd
+from .random_state import seed  # re-export
+
+__all__ = ["seed", "uniform", "normal", "randn", "randint", "exponential",
+           "gamma", "poisson", "negative_binomial", "multinomial", "shuffle"]
+
+uniform = nd.random.uniform
+normal = nd.random.normal
+randint = nd.random.randint
+exponential = nd.random.exponential
+gamma = nd.random.gamma
+poisson = nd.random.poisson
+negative_binomial = nd.random.negative_binomial
+multinomial = nd.random.sample_multinomial
+nd.random.multinomial = nd.random.sample_multinomial
+
+
+def randn(*shape, ctx=None, dtype="float32", loc=0.0, scale=1.0):
+    return nd.random.normal(loc=loc, scale=scale, shape=shape, ctx=ctx, dtype=dtype)
+
+
+def shuffle(data, **kwargs):
+    from .ndarray import imperative_invoke
+    from .ops.registry import get_op
+
+    return imperative_invoke(get_op("_shuffle"), [data], {})
+
+
+# patch the placeholder in mx.nd.random
+nd.random.seed = seed
+nd.random.randn = randn
+nd.random.shuffle = shuffle

@@ -1,0 +1,78 @@
+"""Global PRNG state.
+
+Reference: ``src/resource.cc :: ResourceManagerImpl`` kRandom resources +
+``python/mxnet/random.py :: seed``. MXNet keeps stateful per-device
+generators; the TPU-native equivalent is a counter-based splittable key:
+
+* eager mode: every random op splits a fresh subkey off the global state;
+* traced mode (hybridize / Symbol executor / jitted train step): the trace
+  scope installs a *traced* base key (an executable input), and subkeys are
+  split deterministically from it — so one compiled executable yields fresh
+  randomness per call by feeding a new base key, with zero recompilation.
+"""
+from __future__ import annotations
+
+import contextlib
+import threading
+
+__all__ = ["seed", "next_key", "scoped_key", "get_state_key"]
+
+_state = threading.local()
+_DEFAULT_SEED = 0
+
+
+def _global():
+    if not hasattr(_state, "key"):
+        import jax
+
+        _state.key = jax.random.PRNGKey(_DEFAULT_SEED)
+    return _state
+
+
+def seed(seed_state, ctx="all") -> None:
+    """Seed the global generator (reference: mx.random.seed)."""
+    import jax
+
+    _global().key = jax.random.PRNGKey(int(seed_state))
+
+
+def next_key():
+    """Return a fresh subkey. Inside a trace scope, split from the scoped
+    (traced) key; otherwise split the stateful global key."""
+    import jax
+
+    st = _global()
+    scoped = getattr(st, "scoped", None)
+    if scoped is not None:
+        key, sub = jax.random.split(scoped[-1])
+        scoped[-1] = key
+        return sub
+    key, sub = jax.random.split(st.key)
+    st.key = key
+    return sub
+
+
+def get_state_key():
+    """Fresh key drawn from the stateful global generator (for feeding a
+    compiled executable's rng input)."""
+    return next_key()
+
+
+@contextlib.contextmanager
+def scoped_key(key):
+    """Install a traced base key: all next_key() calls inside derive from it."""
+    st = _global()
+    prev = getattr(st, "scoped", None)
+    if prev is None:
+        st.scoped = [key]
+    else:
+        st.scoped.append(key)
+    stack = st.scoped
+    depth = len(stack)
+    try:
+        yield
+    finally:
+        # pop our frame (it may have been advanced by splits)
+        del stack[depth - 1 :]
+        if not stack:
+            st.scoped = None

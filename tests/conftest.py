@@ -1,0 +1,21 @@
+"""Shared fixtures. The CPU-forcing re-exec lives in the repo-root
+conftest.py; here we only provide seeding and helpers (reference:
+tests/python/unittest/common.py :: with_seed)."""
+import os
+import random as pyrandom
+
+import numpy as np
+import pytest
+
+
+@pytest.fixture(autouse=True)
+def seeded(request):
+    """Seed np/mx/python RNGs per test; log the seed for repro
+    (reference: common.py::with_seed, env MXNET_TEST_SEED)."""
+    seed = int(os.environ.get("MXNET_TEST_SEED", "0")) or abs(hash(request.node.nodeid)) % (2**31)
+    np.random.seed(seed)
+    pyrandom.seed(seed)
+    import mxnet_tpu as mx
+
+    mx.random.seed(seed)
+    yield seed

@@ -1,0 +1,207 @@
+"""Autograd tests (reference: tests/python/unittest/test_autograd.py)."""
+import numpy as np
+import pytest
+
+import mxnet_tpu as mx
+from mxnet_tpu import autograd
+
+
+def test_simple_grad():
+    x = mx.nd.array([1.0, 2.0, 3.0])
+    x.attach_grad()
+    with autograd.record():
+        y = (x * x).sum()
+    y.backward()
+    assert np.allclose(x.grad.asnumpy(), 2 * np.array([1.0, 2.0, 3.0]))
+
+
+def test_chain_rule():
+    x = mx.nd.array([0.5, -0.5])
+    x.attach_grad()
+    with autograd.record():
+        y = mx.nd.exp(mx.nd.sin(x)).sum()
+    y.backward()
+    expect = np.exp(np.sin([0.5, -0.5])) * np.cos([0.5, -0.5])
+    assert np.allclose(x.grad.asnumpy(), expect, rtol=1e-5)
+
+
+def test_multiple_inputs():
+    a = mx.nd.array([2.0])
+    b = mx.nd.array([3.0])
+    a.attach_grad()
+    b.attach_grad()
+    with autograd.record():
+        out = a * b + a
+    out.backward()
+    assert np.allclose(a.grad.asnumpy(), [4.0])  # b + 1
+    assert np.allclose(b.grad.asnumpy(), [2.0])  # a
+
+
+def test_head_gradient():
+    x = mx.nd.array([1.0, 2.0])
+    x.attach_grad()
+    with autograd.record():
+        y = x * 2
+    y.backward(mx.nd.array([10.0, 100.0]))
+    assert np.allclose(x.grad.asnumpy(), [20.0, 200.0])
+
+
+def test_grad_add_req():
+    x = mx.nd.array([1.0])
+    x.attach_grad(grad_req="add")
+    for _ in range(3):
+        with autograd.record():
+            y = x * 2
+        y.backward()
+    assert np.allclose(x.grad.asnumpy(), [6.0])
+
+
+def test_recording_flags():
+    assert not autograd.is_recording()
+    assert not autograd.is_training()
+    with autograd.record():
+        assert autograd.is_recording()
+        assert autograd.is_training()
+        with autograd.pause():
+            assert not autograd.is_recording()
+            assert not autograd.is_training()
+        with autograd.predict_mode():
+            assert autograd.is_recording()
+            assert not autograd.is_training()
+    with autograd.train_mode():
+        assert autograd.is_training()
+        assert not autograd.is_recording()
+
+
+def test_detach_blocks_grad():
+    x = mx.nd.array([2.0])
+    x.attach_grad()
+    with autograd.record():
+        y = x * x
+        z = y.detach() * x
+    z.backward()
+    # z = const(4) * x, so dz/dx = 4
+    assert np.allclose(x.grad.asnumpy(), [4.0])
+
+
+def test_stop_gradient_op():
+    x = mx.nd.array([2.0])
+    x.attach_grad()
+    with autograd.record():
+        y = mx.nd.BlockGrad(x * x) * x
+    y.backward()
+    assert np.allclose(x.grad.asnumpy(), [4.0])
+
+
+def test_autograd_grad_function():
+    x = mx.nd.array([1.0, 2.0])
+    x.attach_grad()
+    with autograd.record():
+        y = (x ** 3).sum()
+    (gx,) = autograd.grad(y, [x])
+    assert np.allclose(gx.asnumpy(), 3 * np.array([1.0, 4.0]))
+
+
+def test_mutation_during_record_raises():
+    # reference parity: in-place writes to tape-held arrays inside record()
+    # are rejected (a silent stale-tape gradient otherwise)
+    x = mx.nd.array([3.0])
+    x.attach_grad()
+    with autograd.record():
+        y = x * x
+        with pytest.raises(mx.MXNetError):
+            x[:] = 100.0
+        with pytest.raises(mx.MXNetError):
+            x += 1
+    y.backward()
+    assert np.allclose(x.grad.asnumpy(), [6.0])
+
+
+def test_mutation_outside_record_is_safe():
+    # VJP captures values at record time: mutating an input after the record
+    # scope closes must not corrupt the backward.
+    x = mx.nd.array([3.0])
+    x.attach_grad()
+    with autograd.record():
+        y = x * x
+    x[:] = 100.0
+    y.backward()
+    assert np.allclose(x.grad.asnumpy(), [6.0])
+
+
+def test_multi_output_op_grad():
+    x = mx.nd.array([[1.0, 5.0, 2.0]])
+    x.attach_grad()
+    with autograd.record():
+        vals, idx = mx.nd.topk(x, k=2, ret_typ="both")
+        loss = vals.sum()
+    loss.backward()
+    # grads flow to the top-2 positions
+    assert np.allclose(x.grad.asnumpy(), [[0.0, 1.0, 1.0]])
+
+
+def test_softmax_output_fused_grad():
+    # reference: SoftmaxOutput backward = (softmax - onehot) * grad_scale
+    data = mx.nd.array([[1.0, 2.0, 3.0]])
+    label = mx.nd.array([2.0])
+    data.attach_grad()
+    with autograd.record():
+        prob = mx.nd.SoftmaxOutput(data, label)
+    prob.backward()
+    sm = np.exp([1, 2, 3]) / np.exp([1, 2, 3]).sum()
+    expect = sm - np.array([0, 0, 1])
+    assert np.allclose(data.grad.asnumpy(), [expect], rtol=1e-5)
+
+
+def test_custom_function():
+    class Sigmoid(autograd.Function):
+        def forward(self, x):
+            y = mx.nd.sigmoid(x)
+            self.save_for_backward(y)
+            return y
+
+        def backward(self, dy):
+            (y,) = self.saved_tensors
+            return dy * y * (1 - y)
+
+    x = mx.nd.array([0.0, 1.0])
+    x.attach_grad()
+    f = Sigmoid()
+    with autograd.record():
+        y = f(x)
+    y.backward()
+    s = 1 / (1 + np.exp(-np.array([0.0, 1.0])))
+    assert np.allclose(x.grad.asnumpy(), s * (1 - s), rtol=1e-5)
+
+
+def test_finite_difference_oracle():
+    # reference: test_utils.check_numeric_gradient — FD vs autograd
+    rng = np.random.RandomState(0)
+    a = rng.randn(3, 3).astype(np.float32)
+    x = mx.nd.array(a)
+    x.attach_grad()
+    with autograd.record():
+        y = (mx.nd.tanh(mx.nd.dot(x, x)) * 0.5).sum()
+    y.backward()
+    eps = 1e-3
+    fd = np.zeros_like(a)
+    for i in range(3):
+        for j in range(3):
+            ap = a.copy(); ap[i, j] += eps
+            am = a.copy(); am[i, j] -= eps
+            fp = (np.tanh(ap @ ap) * 0.5).sum()
+            fm = (np.tanh(am @ am) * 0.5).sum()
+            fd[i, j] = (fp - fm) / (2 * eps)
+    assert np.allclose(x.grad.asnumpy(), fd, rtol=1e-2, atol=1e-3)
+
+
+def test_training_flag_drives_dropout():
+    x = mx.nd.ones((100, 100))
+    with autograd.record(train_mode=True):
+        y = mx.nd.Dropout(x, p=0.5)
+    assert not np.allclose(y.asnumpy(), 1.0)  # masked
+    with autograd.record(train_mode=False):
+        y2 = mx.nd.Dropout(x, p=0.5)
+    assert np.allclose(y2.asnumpy(), 1.0)  # identity in predict mode
+    y3 = mx.nd.Dropout(x, p=0.5, mode="always")
+    assert not np.allclose(y3.asnumpy(), 1.0)
