@@ -45,4 +45,15 @@ from . import autograd
 from . import random
 from . import random_state
 
+from . import initializer
+from . import init  # noqa: F401  (mx.init alias namespace)
+from . import optimizer
+from . import lr_scheduler
+from . import metric
+from . import gluon
+from . import kvstore
+from . import kvstore as kv
+from . import tracing
+
 from .ndarray import NDArray
+from .optimizer import Optimizer

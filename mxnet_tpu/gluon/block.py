@@ -1,0 +1,541 @@
+"""Gluon Block / HybridBlock.
+
+Reference: ``python/mxnet/gluon/block.py :: Block`` (children tree, param
+collection, hooks, initialize, save/load_parameters) and ``:: HybridBlock``
+(`hybridize()` → CachedOp, `export()`, deferred shape inference).
+
+TPU-native CachedOp (SURVEY.md §3.3 — "THE lowering seam"): MXNet's
+``HybridBlock._build_cache`` traces ``hybrid_forward`` into an nnvm graph
+and runs it via ``src/imperative/cached_op.cc`` with static memory planning
+and op bulking. Here ``hybridize()`` wraps the block's forward in ONE
+``jax.jit`` executable per (input shapes, dtypes, train-flag) key:
+
+* static_alloc ≙ XLA buffer allocation, bulking ≙ XLA fusion — both free;
+* parameters enter as executable inputs so autograd can differentiate the
+  whole fused step via one ``jax.vjp``;
+* in-place aux-state writes during the trace (BatchNorm moving stats) are
+  captured by ``mxnet_tpu.tracing`` and returned as extra outputs, then
+  written back — the functional re-design of MXNet's mutable aux states;
+* random ops draw from a per-call PRNG key input, so one compiled
+  executable yields fresh dropout masks per step with zero recompiles.
+"""
+from __future__ import annotations
+
+import re
+import threading
+from collections import OrderedDict
+from typing import List, Optional
+
+from .. import autograd, engine, random_state, tracing
+from ..base import MXNetError, name_manager
+from ..context import Context, cpu, current_context
+from ..ndarray import NDArray
+from ..ndarray.ndarray import _wrap_jax, imperative_invoke, _LambdaOp
+from .parameter import DeferredInitializationError, Parameter, ParameterDict
+
+__all__ = ["Block", "HybridBlock", "SymbolBlock", "nested_flatten_nd"]
+
+
+class _BlockScope(threading.local):
+    """Name scope for automatic prefixing (reference: block.py::_BlockScope)."""
+
+    def __init__(self):
+        super().__init__()
+        self.current = None
+
+    @staticmethod
+    def create(prefix, params, hint):
+        scope = _scope
+        if scope.current is None:
+            if prefix is None:
+                prefix = name_manager.get(None, hint) + "_"
+            if params is None:
+                params = ParameterDict(prefix)
+            else:
+                params = ParameterDict(params.prefix, shared=params)
+            return prefix, params
+        block = scope.current
+        if prefix is None:
+            prefix = name_manager.get(None, hint) + "_"
+        if params is None:
+            parent = block._block._params
+            params = ParameterDict(parent.prefix + prefix, shared=None)
+        else:
+            params = ParameterDict(params.prefix, shared=params)
+        return block._block.prefix + prefix, params
+
+
+_scope = _BlockScope()
+
+
+class _NameScopeCtx:
+    def __init__(self, block):
+        self._block = block
+        self._old = None
+
+    def __enter__(self):
+        self._old = _scope.current
+        _scope.current = self
+        return self
+
+    def __exit__(self, *exc):
+        _scope.current = self._old
+
+
+class Block:
+    """Base building block (reference: gluon/block.py::Block)."""
+
+    def __init__(self, prefix=None, params=None):
+        self._empty_prefix = prefix == ""
+        self._prefix, self._params = _BlockScope.create(prefix, params, self._alias())
+        self._name = self._prefix[:-1] if self._prefix.endswith("_") else self._prefix
+        self._scope = _NameScopeCtx(self)
+        self._children = OrderedDict()
+        self._reg_params = {}
+        self._forward_hooks = OrderedDict()
+        self._forward_pre_hooks = OrderedDict()
+        self._hook_id = 0
+
+    def _alias(self):
+        return self.__class__.__name__.lower()
+
+    # ------------------------------------------------------------------
+    @property
+    def prefix(self):
+        return self._prefix
+
+    @property
+    def name(self):
+        return self._name
+
+    @property
+    def params(self) -> ParameterDict:
+        return self._params
+
+    def name_scope(self):
+        return self._scope
+
+    def collect_params(self, select: Optional[str] = None) -> ParameterDict:
+        ret = ParameterDict(self._params.prefix)
+        if select is None:
+            ret.update(self._params)
+        else:
+            pat = re.compile(select)
+            ret.update({k: v for k, v in self._params.items() if pat.match(k)})
+        for child in self._children.values():
+            ret.update(child.collect_params(select))
+        return ret
+
+    # ------------------------------------------------------------------
+    def __setattr__(self, name, value):
+        if isinstance(value, Block):
+            existing = self.__dict__.get("_children")
+            if existing is not None:
+                existing[name] = value
+        elif isinstance(value, Parameter):
+            reg = self.__dict__.get("_reg_params")
+            if reg is not None:
+                reg[name] = value
+        super().__setattr__(name, value)
+
+    def register_child(self, block, name=None):
+        self._children[name or str(len(self._children))] = block
+        return block
+
+    def register_forward_hook(self, hook):
+        self._hook_id += 1
+        self._forward_hooks[self._hook_id] = hook
+        return _HookHandle(self._forward_hooks, self._hook_id)
+
+    def register_forward_pre_hook(self, hook):
+        self._hook_id += 1
+        self._forward_pre_hooks[self._hook_id] = hook
+        return _HookHandle(self._forward_pre_hooks, self._hook_id)
+
+    def apply(self, fn):
+        for child in self._children.values():
+            child.apply(fn)
+        fn(self)
+        return self
+
+    def initialize(self, init=None, ctx=None, verbose=False,
+                   force_reinit=False):
+        self.collect_params().initialize(init, ctx, verbose, force_reinit)
+
+    def cast(self, dtype):
+        for child in self._children.values():
+            child.cast(dtype)
+        for p in self._params.values():
+            p.cast(dtype)
+
+    # ------------------------------------------------------------------
+    def __call__(self, *args):
+        for hook in self._forward_pre_hooks.values():
+            hook(self, args)
+        out = self.forward(*args)
+        for hook in self._forward_hooks.values():
+            hook(self, args, out)
+        return out
+
+    def forward(self, *args):
+        raise NotImplementedError
+
+    def summary(self, *inputs):
+        rows = []
+
+        def add_hooks(blk, path):
+            hs = []
+            for name, child in blk._children.items():
+                hs += add_hooks(child, f"{path}.{name}")
+            h = blk.register_forward_hook(
+                lambda b, i, o, path=path: rows.append(
+                    (path, type(b).__name__,
+                     getattr(o[0] if isinstance(o, (list, tuple)) else o, "shape", None))))
+            hs.append(h)
+            return hs
+
+        handles = add_hooks(self, self._name)
+        try:
+            self(*inputs)
+        finally:
+            for h in handles:
+                h.detach()
+        lines = [f"{'Layer':<40}{'Type':<25}{'Output shape'}"]
+        lines += [f"{p:<40}{t:<25}{s}" for p, t, s in rows]
+        return "\n".join(lines)
+
+    # ------------------------------------------------------------------
+    def save_parameters(self, filename, deduplicate=False):
+        """reference: Block.save_parameters — params only, keyed by the
+        block-relative name so models are prefix-independent."""
+        params = self._collect_params_with_prefix()
+        from ..ndarray import serialization
+
+        serialization.save(filename, {k: v.data().as_in_context(cpu(0))
+                                      for k, v in params.items()})
+
+    def load_parameters(self, filename, ctx=None, allow_missing=False,
+                        ignore_extra=False, cast_dtype=False,
+                        dtype_source="current"):
+        from ..ndarray import serialization
+
+        loaded = serialization.load(filename)
+        if isinstance(loaded, list):
+            raise MXNetError(f"{filename} holds a list, not a parameter dict")
+        loaded = {k[4:] if k.startswith(("arg:", "aux:")) else k: v
+                  for k, v in loaded.items()}
+        params = self._collect_params_with_prefix()
+        if not allow_missing:
+            for name in params:
+                if name not in loaded:
+                    raise MXNetError(
+                        f"Parameter {name} missing in {filename} "
+                        "(allow_missing=False)")
+        for name, v in loaded.items():
+            if name not in params:
+                if not ignore_extra:
+                    raise MXNetError(
+                        f"{filename} contains extra parameter {name} "
+                        "(ignore_extra=False)")
+                continue
+            p = params[name]
+            if cast_dtype:
+                if dtype_source == "current" and p._data is not None:
+                    v = v.astype(str(p.dtype))
+                elif dtype_source == "saved":
+                    p.dtype = str(v.dtype)
+            if p._data is None and p._deferred_init is None:
+                p.initialize(ctx=ctx or cpu(0))
+            p.set_data(v)
+
+    def _collect_params_with_prefix(self, prefix=""):
+        if prefix:
+            prefix += "."
+        ret = {prefix + name: p for name, p in self._reg_params.items()}
+        for name, child in self._children.items():
+            ret.update(child._collect_params_with_prefix(prefix + name))
+        return ret
+
+    def hybridize(self, active=True, **kwargs):
+        for child in self._children.values():
+            child.hybridize(active, **kwargs)
+
+    def __repr__(self):
+        s = f"{self.__class__.__name__}(\n"
+        for name, child in self._children.items():
+            s += f"  ({name}): {repr(child)}\n"
+        return s + ")"
+
+
+class _HookHandle:
+    def __init__(self, hooks, hid):
+        self._hooks = hooks
+        self._id = hid
+
+    def detach(self):
+        self._hooks.pop(self._id, None)
+
+
+def nested_flatten_nd(out):
+    """Flatten nested (tuple/list of) NDArray into a flat list + treedef."""
+    flat = []
+
+    def walk(o):
+        if isinstance(o, NDArray):
+            flat.append(o)
+            return ("leaf", len(flat) - 1)
+        if isinstance(o, (list, tuple)):
+            return ("seq", type(o).__name__, [walk(x) for x in o])
+        raise MXNetError(f"hybrid forward returned unsupported type {type(o)}")
+
+    tree = walk(out)
+    return flat, tree
+
+
+def nested_unflatten_nd(tree, flat):
+    kind = tree[0]
+    if kind == "leaf":
+        return flat[tree[1]]
+    _, tname, children = tree
+    seq = [nested_unflatten_nd(c, flat) for c in children]
+    return tuple(seq) if tname == "tuple" else seq
+
+
+class _CachedGraph:
+    """One compiled executable per (shapes, dtypes, train-flag) key — the
+    jax.jit equivalent of ``src/imperative/cached_op.cc :: CachedOp``."""
+
+    def __init__(self, block, flags):
+        self.block = block
+        self.flags = dict(flags or {})
+        self._cache = {}
+
+    def clear(self):
+        self._cache.clear()
+
+    def __call__(self, args: List[NDArray]):
+        import jax
+
+        block = self.block
+        ctx = args[0].context if args else current_context()
+        params = [p for p in block.collect_params().values()]
+        # deferred shapes must be settled before tracing
+        if any(p._data is None for p in params):
+            raise DeferredInitializationError  # caller runs one eager pass
+        param_arrays = [p.data(ctx) for p in params]
+        training = autograd.is_training()
+        key = (
+            tuple((a.shape, str(a.dtype)) for a in args),
+            tuple((a.shape, str(a.dtype)) for a in param_arrays),
+            training,
+        )
+        entry = self._cache.get(key)
+        if entry is None:
+            entry = self._build(param_arrays, args, ctx, training)
+            self._cache[key] = entry
+        jitted, cell = entry["jitted"], entry["cell"]
+        rng = random_state.get_state_key()
+
+        n_params = len(param_arrays)
+
+        def call_fn(*tensors):
+            pvals = tensors[:n_params]
+            ivals = tensors[n_params:]
+            outs, aux = jitted(tuple(pvals), rng, *ivals)
+            return tuple(outs) + tuple(aux)
+
+        results = imperative_invoke(
+            _LambdaOp(call_fn, f"CachedOp_{block.name}"),
+            list(param_arrays) + list(args), {}, ctx=ctx)
+        if not isinstance(results, list):
+            results = [results]
+        n_out = cell["n_out"]
+        out_nd = results[:n_out]
+        aux_nd = results[n_out:]
+        for arr, v in zip(cell["aux_arrays"], aux_nd):
+            arr._set_data(v.data)
+        return nested_unflatten_nd(cell["treedef"], out_nd)
+
+    def _build(self, param_arrays, args, ctx, training):
+        import jax
+
+        block = self.block
+        cell = {"aux_arrays": None, "treedef": None, "n_out": None}
+
+        def pure(param_vals, rng, *input_vals):
+            prev_rec = autograd.set_recording(False)
+            prev_train = autograd.set_training(training)
+            olds = [arr._data for arr in param_arrays]
+            with tracing.mutation_scope() as log:
+                with random_state.scoped_key(rng):
+                    try:
+                        for arr, v in zip(param_arrays, param_vals):
+                            arr._data = v
+                            arr._version += 1
+                        nd_in = [NDArray(data=v, ctx=ctx) for v in input_vals]
+                        out = block._eager_forward(*nd_in)
+                        flat, tree = nested_flatten_nd(out)
+                        aux_arrays = [a for a in log.arrays]
+                        cell["aux_arrays"] = aux_arrays
+                        cell["treedef"] = tree
+                        cell["n_out"] = len(flat)
+                        out_vals = tuple(o.data for o in flat)
+                        aux_vals = tuple(a.data for a in aux_arrays)
+                        return out_vals, aux_vals
+                    finally:
+                        # restore any concrete payloads clobbered by tracers:
+                        # first logged mutations, then the param swaps
+                        for a, orig in log.originals:
+                            a._data = orig
+                            a._version += 1
+                        for arr, old in zip(param_arrays, olds):
+                            arr._data = old
+                            arr._version += 1
+                        autograd.set_recording(prev_rec)
+                        autograd.set_training(prev_train)
+
+        return {"jitted": jax.jit(pure), "cell": cell}
+
+
+class HybridBlock(Block):
+    """Block that can be compiled to one XLA executable
+    (reference: gluon/block.py::HybridBlock)."""
+
+    def __init__(self, prefix=None, params=None):
+        super().__init__(prefix=prefix, params=params)
+        self._active = False
+        self._flags = {}
+        self._cached_graph = None
+
+    def hybridize(self, active=True, static_alloc=False, static_shape=False,
+                  inline_limit=2, forward_bulk_size=None,
+                  backward_bulk_size=None, **kwargs):
+        """Compile this block (reference: HybridBlock.hybridize; the
+        CachedOpConfig flags map to XLA behaviors — static_alloc/bulking are
+        native to XLA, kept for API compat)."""
+        self._active = active
+        self._flags = {"static_alloc": static_alloc, "static_shape": static_shape}
+        self._cached_graph = None
+        super().hybridize(active, static_alloc=static_alloc,
+                          static_shape=static_shape, **kwargs)
+
+    def _clear_cached_op(self):
+        self._cached_graph = None
+
+    def cast(self, dtype):
+        self._clear_cached_op()
+        super().cast(dtype)
+
+    def infer_shape(self, *args):
+        """Resolve deferred parameter shapes from sample inputs."""
+        self._deferred_infer_shape(*args)
+
+    def _deferred_infer_shape(self, *args):
+        with autograd.pause():
+            self._eager_forward(*args)
+
+    # ------------------------------------------------------------------
+    def forward(self, *args):
+        if self._active and args and isinstance(args[0], NDArray) \
+                and not tracing.is_tracing():
+            if self._cached_graph is None:
+                self._cached_graph = _CachedGraph(self, self._flags)
+            try:
+                return self._cached_graph(list(args))
+            except DeferredInitializationError:
+                self._deferred_infer_shape(*args)
+                return self._cached_graph(list(args))
+        return self._eager_forward(*args)
+
+    def _eager_forward(self, *args):
+        """Un-compiled forward: resolve params and call hybrid_forward."""
+        from .. import ndarray as nd_mod
+
+        ctx = None
+        for a in args:
+            if isinstance(a, NDArray):
+                ctx = a.context
+                break
+        if ctx is None:
+            ctx = current_context()
+        try:
+            pdata = {name: p.data(ctx) for name, p in self._reg_params.items()}
+        except DeferredInitializationError:
+            self._infer_param_shapes(*args)
+            pdata = {name: p.data(ctx) for name, p in self._reg_params.items()}
+        return self.hybrid_forward(nd_mod, *args, **pdata)
+
+    def _infer_param_shapes(self, *args):
+        """Layer-specific deferred-shape resolution; layers with deferred
+        params override (reference: the nnvm infer_shape pass feeding
+        _finish_deferred_init)."""
+        raise DeferredInitializationError(
+            f"{self.name}: parameter shapes are unknown and "
+            f"{type(self).__name__} does not implement shape inference; "
+            "initialize with explicit shapes")
+
+    def hybrid_forward(self, F, *args, **params):
+        raise NotImplementedError
+
+    def export(self, path, epoch=0):
+        """Export architecture + params (reference: HybridBlock.export →
+        prefix-symbol.json + prefix-%04d.params)."""
+        from ..symbol.export import export_hybrid_block
+
+        return export_hybrid_block(self, path, epoch)
+
+    def optimize_for(self, x, backend=None, **kwargs):
+        """Custom graph-pass hook (reference: HybridBlock.optimize_for).
+        XLA performs fusion natively; this triggers hybridization."""
+        self.hybridize()
+        return self(x)
+
+
+class SymbolBlock(HybridBlock):
+    """Import a symbolic graph as a Block (reference:
+    gluon/block.py::SymbolBlock). Completed in mxnet_tpu/symbol."""
+
+    def __init__(self, outputs, inputs, params=None):
+        super().__init__(prefix="", params=params)
+        self._sym_outputs = outputs
+        self._sym_inputs = inputs if isinstance(inputs, (list, tuple)) else [inputs]
+        from ..symbol import Symbol
+
+        out = outputs if isinstance(outputs, Symbol) else outputs[0]
+        self._out_sym = outputs
+        # register params for every non-input argument of the graph
+        input_names = {s.name for s in self._sym_inputs}
+        for name in out.list_arguments():
+            if name not in input_names:
+                self._reg_params[name] = self.params.get(
+                    name, allow_deferred_init=True)
+        for name in out.list_auxiliary_states():
+            self._reg_params[name] = self.params.get(
+                name, grad_req="null", allow_deferred_init=True)
+
+    @staticmethod
+    def imports(symbol_file, input_names, param_file=None, ctx=None):
+        from ..symbol import load as sym_load, var
+
+        sym = sym_load(symbol_file)
+        if isinstance(input_names, str):
+            input_names = [input_names]
+        inputs = [var(n) for n in input_names]
+        block = SymbolBlock(sym, inputs)
+        if param_file is not None:
+            block.load_parameters(param_file, ctx=ctx, cast_dtype=True,
+                                  allow_missing=False, ignore_extra=False)
+        return block
+
+    def _collect_params_with_prefix(self, prefix=""):
+        if prefix:
+            prefix += "."
+        return {prefix + name: p for name, p in self._reg_params.items()}
+
+    def hybrid_forward(self, F, *args, **params):
+        from ..symbol.executor import eval_symbol
+
+        feed = {s.name: a for s, a in zip(self._sym_inputs, args)}
+        feed.update(params)
+        out = eval_symbol(self._out_sym, feed)
+        return out

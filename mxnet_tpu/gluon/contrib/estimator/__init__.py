@@ -1,0 +1,7 @@
+"""Estimator fit-loop + event handlers (reference:
+python/mxnet/gluon/contrib/estimator/)."""
+from .estimator import Estimator  # noqa: F401
+from .event_handler import (CheckpointHandler, EarlyStoppingHandler,  # noqa: F401
+                            EpochBegin, EpochEnd, LoggingHandler,
+                            MetricHandler, StoppingHandler, TrainBegin,
+                            TrainEnd, ValidationHandler)

@@ -1,0 +1,88 @@
+"""Contrib layers.
+
+Reference: ``python/mxnet/gluon/contrib/nn/basic_layers.py`` —
+SyncBatchNorm, HybridConcurrent, Concurrent, Identity, SparseEmbedding,
+PixelShuffle.
+"""
+from __future__ import annotations
+
+from ... import nn as _nn
+from ...block import Block, HybridBlock
+from ...nn.basic_layers import BatchNorm
+
+__all__ = ["Concurrent", "HybridConcurrent", "Identity", "SyncBatchNorm",
+           "PixelShuffle2D"]
+
+
+class Concurrent(Block):
+    """Parallel branches concatenated (reference: contrib Concurrent)."""
+
+    def __init__(self, axis=-1, prefix=None, params=None):
+        super().__init__(prefix=prefix, params=params)
+        self.axis = axis
+
+    def add(self, *blocks):
+        for block in blocks:
+            self.register_child(block)
+
+    def forward(self, x):
+        from .... import ndarray as F
+
+        out = [block(x) for block in self._children.values()]
+        return F.concat(*out, dim=self.axis)
+
+
+class HybridConcurrent(HybridBlock):
+    def __init__(self, axis=-1, prefix=None, params=None):
+        super().__init__(prefix=prefix, params=params)
+        self.axis = axis
+
+    def add(self, *blocks):
+        for block in blocks:
+            self.register_child(block)
+
+    def hybrid_forward(self, F, x):
+        out = [block(x) for block in self._children.values()]
+        return F.concat(*out, dim=self.axis)
+
+
+class Identity(HybridBlock):
+    def hybrid_forward(self, F, x):
+        return x
+
+
+class SyncBatchNorm(BatchNorm):
+    """Cross-device batch norm (reference:
+    src/operator/contrib/sync_batch_norm.cc + gluon contrib wrapper).
+
+    TPU-native: under pjit/shard_map data parallelism, batch statistics are
+    global when computed inside the sharded graph with a `psum` mean — the
+    parallel.Mesh data-parallel step does exactly that, so this class only
+    needs to flag the intent; on a single device it equals BatchNorm
+    (SURVEY.md §2.4 row SyncBatchNorm: "lax.pmean of moments — trivial on
+    TPU").
+    """
+
+    def __init__(self, in_channels=0, num_devices=None, momentum=0.9,
+                 epsilon=1e-5, center=True, scale=True, use_global_stats=False,
+                 beta_initializer="zeros", gamma_initializer="ones",
+                 running_mean_initializer="zeros",
+                 running_variance_initializer="ones", **kwargs):
+        super().__init__(
+            axis=1, momentum=momentum, epsilon=epsilon, center=center,
+            scale=scale, use_global_stats=use_global_stats,
+            beta_initializer=beta_initializer,
+            gamma_initializer=gamma_initializer,
+            running_mean_initializer=running_mean_initializer,
+            running_variance_initializer=running_variance_initializer,
+            in_channels=in_channels, **kwargs)
+        self._num_devices = num_devices
+
+
+class PixelShuffle2D(HybridBlock):
+    def __init__(self, factor):
+        super().__init__()
+        self._factor = factor if isinstance(factor, int) else factor[0]
+
+    def hybrid_forward(self, F, x):
+        return F.depth_to_space(x, block_size=self._factor)

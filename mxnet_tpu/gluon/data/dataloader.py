@@ -1,0 +1,177 @@
+"""DataLoader — the host input pipeline.
+
+Reference: ``python/mxnet/gluon/data/dataloader.py :: DataLoader`` —
+multiprocessing workers + POSIX-shm NDArray rebuild
+(``src/storage/cpu_shared_storage_manager.h``), `default_batchify_fn`,
+`pin_memory`, thread_pool mode, prefetch.
+
+TPU-native design: workers produce **numpy** batches on the host (the
+TPU analogue of cpu_shared memory — host staging buffers); the final
+``device_put`` happens when the consumer moves the batch to its context
+(`batch.as_in_context(mx.tpu())`), which XLA overlaps with compute.
+Worker transport uses multiprocessing with pickled numpy (zero-copy shm is
+an optimization slot; the API contract is identical). A prefetch queue of
+``2*num_workers`` batches keeps the device fed.
+"""
+from __future__ import annotations
+
+import multiprocessing
+import os
+import sys
+import threading
+import queue as _queue
+from typing import Callable, Optional
+
+import numpy as _np
+
+from ...base import MXNetError
+from ...context import cpu_pinned
+from ...ndarray import NDArray, array as nd_array
+from .dataset import Dataset
+from .sampler import BatchSampler, RandomSampler, Sampler, SequentialSampler
+
+__all__ = ["DataLoader", "default_batchify_fn", "default_mp_batchify_fn"]
+
+
+def default_batchify_fn(data):
+    """Stack samples into a batch (reference: dataloader.py::default_batchify_fn)."""
+    if isinstance(data[0], NDArray):
+        import jax.numpy as jnp
+
+        return nd_array(_np.stack([d.asnumpy() for d in data]))
+    if isinstance(data[0], tuple):
+        data = zip(*data)
+        return [default_batchify_fn(list(i)) for i in data]
+    arr = _np.asarray(data)
+    return nd_array(arr)
+
+
+default_mp_batchify_fn = default_batchify_fn
+
+
+def _as_numpy_sample(sample):
+    if isinstance(sample, NDArray):
+        return sample.asnumpy()
+    if isinstance(sample, tuple):
+        return tuple(_as_numpy_sample(s) for s in sample)
+    return sample
+
+
+_worker_dataset = None
+
+
+def _worker_initializer(dataset):
+    global _worker_dataset
+    _worker_dataset = dataset
+
+
+def _worker_fn(samples, batchify_is_default):
+    """Runs in a worker process: fetch + transform samples, return numpy."""
+    global _worker_dataset
+    out = [_as_numpy_sample(_worker_dataset[i]) for i in samples]
+    return out
+
+
+class DataLoader:
+    """Mini-batch loader over a Dataset (reference: dataloader.py::DataLoader)."""
+
+    def __init__(self, dataset, batch_size=None, shuffle=False, sampler=None,
+                 last_batch=None, batch_sampler=None, batchify_fn=None,
+                 num_workers=0, pin_memory=False, pin_device_id=0,
+                 prefetch=None, thread_pool=False, timeout=120):
+        self._dataset = dataset
+        self._pin_memory = pin_memory
+        self._thread_pool = thread_pool
+        self._timeout = timeout
+        if batch_sampler is None:
+            if batch_size is None:
+                raise MXNetError(
+                    "batch_size must be specified unless batch_sampler is")
+            if sampler is None:
+                sampler = RandomSampler(len(dataset)) if shuffle \
+                    else SequentialSampler(len(dataset))
+            elif shuffle:
+                raise MXNetError("shuffle must be False with a custom sampler")
+            batch_sampler = BatchSampler(sampler, batch_size, last_batch or "keep")
+        elif (batch_size is not None or shuffle or sampler is not None
+              or last_batch is not None):
+            raise MXNetError(
+                "batch_size/shuffle/sampler/last_batch must not be given "
+                "with a batch_sampler")
+        self._batch_sampler = batch_sampler
+        self._num_workers = max(0, num_workers)
+        self._prefetch = max(0, prefetch if prefetch is not None
+                             else 2 * self._num_workers)
+        self._batchify_fn = batchify_fn or default_batchify_fn
+        self._pool = None
+        if self._num_workers > 0:
+            # Worker transport: thread pool by default. fork() after JAX
+            # initialization can deadlock (JAX is multithreaded), and jax ops
+            # release the GIL, so threads give the same overlap the
+            # reference gets from processes+shm without the fork hazard.
+            # Real process workers are opt-in via MXNET_TPU_FORK_WORKERS=1.
+            if not thread_pool and os.environ.get("MXNET_TPU_FORK_WORKERS"):
+                ctx = multiprocessing.get_context("fork")
+                self._pool = ctx.Pool(
+                    self._num_workers, initializer=_worker_initializer,
+                    initargs=(dataset,))
+            else:
+                from multiprocessing.pool import ThreadPool
+
+                self._thread_pool = True
+                self._pool = ThreadPool(self._num_workers)
+
+    def __len__(self):
+        return len(self._batch_sampler)
+
+    def __iter__(self):
+        if self._pool is None:
+            for batch_idx in self._batch_sampler:
+                yield self._batchify([self._dataset[i] for i in batch_idx])
+            return
+        # async path: schedule `prefetch` batches ahead through the pool
+        pending = _queue.Queue()
+        it = iter(self._batch_sampler)
+
+        def submit():
+            try:
+                batch_idx = next(it)
+            except StopIteration:
+                return False
+            if self._thread_pool:
+                res = self._pool.apply_async(
+                    lambda idx: [_as_numpy_sample(self._dataset[i]) for i in idx],
+                    (batch_idx,))
+            else:
+                res = self._pool.apply_async(_worker_fn, (batch_idx, True))
+            pending.put(res)
+            return True
+
+        for _ in range(self._prefetch or 1):
+            if not submit():
+                break
+        while not pending.empty():
+            res = pending.get()
+            samples = res.get(self._timeout)
+            submit()
+            yield self._batchify(samples)
+
+    def _batchify(self, samples):
+        batch = self._batchify_fn(samples)
+        if self._pin_memory:
+            batch = _pin(batch)
+        return batch
+
+    def __del__(self):
+        pool = getattr(self, "_pool", None)
+        if pool is not None:
+            try:
+                pool.terminate()
+            except Exception:
+                pass  # interpreter shutdown: pool internals may be gone
+
+
+def _pin(batch):
+    if isinstance(batch, (list, tuple)):
+        return [_pin(b) for b in batch]
+    return batch.as_in_context(cpu_pinned())

@@ -1,0 +1,12 @@
+"""Vision model zoo — populated in the model-zoo milestone."""
+_models = {}
+
+
+def get_model(name, **kwargs):
+    from ....base import MXNetError
+
+    name = name.lower()
+    if name not in _models:
+        raise MXNetError(
+            f"model {name!r} is not in the zoo yet; available: {sorted(_models)}")
+    return _models[name](**kwargs)

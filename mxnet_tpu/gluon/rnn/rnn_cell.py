@@ -1,0 +1,424 @@
+"""Recurrent cells.
+
+Reference: ``python/mxnet/gluon/rnn/rnn_cell.py`` — RecurrentCell base
+(begin_state, unroll, state_info), RNNCell, LSTMCell, GRUCell,
+SequentialRNNCell, DropoutCell, ModifierCell (Zoneout/Residual),
+BidirectionalCell.
+"""
+from __future__ import annotations
+
+from ... import ndarray as nd
+from ...base import MXNetError
+from ..block import HybridBlock
+
+__all__ = ["RecurrentCell", "HybridRecurrentCell", "RNNCell", "LSTMCell",
+           "GRUCell", "SequentialRNNCell", "DropoutCell", "ZoneoutCell",
+           "ResidualCell", "BidirectionalCell", "ModifierCell"]
+
+
+def _format_sequence(length, inputs, layout, merge):
+    from ... import ndarray as F
+
+    axis = layout.find("T")
+    batch_axis = layout.find("N")
+    if isinstance(inputs, (list, tuple)):
+        in_list = list(inputs)
+        batch = in_list[0].shape[0]
+    else:
+        if axis != 0:
+            inputs = inputs.swapaxes(0, axis)
+        batch = inputs.shape[1]
+        in_list = [inputs[i] for i in range(inputs.shape[0])]
+    return in_list, axis, batch
+
+
+def _mask_sequence_variable_length(F, data, length, valid_length, time_axis,
+                                   merge):
+    raise NotImplementedError
+
+
+class RecurrentCell(HybridBlock):
+    def __init__(self, prefix=None, params=None):
+        super().__init__(prefix=prefix, params=params)
+        self._modified = False
+        self.reset()
+
+    def reset(self):
+        self._init_counter = -1
+        self._counter = -1
+        for cell in self._children.values():
+            if isinstance(cell, RecurrentCell):
+                cell.reset()
+
+    def state_info(self, batch_size=0):
+        raise NotImplementedError
+
+    def begin_state(self, batch_size=0, func=None, ctx=None, **kwargs):
+        from ... import ndarray as F
+
+        func = func or F.zeros
+        states = []
+        for info in self.state_info(batch_size):
+            self._init_counter += 1
+            shape = info["shape"] if isinstance(info, dict) else info
+            states.append(func(shape=shape, ctx=ctx, **kwargs))
+        return states
+
+    def __call__(self, inputs, states=None):
+        self._counter += 1
+        return super().__call__(inputs, states)
+
+    def unroll(self, length, inputs, begin_state=None, layout="NTC",
+               merge_outputs=None, valid_length=None):
+        """Unroll the cell over `length` steps (reference:
+        RecurrentCell.unroll)."""
+        from ... import ndarray as F
+
+        self.reset()
+        in_list, axis, batch = _format_sequence(length, inputs, layout, False)
+        if begin_state is None:
+            ctx = in_list[0].context
+            begin_state = self.begin_state(batch, ctx=ctx,
+                                           dtype=str(in_list[0].dtype))
+        states = begin_state
+        outputs = []
+        all_states = [] if valid_length is not None else None
+        for i in range(length):
+            output, states = self(in_list[i], states)
+            outputs.append(output)
+            if all_states is not None:
+                all_states.append(states)
+        if valid_length is not None:
+            stacked = F.stack(*outputs, axis=0)
+            stacked = F.SequenceMask(stacked, valid_length,
+                                     use_sequence_length=True, axis=0)
+            outputs = [stacked[i] for i in range(length)]
+            # per-sequence final state = state at its own last valid step
+            # (reference: unroll uses F.SequenceLast over the stacked states)
+            states = []
+            for s_idx in range(len(all_states[0])):
+                s_seq = F.stack(*[st[s_idx] for st in all_states], axis=0)
+                states.append(F.SequenceLast(s_seq, valid_length,
+                                             use_sequence_length=True, axis=0))
+        if merge_outputs:
+            t_axis = layout.find("T")
+            outputs = F.stack(*outputs, axis=t_axis)
+        return outputs, states
+
+    def forward(self, inputs, states=None):
+        if states is None:
+            states = self.begin_state(inputs.shape[0], ctx=inputs.context,
+                                      dtype=str(inputs.dtype))
+        return super().forward(inputs, states)
+
+
+HybridRecurrentCell = RecurrentCell
+
+
+class RNNCell(RecurrentCell):
+    def __init__(self, hidden_size, activation="tanh", input_size=0,
+                 i2h_weight_initializer=None, h2h_weight_initializer=None,
+                 i2h_bias_initializer="zeros", h2h_bias_initializer="zeros",
+                 prefix=None, params=None):
+        super().__init__(prefix=prefix, params=params)
+        self._hidden_size = hidden_size
+        self._activation = activation
+        self._input_size = input_size
+        with self.name_scope():
+            self.i2h_weight = self.params.get(
+                "i2h_weight", shape=(hidden_size, input_size),
+                init=i2h_weight_initializer, allow_deferred_init=True)
+            self.h2h_weight = self.params.get(
+                "h2h_weight", shape=(hidden_size, hidden_size),
+                init=h2h_weight_initializer, allow_deferred_init=True)
+            self.i2h_bias = self.params.get(
+                "i2h_bias", shape=(hidden_size,), init=i2h_bias_initializer)
+            self.h2h_bias = self.params.get(
+                "h2h_bias", shape=(hidden_size,), init=h2h_bias_initializer)
+
+    def state_info(self, batch_size=0):
+        return [{"shape": (batch_size, self._hidden_size), "__layout__": "NC"}]
+
+    def _alias(self):
+        return "rnn"
+
+    def _infer_param_shapes(self, x, *rest):
+        self.i2h_weight._finish_deferred_init((self._hidden_size, x.shape[-1]))
+        self.h2h_weight._finish_deferred_init(
+            (self._hidden_size, self._hidden_size))
+
+    def hybrid_forward(self, F, inputs, states, i2h_weight, h2h_weight,
+                       i2h_bias, h2h_bias):
+        i2h = F.FullyConnected(inputs, i2h_weight, i2h_bias,
+                               num_hidden=self._hidden_size)
+        h2h = F.FullyConnected(states[0], h2h_weight, h2h_bias,
+                               num_hidden=self._hidden_size)
+        output = F.Activation(i2h + h2h, act_type=self._activation)
+        return output, [output]
+
+
+class LSTMCell(RecurrentCell):
+    """reference: rnn_cell.py::LSTMCell — gates i, f, g(c~), o."""
+
+    def __init__(self, hidden_size, input_size=0,
+                 i2h_weight_initializer=None, h2h_weight_initializer=None,
+                 i2h_bias_initializer="zeros", h2h_bias_initializer="zeros",
+                 prefix=None, params=None):
+        super().__init__(prefix=prefix, params=params)
+        self._hidden_size = hidden_size
+        self._input_size = input_size
+        with self.name_scope():
+            self.i2h_weight = self.params.get(
+                "i2h_weight", shape=(4 * hidden_size, input_size),
+                init=i2h_weight_initializer, allow_deferred_init=True)
+            self.h2h_weight = self.params.get(
+                "h2h_weight", shape=(4 * hidden_size, hidden_size),
+                init=h2h_weight_initializer, allow_deferred_init=True)
+            self.i2h_bias = self.params.get(
+                "i2h_bias", shape=(4 * hidden_size,), init=i2h_bias_initializer)
+            self.h2h_bias = self.params.get(
+                "h2h_bias", shape=(4 * hidden_size,), init=h2h_bias_initializer)
+
+    def state_info(self, batch_size=0):
+        return [{"shape": (batch_size, self._hidden_size), "__layout__": "NC"},
+                {"shape": (batch_size, self._hidden_size), "__layout__": "NC"}]
+
+    def _alias(self):
+        return "lstm"
+
+    def _infer_param_shapes(self, x, *rest):
+        self.i2h_weight._finish_deferred_init(
+            (4 * self._hidden_size, x.shape[-1]))
+        self.h2h_weight._finish_deferred_init(
+            (4 * self._hidden_size, self._hidden_size))
+
+    def hybrid_forward(self, F, inputs, states, i2h_weight, h2h_weight,
+                       i2h_bias, h2h_bias):
+        i2h = F.FullyConnected(inputs, i2h_weight, i2h_bias,
+                               num_hidden=4 * self._hidden_size)
+        h2h = F.FullyConnected(states[0], h2h_weight, h2h_bias,
+                               num_hidden=4 * self._hidden_size)
+        gates = i2h + h2h
+        in_gate, forget_gate, in_trans, out_gate = F.split(
+            gates, num_outputs=4, axis=1)
+        in_gate = F.sigmoid(in_gate)
+        forget_gate = F.sigmoid(forget_gate)
+        in_trans = F.Activation(in_trans, act_type="tanh")
+        out_gate = F.sigmoid(out_gate)
+        next_c = forget_gate * states[1] + in_gate * in_trans
+        next_h = out_gate * F.Activation(next_c, act_type="tanh")
+        return next_h, [next_h, next_c]
+
+
+class GRUCell(RecurrentCell):
+    """reference: rnn_cell.py::GRUCell — gates r, z, n."""
+
+    def __init__(self, hidden_size, input_size=0,
+                 i2h_weight_initializer=None, h2h_weight_initializer=None,
+                 i2h_bias_initializer="zeros", h2h_bias_initializer="zeros",
+                 prefix=None, params=None):
+        super().__init__(prefix=prefix, params=params)
+        self._hidden_size = hidden_size
+        self._input_size = input_size
+        with self.name_scope():
+            self.i2h_weight = self.params.get(
+                "i2h_weight", shape=(3 * hidden_size, input_size),
+                init=i2h_weight_initializer, allow_deferred_init=True)
+            self.h2h_weight = self.params.get(
+                "h2h_weight", shape=(3 * hidden_size, hidden_size),
+                init=h2h_weight_initializer, allow_deferred_init=True)
+            self.i2h_bias = self.params.get(
+                "i2h_bias", shape=(3 * hidden_size,), init=i2h_bias_initializer)
+            self.h2h_bias = self.params.get(
+                "h2h_bias", shape=(3 * hidden_size,), init=h2h_bias_initializer)
+
+    def state_info(self, batch_size=0):
+        return [{"shape": (batch_size, self._hidden_size), "__layout__": "NC"}]
+
+    def _alias(self):
+        return "gru"
+
+    def _infer_param_shapes(self, x, *rest):
+        self.i2h_weight._finish_deferred_init(
+            (3 * self._hidden_size, x.shape[-1]))
+        self.h2h_weight._finish_deferred_init(
+            (3 * self._hidden_size, self._hidden_size))
+
+    def hybrid_forward(self, F, inputs, states, i2h_weight, h2h_weight,
+                       i2h_bias, h2h_bias):
+        prev_h = states[0]
+        i2h = F.FullyConnected(inputs, i2h_weight, i2h_bias,
+                               num_hidden=3 * self._hidden_size)
+        h2h = F.FullyConnected(prev_h, h2h_weight, h2h_bias,
+                               num_hidden=3 * self._hidden_size)
+        i2h_r, i2h_z, i2h_n = F.split(i2h, num_outputs=3, axis=1)
+        h2h_r, h2h_z, h2h_n = F.split(h2h, num_outputs=3, axis=1)
+        reset = F.sigmoid(i2h_r + h2h_r)
+        update = F.sigmoid(i2h_z + h2h_z)
+        next_n = F.Activation(i2h_n + reset * h2h_n, act_type="tanh")
+        next_h = (1.0 - update) * next_n + update * prev_h
+        return next_h, [next_h]
+
+
+class SequentialRNNCell(RecurrentCell):
+    def __init__(self, prefix=None, params=None):
+        super().__init__(prefix=prefix, params=params)
+
+    def add(self, cell):
+        self.register_child(cell)
+
+    def state_info(self, batch_size=0):
+        infos = []
+        for cell in self._children.values():
+            infos.extend(cell.state_info(batch_size))
+        return infos
+
+    def __len__(self):
+        return len(self._children)
+
+    def __getitem__(self, i):
+        return list(self._children.values())[i]
+
+    def hybrid_forward(self, F, inputs, states):
+        next_states = []
+        p = 0
+        for cell in self._children.values():
+            n = len(cell.state_info())
+            state = states[p : p + n]
+            p += n
+            inputs, state = cell(inputs, state)
+            next_states.extend(state)
+        return inputs, next_states
+
+
+class DropoutCell(RecurrentCell):
+    def __init__(self, rate, axes=(), prefix=None, params=None):
+        super().__init__(prefix=prefix, params=params)
+        self._rate = rate
+        self._axes = axes
+
+    def state_info(self, batch_size=0):
+        return []
+
+    def hybrid_forward(self, F, inputs, states):
+        if self._rate > 0:
+            inputs = F.Dropout(inputs, p=self._rate, axes=self._axes)
+        return inputs, states
+
+
+class ModifierCell(RecurrentCell):
+    def __init__(self, base_cell):
+        super().__init__(prefix=base_cell.prefix + self._alias() + "_",
+                         params=None)
+        self.base_cell = base_cell
+
+    def state_info(self, batch_size=0):
+        return self.base_cell.state_info(batch_size)
+
+    def begin_state(self, batch_size=0, func=None, **kwargs):
+        self.base_cell._modified = False
+        begin = self.base_cell.begin_state(batch_size, func=func, **kwargs)
+        self.base_cell._modified = True
+        return begin
+
+
+class ZoneoutCell(ModifierCell):
+    def __init__(self, base_cell, zoneout_outputs=0.0, zoneout_states=0.0):
+        self._alias_name = "zoneout"
+        super().__init__(base_cell)
+        self.zoneout_outputs = zoneout_outputs
+        self.zoneout_states = zoneout_states
+        self._prev_output = None
+
+    def _alias(self):
+        return "zoneout"
+
+    def reset(self):
+        super().reset()
+        self._prev_output = None
+
+    def hybrid_forward(self, F, inputs, states):
+        next_output, next_states = self.base_cell(inputs, states)
+        mask = lambda p, like: F.Dropout(F.ones_like(like), p=p, mode="always")
+        prev_output = self._prev_output if self._prev_output is not None \
+            else F.zeros_like(next_output)
+        if self.zoneout_outputs > 0.0:
+            output = F.where(mask(self.zoneout_outputs, next_output) != 0,
+                             next_output, prev_output)
+        else:
+            output = next_output
+        if self.zoneout_states > 0.0:
+            new_states = [F.where(mask(self.zoneout_states, ns) != 0, ns, os)
+                          for ns, os in zip(next_states, states)]
+        else:
+            new_states = next_states
+        self._prev_output = output
+        return output, new_states
+
+
+class ResidualCell(ModifierCell):
+    def _alias(self):
+        return "residual"
+
+    def hybrid_forward(self, F, inputs, states):
+        output, states = self.base_cell(inputs, states)
+        output = output + inputs
+        return output, states
+
+
+class BidirectionalCell(RecurrentCell):
+    def __init__(self, l_cell, r_cell, output_prefix="bi_"):
+        super().__init__(prefix="", params=None)
+        self.register_child(l_cell, "l_cell")
+        self.register_child(r_cell, "r_cell")
+        self._output_prefix = output_prefix
+
+    def state_info(self, batch_size=0):
+        return (self._children["l_cell"].state_info(batch_size)
+                + self._children["r_cell"].state_info(batch_size))
+
+    def __call__(self, inputs, states=None):
+        raise MXNetError("BidirectionalCell supports only unroll()")
+
+    def unroll(self, length, inputs, begin_state=None, layout="NTC",
+               merge_outputs=None, valid_length=None):
+        from ... import ndarray as F
+
+        self.reset()
+        in_list, axis, batch = _format_sequence(length, inputs, layout, False)
+        l_cell = self._children["l_cell"]
+        r_cell = self._children["r_cell"]
+        if begin_state is None:
+            ctx = in_list[0].context
+            begin_state = self.begin_state(batch, ctx=ctx,
+                                           dtype=str(in_list[0].dtype))
+        n_l = len(l_cell.state_info(batch))
+        cell_layout = "TNC" if axis == 0 else "NTC"
+        l_outputs, l_states = l_cell.unroll(
+            length, in_list, begin_state[:n_l], layout=cell_layout,
+            merge_outputs=False, valid_length=valid_length)
+        if valid_length is None:
+            rev_in = list(reversed(in_list))
+        else:
+            # length-aware reverse so padding stays at the tail
+            # (reference: F.SequenceReverse(..., sequence_length=valid_length))
+            stacked = F.stack(*in_list, axis=0)
+            rev = F.SequenceReverse(stacked, valid_length,
+                                    use_sequence_length=True, axis=0)
+            rev_in = [rev[i] for i in range(length)]
+        r_outputs, r_states = r_cell.unroll(
+            length, rev_in, begin_state[n_l:], layout=cell_layout,
+            merge_outputs=False, valid_length=valid_length)
+        if valid_length is None:
+            r_outputs = list(reversed(r_outputs))
+        else:
+            stacked = F.stack(*r_outputs, axis=0)
+            rev = F.SequenceReverse(stacked, valid_length,
+                                    use_sequence_length=True, axis=0)
+            r_outputs = [rev[i] for i in range(length)]
+        outputs = [F.concat(lo, ro, dim=1)
+                   for lo, ro in zip(l_outputs, r_outputs)]
+        if merge_outputs:
+            t_axis = layout.find("T")
+            outputs = F.stack(*outputs, axis=t_axis)
+        return outputs, l_states + r_states

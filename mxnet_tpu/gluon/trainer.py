@@ -1,0 +1,186 @@
+"""Gluon Trainer — the optimizer driver.
+
+Reference: ``python/mxnet/gluon/trainer.py :: Trainer`` — decides
+``update_on_kvstore``, `_allreduce_grads` (kv push/pull), `step(batch_size)`,
+the `allreduce_grads` + `update` split for gradient clipping, and
+save/load_states.
+
+TPU-native notes (SURVEY.md §3.5): with the 'tpu_sync' kvstore the push/pull
+pair lowers to one XLA allreduce over the device mesh; with a single device
+(the common single-chip path) there is nothing to reduce and step() is just
+the optimizer sweep. Multi-context parameter copies follow the reference's
+semantics for API parity.
+"""
+from __future__ import annotations
+
+from typing import Dict, List, Optional
+
+from .. import optimizer as opt
+from ..base import MXNetError
+from .parameter import Parameter, ParameterDict
+
+__all__ = ["Trainer"]
+
+
+class Trainer:
+    def __init__(self, params, optimizer, optimizer_params=None,
+                 kvstore="device", compression_params=None,
+                 update_on_kvstore=None):
+        if isinstance(params, (dict, ParameterDict)):
+            params = list(params.values())
+        if not isinstance(params, (list, tuple)):
+            raise MXNetError(
+                "First argument must be a list or dict of Parameters, "
+                f"got {type(params)}")
+        self._params: List[Parameter] = []
+        self._param2idx: Dict[str, int] = {}
+        for i, p in enumerate(params):
+            if not isinstance(p, Parameter):
+                raise MXNetError(f"invalid parameter {p}")
+            self._param2idx[p.name] = i
+            self._params.append(p)
+            p._trainer = self
+        self._compression_params = compression_params
+        self._scale = 1.0
+        optimizer_params = dict(optimizer_params or {})
+        self._init_optimizer(optimizer, optimizer_params)
+        self._kvstore_type = kvstore
+        self._kvstore = None
+        self._kv_initialized = False
+        self._update_on_kvstore = update_on_kvstore
+        self._contexts = None
+
+    def _init_optimizer(self, optimizer, optimizer_params):
+        param_dict = {i: p for i, p in enumerate(self._params)}
+        if isinstance(optimizer, opt.Optimizer):
+            if optimizer_params:
+                raise MXNetError(
+                    "optimizer_params must be None when optimizer is an instance")
+            self._optimizer = optimizer
+            self._optimizer.param_dict = param_dict
+        else:
+            self._optimizer = opt.create(optimizer, param_dict=param_dict,
+                                         **optimizer_params)
+        self._updaters = None  # per-context Updater list, built lazily
+
+    # ------------------------------------------------------------------
+    def _check_contexts(self):
+        contexts = None
+        for p in self._params:
+            ctx = p.list_ctx()
+            if contexts is not None and contexts != ctx:
+                raise MXNetError(
+                    f"All Parameters must be initialized on the same set of "
+                    f"contexts, but {p.name} is on {ctx} while others are on "
+                    f"{contexts}")
+            contexts = ctx
+        return contexts or []
+
+    def _init_kvstore(self):
+        self._contexts = self._check_contexts()
+        if isinstance(self._kvstore_type, str):
+            if len(self._contexts) > 1 or self._kvstore_type in (
+                    "tpu_sync", "dist_sync", "dist_device_sync", "nccl"):
+                from .. import kvstore as kv
+
+                self._kvstore = kv.create(self._kvstore_type)
+            else:
+                self._kvstore = None
+        else:
+            self._kvstore = self._kvstore_type
+        if self._kvstore is None and self._update_on_kvstore:
+            raise MXNetError(
+                "update_on_kvstore=True requires a kvstore, but none is "
+                "active (single context with kvstore='local'/'device' has "
+                "nothing to aggregate); pass kvstore='tpu_sync' or drop "
+                "update_on_kvstore")
+        if self._kvstore is not None:
+            if self._update_on_kvstore is None:
+                # tpu_sync performs in-graph allreduce; the optimizer always
+                # runs worker-side (SURVEY.md §5.8 end-state)
+                self._update_on_kvstore = self._kvstore.type not in (
+                    "tpu_sync", "local", "device") and len(self._contexts) > 1
+            if self._update_on_kvstore:
+                self._kvstore.set_optimizer(self._optimizer)
+            for i, p in enumerate(self._params):
+                if p.grad_req != "null":
+                    self._kvstore.init(i, p.data(self._contexts[0]))
+        self._updaters = [opt.get_updater(self._optimizer)
+                          for _ in self._contexts]
+        self._kv_initialized = True
+
+    # ------------------------------------------------------------------
+    @property
+    def learning_rate(self):
+        return self._optimizer.learning_rate
+
+    @property
+    def optimizer(self):
+        return self._optimizer
+
+    def set_learning_rate(self, lr):
+        self._optimizer.set_learning_rate(lr)
+
+    # ------------------------------------------------------------------
+    def step(self, batch_size, ignore_stale_grad=False):
+        """Apply one optimizer step scaled by 1/batch_size
+        (reference: Trainer.step)."""
+        if not self._kv_initialized:
+            self._init_kvstore()
+        self._optimizer.rescale_grad = self._scale / batch_size
+        self._allreduce_grads()
+        self._update(ignore_stale_grad)
+
+    def allreduce_grads(self):
+        """Reduce gradients only — for gradient clipping between reduce and
+        update (reference: Trainer.allreduce_grads)."""
+        if not self._kv_initialized:
+            self._init_kvstore()
+        self._allreduce_grads()
+
+    def _allreduce_grads(self):
+        if self._kvstore is None:
+            return
+        for i, p in enumerate(self._params):
+            if p.grad_req == "null":
+                continue
+            self._kvstore.push(i, p.list_grad(), priority=-i)
+            if not self._update_on_kvstore:
+                self._kvstore.pull(i, p.list_grad(), priority=-i,
+                                   ignore_sparse=True)
+
+    def update(self, batch_size, ignore_stale_grad=False):
+        if not self._kv_initialized:
+            self._init_kvstore()
+        self._optimizer.rescale_grad = self._scale / batch_size
+        self._update(ignore_stale_grad)
+
+    def _update(self, ignore_stale_grad=False):
+        if self._update_on_kvstore:
+            for i, p in enumerate(self._params):
+                if p.grad_req == "null":
+                    continue
+                self._kvstore.pull(i, p.list_data(), priority=-i)
+            return
+        for i, p in enumerate(self._params):
+            if p.grad_req == "null":
+                continue
+            for upd, arr, grad in zip(self._updaters, p.list_data(), p.list_grad()):
+                upd(i, grad, arr)
+
+    # ------------------------------------------------------------------
+    def save_states(self, fname):
+        """reference: Trainer.save_states (Updater.get_states pickle)."""
+        if not self._kv_initialized:
+            self._init_kvstore()
+        with open(fname, "wb") as f:
+            f.write(self._updaters[0].get_states(dump_optimizer=False))
+
+    def load_states(self, fname):
+        if not self._kv_initialized:
+            self._init_kvstore()
+        with open(fname, "rb") as f:
+            states = f.read()
+        for upd in self._updaters:
+            upd.set_states(states)
+            upd.optimizer = self._optimizer

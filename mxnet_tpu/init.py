@@ -1,0 +1,4 @@
+"""``mx.init`` alias for the initializer namespace
+(reference: python/mxnet/initializer.py is exposed as both)."""
+from .initializer import *  # noqa: F401,F403
+from .initializer import Initializer, create, register  # noqa: F401

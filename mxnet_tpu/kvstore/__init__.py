@@ -1,0 +1,16 @@
+"""KVStore — parameter synchronization for data parallelism.
+
+Reference: ``src/kvstore/kvstore.cc :: KVStore::Create`` and
+``python/mxnet/kvstore.py`` — types 'local', 'device' (single-process
+multi-device reduce, ``src/kvstore/comm.h::CommCPU/CommDevice``),
+'dist_sync'/'dist_async' (ps-lite parameter server), 'nccl'
+(``kvstore_nccl.h``).
+
+TPU-native replacement (SURVEY.md §5.8): the **'tpu_sync'** type drives XLA
+collectives over the device mesh — push/pull become a compiled psum; the
+'nccl', 'dist_device_sync' and 'dist_sync' names alias onto it so reference
+scripts run unchanged. Parameter-server 'dist_async' has no TPU analogue
+and raises with guidance. Multi-host rendezvous uses jax.distributed
+(see mxnet_tpu.parallel) instead of dmlc_tracker env bootstrap.
+"""
+from .kvstore import KVStore, KVStoreLocal, KVStoreTPUSync, create  # noqa: F401

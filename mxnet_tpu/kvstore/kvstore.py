@@ -1,0 +1,197 @@
+"""KVStore implementations (see package docstring for the design map)."""
+from __future__ import annotations
+
+import pickle
+from typing import Dict, List, Optional
+
+from .. import optimizer as opt
+from ..base import MXNetError
+from ..ndarray import NDArray
+from ..ndarray import array as nd_array
+
+__all__ = ["KVStore", "KVStoreLocal", "KVStoreTPUSync", "create"]
+
+
+def create(name="local") -> "KVStore":
+    """reference: mx.kv.create / KVStore::Create."""
+    name = str(name).lower()
+    if name in ("local", "local_update_cpu", "local_allreduce_cpu",
+                "local_allreduce_device", "device"):
+        return KVStoreLocal(name)
+    if name in ("tpu_sync", "nccl", "dist_device_sync", "dist_sync"):
+        return KVStoreTPUSync(name)
+    if name in ("dist_async",):
+        raise MXNetError(
+            "kvstore 'dist_async' (parameter-server async mode) has no "
+            "TPU-native equivalent; use 'tpu_sync' (synchronous in-graph "
+            "allreduce over the mesh) — SURVEY.md §5.8")
+    if name in ("horovod", "byteps"):
+        raise MXNetError(
+            f"kvstore '{name}' plugin is replaced by 'tpu_sync' on TPU")
+    raise MXNetError(f"unknown kvstore type {name!r}")
+
+
+class KVStore:
+    """Base interface (reference: include/mxnet/kvstore.h)."""
+
+    def __init__(self, type_name):
+        self._type = type_name
+        self._updater = None
+        self._optimizer = None
+
+    @property
+    def type(self):
+        return self._type
+
+    @property
+    def rank(self) -> int:
+        return 0
+
+    @property
+    def num_workers(self) -> int:
+        return 1
+
+    def init(self, key, value):
+        raise NotImplementedError
+
+    def push(self, key, value, priority=0):
+        raise NotImplementedError
+
+    def pull(self, key, out, priority=0, ignore_sparse=True):
+        raise NotImplementedError
+
+    def pushpull(self, key, value, out=None, priority=0):
+        self.push(key, value, priority)
+        self.pull(key, out if out is not None else value, priority)
+
+    def row_sparse_pull(self, key, out, priority=0, row_ids=None):
+        # sparse is dense-backed (SURVEY.md §7.3.5)
+        self.pull(key, out, priority)
+
+    def set_optimizer(self, optimizer):
+        self._optimizer = optimizer
+        self._updater = opt.get_updater(optimizer)
+
+    def set_gradient_compression(self, compression_params):
+        raise MXNetError(
+            "gradient compression is a PS-path feature; not applicable to "
+            "the XLA-collective backend (planned for DCN in a later round)")
+
+    def save_optimizer_states(self, fname, dump_optimizer=False):
+        if self._updater is None:
+            raise MXNetError("no optimizer set on this kvstore")
+        with open(fname, "wb") as f:
+            f.write(self._updater.get_states(dump_optimizer))
+
+    def load_optimizer_states(self, fname):
+        if self._updater is None:
+            raise MXNetError("no optimizer set on this kvstore")
+        with open(fname, "rb") as f:
+            self._updater.set_states(f.read())
+
+    def barrier(self):
+        from ..ndarray import waitall
+
+        waitall()
+
+    def _barrier_before_exit(self):
+        pass
+
+
+class KVStoreLocal(KVStore):
+    """Single-process aggregation across device copies
+    (reference: src/kvstore/kvstore_local.h + comm.h::CommCPU/CommDevice).
+
+    'local' reduces via a host-side sum, 'device' sums on the first device —
+    with XLA both are a single fused add chain; the distinction is kept for
+    API parity."""
+
+    def __init__(self, type_name="local"):
+        super().__init__(type_name)
+        self._store: Dict = {}
+
+    def init(self, key, value):
+        key = self._canon(key)
+        if isinstance(value, (list, tuple)):
+            value = value[0]
+        self._store[key] = value.copy()
+
+    def _canon(self, key):
+        return key if isinstance(key, (int, str)) else int(key)
+
+    def _check_init(self, key):
+        if key not in self._store:
+            raise MXNetError(f"kvstore key {key!r} was not initialized")
+
+    def push(self, key, value, priority=0):
+        if isinstance(key, (list, tuple)):
+            for k, v in zip(key, value):
+                self.push(k, v, priority)
+            return
+        key = self._canon(key)
+        self._check_init(key)
+        vals = value if isinstance(value, (list, tuple)) else [value]
+        agg = vals[0]
+        if len(vals) > 1:
+            acc = vals[0].copyto(vals[0].context)
+            for v in vals[1:]:
+                acc += v.as_in_context(acc.context)
+            agg = acc
+        if self._updater is not None:
+            # server-side optimizer path (update_on_kvstore=True)
+            self._updater(key if isinstance(key, int) else hash(key),
+                          agg, self._store[key])
+        else:
+            self._store[key]._set_data(agg.as_in_context(
+                self._store[key].context).data)
+
+    def pull(self, key, out, priority=0, ignore_sparse=True):
+        if isinstance(key, (list, tuple)):
+            for k, o in zip(key, out):
+                self.pull(k, o, priority)
+            return
+        key = self._canon(key)
+        self._check_init(key)
+        outs = out if isinstance(out, (list, tuple)) else [out]
+        src = self._store[key]
+        for o in outs:
+            o._set_data(src.as_in_context(o.context).data
+                        if o.context != src.context else src.data)
+
+
+class KVStoreTPUSync(KVStoreLocal):
+    """Collective data-parallel sync over the device mesh.
+
+    Reference roles replaced: ``kvstore_nccl.h::KVStoreNCCL`` (intra-node
+    collectives) and ``kvstore_dist.h`` sync mode (multi-host). Push/pull on
+    sharded arrays lower to ONE XLA allreduce riding ICI; on replicated
+    single-device arrays it degenerates to the local sum. The real
+    multi-chip path is exercised through ``mxnet_tpu.parallel`` (pjit'd
+    train step with psum) — this object keeps the kvstore API contract so
+    Module/Trainer code runs unchanged.
+    """
+
+    def __init__(self, type_name="tpu_sync"):
+        super().__init__(type_name)
+        self._mesh = None
+
+    def attach_mesh(self, mesh):
+        """Associate a parallel.Mesh; cross-host reduces use its axis."""
+        self._mesh = mesh
+
+    @property
+    def num_workers(self):
+        import jax
+
+        return jax.process_count()
+
+    @property
+    def rank(self):
+        import jax
+
+        return jax.process_index()
+
+    def push(self, key, value, priority=0):
+        # per-process aggregation is the local sum; cross-device reduction
+        # happens in-graph via psum when arrays are mesh-sharded
+        super().push(key, value, priority)

@@ -92,6 +92,26 @@ class NDArray:
 
     def _set_data(self, new_jax) -> None:
         """Functionally replace the payload (an in-place write in API terms)."""
+        from .. import tracing
+
+        log = tracing.active_log()
+        if log is not None:
+            import jax as _jax
+
+            if isinstance(new_jax, _jax.core.Tracer) or isinstance(self._data, _jax.core.Tracer):
+                # traced (hybridized) execution: record so the compiled graph
+                # returns this as an extra output (see tracing.py). Views
+                # write through to their base so base reads stay coherent
+                # within the trace; the BASE is what gets logged/returned.
+                if self._base is not None:
+                    self._base._set_data(self._view_write(self._base.data, new_jax))
+                    self._data = new_jax
+                    self._cached_version = self._base._version
+                    return
+                log.log(self)
+                self._data = new_jax
+                self._version += 1
+                return
         if self._base is not None:
             self._base._set_data(self._view_write(self._base.data, new_jax))
             self._data = new_jax
@@ -202,7 +222,9 @@ class NDArray:
         dt = _resolve_dtype(dtype)
         if not copy and self.dtype == dt:
             return self
-        return imperative_invoke(get_op("Cast"), [self], {"dtype": str(dt)})
+        name = "bfloat16" if str(dt) == "bfloat16" or dt is not None and \
+            getattr(dt, "__name__", "") == "bfloat16" else str(_np.dtype(dt))
+        return imperative_invoke(get_op("Cast"), [self], {"dtype": name})
 
     def as_np_ndarray(self):
         from ..numpy import ndarray as np_ndarray
@@ -720,7 +742,7 @@ def imperative_invoke(opdef, tensor_args, attrs, out=None, ctx=None):
             return grads
 
         # tape inputs must align with vjp's positional grads
-        autograd.record_node(_TapeVjp(vjp_fn),
+        autograd.record_node(_TapeVjp(vjp_fn, multi),
                              [a if isinstance(a, NDArray) else _DUMMY for a in nd_inputs],
                              outputs, name=getattr(opdef, "name", "op"))
 
@@ -740,12 +762,20 @@ def imperative_invoke(opdef, tensor_args, attrs, out=None, ctx=None):
 
 
 class _TapeVjp:
-    __slots__ = ("vjp_fn",)
+    """Adapter: autograd hands cotangents as (tuple if >1 else bare); the
+    jax.vjp function requires the exact pytree of the primal output."""
 
-    def __init__(self, vjp_fn):
+    __slots__ = ("vjp_fn", "out_was_tuple")
+
+    def __init__(self, vjp_fn, out_was_tuple):
         self.vjp_fn = vjp_fn
+        self.out_was_tuple = out_was_tuple
 
     def __call__(self, cotangents):
+        if self.out_was_tuple and not isinstance(cotangents, tuple):
+            cotangents = (cotangents,)
+        elif not self.out_was_tuple and isinstance(cotangents, tuple):
+            cotangents = cotangents[0]
         return self.vjp_fn(cotangents)
 
 

@@ -1,0 +1,489 @@
+"""Optimizer registry and implementations.
+
+Reference: ``python/mxnet/optimizer/optimizer.py`` — the `Optimizer` base
+(lr/wd multipliers, num_update tracking, lr_scheduler hook, multi-precision
+master weights) and the zoo: SGD, NAG, Adam, RMSProp, AdaGrad, AdaDelta,
+Ftrl, Signum, SGLD, DCASGD, LAMB; ``src/operator/contrib/adamw.cc`` for
+AdamW. State math executes through the optimizer update ops
+(``mxnet_tpu/ops/optimizer_op.py``) with `out=` writeback, so a Trainer
+step can also fuse them into a jitted graph.
+"""
+from __future__ import annotations
+
+import math
+import pickle
+from typing import Dict, Optional
+
+import numpy as _np
+
+from .. import ndarray as nd
+from ..base import MXNetError
+from ..ndarray import NDArray
+
+__all__ = ["Optimizer", "SGD", "NAG", "Adam", "AdamW", "RMSProp", "AdaGrad",
+           "AdaDelta", "Ftrl", "Signum", "SGLD", "DCASGD", "LAMB",
+           "Updater", "create", "register", "get_updater"]
+
+_REGISTRY: Dict[str, type] = {}
+
+
+def register(klass):
+    _REGISTRY[klass.__name__.lower()] = klass
+    return klass
+
+
+def create(name, **kwargs):
+    if isinstance(name, Optimizer):
+        return name
+    key = str(name).lower()
+    if key not in _REGISTRY:
+        raise MXNetError(f"unknown optimizer {name!r}; known: {sorted(_REGISTRY)}")
+    return _REGISTRY[key](**kwargs)
+
+
+class Optimizer:
+    def __init__(self, rescale_grad=1.0, param_idx2name=None, wd=0.0,
+                 clip_gradient=None, learning_rate=0.01, lr_scheduler=None,
+                 multi_precision=False, param_dict=None, begin_num_update=0):
+        self.rescale_grad = rescale_grad
+        self.wd = wd
+        self.clip_gradient = clip_gradient
+        self.lr = learning_rate
+        self.lr_scheduler = lr_scheduler
+        if lr_scheduler is not None and getattr(lr_scheduler, "base_lr", None):
+            self.lr = lr_scheduler.base_lr
+        self.multi_precision = multi_precision
+        self.num_update = begin_num_update
+        self.begin_num_update = begin_num_update
+        self._index_update_count: Dict[int, int] = {}
+        self.idx2name = dict(param_idx2name or {})
+        self.param_dict = dict(param_dict or {})
+        self.lr_mult: Dict[str, float] = {}
+        self.wd_mult: Dict[str, float] = {}
+
+    # -- state ----------------------------------------------------------
+    def create_state(self, index, weight):
+        return None
+
+    def create_state_multi_precision(self, index, weight):
+        if self.multi_precision and str(weight.dtype) in ("float16", "bfloat16"):
+            w32 = weight.astype("float32")
+            return (w32, self.create_state(index, w32))
+        return self.create_state(index, weight)
+
+    # -- lr/wd ----------------------------------------------------------
+    def set_learning_rate(self, lr):
+        if self.lr_scheduler is not None:
+            raise MXNetError("cannot set lr directly when an LRScheduler is active")
+        self.lr = lr
+
+    @property
+    def learning_rate(self):
+        if self.lr_scheduler is not None:
+            return self.lr_scheduler(self.num_update)
+        return self.lr
+
+    def set_lr_mult(self, args_lr_mult):
+        self.lr_mult = dict(args_lr_mult)
+
+    def set_wd_mult(self, args_wd_mult):
+        self.wd_mult = dict(args_wd_mult)
+
+    def _update_count(self, index):
+        if index not in self._index_update_count:
+            self._index_update_count[index] = self.begin_num_update
+        self._index_update_count[index] += 1
+        self.num_update = max(self.num_update, self._index_update_count[index])
+
+    def _get_lr(self, index):
+        lr = self.lr_scheduler(self.num_update) if self.lr_scheduler else self.lr
+        if index in self.param_dict:
+            lr *= self.param_dict[index].lr_mult
+        elif index in self.lr_mult:
+            lr *= self.lr_mult[index]
+        elif index in self.idx2name:
+            lr *= self.lr_mult.get(self.idx2name[index], 1.0)
+        return lr
+
+    def _get_wd(self, index):
+        wd = self.wd
+        if index in self.param_dict:
+            wd *= self.param_dict[index].wd_mult
+        elif index in self.wd_mult:
+            wd *= self.wd_mult[index]
+        elif index in self.idx2name:
+            wd *= self.wd_mult.get(self.idx2name[index], 1.0)
+        return wd
+
+    # -- updates --------------------------------------------------------
+    def update(self, index, weight, grad, state):
+        raise NotImplementedError
+
+    def update_multi_precision(self, index, weight, grad, state):
+        if self.multi_precision and str(weight.dtype) in ("float16", "bfloat16"):
+            w32, base_state = state
+            g32 = grad.astype("float32")
+            self.update(index, w32, g32, base_state)
+            weight._set_data(w32.data.astype(weight.data.dtype))
+        else:
+            self.update(index, weight, grad, state)
+
+    def _common_kwargs(self, index):
+        kw = {"lr": self._get_lr(index), "wd": self._get_wd(index),
+              "rescale_grad": self.rescale_grad}
+        if self.clip_gradient is not None:
+            kw["clip_gradient"] = self.clip_gradient
+        return kw
+
+
+@register
+class SGD(Optimizer):
+    """reference: optimizer.py::SGD (momentum + multi-precision)."""
+
+    def __init__(self, momentum=0.0, lazy_update=True, **kwargs):
+        super().__init__(**kwargs)
+        self.momentum = momentum
+
+    def create_state(self, index, weight):
+        if self.momentum == 0.0:
+            return None
+        return nd.zeros(weight.shape, ctx=weight.context, dtype=str(weight.dtype))
+
+    def update(self, index, weight, grad, state):
+        self._update_count(index)
+        kw = self._common_kwargs(index)
+        if state is None:
+            nd.sgd_update(weight, grad, out=weight, **kw)
+        else:
+            nd.sgd_mom_update(weight, grad, state, momentum=self.momentum,
+                              out=[weight, state], **kw)
+
+
+@register
+class NAG(Optimizer):
+    def __init__(self, momentum=0.0, **kwargs):
+        super().__init__(**kwargs)
+        self.momentum = momentum
+
+    def create_state(self, index, weight):
+        if self.momentum == 0.0:
+            return None
+        return nd.zeros(weight.shape, ctx=weight.context, dtype=str(weight.dtype))
+
+    def update(self, index, weight, grad, state):
+        self._update_count(index)
+        kw = self._common_kwargs(index)
+        if state is None:
+            nd.sgd_update(weight, grad, out=weight, **kw)
+        else:
+            nd.nag_mom_update(weight, grad, state, momentum=self.momentum,
+                              out=[weight, state], **kw)
+
+
+@register
+class Adam(Optimizer):
+    def __init__(self, learning_rate=0.001, beta1=0.9, beta2=0.999,
+                 epsilon=1e-8, lazy_update=True, **kwargs):
+        super().__init__(learning_rate=learning_rate, **kwargs)
+        self.beta1, self.beta2, self.epsilon = beta1, beta2, epsilon
+
+    def create_state(self, index, weight):
+        return (nd.zeros(weight.shape, ctx=weight.context, dtype=str(weight.dtype)),
+                nd.zeros(weight.shape, ctx=weight.context, dtype=str(weight.dtype)))
+
+    def update(self, index, weight, grad, state):
+        self._update_count(index)
+        t = self._index_update_count[index]
+        kw = self._common_kwargs(index)
+        # bias correction folded into lr (reference: Adam.update)
+        kw["lr"] *= math.sqrt(1.0 - self.beta2 ** t) / (1.0 - self.beta1 ** t)
+        mean, var = state
+        nd.adam_update(weight, grad, mean, var, beta1=self.beta1,
+                       beta2=self.beta2, epsilon=self.epsilon,
+                       out=[weight, mean, var], **kw)
+
+
+@register
+class AdamW(Optimizer):
+    """Decoupled weight decay (reference: contrib adamw.cc + gluonnlp's
+    AdamW usage for BERT)."""
+
+    def __init__(self, learning_rate=0.001, beta1=0.9, beta2=0.999,
+                 epsilon=1e-6, correct_bias=True, **kwargs):
+        super().__init__(learning_rate=learning_rate, **kwargs)
+        self.beta1, self.beta2, self.epsilon = beta1, beta2, epsilon
+        self.correct_bias = correct_bias
+
+    def create_state(self, index, weight):
+        return (nd.zeros(weight.shape, ctx=weight.context, dtype="float32"),
+                nd.zeros(weight.shape, ctx=weight.context, dtype="float32"))
+
+    def update(self, index, weight, grad, state):
+        self._update_count(index)
+        t = self._index_update_count[index]
+        kw = self._common_kwargs(index)
+        wd = kw.pop("wd")
+        if self.correct_bias:
+            kw["lr"] *= math.sqrt(1.0 - self.beta2 ** t) / (1.0 - self.beta1 ** t)
+        mean, var = state
+        nd.adamw_update(weight, grad, mean, var, beta1=self.beta1,
+                        beta2=self.beta2, epsilon=self.epsilon, wd=wd,
+                        eta=1.0, out=[weight, mean, var], **kw)
+
+
+@register
+class LAMB(Optimizer):
+    """Layer-wise adaptive moments (reference: optimizer.py::LAMB +
+    lamb_update_phase1/2 ops)."""
+
+    def __init__(self, learning_rate=0.001, beta1=0.9, beta2=0.999,
+                 epsilon=1e-6, lower_bound=None, upper_bound=None,
+                 bias_correction=True, **kwargs):
+        super().__init__(learning_rate=learning_rate, **kwargs)
+        self.beta1, self.beta2, self.epsilon = beta1, beta2, epsilon
+        self.lower_bound, self.upper_bound = lower_bound, upper_bound
+        self.bias_correction = bias_correction
+
+    def create_state(self, index, weight):
+        return (nd.zeros(weight.shape, ctx=weight.context, dtype="float32"),
+                nd.zeros(weight.shape, ctx=weight.context, dtype="float32"))
+
+    def update(self, index, weight, grad, state):
+        self._update_count(index)
+        t = self._index_update_count[index]
+        kw = self._common_kwargs(index)
+        lr = kw.pop("lr")
+        wd = kw.pop("wd")
+        mean, var = state
+        g = nd.lamb_update_phase1(weight, grad, mean, var, beta1=self.beta1,
+                                  beta2=self.beta2, epsilon=self.epsilon,
+                                  t=t, bias_correction=self.bias_correction,
+                                  wd=wd, **kw)
+        if isinstance(g, list):
+            g, new_mean, new_var = g
+            mean._set_data(new_mean.data)
+            var._set_data(new_var.data)
+        r1 = weight.norm()
+        r2 = g.norm()
+        nd.lamb_update_phase2(weight, g, r1, r2, lr=lr,
+                              lower_bound=self.lower_bound if self.lower_bound is not None else -1.0,
+                              upper_bound=self.upper_bound if self.upper_bound is not None else -1.0,
+                              out=weight)
+
+
+@register
+class RMSProp(Optimizer):
+    def __init__(self, learning_rate=0.001, gamma1=0.9, gamma2=0.9,
+                 epsilon=1e-8, centered=False, clip_weights=None, **kwargs):
+        super().__init__(learning_rate=learning_rate, **kwargs)
+        self.gamma1, self.gamma2 = gamma1, gamma2
+        self.epsilon = epsilon
+        self.centered = centered
+        self.clip_weights = clip_weights
+
+    def create_state(self, index, weight):
+        z = lambda: nd.zeros(weight.shape, ctx=weight.context, dtype=str(weight.dtype))
+        if self.centered:
+            return (z(), z(), z())
+        return (z(),)
+
+    def update(self, index, weight, grad, state):
+        self._update_count(index)
+        kw = self._common_kwargs(index)
+        cw = self.clip_weights if self.clip_weights is not None else -1.0
+        if self.centered:
+            n, g_acc, delta = state
+            nd.rmspropalex_update(weight, grad, n, g_acc, delta,
+                                  gamma1=self.gamma1, gamma2=self.gamma2,
+                                  epsilon=self.epsilon, clip_weights=cw,
+                                  out=[weight, n, g_acc, delta], **kw)
+        else:
+            (n,) = state
+            nd.rmsprop_update(weight, grad, n, gamma1=self.gamma1,
+                              epsilon=self.epsilon, clip_weights=cw,
+                              out=[weight, n], **kw)
+
+
+@register
+class AdaGrad(Optimizer):
+    def __init__(self, eps=1e-7, **kwargs):
+        super().__init__(**kwargs)
+        self.float_stable_eps = eps
+
+    def create_state(self, index, weight):
+        return nd.zeros(weight.shape, ctx=weight.context, dtype=str(weight.dtype))
+
+    def update(self, index, weight, grad, state):
+        self._update_count(index)
+        kw = self._common_kwargs(index)
+        nd.adagrad_update(weight, grad, state, epsilon=self.float_stable_eps,
+                          out=[weight, state], **kw)
+
+
+@register
+class AdaDelta(Optimizer):
+    def __init__(self, rho=0.90, epsilon=1e-5, **kwargs):
+        super().__init__(**kwargs)
+        self.rho, self.epsilon = rho, epsilon
+
+    def create_state(self, index, weight):
+        return (nd.zeros(weight.shape, ctx=weight.context, dtype=str(weight.dtype)),
+                nd.zeros(weight.shape, ctx=weight.context, dtype=str(weight.dtype)))
+
+    def update(self, index, weight, grad, state):
+        self._update_count(index)
+        kw = self._common_kwargs(index)
+        kw.pop("lr", None)  # AdaDelta has no learning rate
+        acc_g, acc_d = state
+        nd.adadelta_update(weight, grad, acc_g, acc_d, rho=self.rho,
+                           epsilon=self.epsilon, out=[weight, acc_g, acc_d],
+                           **kw)
+
+
+@register
+class Ftrl(Optimizer):
+    def __init__(self, lamda1=0.01, learning_rate=0.1, beta=1.0, **kwargs):
+        super().__init__(learning_rate=learning_rate, **kwargs)
+        self.lamda1, self.beta = lamda1, beta
+
+    def create_state(self, index, weight):
+        return (nd.zeros(weight.shape, ctx=weight.context, dtype=str(weight.dtype)),
+                nd.zeros(weight.shape, ctx=weight.context, dtype=str(weight.dtype)))
+
+    def update(self, index, weight, grad, state):
+        self._update_count(index)
+        kw = self._common_kwargs(index)
+        z, n = state
+        nd.ftrl_update(weight, grad, z, n, lamda1=self.lamda1, beta=self.beta,
+                       out=[weight, z, n], **kw)
+
+
+@register
+class Signum(Optimizer):
+    def __init__(self, learning_rate=0.01, momentum=0.9, wd_lh=0.0, **kwargs):
+        super().__init__(learning_rate=learning_rate, **kwargs)
+        self.momentum = momentum
+        self.wd_lh = wd_lh
+
+    def create_state(self, index, weight):
+        if self.momentum == 0.0:
+            return None
+        return nd.zeros(weight.shape, ctx=weight.context, dtype=str(weight.dtype))
+
+    def update(self, index, weight, grad, state):
+        self._update_count(index)
+        kw = self._common_kwargs(index)
+        if state is None:
+            nd.signsgd_update(weight, grad, out=weight, **kw)
+        else:
+            nd.signum_update(weight, grad, state, momentum=self.momentum,
+                             wd_lh=self.wd_lh, out=[weight, state], **kw)
+
+
+@register
+class SGLD(Optimizer):
+    """Stochastic gradient Langevin dynamics (reference: optimizer.py::SGLD)."""
+
+    def create_state(self, index, weight):
+        return None
+
+    def update(self, index, weight, grad, state):
+        self._update_count(index)
+        lr = self._get_lr(index)
+        wd = self._get_wd(index)
+        g = grad * self.rescale_grad
+        if self.clip_gradient is not None:
+            g = g.clip(-self.clip_gradient, self.clip_gradient)
+        noise = nd.random.normal(0, math.sqrt(lr), shape=weight.shape,
+                                 ctx=weight.context)
+        weight._set_data(
+            (weight - lr / 2 * (g + wd * weight) + noise).data)
+
+
+@register
+class DCASGD(Optimizer):
+    """Delay-compensated async SGD (reference: optimizer.py::DCASGD)."""
+
+    def __init__(self, momentum=0.0, lamda=0.04, **kwargs):
+        super().__init__(**kwargs)
+        self.momentum = momentum
+        self.lamda = lamda
+
+    def create_state(self, index, weight):
+        mom = None
+        if self.momentum != 0.0:
+            mom = nd.zeros(weight.shape, ctx=weight.context, dtype=str(weight.dtype))
+        return (mom, weight.copy())
+
+    def update(self, index, weight, grad, state):
+        self._update_count(index)
+        lr = self._get_lr(index)
+        wd = self._get_wd(index)
+        g = grad * self.rescale_grad
+        if self.clip_gradient is not None:
+            g = g.clip(-self.clip_gradient, self.clip_gradient)
+        mom, prev_w = state
+        delta = g + wd * weight + self.lamda * g * g * (weight - prev_w)
+        if mom is not None:
+            mom._set_data((self.momentum * mom - lr * delta).data)
+            upd = mom
+        else:
+            upd = -lr * delta
+        new_w = weight + upd
+        # previous_weight tracks the weight AFTER this update (reference:
+        # DCASGD — in synchronous training the compensation term is zero)
+        prev_w._set_data(new_w.data)
+        weight._set_data(new_w.data)
+
+
+class Updater:
+    """State manager mapping param index -> optimizer state
+    (reference: optimizer.py::Updater — also what KVStore server-side
+    optimizers run)."""
+
+    def __init__(self, optimizer: Optimizer):
+        self.optimizer = optimizer
+        self.states: Dict[int, object] = {}
+        self.states_synced: Dict[int, bool] = {}
+
+    def __call__(self, index, grad, weight):
+        if index not in self.states:
+            self.states[index] = self.optimizer.create_state_multi_precision(index, weight)
+        self.optimizer.update_multi_precision(index, weight, grad, self.states[index])
+
+    def get_states(self, dump_optimizer=False):
+        def to_np(s):
+            if s is None:
+                return None
+            if isinstance(s, tuple):
+                return tuple(to_np(x) for x in s)
+            if isinstance(s, NDArray):
+                return s.asnumpy()
+            return s
+
+        payload = {k: to_np(v) for k, v in self.states.items()}
+        if dump_optimizer:
+            return pickle.dumps((payload, self.optimizer))
+        return pickle.dumps(payload)
+
+    def set_states(self, states):
+        data = pickle.loads(states)
+        if isinstance(data, tuple) and len(data) == 2 and isinstance(data[1], Optimizer):
+            data, self.optimizer = data
+
+        def to_nd(s):
+            if s is None:
+                return None
+            if isinstance(s, tuple):
+                return tuple(to_nd(x) for x in s)
+            if isinstance(s, _np.ndarray):
+                from ..ndarray import array
+
+                return array(s, dtype=s.dtype)
+            return s
+
+        self.states = {k: to_nd(v) for k, v in data.items()}
+
+
+def get_updater(optimizer: Optimizer) -> Updater:
+    return Updater(optimizer)

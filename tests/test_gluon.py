@@ -1,0 +1,294 @@
+"""Gluon tests (reference: tests/python/unittest/test_gluon.py)."""
+import numpy as np
+import pytest
+
+import mxnet_tpu as mx
+from mxnet_tpu import autograd, gluon
+from mxnet_tpu.gluon import nn
+
+
+def test_dense_shapes_and_flatten():
+    d = nn.Dense(7)
+    d.initialize()
+    out = d(mx.nd.ones((4, 3, 5)))
+    assert out.shape == (4, 7)  # flatten=True
+    d2 = nn.Dense(7, flatten=False)
+    d2.initialize()
+    assert d2(mx.nd.ones((4, 3, 5))).shape == (4, 3, 7)
+
+
+def test_deferred_init_and_explicit():
+    d = nn.Dense(3)
+    d.initialize()
+    with pytest.raises(Exception):
+        d.weight.data()  # deferred until first forward
+    d(mx.nd.ones((2, 9)))
+    assert d.weight.shape == (3, 9)
+    e = nn.Dense(3, in_units=9)
+    e.initialize()
+    assert e.weight.data().shape == (3, 9)
+
+
+def test_conv_pool_stack():
+    net = nn.HybridSequential()
+    with net.name_scope():
+        net.add(nn.Conv2D(8, 3, padding=1), nn.MaxPool2D(), nn.Conv2D(4, 1))
+    net.initialize()
+    out = net(mx.nd.ones((2, 3, 8, 8)))
+    assert out.shape == (2, 4, 4, 4)
+
+
+def test_conv_groups_and_transpose():
+    c = nn.Conv2D(8, 3, groups=2, in_channels=4)
+    c.initialize()
+    assert c(mx.nd.ones((1, 4, 5, 5))).shape == (1, 8, 3, 3)
+    t = nn.Conv2DTranspose(3, 4, strides=2, in_channels=2)
+    t.initialize()
+    out = t(mx.nd.ones((1, 2, 4, 4)))
+    assert out.shape == (1, 3, 10, 10)  # (4-1)*2 + 4
+
+
+def test_parameter_sharing():
+    d1 = nn.Dense(5, in_units=4)
+    d2 = nn.Dense(5, in_units=4, params=d1.collect_params())
+    d1.initialize()
+    x = mx.nd.random.uniform(shape=(2, 4))
+    assert np.allclose(d1(x).asnumpy(), d2(x).asnumpy())
+
+
+def test_collect_params_select():
+    net = nn.HybridSequential(prefix="model_")
+    with net.name_scope():
+        net.add(nn.Dense(3, in_units=2), nn.Dense(2, in_units=3))
+    params = net.collect_params(".*weight")
+    assert all(k.endswith("weight") for k in params.keys())
+    assert len(params) == 2
+
+
+def test_hybridize_parity_and_cache():
+    net = nn.HybridSequential()
+    with net.name_scope():
+        net.add(nn.Dense(16, activation="relu"), nn.Dense(4))
+    net.initialize()
+    x = mx.nd.random.normal(shape=(3, 8))
+    eager = net(x).asnumpy()
+    net.hybridize()
+    hybrid = net(x).asnumpy()
+    assert np.allclose(eager, hybrid, rtol=1e-5, atol=1e-6)
+    # different shape recompiles transparently
+    y = mx.nd.random.normal(shape=(5, 8))
+    assert net(y).shape == (5, 4)
+
+
+def test_hybridize_dropout_fresh_masks():
+    # one compiled executable must yield fresh randomness per call
+    net = nn.HybridSequential()
+    with net.name_scope():
+        net.add(nn.Dropout(0.5))
+    net.initialize()
+    net.hybridize()
+    x = mx.nd.ones((100,))
+    with autograd.record():
+        a = net(x).asnumpy()
+        b = net(x).asnumpy()
+    assert not np.allclose(a, b), "dropout mask must differ across calls"
+
+
+def test_hybridize_batchnorm_aux_updates():
+    bn = nn.BatchNorm()
+    bn.initialize()
+    bn.hybridize()
+    x = mx.nd.random.normal(loc=5.0, shape=(16, 3))
+    with autograd.record():
+        bn(x)
+    rm = bn.running_mean.data().asnumpy()
+    assert not np.allclose(rm, 0), "traced aux-state update must write back"
+
+
+def test_hybridize_grads_match_eager():
+    def build():
+        net = nn.HybridSequential()
+        with net.name_scope():
+            net.add(nn.Dense(8, activation="tanh"), nn.Dense(1))
+        return net
+
+    net = build()
+    net.initialize(mx.init.Xavier())
+    x = mx.nd.random.normal(shape=(4, 6))
+    with autograd.record():
+        loss = (net(x) ** 2).sum()
+    loss.backward()
+    eager_grads = {k: p.grad().asnumpy().copy()
+                   for k, p in net.collect_params().items()}
+    for p in net.collect_params().values():
+        p.zero_grad()
+    net.hybridize()
+    with autograd.record():
+        loss = (net(x) ** 2).sum()
+    loss.backward()
+    for k, p in net.collect_params().items():
+        assert np.allclose(p.grad().asnumpy(), eager_grads[k], rtol=1e-4,
+                           atol=1e-5), k
+
+
+def test_trainer_step_converges():
+    net = nn.Dense(1, in_units=2)
+    net.initialize(mx.init.Normal(0.1))
+    trainer = gluon.Trainer(net.collect_params(), "sgd",
+                            {"learning_rate": 0.1})
+    x = mx.nd.array(np.random.randn(64, 2).astype("float32"))
+    w_true = np.array([[2.0], [-3.0]], dtype="float32")
+    y = mx.nd.array(x.asnumpy() @ w_true)
+    l2 = gluon.loss.L2Loss()
+    for _ in range(200):
+        with autograd.record():
+            loss = l2(net(x), y)
+        loss.backward()
+        trainer.step(64)
+    w = net.weight.data().asnumpy()
+    assert np.allclose(w, w_true.T, atol=1e-2)
+
+
+def test_loss_values_vs_numpy():
+    pred = mx.nd.array([[1.0, 2.0, 3.0], [1.0, 1.0, 1.0]])
+    label = mx.nd.array([2, 0])
+    l = gluon.loss.SoftmaxCrossEntropyLoss()(pred, label).asnumpy()
+    logp = pred.asnumpy() - np.log(np.exp(pred.asnumpy()).sum(-1, keepdims=True))
+    expect = -np.array([logp[0, 2], logp[1, 0]])
+    assert np.allclose(l, expect, rtol=1e-5)
+    # L2
+    p = mx.nd.array([1.0, 2.0])
+    t = mx.nd.array([0.0, 0.0])
+    assert np.allclose(gluon.loss.L2Loss()(p, t).asnumpy(), [0.5, 2.0])
+    # BCE with logits is stable at extremes
+    big = mx.nd.array([100.0, -100.0])
+    lbl = mx.nd.array([1.0, 0.0])
+    bce = gluon.loss.SigmoidBCELoss()(big, lbl).asnumpy()
+    assert np.all(np.isfinite(bce)) and np.allclose(bce, 0, atol=1e-4)
+
+
+def test_save_load_parameters(tmp_path):
+    net = nn.HybridSequential()
+    with net.name_scope():
+        net.add(nn.Dense(4, in_units=3), nn.BatchNorm(in_channels=4))
+    net.initialize()
+    x = mx.nd.random.normal(shape=(2, 3))
+    ref = net(x).asnumpy()
+    f = str(tmp_path / "net.params")
+    net.save_parameters(f)
+    net2 = nn.HybridSequential()
+    with net2.name_scope():
+        net2.add(nn.Dense(4, in_units=3), nn.BatchNorm(in_channels=4))
+    net2.load_parameters(f)
+    assert np.allclose(net2(x).asnumpy(), ref, atol=1e-6)
+    with pytest.raises(Exception):
+        bad = nn.Dense(9, in_units=3)
+        bad.load_parameters(f)
+
+
+def test_dataloader_batching_and_workers():
+    ds = gluon.data.ArrayDataset(np.arange(20).astype("float32"),
+                                 np.arange(20).astype("int32"))
+    loader = gluon.data.DataLoader(ds, batch_size=6, last_batch="keep")
+    batches = list(loader)
+    assert len(batches) == 4
+    assert batches[0][0].shape == (6,)
+    assert batches[-1][0].shape == (2,)
+    loader = gluon.data.DataLoader(ds, batch_size=6, last_batch="discard")
+    assert len(list(loader)) == 3
+    # multiprocess workers produce identical content for sequential sampling
+    loader_mp = gluon.data.DataLoader(ds, batch_size=5, num_workers=2)
+    got = np.concatenate([b[0].asnumpy() for b in loader_mp])
+    assert np.allclose(np.sort(got), np.arange(20))
+
+
+def test_transforms_pipeline():
+    from mxnet_tpu.gluon.data.vision import transforms
+
+    img = mx.nd.array(np.random.randint(0, 255, (28, 28, 3)), dtype="uint8")
+    t = transforms.Compose([transforms.ToTensor(),
+                            transforms.Normalize(0.5, 0.5)])
+    out = t(img)
+    assert out.shape == (3, 28, 28)
+    assert out.dtype == np.float32
+    r = transforms.Resize(14)(img)
+    assert r.shape == (14, 14, 3)
+    c = transforms.CenterCrop(20)(img)
+    assert c.shape == (20, 20, 3)
+    rc = transforms.RandomResizedCrop(16)(img)
+    assert rc.shape == (16, 16, 3)
+
+
+def test_rnn_cells_match_layer():
+    # single-layer unidirectional LSTM: cell unroll == fused layer
+    hidden = 5
+    layer = gluon.rnn.LSTM(hidden, input_size=4)
+    layer.initialize()
+    cell = gluon.rnn.LSTMCell(hidden, input_size=4)
+    cell.initialize()
+    # copy layer weights into cell
+    cell.i2h_weight.set_data(layer.l0_i2h_weight.data())
+    cell.h2h_weight.set_data(layer.l0_h2h_weight.data())
+    cell.i2h_bias.set_data(layer.l0_i2h_bias.data())
+    cell.h2h_bias.set_data(layer.l0_h2h_bias.data())
+    x = mx.nd.random.normal(shape=(7, 2, 4))  # TNC
+    fused = layer(x).asnumpy()
+    outs, _ = cell.unroll(7, x, layout="TNC", merge_outputs=True)
+    assert np.allclose(outs.asnumpy(), fused, rtol=1e-4, atol=1e-5)
+
+
+def test_gru_rnn_layers_run():
+    for layer in (gluon.rnn.GRU(6, num_layers=2),
+                  gluon.rnn.RNN(6, activation="tanh")):
+        layer.initialize()
+        out = layer(mx.nd.random.normal(shape=(4, 3, 5)))
+        assert out.shape == (4, 3, 6)
+
+
+def test_sequential_slicing():
+    net = nn.HybridSequential()
+    with net.name_scope():
+        net.add(nn.Dense(4), nn.Dense(3), nn.Dense(2))
+    assert len(net) == 3
+    assert isinstance(net[1], nn.Dense)
+    sub = net[:2]
+    assert len(sub) == 2
+
+
+def test_metrics():
+    acc = mx.metric.Accuracy()
+    acc.update([mx.nd.array([1, 0])], [mx.nd.array([[0.2, 0.8], [0.9, 0.1]])])
+    assert acc.get()[1] == 1.0
+    topk = mx.metric.TopKAccuracy(top_k=2)
+    topk.update([mx.nd.array([2])], [mx.nd.array([[0.4, 0.3, 0.35]])])
+    assert topk.get()[1] == 1.0
+    mse = mx.metric.MSE()
+    mse.update([mx.nd.array([1.0, 2.0])], [mx.nd.array([0.0, 0.0])])
+    assert np.isclose(mse.get()[1], 2.5)
+    comp = mx.metric.CompositeEvalMetric()
+    comp.add(mx.metric.Accuracy())
+    comp.add(mx.metric.MSE())
+    names, values = comp.get()
+    assert len(names) == 2
+
+
+def test_block_hooks():
+    net = nn.Dense(2, in_units=2)
+    net.initialize()
+    calls = []
+    h1 = net.register_forward_pre_hook(lambda blk, inp: calls.append("pre"))
+    h2 = net.register_forward_hook(lambda blk, inp, out: calls.append("post"))
+    net(mx.nd.ones((1, 2)))
+    assert calls == ["pre", "post"]
+    h1.detach()
+    h2.detach()
+    net(mx.nd.ones((1, 2)))
+    assert calls == ["pre", "post"]
+
+
+def test_cast_dtype():
+    net = nn.Dense(3, in_units=2)
+    net.initialize()
+    net.cast("bfloat16")
+    out = net(mx.nd.ones((2, 2), dtype="bfloat16"))
+    assert str(out.dtype) == "bfloat16"

@@ -1,0 +1,140 @@
+"""Optimizer tests vs numpy reference implementations
+(reference: tests/python/unittest/test_optimizer.py)."""
+import numpy as np
+import pytest
+
+import mxnet_tpu as mx
+from mxnet_tpu import optimizer as opt
+
+
+def _setup(shape=(4, 3), seed=0):
+    rng = np.random.RandomState(seed)
+    w = rng.randn(*shape).astype("float32")
+    g = rng.randn(*shape).astype("float32")
+    return w, g, mx.nd.array(w), mx.nd.array(g)
+
+
+def test_sgd_matches_numpy():
+    w, g, wnd, gnd = _setup()
+    o = opt.create("sgd", learning_rate=0.1, wd=0.01, rescale_grad=1.0)
+    state = o.create_state(0, wnd)
+    o.update(0, wnd, gnd, state)
+    expect = w - 0.1 * (g + 0.01 * w)
+    assert np.allclose(wnd.asnumpy(), expect, rtol=1e-5)
+
+
+def test_sgd_momentum_matches_numpy():
+    w, g, wnd, gnd = _setup()
+    o = opt.create("sgd", learning_rate=0.1, momentum=0.9, wd=0.0)
+    state = o.create_state(0, wnd)
+    mom = np.zeros_like(w)
+    for _ in range(3):
+        o.update(0, wnd, gnd, state)
+        mom = 0.9 * mom - 0.1 * g
+        w = w + mom
+    assert np.allclose(wnd.asnumpy(), w, rtol=1e-5)
+
+
+def test_adam_matches_numpy():
+    w, g, wnd, gnd = _setup()
+    lr, b1, b2, eps = 0.01, 0.9, 0.999, 1e-8
+    o = opt.create("adam", learning_rate=lr, beta1=b1, beta2=b2, epsilon=eps,
+                   wd=0.0)
+    state = o.create_state(0, wnd)
+    m = np.zeros_like(w)
+    v = np.zeros_like(w)
+    for t in range(1, 4):
+        o.update(0, wnd, gnd, state)
+        m = b1 * m + (1 - b1) * g
+        v = b2 * v + (1 - b2) * g * g
+        lr_t = lr * np.sqrt(1 - b2 ** t) / (1 - b1 ** t)
+        w = w - lr_t * m / (np.sqrt(v) + eps)
+    assert np.allclose(wnd.asnumpy(), w, rtol=1e-4, atol=1e-6)
+
+
+def test_rmsprop_runs_and_descends():
+    w, g, wnd, gnd = _setup()
+    o = opt.create("rmsprop", learning_rate=0.01)
+    state = o.create_state(0, wnd)
+    before = np.abs(wnd.asnumpy()).sum()
+    for _ in range(5):
+        o.update(0, wnd, gnd, state)
+    assert not np.allclose(wnd.asnumpy(), w)
+
+
+@pytest.mark.parametrize("name", ["adagrad", "adadelta", "ftrl", "signum",
+                                  "nag", "lamb", "adamw", "sgld", "dcasgd"])
+def test_all_optimizers_update(name):
+    w, g, wnd, gnd = _setup(seed=3)
+    o = opt.create(name, **({"learning_rate": 0.05} if name != "adadelta" else {}))
+    state = o.create_state_multi_precision(0, wnd)
+    o.update_multi_precision(0, wnd, gnd, state)
+    assert not np.allclose(wnd.asnumpy(), w), name
+    assert np.all(np.isfinite(wnd.asnumpy())), name
+
+
+def test_multi_precision_bf16():
+    rng = np.random.RandomState(1)
+    w = rng.randn(8, 8).astype("float32")
+    wnd = mx.nd.array(w, dtype="bfloat16")
+    gnd = mx.nd.array(rng.randn(8, 8), dtype="bfloat16")
+    o = opt.create("sgd", learning_rate=0.1, momentum=0.9, multi_precision=True)
+    state = o.create_state_multi_precision(0, wnd)
+    # master weight is fp32
+    assert str(state[0].dtype) == "float32"
+    o.update_multi_precision(0, wnd, gnd, state)
+    assert str(wnd.dtype) == "bfloat16"
+
+
+def test_updater_state_roundtrip():
+    w, g, wnd, gnd = _setup()
+    o = opt.create("adam", learning_rate=0.01)
+    upd = opt.get_updater(o)
+    upd(0, gnd, wnd)
+    states = upd.get_states()
+    upd2 = opt.get_updater(opt.create("adam", learning_rate=0.01))
+    upd2.set_states(states)
+    assert 0 in upd2.states
+    m1 = upd.states[0][0].asnumpy()
+    m2 = upd2.states[0][0].asnumpy()
+    assert np.allclose(m1, m2)
+
+
+def test_lr_scheduler_factor():
+    from mxnet_tpu.lr_scheduler import FactorScheduler, CosineScheduler
+
+    s = FactorScheduler(step=10, factor=0.5, base_lr=1.0)
+    assert s(1) == 1.0
+    assert s(11) == 0.5
+    assert s(21) == 0.25
+    c = CosineScheduler(max_update=100, base_lr=1.0, final_lr=0.0)
+    assert np.isclose(c(0), 1.0)
+    assert np.isclose(c(50), 0.5, atol=1e-6)
+    assert np.isclose(c(100), 0.0)
+
+
+def test_lr_scheduler_warmup():
+    from mxnet_tpu.lr_scheduler import PolyScheduler
+
+    s = PolyScheduler(max_update=100, base_lr=1.0, warmup_steps=10,
+                      warmup_begin_lr=0.0)
+    assert s(5) == 0.5
+    assert s(10) == 1.0
+
+
+def test_optimizer_with_scheduler():
+    from mxnet_tpu.lr_scheduler import FactorScheduler
+
+    o = opt.create("sgd", learning_rate=1.0,
+                   lr_scheduler=FactorScheduler(step=1, factor=0.5, base_lr=1.0))
+    w, g, wnd, gnd = _setup()
+    state = o.create_state(0, wnd)
+    o.update(0, wnd, gnd, state)
+    assert o.learning_rate < 1.0 or o.num_update == 1
+
+
+def test_lr_mult_wd_mult():
+    o = opt.create("sgd", learning_rate=1.0)
+    o.set_lr_mult({0: 0.1})
+    assert np.isclose(o._get_lr(0), 0.1)
+    assert np.isclose(o._get_lr(1), 1.0)
