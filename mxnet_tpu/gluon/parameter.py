@@ -328,7 +328,8 @@ class ParameterDict:
 
     def initialize(self, init=None, ctx=None, verbose=False,
                    force_reinit=False) -> None:
-        default = init if init is not None else initializer.Uniform()
+        default = initializer.create(init) if init is not None \
+            else initializer.Uniform()
         for p in self.values():
             p.initialize(None, ctx, default_init=default, force_reinit=force_reinit)
 

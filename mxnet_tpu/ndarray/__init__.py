@@ -59,7 +59,14 @@ def _make_wrapper(opname: str):
                 if i < len(tensors):
                     tensors[i] = a
                 else:
-                    raise TypeError(f"{opname}: too many positional arguments")
+                    # overflow positionals map onto attr params in order
+                    # (MXNet parity: e.g. nd.clip(x, 0, 6))
+                    j = i - len(tensors)
+                    if j < len(opdef.attr_params):
+                        attrs[opdef.attr_params[j]] = a
+                    else:
+                        raise TypeError(
+                            f"{opname}: too many positional arguments")
             for k, v in kwargs.items():
                 if k in opdef.tensor_params:
                     tensors[opdef.tensor_params.index(k)] = v
