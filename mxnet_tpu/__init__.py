@@ -53,6 +53,7 @@ from . import metric
 from . import gluon
 from . import kvstore
 from . import kvstore as kv
+from . import parallel
 from . import tracing
 
 from .ndarray import NDArray

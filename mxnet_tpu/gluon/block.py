@@ -301,6 +301,53 @@ def nested_unflatten_nd(tree, flat):
     return tuple(seq) if tname == "tuple" else seq
 
 
+def make_pure_fn(block, param_arrays, ctx, training):
+    """Build a pure function over a Block's forward.
+
+    Returns ``(pure, cell)`` where ``pure(param_vals, rng, *input_vals) ->
+    (out_vals, aux_vals)`` is jax-traceable and ``cell`` carries the output
+    treedef plus the aux-state NDArrays mutated during the trace (BatchNorm
+    moving stats etc. — see mxnet_tpu.tracing). This is the single lowering
+    seam shared by CachedOp (hybridize) and the sharded train step
+    (mxnet_tpu.parallel.step); reference: src/imperative/cached_op.cc.
+    """
+
+    def pure(param_vals, rng, *input_vals):
+        prev_rec = autograd.set_recording(False)
+        prev_train = autograd.set_training(training)
+        olds = [arr._data for arr in param_arrays]
+        with tracing.mutation_scope() as log:
+            with random_state.scoped_key(rng):
+                try:
+                    for arr, v in zip(param_arrays, param_vals):
+                        arr._data = v
+                        arr._version += 1
+                    nd_in = [NDArray(data=v, ctx=ctx) for v in input_vals]
+                    out = block._eager_forward(*nd_in)
+                    flat, tree = nested_flatten_nd(out)
+                    aux_arrays = [a for a in log.arrays]
+                    cell["aux_arrays"] = aux_arrays
+                    cell["treedef"] = tree
+                    cell["n_out"] = len(flat)
+                    out_vals = tuple(o.data for o in flat)
+                    aux_vals = tuple(a.data for a in aux_arrays)
+                    return out_vals, aux_vals
+                finally:
+                    # restore any concrete payloads clobbered by tracers:
+                    # first logged mutations, then the param swaps
+                    for a, orig in log.originals:
+                        a._data = orig
+                        a._version += 1
+                    for arr, old in zip(param_arrays, olds):
+                        arr._data = old
+                        arr._version += 1
+                    autograd.set_recording(prev_rec)
+                    autograd.set_training(prev_train)
+
+    cell = {"aux_arrays": None, "treedef": None, "n_out": None}
+    return pure, cell
+
+
 class _CachedGraph:
     """One compiled executable per (shapes, dtypes, train-flag) key — the
     jax.jit equivalent of ``src/imperative/cached_op.cc :: CachedOp``."""
@@ -359,41 +406,7 @@ class _CachedGraph:
     def _build(self, param_arrays, args, ctx, training):
         import jax
 
-        block = self.block
-        cell = {"aux_arrays": None, "treedef": None, "n_out": None}
-
-        def pure(param_vals, rng, *input_vals):
-            prev_rec = autograd.set_recording(False)
-            prev_train = autograd.set_training(training)
-            olds = [arr._data for arr in param_arrays]
-            with tracing.mutation_scope() as log:
-                with random_state.scoped_key(rng):
-                    try:
-                        for arr, v in zip(param_arrays, param_vals):
-                            arr._data = v
-                            arr._version += 1
-                        nd_in = [NDArray(data=v, ctx=ctx) for v in input_vals]
-                        out = block._eager_forward(*nd_in)
-                        flat, tree = nested_flatten_nd(out)
-                        aux_arrays = [a for a in log.arrays]
-                        cell["aux_arrays"] = aux_arrays
-                        cell["treedef"] = tree
-                        cell["n_out"] = len(flat)
-                        out_vals = tuple(o.data for o in flat)
-                        aux_vals = tuple(a.data for a in aux_arrays)
-                        return out_vals, aux_vals
-                    finally:
-                        # restore any concrete payloads clobbered by tracers:
-                        # first logged mutations, then the param swaps
-                        for a, orig in log.originals:
-                            a._data = orig
-                            a._version += 1
-                        for arr, old in zip(param_arrays, olds):
-                            arr._data = old
-                            arr._version += 1
-                        autograd.set_recording(prev_rec)
-                        autograd.set_training(prev_train)
-
+        pure, cell = make_pure_fn(self.block, param_arrays, ctx, training)
         return {"jitted": jax.jit(pure), "cell": cell}
 
 

@@ -167,8 +167,43 @@ def _cached_call(opname: str, attr_items: tuple, n_tensors: int, has_rng: bool):
     return jax.jit(pure)
 
 
+def _harmonize_devices(tensors):
+    """Mixed single-device / mesh-sharded operands: replicate the
+    single-device ones onto the sharded operand's mesh.
+
+    This is what lets a model trained by parallel.TrainStep (params laid out
+    over a Mesh) be used eagerly afterwards — ``net(x)`` with a host-side
+    ``x`` — without the user re-placing anything. The reference's analogue
+    is ``as_in_context`` coercion; here the "context" is the mesh layout.
+    """
+    import jax
+    from jax.sharding import NamedSharding, PartitionSpec
+
+    mesh = None
+    mixed = False
+    for t in tensors:
+        sh = getattr(t, "sharding", None)
+        if isinstance(sh, NamedSharding) and sh.num_devices > 1:
+            if mesh is None:
+                mesh = sh.mesh
+        elif hasattr(t, "sharding"):
+            mixed = True
+    if mesh is None or not mixed:
+        return tensors
+    rep = NamedSharding(mesh, PartitionSpec())
+    out = []
+    for t in tensors:
+        sh = getattr(t, "sharding", None)
+        if isinstance(sh, NamedSharding) and sh.num_devices > 1:
+            out.append(t)
+        else:
+            out.append(jax.device_put(t, rep))
+    return type(tensors)(out) if isinstance(tensors, tuple) else out
+
+
 def eager_call(opdef: OpDef, tensors, attrs, rng=None):
     """Execute an op eagerly through the per-op executable cache."""
+    tensors = _harmonize_devices(tensors)
     attr_items = tuple(sorted(attrs.items(), key=lambda kv: kv[0]))
     try:
         hash(attr_items)

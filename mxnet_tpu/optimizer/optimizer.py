@@ -10,6 +10,7 @@ step can also fuse them into a jitted graph.
 """
 from __future__ import annotations
 
+import contextlib
 import math
 import pickle
 from typing import Dict, Optional
@@ -60,6 +61,8 @@ class Optimizer:
         self.param_dict = dict(param_dict or {})
         self.lr_mult: Dict[str, float] = {}
         self.wd_mult: Dict[str, float] = {}
+        # dynamic-trace mode (see .dynamic()): (t, base_lr) as traced scalars
+        self._dyn = None
 
     # -- state ----------------------------------------------------------
     def create_state(self, index, weight):
@@ -89,14 +92,42 @@ class Optimizer:
     def set_wd_mult(self, args_wd_mult):
         self.wd_mult = dict(args_wd_mult)
 
+    @contextlib.contextmanager
+    def dynamic(self, t, base_lr):
+        """Trace mode for the fused (jitted) train step.
+
+        ``t`` (step count) and ``base_lr`` (scheduled learning rate) enter
+        the compiled graph as traced scalars, so ONE executable serves every
+        step — bias corrections and LR schedules stay dynamic instead of
+        being baked in at trace time. The eager path (Updater/Trainer) never
+        uses this; it keeps MXNet's per-index python counters.
+        """
+        prev = self._dyn
+        self._dyn = (t, base_lr)
+        try:
+            yield
+        finally:
+            self._dyn = prev
+
     def _update_count(self, index):
+        if self._dyn is not None:
+            return  # counts advance eagerly in the fused-step driver
         if index not in self._index_update_count:
             self._index_update_count[index] = self.begin_num_update
         self._index_update_count[index] += 1
         self.num_update = max(self.num_update, self._index_update_count[index])
 
+    def _t(self, index):
+        """Per-index update count; traced scalar in dynamic mode."""
+        if self._dyn is not None:
+            return self._dyn[0]
+        return self._index_update_count[index]
+
     def _get_lr(self, index):
-        lr = self.lr_scheduler(self.num_update) if self.lr_scheduler else self.lr
+        if self._dyn is not None:
+            lr = self._dyn[1]
+        else:
+            lr = self.lr_scheduler(self.num_update) if self.lr_scheduler else self.lr
         if index in self.param_dict:
             lr *= self.param_dict[index].lr_mult
         elif index in self.lr_mult:
@@ -193,10 +224,10 @@ class Adam(Optimizer):
 
     def update(self, index, weight, grad, state):
         self._update_count(index)
-        t = self._index_update_count[index]
+        t = self._t(index)
         kw = self._common_kwargs(index)
         # bias correction folded into lr (reference: Adam.update)
-        kw["lr"] *= math.sqrt(1.0 - self.beta2 ** t) / (1.0 - self.beta1 ** t)
+        kw["lr"] *= (1.0 - self.beta2 ** t) ** 0.5 / (1.0 - self.beta1 ** t)
         mean, var = state
         nd.adam_update(weight, grad, mean, var, beta1=self.beta1,
                        beta2=self.beta2, epsilon=self.epsilon,
@@ -220,11 +251,11 @@ class AdamW(Optimizer):
 
     def update(self, index, weight, grad, state):
         self._update_count(index)
-        t = self._index_update_count[index]
+        t = self._t(index)
         kw = self._common_kwargs(index)
         wd = kw.pop("wd")
         if self.correct_bias:
-            kw["lr"] *= math.sqrt(1.0 - self.beta2 ** t) / (1.0 - self.beta1 ** t)
+            kw["lr"] *= (1.0 - self.beta2 ** t) ** 0.5 / (1.0 - self.beta1 ** t)
         mean, var = state
         nd.adamw_update(weight, grad, mean, var, beta1=self.beta1,
                         beta2=self.beta2, epsilon=self.epsilon, wd=wd,
@@ -250,7 +281,7 @@ class LAMB(Optimizer):
 
     def update(self, index, weight, grad, state):
         self._update_count(index)
-        t = self._index_update_count[index]
+        t = self._t(index)
         kw = self._common_kwargs(index)
         lr = kw.pop("lr")
         wd = kw.pop("wd")

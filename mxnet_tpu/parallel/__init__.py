@@ -1,0 +1,22 @@
+"""mxnet_tpu.parallel — mesh, sharding, and fused distributed training.
+
+The TPU-native replacement for the reference's multi-device/multi-node
+machinery (SURVEY.md §2.4, §3.5, §5.8): context lists, KVStore comm trees,
+NCCL, and ps-lite collapse into ONE ``jax.sharding.Mesh`` with declarative
+layouts; XLA inserts the collectives over ICI/DCN.
+
+    from mxnet_tpu import parallel as par
+    mesh = par.make_mesh({'dp': 8})
+    step = par.TrainStep(net, loss, 'sgd', mesh=mesh)
+"""
+from .mesh import AXES, make_mesh, current_mesh, use_mesh, local_devices, \
+    mesh_axis_size
+from .sharding import (PartitionSpec, ShardingRules, named_sharding,
+                       replicated, shard_array, shard_parameters,
+                       spec_for_param)
+from .step import TrainStep
+
+__all__ = ["AXES", "make_mesh", "current_mesh", "use_mesh", "local_devices",
+           "mesh_axis_size", "PartitionSpec", "ShardingRules",
+           "named_sharding", "replicated", "shard_array", "shard_parameters",
+           "spec_for_param", "TrainStep"]

@@ -1,0 +1,122 @@
+"""Parameter/activation sharding rules (GSPMD layout plane).
+
+The reference has no tensor parallelism (SURVEY.md §2.4 — TP row: "NO");
+its model-parallel story is manual ``group2ctx`` placement. Here layout is
+declarative: a list of ``(name_regex, PartitionSpec)`` rules maps parameter
+names to mesh axes and GSPMD inserts the collectives. Model zoos ship their
+own rule sets (e.g. Megatron-style column/row splits for transformer blocks
+— ``mxnet_tpu.gluon.model_zoo.nlp``); anything unmatched is replicated.
+"""
+from __future__ import annotations
+
+import re
+from typing import List, Optional, Sequence, Tuple
+
+from .mesh import mesh_axis_size
+
+__all__ = ["PartitionSpec", "ShardingRules", "named_sharding",
+           "spec_for_param", "shard_array", "shard_parameters",
+           "replicated"]
+
+
+def PartitionSpec(*specs):  # noqa: N802 — re-export with lazy import
+    from jax.sharding import PartitionSpec as P
+
+    return P(*specs)
+
+
+def named_sharding(mesh, spec):
+    from jax.sharding import NamedSharding
+
+    return NamedSharding(mesh, spec)
+
+
+def replicated(mesh):
+    from jax.sharding import PartitionSpec as P
+
+    return named_sharding(mesh, P())
+
+
+class ShardingRules:
+    """Ordered ``(regex, PartitionSpec)`` rules; first match wins.
+
+        rules = ShardingRules([
+            (r".*_attention_qkv_weight$", P("tp", None)),
+            (r".*_ffn1_weight$",          P("tp", None)),
+            (r".*_ffn2_weight$",          P(None, "tp")),
+        ])
+    """
+
+    def __init__(self, rules: Optional[Sequence[Tuple[str, object]]] = None):
+        self._rules: List[Tuple[re.Pattern, object]] = []
+        for pattern, spec in rules or []:
+            self.add(pattern, spec)
+
+    def add(self, pattern: str, spec) -> "ShardingRules":
+        self._rules.append((re.compile(pattern), spec))
+        return self
+
+    def extend(self, other: "ShardingRules") -> "ShardingRules":
+        self._rules.extend(other._rules)
+        return self
+
+    def match(self, name: str):
+        for pat, spec in self._rules:
+            if pat.search(name):
+                return spec
+        return None
+
+    def __len__(self):
+        return len(self._rules)
+
+
+def _axes_of(entry):
+    if entry is None:
+        return ()
+    if isinstance(entry, (tuple, list)):
+        return tuple(entry)
+    return (entry,)
+
+
+def spec_for_param(name: str, shape, rules: Optional[ShardingRules], mesh):
+    """Resolve a param's PartitionSpec, falling back to replication when no
+    rule matches or the dimension doesn't divide the mesh axis (a warning-
+    free fallback keeps odd-shaped heads/vocab tails working)."""
+    from jax.sharding import PartitionSpec as P
+
+    spec = rules.match(name) if rules is not None else None
+    if spec is None:
+        return P()
+    entries = list(spec) + [None] * (len(shape) - len(spec))
+    for dim, entry in zip(shape, entries):
+        size = 1
+        for ax in _axes_of(entry):
+            size *= mesh_axis_size(mesh, ax)
+        if size > 1 and dim % size:
+            return P()
+    return P(*entries[: len(shape)])
+
+
+def shard_array(value, mesh, spec):
+    """device_put a jax array with a NamedSharding."""
+    import jax
+
+    return jax.device_put(value, named_sharding(mesh, spec))
+
+
+def shard_parameters(params, mesh, rules: Optional[ShardingRules] = None):
+    """Lay out initialized Gluon parameters over ``mesh`` in place.
+
+    ``params`` is a ParameterDict (or dict of Parameter). Returns
+    ``{name: PartitionSpec}`` for every parameter — the layout map the
+    fused train step reuses for its in/out shardings.
+    """
+    specs = {}
+    values = params.values() if hasattr(params, "values") else params
+    for p in values:
+        spec = spec_for_param(p.name, p.shape, rules, mesh)
+        specs[p.name] = spec
+        if p._data is not None:
+            for arr in p.list_data():
+                arr._set_data(shard_array(arr.data, mesh, spec))
+    return specs

@@ -1,0 +1,294 @@
+"""The fused, sharded training step — SURVEY.md §3.5's end state.
+
+Reference call stack being replaced: ``Trainer.step`` → kvstore push/pull
+(NCCL allreduce / ps-lite ZPush-ZPull) → per-context ``Optimizer.update``
+(src/kvstore/*, python/mxnet/gluon/trainer.py). On TPU that whole stack is
+ONE compiled executable: forward, loss, backward, gradient psum over the
+``dp`` mesh axis (inserted by GSPMD from the batch sharding), and the
+optimizer sweep — all fused, parameters donated so the update is in-place
+in HBM.
+
+    step = TrainStep(net, loss, optimizer='adam', mesh=make_mesh({'dp': 8}))
+    loss, outs = step(data, label)     # one device-side step, no host sync
+
+Semantics preserved from the reference:
+* optimizer state dtypes/bias corrections identical to the eager Updater
+  (the same ``Optimizer`` object runs inside the trace — in dynamic mode,
+  so step count and scheduled LR stay traced scalars and one executable
+  serves every step);
+* BatchNorm moving stats (aux states) are returned as extra outputs and
+  written back, like CachedOp's aux-state contract;
+* gradient clipping/rescale via the optimizer's own attributes.
+"""
+from __future__ import annotations
+
+from typing import Dict, List, Optional, Sequence
+
+from .. import optimizer as opt_mod
+from .. import random_state, tracing
+from ..context import current_context
+from ..ndarray import NDArray
+from ..gluon.block import make_pure_fn, nested_flatten_nd, nested_unflatten_nd
+from .mesh import current_mesh, make_mesh
+from .sharding import ShardingRules, named_sharding, spec_for_param
+
+__all__ = ["TrainStep"]
+
+
+def _as_tuple(x):
+    if x is None:
+        return ()
+    if isinstance(x, (list, tuple)):
+        return tuple(x)
+    return (x,)
+
+
+class TrainStep:
+    """Compile ``net`` + ``loss`` + ``optimizer`` into one sharded step.
+
+    Parameters
+    ----------
+    net : HybridBlock with initialized parameters.
+    loss : callable ``loss(outputs, *labels) -> NDArray`` (a gluon Loss
+        block works); reduced by mean inside the graph.
+    optimizer : Optimizer instance or name ('sgd', 'adam', ...).
+    mesh : jax Mesh; default = the active ``use_mesh`` mesh, else all
+        visible devices on one ``dp`` axis.
+    rules : ShardingRules for parameter layout (tensor parallelism);
+        unmatched params are replicated.
+    batch_axis : mesh axes the leading batch dimension is sharded over
+        (default ``('dp',)``; pass e.g. ``('dp','fsdp')`` for combined axes).
+    seq_axis : optional mesh axis for sequence sharding of rank>=2 inputs
+        (dimension 1) — context parallelism for long sequences.
+    """
+
+    def __init__(self, net, loss, optimizer, mesh=None,
+                 rules: Optional[ShardingRules] = None,
+                 batch_axis: Sequence[str] = ("dp",), seq_axis=None,
+                 optimizer_params=None):
+        self.net = net
+        self.loss = loss
+        if not isinstance(optimizer, opt_mod.Optimizer):
+            optimizer = opt_mod.create(optimizer, **(optimizer_params or {}))
+        self.optimizer = optimizer
+        if mesh is None:
+            mesh = current_mesh() or make_mesh()
+        self.mesh = mesh
+        self.rules = rules
+        self.batch_axis = tuple(a for a in _as_tuple(batch_axis)
+                                if a in mesh.axis_names)
+        self.seq_axis = seq_axis if (seq_axis in mesh.axis_names) else None
+        self._cache: Dict = {}
+        self._params = None          # List[Parameter]
+        self._param_specs = None     # per-param PartitionSpec
+        self._trainable = None       # indices into _params
+        self._state_leaf_nds = None  # flat list of state NDArrays (persist)
+        self._state_meta = None      # per-trainable (treedef, n_leaves, shapes)
+
+    # -- setup ----------------------------------------------------------
+    def _settle_params(self, data_tuple):
+        params = list(self.net.collect_params().values())
+        if any(p._data is None for p in params):
+            # deferred shapes: one eager forward settles them (same move as
+            # HybridBlock.__call__ on DeferredInitializationError)
+            self.net(*data_tuple)
+            params = list(self.net.collect_params().values())
+        self._params = params
+        self._trainable = [i for i, p in enumerate(params)
+                           if p.grad_req != "null"]
+        # per-param lr_mult/wd_mult flow through the optimizer's param_dict,
+        # keyed by the SAME trainable ordinals update() is called with
+        # (mirrors Trainer._init_optimizer wiring at trainer.py)
+        self.optimizer.param_dict = {
+            k: params[i] for k, i in enumerate(self._trainable)}
+        self._param_specs = [
+            spec_for_param(p.name, p.shape, self.rules, self.mesh)
+            for p in params]
+        # lay params out on the mesh once (single-process view: one NDArray
+        # per param; its payload becomes a sharded global jax.Array)
+        import jax
+
+        for p, spec in zip(params, self._param_specs):
+            arr = p.data()
+            arr._set_data(
+                jax.device_put(arr.data, named_sharding(self.mesh, spec)))
+
+    def _init_states(self):
+        import jax
+        from jax.sharding import PartitionSpec as P
+
+        leaf_nds: List[NDArray] = []
+        meta = []
+        is_leaf = lambda x: x is None or isinstance(x, NDArray)
+        for k, i in enumerate(self._trainable):
+            p = self._params[i]
+            state = self.optimizer.create_state_multi_precision(k, p.data())
+            leaves, treedef = jax.tree_util.tree_flatten(state, is_leaf=is_leaf)
+            # keep the NDArray objects alive: their payloads are replaced
+            # after every step (the persistent optimizer state). None leaves
+            # (stateless SGD) are recorded in `present` and rebuilt in-trace.
+            spec = self._param_specs[i]
+            present = [leaf is not None for leaf in leaves]
+            specs = []
+            for leaf in leaves:
+                if leaf is None:
+                    continue
+                leaf_spec = spec if tuple(leaf.shape) == tuple(p.shape) else P()
+                leaf._set_data(jax.device_put(
+                    leaf.data, named_sharding(self.mesh, leaf_spec)))
+                specs.append(leaf_spec)
+                leaf_nds.append(leaf)
+            meta.append((treedef, present, specs))
+        self._state_leaf_nds = leaf_nds
+        self._state_meta = meta
+
+    def _batch_spec(self, val):
+        from jax.sharding import PartitionSpec as P
+
+        entries = [None] * val.ndim
+        if val.ndim >= 1 and self.batch_axis:
+            size = 1
+            for ax in self.batch_axis:
+                size *= self.mesh.shape[ax]
+            if size > 1 and val.shape[0] % size == 0:
+                entries[0] = self.batch_axis if len(self.batch_axis) > 1 \
+                    else self.batch_axis[0]
+        if self.seq_axis and val.ndim >= 2:
+            s = self.mesh.shape[self.seq_axis]
+            if s > 1 and val.shape[1] % s == 0:
+                entries[1] = self.seq_axis
+        return P(*entries)
+
+    # -- build ----------------------------------------------------------
+    def _build(self, data_tuple, label_tuple, training):
+        import jax
+        from jax.sharding import PartitionSpec as P
+
+        ctx = self._params[0].data().context if self._params else current_context()
+        param_arrays = [p.data() for p in self._params]
+        pure, cell = make_pure_fn(self.net, param_arrays, ctx, training)
+        trainable = list(self._trainable)
+        n_data = len(data_tuple)
+        optimizer = self.optimizer
+        loss_fn = self.loss
+        state_meta = self._state_meta
+
+        def step_fn(param_vals, state_vals, t, lr, rng, *batch_vals):
+            import jax.numpy as jnp
+
+            data_vals = batch_vals[:n_data]
+            label_vals = batch_vals[n_data:]
+
+            def loss_of(train_vals):
+                pvals = list(param_vals)
+                for k, i in enumerate(trainable):
+                    pvals[i] = train_vals[k]
+                outs, aux = pure(tuple(pvals), rng, *data_vals)
+                out_nd = [NDArray(data=v, ctx=ctx) for v in outs]
+                out_tree = nested_unflatten_nd(cell["treedef"], out_nd)
+                label_nds = [NDArray(data=v, ctx=ctx) for v in label_vals]
+                loss_out = loss_fn(out_tree, *label_nds)
+                flat_loss, _ = nested_flatten_nd(loss_out)
+                loss_val = jnp.mean(flat_loss[0].data.astype(jnp.float32))
+                return loss_val, (outs, aux)
+
+            train_vals = tuple(param_vals[i] for i in trainable)
+            (loss_val, (outs, aux)), grads = jax.value_and_grad(
+                loss_of, has_aux=True)(train_vals)
+
+            new_params = list(param_vals)
+            new_state_vals = list(state_vals)
+            with optimizer.dynamic(t, lr):
+                with tracing.mutation_scope():
+                    pos = 0
+                    for k, i in enumerate(trainable):
+                        treedef, present, _ = state_meta[k]
+                        w_nd = NDArray(data=param_vals[i], ctx=ctx)
+                        g_nd = NDArray(data=grads[k], ctx=ctx)
+                        leaf_nds = []
+                        live = []
+                        cursor = pos
+                        for is_present in present:
+                            if is_present:
+                                nd_leaf = NDArray(data=state_vals[cursor], ctx=ctx)
+                                leaf_nds.append(nd_leaf)
+                                live.append((cursor, nd_leaf))
+                                cursor += 1
+                            else:
+                                leaf_nds.append(None)
+                        state = jax.tree_util.tree_unflatten(treedef, leaf_nds)
+                        optimizer.update_multi_precision(k, w_nd, g_nd, state)
+                        new_params[i] = w_nd.data
+                        for idx, nd_leaf in live:
+                            new_state_vals[idx] = nd_leaf.data
+                        pos = cursor
+            return (tuple(new_params), tuple(new_state_vals), loss_val,
+                    tuple(outs), tuple(aux))
+
+        mesh = self.mesh
+        ns = lambda spec: named_sharding(mesh, spec)
+        rep = ns(P())
+        param_sh = tuple(ns(s) for s in self._param_specs)
+        state_sh = tuple(ns(spec) for (_, _, specs) in state_meta
+                         for spec in specs)
+        batch_sh = tuple(ns(self._batch_spec(v))
+                         for v in list(data_tuple) + list(label_tuple))
+        in_sh = (param_sh, state_sh, rep, rep, rep) + batch_sh
+        # outputs: params/states keep their layout (no per-step reshard);
+        # loss replicated; model outputs/aux left to XLA (None = inferred)
+        jitted = jax.jit(
+            step_fn,
+            in_shardings=in_sh,
+            out_shardings=(param_sh, state_sh, rep, None, None),
+            donate_argnums=(0, 1),
+        )
+        return {"jitted": jitted, "cell": cell, "batch_sh": batch_sh}
+
+    # -- call ------------------------------------------------------------
+    def __call__(self, data, label):
+        import jax
+
+        data_tuple = _as_tuple(data)
+        label_tuple = _as_tuple(label)
+        if self._params is None:
+            self._settle_params(data_tuple)
+            self._init_states()
+        training = True
+        key = (len(data_tuple),
+               tuple((tuple(v.shape), str(v.dtype))
+                     for v in data_tuple + label_tuple), training)
+        entry = self._cache.get(key)
+        if entry is None:
+            entry = self._build(data_tuple, label_tuple, training)
+            self._cache[key] = entry
+        jitted, cell = entry["jitted"], entry["cell"]
+
+        optimizer = self.optimizer
+        # advance step counts eagerly (the dynamic-mode counterpart of
+        # Optimizer._update_count inside the reference's Updater)
+        for k in range(len(self._trainable)):
+            optimizer._update_count(k)
+        t = optimizer.num_update
+        lr = float(optimizer.learning_rate)
+        rng = random_state.get_state_key()
+
+        param_vals = tuple(p.data().data for p in self._params)
+        state_vals = tuple(s.data for s in self._state_leaf_nds)
+        # explicit device_put: host batches become sharded global arrays
+        # (each host feeds its slice on pods — SURVEY.md §7.1 "Data")
+        batch_vals = [jax.device_put(v.data, sh)
+                      for v, sh in zip(data_tuple + label_tuple,
+                                       entry["batch_sh"])]
+        new_params, new_states, loss_val, outs, aux = jitted(
+            param_vals, state_vals, t, lr, rng, *batch_vals)
+
+        for p, v in zip(self._params, new_params):
+            p.data()._set_data(v)
+        for s, v in zip(self._state_leaf_nds, new_states):
+            s._set_data(v)
+        for arr, v in zip(cell["aux_arrays"], aux):
+            arr._set_data(v)
+        ctx = self._params[0].data().context if self._params else current_context()
+        out_nd = [NDArray(data=v, ctx=ctx) for v in outs]
+        out_tree = nested_unflatten_nd(cell["treedef"], out_nd)
+        return NDArray(data=loss_val, ctx=ctx), out_tree
