@@ -1,0 +1,67 @@
+"""Benchmark: ResNet-50 training throughput (images/sec/chip).
+
+Prints ONE JSON line: {"metric", "value", "unit", "vs_baseline"}.
+Baseline = 800 img/s (the reference's headline ResNet-50 fp16 number on one
+V100 — BASELINE.md "Upstream MXNet published figures"). Runs the fused
+TrainStep (forward+loss+backward+optimizer in one XLA executable) in
+bfloat16 on whatever accelerator jax exposes (one TPU chip under the
+driver; CPU fallback works but is slow).
+"""
+from __future__ import annotations
+
+import json
+import sys
+import time
+
+import numpy as np
+
+BASELINE_IMG_S = 800.0  # reference ResNet-50 fp16, 1x V100 (BASELINE.md)
+
+
+def main():
+    import jax
+    import mxnet_tpu as mx
+    from mxnet_tpu import parallel as par
+    from mxnet_tpu.gluon import loss as gloss
+    from mxnet_tpu.gluon.model_zoo import vision
+
+    platform = jax.devices()[0].platform
+    batch = 64 if platform == "tpu" else 8
+    steps = 20 if platform == "tpu" else 3
+
+    net = vision.resnet50_v1()
+    net.initialize()
+    net.cast("bfloat16")
+
+    rs = np.random.RandomState(0)
+    x = mx.nd.array(rs.randn(batch, 3, 224, 224).astype(np.float32)) \
+        .astype("bfloat16")
+    y = mx.nd.array(rs.randint(0, 1000, (batch,)).astype(np.float32))
+
+    mesh = par.make_mesh({"dp": 1}, devices=jax.devices()[:1])
+    step = par.TrainStep(net, gloss.SoftmaxCrossEntropyLoss(), "sgd",
+                         mesh=mesh,
+                         optimizer_params={"learning_rate": 0.1,
+                                           "momentum": 0.9,
+                                           "multi_precision": True})
+    # warmup: compile + first step
+    loss, _ = step(x, y)
+    loss.asnumpy()
+
+    t0 = time.perf_counter()
+    for _ in range(steps):
+        loss, _ = step(x, y)
+    loss.asnumpy()  # sync
+    dt = time.perf_counter() - t0
+
+    img_s = batch * steps / dt
+    print(json.dumps({
+        "metric": "resnet50_train_images_per_sec_per_chip",
+        "value": round(img_s, 2),
+        "unit": "images/sec",
+        "vs_baseline": round(img_s / BASELINE_IMG_S, 4),
+    }))
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -1,0 +1,40 @@
+"""NLP model zoo — GluonNLP-capability models, TPU-first.
+
+Reference capability: the GluonNLP model zoo consumed through the Gluon API
+(SURVEY.md §1 L8: "GluonCV / GluonNLP are separate repos consuming the
+Gluon API", named in BASELINE.json configs 2-4). Families here:
+
+* Transformer NMT (`get_transformer`, capability: transformer_en_de_512)
+* BERT (`bert_12_768_12`, `bert_24_1024_16`)
+* Llama-style decoder LM (`llama_3_8b` — stretch config, new capability)
+
+Each family ships Megatron-style tensor-parallel ShardingRules
+(`*_sharding_rules`) consumed by mxnet_tpu.parallel.TrainStep.
+"""
+from .attention import MultiHeadAttention
+from .transformer import (PositionwiseFFN, TransformerEncoderCell,
+                          TransformerDecoderCell, TransformerEncoder,
+                          TransformerDecoder, Transformer, get_transformer,
+                          transformer_sharding_rules)
+from .bert import (BERTEncoder, BERTModel, bert_12_768_12, bert_24_1024_16,
+                   bert_sharding_rules)
+from .llama import (RMSNorm, LlamaAttention, LlamaMLP, LlamaBlock,
+                    LlamaModel, llama_tiny, llama_3_8b,
+                    llama_sharding_rules)
+
+_models = {
+    "transformer": get_transformer,
+    "bert_12_768_12": bert_12_768_12,
+    "bert_24_1024_16": bert_24_1024_16,
+    "llama_tiny": llama_tiny,
+    "llama_3_8b": llama_3_8b,
+}
+
+
+def get_model(name, **kwargs):
+    """reference surface: gluonnlp.model.get_model(name)."""
+    name = str(name).lower()
+    if name not in _models:
+        raise ValueError(
+            f"unknown nlp model {name!r}; available: {sorted(_models)}")
+    return _models[name](**kwargs)

@@ -1,0 +1,133 @@
+"""BERT — the GluonNLP pretraining/finetune capability.
+
+Reference capability: gluonnlp `bert_12_768_12` / `bert_24_1024_16`
+(BERTModel + BERTEncoder over MXNet fused attention,
+src/operator/contrib/transformer.cc). TPU-native re-design: post-LN encoder
+cells over `_contrib_sdp_attention` (f32 softmax, Pallas flash path),
+learned position embeddings added in-graph, bf16-friendly throughout. The
+masked-LM decoder ties the word embedding, and the pooler/NSP heads match
+the reference model surface so finetune scripts port 1:1.
+"""
+from __future__ import annotations
+
+from ...block import HybridBlock
+from ... import nn
+from .transformer import TransformerEncoderCell
+
+__all__ = ["BERTEncoder", "BERTModel", "bert_12_768_12", "bert_24_1024_16",
+           "bert_sharding_rules"]
+
+
+class BERTEncoder(HybridBlock):
+    """Stack of post-LN transformer cells with GELU FFN."""
+
+    def __init__(self, num_layers=12, units=768, hidden_size=3072,
+                 num_heads=12, dropout=0.1, prefix=None, params=None):
+        super().__init__(prefix=prefix, params=params)
+        with self.name_scope():
+            self.cells = nn.HybridSequential(prefix="")
+            for i in range(num_layers):
+                # BERT FFN uses GELU (reference: gluonnlp BERTEncoder)
+                self.cells.add(TransformerEncoderCell(
+                    units, hidden_size, num_heads, dropout=dropout,
+                    activation="gelu", prefix=f"layer{i}_"))
+
+    def hybrid_forward(self, F, x, mask=None):
+        for cell in self.cells._children.values():
+            x = cell(x, mask) if mask is not None else cell(x)
+        return x
+
+
+class BERTModel(HybridBlock):
+    """word + token-type + position embeddings -> encoder -> heads.
+
+    Outputs (matching the reference surface):
+      sequence_output (B, L, U); pooled_output (B, U);
+      and when ``use_decoder`` the masked-LM logits (B, L, vocab).
+    """
+
+    def __init__(self, vocab_size=30522, token_type_vocab_size=2,
+                 max_length=512, num_layers=12, units=768, hidden_size=3072,
+                 num_heads=12, dropout=0.1, use_pooler=True,
+                 use_classifier=True, use_decoder=True,
+                 prefix=None, params=None):
+        super().__init__(prefix=prefix, params=params)
+        self._units = units
+        self._use_pooler = use_pooler
+        self._use_classifier = use_classifier
+        self._use_decoder = use_decoder
+        with self.name_scope():
+            self.word_embed = nn.Embedding(vocab_size, units,
+                                           prefix="word_embed_")
+            self.token_type_embed = nn.Embedding(token_type_vocab_size, units,
+                                                 prefix="token_type_embed_")
+            self.position_embed = nn.Embedding(max_length, units,
+                                               prefix="position_embed_")
+            self.embed_ln = nn.LayerNorm(prefix="embed_ln_")
+            self.embed_dropout = nn.Dropout(dropout) if dropout else None
+            self.encoder = BERTEncoder(num_layers, units, hidden_size,
+                                       num_heads, dropout, prefix="enc_")
+            if use_pooler:
+                self.pooler = nn.Dense(units, flatten=False, activation="tanh",
+                                       prefix="pooler_")
+            if use_classifier:
+                self.classifier = nn.Dense(2, flatten=False,
+                                           prefix="classifier_")
+            if use_decoder:
+                # masked-LM head: transform + tied-embedding output matmul
+                self.decoder_transform = nn.Dense(
+                    units, flatten=False, activation="gelu",
+                    prefix="decoder_transform_")
+                self.decoder_ln = nn.LayerNorm(prefix="decoder_ln_")
+                self.decoder = nn.Dense(
+                    vocab_size, flatten=False,
+                    params=self.word_embed.params, prefix="word_embed_")
+
+    def hybrid_forward(self, F, token_ids, token_types=None, valid_mask=None):
+        l = token_ids.shape[1]
+        positions = F.arange(0, l, dtype="float32")
+        x = self.word_embed(token_ids)
+        if token_types is not None:
+            x = x + self.token_type_embed(token_types)
+        x = x + self.position_embed(positions).reshape((1, l, self._units))
+        x = self.embed_ln(x)
+        if self.embed_dropout is not None:
+            x = self.embed_dropout(x)
+        attn_mask = None
+        if valid_mask is not None:
+            # (B, L) 1/0 -> (B, 1, 1, L): every query may attend valid keys
+            attn_mask = valid_mask.reshape(
+                (valid_mask.shape[0], 1, 1, valid_mask.shape[1]))
+        seq = self.encoder(x, attn_mask)
+        outs = [seq]
+        pooled = None
+        if self._use_pooler:
+            pooled = self.pooler(seq[:, 0:1, :].reshape((-1, self._units)))
+            outs.append(pooled)
+        if self._use_classifier and pooled is not None:
+            outs.append(self.classifier(pooled))
+        if self._use_decoder:
+            h = self.decoder_ln(self.decoder_transform(seq))
+            outs.append(self.decoder(h))
+        return tuple(outs) if len(outs) > 1 else outs[0]
+
+
+def bert_sharding_rules(tp_axis="tp"):
+    """Megatron TP layout for BERT (same rule shapes as the transformer)."""
+    from .transformer import transformer_sharding_rules
+
+    return transformer_sharding_rules(tp_axis)
+
+
+def bert_12_768_12(**kwargs):
+    """BERT-base (reference capability: gluonnlp bert_12_768_12)."""
+    cfg = dict(num_layers=12, units=768, hidden_size=3072, num_heads=12)
+    cfg.update(kwargs)
+    return BERTModel(**cfg)
+
+
+def bert_24_1024_16(**kwargs):
+    """BERT-large (reference capability: gluonnlp bert_24_1024_16)."""
+    cfg = dict(num_layers=24, units=1024, hidden_size=4096, num_heads=16)
+    cfg.update(kwargs)
+    return BERTModel(**cfg)

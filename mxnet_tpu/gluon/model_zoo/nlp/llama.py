@@ -1,0 +1,172 @@
+"""Llama-style decoder-only LM — the stretch config (BASELINE.json config[4]).
+
+No reference counterpart (the reference pre-dates Llama; SURVEY.md §5.7
+flags long-context as a new capability). TPU-first design choices:
+* RMSNorm in f32, output in compute dtype;
+* RoPE computed in-graph from positions (no host tables, no recompiles
+  across sequence lengths within a bucket);
+* grouped-query attention (n_kv_heads < n_heads) through the same
+  `_contrib_sdp_attention` seam (kv heads broadcast to q heads);
+* SwiGLU FFN as two fused matmuls (gate+up projected together);
+* Megatron TP rules + sequence-axis sharding hooks for ring attention.
+"""
+from __future__ import annotations
+
+import math
+
+from ...block import HybridBlock
+from ... import nn
+from ...parameter import Parameter
+
+__all__ = ["RMSNorm", "LlamaAttention", "LlamaMLP", "LlamaBlock",
+           "LlamaModel", "llama_tiny", "llama_3_8b", "llama_sharding_rules"]
+
+
+class RMSNorm(HybridBlock):
+    def __init__(self, units, eps=1e-6, prefix=None, params=None):
+        super().__init__(prefix=prefix, params=params)
+        self._eps = eps
+        with self.name_scope():
+            self.weight = self.params.get("weight", shape=(units,),
+                                          init="ones")
+
+    def hybrid_forward(self, F, x, weight):
+        return F._contrib_rms_norm(x, weight, eps=self._eps)
+
+
+class LlamaAttention(HybridBlock):
+    def __init__(self, units, num_heads, num_kv_heads=None, rope_theta=10000.0,
+                 prefix=None, params=None):
+        super().__init__(prefix=prefix, params=params)
+        num_kv_heads = num_kv_heads or num_heads
+        if num_heads % num_kv_heads:
+            raise ValueError("num_heads must be divisible by num_kv_heads")
+        self._units = units
+        self._h = num_heads
+        self._kv = num_kv_heads
+        self._d = units // num_heads
+        self._theta = rope_theta
+        with self.name_scope():
+            self.q_proj = nn.Dense(units, flatten=False, use_bias=False,
+                                   prefix="q_")
+            self.kv_proj = nn.Dense(2 * self._kv * self._d, flatten=False,
+                                    use_bias=False, prefix="kv_")
+            self.out_proj = nn.Dense(units, flatten=False, use_bias=False,
+                                     prefix="out_")
+
+    def hybrid_forward(self, F, x):
+        b, l = x.shape[0], x.shape[1]
+        q = self.q_proj(x).reshape((b, l, self._h, self._d))
+        kv = self.kv_proj(x).reshape((b, l, 2 * self._kv, self._d))
+        k, v = F.split(kv, num_outputs=2, axis=2)
+        q = F._contrib_rope(q, theta=self._theta)
+        k = F._contrib_rope(k, theta=self._theta)
+        # (B, L, H, D) -> (B, H, L, D); kv heads repeat up to q heads (GQA)
+        q = q.transpose((0, 2, 1, 3))
+        k = k.transpose((0, 2, 1, 3))
+        v = v.transpose((0, 2, 1, 3))
+        if self._kv != self._h:
+            rep = self._h // self._kv
+            k = F.repeat(k, repeats=rep, axis=1)
+            v = F.repeat(v, repeats=rep, axis=1)
+        out = F._contrib_sdp_attention(q, k, v, causal=True)
+        out = out.transpose((0, 2, 1, 3)).reshape((b, l, self._units))
+        return self.out_proj(out)
+
+
+class LlamaMLP(HybridBlock):
+    """SwiGLU: gate and up projected in ONE matmul, then silu(gate)*up."""
+
+    def __init__(self, units, hidden_size, prefix=None, params=None):
+        super().__init__(prefix=prefix, params=params)
+        self._hidden = hidden_size
+        with self.name_scope():
+            self.gate_up = nn.Dense(2 * hidden_size, flatten=False,
+                                    use_bias=False, prefix="gateup_")
+            self.down = nn.Dense(units, flatten=False, use_bias=False,
+                                 prefix="down_")
+
+    def hybrid_forward(self, F, x):
+        gu = self.gate_up(x)
+        gate, up = F.split(gu, num_outputs=2, axis=-1)
+        return self.down(F.Activation(gate, act_type="silu") * up)
+
+
+class LlamaBlock(HybridBlock):
+    def __init__(self, units, hidden_size, num_heads, num_kv_heads=None,
+                 rope_theta=10000.0, eps=1e-6, prefix=None, params=None):
+        super().__init__(prefix=prefix, params=params)
+        with self.name_scope():
+            self.attn_norm = RMSNorm(units, eps, prefix="attnnorm_")
+            self.attention = LlamaAttention(units, num_heads, num_kv_heads,
+                                            rope_theta, prefix="attn_")
+            self.mlp_norm = RMSNorm(units, eps, prefix="mlpnorm_")
+            self.mlp = LlamaMLP(units, hidden_size, prefix="mlp_")
+
+    def hybrid_forward(self, F, x):
+        x = x + self.attention(self.attn_norm(x))
+        return x + self.mlp(self.mlp_norm(x))
+
+
+class LlamaModel(HybridBlock):
+    """Decoder-only causal LM; returns (B, L, vocab) logits."""
+
+    def __init__(self, vocab_size=128256, num_layers=32, units=4096,
+                 hidden_size=14336, num_heads=32, num_kv_heads=8,
+                 rope_theta=500000.0, eps=1e-5, tie_weights=False,
+                 prefix=None, params=None):
+        super().__init__(prefix=prefix, params=params)
+        self._units = units
+        with self.name_scope():
+            self.embed = nn.Embedding(vocab_size, units, prefix="embed_")
+            self.blocks = []
+            for i in range(num_layers):
+                blk = LlamaBlock(units, hidden_size, num_heads, num_kv_heads,
+                                 rope_theta, eps, prefix=f"layer{i}_")
+                self.blocks.append(blk)
+                self.register_child(blk, f"layer{i}")
+            self.norm = RMSNorm(units, eps, prefix="norm_")
+            if tie_weights:
+                self.lm_head = nn.Dense(vocab_size, flatten=False,
+                                        use_bias=False,
+                                        params=self.embed.params,
+                                        prefix="embed_")
+            else:
+                self.lm_head = nn.Dense(vocab_size, flatten=False,
+                                        use_bias=False, prefix="lm_head_")
+
+    def hybrid_forward(self, F, tokens):
+        x = self.embed(tokens)
+        for blk in self.blocks:
+            x = blk(x)
+        return self.lm_head(self.norm(x))
+
+
+def llama_sharding_rules(tp_axis="tp"):
+    """Megatron TP: q/kv/gate-up column-parallel, out/down row-parallel,
+    embedding + lm_head vocab-parallel."""
+    from ....parallel import ShardingRules
+    from jax.sharding import PartitionSpec as P
+
+    return ShardingRules([
+        (r"(q|kv|gateup)_weight$", P(tp_axis, None)),
+        (r"(out|down)_weight$", P(None, tp_axis)),
+        (r"(embed|lm_head)_weight$", P(tp_axis, None)),
+    ])
+
+
+def llama_tiny(**kwargs):
+    """Test-sized config (CI / dry-run)."""
+    cfg = dict(vocab_size=256, num_layers=2, units=64, hidden_size=128,
+               num_heads=4, num_kv_heads=2, rope_theta=10000.0)
+    cfg.update(kwargs)
+    return LlamaModel(**cfg)
+
+
+def llama_3_8b(**kwargs):
+    """Llama-3-8B shapes (BASELINE.json stretch config)."""
+    cfg = dict(vocab_size=128256, num_layers=32, units=4096,
+               hidden_size=14336, num_heads=32, num_kv_heads=8,
+               rope_theta=500000.0)
+    cfg.update(kwargs)
+    return LlamaModel(**cfg)

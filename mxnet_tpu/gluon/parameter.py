@@ -63,13 +63,18 @@ class Parameter:
         if self._shape is None:
             self._shape = tuple(new_shape)
             return
+        # 0 is an unknown dim on EITHER side (deferred init / shared params
+        # e.g. a tied Dense declaring (vocab, 0) over an embedding's
+        # (vocab, units)); merge keeping the more specific size.
         if len(self._shape) != len(new_shape) or any(
-            s != 0 and s != n for s, n in zip(self._shape, new_shape)
+            s != 0 and n != 0 and s != n
+            for s, n in zip(self._shape, new_shape)
         ):
             raise MXNetError(
                 f"Parameter {self.name}: cannot overwrite shape {self._shape} "
                 f"with incompatible {tuple(new_shape)}")
-        self._shape = tuple(new_shape)
+        self._shape = tuple(s if n == 0 else n
+                            for s, n in zip(self._shape, new_shape))
 
     @property
     def grad_req(self):

@@ -1,0 +1,87 @@
+"""Fused attention ops.
+
+Reference: ``src/operator/contrib/transformer.cc`` — MXNet's fused attention
+is a pair of batched-matmul kernels (`_contrib_interleaved_matmul_selfatt_qk`
+/ `..._valatt`) used by GluonNLP's Transformer/BERT. The TPU-native design
+exposes ONE fused scaled-dot-product attention op instead: softmax statistics
+in f32, bf16 matmuls on the MXU, and a single seam where the Pallas
+flash-attention kernel (mxnet_tpu.pallas_kernels) replaces the reference
+path on TPU for long sequences.
+"""
+from __future__ import annotations
+
+import math
+
+import jax
+import jax.numpy as jnp
+
+from .registry import register
+
+
+def _sdpa_reference(q, k, v, mask, scale, causal):
+    """(B, H, Lq, D) x (B, H, Lk, D) -> (B, H, Lq, D); f32 softmax."""
+    dtype = q.dtype
+    scores = jnp.einsum("bhqd,bhkd->bhqk", q, k).astype(jnp.float32) * scale
+    if causal:
+        lq, lk = scores.shape[-2], scores.shape[-1]
+        causal_mask = jnp.tril(jnp.ones((lq, lk), dtype=bool), k=lk - lq)
+        scores = jnp.where(causal_mask, scores, jnp.float32(-1e9))
+    if mask is not None:
+        # mask: 1 = attend, 0 = ignore; broadcastable to (B, H, Lq, Lk)
+        m = jnp.broadcast_to(mask.astype(bool), scores.shape)
+        scores = jnp.where(m, scores, jnp.float32(-1e9))
+    probs = jax.nn.softmax(scores, axis=-1)
+    return jnp.einsum("bhqk,bhkd->bhqd", probs.astype(dtype), v)
+
+
+@register("_contrib_sdp_attention", aliases=["sdp_attention"])
+def sdp_attention(query, key, value, mask=None, *, scale=None, causal=False,
+                  flash=True):
+    """Scaled dot-product attention over (batch, heads, seq, head_dim).
+
+    ``flash=True`` routes to the Pallas flash kernel on TPU when the shape
+    qualifies (seq multiple of block size); otherwise the XLA reference path
+    runs (which XLA fuses well on its own for short sequences).
+    """
+    if scale is None:
+        scale = 1.0 / math.sqrt(query.shape[-1])
+    if flash and mask is None:
+        from ..pallas_kernels import (flash_attention, flash_attention_scan,
+                                      flash_supported)
+
+        if flash_supported(query, key, value):
+            return flash_attention(query, key, value, scale=scale,
+                                   causal=causal)
+        if key.shape[-2] >= 2048:
+            # long sequence off-TPU: O(L) memory blockwise path
+            return flash_attention_scan(query, key, value, scale=scale,
+                                        causal=causal)
+    return _sdpa_reference(query, key, value, mask, scale, causal)
+
+
+@register("_contrib_rms_norm", aliases=["rms_norm"])
+def rms_norm(data, weight, *, eps=1e-6):
+    """RMSNorm (no reference counterpart — Llama-era op, SURVEY.md §5.7).
+    Statistics in f32, output in compute dtype."""
+    x32 = data.astype(jnp.float32)
+    inv = jax.lax.rsqrt(jnp.mean(x32 * x32, axis=-1, keepdims=True) + eps)
+    return (x32 * inv).astype(data.dtype) * weight
+
+
+@register("_contrib_rope", aliases=["rope"])
+def rope(data, *, theta=10000.0, position_offset=0):
+    """Rotary position embedding over (B, L, H, D); rotate-half convention.
+    Computed in-graph from positions — no host-side tables."""
+    b, l, h, d = data.shape
+    pos = jnp.arange(position_offset, position_offset + l,
+                     dtype=jnp.float32)
+    inv_freq = 1.0 / (theta ** (jnp.arange(0, d, 2, dtype=jnp.float32) / d))
+    angles = pos[:, None] * inv_freq[None, :]            # (L, D/2)
+    cos = jnp.cos(angles)[None, :, None, :]
+    sin = jnp.sin(angles)[None, :, None, :]
+    x1 = data[..., 0::2].astype(jnp.float32)
+    x2 = data[..., 1::2].astype(jnp.float32)
+    r1 = x1 * cos - x2 * sin
+    r2 = x2 * cos + x1 * sin
+    out = jnp.stack([r1, r2], axis=-1).reshape((b, l, h, d))
+    return out.astype(data.dtype)

@@ -70,7 +70,9 @@ def convolution(data, weight, bias=None, *, kernel=(), stride=(), dilate=(),
         rhs_dilation=dilate,
         dimension_numbers=dnums,
         feature_group_count=num_group,
-        preferred_element_type=jnp.float32 if data.dtype == jnp.bfloat16 else None,
+        # NOTE: no preferred_element_type=f32 here — the TPU MXU accumulates
+        # bf16 convs in f32 natively, and an explicit f32 output breaks the
+        # conv transpose (VJP) rule's dtype agreement.
     )
     out = out.astype(data.dtype)
     if not no_bias and bias is not None:
@@ -286,6 +288,11 @@ def activation(data, *, act_type="relu"):
         "tanh": jnp.tanh,
         "softrelu": jax.nn.softplus,
         "softsign": jax.nn.soft_sign,
+        "silu": jax.nn.silu,
+        "swish": jax.nn.silu,
+        "gelu": lambda x: jax.nn.gelu(x, approximate=False),
+        "gelu_tanh": lambda x: jax.nn.gelu(x, approximate=True),
+        "mish": lambda x: x * jnp.tanh(jax.nn.softplus(x)),
     }
     return fns[act_type](data)
 

@@ -1,0 +1,14 @@
+"""Pallas TPU kernels — the hand-written hot path.
+
+Reference counterpart: MXNet's fused CUDA kernels
+(`src/operator/contrib/transformer.cc`, `src/operator/fusion/`) and NVRTC
+runtime fusion. On TPU, XLA already fuses elementwise chains; what pays here
+is flash attention (O(L) memory softmax-attention streaming K/V blocks
+through VMEM) — the enabler for long sequences — plus the `mx.pallas`
+user-kernel surface (the `mx.rtc.CudaModule` capability re-imagined,
+see mxnet_tpu.pallas_api).
+"""
+from .flash_attention import (flash_attention, flash_attention_scan,
+                              flash_supported)
+
+__all__ = ["flash_attention", "flash_attention_scan", "flash_supported"]

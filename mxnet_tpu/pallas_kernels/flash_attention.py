@@ -1,0 +1,210 @@
+"""Flash attention: Pallas TPU kernel + blockwise-scan fallback.
+
+Two implementations of the same O(L) -memory online-softmax algorithm:
+
+* ``flash_attention`` — Pallas kernel. Grid (batch*heads, q_blocks,
+  k_blocks), K/V streamed HBM->VMEM one block per grid step, f32
+  accumulators in VMEM scratch, bf16 matmuls on the MXU. Backward via
+  ``jax.custom_vjp`` differentiating the scan fallback (recompute — trades
+  FLOPs for the O(L^2) score matrix, the flash trade).
+* ``flash_attention_scan`` — pure-XLA `lax.scan` over K blocks; runs
+  anywhere (the CPU-oracle path for check_consistency tests) and is the
+  long-sequence fallback when the kernel's shape constraints aren't met.
+
+Shapes: q (B, H, Lq, D), k/v (B, H, Lk, D) -> (B, H, Lq, D).
+"""
+from __future__ import annotations
+
+import functools
+import math
+
+import jax
+import jax.numpy as jnp
+
+BLOCK_Q = 128
+BLOCK_K = 128
+_NEG_INF = -1e30
+
+
+def flash_supported(q, k, v) -> bool:
+    """Kernel eligibility: TPU platform + block-aligned sequence lengths."""
+    try:
+        platform = jax.devices()[0].platform
+    except Exception:
+        return False
+    if platform != "tpu":
+        return False
+    lq, lk = q.shape[-2], k.shape[-2]
+    return (lq % BLOCK_Q == 0 and lk % BLOCK_K == 0
+            and q.shape[-1] <= 256 and q.shape[-1] % 8 == 0)
+
+
+# ---------------------------------------------------------------------------
+# scan fallback (runs anywhere; also the VJP recompute path)
+# ---------------------------------------------------------------------------
+
+
+def flash_attention_scan(q, k, v, scale=None, causal=False,
+                         block_k=BLOCK_K):
+    """Online-softmax attention via lax.scan over K blocks. O(Lk/block)
+    scan steps, never materialises the (Lq, Lk) score matrix."""
+    if scale is None:
+        scale = 1.0 / math.sqrt(q.shape[-1])
+    dtype = q.dtype
+    b, h, lq, d = q.shape
+    lk = k.shape[2]
+    block_k = min(block_k, lk)
+    nk = -(-lk // block_k)
+    pad = nk * block_k - lk
+    if pad:
+        k = jnp.pad(k, ((0, 0), (0, 0), (0, pad), (0, 0)))
+        v = jnp.pad(v, ((0, 0), (0, 0), (0, pad), (0, 0)))
+    qf = q.astype(jnp.float32) * scale
+    kf = k.astype(jnp.float32).reshape(b, h, nk, block_k, d)
+    vf = v.astype(jnp.float32).reshape(b, h, nk, block_k, d)
+    # bottom-right causal alignment (matches _sdpa_reference's tril
+    # k=lk-lq): the LAST query row sees all lk keys
+    q_pos = jnp.arange(lq)[:, None] + (lk - lq)
+
+    def body(carry, blk):
+        acc, m, l = carry
+        kb, vb, kidx = blk
+        s = jnp.einsum("bhqd,bhkd->bhqk", qf, kb)
+        k_pos = kidx * block_k + jnp.arange(block_k)[None, :]
+        valid = k_pos < lk
+        if causal:
+            valid = valid & (k_pos <= q_pos)
+        s = jnp.where(valid[None, None], s, _NEG_INF)
+        m_new = jnp.maximum(m, jnp.max(s, axis=-1, keepdims=True))
+        p = jnp.exp(s - m_new)
+        alpha = jnp.exp(m - m_new)
+        l_new = l * alpha + jnp.sum(p, axis=-1, keepdims=True)
+        acc_new = acc * alpha + jnp.einsum("bhqk,bhkd->bhqd", p, vb)
+        return (acc_new, m_new, l_new), None
+
+    acc0 = jnp.zeros((b, h, lq, d), jnp.float32)
+    m0 = jnp.full((b, h, lq, 1), _NEG_INF, jnp.float32)
+    l0 = jnp.zeros((b, h, lq, 1), jnp.float32)
+    (acc, m, l), _ = jax.lax.scan(
+        body, (acc0, m0, l0),
+        (kf.transpose(2, 0, 1, 3, 4), vf.transpose(2, 0, 1, 3, 4),
+         jnp.arange(nk)))
+    return (acc / l).astype(dtype)
+
+
+# ---------------------------------------------------------------------------
+# pallas kernel
+# ---------------------------------------------------------------------------
+
+
+def _fwd_kernel(q_ref, k_ref, v_ref, o_ref, acc_ref, m_ref, l_ref, *,
+                scale, causal, nk, causal_offset):
+    from jax.experimental import pallas as pl
+
+    ki = pl.program_id(2)
+
+    @pl.when(ki == 0)
+    def _init():
+        acc_ref[:] = jnp.zeros_like(acc_ref)
+        m_ref[:] = jnp.full_like(m_ref, _NEG_INF)
+        l_ref[:] = jnp.zeros_like(l_ref)
+
+    qi = pl.program_id(1)
+
+    def compute():
+        q = q_ref[0].astype(jnp.float32) * scale          # (BQ, D)
+        k = k_ref[0].astype(jnp.float32)                   # (BK, D)
+        v = v_ref[0].astype(jnp.float32)
+        s = jax.lax.dot_general(
+            q, k, (((1,), (1,)), ((), ())),
+            preferred_element_type=jnp.float32)            # (BQ, BK)
+        if causal:
+            # bottom-right alignment: offset = lk - lq
+            q_pos = causal_offset + qi * BLOCK_Q + jax.lax.broadcasted_iota(
+                jnp.int32, (BLOCK_Q, BLOCK_K), 0)
+            k_pos = ki * BLOCK_K + jax.lax.broadcasted_iota(
+                jnp.int32, (BLOCK_Q, BLOCK_K), 1)
+            s = jnp.where(k_pos <= q_pos, s, _NEG_INF)
+        m_prev = m_ref[:, 0:1]                             # (BQ, 1)
+        m_new = jnp.maximum(m_prev, jnp.max(s, axis=-1, keepdims=True))
+        p = jnp.exp(s - m_new)
+        alpha = jnp.exp(m_prev - m_new)
+        l_ref[:] = l_ref[:] * alpha + jnp.broadcast_to(
+            jnp.sum(p, axis=-1, keepdims=True), l_ref.shape)
+        acc_ref[:] = acc_ref[:] * alpha + jnp.dot(
+            p, v, preferred_element_type=jnp.float32)
+        m_ref[:] = jnp.broadcast_to(m_new, m_ref.shape)
+
+    if causal:
+        # blocks entirely above the diagonal contribute nothing — skip
+        @pl.when(ki * BLOCK_K <= causal_offset + qi * BLOCK_Q + BLOCK_Q - 1)
+        def _():
+            compute()
+    else:
+        compute()
+
+    @pl.when(ki == nk - 1)
+    def _final():
+        o_ref[0] = (acc_ref[:] / l_ref[:, 0:1]).astype(o_ref.dtype)
+
+
+def _flash_fwd_pallas(q, k, v, scale, causal, interpret=False):
+    from jax.experimental import pallas as pl
+    from jax.experimental.pallas import tpu as pltpu
+
+    b, h, lq, d = q.shape
+    lk = k.shape[2]
+    bh = b * h
+    q3 = q.reshape(bh, lq, d)
+    k3 = k.reshape(bh, lk, d)
+    v3 = v.reshape(bh, lk, d)
+    nq, nk = lq // BLOCK_Q, lk // BLOCK_K
+    kernel = functools.partial(_fwd_kernel, scale=scale, causal=causal,
+                               nk=nk, causal_offset=lk - lq)
+    out = pl.pallas_call(
+        kernel,
+        grid=(bh, nq, nk),
+        in_specs=[
+            pl.BlockSpec((1, BLOCK_Q, d), lambda bh_, qi, ki: (bh_, qi, 0)),
+            pl.BlockSpec((1, BLOCK_K, d), lambda bh_, qi, ki: (bh_, ki, 0)),
+            pl.BlockSpec((1, BLOCK_K, d), lambda bh_, qi, ki: (bh_, ki, 0)),
+        ],
+        out_specs=pl.BlockSpec((1, BLOCK_Q, d),
+                               lambda bh_, qi, ki: (bh_, qi, 0)),
+        out_shape=jax.ShapeDtypeStruct((bh, lq, d), q.dtype),
+        scratch_shapes=[
+            pltpu.VMEM((BLOCK_Q, d), jnp.float32),
+            pltpu.VMEM((BLOCK_Q, 128), jnp.float32),
+            pltpu.VMEM((BLOCK_Q, 128), jnp.float32),
+        ],
+        interpret=interpret,
+    )(q3, k3, v3)
+    return out.reshape(b, h, lq, d)
+
+
+@functools.partial(jax.custom_vjp, nondiff_argnums=(3, 4, 5))
+def _flash(q, k, v, scale, causal, interpret):
+    return _flash_fwd_pallas(q, k, v, scale, causal, interpret)
+
+
+def _flash_fwd(q, k, v, scale, causal, interpret):
+    return _flash_fwd_pallas(q, k, v, scale, causal, interpret), (q, k, v)
+
+
+def _flash_bwd(scale, causal, interpret, res, g):
+    q, k, v = res
+    # recompute-based backward through the O(L)-memory scan path
+    _, vjp = jax.vjp(
+        lambda q_, k_, v_: flash_attention_scan(q_, k_, v_, scale=scale,
+                                                causal=causal), q, k, v)
+    return vjp(g)
+
+
+_flash.defvjp(_flash_fwd, _flash_bwd)
+
+
+def flash_attention(q, k, v, scale=None, causal=False, interpret=False):
+    """Pallas flash attention (differentiable)."""
+    if scale is None:
+        scale = 1.0 / math.sqrt(q.shape[-1])
+    return _flash(q, k, v, float(scale), bool(causal), bool(interpret))

@@ -88,9 +88,14 @@ def spec_for_param(name: str, shape, rules: Optional[ShardingRules], mesh):
     if spec is None:
         return P()
     entries = list(spec) + [None] * (len(shape) - len(spec))
+    axis_names = set(getattr(mesh, "axis_names", ()) or ())
     for dim, entry in zip(shape, entries):
         size = 1
         for ax in _axes_of(entry):
+            if ax not in axis_names:
+                # rule names an axis this mesh doesn't have (e.g. TP rules
+                # on a dp-only mesh): fall back to replication
+                return P()
             size *= mesh_axis_size(mesh, ax)
         if size > 1 and dim % size:
             return P()

@@ -1,0 +1,164 @@
+"""NLP model zoo tests: transformer/BERT/Llama forward shapes, causality,
+weight tying, sharded training on the 8-device CPU mesh, hybridize parity."""
+import numpy as np
+import pytest
+
+import mxnet_tpu as mx
+from mxnet_tpu import parallel as par
+from mxnet_tpu.gluon import loss as gloss
+from mxnet_tpu.gluon.model_zoo import nlp
+
+
+def toks(b, l, vocab, seed=0):
+    return mx.nd.array(
+        np.random.RandomState(seed).randint(0, vocab, (b, l)).astype("float32"))
+
+
+class _LMLoss:
+    def __init__(self):
+        self._l = gloss.SoftmaxCrossEntropyLoss()
+
+    def __call__(self, out, labels):
+        if isinstance(out, (tuple, list)):
+            out = out[-1]  # mlm logits
+        return self._l(out.reshape((-1, out.shape[-1])), labels.reshape((-1,)))
+
+
+class TestForwardShapes:
+    def test_bert_outputs(self):
+        bert = nlp.BERTModel(vocab_size=100, max_length=32, num_layers=2,
+                             units=32, hidden_size=64, num_heads=4)
+        bert.initialize()
+        seq, pooled, cls, mlm = bert(
+            toks(2, 16, 100), toks(2, 16, 2, 1),
+            mx.nd.array(np.ones((2, 16), dtype="float32")))
+        assert seq.shape == (2, 16, 32)
+        assert pooled.shape == (2, 32)
+        assert cls.shape == (2, 2)
+        assert mlm.shape == (2, 16, 100)
+
+    def test_transformer_nmt(self):
+        tr = nlp.Transformer(src_vocab=50, num_layers=2, units=32,
+                             hidden_size=64, num_heads=4, max_length=32)
+        tr.initialize()
+        out = tr(toks(2, 10, 50), toks(2, 12, 50, 1))
+        assert out.shape == (2, 12, 50)
+
+    def test_llama_logits(self):
+        ll = nlp.llama_tiny()
+        ll.initialize()
+        out = ll(toks(2, 16, 256))
+        assert out.shape == (2, 16, 256)
+
+    def test_get_model(self):
+        m = nlp.get_model("llama_tiny")
+        assert isinstance(m, nlp.LlamaModel)
+        with pytest.raises(ValueError):
+            nlp.get_model("nope")
+
+
+class TestSemantics:
+    def test_llama_causality(self):
+        """Changing a future token must not change earlier logits."""
+        ll = nlp.llama_tiny()
+        ll.initialize()
+        x1 = toks(1, 8, 256, 3)
+        x2 = x1.copy()
+        x2[0, -1] = (float(x2[0, -1].asnumpy()) + 1) % 256
+        o1 = ll(x1).asnumpy()
+        o2 = ll(x2).asnumpy()
+        np.testing.assert_allclose(o1[0, :-1], o2[0, :-1], rtol=1e-4,
+                                   atol=1e-5)
+        assert not np.allclose(o1[0, -1], o2[0, -1])
+
+    def test_bert_mask_blocks_padding(self):
+        """Masked (padding) keys must not influence valid positions."""
+        bert = nlp.BERTModel(vocab_size=50, max_length=16, num_layers=1,
+                             units=16, hidden_size=32, num_heads=2,
+                             dropout=0.0, use_pooler=False,
+                             use_classifier=False, use_decoder=False)
+        bert.initialize()
+        x1 = toks(1, 8, 50, 5)
+        x2 = x1.copy()
+        x2[0, -2:] = 0  # change padding-region tokens
+        mask = np.ones((1, 8), dtype="float32")
+        mask[0, -2:] = 0
+        m = mx.nd.array(mask)
+        o1 = bert(x1, None, m).asnumpy()
+        o2 = bert(x2, None, m).asnumpy()
+        np.testing.assert_allclose(o1[0, :6], o2[0, :6], rtol=1e-4, atol=1e-5)
+
+    def test_bert_tied_decoder(self):
+        """MLM decoder weight IS the word-embedding weight."""
+        bert = nlp.BERTModel(vocab_size=40, max_length=8, num_layers=1,
+                             units=16, hidden_size=32, num_heads=2)
+        bert.initialize()
+        emb_w = bert.word_embed.params.get("weight")
+        dec_w = bert.decoder.params.get("weight")
+        assert emb_w is dec_w
+
+    def test_rope_rotation_invariance(self):
+        """RoPE preserves norms (pure rotation of pairs)."""
+        import mxnet_tpu.ndarray as nd
+        x = mx.nd.array(np.random.RandomState(0)
+                        .randn(2, 8, 4, 16).astype("float32"))
+        r = nd.rope(x, theta=10000.0)
+        np.testing.assert_allclose(
+            np.linalg.norm(x.asnumpy(), axis=-1),
+            np.linalg.norm(r.asnumpy(), axis=-1), rtol=1e-5)
+
+    def test_sdp_attention_matches_manual(self):
+        import mxnet_tpu.ndarray as nd
+        rs = np.random.RandomState(0)
+        q = rs.randn(1, 2, 4, 8).astype("float32")
+        k = rs.randn(1, 2, 4, 8).astype("float32")
+        v = rs.randn(1, 2, 4, 8).astype("float32")
+        out = nd.sdp_attention(mx.nd.array(q), mx.nd.array(k),
+                               mx.nd.array(v)).asnumpy()
+        scores = np.einsum("bhqd,bhkd->bhqk", q, k) / np.sqrt(8)
+        e = np.exp(scores - scores.max(-1, keepdims=True))
+        p = e / e.sum(-1, keepdims=True)
+        ref = np.einsum("bhqk,bhkd->bhqd", p, v)
+        np.testing.assert_allclose(out, ref, rtol=1e-4, atol=1e-5)
+
+
+class TestShardedTraining:
+    def test_llama_tp_sp_dp_trains(self):
+        ll = nlp.llama_tiny()
+        ll.initialize()
+        mesh = par.make_mesh({"dp": 2, "sp": 2, "tp": 2})
+        step = par.TrainStep(ll, _LMLoss(), "adamw", mesh=mesh,
+                             rules=nlp.llama_sharding_rules(), seq_axis="sp",
+                             optimizer_params={"learning_rate": 3e-3})
+        x, y = toks(4, 16, 256, 1), toks(4, 16, 256, 2)
+        losses = [float(step(x, y)[0].asnumpy()) for _ in range(6)]
+        assert losses[-1] < losses[0]
+        from jax.sharding import PartitionSpec as P
+        w = [p for p in ll.collect_params().values()
+             if p.name.endswith("gateup_weight")][0]
+        assert w.data().data.sharding.spec == P("tp", None)
+
+    def test_bert_tp_trains(self):
+        bert = nlp.BERTModel(vocab_size=100, max_length=32, num_layers=2,
+                             units=32, hidden_size=64, num_heads=4,
+                             dropout=0.0, use_pooler=False,
+                             use_classifier=False, use_decoder=True)
+        bert.initialize()
+        step = par.TrainStep(bert, _LMLoss(), "adamw",
+                             mesh=par.make_mesh({"dp": 4, "tp": 2}),
+                             rules=nlp.bert_sharding_rules(),
+                             optimizer_params={"learning_rate": 1e-2})
+        x, y = toks(4, 16, 100, 1), toks(4, 16, 100, 2)
+        losses = [float(step(x, y)[0].asnumpy()) for _ in range(15)]
+        assert losses[-1] < losses[0] * 0.8
+
+
+class TestHybridize:
+    def test_llama_hybridize_parity(self):
+        ll = nlp.llama_tiny()
+        ll.initialize()
+        x = toks(2, 8, 256, 7)
+        eager = ll(x).asnumpy()
+        ll.hybridize()
+        jitted = ll(x).asnumpy()
+        np.testing.assert_allclose(eager, jitted, rtol=1e-4, atol=1e-5)
