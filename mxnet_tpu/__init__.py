@@ -53,7 +53,12 @@ from . import metric
 from . import gluon
 from . import kvstore
 from . import kvstore as kv
+from . import io
+from . import module
+from . import module as mod
 from . import parallel
+from . import symbol
+from . import symbol as sym
 from . import tracing
 
 from .ndarray import NDArray

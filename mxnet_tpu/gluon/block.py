@@ -449,6 +449,10 @@ class HybridBlock(Block):
 
     # ------------------------------------------------------------------
     def forward(self, *args):
+        from ..symbol import Symbol as _Sym
+
+        if args and isinstance(args[0], _Sym):
+            return self._symbolic_forward(*args)
         if self._active and args and isinstance(args[0], NDArray) \
                 and not tracing.is_tracing():
             if self._cached_graph is None:
@@ -459,6 +463,19 @@ class HybridBlock(Block):
                 self._deferred_infer_shape(*args)
                 return self._cached_graph(list(args))
         return self._eager_forward(*args)
+
+    def _symbolic_forward(self, *args):
+        """Trace hybrid_forward with Symbol proxies (reference:
+        HybridBlock._build_cache's CachedOp graph construction; here it
+        serves `export()` → symbol.json). Parameters become variables named
+        by their full parameter name, so the exported graph binds against
+        the saved .params file."""
+        from .. import symbol as sym_mod
+
+        pdata = {}
+        for name, p in self._reg_params.items():
+            pdata[name] = sym_mod.var(p.name)
+        return self.hybrid_forward(sym_mod, *args, **pdata)
 
     def _eager_forward(self, *args):
         """Un-compiled forward: resolve params and call hybrid_forward."""

@@ -1,0 +1,13 @@
+"""mx.io — DataIter protocol and iterators.
+
+Reference: ``python/mxnet/io/io.py`` (`DataIter`, `DataBatch`, `DataDesc`,
+`NDArrayIter`, `PrefetchingIter`, `ResizeIter`) and the C++-backed iters
+(`MXDataIter` wrapping `src/io/` — MNISTIter/ImageRecordIter/CSVIter).
+TPU note: iterators produce host-side batches; device placement happens at
+bind/step time (per-host sharded `device_put` on pods).
+"""
+from .io import (DataDesc, DataBatch, DataIter, NDArrayIter, ResizeIter,
+                 PrefetchingIter, CSVIter, MNISTIter)
+
+__all__ = ["DataDesc", "DataBatch", "DataIter", "NDArrayIter", "ResizeIter",
+           "PrefetchingIter", "CSVIter", "MNISTIter"]

@@ -1,0 +1,15 @@
+"""mx.mod — legacy symbolic trainer API.
+
+Reference: ``python/mxnet/module/`` — ``BaseModule.fit`` (the classic MXNet
+training loop), ``Module`` (bind/init_params/init_optimizer/
+forward/backward/update over per-device executors), ``BucketingModule``
+(per-bucket executors sharing params — the variable-length answer).
+TPU-native: one Executor (= one jitted fwd+bwd graph); the
+DataParallelExecutorGroup's batch slicing collapses into mesh sharding
+(mxnet_tpu.parallel), and buckets map onto the jit shape-cache.
+"""
+from .module import Module, BucketingModule, BaseModule, save_checkpoint, \
+    load_checkpoint
+
+__all__ = ["Module", "BucketingModule", "BaseModule", "save_checkpoint",
+           "load_checkpoint"]

@@ -1,0 +1,466 @@
+"""Module / BucketingModule (reference: python/mxnet/module/)."""
+from __future__ import annotations
+
+import logging
+import time
+from typing import Dict, List, Optional
+
+import numpy as _np
+
+from .. import initializer as init_mod
+from .. import metric as metric_mod
+from .. import optimizer as opt_mod
+from ..base import MXNetError
+from ..context import Context, cpu, current_context
+from ..ndarray import NDArray, array as nd_array, zeros as nd_zeros
+from ..ndarray.serialization import save as nd_save, load as nd_load
+from ..symbol import Symbol
+from ..symbol import load as sym_load
+
+
+def save_checkpoint(prefix, epoch, symbol, arg_params, aux_params):
+    """reference: python/mxnet/model.py::save_checkpoint — writes
+    prefix-symbol.json + prefix-%04d.params (the deployment artifact)."""
+    if symbol is not None:
+        symbol.save(f"{prefix}-symbol.json")
+    payload = {}
+    payload.update({f"arg:{k}": v for k, v in (arg_params or {}).items()})
+    payload.update({f"aux:{k}": v for k, v in (aux_params or {}).items()})
+    nd_save(f"{prefix}-{epoch:04d}.params", payload)
+
+
+def load_checkpoint(prefix, epoch):
+    """reference: model.py::load_checkpoint."""
+    symbol = sym_load(f"{prefix}-symbol.json")
+    payload = nd_load(f"{prefix}-{epoch:04d}.params")
+    arg_params, aux_params = {}, {}
+    for k, v in payload.items():
+        if k.startswith("arg:"):
+            arg_params[k[4:]] = v
+        elif k.startswith("aux:"):
+            aux_params[k[4:]] = v
+        else:
+            arg_params[k] = v
+    return symbol, arg_params, aux_params
+
+
+class BaseModule:
+    """reference: module/base_module.py::BaseModule — fit/score/predict."""
+
+    def __init__(self, logger=None):
+        self.logger = logger or logging.getLogger(__name__)
+        self.binded = False
+        self.params_initialized = False
+        self.optimizer_initialized = False
+
+    # subclass surface: bind, init_params, init_optimizer, forward,
+    # backward, update, get_outputs, update_metric
+
+    def forward_backward(self, data_batch):
+        self.forward(data_batch, is_train=True)
+        self.backward()
+
+    def score(self, eval_data, eval_metric, num_batch=None, reset=True,
+              epoch=0):
+        if reset:
+            eval_data.reset()
+        if isinstance(eval_metric, str):
+            eval_metric = metric_mod.create(eval_metric)
+        eval_metric.reset()
+        for nbatch, batch in enumerate(eval_data):
+            if num_batch is not None and nbatch == num_batch:
+                break
+            self.forward(batch, is_train=False)
+            self.update_metric(eval_metric, batch.label)
+        return eval_metric.get_name_value()
+
+    def predict(self, eval_data, num_batch=None, reset=True):
+        if reset:
+            eval_data.reset()
+        outputs = []
+        for nbatch, batch in enumerate(eval_data):
+            if num_batch is not None and nbatch == num_batch:
+                break
+            self.forward(batch, is_train=False)
+            outs = self.get_outputs()
+            pad = batch.pad or 0
+            n = outs[0].shape[0] - pad
+            outputs.append([o[:n] for o in outs])
+        if not outputs:
+            return []
+        from ..ndarray import concat
+
+        n_out = len(outputs[0])
+        merged = []
+        for i in range(n_out):
+            parts = [row[i] for row in outputs]
+            merged.append(concat(*parts, dim=0) if len(parts) > 1
+                          else parts[0])
+        return merged if n_out > 1 else merged[0]
+
+    def fit(self, train_data, eval_data=None, eval_metric="acc",
+            epoch_end_callback=None, batch_end_callback=None,
+            kvstore="local", optimizer="sgd",
+            optimizer_params=(("learning_rate", 0.01),),
+            eval_end_callback=None, eval_batch_end_callback=None,
+            initializer=None, arg_params=None, aux_params=None,
+            allow_missing=False, force_rebind=False, force_init=False,
+            begin_epoch=0, num_epoch=None, validation_metric=None,
+            monitor=None):
+        """reference: base_module.py::BaseModule.fit — the classic loop."""
+        if num_epoch is None:
+            raise MXNetError("num_epoch is required for fit")
+        initializer = initializer or init_mod.Uniform(0.01)
+        self.bind(data_shapes=train_data.provide_data,
+                  label_shapes=train_data.provide_label,
+                  for_training=True, force_rebind=force_rebind)
+        self.init_params(initializer=initializer, arg_params=arg_params,
+                         aux_params=aux_params, allow_missing=allow_missing,
+                         force_init=force_init)
+        self.init_optimizer(kvstore=kvstore, optimizer=optimizer,
+                            optimizer_params=optimizer_params)
+        if isinstance(eval_metric, str):
+            eval_metric = metric_mod.create(eval_metric)
+        validation_metric = validation_metric or eval_metric
+
+        for epoch in range(begin_epoch, num_epoch):
+            tic = time.time()
+            eval_metric.reset()
+            train_data.reset()
+            for nbatch, data_batch in enumerate(train_data):
+                self.forward_backward(data_batch)
+                self.update()
+                self.update_metric(eval_metric, data_batch.label)
+                if batch_end_callback is not None:
+                    param = _BatchEndParam(epoch=epoch, nbatch=nbatch,
+                                           eval_metric=eval_metric,
+                                           locals=locals())
+                    for cb in _as_list(batch_end_callback):
+                        cb(param)
+            for name, val in eval_metric.get_name_value():
+                self.logger.info("Epoch[%d] Train-%s=%f", epoch, name, val)
+            self.logger.info("Epoch[%d] Time cost=%.3f", epoch,
+                             time.time() - tic)
+            if epoch_end_callback is not None:
+                arg_p, aux_p = self.get_params()
+                for cb in _as_list(epoch_end_callback):
+                    cb(epoch, self.symbol, arg_p, aux_p)
+            if eval_data is not None:
+                res = self.score(eval_data, validation_metric, epoch=epoch)
+                for name, val in res:
+                    self.logger.info("Epoch[%d] Validation-%s=%f", epoch,
+                                     name, val)
+
+
+class _BatchEndParam:
+    def __init__(self, epoch, nbatch, eval_metric, locals):
+        self.epoch = epoch
+        self.nbatch = nbatch
+        self.eval_metric = eval_metric
+        self.locals = locals
+
+
+def _as_list(x):
+    return x if isinstance(x, (list, tuple)) else [x]
+
+
+class Module(BaseModule):
+    """reference: module/module.py::Module — a Symbol bound for training.
+
+    TPU-native: ONE executor over the whole fwd+bwd graph; device lists
+    collapse into the mesh (use mxnet_tpu.parallel for multi-chip)."""
+
+    def __init__(self, symbol: Symbol, data_names=("data",),
+                 label_names=("softmax_label",), logger=None, context=None,
+                 work_load_list=None, fixed_param_names=None,
+                 state_names=None, group2ctxs=None,
+                 compression_params=None):
+        super().__init__(logger)
+        self._symbol = symbol
+        self._data_names = list(data_names or [])
+        self._label_names = list(label_names or [])
+        if isinstance(context, (list, tuple)):
+            context = context[0]  # DP via ctx lists → use parallel.TrainStep
+        self._context = context or current_context()
+        self._fixed_param_names = set(fixed_param_names or [])
+        arg_names = symbol.list_arguments()
+        self._param_names = [
+            n for n in arg_names
+            if n not in self._data_names and n not in self._label_names]
+        self._exec = None
+        self._optimizer = None
+        self._updater = None
+        self._kvstore = None
+        self._data_shapes = None
+        self._label_shapes = None
+
+    @property
+    def symbol(self):
+        return self._symbol
+
+    @property
+    def data_names(self):
+        return self._data_names
+
+    @property
+    def output_names(self):
+        return self._symbol.list_outputs()
+
+    @property
+    def data_shapes(self):
+        return self._data_shapes
+
+    @property
+    def label_shapes(self):
+        return self._label_shapes
+
+    @property
+    def output_shapes(self):
+        return list(zip(self.output_names,
+                        [o.shape for o in self._exec.outputs]))
+
+    # -- bind -----------------------------------------------------------
+    def bind(self, data_shapes, label_shapes=None, for_training=True,
+             inputs_need_grad=False, force_rebind=False, shared_module=None,
+             grad_req="write"):
+        if self.binded and not force_rebind:
+            return
+        shape_kwargs = {}
+        self._data_shapes = data_shapes
+        self._label_shapes = label_shapes
+        for desc in data_shapes:
+            name, shape = (desc[0], desc[1]) if isinstance(desc, tuple) \
+                else (desc.name, desc.shape)
+            shape_kwargs[name] = shape
+        for desc in (label_shapes or []):
+            name, shape = (desc[0], desc[1]) if isinstance(desc, tuple) \
+                else (desc.name, desc.shape)
+            shape_kwargs[name] = shape
+        req = {}
+        for n in self._symbol.list_arguments():
+            if n in self._data_names or n in self._label_names or \
+                    n in self._fixed_param_names:
+                req[n] = "null"
+            else:
+                req[n] = grad_req if for_training else "null"
+        self._exec = self._symbol.simple_bind(ctx=self._context,
+                                              grad_req=req, **shape_kwargs)
+        self.binded = True
+
+    # -- params ---------------------------------------------------------
+    def init_params(self, initializer=None, arg_params=None, aux_params=None,
+                    allow_missing=False, force_init=False,
+                    allow_extra=False):
+        if self.params_initialized and not force_init:
+            return
+        if not self.binded:
+            raise MXNetError("call bind before init_params")
+        initializer = initializer or init_mod.Uniform(0.01)
+        for name in self._param_names:
+            arr = self._exec.arg_dict[name]
+            if arg_params and name in arg_params:
+                src = arg_params[name]
+                arr._set_data(src.data if isinstance(src, NDArray)
+                              else nd_array(src).data)
+            elif not allow_missing or arg_params is None:
+                desc = init_mod.InitDesc(name, global_init=initializer)
+                initializer(desc, arr)
+            elif not allow_missing:
+                raise MXNetError(f"missing parameter {name}")
+        for name in self._symbol.list_auxiliary_states():
+            arr = self._exec.aux_dict[name]
+            if aux_params and name in aux_params:
+                src = aux_params[name]
+                arr._set_data(src.data if isinstance(src, NDArray)
+                              else nd_array(src).data)
+            else:
+                # variance-like stats start at 1, means at 0 (reference
+                # behaviour from per-op init attrs)
+                if "var" in name:
+                    arr[:] = 1.0
+                else:
+                    arr[:] = 0.0
+        self.params_initialized = True
+
+    def get_params(self):
+        arg = {n: self._exec.arg_dict[n].copy() for n in self._param_names}
+        aux = {n: v.copy() for n, v in self._exec.aux_dict.items()}
+        return arg, aux
+
+    def set_params(self, arg_params, aux_params, allow_missing=False,
+                   force_init=True, allow_extra=False):
+        self.init_params(initializer=None, arg_params=arg_params,
+                         aux_params=aux_params, allow_missing=allow_missing,
+                         force_init=force_init)
+
+    # -- optimizer ------------------------------------------------------
+    def init_optimizer(self, kvstore="local", optimizer="sgd",
+                       optimizer_params=(("learning_rate", 0.01),),
+                       force_init=False):
+        if self.optimizer_initialized and not force_init:
+            return
+        if isinstance(optimizer, str):
+            idx2name = {i: n for i, n in enumerate(self._param_names)}
+            optimizer = opt_mod.create(
+                optimizer, param_idx2name=idx2name,
+                **dict(optimizer_params or {}))
+        self._optimizer = optimizer
+        self._updater = opt_mod.get_updater(optimizer)
+        self.optimizer_initialized = True
+
+    # -- step -----------------------------------------------------------
+    def forward(self, data_batch, is_train=None):
+        if is_train is None:
+            is_train = False
+        feeds = {}
+        for name, arr in zip(self._data_names, data_batch.data or []):
+            feeds[name] = arr
+        if data_batch.label:
+            for name, arr in zip(self._label_names, data_batch.label):
+                if name in self._exec.arg_dict:
+                    feeds[name] = arr
+        self._exec.forward(is_train=is_train, **feeds)
+
+    def backward(self, out_grads=None):
+        self._exec.backward(out_grads)
+
+    def update(self):
+        for i, name in enumerate(self._param_names):
+            grad = self._exec.grad_dict.get(name)
+            if grad is None:
+                continue
+            self._updater(i, grad, self._exec.arg_dict[name])
+
+    def get_outputs(self, merge_multi_context=True):
+        return self._exec.outputs
+
+    def get_input_grads(self, merge_multi_context=True):
+        return [self._exec.grad_dict.get(n) for n in self._data_names]
+
+    def update_metric(self, eval_metric, labels, pre_sliced=False):
+        eval_metric.update_dict(
+            dict(zip(self._label_names, labels or [])),
+            dict(zip(self.output_names, self._exec.outputs)))
+
+    def save_checkpoint(self, prefix, epoch, save_optimizer_states=False):
+        arg, aux = self.get_params()
+        save_checkpoint(prefix, epoch, self._symbol, arg, aux)
+        if save_optimizer_states:
+            with open(f"{prefix}-{epoch:04d}.states", "wb") as f:
+                f.write(self._updater.get_states())
+
+    @staticmethod
+    def load(prefix, epoch, load_optimizer_states=False, **kwargs):
+        symbol, arg_params, aux_params = load_checkpoint(prefix, epoch)
+        mod = Module(symbol, **kwargs)
+        mod._preloaded = (arg_params, aux_params)
+        mod._preloaded_states = f"{prefix}-{epoch:04d}.states" \
+            if load_optimizer_states else None
+        return mod
+
+
+class BucketingModule(BaseModule):
+    """reference: module/bucketing_module.py — per-bucket executors sharing
+    parameters; here each bucket is one jit cache entry and parameters are
+    shared through a common arg/aux store."""
+
+    def __init__(self, sym_gen, default_bucket_key=None, logger=None,
+                 context=None, **kwargs):
+        super().__init__(logger)
+        self._sym_gen = sym_gen
+        self._default_bucket_key = default_bucket_key
+        self._context = context
+        self._kwargs = kwargs
+        self._modules: Dict = {}
+        self._curr_module: Optional[Module] = None
+        self._curr_key = None
+        self._shared_args: Dict[str, NDArray] = {}
+        self._shared_aux: Dict[str, NDArray] = {}
+        self._optimizer_conf = None
+
+    @property
+    def symbol(self):
+        return self._curr_module.symbol if self._curr_module else None
+
+    def _get_module(self, bucket_key, data_shapes, label_shapes,
+                    for_training=True):
+        if bucket_key in self._modules:
+            return self._modules[bucket_key]
+        sym, data_names, label_names = self._sym_gen(bucket_key)
+        mod = Module(sym, data_names, label_names, logger=self.logger,
+                     context=self._context, **self._kwargs)
+        mod.bind(data_shapes, label_shapes, for_training=for_training)
+        # share parameter storage across buckets (the BucketingModule
+        # contract): same NDArray objects in every executor
+        for n in mod._param_names:
+            if n in self._shared_args:
+                mod._exec.arg_dict[n] = self._shared_args[n]
+            else:
+                self._shared_args[n] = mod._exec.arg_dict[n]
+        for n in mod.symbol.list_auxiliary_states():
+            if n in self._shared_aux:
+                mod._exec.aux_dict[n] = self._shared_aux[n]
+            else:
+                self._shared_aux[n] = mod._exec.aux_dict[n]
+        self._modules[bucket_key] = mod
+        return mod
+
+    def bind(self, data_shapes, label_shapes=None, for_training=True,
+             force_rebind=False, **kwargs):
+        self._curr_module = self._get_module(
+            self._default_bucket_key, data_shapes, label_shapes,
+            for_training)
+        self._curr_key = self._default_bucket_key
+        self.binded = True
+
+    def init_params(self, **kwargs):
+        self._curr_module.init_params(**kwargs)
+        self.params_initialized = True
+
+    def init_optimizer(self, kvstore="local", optimizer="sgd",
+                       optimizer_params=(("learning_rate", 0.01),),
+                       force_init=False):
+        self._curr_module.init_optimizer(kvstore, optimizer,
+                                         optimizer_params, force_init)
+        self._optimizer_conf = (kvstore, optimizer, optimizer_params)
+        # all buckets share one updater (shared parameter state)
+        for mod in self._modules.values():
+            mod._optimizer = self._curr_module._optimizer
+            mod._updater = self._curr_module._updater
+            mod.optimizer_initialized = True
+        self.optimizer_initialized = True
+
+    def switch_bucket(self, bucket_key, data_shapes, label_shapes=None):
+        mod = self._get_module(bucket_key, data_shapes, label_shapes)
+        if not mod.params_initialized and self.params_initialized:
+            mod.params_initialized = True
+        if self.optimizer_initialized and not mod.optimizer_initialized:
+            mod._optimizer = self._curr_module._optimizer
+            mod._updater = self._curr_module._updater
+            mod.optimizer_initialized = True
+        self._curr_module = mod
+        self._curr_key = bucket_key
+
+    def forward(self, data_batch, is_train=None):
+        key = data_batch.bucket_key
+        if key is None:
+            key = self._default_bucket_key
+        if key != self._curr_key:
+            self.switch_bucket(key, data_batch.provide_data,
+                               data_batch.provide_label)
+        self._curr_module.forward(data_batch, is_train)
+
+    def backward(self, out_grads=None):
+        self._curr_module.backward(out_grads)
+
+    def update(self):
+        self._curr_module.update()
+
+    def get_outputs(self, merge_multi_context=True):
+        return self._curr_module.get_outputs()
+
+    def get_params(self):
+        return self._curr_module.get_params()
+
+    def update_metric(self, eval_metric, labels, pre_sliced=False):
+        self._curr_module.update_metric(eval_metric, labels)

@@ -1,0 +1,144 @@
+"""mx.sym — symbolic API namespace with generated op wrappers.
+
+Reference: ``python/mxnet/symbol/register.py`` generates ``mx.sym.*``
+functions from the C op registry at import; here the same
+``mxnet_tpu.ops.registry`` drives both nd and sym wrappers, so every
+operator is automatically available in both APIs (the nnvm single-registry
+property, SURVEY.md §2.1 "Operator library").
+"""
+from __future__ import annotations
+
+import sys
+import types
+
+from ..base import MXNetError
+from ..ops.registry import get_op, list_ops
+from .symbol import (Symbol, var, Variable, Group, load, load_json,
+                     _apply_op)
+from .executor import Executor
+
+__all__ = ["Symbol", "var", "Variable", "Group", "load", "load_json",
+           "Executor"]
+
+
+def _make_symbol_function(opname: str):
+    opdef = get_op(opname)
+
+    def wrapper(*args, name=None, **kwargs):
+        tensors = [None] * len(opdef.tensor_params)
+        attrs = {}
+        if opdef.tensor_params and not opdef.variadic:
+            for i, a in enumerate(args):
+                if i < len(tensors):
+                    tensors[i] = a
+                else:
+                    j = i - len(tensors)
+                    if j < len(opdef.attr_params):
+                        attrs[opdef.attr_params[j]] = a
+                    else:
+                        raise TypeError(
+                            f"{opname}: too many positional arguments")
+            for k, v in kwargs.items():
+                if k in opdef.tensor_params:
+                    tensors[opdef.tensor_params.index(k)] = v
+                else:
+                    attrs[k] = v
+            # auto-create variables for unset inputs (MXNet behaviour:
+            # sym.FullyConnected(data=x) creates fc_weight/fc_bias vars).
+            # Optional tensors are only auto-created for the bias slot and
+            # only when no_bias is unset (conv/fc/deconv convention); other
+            # optional inputs (masks, lengths) stay absent.
+            syms = []
+            base = name or opname.lower().lstrip("_")
+            for pname, t in zip(opdef.tensor_params, tensors):
+                if isinstance(t, Symbol):
+                    syms.append(t)
+                elif t is None:
+                    if pname in opdef.optional_tensor_params:
+                        if pname == "bias" and not attrs.get("no_bias",
+                                                             False):
+                            syms.append(var(f"{base}_{pname}"))
+                        continue
+                    v = var(f"{base}_{pname}")
+                    from .symbol import AUX_PARAMS
+
+                    if pname in AUX_PARAMS.get(opname, ()):
+                        v._entries[0][0].attrs["__aux__"] = True
+                    syms.append(v)
+                else:
+                    raise MXNetError(
+                        f"sym.{opname}: input {pname} must be a Symbol, "
+                        f"got {type(t)}")
+        else:
+            if opdef.variadic:
+                syms = list(args)
+                attrs.update(kwargs)
+            else:
+                for i, a in enumerate(args):
+                    if i < len(opdef.attr_params):
+                        attrs[opdef.attr_params[i]] = a
+                attrs.update(kwargs)
+                syms = []
+        return _apply_op(opname, syms, attrs, name=name)
+
+    wrapper.__name__ = opname
+    wrapper.__qualname__ = f"sym.{opname}"
+    wrapper.__doc__ = opdef.fn.__doc__ or f"{opname} symbol operator."
+    return wrapper
+
+
+_this = sys.modules[__name__]
+random = types.ModuleType(__name__ + ".random")
+contrib = types.ModuleType(__name__ + ".contrib")
+linalg = types.ModuleType(__name__ + ".linalg")
+sys.modules[random.__name__] = random
+sys.modules[contrib.__name__] = contrib
+sys.modules[linalg.__name__] = linalg
+
+for _name in list_ops():
+    _w = _make_symbol_function(_name)
+    if not hasattr(_this, _name):
+        setattr(_this, _name, _w)
+    if _name.startswith("_contrib_"):
+        setattr(contrib, _name[len("_contrib_"):], _w)
+    if _name.startswith("_linalg_"):
+        setattr(linalg, _name[len("_linalg_"):], _w)
+    if _name.startswith("_random_"):
+        setattr(random, _name[len("_random_"):], _w)
+
+
+# ---------------------------------------------------------------------------
+# Symbol sugar methods — MXNet exposes most ops as Symbol methods too
+# (reference: symbol/register.py attaches generated methods).
+# ---------------------------------------------------------------------------
+
+_SYMBOL_METHODS = {
+    "reshape": "reshape", "transpose": "transpose", "flatten": "Flatten",
+    "astype": "cast", "cast": "cast", "sum": "sum", "mean": "mean",
+    "max": "max", "min": "min", "prod": "prod", "clip": "clip",
+    "expand_dims": "expand_dims", "squeeze": "squeeze",
+    "slice_axis": "slice_axis", "split": "split", "repeat": "repeat",
+    "tile": "tile", "softmax": "softmax", "log_softmax": "log_softmax",
+    "exp": "exp", "log": "log", "sqrt": "sqrt", "square": "square",
+    "abs": "abs", "norm": "norm", "argmax": "argmax", "argmin": "argmin",
+    "sigmoid": "sigmoid", "tanh": "tanh", "relu": "relu",
+}
+
+
+def _attach_symbol_methods():
+    from ..ops.registry import has_op
+
+    for meth, opname in _SYMBOL_METHODS.items():
+        if not has_op(opname):
+            continue
+        fn = _make_symbol_function(opname)
+
+        def method(self, *args, _fn=fn, **kwargs):
+            return _fn(self, *args, **kwargs)
+
+        method.__name__ = meth
+        if not hasattr(Symbol, meth):
+            setattr(Symbol, meth, method)
+
+
+_attach_symbol_methods()

@@ -1,0 +1,299 @@
+"""Executor — bound symbolic graph (reference: L3 GraphExecutor).
+
+Reference: ``src/executor/graph_executor.cc :: GraphExecutor::Init`` builds
+fwd+bwd nnvm graphs, plans memory, attaches op executors and runs them
+through the engine with segment bulking (SURVEY.md §3.4). TPU-native:
+binding traces the whole graph into ONE jitted function (memory planning,
+bulking, fusion = XLA); backward is ``jax.vjp`` of that function, so the
+"full fwd+bwd graph" of the reference is literally one executable here.
+"""
+from __future__ import annotations
+
+from typing import Dict, List, Optional
+
+from ..base import MXNetError
+from ..context import Context, cpu, current_context
+from ..ndarray import NDArray, zeros as nd_zeros
+from ..ndarray.ndarray import _wrap_jax
+from .symbol import Symbol, _apply_opdef
+from ..ops.registry import get_op
+
+__all__ = ["Executor"]
+
+
+def eval_graph(sym: Symbol, values: Dict[str, object], training: bool,
+               rng=None):
+    """Topologically evaluate the graph on jax arrays. Returns the list of
+    output arrays plus {aux_name: updated_value} for mutated aux states."""
+    results: Dict[tuple, object] = {}
+    aux_updates: Dict[str, object] = {}
+    for node in sym._topo():
+        if node.op is None:
+            if node.name not in values:
+                raise MXNetError(f"executor: missing input {node.name!r}")
+            results[(id(node), 0)] = values[node.name]
+            continue
+        opdef = get_op(node.op)
+        ins = [results[(id(p), i)] for p, i in node.inputs]
+        out = _apply_opdef(opdef, ins, node.attrs, rng=rng, training=training)
+        if isinstance(out, (list, tuple)):
+            # training-mode BatchNorm returns (out, batch_mean, batch_var):
+            # fold the stat updates back into the aux vars functionally
+            if node.op in ("BatchNorm", "SyncBatchNorm") and training:
+                momentum = node.attrs.get("momentum", 0.9)
+                y, bmean, bvar = out
+                for pname, (parent, pi) in zip(opdef.tensor_params,
+                                               node.inputs):
+                    if parent.op is not None:
+                        continue
+                    if pname == "moving_mean":
+                        prev = results[(id(parent), 0)]
+                        aux_updates[parent.name] = \
+                            momentum * prev + (1 - momentum) * bmean
+                    elif pname == "moving_var":
+                        prev = results[(id(parent), 0)]
+                        aux_updates[parent.name] = \
+                            momentum * prev + (1 - momentum) * bvar
+                results[(id(node), 0)] = y
+                for i in range(1, node.num_outputs):
+                    results[(id(node), i)] = out[i] if i < len(out) else None
+            else:
+                for i, o in enumerate(out):
+                    results[(id(node), i)] = o
+                if node.num_outputs == 1:
+                    results[(id(node), 0)] = out[0]
+        else:
+            results[(id(node), 0)] = out
+    outs = [results[(id(n), i)] for n, i in sym._entries]
+    return outs, aux_updates
+
+
+class Executor:
+    """reference: python/mxnet/executor.py::Executor."""
+
+    def __init__(self, symbol: Symbol, ctx, args, args_grad=None,
+                 grad_req="write", aux_states=None):
+        self._symbol = symbol
+        self._ctx = ctx or current_context()
+        arg_names = symbol.list_arguments()
+        aux_names = symbol.list_auxiliary_states()
+        if isinstance(args, (list, tuple)):
+            args = dict(zip(arg_names, args))
+        self.arg_dict: Dict[str, NDArray] = dict(args)
+        missing = [n for n in arg_names if n not in self.arg_dict]
+        if missing:
+            raise MXNetError(f"bind: missing arguments {missing}")
+        if isinstance(aux_states, (list, tuple)):
+            aux_states = dict(zip(aux_names, aux_states))
+        self.aux_dict: Dict[str, NDArray] = dict(aux_states or {})
+        for n in aux_names:
+            if n not in self.aux_dict:
+                raise MXNetError(f"bind: missing auxiliary state {n}")
+        if isinstance(grad_req, str):
+            grad_req = {n: grad_req for n in arg_names}
+        elif isinstance(grad_req, (list, tuple)):
+            grad_req = dict(zip(arg_names, grad_req))
+        self._grad_req = grad_req
+        if args_grad is None:
+            args_grad = {
+                n: nd_zeros(self.arg_dict[n].shape, ctx=self._ctx,
+                            dtype=str(self.arg_dict[n].dtype))
+                for n in arg_names if grad_req.get(n, "null") != "null"}
+        elif isinstance(args_grad, (list, tuple)):
+            args_grad = dict(zip(arg_names, args_grad))
+        self.grad_dict: Dict[str, NDArray] = dict(args_grad)
+        self.outputs: List[NDArray] = []
+        self._fwd_cache = {}
+        self._vjp = None
+        self._is_train = False
+
+    # -- compiled forward ----------------------------------------------
+    def _compiled(self, training: bool):
+        import jax
+
+        key = training
+        fn = self._fwd_cache.get(key)
+        if fn is None:
+            sym = self._symbol
+            arg_names = sym.list_arguments()
+            aux_names = sym.list_auxiliary_states()
+
+            def pure(arg_vals, aux_vals, rng):
+                values = dict(zip(arg_names, arg_vals))
+                values.update(dict(zip(aux_names, aux_vals)))
+                outs, aux_updates = eval_graph(sym, values, training, rng)
+                new_aux = tuple(
+                    aux_updates.get(n, values[n]) for n in aux_names)
+                return tuple(outs), new_aux
+
+            fn = jax.jit(pure)
+            self._fwd_cache[key] = fn
+        return fn
+
+    def forward(self, is_train=False, **kwargs):
+        import jax
+
+        from .. import random_state
+
+        for k, v in kwargs.items():
+            if k not in self.arg_dict:
+                raise MXNetError(f"forward: unknown argument {k}")
+            self.arg_dict[k]._set_data(
+                v.data if isinstance(v, NDArray) else v)
+        self._is_train = bool(is_train)
+        arg_names = self._symbol.list_arguments()
+        aux_names = self._symbol.list_auxiliary_states()
+        arg_vals = tuple(self.arg_dict[n].data for n in arg_names)
+        aux_vals = tuple(self.aux_dict[n].data for n in aux_names)
+        rng = random_state.get_state_key()
+        if self._is_train:
+            # value-and-vjp so backward() can run later without retracing
+            def fwd_for_grad(diff_vals):
+                vals = list(arg_vals)
+                for slot, v in zip(self._diff_slots(), diff_vals):
+                    vals[slot] = v
+                outs, new_aux = self._compiled(True)(tuple(vals), aux_vals,
+                                                     rng)
+                return outs, new_aux
+
+            import jax
+
+            diff_vals = tuple(arg_vals[i] for i in self._diff_slots())
+            outs, vjp, new_aux = jax.vjp(fwd_for_grad, diff_vals,
+                                         has_aux=True)
+            self._vjp = vjp
+        else:
+            outs, new_aux = self._compiled(False)(arg_vals, aux_vals, rng)
+            self._vjp = None
+        for n, v in zip(aux_names, new_aux):
+            self.aux_dict[n]._set_data(v)
+        self.outputs = [_wrap_jax(o, self._ctx) for o in outs]
+        return self.outputs
+
+    def _diff_slots(self):
+        arg_names = self._symbol.list_arguments()
+        return [i for i, n in enumerate(arg_names)
+                if self._grad_req.get(n, "null") != "null"]
+
+    def backward(self, out_grads=None):
+        if self._vjp is None:
+            raise MXNetError(
+                "backward() requires a prior forward(is_train=True)")
+        import jax.numpy as jnp
+
+        if out_grads is None:
+            grads = tuple(jnp.ones_like(o.data) for o in self.outputs)
+        else:
+            if isinstance(out_grads, NDArray):
+                out_grads = [out_grads]
+            grads = tuple(
+                g.data if isinstance(g, NDArray) else jnp.asarray(g)
+                for g in out_grads)
+        (dvals,) = self._vjp(grads)
+        arg_names = self._symbol.list_arguments()
+        for slot, g in zip(self._diff_slots(), dvals):
+            name = arg_names[slot]
+            garr = self.grad_dict.get(name)
+            if garr is None:
+                continue
+            if self._grad_req.get(name) == "add":
+                garr._set_data(garr.data + g)
+            else:
+                garr._set_data(g.astype(garr.data.dtype))
+
+    # -- simple_bind ----------------------------------------------------
+    @classmethod
+    def _simple_bind(cls, symbol: Symbol, ctx, grad_req, shape_kwargs):
+        from .. import initializer
+
+        arg_shapes, out_shapes, aux_shapes = symbol.infer_shape(
+            **shape_kwargs)
+        arg_names = symbol.list_arguments()
+        aux_names = symbol.list_auxiliary_states()
+        args = {}
+        for n, s in zip(arg_names, arg_shapes):
+            args[n] = nd_zeros(s, ctx=ctx)
+        aux = {n: nd_zeros(s, ctx=ctx) for n, s in zip(aux_names, aux_shapes)}
+        return cls(symbol, ctx, args, None, grad_req, aux)
+
+    def copy_params_from(self, arg_params, aux_params=None,
+                         allow_extra_params=False):
+        for n, v in (arg_params or {}).items():
+            if n in self.arg_dict:
+                self.arg_dict[n]._set_data(
+                    v.data if isinstance(v, NDArray) else v)
+            elif not allow_extra_params:
+                raise MXNetError(f"unknown parameter {n}")
+        for n, v in (aux_params or {}).items():
+            if n in self.aux_dict:
+                self.aux_dict[n]._set_data(
+                    v.data if isinstance(v, NDArray) else v)
+            elif not allow_extra_params:
+                raise MXNetError(f"unknown aux state {n}")
+
+    @property
+    def arg_arrays(self):
+        return [self.arg_dict[n] for n in self._symbol.list_arguments()]
+
+    @property
+    def grad_arrays(self):
+        return [self.grad_dict.get(n)
+                for n in self._symbol.list_arguments()]
+
+    @property
+    def aux_arrays(self):
+        return [self.aux_dict[n]
+                for n in self._symbol.list_auxiliary_states()]
+
+
+def eval_symbol(sym: Symbol, feed: Dict[str, NDArray]):
+    """Evaluate a symbol graph on NDArrays through the nd wrappers — the
+    SymbolBlock forward. Runs on the autograd tape (eager training works)
+    and under hybridize tracing (values may be tracer-backed). Training-mode
+    BatchNorm folds its batch stats into the aux NDArrays like the gluon
+    block does."""
+    from .. import autograd
+    from .. import ndarray as nd_mod
+
+    training = autograd.is_training()
+    results: Dict[tuple, NDArray] = {}
+    for node in sym._topo():
+        if node.op is None:
+            if node.name not in feed:
+                raise MXNetError(f"eval_symbol: missing input {node.name!r}")
+            results[(id(node), 0)] = feed[node.name]
+            continue
+        opdef = get_op(node.op)
+        ins = [results[(id(p), i)] for p, i in node.inputs]
+        fn = getattr(nd_mod, node.op)
+        attrs = {k: v for k, v in node.attrs.items()
+                 if not k.startswith("__")}
+        out = fn(*ins, **attrs)
+        if isinstance(out, (list, tuple)) and \
+                node.op in ("BatchNorm", "SyncBatchNorm") and training:
+            momentum = node.attrs.get("momentum", 0.9)
+            y, bmean, bvar = out
+            with autograd.pause():
+                for pname, (parent, _pi) in zip(opdef.tensor_params,
+                                                node.inputs):
+                    if parent.op is not None or parent.name not in feed:
+                        continue
+                    arr = feed[parent.name]
+                    if pname == "moving_mean":
+                        arr._set_data(
+                            (momentum * arr.data
+                             + (1 - momentum) * bmean.data.astype(
+                                 arr.data.dtype)))
+                    elif pname == "moving_var":
+                        arr._set_data(
+                            (momentum * arr.data
+                             + (1 - momentum) * bvar.data.astype(
+                                 arr.data.dtype)))
+            results[(id(node), 0)] = y
+        elif isinstance(out, (list, tuple)):
+            for i, o in enumerate(out):
+                results[(id(node), i)] = o
+        else:
+            results[(id(node), 0)] = out
+    outs = [results[(id(n), i)] for n, i in sym._entries]
+    return outs[0] if len(outs) == 1 else outs

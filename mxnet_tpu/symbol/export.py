@@ -1,0 +1,79 @@
+"""HybridBlock.export — gluon → symbol.json + .params.
+
+Reference: ``python/mxnet/gluon/block.py :: HybridBlock.export`` produces
+``prefix-symbol.json`` + ``prefix-%04d.params``, the deployment artifact
+re-imported by ``SymbolBlock.imports`` (and by other language bindings).
+The trace here runs hybrid_forward with Symbol proxies — the same move the
+reference makes with its symbol frontend.
+"""
+from __future__ import annotations
+
+from ..base import MXNetError
+from ..ndarray.serialization import save as nd_save
+from .symbol import AUX_PARAMS, Symbol, var
+from ..ops.registry import get_op
+
+__all__ = ["export_hybrid_block", "mark_aux_states"]
+
+
+def mark_aux_states(sym: Symbol) -> None:
+    """Mark variables feeding aux slots of stateful ops (BatchNorm moving
+    stats) with __aux__, mirroring nnvm's FMutateInputs classification."""
+    for node in sym._topo():
+        if node.op in AUX_PARAMS:
+            opdef = get_op(node.op)
+            aux_names = AUX_PARAMS[node.op]
+            for pname, (parent, _) in zip(opdef.tensor_params, node.inputs):
+                if pname in aux_names and parent.op is None:
+                    parent.attrs["__aux__"] = True
+
+
+def export_hybrid_block(block, path: str, epoch: int = 0):
+    """Trace ``block`` symbolically and write the deployment artifact."""
+    params = block.collect_params()
+    uninitialized = [p.name for p in params.values() if p._data is None]
+    if uninitialized:
+        raise MXNetError(
+            f"export: run a forward pass first; uninitialized params: "
+            f"{uninitialized[:3]}...")
+    data = var("data")
+    try:
+        out = block._symbolic_forward(data)
+    except Exception as e:
+        raise MXNetError(
+            f"export: block is not symbolically traceable ({e}); blocks "
+            "whose forward depends on concrete shapes/values cannot be "
+            "exported — same restriction as the reference's hybridize "
+            "tracing") from e
+    if isinstance(out, (list, tuple)):
+        from .symbol import Group
+
+        flat = []
+
+        def walk(o):
+            if isinstance(o, Symbol):
+                flat.append(o)
+            elif isinstance(o, (list, tuple)):
+                for x in o:
+                    walk(x)
+
+        walk(out)
+        out = Group(flat)
+    mark_aux_states(out)
+    sym_file = f"{path}-symbol.json"
+    out.save(sym_file)
+    arg_names = set(out.list_arguments())
+    aux_names = set(out.list_auxiliary_states())
+    payload = {}
+    for p in params.values():
+        if p._data is None:
+            continue
+        if p.name in aux_names:
+            payload[f"aux:{p.name}"] = p.data()
+        elif p.name in arg_names:
+            payload[f"arg:{p.name}"] = p.data()
+        # params not reached by the trace (e.g. unused heads) are dropped,
+        # matching the reference's export behaviour
+    params_file = f"{path}-{epoch:04d}.params"
+    nd_save(params_file, payload)
+    return sym_file, params_file

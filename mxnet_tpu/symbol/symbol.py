@@ -1,0 +1,528 @@
+"""Symbol — the declarative graph API (L3/L7 of SURVEY.md §1).
+
+Reference: ``python/mxnet/symbol/symbol.py :: Symbol`` over nnvm's graph IR
+(``3rdparty/tvm/nnvm :: Node/NodeEntry/Graph``, serialized by
+``SaveJSON/LoadJSON`` — the symbol.json format). TPU-native re-design: the
+graph is a lightweight python DAG over the SAME op registry the imperative
+API uses; binding compiles the whole graph into ONE XLA executable (the
+reference's GraphExecutor memory planning / op bulking are what XLA does
+natively). symbol.json stays byte-compatible so reference model artifacts
+(`HybridBlock.export`, `model.save_checkpoint`) load unchanged.
+"""
+from __future__ import annotations
+
+import json
+from typing import Dict, List, Optional, Sequence, Tuple
+
+from ..base import MXNetError, name_manager
+from ..ops.registry import get_op, has_op, list_ops
+
+__all__ = ["Symbol", "var", "Variable", "Group", "load", "load_json",
+           "AUX_PARAMS"]
+
+# ops whose trailing tensor params are auxiliary states (mutated by the op,
+# not gradient targets) — reference: per-op FMutateInputs attr in nnvm
+AUX_PARAMS: Dict[str, Tuple[str, ...]] = {
+    "BatchNorm": ("moving_mean", "moving_var"),
+    "SyncBatchNorm": ("moving_mean", "moving_var"),
+}
+
+
+class _Node:
+    """One graph node: a variable (op=None) or an op application."""
+
+    __slots__ = ("op", "name", "attrs", "inputs", "num_outputs", "_attr_dict")
+
+    def __init__(self, op: Optional[str], name: str, attrs: dict,
+                 inputs: List[Tuple["_Node", int]], num_outputs: int = 1):
+        self.op = op
+        self.name = name
+        self.attrs = attrs
+        self.inputs = inputs
+        self.num_outputs = num_outputs
+        self._attr_dict = {}
+
+
+class Symbol:
+    """A list of output entries of the graph (reference: Symbol is a
+    NodeEntry array; single-output in the common case)."""
+
+    def __init__(self, entries: Sequence[Tuple[_Node, int]]):
+        self._entries: List[Tuple[_Node, int]] = list(entries)
+
+    # -- construction helpers ------------------------------------------
+    @property
+    def name(self):
+        if len(self._entries) == 1:
+            return self._entries[0][0].name
+        return ", ".join(n.name for n, _ in self._entries)
+
+    def __len__(self):
+        return len(self._entries)
+
+    def __iter__(self):
+        for i in range(len(self._entries)):
+            yield Symbol([self._entries[i]])
+
+    def __getitem__(self, idx):
+        if isinstance(idx, str):
+            names = self.list_outputs()
+            if idx not in names:
+                raise MXNetError(f"no output named {idx!r}; have {names}")
+            idx = names.index(idx)
+        return Symbol([self._entries[idx]])
+
+    def attr(self, key):
+        return self._entries[0][0]._attr_dict.get(key)
+
+    def _set_attr(self, **kwargs):
+        self._entries[0][0]._attr_dict.update(kwargs)
+
+    def get_internals(self) -> "Symbol":
+        entries = []
+        for node in self._topo():
+            for i in range(node.num_outputs):
+                entries.append((node, i))
+        return Symbol(entries)
+
+    def get_children(self) -> Optional["Symbol"]:
+        node = self._entries[0][0]
+        if not node.inputs:
+            return None
+        return Symbol(list(node.inputs))
+
+    # -- graph walks ----------------------------------------------------
+    def _topo(self) -> List[_Node]:
+        seen = {}
+        order: List[_Node] = []
+
+        def visit(node):
+            if id(node) in seen:
+                return
+            seen[id(node)] = node
+            for parent, _ in node.inputs:
+                visit(parent)
+            order.append(node)
+
+        for node, _ in self._entries:
+            visit(node)
+        return order
+
+    def list_arguments(self) -> List[str]:
+        out = []
+        for node in self._topo():
+            if node.op is None and not node.attrs.get("__aux__"):
+                out.append(node.name)
+        return out
+
+    def list_auxiliary_states(self) -> List[str]:
+        out = []
+        for node in self._topo():
+            if node.op is None and node.attrs.get("__aux__"):
+                out.append(node.name)
+        return out
+
+    def list_outputs(self) -> List[str]:
+        out = []
+        for node, idx in self._entries:
+            if node.num_outputs > 1:
+                out.append(f"{node.name}_output{idx}")
+            else:
+                out.append(f"{node.name}_output")
+        return out
+
+    def list_inputs(self):
+        return self.list_arguments() + self.list_auxiliary_states()
+
+    # -- shape/type inference ------------------------------------------
+    def infer_shape(self, *args, **kwargs):
+        try:
+            return self._infer_shape_impl(False, *args, **kwargs)
+        except MXNetError:
+            raise
+
+    def infer_shape_partial(self, *args, **kwargs):
+        return self._infer_shape_impl(True, *args, **kwargs)
+
+    def _infer_shape_impl(self, partial, *args, **kwargs):
+        import jax
+        import jax.numpy as jnp
+        import numpy as np
+
+        arg_names = self.list_arguments()
+        aux_names = self.list_auxiliary_states()
+        known: Dict[str, tuple] = {}
+        if args:
+            for n, s in zip(arg_names, args):
+                if s is not None:
+                    known[n] = tuple(s)
+        known.update({k: tuple(v) for k, v in kwargs.items() if v is not None})
+
+        # forward-propagate shapes; parameter shapes of param-bearing ops
+        # (weights/biases/norm stats) are back-filled from the data shape —
+        # the bidirectional FInferShape behaviour simple_bind relies on
+        shapes: Dict[Tuple[int, int], tuple] = {}
+        try:
+            for node in self._topo():
+                if node.op is None:
+                    shp = known.get(node.name)
+                    if shp is None:
+                        declared = node.attrs.get("__shape__")
+                        if declared:
+                            shp = tuple(declared)
+                    shapes[(id(node), 0)] = tuple(shp) if shp else None
+                    continue
+                _backfill_param_shapes(node, shapes)
+                in_shapes = [shapes.get((id(p), i)) for p, i in node.inputs]
+                if any(s is None for s in in_shapes):
+                    if not partial:
+                        missing = [p.name for (p, i), s in
+                                   zip(node.inputs, in_shapes) if s is None]
+                        raise MXNetError(
+                            f"cannot infer shape at op {node.name!r} "
+                            f"({node.op}): inputs {missing} unknown")
+                    for i in range(node.num_outputs):
+                        shapes[(id(node), i)] = None
+                    continue
+                out_shapes = _abstract_op(node, in_shapes)
+                for i, s in enumerate(out_shapes):
+                    shapes[(id(node), i)] = s
+        except NotImplementedError as e:
+            raise MXNetError(str(e))
+
+        arg_shapes = []
+        for node in self._topo():
+            if node.op is None and not node.attrs.get("__aux__"):
+                arg_shapes.append(shapes.get((id(node), 0)))
+        aux_shapes = []
+        for node in self._topo():
+            if node.op is None and node.attrs.get("__aux__"):
+                aux_shapes.append(shapes.get((id(node), 0)))
+        out_shapes = [shapes.get((id(n), i)) for n, i in self._entries]
+        return arg_shapes, out_shapes, aux_shapes
+
+    def infer_type(self, *args, **kwargs):
+        arg_names = self.list_arguments()
+        dt = kwargs.get("data", "float32") if kwargs else \
+            (args[0] if args else "float32")
+        import numpy as np
+
+        t = np.dtype(dt) if not isinstance(dt, type) else np.dtype("float32")
+        return ([t] * len(arg_names), [t] * len(self._entries),
+                [t] * len(self.list_auxiliary_states()))
+
+    # -- serialization (symbol.json compat) ----------------------------
+    def tojson(self) -> str:
+        nodes = self._topo()
+        node_idx = {id(n): i for i, n in enumerate(nodes)}
+        jnodes = []
+        arg_nodes = []
+        for i, n in enumerate(nodes):
+            if n.op is None:
+                arg_nodes.append(i)
+            attrs = {k: _attr_str(v) for k, v in n.attrs.items()
+                     if not k.startswith("__")}
+            jn = {
+                "op": n.op if n.op is not None else "null",
+                "name": n.name,
+                "inputs": [[node_idx[id(p)], oi, 0] for p, oi in n.inputs],
+            }
+            if attrs:
+                jn["attrs"] = attrs
+            jnodes.append(jn)
+        heads = [[node_idx[id(n)], oi, 0] for n, oi in self._entries]
+        return json.dumps({
+            "nodes": jnodes,
+            "arg_nodes": arg_nodes,
+            "node_row_ptr": list(range(len(jnodes) + 1)),
+            "heads": heads,
+            "attrs": {"mxnet_version": ["int", 10700]},
+        }, indent=2)
+
+    def save(self, fname: str) -> None:
+        with open(fname, "w") as f:
+            f.write(self.tojson())
+
+    # -- composition sugar ---------------------------------------------
+    def __add__(self, other):
+        return _binary(self, other, "broadcast_add", "_plus_scalar")
+
+    def __radd__(self, other):
+        return self.__add__(other)
+
+    def __sub__(self, other):
+        return _binary(self, other, "broadcast_sub", "_minus_scalar")
+
+    def __rsub__(self, other):
+        return _binary(self, other, "broadcast_sub", "_rminus_scalar",
+                       reverse=True)
+
+    def __mul__(self, other):
+        return _binary(self, other, "broadcast_mul", "_mul_scalar")
+
+    def __rmul__(self, other):
+        return self.__mul__(other)
+
+    def __truediv__(self, other):
+        return _binary(self, other, "broadcast_div", "_div_scalar")
+
+    def __rtruediv__(self, other):
+        return _binary(self, other, "broadcast_div", "_rdiv_scalar",
+                       reverse=True)
+
+    def __pow__(self, other):
+        return _binary(self, other, "broadcast_power", "_power_scalar")
+
+    def __neg__(self):
+        return self.__mul__(-1.0)
+
+    def __repr__(self):
+        return f"<Symbol {self.name}>"
+
+    # -- binding --------------------------------------------------------
+    def simple_bind(self, ctx=None, grad_req="write", type_dict=None,
+                    stype_dict=None, group2ctx=None, shared_arg_names=None,
+                    shared_exec=None, shared_buffer=None, **kwargs):
+        from .executor import Executor
+
+        return Executor._simple_bind(self, ctx, grad_req, kwargs)
+
+    def bind(self, ctx, args, args_grad=None, grad_req="write",
+             aux_states=None, group2ctx=None, shared_exec=None):
+        from .executor import Executor
+
+        return Executor(self, ctx, args, args_grad, grad_req, aux_states)
+
+    def eval(self, ctx=None, **kwargs):
+        ex = self.bind(ctx, kwargs)
+        return ex.forward()
+
+    # gradient graph is implicit (jax.vjp in the Executor); provided for
+    # API parity
+    def __call__(self, *args, **kwargs):
+        raise MXNetError("Symbol composition via __call__ (legacy grouping) "
+                         "is not supported; apply ops from mx.sym directly")
+
+
+def _attr_str(v):
+    if isinstance(v, bool):
+        return "True" if v else "False"
+    if isinstance(v, (list, tuple)):
+        return "(" + ", ".join(str(x) for x in v) + ")"
+    return str(v)
+
+
+def _backfill_param_shapes(node: _Node, shapes) -> None:
+    """Infer unknown VARIABLE input shapes of param-bearing ops from the
+    (known) data shape + attrs (reference: per-op FInferShape backward
+    direction). Covers the layers simple_bind users declare params for."""
+    data_shape = None
+    if node.inputs:
+        p0, i0 = node.inputs[0]
+        data_shape = shapes.get((id(p0), i0))
+    if data_shape is None:
+        return
+    a = node.attrs
+    opdef = get_op(node.op)
+
+    def put(pname, shp):
+        for (parent, pi), tp in zip(node.inputs, opdef.tensor_params):
+            if tp == pname and parent.op is None and                     shapes.get((id(parent), 0)) is None:
+                shapes[(id(parent), 0)] = tuple(int(x) for x in shp)
+
+    op = node.op
+    if op == "FullyConnected":
+        flatten = a.get("flatten", True)
+        in_units = 1
+        if flatten:
+            for d in data_shape[1:]:
+                in_units *= d
+        else:
+            in_units = data_shape[-1]
+        nh = a.get("num_hidden", 0)
+        put("weight", (nh, in_units))
+        put("bias", (nh,))
+    elif op == "Convolution":
+        kernel = tuple(a.get("kernel", ()))
+        nf = a.get("num_filter", 1)
+        ng = a.get("num_group", 1)
+        put("weight", (nf, data_shape[1] // ng) + kernel)
+        put("bias", (nf,))
+    elif op == "Deconvolution":
+        kernel = tuple(a.get("kernel", ()))
+        nf = a.get("num_filter", 1)
+        ng = a.get("num_group", 1)
+        put("weight", (data_shape[1], nf // ng) + kernel)
+        put("bias", (nf,))
+    elif op in ("BatchNorm", "SyncBatchNorm", "InstanceNorm"):
+        c = data_shape[a.get("axis", 1)]
+        for pname in ("gamma", "beta", "moving_mean", "moving_var"):
+            put(pname, (c,))
+    elif op == "LayerNorm":
+        c = data_shape[a.get("axis", -1)]
+        put("gamma", (c,))
+        put("beta", (c,))
+    elif op == "GroupNorm":
+        c = data_shape[1]
+        put("gamma", (c,))
+        put("beta", (c,))
+    elif op == "Embedding":
+        put("weight", (a.get("input_dim", 0), a.get("output_dim", 0)))
+    elif op == "_contrib_rms_norm":
+        put("weight", (data_shape[-1],))
+
+
+def _abstract_op(node: _Node, in_shapes: List[tuple]):
+    """Shape inference by abstract evaluation of the registered jax fn."""
+    import jax
+    import jax.numpy as jnp
+
+    opdef = get_op(node.op)
+    specs = [jax.ShapeDtypeStruct(s, jnp.float32) for s in in_shapes]
+
+    def fn(*xs):
+        return _apply_opdef(opdef, list(xs), node.attrs, rng=None,
+                            training=False)
+
+    out = jax.eval_shape(fn, *specs)
+    if isinstance(out, (list, tuple)):
+        return [tuple(o.shape) for o in out]
+    return [tuple(out.shape)]
+
+
+def _apply_opdef(opdef, tensors, attrs, rng, training):
+    kw = {k: v for k, v in attrs.items() if not k.startswith("__")
+          and k in opdef.attr_params}
+    if opdef.pass_training_flag:
+        kw["_training"] = training
+    if opdef.needs_rng:
+        import jax
+
+        key = rng if rng is not None else jax.random.PRNGKey(0)
+        return opdef.fn(key, *tensors, **kw)
+    return opdef.fn(*tensors, **kw)
+
+
+def _binary(lhs, other, op, scalar_op, reverse=False):
+    if isinstance(other, Symbol):
+        return _apply_op(op, [lhs, other], {})
+    attrs = {"scalar": float(other)}
+    return _apply_op(scalar_op, [lhs], attrs)
+
+
+_name_counters: Dict[str, int] = {}
+
+
+def _auto_name(hint: str) -> str:
+    i = _name_counters.get(hint, 0)
+    _name_counters[hint] = i + 1
+    return f"{hint}{i}"
+
+
+def _apply_op(opname: str, inputs: List[Symbol], attrs: dict,
+              name: Optional[str] = None) -> Symbol:
+    opdef = get_op(opname)
+    entries = []
+    for s in inputs:
+        if len(s._entries) != 1:
+            raise MXNetError(
+                f"op {opname}: multi-output symbol used directly as input; "
+                "select an output first (sym[i])")
+        entries.append(s._entries[0])
+    node_name = name or _auto_name(opname.lower().lstrip("_"))
+    nout = opdef.num_outputs or 1
+    node = _Node(opname, node_name, dict(attrs), entries, nout)
+    return Symbol([(node, 0)]) if nout == 1 else \
+        Symbol([(node, i) for i in range(nout)])
+
+
+def var(name, attr=None, shape=None, lr_mult=None, wd_mult=None, dtype=None,
+        init=None, stype=None, **kwargs) -> Symbol:
+    """Create a variable symbol (reference: symbol.var / sym.Variable)."""
+    attrs = {}
+    if shape is not None:
+        attrs["__shape__"] = tuple(shape)
+    if dtype is not None:
+        attrs["__dtype__"] = str(dtype)
+    if init is not None:
+        attrs["__init__"] = str(init)
+    node = _Node(None, name, attrs, [])
+    s = Symbol([(node, 0)])
+    if attr:
+        s._set_attr(**attr)
+    return s
+
+
+Variable = var
+
+
+def Group(symbols: Sequence[Symbol]) -> Symbol:
+    entries = []
+    for s in symbols:
+        entries.extend(s._entries)
+    return Symbol(entries)
+
+
+def load_json(json_str: str) -> Symbol:
+    """Parse symbol.json (byte-compatible with nnvm SaveJSON output)."""
+    data = json.loads(json_str)
+    jnodes = data["nodes"]
+    nodes: List[_Node] = []
+    for jn in jnodes:
+        op = jn["op"]
+        attrs_raw = jn.get("attrs", jn.get("param", {})) or {}
+        if op == "null":
+            node = _Node(None, jn["name"], {}, [])
+        else:
+            opdef = get_op(op)  # raises NotImplementedError for unknown ops
+            attrs = _coerce_attrs(opdef, attrs_raw)
+            inputs = [(nodes[i], oi) for i, oi, *_ in jn["inputs"]]
+            node = _Node(op, jn["name"], attrs, inputs,
+                         opdef.num_outputs or 1)
+        nodes.append(node)
+    heads = data.get("heads") or [[len(nodes) - 1, 0, 0]]
+    # aux detection: inputs of ops feeding aux tensor params become aux vars
+    for node in nodes:
+        if node.op in AUX_PARAMS:
+            opdef = get_op(node.op)
+            aux_names = AUX_PARAMS[node.op]
+            for pname, (parent, _) in zip(opdef.tensor_params, node.inputs):
+                if pname in aux_names and parent.op is None:
+                    parent.attrs["__aux__"] = True
+    return Symbol([(nodes[i], oi) for i, oi, *_ in heads])
+
+
+def load(fname: str) -> Symbol:
+    with open(fname) as f:
+        return load_json(f.read())
+
+
+def _coerce_attrs(opdef, attrs_raw: dict) -> dict:
+    """symbol.json stores attrs as strings; coerce back to python values by
+    inspecting the op fn's defaults (the dmlc::Parameter round-trip)."""
+    import ast
+    import inspect
+
+    sig = inspect.signature(opdef.fn)
+    out = {}
+    for k, v in attrs_raw.items():
+        if k not in opdef.attr_params:
+            continue
+        if not isinstance(v, str):
+            out[k] = v
+            continue
+        try:
+            out[k] = ast.literal_eval(v)
+            continue
+        except (ValueError, SyntaxError):
+            pass
+        low = v.strip()
+        if low in ("True", "true", "1"):
+            out[k] = True
+        elif low in ("False", "false", "0"):
+            out[k] = False
+        elif low in ("None", "null"):
+            out[k] = None
+        else:
+            out[k] = v  # string-typed attr (e.g. act_type='relu')
+    return out
