@@ -31,6 +31,10 @@ def pytest_configure(config):
             pass
     env = dict(os.environ)
     env["JAX_PLATFORMS"] = "cpu"
+    # Stash the original PYTHONPATH so tests that spawn driver-like
+    # subprocesses (tests/test_graft_entry.py) can restore the container's
+    # sitecustomize environment.
+    env["MXNET_TPU_ORIG_PYTHONPATH"] = env.get("PYTHONPATH", "")
     env["PYTHONPATH"] = ""
     flags = env.get("XLA_FLAGS", "")
     if "xla_force_host_platform_device_count" not in flags:

@@ -1,0 +1,53 @@
+"""Driver entry-point regression tests.
+
+Round-1 verdict: the driver imports ``__graft_entry__`` and calls
+``dryrun_multichip(8)`` directly — without setting JAX_PLATFORMS /
+XLA_FLAGS — so the env bootstrap must live inside the function. These
+tests invoke it exactly that way, in a subprocess with a scrubbed env.
+"""
+import os
+import subprocess
+import sys
+
+import pytest
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+def _scrubbed_env():
+    env = dict(os.environ)
+    env.pop("XLA_FLAGS", None)
+    env.pop("JAX_PLATFORMS", None)
+    # Restore the container's original PYTHONPATH (stashed by the root
+    # conftest before its CPU re-exec) so the subprocess sees the same
+    # sitecustomize/plugin registration the real driver does.
+    orig = env.pop("MXNET_TPU_ORIG_PYTHONPATH", None)
+    if orig is not None:
+        env["PYTHONPATH"] = orig
+    return env
+
+
+@pytest.mark.slow
+def test_dryrun_multichip_driver_pattern():
+    """The exact driver invocation: import module, call dryrun_multichip(8)."""
+    proc = subprocess.run(
+        [sys.executable, "-c",
+         "import __graft_entry__ as g\n"
+         "g.dryrun_multichip(8)\n"],
+        cwd=REPO, env=_scrubbed_env(), capture_output=True, text=True,
+        timeout=600)
+    assert proc.returncode == 0, proc.stderr[-2000:]
+    assert "OK" in proc.stdout
+
+
+def test_acquire_devices_in_initialized_session():
+    """In-process path: jax is already initialized (conftest CPU mesh)."""
+    import jax
+
+    sys.path.insert(0, REPO)
+    try:
+        import __graft_entry__ as g
+    finally:
+        sys.path.pop(0)
+    devices = g._acquire_devices(len(jax.devices()))
+    assert len(devices) == len(jax.devices())
