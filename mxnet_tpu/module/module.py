@@ -193,6 +193,10 @@ class Module(BaseModule):
         self._kvstore = None
         self._data_shapes = None
         self._label_shapes = None
+        # set by Module.load: checkpointed params applied at init_params
+        # time, optimizer states applied at init_optimizer time
+        self._preloaded = None
+        self._preloaded_states = None
 
     @property
     def symbol(self):
@@ -255,18 +259,25 @@ class Module(BaseModule):
             return
         if not self.binded:
             raise MXNetError("call bind before init_params")
+        if arg_params is None and aux_params is None and \
+                self._preloaded is not None:
+            # Module.load semantics (reference: module.py::Module.load):
+            # the checkpointed params take effect at init_params time.
+            arg_params, aux_params = self._preloaded
         initializer = initializer or init_mod.Uniform(0.01)
         for name in self._param_names:
             arr = self._exec.arg_dict[name]
-            if arg_params and name in arg_params:
+            if arg_params is not None and name in arg_params:
                 src = arg_params[name]
                 arr._set_data(src.data if isinstance(src, NDArray)
                               else nd_array(src).data)
-            elif not allow_missing or arg_params is None:
+            elif arg_params is not None and not allow_missing:
+                raise MXNetError(
+                    f"parameter {name} missing from arg_params "
+                    "(pass allow_missing=True to initialize it instead)")
+            else:
                 desc = init_mod.InitDesc(name, global_init=initializer)
                 initializer(desc, arr)
-            elif not allow_missing:
-                raise MXNetError(f"missing parameter {name}")
         for name in self._symbol.list_auxiliary_states():
             arr = self._exec.aux_dict[name]
             if aux_params and name in aux_params:
@@ -306,6 +317,12 @@ class Module(BaseModule):
                 **dict(optimizer_params or {}))
         self._optimizer = optimizer
         self._updater = opt_mod.get_updater(optimizer)
+        if self._preloaded_states is not None:
+            # Module.load(..., load_optimizer_states=True): apply the
+            # checkpointed updater states now that the updater exists.
+            with open(self._preloaded_states, "rb") as f:
+                self._updater.set_states(f.read())
+            self._preloaded_states = None
         self.optimizer_initialized = True
 
     # -- step -----------------------------------------------------------

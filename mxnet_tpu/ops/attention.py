@@ -49,7 +49,7 @@ def sdp_attention(query, key, value, mask=None, *, scale=None, causal=False,
         from ..pallas_kernels import (flash_attention, flash_attention_scan,
                                       flash_supported)
 
-        if flash_supported(query, key, value):
+        if flash_supported(query, key, value, causal=causal):
             return flash_attention(query, key, value, scale=scale,
                                    causal=causal)
         if key.shape[-2] >= 2048:
@@ -69,8 +69,14 @@ def rms_norm(data, weight, *, eps=1e-6):
 
 
 @register("_contrib_rope", aliases=["rope"])
-def rope(data, *, theta=10000.0, position_offset=0):
-    """Rotary position embedding over (B, L, H, D); rotate-half convention.
+def rope(data, *, theta=10000.0, position_offset=0, interleaved=False):
+    """Rotary position embedding over (B, L, H, D).
+
+    Default is the true rotate-half convention (Llama / HF checkpoints):
+    the head dim is split into first/second halves and rotated as
+    ``concat(x1*cos - x2*sin, x2*cos + x1*sin)``, so weights ported from
+    Llama-family checkpoints produce identical activations.
+    ``interleaved=True`` selects the GPT-J/NeoX even-odd pair convention.
     Computed in-graph from positions — no host-side tables."""
     b, l, h, d = data.shape
     pos = jnp.arange(position_offset, position_offset + l,
@@ -79,9 +85,16 @@ def rope(data, *, theta=10000.0, position_offset=0):
     angles = pos[:, None] * inv_freq[None, :]            # (L, D/2)
     cos = jnp.cos(angles)[None, :, None, :]
     sin = jnp.sin(angles)[None, :, None, :]
-    x1 = data[..., 0::2].astype(jnp.float32)
-    x2 = data[..., 1::2].astype(jnp.float32)
-    r1 = x1 * cos - x2 * sin
-    r2 = x2 * cos + x1 * sin
-    out = jnp.stack([r1, r2], axis=-1).reshape((b, l, h, d))
+    if interleaved:
+        x1 = data[..., 0::2].astype(jnp.float32)
+        x2 = data[..., 1::2].astype(jnp.float32)
+        r1 = x1 * cos - x2 * sin
+        r2 = x2 * cos + x1 * sin
+        out = jnp.stack([r1, r2], axis=-1).reshape((b, l, h, d))
+    else:
+        x1 = data[..., : d // 2].astype(jnp.float32)
+        x2 = data[..., d // 2:].astype(jnp.float32)
+        r1 = x1 * cos - x2 * sin
+        r2 = x2 * cos + x1 * sin
+        out = jnp.concatenate([r1, r2], axis=-1)
     return out.astype(data.dtype)

@@ -9,6 +9,7 @@ user-kernel surface (the `mx.rtc.CudaModule` capability re-imagined,
 see mxnet_tpu.pallas_api).
 """
 from .flash_attention import (flash_attention, flash_attention_scan,
-                              flash_supported)
+                              flash_supported, flash_shape_supported)
 
-__all__ = ["flash_attention", "flash_attention_scan", "flash_supported"]
+__all__ = ["flash_attention", "flash_attention_scan", "flash_supported",
+           "flash_shape_supported"]

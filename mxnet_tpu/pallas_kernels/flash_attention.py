@@ -26,7 +26,21 @@ BLOCK_K = 128
 _NEG_INF = -1e30
 
 
-def flash_supported(q, k, v) -> bool:
+def flash_shape_supported(q, k, v, causal=False) -> bool:
+    """Platform-independent kernel shape eligibility.
+
+    Causal with lq > lk is rejected: bottom-right alignment would leave the
+    top query rows with no visible keys (a fully-masked, degenerate row the
+    dense reference only "answers" with a uniform softmax over masked-out
+    scores — not a shape any model in the zoo produces)."""
+    lq, lk = q.shape[-2], k.shape[-2]
+    if causal and lq > lk:
+        return False
+    return (lq % BLOCK_Q == 0 and lk % BLOCK_K == 0
+            and q.shape[-1] <= 256 and q.shape[-1] % 8 == 0)
+
+
+def flash_supported(q, k, v, causal=False) -> bool:
     """Kernel eligibility: TPU platform + block-aligned sequence lengths."""
     try:
         platform = jax.devices()[0].platform
@@ -34,9 +48,7 @@ def flash_supported(q, k, v) -> bool:
         return False
     if platform != "tpu":
         return False
-    lq, lk = q.shape[-2], k.shape[-2]
-    return (lq % BLOCK_Q == 0 and lk % BLOCK_K == 0
-            and q.shape[-1] <= 256 and q.shape[-1] % 8 == 0)
+    return flash_shape_supported(q, k, v, causal=causal)
 
 
 # ---------------------------------------------------------------------------
@@ -76,7 +88,10 @@ def flash_attention_scan(q, k, v, scale=None, causal=False,
             valid = valid & (k_pos <= q_pos)
         s = jnp.where(valid[None, None], s, _NEG_INF)
         m_new = jnp.maximum(m, jnp.max(s, axis=-1, keepdims=True))
-        p = jnp.exp(s - m_new)
+        # zero masked probabilities explicitly: for a FULLY-masked row
+        # m_new == _NEG_INF and exp(s - m_new) would be 1 for every
+        # masked/padded key, silently averaging them in
+        p = jnp.where(valid[None, None], jnp.exp(s - m_new), 0.0)
         alpha = jnp.exp(m - m_new)
         l_new = l * alpha + jnp.sum(p, axis=-1, keepdims=True)
         acc_new = acc * alpha + jnp.einsum("bhqk,bhkd->bhqd", p, vb)
@@ -89,7 +104,8 @@ def flash_attention_scan(q, k, v, scale=None, causal=False,
         body, (acc0, m0, l0),
         (kf.transpose(2, 0, 1, 3, 4), vf.transpose(2, 0, 1, 3, 4),
          jnp.arange(nk)))
-    return (acc / l).astype(dtype)
+    # fully-masked rows (l == 0) emit zeros rather than 0/0 NaN
+    return (acc / jnp.where(l == 0.0, 1.0, l)).astype(dtype)
 
 
 # ---------------------------------------------------------------------------
@@ -145,7 +161,10 @@ def _fwd_kernel(q_ref, k_ref, v_ref, o_ref, acc_ref, m_ref, l_ref, *,
 
     @pl.when(ki == nk - 1)
     def _final():
-        o_ref[0] = (acc_ref[:] / l_ref[:, 0:1]).astype(o_ref.dtype)
+        # fully-masked rows (every K block skipped: l == 0) emit zeros
+        l = l_ref[:, 0:1]
+        o_ref[0] = (acc_ref[:] / jnp.where(l == 0.0, 1.0, l)).astype(
+            o_ref.dtype)
 
 
 def _flash_fwd_pallas(q, k, v, scale, causal, interpret=False):

@@ -222,15 +222,20 @@ def test_take_pick_onehot():
 def test_reductions_match_numpy():
     a = np.random.randn(3, 4, 5).astype(np.float32)
     x = mx.nd.array(a)
-    assert np.allclose(x.sum().asnumpy(), a.sum(), rtol=1e-5)
-    assert np.allclose(mx.nd.sum(x, axis=1).asnumpy(), a.sum(axis=1), rtol=1e-5)
-    assert np.allclose(mx.nd.mean(x, axis=(0, 2)).asnumpy(), a.mean(axis=(0, 2)), rtol=1e-5)
+    # atol for near-zero means/sums: f32 accumulation order differs
+    # between XLA and numpy
+    assert np.allclose(x.sum().asnumpy(), a.sum(), rtol=1e-5, atol=1e-5)
+    assert np.allclose(mx.nd.sum(x, axis=1).asnumpy(), a.sum(axis=1),
+                       rtol=1e-5, atol=1e-5)
+    assert np.allclose(mx.nd.mean(x, axis=(0, 2)).asnumpy(),
+                       a.mean(axis=(0, 2)), rtol=1e-5, atol=1e-5)
     assert np.allclose(mx.nd.max(x, axis=2, keepdims=True).asnumpy(),
                        a.max(axis=2, keepdims=True))
-    assert np.allclose(mx.nd.norm(x).asnumpy(), np.linalg.norm(a.ravel()), rtol=1e-5)
+    assert np.allclose(mx.nd.norm(x).asnumpy(), np.linalg.norm(a.ravel()),
+                       rtol=1e-5, atol=1e-6)
     # exclude semantics
     assert np.allclose(mx.nd.sum(x, axis=1, exclude=True).asnumpy(),
-                       a.sum(axis=(0, 2)), rtol=1e-5)
+                       a.sum(axis=(0, 2)), rtol=1e-5, atol=1e-5)
 
 
 def test_dot():

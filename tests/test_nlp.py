@@ -107,6 +107,43 @@ class TestSemantics:
             np.linalg.norm(x.asnumpy(), axis=-1),
             np.linalg.norm(r.asnumpy(), axis=-1), rtol=1e-5)
 
+    def test_rope_rotate_half_convention(self):
+        """Default rope matches the Llama/HF rotate-half formula:
+        x*cos + rotate_half(x)*sin with half-split frequencies."""
+        import mxnet_tpu.ndarray as nd
+        rs = np.random.RandomState(1)
+        b, l, h, d = 2, 6, 3, 8
+        x = rs.randn(b, l, h, d).astype("float32")
+        out = nd.rope(mx.nd.array(x), theta=10000.0).asnumpy()
+
+        pos = np.arange(l, dtype=np.float64)
+        inv_freq = 1.0 / (10000.0 ** (np.arange(0, d, 2) / d))
+        ang = pos[:, None] * inv_freq[None, :]               # (L, d/2)
+        cos = np.concatenate([np.cos(ang)] * 2, -1)[None, :, None, :]
+        sin = np.concatenate([np.sin(ang)] * 2, -1)[None, :, None, :]
+        rot = np.concatenate([-x[..., d // 2:], x[..., : d // 2]], -1)
+        ref = x * cos + rot * sin
+        np.testing.assert_allclose(out, ref, rtol=1e-5, atol=1e-5)
+
+    def test_rope_interleaved_convention(self):
+        """interleaved=True keeps the GPT-J even/odd pair rotation."""
+        import mxnet_tpu.ndarray as nd
+        rs = np.random.RandomState(2)
+        x = rs.randn(1, 4, 2, 6).astype("float32")
+        out = nd.rope(mx.nd.array(x), theta=100.0,
+                      interleaved=True).asnumpy()
+        d = 6
+        pos = np.arange(4, dtype=np.float64)
+        inv_freq = 1.0 / (100.0 ** (np.arange(0, d, 2) / d))
+        ang = pos[:, None] * inv_freq[None, :]
+        cos = np.cos(ang)[None, :, None, :]
+        sin = np.sin(ang)[None, :, None, :]
+        x1, x2 = x[..., 0::2], x[..., 1::2]
+        ref = np.empty_like(x)
+        ref[..., 0::2] = x1 * cos - x2 * sin
+        ref[..., 1::2] = x2 * cos + x1 * sin
+        np.testing.assert_allclose(out, ref, rtol=1e-5, atol=1e-5)
+
     def test_sdp_attention_matches_manual(self):
         import mxnet_tpu.ndarray as nd
         rs = np.random.RandomState(0)

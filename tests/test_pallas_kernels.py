@@ -63,6 +63,35 @@ class TestPallasKernel:
         np.testing.assert_allclose(np.asarray(out), np.asarray(ref),
                                    rtol=1e-5, atol=1e-5)
 
+    def test_causal_lq_gt_lk_no_nan(self):
+        """Advisor finding: causal with lq > lk leaves top query rows fully
+        masked; they must emit zeros, never 0/0 NaN (kernel and scan)."""
+        q, k, v = _qkv(lq=384, lk=128)
+        out_k = np.asarray(flash_attention(q, k, v, causal=True,
+                                           interpret=True))
+        out_s = np.asarray(flash_attention_scan(q, k, v, causal=True))
+        assert np.isfinite(out_k).all()
+        assert np.isfinite(out_s).all()
+        # bottom-right alignment: the first lq-lk query rows see no keys
+        np.testing.assert_allclose(out_k[:, :, :384 - 128], 0.0)
+        np.testing.assert_allclose(out_s[:, :, :384 - 128], 0.0)
+        # visible rows still match the dense reference
+        ref = np.asarray(_sdpa_reference(q, k, v, None, SCALE, True))
+        np.testing.assert_allclose(out_k[:, :, 384 - 128 + 1:],
+                                   ref[:, :, 384 - 128 + 1:],
+                                   rtol=1e-5, atol=1e-5)
+
+    def test_flash_supported_rejects_causal_lq_gt_lk(self):
+        # flash_shape_supported is the platform-independent predicate, so
+        # this regression is covered on the CPU test mesh too (plain
+        # flash_supported would short-circuit False on platform != tpu)
+        from mxnet_tpu.pallas_kernels import flash_shape_supported
+
+        q, k, v = _qkv(lq=384, lk=128)
+        assert not flash_shape_supported(q, k, v, causal=True)
+        assert flash_shape_supported(q, k, v, causal=False)
+        assert flash_shape_supported(k, q, q, causal=True)  # lq < lk ok
+
     def test_gradients_match(self):
         q, k, v = _qkv(lq=128, lk=128)
 
