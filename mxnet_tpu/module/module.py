@@ -250,6 +250,13 @@ class Module(BaseModule):
         self._exec = self._symbol.simple_bind(ctx=self._context,
                                               grad_req=req, **shape_kwargs)
         self.binded = True
+        if self._preloaded is not None and not self.params_initialized:
+            # Module.load semantics (reference: module.py::Module.load):
+            # after load()+bind() the checkpointed params are live even if
+            # the user never calls init_params explicitly. allow_missing
+            # because a legacy checkpoint may lack aux entries — absent
+            # entries keep their default init, as in the reference.
+            self.init_params(allow_missing=True)
 
     # -- params ---------------------------------------------------------
     def init_params(self, initializer=None, arg_params=None, aux_params=None,
@@ -259,11 +266,15 @@ class Module(BaseModule):
             return
         if not self.binded:
             raise MXNetError("call bind before init_params")
-        if arg_params is None and aux_params is None and \
-                self._preloaded is not None:
+        if self._preloaded is not None:
             # Module.load semantics (reference: module.py::Module.load):
-            # the checkpointed params take effect at init_params time.
-            arg_params, aux_params = self._preloaded
+            # the checkpointed params take effect at init_params time;
+            # either half may be overridden by an explicit argument.
+            pre_arg, pre_aux = self._preloaded
+            if arg_params is None:
+                arg_params = pre_arg
+            if aux_params is None:
+                aux_params = pre_aux
         initializer = initializer or init_mod.Uniform(0.01)
         for name in self._param_names:
             arr = self._exec.arg_dict[name]
@@ -280,10 +291,14 @@ class Module(BaseModule):
                 initializer(desc, arr)
         for name in self._symbol.list_auxiliary_states():
             arr = self._exec.aux_dict[name]
-            if aux_params and name in aux_params:
+            if aux_params is not None and name in aux_params:
                 src = aux_params[name]
                 arr._set_data(src.data if isinstance(src, NDArray)
                               else nd_array(src).data)
+            elif aux_params is not None and not allow_missing:
+                raise MXNetError(
+                    f"auxiliary state {name} missing from aux_params "
+                    "(pass allow_missing=True to initialize it instead)")
             else:
                 # variance-like stats start at 1, means at 0 (reference
                 # behaviour from per-op init attrs)

@@ -88,13 +88,13 @@ def rope(data, *, theta=10000.0, position_offset=0, interleaved=False):
     if interleaved:
         x1 = data[..., 0::2].astype(jnp.float32)
         x2 = data[..., 1::2].astype(jnp.float32)
-        r1 = x1 * cos - x2 * sin
-        r2 = x2 * cos + x1 * sin
-        out = jnp.stack([r1, r2], axis=-1).reshape((b, l, h, d))
     else:
         x1 = data[..., : d // 2].astype(jnp.float32)
         x2 = data[..., d // 2:].astype(jnp.float32)
-        r1 = x1 * cos - x2 * sin
-        r2 = x2 * cos + x1 * sin
+    r1 = x1 * cos - x2 * sin
+    r2 = x2 * cos + x1 * sin
+    if interleaved:
+        out = jnp.stack([r1, r2], axis=-1).reshape((b, l, h, d))
+    else:
         out = jnp.concatenate([r1, r2], axis=-1)
     return out.astype(data.dtype)

@@ -244,6 +244,19 @@ class TrainStep:
         )
         return {"jitted": jitted, "cell": cell, "batch_sh": batch_sh}
 
+    def stage_batch(self, data, label=()):
+        """Place host batches on the mesh with this step's input sharding.
+
+        In-place on the NDArrays; a later ``__call__`` with the same arrays
+        makes the per-step ``device_put`` a no-op. Benchmarks and
+        synthetic-data loops use this to keep data device-resident.
+        """
+        import jax
+
+        for v in _as_tuple(data) + _as_tuple(label):
+            v._set_data(jax.device_put(
+                v.data, named_sharding(self.mesh, self._batch_spec(v))))
+
     # -- call ------------------------------------------------------------
     def __call__(self, data, label):
         import jax
@@ -268,8 +281,12 @@ class TrainStep:
         # Optimizer._update_count inside the reference's Updater)
         for k in range(len(self._trainable)):
             optimizer._update_count(k)
-        t = optimizer.num_update
-        lr = float(optimizer.learning_rate)
+        import numpy as np
+
+        # fixed-width host scalars: under jax_enable_x64 a bare Python
+        # int/float would trace as i64/f64 and drip f64 math into the step
+        t = np.int32(optimizer.num_update)
+        lr = np.float32(optimizer.learning_rate)
         rng = random_state.get_state_key()
 
         param_vals = tuple(p.data().data for p in self._params)

@@ -113,3 +113,60 @@ class TestModuleCheckpoint:
                         force_init=True)
         args, _ = mod.get_params()
         np.testing.assert_allclose(args["fc1_weight"].asnumpy(), 0.0)
+
+    def test_load_bind_forward_no_init_params(self, tmp_path):
+        """Round-2 review finding: load+bind+forward (no explicit
+        init_params call) must run with the checkpointed weights —
+        reference Module.load marks params initialized at load time."""
+        prefix = str(tmp_path / "mlp")
+        mod = Module(_mlp_symbol())
+        train = _toy_iter()
+        mod.fit(train, num_epoch=2, optimizer="sgd")
+        mod.save_checkpoint(prefix, 1)
+
+        mod2 = Module.load(prefix, 1)
+        mod2.bind(data_shapes=train.provide_data,
+                  label_shapes=train.provide_label, for_training=False)
+        assert mod2.params_initialized
+        batch = next(iter(_toy_iter()))
+        mod.forward(batch, is_train=False)
+        mod2.forward(batch, is_train=False)
+        np.testing.assert_allclose(mod2.get_outputs()[0].asnumpy(),
+                                   mod.get_outputs()[0].asnumpy(),
+                                   rtol=1e-5, atol=1e-6)
+
+    def test_load_partial_init_keeps_other_half(self, tmp_path):
+        """Round-2 review finding: init_params with only one of
+        arg_params/aux_params on a loaded module must keep the
+        checkpointed other half, not reinitialize it."""
+        prefix = str(tmp_path / "mlp")
+        mod = Module(_mlp_symbol())
+        train = _toy_iter()
+        mod.fit(train, num_epoch=1, optimizer="sgd")
+        mod.save_checkpoint(prefix, 0)
+        saved_args, _ = mod.get_params()
+
+        mod2 = Module.load(prefix, 0)
+        mod2.bind(data_shapes=train.provide_data,
+                  label_shapes=train.provide_label)
+        mod2.init_params(aux_params={}, allow_missing=True, force_init=True)
+        loaded_args, _ = mod2.get_params()
+        for name, arr in saved_args.items():
+            np.testing.assert_allclose(
+                loaded_args[name].asnumpy(), arr.asnumpy(), rtol=1e-6,
+                err_msg=f"preloaded param {name} discarded by partial init")
+
+    def test_init_params_missing_aux_raises(self):
+        """Round-2 review finding: strictness must cover aux states too."""
+        data = sym.var("data")
+        fc = sym.FullyConnected(data, name="fc1", num_hidden=4)
+        bn = sym.BatchNorm(fc, name="bn")
+        out = sym.SoftmaxOutput(bn, name="softmax")
+        mod = Module(out)
+        mod.bind(data_shapes=[("data", (8, 8))],
+                 label_shapes=[("softmax_label", (8,))])
+        mod.init_params(allow_missing=True)
+        args, _ = mod.get_params()
+        with pytest.raises(MXNetError, match="auxiliary"):
+            mod.init_params(arg_params=args, aux_params={},
+                            allow_missing=False, force_init=True)
