@@ -1,0 +1,94 @@
+"""Benchmark: BERT-base seq-512 training throughput + MFU.
+
+Prints ONE JSON line {"metric", "value", "unit", "vs_baseline", "mfu"}.
+Baseline = 290 samples/s/chip — the 50%-MFU ceiling from BASELINE.md
+(6 * 110M params * 512 tokens ~= 338 GFLOPs/sample on a ~197 bf16-TFLOP/s
+v5e chip). Runs the fused TrainStep (fwd + masked-LM CE + bwd + AdamW-style
+update in one XLA executable) in bfloat16; attention runs the Pallas flash
+kernels in both directions (pallas_kernels/flash_attention.py).
+
+Same synthetic-data methodology as bench.py (see PERF.md): the batch is
+staged on device before the timed loop.
+"""
+from __future__ import annotations
+
+import json
+import sys
+import time
+
+import numpy as np
+
+BASELINE_SAMPLES_S = 290.0   # 50%-MFU ceiling, BASELINE.md row 2
+FLOPS_PER_SAMPLE = 6 * 110e6 * 512   # ~338 GF: 6ND with N=110M, D=512 tok
+
+
+def main():
+    import jax
+    import mxnet_tpu as mx
+    from mxnet_tpu import parallel as par
+    from mxnet_tpu.callback import device_peak_flops
+    from mxnet_tpu.gluon import loss as gloss
+    from mxnet_tpu.gluon.model_zoo.nlp import bert
+
+    platform = jax.devices()[0].platform
+    batch = 16 if platform != "cpu" else 2
+    seq = 512 if platform != "cpu" else 128
+    steps = 20 if platform != "cpu" else 2
+
+    net = bert.bert_12_768_12(use_decoder=True, use_pooler=False,
+                              use_classifier=False)
+    net.initialize()
+    net.cast("bfloat16")
+
+    rs = np.random.RandomState(0)
+    tokens = mx.nd.array(rs.randint(0, 30000, (batch, seq)).astype(np.int32))
+    labels = mx.nd.array(rs.randint(0, 30000, (batch, seq)).astype(np.float32))
+
+    class MLMLoss(gloss.SoftmaxCrossEntropyLoss):
+        def hybrid_forward(self, F, pred, label):
+            # pred: (B, L, vocab) MLM logits; CE over every position
+            return super().hybrid_forward(
+                F, pred.reshape(-1, pred.shape[-1]), label.reshape(-1))
+
+    def pick_output(outs, label):
+        # BERTModel returns (sequence, mlm_logits) with use_decoder
+        mlm = outs[1] if isinstance(outs, (list, tuple)) else outs
+        return mlm
+
+    class LossAdapter:
+        def __init__(self):
+            self._l = MLMLoss()
+
+        def __call__(self, outs, label):
+            return self._l(pick_output(outs, label), label)
+
+    mesh = par.make_mesh({"dp": 1}, devices=jax.devices()[:1])
+    step = par.TrainStep(net, LossAdapter(), "adam", mesh=mesh,
+                         optimizer_params={"learning_rate": 1e-4,
+                                           "multi_precision": True})
+    loss, _ = step(tokens, labels)
+    loss.asnumpy()
+    step.stage_batch(tokens, labels)
+    loss, _ = step(tokens, labels)
+    loss.asnumpy()
+
+    t0 = time.perf_counter()
+    for _ in range(steps):
+        loss, _ = step(tokens, labels)
+    loss.asnumpy()
+    dt = time.perf_counter() - t0
+
+    samples_s = batch * steps / dt
+    peak = device_peak_flops() or float("nan")
+    mfu = samples_s * FLOPS_PER_SAMPLE / peak if peak == peak else None
+    print(json.dumps({
+        "metric": "bert_base_seq512_train_samples_per_sec_per_chip",
+        "value": round(samples_s, 2),
+        "unit": "samples/sec",
+        "vs_baseline": round(samples_s / BASELINE_SAMPLES_S, 4),
+        "mfu": round(mfu, 4) if mfu is not None else None,
+    }))
+
+
+if __name__ == "__main__":
+    sys.exit(main())

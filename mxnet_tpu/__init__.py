@@ -60,6 +60,9 @@ from . import parallel
 from . import symbol
 from . import symbol as sym
 from . import tracing
+from . import profiler
+from . import callback
+from . import monitor
 
 from .ndarray import NDArray
 from .optimizer import Optimizer

@@ -110,3 +110,49 @@ def classproperty(func):
             return func(owner)
 
     return _Descriptor()
+
+
+# ---------------------------------------------------------------------------
+# execution-platform plumbing
+# ---------------------------------------------------------------------------
+import contextlib as _contextlib
+import contextvars as _contextvars
+
+_exec_platform = _contextvars.ContextVar("mxnet_tpu_exec_platform",
+                                         default=None)
+
+
+@_contextlib.contextmanager
+def execution_platform(platform):
+    """Declare the platform ops are being traced/lowered for.
+
+    The framework's jit entry points (per-op eager cache, CachedOp,
+    TrainStep) set this from the devices they will actually run on, so
+    kernel-eligibility checks inside a trace (e.g. the Pallas flash
+    attention dispatch) don't have to guess from the default backend — a
+    CPU-context op must not take the Pallas path just because a TPU exists
+    in the process.
+    """
+    token = _exec_platform.set(platform)
+    try:
+        yield
+    finally:
+        _exec_platform.reset(token)
+
+
+def current_execution_platform(sample=None):
+    """Execution platform for `sample` (concrete array, tracer, or None)."""
+    override = _exec_platform.get()
+    if override is not None:
+        return override
+    import jax
+
+    if sample is not None and not isinstance(sample, jax.core.Tracer):
+        try:
+            return next(iter(sample.devices())).platform
+        except Exception:
+            pass
+    try:
+        return jax.devices()[0].platform
+    except Exception:
+        return "none"

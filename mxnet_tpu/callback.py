@@ -1,0 +1,147 @@
+"""Training callbacks (reference: ``python/mxnet/callback.py``).
+
+``Speedometer`` (throughput every N batches — here with optional MFU
+reporting against the device's bf16 peak, the north-star metric),
+``do_checkpoint``, ``log_train_metric``, ``ProgressBar``. All follow the
+reference's ``BatchEndParam``/``(epoch, symbol, arg, aux)`` callback
+contracts so ``Module.fit`` / user loops drive them unchanged.
+"""
+from __future__ import annotations
+
+import logging
+import time
+
+__all__ = ["Speedometer", "do_checkpoint", "log_train_metric", "ProgressBar",
+           "device_peak_flops"]
+
+# bf16 peak TFLOP/s per chip by TPU generation (public spec sheets);
+# used only for the optional MFU line — throughput is always reported.
+_TPU_PEAK_TFLOPS = {
+    "v4": 275.0, "v5e": 197.0, "v5 lite": 197.0, "v5p": 459.0,
+    "v6e": 918.0,
+}
+
+
+def device_peak_flops(device=None):
+    """Best-effort bf16 peak FLOP/s of ``device`` (default: first device).
+
+    Returns None when unknown (e.g. CPU) — callers should skip MFU then.
+    """
+    import jax
+
+    device = device or jax.devices()[0]
+    kind = getattr(device, "device_kind", "").lower()
+    for key, tf in _TPU_PEAK_TFLOPS.items():
+        if key in kind:
+            return tf * 1e12
+    return None
+
+
+class Speedometer:
+    """Log training speed (and optionally MFU) every ``frequent`` batches.
+
+    Reference: ``callback.py::Speedometer``. Extra TPU-native parameter
+    ``flops_per_sample``: when given and the device peak is known, an MFU
+    percentage is appended — BASELINE.md's north-star metric.
+    """
+
+    def __init__(self, batch_size, frequent=50, auto_reset=True,
+                 flops_per_sample=None, num_devices=None):
+        self.batch_size = batch_size
+        self.frequent = frequent
+        self.auto_reset = auto_reset
+        self.flops_per_sample = flops_per_sample
+        # batch_size counts samples across ALL chips (global batch), so the
+        # MFU denominator must be the aggregate peak of the chips doing the
+        # work; default = every default-backend device
+        self.num_devices = num_devices
+        self.init = False
+        self.tic = 0.0
+        self.last_count = 0
+        self._peak = None
+
+    def __call__(self, param):
+        count = param.nbatch
+        if self.last_count > count:
+            self.init = False
+        self.last_count = count
+
+        if not self.init:
+            self.init = True
+            self.tic = time.time()
+            return
+        if count % self.frequent != 0:
+            return
+        speed = self.frequent * self.batch_size / (time.time() - self.tic)
+        mfu = ""
+        if self.flops_per_sample:
+            if self._peak is None:
+                per_chip = device_peak_flops() or 0.0
+                if per_chip:
+                    import jax
+
+                    n = self.num_devices or jax.device_count()
+                    self._peak = per_chip * n
+                else:
+                    self._peak = 0.0
+            if self._peak:
+                mfu = "\tMFU=%.1f%%" % (
+                    100.0 * speed * self.flops_per_sample / self._peak)
+        if param.eval_metric is not None:
+            name_value = param.eval_metric.get_name_value()
+            if self.auto_reset:
+                param.eval_metric.reset()
+            msg = "Epoch[%d] Batch [%d]\tSpeed: %.2f samples/sec%s"
+            msg += "\t%s=%f" * len(name_value)
+            logging.info(msg, param.epoch, count, speed, mfu,
+                         *sum(name_value, ()))
+        else:
+            logging.info("Iter[%d] Batch [%d]\tSpeed: %.2f samples/sec%s",
+                         param.epoch, count, speed, mfu)
+        self.tic = time.time()
+
+
+def do_checkpoint(prefix, period=1):
+    """Epoch-end callback: save checkpoint every ``period`` epochs.
+
+    Reference: ``callback.py::do_checkpoint`` → ``model.save_checkpoint``.
+    """
+    from .module.module import save_checkpoint
+
+    period = int(max(1, period))
+
+    def _callback(epoch, sym, arg, aux):
+        if (epoch + 1) % period == 0:
+            save_checkpoint(prefix, epoch + 1, sym, arg, aux)
+
+    return _callback
+
+
+def log_train_metric(period, auto_reset=False):
+    """Batch-end callback: log the evaluation metric every ``period``."""
+
+    def _callback(param):
+        if param.nbatch % period == 0 and param.eval_metric is not None:
+            name_value = param.eval_metric.get_name_value()
+            for name, value in name_value:
+                logging.info("Iter[%d] Batch[%d] Train-%s=%f",
+                             param.epoch, param.nbatch, name, value)
+            if auto_reset:
+                param.eval_metric.reset()
+
+    return _callback
+
+
+class ProgressBar:
+    """Text progress bar over total batch count (reference: ProgressBar)."""
+
+    def __init__(self, total, length=80):
+        self.bar_len = length
+        self.total = total
+
+    def __call__(self, param):
+        count = param.nbatch
+        filled_len = int(round(self.bar_len * count / float(self.total)))
+        percents = int(round(100.0 * count / float(self.total)))
+        prog_bar = "=" * filled_len + "-" * (self.bar_len - filled_len)
+        logging.info("[%s] %s%s\r", prog_bar, percents, "%")

@@ -60,6 +60,11 @@ class BaseModule:
         self.forward(data_batch, is_train=True)
         self.backward()
 
+    def install_monitor(self, mon):
+        """reference: base_module.py::BaseModule.install_monitor — each
+        module type registers its own executor(s) with the Monitor."""
+        raise NotImplementedError()
+
     def score(self, eval_data, eval_metric, num_batch=None, reset=True,
               epoch=0):
         if reset:
@@ -122,14 +127,20 @@ class BaseModule:
         if isinstance(eval_metric, str):
             eval_metric = metric_mod.create(eval_metric)
         validation_metric = validation_metric or eval_metric
+        if monitor is not None:
+            self.install_monitor(monitor)
 
         for epoch in range(begin_epoch, num_epoch):
             tic = time.time()
             eval_metric.reset()
             train_data.reset()
             for nbatch, data_batch in enumerate(train_data):
+                if monitor is not None:
+                    monitor.tic()
                 self.forward_backward(data_batch)
                 self.update()
+                if monitor is not None:
+                    monitor.toc_print()
                 self.update_metric(eval_metric, data_batch.label)
                 if batch_end_callback is not None:
                     param = _BatchEndParam(epoch=epoch, nbatch=nbatch,
@@ -374,6 +385,17 @@ class Module(BaseModule):
             dict(zip(self._label_names, labels or [])),
             dict(zip(self.output_names, self._exec.outputs)))
 
+    def install_monitor(self, mon):
+        if self._exec is None:
+            raise MXNetError("install_monitor requires bind()")
+        # a rebind creates a fresh executor — swap it in the Monitor so a
+        # second fit(force_rebind=True) doesn't report stale arrays
+        prev = getattr(self, "_monitored_exec", None)
+        if prev is not None and prev is not self._exec and prev in mon.exes:
+            mon.exes.remove(prev)
+        mon.install(self._exec)
+        self._monitored_exec = self._exec
+
     def save_checkpoint(self, prefix, epoch, save_optimizer_states=False):
         arg, aux = self.get_params()
         save_checkpoint(prefix, epoch, self._symbol, arg, aux)
@@ -434,8 +456,15 @@ class BucketingModule(BaseModule):
                 mod._exec.aux_dict[n] = self._shared_aux[n]
             else:
                 self._shared_aux[n] = mod._exec.aux_dict[n]
+        if getattr(self, "_monitor", None) is not None:
+            mod.install_monitor(self._monitor)
         self._modules[bucket_key] = mod
         return mod
+
+    def install_monitor(self, mon):
+        self._monitor = mon
+        for mod in self._modules.values():
+            mod.install_monitor(mon)
 
     def bind(self, data_shapes, label_shapes=None, for_training=True,
              force_rebind=False, **kwargs):

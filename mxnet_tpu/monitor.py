@@ -1,0 +1,86 @@
+"""``mx.monitor.Monitor`` — debugging stat collection (reference:
+``python/mxnet/monitor.py``).
+
+The reference installs a per-op output hook on every executor
+(``MXExecutorSetMonitorCallback``) and prints ``stat_func`` of each
+intermediate array every ``interval`` batches. Under XLA the graph is one
+fused executable, so per-internal-op outputs don't exist to hook; the
+TPU-native Monitor instead snapshots everything that IS materialized at the
+step boundary — arguments (weights), gradients, auxiliary states, and
+outputs of each installed executor — which covers the dominant uses
+(exploding/vanishing grad & weight norms). Name filtering (``pattern``),
+``interval``, ``tic/toc/toc_print`` and the ``(step, name, stat)`` result
+triples match the reference API.
+"""
+from __future__ import annotations
+
+import logging
+import re
+
+import numpy as np
+
+__all__ = ["Monitor"]
+
+
+class Monitor:
+    def __init__(self, interval, stat_func=None, pattern=".*", sort=False):
+        if stat_func is None:
+            def stat_func(x):
+                # reference default: mean(abs(x))
+                return np.abs(x).mean()
+        self.stat_func = stat_func
+        self.interval = interval
+        self.activated = False
+        self.queue = []
+        self.step = 0
+        self.exes = []
+        self.re_pattern = re.compile(pattern)
+        self.sort = sort
+
+    def install(self, exe):
+        """Register an executor whose arrays are snapshotted at toc()."""
+        if exe not in self.exes:
+            self.exes.append(exe)
+        return exe
+
+    def tic(self):
+        """Start collecting for this batch if the interval hits."""
+        if self.step % self.interval == 0:
+            self.queue = []
+            self.activated = True
+        self.step += 1
+
+    def _collect(self, name, arr):
+        if arr is None or not self.re_pattern.match(name):
+            return
+        try:
+            val = self.stat_func(arr.asnumpy())
+        except Exception as e:  # stat on a weird dtype/shape — keep going
+            val = f"<stat failed: {e}>"
+        self.queue.append((self.step, name, val))
+
+    def toc(self):
+        """Collect stats from installed executors; returns result triples."""
+        if not self.activated:
+            return []
+        for exe in self.exes:
+            for name, arr in getattr(exe, "arg_dict", {}).items():
+                self._collect(name, arr)
+            for name, arr in getattr(exe, "aux_dict", {}).items():
+                self._collect(name, arr)
+            grad_dict = getattr(exe, "grad_dict", {}) or {}
+            for name, arr in grad_dict.items():
+                self._collect(name + "_grad", arr)
+            for i, arr in enumerate(getattr(exe, "outputs", []) or []):
+                self._collect(f"output{i}", arr)
+        self.activated = False
+        res = list(self.queue)
+        if self.sort:
+            res.sort(key=lambda t: t[1])
+        self.queue = []
+        return res
+
+    def toc_print(self):
+        """Collect and log (reference: Monitor.toc_print)."""
+        for step, name, value in self.toc():
+            logging.info("Batch: %7d %30s %s", step, name, value)

@@ -720,7 +720,11 @@ def imperative_invoke(opdef, tensor_args, attrs, out=None, ctx=None):
         else:
             def pure(*tensors):
                 return fn(*tensors, **fixed_attrs)
-        result, vjp_fn = jax.vjp(pure, *vals)
+        from ..base import current_execution_platform, execution_platform
+
+        sample = next((v for v in vals if hasattr(v, "devices")), None)
+        with execution_platform(current_execution_platform(sample)):
+            result, vjp_fn = jax.vjp(pure, *vals)
     else:
         result = eager_call(opdef, vals, attrs, rng=rng) if isinstance(opdef, OpDef) \
             else opdef.fn(*vals, **{k: v for k, v in attrs.items()})

@@ -203,6 +203,8 @@ def _harmonize_devices(tensors):
 
 def eager_call(opdef: OpDef, tensors, attrs, rng=None):
     """Execute an op eagerly through the per-op executable cache."""
+    from ..base import current_execution_platform, execution_platform
+
     tensors = _harmonize_devices(tensors)
     attr_items = tuple(sorted(attrs.items(), key=lambda kv: kv[0]))
     try:
@@ -210,11 +212,16 @@ def eager_call(opdef: OpDef, tensors, attrs, rng=None):
         uncached = opdef.eager_only
     except TypeError:  # unhashable attr (e.g. list) — run uncached
         uncached = True
-    if uncached:
+    # pin the execution platform from the concrete operands so in-trace
+    # kernel dispatch (Pallas flash) targets where the op actually runs
+    sample = tensors[0] if tensors else None
+    with execution_platform(current_execution_platform(sample)):
+        if uncached:
+            if rng is not None:
+                return opdef.fn(rng, *tensors, **attrs)
+            return opdef.fn(*tensors, **attrs)
+        fn = _cached_call(opdef.name, attr_items, len(tensors),
+                          rng is not None)
         if rng is not None:
-            return opdef.fn(rng, *tensors, **attrs)
-        return opdef.fn(*tensors, **attrs)
-    fn = _cached_call(opdef.name, attr_items, len(tensors), rng is not None)
-    if rng is not None:
-        return fn(rng, *tensors)
-    return fn(*tensors)
+            return fn(rng, *tensors)
+        return fn(*tensors)

@@ -2,11 +2,14 @@
 
 Two implementations of the same O(L) -memory online-softmax algorithm:
 
-* ``flash_attention`` — Pallas kernel. Grid (batch*heads, q_blocks,
-  k_blocks), K/V streamed HBM->VMEM one block per grid step, f32
-  accumulators in VMEM scratch, bf16 matmuls on the MXU. Backward via
-  ``jax.custom_vjp`` differentiating the scan fallback (recompute — trades
-  FLOPs for the O(L^2) score matrix, the flash trade).
+* ``flash_attention`` — Pallas kernels both directions. Forward: grid
+  (batch*heads, q_blocks, k_blocks), K/V streamed HBM->VMEM one block per
+  grid step, f32 accumulators in VMEM scratch, bf16 matmuls on the MXU;
+  emits the per-row logsumexp as a residual. Backward (``jax.custom_vjp``):
+  a dK/dV kernel (K block resident, Q streams; scores computed transposed
+  so row stats broadcast from lane vectors) and a dQ kernel (Q resident,
+  K streams), both recomputing probabilities from the saved logsumexp —
+  the FlashAttention-2 recompute trade, all matmuls on the MXU.
 * ``flash_attention_scan`` — pure-XLA `lax.scan` over K blocks; runs
   anywhere (the CPU-oracle path for check_consistency tests) and is the
   long-sequence fallback when the kernel's shape constraints aren't met.
@@ -20,10 +23,32 @@ import math
 
 import jax
 import jax.numpy as jnp
+import numpy as _np
 
 BLOCK_Q = 128
 BLOCK_K = 128
+
 _NEG_INF = -1e30
+# np.float32 constants: under global jax_enable_x64 a Python float would be
+# promoted to f64 inside the kernel trace, which Mosaic cannot legalize
+_NEG_INF32 = _np.float32(-1e30)
+_ONE32 = _np.float32(1.0)
+_ZERO32 = _np.float32(0.0)
+
+
+def _x32_mode():
+    # Mosaic cannot legalize the i64/f64 constants that jax_enable_x64
+    # (on globally for MXNet dtype parity) injects into kernel traces and
+    # BlockSpec index maps; trace kernels in 32-bit mode.
+    return jax.enable_x64(False)
+
+
+def _prec_for(dtype):
+    # f32 inputs get multi-pass MXU matmuls (f32-faithful); bf16 inputs run
+    # the native single-pass — the training fast path
+    if jnp.dtype(dtype) == jnp.float32:
+        return jax.lax.Precision.HIGHEST
+    return jax.lax.Precision.DEFAULT
 
 
 def flash_shape_supported(q, k, v, causal=False) -> bool:
@@ -41,12 +66,15 @@ def flash_shape_supported(q, k, v, causal=False) -> bool:
 
 
 def flash_supported(q, k, v, causal=False) -> bool:
-    """Kernel eligibility: TPU platform + block-aligned sequence lengths."""
-    try:
-        platform = jax.devices()[0].platform
-    except Exception:
-        return False
-    if platform != "tpu":
+    """Kernel eligibility: TPU execution + block-aligned sequence lengths.
+
+    Platform comes from ``base.current_execution_platform`` — set by the
+    framework's jit entry points — so a CPU-context op never takes the
+    kernel path just because a TPU exists in the process.
+    """
+    from ..base import current_execution_platform
+
+    if current_execution_platform(q) != "tpu":
         return False
     return flash_shape_supported(q, k, v, causal=causal)
 
@@ -113,8 +141,8 @@ def flash_attention_scan(q, k, v, scale=None, causal=False,
 # ---------------------------------------------------------------------------
 
 
-def _fwd_kernel(q_ref, k_ref, v_ref, o_ref, acc_ref, m_ref, l_ref, *,
-                scale, causal, nk, causal_offset):
+def _fwd_kernel(q_ref, k_ref, v_ref, o_ref, lse_ref, acc_ref, m_ref, l_ref,
+                *, scale, causal, nk, causal_offset, prec):
     from jax.experimental import pallas as pl
 
     ki = pl.program_id(2)
@@ -122,7 +150,7 @@ def _fwd_kernel(q_ref, k_ref, v_ref, o_ref, acc_ref, m_ref, l_ref, *,
     @pl.when(ki == 0)
     def _init():
         acc_ref[:] = jnp.zeros_like(acc_ref)
-        m_ref[:] = jnp.full_like(m_ref, _NEG_INF)
+        m_ref[:] = jnp.full_like(m_ref, _NEG_INF32)
         l_ref[:] = jnp.zeros_like(l_ref)
 
     qi = pl.program_id(1)
@@ -133,14 +161,14 @@ def _fwd_kernel(q_ref, k_ref, v_ref, o_ref, acc_ref, m_ref, l_ref, *,
         v = v_ref[0].astype(jnp.float32)
         s = jax.lax.dot_general(
             q, k, (((1,), (1,)), ((), ())),
-            preferred_element_type=jnp.float32)            # (BQ, BK)
+            preferred_element_type=jnp.float32, precision=prec)  # (BQ, BK)
         if causal:
             # bottom-right alignment: offset = lk - lq
             q_pos = causal_offset + qi * BLOCK_Q + jax.lax.broadcasted_iota(
                 jnp.int32, (BLOCK_Q, BLOCK_K), 0)
             k_pos = ki * BLOCK_K + jax.lax.broadcasted_iota(
                 jnp.int32, (BLOCK_Q, BLOCK_K), 1)
-            s = jnp.where(k_pos <= q_pos, s, _NEG_INF)
+            s = jnp.where(k_pos <= q_pos, s, _NEG_INF32)
         m_prev = m_ref[:, 0:1]                             # (BQ, 1)
         m_new = jnp.maximum(m_prev, jnp.max(s, axis=-1, keepdims=True))
         p = jnp.exp(s - m_new)
@@ -148,7 +176,7 @@ def _fwd_kernel(q_ref, k_ref, v_ref, o_ref, acc_ref, m_ref, l_ref, *,
         l_ref[:] = l_ref[:] * alpha + jnp.broadcast_to(
             jnp.sum(p, axis=-1, keepdims=True), l_ref.shape)
         acc_ref[:] = acc_ref[:] * alpha + jnp.dot(
-            p, v, preferred_element_type=jnp.float32)
+            p, v, preferred_element_type=jnp.float32, precision=prec)
         m_ref[:] = jnp.broadcast_to(m_new, m_ref.shape)
 
     if causal:
@@ -163,8 +191,16 @@ def _fwd_kernel(q_ref, k_ref, v_ref, o_ref, acc_ref, m_ref, l_ref, *,
     def _final():
         # fully-masked rows (every K block skipped: l == 0) emit zeros
         l = l_ref[:, 0:1]
-        o_ref[0] = (acc_ref[:] / jnp.where(l == 0.0, 1.0, l)).astype(
+        o_ref[0] = (acc_ref[:] / jnp.where(l == _ZERO32, _ONE32, l)).astype(
             o_ref.dtype)
+        # per-row logsumexp residual for the backward kernels, stored as a
+        # lane vector broadcast over 8 sublanes — (8, BQ) is the smallest
+        # f32 tile, so the (BQ,) column transposes into it legally
+        m_col = m_ref[:, 0:1]
+        l_safe = jnp.where(l == _ZERO32, _ONE32, l)
+        lse_col = jnp.where(l == _ZERO32, _NEG_INF32, m_col + jnp.log(l_safe))
+        lse_ref[0, 0] = jnp.broadcast_to(
+            lse_col.reshape(1, BLOCK_Q), (8, BLOCK_Q))
 
 
 def _flash_fwd_pallas(q, k, v, scale, causal, interpret=False):
@@ -178,9 +214,20 @@ def _flash_fwd_pallas(q, k, v, scale, causal, interpret=False):
     k3 = k.reshape(bh, lk, d)
     v3 = v.reshape(bh, lk, d)
     nq, nk = lq // BLOCK_Q, lk // BLOCK_K
+    prec = _prec_for(q.dtype)
     kernel = functools.partial(_fwd_kernel, scale=scale, causal=causal,
-                               nk=nk, causal_offset=lk - lq)
-    out = pl.pallas_call(
+                               nk=nk, causal_offset=lk - lq, prec=prec)
+    with _x32_mode():
+        out, lse = _call_fwd(kernel, q3, k3, v3, bh, nq, nk, lq, d,
+                             q.dtype, interpret)
+    return out.reshape(b, h, lq, d), lse
+
+
+def _call_fwd(kernel, q3, k3, v3, bh, nq, nk, lq, d, dtype, interpret):
+    from jax.experimental import pallas as pl
+    from jax.experimental.pallas import tpu as pltpu
+
+    out, lse = pl.pallas_call(
         kernel,
         grid=(bh, nq, nk),
         in_specs=[
@@ -188,9 +235,15 @@ def _flash_fwd_pallas(q, k, v, scale, causal, interpret=False):
             pl.BlockSpec((1, BLOCK_K, d), lambda bh_, qi, ki: (bh_, ki, 0)),
             pl.BlockSpec((1, BLOCK_K, d), lambda bh_, qi, ki: (bh_, ki, 0)),
         ],
-        out_specs=pl.BlockSpec((1, BLOCK_Q, d),
-                               lambda bh_, qi, ki: (bh_, qi, 0)),
-        out_shape=jax.ShapeDtypeStruct((bh, lq, d), q.dtype),
+        out_specs=[
+            pl.BlockSpec((1, BLOCK_Q, d), lambda bh_, qi, ki: (bh_, qi, 0)),
+            pl.BlockSpec((1, 1, 8, BLOCK_Q),
+                         lambda bh_, qi, ki: (bh_, qi, 0, 0)),
+        ],
+        out_shape=[
+            jax.ShapeDtypeStruct((bh, lq, d), dtype),
+            jax.ShapeDtypeStruct((bh, nq, 8, BLOCK_Q), jnp.float32),
+        ],
         scratch_shapes=[
             pltpu.VMEM((BLOCK_Q, d), jnp.float32),
             pltpu.VMEM((BLOCK_Q, 128), jnp.float32),
@@ -198,25 +251,211 @@ def _flash_fwd_pallas(q, k, v, scale, causal, interpret=False):
         ],
         interpret=interpret,
     )(q3, k3, v3)
-    return out.reshape(b, h, lq, d)
+    return out, lse
+
+
+def _bwd_dkdv_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref,
+                     dk_ref, dv_ref, dk_acc, dv_acc, *,
+                     scale, causal, nq, causal_offset, prec):
+    """dK/dV for one K block; Q blocks stream on the innermost grid dim.
+
+    All score math is done TRANSPOSED — s_T = (BK, BQ) — so the per-row
+    stats (lse, delta) broadcast from lane vectors (1, BQ) without any
+    relayout, and dV/dK contractions take p_T/ds_T directly.
+    """
+    from jax.experimental import pallas as pl
+
+    qi = pl.program_id(2)
+    ki = pl.program_id(1)
+
+    @pl.when(qi == 0)
+    def _init():
+        dk_acc[:] = jnp.zeros_like(dk_acc)
+        dv_acc[:] = jnp.zeros_like(dv_acc)
+
+    def compute():
+        q = q_ref[0].astype(jnp.float32)                   # (BQ, D)
+        k = k_ref[0].astype(jnp.float32)                   # (BK, D)
+        v = v_ref[0].astype(jnp.float32)
+        do = do_ref[0].astype(jnp.float32)                 # (BQ, D)
+        lse = lse_ref[0, 0][0:1, :]                         # (1, BQ)
+        delta = delta_ref[0, 0][0:1, :]                     # (1, BQ)
+        s_t = jax.lax.dot_general(
+            k, q, (((1,), (1,)), ((), ())),
+            preferred_element_type=jnp.float32, precision=prec) * scale
+        if causal:
+            q_pos = causal_offset + qi * BLOCK_Q + jax.lax.broadcasted_iota(
+                jnp.int32, (BLOCK_K, BLOCK_Q), 1)
+            k_pos = ki * BLOCK_K + jax.lax.broadcasted_iota(
+                jnp.int32, (BLOCK_K, BLOCK_Q), 0)
+            s_t = jnp.where(k_pos <= q_pos, s_t, _NEG_INF32)
+        p_t = jnp.exp(s_t - lse)                            # (BK, BQ)
+        dv_acc[:] += jnp.dot(p_t, do, preferred_element_type=jnp.float32,
+                             precision=prec)
+        dp_t = jax.lax.dot_general(
+            v, do, (((1,), (1,)), ((), ())),
+            preferred_element_type=jnp.float32, precision=prec)  # (BK, BQ)
+        ds_t = p_t * (dp_t - delta) * scale
+        dk_acc[:] += jnp.dot(ds_t, q, preferred_element_type=jnp.float32,
+                             precision=prec)
+
+    if causal:
+        @pl.when(ki * BLOCK_K <= causal_offset + qi * BLOCK_Q + BLOCK_Q - 1)
+        def _():
+            compute()
+    else:
+        compute()
+
+    @pl.when(qi == nq - 1)
+    def _final():
+        dk_ref[0] = dk_acc[:].astype(dk_ref.dtype)
+        dv_ref[0] = dv_acc[:].astype(dv_ref.dtype)
+
+
+def _bwd_dq_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref,
+                   dq_ref, dq_acc, *, scale, causal, nk, causal_offset, prec):
+    """dQ for one Q block; K blocks stream on the innermost grid dim."""
+    from jax.experimental import pallas as pl
+
+    ki = pl.program_id(2)
+    qi = pl.program_id(1)
+
+    @pl.when(ki == 0)
+    def _init():
+        dq_acc[:] = jnp.zeros_like(dq_acc)
+
+    def compute():
+        q = q_ref[0].astype(jnp.float32)
+        k = k_ref[0].astype(jnp.float32)
+        v = v_ref[0].astype(jnp.float32)
+        do = do_ref[0].astype(jnp.float32)
+        lse = lse_ref[0, 0][0:1, :]                         # (1, BQ)
+        delta = delta_ref[0, 0][0:1, :]                     # (1, BQ)
+        s_t = jax.lax.dot_general(
+            k, q, (((1,), (1,)), ((), ())),
+            preferred_element_type=jnp.float32, precision=prec) * scale
+        if causal:
+            q_pos = causal_offset + qi * BLOCK_Q + jax.lax.broadcasted_iota(
+                jnp.int32, (BLOCK_K, BLOCK_Q), 1)
+            k_pos = ki * BLOCK_K + jax.lax.broadcasted_iota(
+                jnp.int32, (BLOCK_K, BLOCK_Q), 0)
+            s_t = jnp.where(k_pos <= q_pos, s_t, _NEG_INF32)
+        p_t = jnp.exp(s_t - lse)
+        dp_t = jax.lax.dot_general(
+            v, do, (((1,), (1,)), ((), ())),
+            preferred_element_type=jnp.float32, precision=prec)
+        ds_t = p_t * (dp_t - delta) * scale                 # (BK, BQ)
+        # dq = ds @ k = ds_t^T @ k : contract the BK dim of both
+        dq_acc[:] += jax.lax.dot_general(
+            ds_t, k, (((0,), (0,)), ((), ())),
+            preferred_element_type=jnp.float32, precision=prec)  # (BQ, D)
+
+    if causal:
+        @pl.when(ki * BLOCK_K <= causal_offset + qi * BLOCK_Q + BLOCK_Q - 1)
+        def _():
+            compute()
+    else:
+        compute()
+
+    @pl.when(ki == nk - 1)
+    def _final():
+        dq_ref[0] = dq_acc[:].astype(dq_ref.dtype)
+
+
+def _flash_bwd_pallas(q, k, v, o, lse, g, scale, causal, interpret=False):
+    from jax.experimental import pallas as pl
+    from jax.experimental.pallas import tpu as pltpu
+
+    b, h, lq, d = q.shape
+    lk = k.shape[2]
+    bh = b * h
+    q3 = q.reshape(bh, lq, d)
+    k3 = k.reshape(bh, lk, d)
+    v3 = v.reshape(bh, lk, d)
+    do3 = g.reshape(bh, lq, d)
+    nq, nk = lq // BLOCK_Q, lk // BLOCK_K
+    # delta_i = rowsum(dO_i * O_i) — cheap, fused by XLA outside the
+    # kernel; stored in the same sublane-padded layout as lse
+    delta = jnp.sum(do3.astype(jnp.float32)
+                    * o.reshape(bh, lq, d).astype(jnp.float32), axis=-1)
+    delta = jnp.broadcast_to(delta.reshape(bh, nq, 1, BLOCK_Q),
+                             (bh, nq, 8, BLOCK_Q))
+    offset = lk - lq
+
+    q_spec = pl.BlockSpec((1, BLOCK_Q, d), lambda bh_, i, j: (bh_, j, 0))
+    row_spec = pl.BlockSpec((1, 1, 8, BLOCK_Q),
+                            lambda bh_, i, j: (bh_, j, 0, 0))
+    with _x32_mode():
+        dkdv = pl.pallas_call(
+            functools.partial(_bwd_dkdv_kernel, scale=scale, causal=causal,
+                              nq=nq, causal_offset=offset,
+                              prec=_prec_for(q.dtype)),
+            grid=(bh, nk, nq),
+            in_specs=[
+                q_spec,                                          # q by qi=j
+                pl.BlockSpec((1, BLOCK_K, d), lambda bh_, i, j: (bh_, i, 0)),
+                pl.BlockSpec((1, BLOCK_K, d), lambda bh_, i, j: (bh_, i, 0)),
+                q_spec,                                          # do by qi=j
+                row_spec,                                        # lse
+                row_spec,                                        # delta
+            ],
+            out_specs=[
+                pl.BlockSpec((1, BLOCK_K, d), lambda bh_, i, j: (bh_, i, 0)),
+                pl.BlockSpec((1, BLOCK_K, d), lambda bh_, i, j: (bh_, i, 0)),
+            ],
+            out_shape=[
+                jax.ShapeDtypeStruct((bh, lk, d), k.dtype),
+                jax.ShapeDtypeStruct((bh, lk, d), v.dtype),
+            ],
+            scratch_shapes=[
+                pltpu.VMEM((BLOCK_K, d), jnp.float32),
+                pltpu.VMEM((BLOCK_K, d), jnp.float32),
+            ],
+            interpret=interpret,
+        )
+        dk3, dv3 = dkdv(q3, k3, v3, do3, lse, delta)
+
+        dq = pl.pallas_call(
+            functools.partial(_bwd_dq_kernel, scale=scale, causal=causal,
+                              nk=nk, causal_offset=offset,
+                              prec=_prec_for(q.dtype)),
+            grid=(bh, nq, nk),
+            in_specs=[
+                pl.BlockSpec((1, BLOCK_Q, d), lambda bh_, i, j: (bh_, i, 0)),
+                pl.BlockSpec((1, BLOCK_K, d), lambda bh_, i, j: (bh_, j, 0)),
+                pl.BlockSpec((1, BLOCK_K, d), lambda bh_, i, j: (bh_, j, 0)),
+                pl.BlockSpec((1, BLOCK_Q, d), lambda bh_, i, j: (bh_, i, 0)),
+                pl.BlockSpec((1, 1, 8, BLOCK_Q),
+                             lambda bh_, i, j: (bh_, i, 0, 0)),
+                pl.BlockSpec((1, 1, 8, BLOCK_Q),
+                             lambda bh_, i, j: (bh_, i, 0, 0)),
+            ],
+            out_specs=pl.BlockSpec((1, BLOCK_Q, d),
+                                   lambda bh_, i, j: (bh_, i, 0)),
+            out_shape=jax.ShapeDtypeStruct((bh, lq, d), q.dtype),
+            scratch_shapes=[pltpu.VMEM((BLOCK_Q, d), jnp.float32)],
+            interpret=interpret,
+        )(q3, k3, v3, do3, lse, delta)
+    return (dq.reshape(b, h, lq, d), dk3.reshape(b, h, lk, d),
+            dv3.reshape(b, h, lk, d))
 
 
 @functools.partial(jax.custom_vjp, nondiff_argnums=(3, 4, 5))
 def _flash(q, k, v, scale, causal, interpret):
-    return _flash_fwd_pallas(q, k, v, scale, causal, interpret)
+    return _flash_fwd_pallas(q, k, v, scale, causal, interpret)[0]
 
 
 def _flash_fwd(q, k, v, scale, causal, interpret):
-    return _flash_fwd_pallas(q, k, v, scale, causal, interpret), (q, k, v)
+    o, lse = _flash_fwd_pallas(q, k, v, scale, causal, interpret)
+    return o, (q, k, v, o, lse)
 
 
 def _flash_bwd(scale, causal, interpret, res, g):
-    q, k, v = res
-    # recompute-based backward through the O(L)-memory scan path
-    _, vjp = jax.vjp(
-        lambda q_, k_, v_: flash_attention_scan(q_, k_, v_, scale=scale,
-                                                causal=causal), q, k, v)
-    return vjp(g)
+    # Pallas dq/dk/dv kernels recomputing p from the saved logsumexp —
+    # training-mode attention runs on the MXU in BOTH directions (round-1
+    # weakness #5: the old bwd re-differentiated the XLA scan).
+    q, k, v, o, lse = res
+    return _flash_bwd_pallas(q, k, v, o, lse, g, scale, causal, interpret)
 
 
 _flash.defvjp(_flash_fwd, _flash_bwd)

@@ -296,8 +296,11 @@ class TrainStep:
         batch_vals = [jax.device_put(v.data, sh)
                       for v, sh in zip(data_tuple + label_tuple,
                                        entry["batch_sh"])]
-        new_params, new_states, loss_val, outs, aux = jitted(
-            param_vals, state_vals, t, lr, rng, *batch_vals)
+        from ..base import execution_platform
+
+        with execution_platform(self.mesh.devices.flat[0].platform):
+            new_params, new_states, loss_val, outs, aux = jitted(
+                param_vals, state_vals, t, lr, rng, *batch_vals)
 
         for p, v in zip(self._params, new_params):
             p.data()._set_data(v)

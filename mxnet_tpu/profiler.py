@@ -1,0 +1,243 @@
+"""``mx.profiler`` — tracing/profiling over ``jax.profiler``.
+
+Reference surface: ``python/mxnet/profiler.py`` (``set_config``, ``set_state``
+``start``/``stop``, ``dumps``, scoped annotation objects ``Task``/``Frame``/
+``Event``/``Counter``/``Marker``) backed by ``src/profiler/profiler.cc``'s
+chrome://tracing dump. TPU-native design: the device-side trace comes from
+XLA/XProf via ``jax.profiler.start_trace`` (TensorBoard-viewable, includes
+per-HLO device timelines — strictly more than the reference's per-op spans);
+host-side scoped annotations lower to ``jax.profiler.TraceAnnotation`` /
+``StepTraceAnnotation`` so they appear on the same timeline. ``dumps()``
+returns an aggregate table of host-recorded spans, mirroring
+``profiler.dumps()``'s aggregate-stats mode (``aggregate_stats.cc``).
+
+Env: ``MXNET_PROFILER_AUTOSTART=1`` starts profiling at import, like the
+reference.
+"""
+from __future__ import annotations
+
+import os
+import threading
+import time
+from collections import defaultdict
+from typing import Dict, List, Optional
+
+__all__ = [
+    "set_config", "set_state", "start", "stop", "pause", "resume", "dumps",
+    "dump", "state", "Task", "Frame", "Event", "Counter", "Marker",
+]
+
+_lock = threading.Lock()
+_config: Dict = {
+    "filename": "profile.json",       # chrome-trace-style output dir/file
+    "profile_all": False,
+    "profile_symbolic": True,
+    "profile_imperative": True,
+    "profile_memory": False,
+    "profile_api": False,
+    "aggregate_stats": True,
+    "continuous_dump": False,
+}
+_state = "stop"            # 'run' | 'stop' | 'pause'
+_trace_dir: Optional[str] = None
+_jax_trace_active = False
+# host-side span aggregation: name -> [count, total_s, min_s, max_s]
+_spans: Dict[str, List[float]] = defaultdict(lambda: [0, 0.0, float("inf"), 0.0])
+_counters: Dict[str, float] = {}
+_markers: List[tuple] = []
+
+
+def set_config(**kwargs):
+    """Configure the profiler (reference: profiler.py::set_config).
+
+    Accepts the reference's kwargs (``profile_all``, ``profile_symbolic``,
+    ``profile_imperative``, ``profile_memory``, ``profile_api``,
+    ``filename``, ``aggregate_stats``, ``continuous_dump``). ``filename``'s
+    directory is where the XProf trace is written.
+    """
+    unknown = set(kwargs) - set(_config)
+    if unknown:
+        raise ValueError(f"unknown profiler config keys: {sorted(unknown)}")
+    with _lock:
+        _config.update(kwargs)
+
+
+def state():
+    return _state
+
+
+def set_state(new_state="stop"):
+    """'run' starts the device trace; 'stop' ends it (reference semantics)."""
+    global _state, _jax_trace_active, _trace_dir
+    if new_state not in ("run", "stop", "pause"):
+        raise ValueError(f"bad profiler state {new_state!r}")
+    with _lock:
+        if new_state == "run" and _state != "run":
+            import jax
+
+            _trace_dir = os.path.splitext(_config["filename"])[0] + "_xprof"
+            os.makedirs(_trace_dir, exist_ok=True)
+            try:
+                jax.profiler.start_trace(_trace_dir)
+                _jax_trace_active = True
+            except RuntimeError:
+                # a trace is already running (nested start) — keep host spans
+                _jax_trace_active = False
+        elif new_state in ("stop", "pause") and _state == "run":
+            if _jax_trace_active:
+                import jax
+
+                jax.profiler.stop_trace()
+                _jax_trace_active = False
+        _state = new_state
+
+
+def start():
+    set_state("run")
+
+
+def stop():
+    set_state("stop")
+
+
+def pause(profile_process="worker"):
+    set_state("pause")
+
+
+def resume(profile_process="worker"):
+    set_state("run")
+
+
+def dumps(reset=False, format="table"):
+    """Aggregate-stats table of host-recorded spans + counters.
+
+    Mirrors ``profiler.dumps()`` (aggregate mode). The device-side XProf
+    trace lives in ``<filename stem>_xprof/`` for TensorBoard.
+    """
+    with _lock:
+        lines = ["Profile Statistics:",
+                 f"{'Name':<40}{'Calls':>8}{'Total(ms)':>12}"
+                 f"{'Min(ms)':>10}{'Max(ms)':>10}{'Avg(ms)':>10}"]
+        for name in sorted(_spans):
+            cnt, tot, mn, mx = _spans[name]
+            lines.append(
+                f"{name:<40}{cnt:>8}{tot * 1e3:>12.3f}{mn * 1e3:>10.3f}"
+                f"{mx * 1e3:>10.3f}{tot / max(cnt, 1) * 1e3:>10.3f}")
+        for name in sorted(_counters):
+            lines.append(f"{name:<40}{'':>8}{_counters[name]:>12.3f}")
+        if reset:
+            _spans.clear()
+            _counters.clear()
+            _markers.clear()
+        out = "\n".join(lines)
+    if _trace_dir:
+        out += f"\n(XProf device trace: {_trace_dir})"
+    return out
+
+
+def dump(finished=True, profile_process="worker"):
+    """Write the aggregate table next to the configured filename."""
+    path = _config["filename"]
+    with open(path, "w") as f:
+        f.write(dumps())
+    return path
+
+
+class _Scope:
+    """Scoped annotation: context manager + start/stop object API.
+
+    Lowered to ``jax.profiler.TraceAnnotation`` so the span shows on the
+    XProf host timeline, and recorded in the host aggregate table.
+    """
+
+    _kind = "Event"
+
+    def __init__(self, name):
+        self.name = name
+        self._t0 = None
+        self._ann = None
+
+    def start(self):
+        import jax
+
+        self._t0 = time.perf_counter()
+        self._ann = jax.profiler.TraceAnnotation(
+            f"{self._kind}::{self.name}")
+        self._ann.__enter__()
+        return self
+
+    def stop(self):
+        if self._t0 is None:
+            return
+        dt = time.perf_counter() - self._t0
+        if self._ann is not None:
+            self._ann.__exit__(None, None, None)
+            self._ann = None
+        with _lock:
+            rec = _spans[f"{self._kind}::{self.name}"]
+            rec[0] += 1
+            rec[1] += dt
+            rec[2] = min(rec[2], dt)
+            rec[3] = max(rec[3], dt)
+        self._t0 = None
+
+    def __enter__(self):
+        return self.start()
+
+    def __exit__(self, *exc):
+        self.stop()
+        return False
+
+
+class Task(_Scope):
+    _kind = "Task"
+
+
+class Frame(_Scope):
+    _kind = "Frame"
+
+
+class Event(_Scope):
+    _kind = "Event"
+
+
+class Counter:
+    """Named counter (reference: profiler.Counter): set/increment/decrement."""
+
+    def __init__(self, name, value=0):
+        self.name = name
+        self.set_value(value)
+
+    def set_value(self, value):
+        with _lock:
+            _counters[self.name] = float(value)
+
+    def increment(self, delta=1):
+        with _lock:
+            _counters[self.name] = _counters.get(self.name, 0.0) + delta
+
+    def decrement(self, delta=1):
+        self.increment(-delta)
+
+    def __iadd__(self, v):
+        self.increment(v)
+        return self
+
+    def __isub__(self, v):
+        self.decrement(v)
+        return self
+
+
+class Marker:
+    """Instant event (reference: profiler.Marker.mark)."""
+
+    def __init__(self, name):
+        self.name = name
+
+    def mark(self, scope="process"):
+        with _lock:
+            _markers.append((self.name, scope, time.perf_counter()))
+
+
+if os.environ.get("MXNET_PROFILER_AUTOSTART", "0") == "1":
+    set_state("run")

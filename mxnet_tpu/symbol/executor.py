@@ -146,6 +146,9 @@ class Executor:
         arg_vals = tuple(self.arg_dict[n].data for n in arg_names)
         aux_vals = tuple(self.aux_dict[n].data for n in aux_names)
         rng = random_state.get_state_key()
+        from ..base import current_execution_platform, execution_platform
+
+        sample = next((v for v in arg_vals if hasattr(v, "devices")), None)
         if self._is_train:
             # value-and-vjp so backward() can run later without retracing
             def fwd_for_grad(diff_vals):
@@ -159,11 +162,14 @@ class Executor:
             import jax
 
             diff_vals = tuple(arg_vals[i] for i in self._diff_slots())
-            outs, vjp, new_aux = jax.vjp(fwd_for_grad, diff_vals,
-                                         has_aux=True)
+            with execution_platform(current_execution_platform(sample)):
+                outs, vjp, new_aux = jax.vjp(fwd_for_grad, diff_vals,
+                                             has_aux=True)
             self._vjp = vjp
         else:
-            outs, new_aux = self._compiled(False)(arg_vals, aux_vals, rng)
+            with execution_platform(current_execution_platform(sample)):
+                outs, new_aux = self._compiled(False)(arg_vals, aux_vals,
+                                                      rng)
             self._vjp = None
         for n, v in zip(aux_names, new_aux):
             self.aux_dict[n]._set_data(v)

@@ -170,3 +170,45 @@ class TestModuleCheckpoint:
         with pytest.raises(MXNetError, match="auxiliary"):
             mod.init_params(arg_params=args, aux_params={},
                             allow_missing=False, force_init=True)
+
+
+class TestInstallMonitor:
+    def test_fit_with_monitor_module(self, caplog):
+        import logging
+
+        from mxnet_tpu.monitor import Monitor
+
+        mod = Module(_mlp_symbol(), data_names=("data",),
+                     label_names=("softmax_label",))
+        mon = Monitor(interval=2, pattern=".*fc1.*")
+        with caplog.at_level(logging.INFO):
+            mod.fit(_toy_iter(), num_epoch=1, monitor=mon)
+        assert mod._exec in mon.exes
+        assert any("fc1_weight" in r.getMessage() for r in caplog.records)
+
+    def test_fit_with_monitor_bucketing(self):
+        """Round-2 review finding: BaseModule.fit touched Module-only _exec;
+        install_monitor must be polymorphic over BucketingModule too."""
+        from mxnet_tpu.module import BucketingModule
+        from mxnet_tpu.monitor import Monitor
+
+        def sym_gen(key):
+            return _mlp_symbol(), ("data",), ("softmax_label",)
+
+        mod = BucketingModule(sym_gen, default_bucket_key=8)
+        mon = Monitor(interval=1)
+        mod.fit(_toy_iter(), num_epoch=1, monitor=mon)
+        assert len(mon.exes) == 1
+
+    def test_rebind_swaps_monitored_executor(self):
+        from mxnet_tpu.monitor import Monitor
+
+        mod = Module(_mlp_symbol(), data_names=("data",),
+                     label_names=("softmax_label",))
+        mon = Monitor(interval=1)
+        mod.fit(_toy_iter(), num_epoch=1, monitor=mon)
+        first = mod._exec
+        mod.fit(_toy_iter(), num_epoch=1, monitor=mon, force_rebind=True,
+                force_init=True)
+        assert first not in mon.exes and mod._exec in mon.exes
+        assert len(mon.exes) == 1
