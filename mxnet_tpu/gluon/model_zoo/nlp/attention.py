@@ -48,7 +48,10 @@ class MultiHeadAttention(HybridBlock):
             self.dropout = nn.Dropout(dropout) if dropout else None
 
     def _split_heads(self, F, x):
-        # (B, L, U) -> (B, H, L, D)
+        # (B, L, U) -> (B, H, L, D) — the Pallas kernel's layout (Mosaic
+        # tiles (L, D); a squeezed-H blhd tile is not lowerable, see
+        # flash_shape_supported). XLA folds these transposes into the
+        # surrounding matmuls where it can.
         b, l = x.shape[0], x.shape[1]
         h, d = self._num_heads, self._units // self._num_heads
         return x.reshape((b, l, h, d)).transpose((0, 2, 1, 3))

@@ -18,10 +18,15 @@ import jax.numpy as jnp
 from .registry import register
 
 
-def _sdpa_reference(q, k, v, mask, scale, causal):
-    """(B, H, Lq, D) x (B, H, Lk, D) -> (B, H, Lq, D); f32 softmax."""
+def _sdpa_reference(q, k, v, mask, scale, causal, layout="bhld"):
+    """f32-softmax attention. layout "bhld": (B, H, L, D); "blhd":
+    (B, L, H, D) — head transposes fold into the einsum contractions."""
     dtype = q.dtype
-    scores = jnp.einsum("bhqd,bhkd->bhqk", q, k).astype(jnp.float32) * scale
+    if layout == "blhd":
+        scores = jnp.einsum("bqhd,bkhd->bhqk", q, k)
+    else:
+        scores = jnp.einsum("bhqd,bhkd->bhqk", q, k)
+    scores = scores.astype(jnp.float32) * scale
     if causal:
         lq, lk = scores.shape[-2], scores.shape[-1]
         causal_mask = jnp.tril(jnp.ones((lq, lk), dtype=bool), k=lk - lq)
@@ -30,14 +35,22 @@ def _sdpa_reference(q, k, v, mask, scale, causal):
         # mask: 1 = attend, 0 = ignore; broadcastable to (B, H, Lq, Lk)
         m = jnp.broadcast_to(mask.astype(bool), scores.shape)
         scores = jnp.where(m, scores, jnp.float32(-1e9))
-    probs = jax.nn.softmax(scores, axis=-1)
-    return jnp.einsum("bhqk,bhkd->bhqd", probs.astype(dtype), v)
+    probs = jax.nn.softmax(scores, axis=-1).astype(dtype)
+    if layout == "blhd":
+        return jnp.einsum("bhqk,bkhd->bqhd", probs, v)
+    return jnp.einsum("bhqk,bhkd->bhqd", probs, v)
 
 
 @register("_contrib_sdp_attention", aliases=["sdp_attention"])
 def sdp_attention(query, key, value, mask=None, *, scale=None, causal=False,
-                  flash=True):
-    """Scaled dot-product attention over (batch, heads, seq, head_dim).
+                  flash=True, layout="bhld"):
+    """Scaled dot-product attention.
+
+    ``layout``: "bhld" (batch, heads, seq, head_dim) or "blhd" (batch, seq,
+    heads, head_dim). blhd runs the XLA einsum path (head transposes fold
+    into the contractions); the Pallas kernel currently takes bhld only —
+    Mosaic cannot tile a per-head (seq, head_dim) block of a blhd array
+    (squeezed H lands in sublane position), see flash_shape_supported.
 
     ``flash=True`` routes to the Pallas flash kernel on TPU when the shape
     qualifies (seq multiple of block size); otherwise the XLA reference path
@@ -49,14 +62,21 @@ def sdp_attention(query, key, value, mask=None, *, scale=None, causal=False,
         from ..pallas_kernels import (flash_attention, flash_attention_scan,
                                       flash_supported)
 
-        if flash_supported(query, key, value, causal=causal):
+        if flash_supported(query, key, value, causal=causal, layout=layout):
             return flash_attention(query, key, value, scale=scale,
-                                   causal=causal)
-        if key.shape[-2] >= 2048:
+                                   causal=causal, layout=layout)
+        seq_ax = 1 if layout == "blhd" else -2
+        if key.shape[seq_ax] >= 2048:
             # long sequence off-TPU: O(L) memory blockwise path
+            if layout == "blhd":
+                out = flash_attention_scan(
+                    query.transpose(0, 2, 1, 3), key.transpose(0, 2, 1, 3),
+                    value.transpose(0, 2, 1, 3), scale=scale, causal=causal)
+                return out.transpose(0, 2, 1, 3)
             return flash_attention_scan(query, key, value, scale=scale,
                                         causal=causal)
-    return _sdpa_reference(query, key, value, mask, scale, causal)
+    return _sdpa_reference(query, key, value, mask, scale, causal,
+                           layout=layout)
 
 
 @register("_contrib_rms_norm", aliases=["rms_norm"])

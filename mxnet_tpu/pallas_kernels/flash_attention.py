@@ -27,6 +27,26 @@ import numpy as _np
 
 BLOCK_Q = 128
 BLOCK_K = 128
+MAX_BLOCK = 512
+
+
+def _block_sizes(lq, lk):
+    """Largest power-of-two blocks (<= MAX_BLOCK) dividing the seq lengths.
+
+    Bigger blocks mean fewer grid steps and larger MXU matmuls — at seq 512
+    a single (512, 512) block turns the whole head into one VMEM-resident
+    fused attention, which is what beats XLA's HBM-bound softmax path. 512
+    is the VMEM comfort cap: the f32 score tile is bq*bk*4 = 1 MB.
+    """
+    try:
+        bq = next(b for b in (MAX_BLOCK, 256, 128) if lq % b == 0)
+        bk = next(b for b in (MAX_BLOCK, 256, 128) if lk % b == 0)
+    except StopIteration:
+        raise ValueError(
+            f"flash_attention requires sequence lengths that are multiples "
+            f"of {BLOCK_Q}; got lq={lq}, lk={lk} (use flash_attention_scan "
+            f"or sdp_attention, which fall back automatically)") from None
+    return bq, bk
 
 _NEG_INF = -1e30
 # np.float32 constants: under global jax_enable_x64 a Python float would be
@@ -51,13 +71,20 @@ def _prec_for(dtype):
     return jax.lax.Precision.DEFAULT
 
 
-def flash_shape_supported(q, k, v, causal=False) -> bool:
+def flash_shape_supported(q, k, v, causal=False, layout="bhld") -> bool:
     """Platform-independent kernel shape eligibility.
 
     Causal with lq > lk is rejected: bottom-right alignment would leave the
     top query rows with no visible keys (a fully-masked, degenerate row the
     dense reference only "answers" with a uniform softmax over masked-out
     scores — not a shape any model in the zoo produces)."""
+    if layout == "blhd":
+        # Mosaic requires the last two block dims be (8k, 128k)-aligned or
+        # span the full array dim; a per-head (bq, d) tile of (B, L, H, D)
+        # puts a squeezed H in sublane position, which it rejects. The
+        # kernel therefore only takes the bhld layout; blhd callers get the
+        # einsum path (whose head transposes fold into the contractions).
+        return False
     lq, lk = q.shape[-2], k.shape[-2]
     if causal and lq > lk:
         return False
@@ -65,7 +92,7 @@ def flash_shape_supported(q, k, v, causal=False) -> bool:
             and q.shape[-1] <= 256 and q.shape[-1] % 8 == 0)
 
 
-def flash_supported(q, k, v, causal=False) -> bool:
+def flash_supported(q, k, v, causal=False, layout="bhld") -> bool:
     """Kernel eligibility: TPU execution + block-aligned sequence lengths.
 
     Platform comes from ``base.current_execution_platform`` — set by the
@@ -76,7 +103,7 @@ def flash_supported(q, k, v, causal=False) -> bool:
 
     if current_execution_platform(q) != "tpu":
         return False
-    return flash_shape_supported(q, k, v, causal=causal)
+    return flash_shape_supported(q, k, v, causal=causal, layout=layout)
 
 
 # ---------------------------------------------------------------------------
@@ -142,7 +169,7 @@ def flash_attention_scan(q, k, v, scale=None, causal=False,
 
 
 def _fwd_kernel(q_ref, k_ref, v_ref, o_ref, lse_ref, acc_ref, m_ref, l_ref,
-                *, scale, causal, nk, causal_offset, prec):
+                *, scale, causal, nk, causal_offset, prec, bq, bk):
     from jax.experimental import pallas as pl
 
     ki = pl.program_id(2)
@@ -156,18 +183,18 @@ def _fwd_kernel(q_ref, k_ref, v_ref, o_ref, lse_ref, acc_ref, m_ref, l_ref,
     qi = pl.program_id(1)
 
     def compute():
-        q = q_ref[0].astype(jnp.float32) * scale          # (BQ, D)
-        k = k_ref[0].astype(jnp.float32)                   # (BK, D)
-        v = v_ref[0].astype(jnp.float32)
+        q = q_ref[...].astype(jnp.float32) * scale         # (BQ, D)
+        k = k_ref[...].astype(jnp.float32)                 # (BK, D)
+        v = v_ref[...].astype(jnp.float32)
         s = jax.lax.dot_general(
             q, k, (((1,), (1,)), ((), ())),
             preferred_element_type=jnp.float32, precision=prec)  # (BQ, BK)
         if causal:
             # bottom-right alignment: offset = lk - lq
-            q_pos = causal_offset + qi * BLOCK_Q + jax.lax.broadcasted_iota(
-                jnp.int32, (BLOCK_Q, BLOCK_K), 0)
-            k_pos = ki * BLOCK_K + jax.lax.broadcasted_iota(
-                jnp.int32, (BLOCK_Q, BLOCK_K), 1)
+            q_pos = causal_offset + qi * bq + jax.lax.broadcasted_iota(
+                jnp.int32, (bq, bk), 0)
+            k_pos = ki * bk + jax.lax.broadcasted_iota(
+                jnp.int32, (bq, bk), 1)
             s = jnp.where(k_pos <= q_pos, s, _NEG_INF32)
         m_prev = m_ref[:, 0:1]                             # (BQ, 1)
         m_new = jnp.maximum(m_prev, jnp.max(s, axis=-1, keepdims=True))
@@ -181,7 +208,7 @@ def _fwd_kernel(q_ref, k_ref, v_ref, o_ref, lse_ref, acc_ref, m_ref, l_ref,
 
     if causal:
         # blocks entirely above the diagonal contribute nothing — skip
-        @pl.when(ki * BLOCK_K <= causal_offset + qi * BLOCK_Q + BLOCK_Q - 1)
+        @pl.when(ki * bk <= causal_offset + qi * bq + bq - 1)
         def _():
             compute()
     else:
@@ -191,7 +218,7 @@ def _fwd_kernel(q_ref, k_ref, v_ref, o_ref, lse_ref, acc_ref, m_ref, l_ref,
     def _final():
         # fully-masked rows (every K block skipped: l == 0) emit zeros
         l = l_ref[:, 0:1]
-        o_ref[0] = (acc_ref[:] / jnp.where(l == _ZERO32, _ONE32, l)).astype(
+        o_ref[...] = (acc_ref[:] / jnp.where(l == _ZERO32, _ONE32, l)).astype(
             o_ref.dtype)
         # per-row logsumexp residual for the backward kernels, stored as a
         # lane vector broadcast over 8 sublanes — (8, BQ) is the smallest
@@ -199,64 +226,92 @@ def _fwd_kernel(q_ref, k_ref, v_ref, o_ref, lse_ref, acc_ref, m_ref, l_ref,
         m_col = m_ref[:, 0:1]
         l_safe = jnp.where(l == _ZERO32, _ONE32, l)
         lse_col = jnp.where(l == _ZERO32, _NEG_INF32, m_col + jnp.log(l_safe))
-        lse_ref[0, 0] = jnp.broadcast_to(
-            lse_col.reshape(1, BLOCK_Q), (8, BLOCK_Q))
+        lse_ref[...] = jnp.broadcast_to(
+            lse_col.reshape(1, bq), (8, bq))
 
 
-def _flash_fwd_pallas(q, k, v, scale, causal, interpret=False):
+def _dims(x, layout, is_q=True):
+    if layout == "blhd":
+        b, l, h, d = x.shape
+    else:
+        b, h, l, d = x.shape
+    return b, h, l, d
+
+
+def _tile_spec(layout, h, blk, d, seq_index):
+    """BlockSpec for one (blk, d) Q/K/V/O tile of a head.
+
+    bhld: array is pre-reshaped (B*H, L, D); blhd: array stays native
+    (B, L, H, D) and the batch/head grid dim splits in the index map —
+    no relayout of the activations at all (None entries squeeze the unit
+    dims out of the kernel block).
+    """
+    from jax.experimental import pallas as pl
+
+    if layout == "blhd":
+        return pl.BlockSpec(
+            (None, blk, None, d),
+            lambda bh_, qi, ki, _h=h, _s=seq_index: (
+                bh_ // _h, (qi, ki)[_s], bh_ % _h, 0))
+    return pl.BlockSpec(
+        (None, blk, d),
+        lambda bh_, qi, ki, _s=seq_index: (bh_, (qi, ki)[_s], 0))
+
+
+def _flash_fwd_pallas(q, k, v, scale, causal, interpret=False,
+                      layout="bhld"):
     from jax.experimental import pallas as pl
     from jax.experimental.pallas import tpu as pltpu
 
-    b, h, lq, d = q.shape
-    lk = k.shape[2]
+    b, h, lq, d = _dims(q, layout)
+    lk = _dims(k, layout)[2]
     bh = b * h
-    q3 = q.reshape(bh, lq, d)
-    k3 = k.reshape(bh, lk, d)
-    v3 = v.reshape(bh, lk, d)
-    nq, nk = lq // BLOCK_Q, lk // BLOCK_K
+    if layout == "bhld":
+        q = q.reshape(bh, lq, d)
+        k = k.reshape(bh, lk, d)
+        v = v.reshape(bh, lk, d)
+        o_shape = jax.ShapeDtypeStruct((bh, lq, d), q.dtype)
+    else:
+        o_shape = jax.ShapeDtypeStruct((b, lq, h, d), q.dtype)
+    bq, bk = _block_sizes(lq, lk)
+    nq, nk = lq // bq, lk // bk
     prec = _prec_for(q.dtype)
     kernel = functools.partial(_fwd_kernel, scale=scale, causal=causal,
-                               nk=nk, causal_offset=lk - lq, prec=prec)
+                               nk=nk, causal_offset=lk - lq, prec=prec,
+                               bq=bq, bk=bk)
     with _x32_mode():
-        out, lse = _call_fwd(kernel, q3, k3, v3, bh, nq, nk, lq, d,
-                             q.dtype, interpret)
-    return out.reshape(b, h, lq, d), lse
-
-
-def _call_fwd(kernel, q3, k3, v3, bh, nq, nk, lq, d, dtype, interpret):
-    from jax.experimental import pallas as pl
-    from jax.experimental.pallas import tpu as pltpu
-
-    out, lse = pl.pallas_call(
-        kernel,
-        grid=(bh, nq, nk),
-        in_specs=[
-            pl.BlockSpec((1, BLOCK_Q, d), lambda bh_, qi, ki: (bh_, qi, 0)),
-            pl.BlockSpec((1, BLOCK_K, d), lambda bh_, qi, ki: (bh_, ki, 0)),
-            pl.BlockSpec((1, BLOCK_K, d), lambda bh_, qi, ki: (bh_, ki, 0)),
-        ],
-        out_specs=[
-            pl.BlockSpec((1, BLOCK_Q, d), lambda bh_, qi, ki: (bh_, qi, 0)),
-            pl.BlockSpec((1, 1, 8, BLOCK_Q),
-                         lambda bh_, qi, ki: (bh_, qi, 0, 0)),
-        ],
-        out_shape=[
-            jax.ShapeDtypeStruct((bh, lq, d), dtype),
-            jax.ShapeDtypeStruct((bh, nq, 8, BLOCK_Q), jnp.float32),
-        ],
-        scratch_shapes=[
-            pltpu.VMEM((BLOCK_Q, d), jnp.float32),
-            pltpu.VMEM((BLOCK_Q, 128), jnp.float32),
-            pltpu.VMEM((BLOCK_Q, 128), jnp.float32),
-        ],
-        interpret=interpret,
-    )(q3, k3, v3)
+        out, lse = pl.pallas_call(
+            kernel,
+            grid=(bh, nq, nk),
+            in_specs=[
+                _tile_spec(layout, h, bq, d, 0),
+                _tile_spec(layout, h, bk, d, 1),
+                _tile_spec(layout, h, bk, d, 1),
+            ],
+            out_specs=[
+                _tile_spec(layout, h, bq, d, 0),
+                pl.BlockSpec((None, None, 8, bq),
+                             lambda bh_, qi, ki: (bh_, qi, 0, 0)),
+            ],
+            out_shape=[
+                o_shape,
+                jax.ShapeDtypeStruct((bh, nq, 8, bq), jnp.float32),
+            ],
+            scratch_shapes=[
+                pltpu.VMEM((bq, d), jnp.float32),
+                pltpu.VMEM((bq, 128), jnp.float32),
+                pltpu.VMEM((bq, 128), jnp.float32),
+            ],
+            interpret=interpret,
+        )(q, k, v)
+    if layout == "bhld":
+        out = out.reshape(b, h, lq, d)
     return out, lse
 
 
 def _bwd_dkdv_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref,
                      dk_ref, dv_ref, dk_acc, dv_acc, *,
-                     scale, causal, nq, causal_offset, prec):
+                     scale, causal, nq, causal_offset, prec, bq, bk):
     """dK/dV for one K block; Q blocks stream on the innermost grid dim.
 
     All score math is done TRANSPOSED — s_T = (BK, BQ) — so the per-row
@@ -274,20 +329,20 @@ def _bwd_dkdv_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref,
         dv_acc[:] = jnp.zeros_like(dv_acc)
 
     def compute():
-        q = q_ref[0].astype(jnp.float32)                   # (BQ, D)
-        k = k_ref[0].astype(jnp.float32)                   # (BK, D)
-        v = v_ref[0].astype(jnp.float32)
-        do = do_ref[0].astype(jnp.float32)                 # (BQ, D)
-        lse = lse_ref[0, 0][0:1, :]                         # (1, BQ)
-        delta = delta_ref[0, 0][0:1, :]                     # (1, BQ)
+        q = q_ref[...].astype(jnp.float32)                 # (BQ, D)
+        k = k_ref[...].astype(jnp.float32)                 # (BK, D)
+        v = v_ref[...].astype(jnp.float32)
+        do = do_ref[...].astype(jnp.float32)               # (BQ, D)
+        lse = lse_ref[0:1, :]                               # (1, BQ)
+        delta = delta_ref[0:1, :]                           # (1, BQ)
         s_t = jax.lax.dot_general(
             k, q, (((1,), (1,)), ((), ())),
             preferred_element_type=jnp.float32, precision=prec) * scale
         if causal:
-            q_pos = causal_offset + qi * BLOCK_Q + jax.lax.broadcasted_iota(
-                jnp.int32, (BLOCK_K, BLOCK_Q), 1)
-            k_pos = ki * BLOCK_K + jax.lax.broadcasted_iota(
-                jnp.int32, (BLOCK_K, BLOCK_Q), 0)
+            q_pos = causal_offset + qi * bq + jax.lax.broadcasted_iota(
+                jnp.int32, (bk, bq), 1)
+            k_pos = ki * bk + jax.lax.broadcasted_iota(
+                jnp.int32, (bk, bq), 0)
             s_t = jnp.where(k_pos <= q_pos, s_t, _NEG_INF32)
         p_t = jnp.exp(s_t - lse)                            # (BK, BQ)
         dv_acc[:] += jnp.dot(p_t, do, preferred_element_type=jnp.float32,
@@ -300,7 +355,7 @@ def _bwd_dkdv_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref,
                              precision=prec)
 
     if causal:
-        @pl.when(ki * BLOCK_K <= causal_offset + qi * BLOCK_Q + BLOCK_Q - 1)
+        @pl.when(ki * bk <= causal_offset + qi * bq + bq - 1)
         def _():
             compute()
     else:
@@ -308,12 +363,13 @@ def _bwd_dkdv_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref,
 
     @pl.when(qi == nq - 1)
     def _final():
-        dk_ref[0] = dk_acc[:].astype(dk_ref.dtype)
-        dv_ref[0] = dv_acc[:].astype(dv_ref.dtype)
+        dk_ref[...] = dk_acc[:].astype(dk_ref.dtype)
+        dv_ref[...] = dv_acc[:].astype(dv_ref.dtype)
 
 
 def _bwd_dq_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref,
-                   dq_ref, dq_acc, *, scale, causal, nk, causal_offset, prec):
+                   dq_ref, dq_acc, *, scale, causal, nk, causal_offset, prec,
+                   bq, bk):
     """dQ for one Q block; K blocks stream on the innermost grid dim."""
     from jax.experimental import pallas as pl
 
@@ -325,20 +381,20 @@ def _bwd_dq_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref,
         dq_acc[:] = jnp.zeros_like(dq_acc)
 
     def compute():
-        q = q_ref[0].astype(jnp.float32)
-        k = k_ref[0].astype(jnp.float32)
-        v = v_ref[0].astype(jnp.float32)
-        do = do_ref[0].astype(jnp.float32)
-        lse = lse_ref[0, 0][0:1, :]                         # (1, BQ)
-        delta = delta_ref[0, 0][0:1, :]                     # (1, BQ)
+        q = q_ref[...].astype(jnp.float32)
+        k = k_ref[...].astype(jnp.float32)
+        v = v_ref[...].astype(jnp.float32)
+        do = do_ref[...].astype(jnp.float32)
+        lse = lse_ref[0:1, :]                               # (1, BQ)
+        delta = delta_ref[0:1, :]                           # (1, BQ)
         s_t = jax.lax.dot_general(
             k, q, (((1,), (1,)), ((), ())),
             preferred_element_type=jnp.float32, precision=prec) * scale
         if causal:
-            q_pos = causal_offset + qi * BLOCK_Q + jax.lax.broadcasted_iota(
-                jnp.int32, (BLOCK_K, BLOCK_Q), 1)
-            k_pos = ki * BLOCK_K + jax.lax.broadcasted_iota(
-                jnp.int32, (BLOCK_K, BLOCK_Q), 0)
+            q_pos = causal_offset + qi * bq + jax.lax.broadcasted_iota(
+                jnp.int32, (bk, bq), 1)
+            k_pos = ki * bk + jax.lax.broadcasted_iota(
+                jnp.int32, (bk, bq), 0)
             s_t = jnp.where(k_pos <= q_pos, s_t, _NEG_INF32)
         p_t = jnp.exp(s_t - lse)
         dp_t = jax.lax.dot_general(
@@ -351,7 +407,7 @@ def _bwd_dq_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref,
             preferred_element_type=jnp.float32, precision=prec)  # (BQ, D)
 
     if causal:
-        @pl.when(ki * BLOCK_K <= causal_offset + qi * BLOCK_Q + BLOCK_Q - 1)
+        @pl.when(ki * bk <= causal_offset + qi * bq + bq - 1)
         def _():
             compute()
     else:
@@ -359,110 +415,130 @@ def _bwd_dq_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref,
 
     @pl.when(ki == nk - 1)
     def _final():
-        dq_ref[0] = dq_acc[:].astype(dq_ref.dtype)
+        dq_ref[...] = dq_acc[:].astype(dq_ref.dtype)
 
 
-def _flash_bwd_pallas(q, k, v, o, lse, g, scale, causal, interpret=False):
+def _flash_bwd_pallas(q, k, v, o, lse, g, scale, causal, interpret=False,
+                      layout="bhld"):
     from jax.experimental import pallas as pl
     from jax.experimental.pallas import tpu as pltpu
 
-    b, h, lq, d = q.shape
-    lk = k.shape[2]
+    b, h, lq, d = _dims(q, layout)
+    lk = _dims(k, layout)[2]
     bh = b * h
-    q3 = q.reshape(bh, lq, d)
-    k3 = k.reshape(bh, lk, d)
-    v3 = v.reshape(bh, lk, d)
-    do3 = g.reshape(bh, lq, d)
-    nq, nk = lq // BLOCK_Q, lk // BLOCK_K
+    if layout == "bhld":
+        q = q.reshape(bh, lq, d)
+        k = k.reshape(bh, lk, d)
+        v = v.reshape(bh, lk, d)
+        do = g.reshape(bh, lq, d)
+        do_f32 = do.astype(jnp.float32)
+        o_f32 = o.reshape(bh, lq, d).astype(jnp.float32)
+        dq_shape = jax.ShapeDtypeStruct((bh, lq, d), q.dtype)
+        dk_shape = jax.ShapeDtypeStruct((bh, lk, d), k.dtype)
+        dv_shape = jax.ShapeDtypeStruct((bh, lk, d), v.dtype)
+    else:
+        do = g
+        # (B, L, H, D) -> (BH, L) rowsums for delta
+        do_f32 = g.astype(jnp.float32).transpose(0, 2, 1, 3).reshape(
+            bh, lq, d)
+        o_f32 = o.astype(jnp.float32).transpose(0, 2, 1, 3).reshape(
+            bh, lq, d)
+        dq_shape = jax.ShapeDtypeStruct((b, lq, h, d), q.dtype)
+        dk_shape = jax.ShapeDtypeStruct((b, lk, h, d), k.dtype)
+        dv_shape = jax.ShapeDtypeStruct((b, lk, h, d), v.dtype)
+    bq, bk = _block_sizes(lq, lk)
+    nq, nk = lq // bq, lk // bk
     # delta_i = rowsum(dO_i * O_i) — cheap, fused by XLA outside the
     # kernel; stored in the same sublane-padded layout as lse
-    delta = jnp.sum(do3.astype(jnp.float32)
-                    * o.reshape(bh, lq, d).astype(jnp.float32), axis=-1)
-    delta = jnp.broadcast_to(delta.reshape(bh, nq, 1, BLOCK_Q),
-                             (bh, nq, 8, BLOCK_Q))
+    delta = jnp.sum(do_f32 * o_f32, axis=-1)
+    delta = jnp.broadcast_to(delta.reshape(bh, nq, 1, bq),
+                             (bh, nq, 8, bq))
     offset = lk - lq
+    prec = _prec_for(q.dtype)
 
-    q_spec = pl.BlockSpec((1, BLOCK_Q, d), lambda bh_, i, j: (bh_, j, 0))
-    row_spec = pl.BlockSpec((1, 1, 8, BLOCK_Q),
-                            lambda bh_, i, j: (bh_, j, 0, 0))
+    # grid (bh, nk, nq): q/do/lse/delta stream on the inner (j) dim, so
+    # their tiles index by grid dim 2 (seq_index=1) and K/V by dim 1
+    q_spec_j = _tile_spec(layout, h, bq, d, 1)
+    k_spec_i = _tile_spec(layout, h, bk, d, 0)
+    row_spec_j = pl.BlockSpec((None, None, 8, bq),
+                              lambda bh_, i, j: (bh_, j, 0, 0))
     with _x32_mode():
-        dkdv = pl.pallas_call(
+        dk3, dv3 = pl.pallas_call(
             functools.partial(_bwd_dkdv_kernel, scale=scale, causal=causal,
                               nq=nq, causal_offset=offset,
-                              prec=_prec_for(q.dtype)),
+                              prec=prec, bq=bq, bk=bk),
             grid=(bh, nk, nq),
-            in_specs=[
-                q_spec,                                          # q by qi=j
-                pl.BlockSpec((1, BLOCK_K, d), lambda bh_, i, j: (bh_, i, 0)),
-                pl.BlockSpec((1, BLOCK_K, d), lambda bh_, i, j: (bh_, i, 0)),
-                q_spec,                                          # do by qi=j
-                row_spec,                                        # lse
-                row_spec,                                        # delta
-            ],
-            out_specs=[
-                pl.BlockSpec((1, BLOCK_K, d), lambda bh_, i, j: (bh_, i, 0)),
-                pl.BlockSpec((1, BLOCK_K, d), lambda bh_, i, j: (bh_, i, 0)),
-            ],
-            out_shape=[
-                jax.ShapeDtypeStruct((bh, lk, d), k.dtype),
-                jax.ShapeDtypeStruct((bh, lk, d), v.dtype),
-            ],
+            in_specs=[q_spec_j, k_spec_i, k_spec_i, q_spec_j,
+                      row_spec_j, row_spec_j],
+            out_specs=[k_spec_i, k_spec_i],
+            out_shape=[dk_shape, dv_shape],
             scratch_shapes=[
-                pltpu.VMEM((BLOCK_K, d), jnp.float32),
-                pltpu.VMEM((BLOCK_K, d), jnp.float32),
+                pltpu.VMEM((bk, d), jnp.float32),
+                pltpu.VMEM((bk, d), jnp.float32),
             ],
             interpret=interpret,
-        )
-        dk3, dv3 = dkdv(q3, k3, v3, do3, lse, delta)
+        )(q, k, v, do, lse, delta)
 
         dq = pl.pallas_call(
             functools.partial(_bwd_dq_kernel, scale=scale, causal=causal,
                               nk=nk, causal_offset=offset,
-                              prec=_prec_for(q.dtype)),
+                              prec=prec, bq=bq, bk=bk),
             grid=(bh, nq, nk),
             in_specs=[
-                pl.BlockSpec((1, BLOCK_Q, d), lambda bh_, i, j: (bh_, i, 0)),
-                pl.BlockSpec((1, BLOCK_K, d), lambda bh_, i, j: (bh_, j, 0)),
-                pl.BlockSpec((1, BLOCK_K, d), lambda bh_, i, j: (bh_, j, 0)),
-                pl.BlockSpec((1, BLOCK_Q, d), lambda bh_, i, j: (bh_, i, 0)),
-                pl.BlockSpec((1, 1, 8, BLOCK_Q),
+                _tile_spec(layout, h, bq, d, 0),
+                _tile_spec(layout, h, bk, d, 1),
+                _tile_spec(layout, h, bk, d, 1),
+                _tile_spec(layout, h, bq, d, 0),
+                pl.BlockSpec((None, None, 8, bq),
                              lambda bh_, i, j: (bh_, i, 0, 0)),
-                pl.BlockSpec((1, 1, 8, BLOCK_Q),
+                pl.BlockSpec((None, None, 8, bq),
                              lambda bh_, i, j: (bh_, i, 0, 0)),
             ],
-            out_specs=pl.BlockSpec((1, BLOCK_Q, d),
-                                   lambda bh_, i, j: (bh_, i, 0)),
-            out_shape=jax.ShapeDtypeStruct((bh, lq, d), q.dtype),
-            scratch_shapes=[pltpu.VMEM((BLOCK_Q, d), jnp.float32)],
+            out_specs=_tile_spec(layout, h, bq, d, 0),
+            out_shape=dq_shape,
+            scratch_shapes=[pltpu.VMEM((bq, d), jnp.float32)],
             interpret=interpret,
-        )(q3, k3, v3, do3, lse, delta)
-    return (dq.reshape(b, h, lq, d), dk3.reshape(b, h, lk, d),
-            dv3.reshape(b, h, lk, d))
+        )(q, k, v, do, lse, delta)
+    if layout == "bhld":
+        return (dq.reshape(b, h, lq, d), dk3.reshape(b, h, lk, d),
+                dv3.reshape(b, h, lk, d))
+    return dq, dk3, dv3
 
 
-@functools.partial(jax.custom_vjp, nondiff_argnums=(3, 4, 5))
-def _flash(q, k, v, scale, causal, interpret):
-    return _flash_fwd_pallas(q, k, v, scale, causal, interpret)[0]
+@functools.partial(jax.custom_vjp, nondiff_argnums=(3, 4, 5, 6))
+def _flash(q, k, v, scale, causal, interpret, layout):
+    return _flash_fwd_pallas(q, k, v, scale, causal, interpret, layout)[0]
 
 
-def _flash_fwd(q, k, v, scale, causal, interpret):
-    o, lse = _flash_fwd_pallas(q, k, v, scale, causal, interpret)
+def _flash_fwd(q, k, v, scale, causal, interpret, layout):
+    o, lse = _flash_fwd_pallas(q, k, v, scale, causal, interpret, layout)
     return o, (q, k, v, o, lse)
 
 
-def _flash_bwd(scale, causal, interpret, res, g):
+def _flash_bwd(scale, causal, interpret, layout, res, g):
     # Pallas dq/dk/dv kernels recomputing p from the saved logsumexp —
     # training-mode attention runs on the MXU in BOTH directions (round-1
     # weakness #5: the old bwd re-differentiated the XLA scan).
     q, k, v, o, lse = res
-    return _flash_bwd_pallas(q, k, v, o, lse, g, scale, causal, interpret)
+    return _flash_bwd_pallas(q, k, v, o, lse, g, scale, causal, interpret,
+                             layout)
 
 
 _flash.defvjp(_flash_fwd, _flash_bwd)
 
 
-def flash_attention(q, k, v, scale=None, causal=False, interpret=False):
-    """Pallas flash attention (differentiable)."""
+def flash_attention(q, k, v, scale=None, causal=False, interpret=False,
+                    layout="bhld"):
+    """Pallas flash attention (differentiable).
+
+    ``layout``: "bhld" (B, H, L, D) — the classic attention layout — or
+    "blhd" (B, L, H, D), the projection-native layout. blhd currently
+    lowers only in interpret mode (tests / CPU oracle): Mosaic rejects the
+    squeezed-H sublane tile — groundwork for a (B, L, H*D) 128-aligned
+    view once a head_dim % 128 model needs it. On-hardware callers go
+    through ``sdp_attention``, which gates on ``flash_supported``.
+    """
     if scale is None:
         scale = 1.0 / math.sqrt(q.shape[-1])
-    return _flash(q, k, v, float(scale), bool(causal), bool(interpret))
+    return _flash(q, k, v, float(scale), bool(causal), bool(interpret),
+                  str(layout))

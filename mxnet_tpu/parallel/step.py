@@ -65,9 +65,14 @@ class TrainStep:
     def __init__(self, net, loss, optimizer, mesh=None,
                  rules: Optional[ShardingRules] = None,
                  batch_axis: Sequence[str] = ("dp",), seq_axis=None,
-                 optimizer_params=None):
+                 optimizer_params=None, loss_only=False):
         self.net = net
         self.loss = loss
+        # loss_only: don't return model outputs from the step — for nets
+        # with huge heads (e.g. an MLM decoder's (B, L, vocab) logits) the
+        # returned buffer otherwise must be materialized in HBM and shipped
+        # out of the executable every step
+        self.loss_only = bool(loss_only)
         if not isinstance(optimizer, opt_mod.Optimizer):
             optimizer = opt_mod.create(optimizer, **(optimizer_params or {}))
         self.optimizer = optimizer
@@ -167,6 +172,7 @@ class TrainStep:
         ctx = self._params[0].data().context if self._params else current_context()
         param_arrays = [p.data() for p in self._params]
         pure, cell = make_pure_fn(self.net, param_arrays, ctx, training)
+        loss_only = self.loss_only
         trainable = list(self._trainable)
         n_data = len(data_tuple)
         optimizer = self.optimizer
@@ -222,6 +228,8 @@ class TrainStep:
                         for idx, nd_leaf in live:
                             new_state_vals[idx] = nd_leaf.data
                         pos = cursor
+            if loss_only:
+                outs = ()
             return (tuple(new_params), tuple(new_state_vals), loss_val,
                     tuple(outs), tuple(aux))
 
@@ -242,7 +250,8 @@ class TrainStep:
             out_shardings=(param_sh, state_sh, rep, None, None),
             donate_argnums=(0, 1),
         )
-        return {"jitted": jitted, "cell": cell, "batch_sh": batch_sh}
+        return {"jitted": jitted, "cell": cell, "batch_sh": batch_sh,
+                "loss_only": loss_only}
 
     def stage_batch(self, data, label=()):
         """Place host batches on the mesh with this step's input sharding.
@@ -309,6 +318,11 @@ class TrainStep:
         for arr, v in zip(cell["aux_arrays"], aux):
             arr._set_data(v)
         ctx = self._params[0].data().context if self._params else current_context()
+        # read the flag the executable was traced with, not the live
+        # attribute — toggling self.loss_only between steps must not desync
+        # the host return path from the compiled output arity
+        if entry["loss_only"]:
+            return NDArray(data=loss_val, ctx=ctx), None
         out_nd = [NDArray(data=v, ctx=ctx) for v in outs]
         out_tree = nested_unflatten_nd(cell["treedef"], out_nd)
         return NDArray(data=loss_val, ctx=ctx), out_tree

@@ -138,3 +138,24 @@ class TestPallasKernel:
             assert np.isfinite(a).all(), f"d{name} has non-finite values"
             np.testing.assert_allclose(a, np.asarray(b), rtol=0.1, atol=0.1,
                                        err_msg=f"d{name} bf16")
+
+    @pytest.mark.parametrize("causal", [False, True])
+    def test_blhd_layout_matches_bhld(self, causal):
+        """blhd (projection-native, transpose-free) must equal bhld in both
+        directions — fwd values and dq/dk/dv."""
+        q, k, v = _qkv(lq=256, lk=256)
+        g = jnp.asarray(np.random.RandomState(3)
+                        .randn(*q.shape).astype("float32"))
+        t = lambda x: jnp.transpose(x, (0, 2, 1, 3))
+
+        o_ref, vjp_ref = jax.vjp(lambda a, b, c: flash_attention(
+            a, b, c, causal=causal, interpret=True), q, k, v)
+        o_new, vjp_new = jax.vjp(lambda a, b, c: flash_attention(
+            a, b, c, causal=causal, interpret=True, layout="blhd"),
+            t(q), t(k), t(v))
+        np.testing.assert_allclose(np.asarray(t(o_new)), np.asarray(o_ref),
+                                   rtol=1e-5, atol=1e-5)
+        for a, b, name in zip(vjp_new(t(g)), vjp_ref(g), "qkv"):
+            np.testing.assert_allclose(np.asarray(t(a)), np.asarray(b),
+                                       rtol=1e-5, atol=1e-5,
+                                       err_msg=f"d{name} causal={causal}")
