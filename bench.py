@@ -68,12 +68,39 @@ def main():
     dt = time.perf_counter() - t0
 
     img_s = batch * steps / dt
-    print(json.dumps({
+    record = {
         "metric": "resnet50_train_images_per_sec_per_chip",
         "value": round(img_s, 2),
         "unit": "images/sec",
         "vs_baseline": round(img_s / BASELINE_IMG_S, 4),
-    }))
+    }
+    record.update(_bert_extra())
+    print(json.dumps(record))
+
+
+def _bert_extra():
+    """Secondary headline: BERT-base seq-512 training (bench_bert.py), as
+    extra keys so the driver's one-JSON-line contract holds."""
+    import json as _json
+    import os
+    import subprocess
+
+    if os.environ.get("BENCH_SKIP_BERT"):
+        return {}
+    try:
+        out = subprocess.run(
+            [sys.executable, os.path.join(os.path.dirname(
+                os.path.abspath(__file__)), "bench_bert.py")],
+            capture_output=True, text=True, timeout=1200)
+        line = out.stdout.strip().splitlines()[-1]
+        rec = _json.loads(line)
+        return {
+            "bert_samples_per_sec_per_chip": rec["value"],
+            "bert_vs_baseline": rec["vs_baseline"],
+            "bert_mfu": rec.get("mfu"),
+        }
+    except Exception:
+        return {}
 
 
 if __name__ == "__main__":
