@@ -93,7 +93,10 @@ class Context:
 
         dt = self.device_type
         if dt in ("cpu", "cpu_pinned", "cpu_shared"):
-            devs = jax.devices("cpu")
+            # THIS process's devices: in a multi-controller job (dist_sync)
+            # cpu(i)/tpu(i) is rank-local, like the reference's per-worker
+            # gpu(i) — other ranks' devices are not addressable anyway
+            devs = jax.local_devices(backend="cpu")
         elif dt == "tpu":
             devs = _accelerator_devices("tpu")
         elif dt == "gpu":
@@ -117,11 +120,12 @@ class Context:
 
 
 def _accelerator_devices(kind: Optional[str]):
-    """Non-CPU jax devices, most-specific first."""
+    """Non-CPU jax devices of THIS process, most-specific first (rank-local
+    numbering in multi-controller jobs — see Context.jax_device)."""
     import jax
 
     try:
-        all_devs = jax.devices()
+        all_devs = jax.local_devices()
     except RuntimeError:
         return []
     accel = [d for d in all_devs if d.platform != "cpu"]
@@ -129,8 +133,8 @@ def _accelerator_devices(kind: Optional[str]):
         tpus = [d for d in accel if "tpu" in d.platform.lower() or "axon" in d.platform.lower()]
         # Under forced-CPU test runs there is no TPU; fall back to CPU
         # devices so `mx.tpu()` code paths stay testable (oracle device).
-        return tpus or accel or jax.devices("cpu")
-    return accel or jax.devices("cpu")
+        return tpus or accel or jax.local_devices(backend="cpu")
+    return accel or jax.local_devices(backend="cpu")
 
 
 def cpu(device_id: int = 0) -> Context:

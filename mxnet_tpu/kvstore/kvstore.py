@@ -130,20 +130,32 @@ class KVStoreLocal(KVStore):
             return
         key = self._canon(key)
         self._check_init(key)
-        vals = value if isinstance(value, (list, tuple)) else [value]
+        vals = list(value) if isinstance(value, (list, tuple)) else [value]
+        agg = self._aggregate(vals)
+        if self._updater is not None:
+            # server-side optimizer path (update_on_kvstore=True). The key
+            # itself indexes updater state: ints and strings are both
+            # stable across processes/restarts (hash() is neither).
+            self._updater(key, agg, self._store[key])
+        else:
+            self._store_reduced(key, agg)
+
+    def _aggregate(self, vals: List[NDArray]) -> NDArray:
+        """Reduce per-device copies to one value (subclass hook)."""
         agg = vals[0]
         if len(vals) > 1:
             acc = vals[0].copyto(vals[0].context)
             for v in vals[1:]:
                 acc += v.as_in_context(acc.context)
             agg = acc
-        if self._updater is not None:
-            # server-side optimizer path (update_on_kvstore=True)
-            self._updater(key if isinstance(key, int) else hash(key),
-                          agg, self._store[key])
-        else:
-            self._store[key]._set_data(agg.as_in_context(
-                self._store[key].context).data)
+        return agg
+
+    def _store_reduced(self, key, agg: NDArray):
+        # snapshot the (immutable) payload — never alias the caller's
+        # NDArray, which it may keep mutating in place
+        dst = self._store[key]
+        dst._set_data(agg.as_in_context(dst.context).data
+                      if dst.context != agg.context else agg.data)
 
     def pull(self, key, out, priority=0, ignore_sparse=True):
         if isinstance(key, (list, tuple)):
@@ -163,20 +175,27 @@ class KVStoreTPUSync(KVStoreLocal):
     """Collective data-parallel sync over the device mesh.
 
     Reference roles replaced: ``kvstore_nccl.h::KVStoreNCCL`` (intra-node
-    collectives) and ``kvstore_dist.h`` sync mode (multi-host). Push/pull on
-    sharded arrays lower to ONE XLA allreduce riding ICI; on replicated
-    single-device arrays it degenerates to the local sum. The real
-    multi-chip path is exercised through ``mxnet_tpu.parallel`` (pjit'd
-    train step with psum) — this object keeps the kvstore API contract so
-    Module/Trainer code runs unchanged.
+    collectives) and ``kvstore_dist.h`` sync mode (multi-host). A push of
+    per-device gradient copies lowers to ONE compiled XLA all-reduce
+    (``shard_map`` + ``lax.psum`` over a device mesh). Single-process: the
+    mesh is the devices holding the copies (psum rides ICI). Multi-process
+    (``dist_sync`` after the ``jax.distributed`` bootstrap): the mesh is
+    ALL processes' devices — each process contributes its local copies and
+    the psum crosses hosts over DCN. The reduced value is a replicated
+    ``jax.Array``, so ``pull`` into any participating device's context is
+    a local view, not a transfer.
     """
 
     def __init__(self, type_name="tpu_sync"):
         super().__init__(type_name)
+        if type_name in ("dist_sync", "dist_device_sync"):
+            _maybe_init_distributed()
         self._mesh = None
+        self._reducers: Dict = {}
 
     def attach_mesh(self, mesh):
-        """Associate a parallel.Mesh; cross-host reduces use its axis."""
+        """Pin the reduction mesh (default: pushed copies' own devices in
+        single-process mode, all global devices in multi-process mode)."""
         self._mesh = mesh
 
     @property
@@ -191,7 +210,144 @@ class KVStoreTPUSync(KVStoreLocal):
 
         return jax.process_index()
 
-    def push(self, key, value, priority=0):
-        # per-process aggregation is the local sum; cross-device reduction
-        # happens in-graph via psum when arrays are mesh-sharded
-        super().push(key, value, priority)
+    # -- the collective ------------------------------------------------
+    def _reduce_mesh(self, vals):
+        """The mesh a push's psum runs over, and the devices expected to
+        contribute one copy each from THIS process."""
+        import jax
+        import numpy as np
+        from jax.sharding import Mesh
+
+        if self._mesh is not None:
+            mesh = self._mesh
+            local = [d for d in mesh.devices.flat
+                     if d.process_index == jax.process_index()]
+            return mesh, local
+        if jax.process_count() > 1:
+            devs = jax.devices()          # same order on every process
+            return Mesh(np.array(devs), ("kv",)), jax.local_devices()
+        devs = [next(iter(v.data.devices())) for v in vals]
+        return Mesh(np.array(devs), ("kv",)), devs
+
+    def _reducer(self, mesh, ndev, shape, dtype):
+        """jit(shard_map(psum)) per (mesh, ndev, shape, dtype) — compiled
+        once, reused for every push of this signature (the reference
+        pre-creates one NCCL reduction per key; here the executable is the
+        bucket)."""
+        # Mesh hashes by devices+axes, so equal meshes share the entry
+        sig = (mesh, ndev, tuple(shape), str(dtype))
+        fn = self._reducers.get(sig)
+        if fn is None:
+            import jax
+            from jax.sharding import PartitionSpec as P
+            from jax.experimental.shard_map import shard_map
+
+            def allreduce(stacked):
+                # each shard is one device's (1, *shape) copy; psum over
+                # the mesh and drop the stack dim
+                red = shard_map(
+                    lambda x: jax.lax.psum(x[0], "kv"), mesh=mesh,
+                    in_specs=P("kv"), out_specs=P())
+                return red(stacked)
+
+            fn = jax.jit(allreduce)
+            self._reducers[sig] = fn
+        return fn
+
+    def _collective_sum(self, vals: List[NDArray]):
+        """All-reduce per-device copies: one XLA psum over the mesh."""
+        import jax
+        from jax.sharding import NamedSharding, PartitionSpec as P
+
+        mesh, local_devs = self._reduce_mesh(vals)
+        ndev = mesh.devices.size
+        shape = tuple(vals[0].shape)
+        by_dev = {next(iter(v.data.devices())): v for v in vals}
+        if set(by_dev) != set(local_devs):
+            raise MXNetError(
+                f"tpu_sync push expects one gradient copy per local mesh "
+                f"device ({len(local_devs)}); got copies on "
+                f"{sorted(str(d) for d in by_dev)}")
+        # stack the copies as a global array sharded over 'kv' — each
+        # device contributes its local shard in place (across processes,
+        # make_array assembles the global view from addressable shards)
+        shards = [by_dev[d].data.reshape((1,) + shape) for d in local_devs]
+        stacked = jax.make_array_from_single_device_arrays(
+            (ndev,) + shape, NamedSharding(mesh, P("kv")), shards)
+        return self._reducer(mesh, ndev, shape, vals[0].dtype)(stacked)
+
+    def _aggregate(self, vals: List[NDArray]) -> NDArray:
+        import jax
+
+        multi = (jax.process_count() > 1 or self._mesh is not None
+                 or (len(vals) > 1 and len(
+                     {next(iter(v.data.devices())) for v in vals})
+                     == len(vals)))
+        if multi:
+            return NDArray(data=self._collective_sum(vals),
+                           ctx=vals[0].context)
+        return super()._aggregate(vals)
+
+    def _store_reduced(self, key, agg: NDArray):
+        data = agg.data
+        if hasattr(data, "sharding") and len(data.sharding.device_set) > 1:
+            # keep the replicated multi-device array: pulls become local
+            # per-device views
+            self._store[key]._set_data(data)
+        else:
+            super()._store_reduced(key, agg)
+
+    def pull(self, key, out, priority=0, ignore_sparse=True):
+        import jax
+
+        if isinstance(key, (list, tuple)):
+            for k, o in zip(key, out):
+                self.pull(k, o, priority)
+            return
+        key = self._canon(key)
+        self._check_init(key)
+        outs = out if isinstance(out, (list, tuple)) else [out]
+        src = self._store[key]
+        data = src.data
+        # replicated jax.Array: per-device shards are local views of the
+        # reduced value (works even when the array spans other processes'
+        # devices, where a whole-array device_put would be illegal)
+        shard_by_dev = {s.device: s.data
+                        for s in getattr(data, "addressable_shards", [])} \
+            if hasattr(data, "sharding") \
+            and len(data.sharding.device_set) > 1 else {}
+        for o in outs:
+            dev = o.context.jax_device()
+            if dev in shard_by_dev:
+                o._set_data(shard_by_dev[dev])
+            else:
+                o._set_data(src.as_in_context(o.context).data
+                            if o.context != src.context else data)
+
+
+def _maybe_init_distributed():
+    """Bootstrap ``jax.distributed`` for multi-host dist_sync.
+
+    Env contract (SURVEY.md §5.6.4): the reference launcher exports
+    ``DMLC_PS_ROOT_URI``/``DMLC_PS_ROOT_PORT``/``DMLC_NUM_WORKER``/
+    ``DMLC_WORKER_ID``; the TPU-native launcher (tools/launch.py) exports
+    the same names, mapped here onto the JAX coordination service. When
+    DMLC_* vars are set they win (they are passed explicitly, overriding
+    JAX's own env); a job already initialized by the user or a TPU-pod
+    runtime is left untouched.
+    """
+    import os
+
+    uri = os.environ.get("DMLC_PS_ROOT_URI")
+    n = int(os.environ.get("DMLC_NUM_WORKER", "1"))
+    if not uri or n <= 1:
+        return
+    import jax
+
+    if jax.distributed.is_initialized():
+        return  # coordination service already up (launcher or user)
+    port = os.environ.get("DMLC_PS_ROOT_PORT", "9091")
+    rank = int(os.environ.get("DMLC_WORKER_ID", "0"))
+    jax.distributed.initialize(
+        coordinator_address=f"{uri}:{port}",
+        num_processes=n, process_id=rank)

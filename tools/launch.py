@@ -1,0 +1,107 @@
+#!/usr/bin/env python
+"""Multi-process launcher (reference: ``tools/launch.py`` + dmlc_tracker).
+
+The reference starts a parameter-server tracker plus ssh/mpi workers. The
+TPU-native cluster is a multi-controller JAX job: every process runs the
+same program, rendezvouses through the coordination service, and XLA
+collectives ride ICI/DCN — so the launcher's whole job is to export the
+rendezvous env contract (SURVEY.md §5.6.4, the same DMLC_* names the
+reference's trainers already read) and fan out the command.
+
+Local mode (this machine, -n workers; smoke tests / 1 host with N chips):
+
+    python tools/launch.py -n 4 python train.py --kv-store dist_sync
+
+Multi-host mode (-H hostfile, one line per host; requires passwordless
+ssh, mirroring the reference's ssh launcher):
+
+    python tools/launch.py -n 8 -H hosts python train.py
+
+Workers read: DMLC_PS_ROOT_URI / DMLC_PS_ROOT_PORT (coordinator address),
+DMLC_NUM_WORKER, DMLC_WORKER_ID — ``mxnet_tpu.kvstore.create('dist_sync')``
+bootstraps ``jax.distributed`` from exactly these.
+"""
+from __future__ import annotations
+
+import argparse
+import os
+import shlex
+import signal
+import socket
+import subprocess
+import sys
+
+
+def _free_port():
+    with socket.socket() as s:
+        s.bind(("", 0))
+        return s.getsockname()[1]
+
+
+def main(argv=None):
+    ap = argparse.ArgumentParser(
+        description=__doc__, formatter_class=argparse.RawDescriptionHelpFormatter)
+    ap.add_argument("-n", "--num-workers", type=int, required=True,
+                    help="total worker processes")
+    ap.add_argument("-H", "--hostfile", default=None,
+                    help="one host per line; default: all workers local")
+    ap.add_argument("-p", "--port", type=int, default=0,
+                    help="coordinator port (default: pick a free one)")
+    ap.add_argument("--env", action="append", default=[],
+                    metavar="K=V", help="extra env to export to workers")
+    ap.add_argument("command", nargs=argparse.REMAINDER,
+                    help="worker command")
+    args = ap.parse_args(argv)
+    if not args.command:
+        ap.error("no worker command given")
+    cmd = args.command[1:] if args.command[0] == "--" else args.command
+
+    hosts = None
+    if args.hostfile:
+        with open(args.hostfile) as f:
+            hosts = [ln.strip() for ln in f if ln.strip()
+                     and not ln.startswith("#")]
+        if not hosts:
+            ap.error(f"hostfile {args.hostfile} is empty")
+
+    root_uri = hosts[0] if hosts else "127.0.0.1"
+    port = args.port or _free_port()
+    extra = dict(kv.split("=", 1) for kv in args.env)
+
+    procs = []
+    try:
+        for rank in range(args.num_workers):
+            env = dict(os.environ, **extra,
+                       DMLC_PS_ROOT_URI=root_uri,
+                       DMLC_PS_ROOT_PORT=str(port),
+                       DMLC_NUM_WORKER=str(args.num_workers),
+                       DMLC_WORKER_ID=str(rank),
+                       DMLC_ROLE="worker")
+            if hosts:
+                host = hosts[rank % len(hosts)]
+                exports = " ".join(
+                    f"{k}={shlex.quote(env[k])}"
+                    for k in ("DMLC_PS_ROOT_URI", "DMLC_PS_ROOT_PORT",
+                              "DMLC_NUM_WORKER", "DMLC_WORKER_ID",
+                              "DMLC_ROLE", *extra))
+                remote = f"cd {shlex.quote(os.getcwd())} && " \
+                         f"env {exports} {' '.join(map(shlex.quote, cmd))}"
+                p = subprocess.Popen(["ssh", "-o", "BatchMode=yes", host,
+                                      remote])
+            else:
+                p = subprocess.Popen(cmd, env=env)
+            procs.append(p)
+        rc = 0
+        for p in procs:
+            rc = p.wait() or rc
+        return rc
+    except KeyboardInterrupt:
+        for p in procs:
+            p.send_signal(signal.SIGTERM)
+        for p in procs:
+            p.wait()
+        return 130
+
+
+if __name__ == "__main__":
+    sys.exit(main())
