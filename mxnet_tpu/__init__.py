@@ -76,6 +76,8 @@ from . import tracing
 from . import profiler
 from . import callback
 from . import monitor
+from . import numpy as np
+from . import numpy_extension as npx
 
 from .ndarray import NDArray
 from .optimizer import Optimizer

@@ -218,7 +218,10 @@ def _run_backward(heads, head_grads, collect=None, write_attached=True):
     keep = {}  # keep NDArray objects alive so ids stay unique
 
     def add_grad(arr, g):
-        if g is None or (hasattr(g, "dtype") and g.dtype == "float0"):
+        from jax.dtypes import float0 as _float0
+
+        # float0 = jax's "no cotangent" marker (int/bool inputs)
+        if g is None or (hasattr(g, "dtype") and g.dtype == _float0):
             return
         k = id(arr)
         if k in acc:

@@ -1,0 +1,836 @@
+"""``mx.np`` — the NumPy-semantics frontend (reference:
+``python/mxnet/numpy/multiarray.py`` and siblings).
+
+The reference reimplements ~250 NumPy operators in C++ (``_np_*`` kernels)
+and wraps them behind an ``mx.np.ndarray`` with NumPy semantics. Here the
+compute layer IS NumPy-semantics already (jax.numpy), so the frontend is
+thin: every function routes the payloads through ``imperative_invoke`` with
+a jnp-backed op so autograd recording, context handling, ``out=``, and the
+naive-engine sync contract behave exactly like the ``mx.nd`` layer, and the
+result class is rebound to ``mx.np.ndarray`` (same object — tape linkage
+preserved).
+
+Scope notes vs the reference: bool-mask and fancy indexing go through the
+same tape-aware path as basic indexing; in-place arithmetic mutates
+through the NDArray write lens (views write through).
+"""
+from __future__ import annotations
+
+import builtins
+import math as _math
+
+import numpy as _onp
+
+from ..base import MXNetError
+from ..context import Context, current_context
+from ..ndarray.ndarray import NDArray, imperative_invoke, _LambdaOp
+
+__all__ = ["ndarray"]  # extended programmatically below
+
+
+def _jnp():
+    import jax.numpy as jnp
+
+    return jnp
+
+
+# ---------------------------------------------------------------------------
+# class
+# ---------------------------------------------------------------------------
+
+
+def _np_wrap(res):
+    """Rebind results to the np ndarray class IN PLACE (keeps tape nodes)."""
+    if isinstance(res, NDArray):
+        res.__class__ = ndarray
+        return res
+    if isinstance(res, (list, tuple)):
+        return type(res)(_np_wrap(r) for r in res)
+    return res
+
+
+def _invoke(name, fn, tensors, attrs=None, out=None):
+    return _np_wrap(imperative_invoke(_LambdaOp(fn, name), list(tensors),
+                                      dict(attrs or {}), out=out))
+
+
+class ndarray(NDArray):
+    """NumPy-semantics array (reference: ``numpy/multiarray.py::ndarray``).
+
+    Subclasses the imperative NDArray: device/context handling, autograd
+    (attach_grad/backward), views and serialization are shared; operators
+    and methods follow NumPy conventions (true division, operator dtype
+    promotion via jnp, tuple axes everywhere).
+    """
+
+    def as_nd_ndarray(self):
+        out = NDArray(data=self.data, ctx=self._ctx)
+        return out
+
+    def as_np_ndarray(self):
+        return self
+
+    # -- operators (all tape-aware via imperative_invoke) ---------------
+    def _np_binop(self, other, jname, reflected=False):
+        jnp = _jnp()
+        jf = getattr(jnp, jname)
+        fn = (lambda a, b: jf(b, a)) if reflected else jf
+        other_t = other if isinstance(other, NDArray) else other
+        return _invoke(f"np_{jname}", fn, [self, other_t])
+
+    def __add__(self, other):
+        return self._np_binop(other, "add")
+
+    __radd__ = __add__
+
+    def __sub__(self, other):
+        return self._np_binop(other, "subtract")
+
+    def __rsub__(self, other):
+        return self._np_binop(other, "subtract", reflected=True)
+
+    def __mul__(self, other):
+        return self._np_binop(other, "multiply")
+
+    __rmul__ = __mul__
+
+    def __truediv__(self, other):
+        return self._np_binop(other, "true_divide")
+
+    def __rtruediv__(self, other):
+        return self._np_binop(other, "true_divide", reflected=True)
+
+    def __floordiv__(self, other):
+        return self._np_binop(other, "floor_divide")
+
+    def __rfloordiv__(self, other):
+        return self._np_binop(other, "floor_divide", reflected=True)
+
+    def __mod__(self, other):
+        return self._np_binop(other, "mod")
+
+    def __rmod__(self, other):
+        return self._np_binop(other, "mod", reflected=True)
+
+    def __pow__(self, other):
+        return self._np_binop(other, "power")
+
+    def __rpow__(self, other):
+        return self._np_binop(other, "power", reflected=True)
+
+    def __matmul__(self, other):
+        return self._np_binop(other, "matmul")
+
+    def __rmatmul__(self, other):
+        return self._np_binop(other, "matmul", reflected=True)
+
+    def __neg__(self):
+        return _invoke("np_negative", _jnp().negative, [self])
+
+    def __abs__(self):
+        return _invoke("np_abs", _jnp().abs, [self])
+
+    def __eq__(self, other):
+        if other is None:
+            return False
+        return self._np_binop(other, "equal")
+
+    def __ne__(self, other):
+        if other is None:
+            return True
+        return self._np_binop(other, "not_equal")
+
+    def __lt__(self, other):
+        return self._np_binop(other, "less")
+
+    def __le__(self, other):
+        return self._np_binop(other, "less_equal")
+
+    def __gt__(self, other):
+        return self._np_binop(other, "greater")
+
+    def __ge__(self, other):
+        return self._np_binop(other, "greater_equal")
+
+    __hash__ = None  # numpy arrays are unhashable
+
+    def __iadd__(self, other):
+        NDArray.__iadd__(self, other)
+        return self
+
+    def __isub__(self, other):
+        NDArray.__isub__(self, other)
+        return self
+
+    # -- indexing -------------------------------------------------------
+    def __getitem__(self, key):
+        def _is_adv(k):
+            return isinstance(k, (NDArray, _onp.ndarray)) or (
+                isinstance(k, (list,)) and len(k) > 0
+                and not isinstance(k[0], slice))
+
+        advanced = _is_adv(key) or (isinstance(key, tuple)
+                                    and builtins.any(_is_adv(k)
+                                                     for k in key))
+        if not advanced:
+            try:
+                return _np_wrap(NDArray.__getitem__(self, key))
+            except (MXNetError, TypeError, IndexError, NotImplementedError):
+                pass
+        # advanced indexing (bool masks, fancy integer arrays): tape-aware
+        # functional gather. jax silently CASTS a bool index array to an
+        # int gather, so masks are converted to nonzero indices on host
+        # (they are concrete — this is the eager frontend).
+        def _idx(k):
+            if isinstance(k, NDArray):
+                k = _onp.asarray(k.data) if str(k.data.dtype) == "bool" \
+                    else k.data
+            if isinstance(k, _onp.ndarray) and k.dtype == _onp.bool_:
+                return _onp.nonzero(k)
+            return k
+
+        if isinstance(key, tuple):
+            parts = [_idx(k) for k in key]
+            if builtins.any(isinstance(p, tuple) for p in parts):
+                raise MXNetError(
+                    "boolean masks inside a tuple index are not supported; "
+                    "index with the mask alone or use np.where")
+            idx = tuple(parts)
+        else:
+            idx = _idx(key)
+        return _invoke("np_getitem", lambda d: d[idx], [self])
+
+    # -- ndarray protocol ------------------------------------------------
+    @property
+    def T(self):
+        return _invoke("np_transpose", _jnp().transpose, [self])
+
+    def transpose(self, *axes):
+        if len(axes) == 1 and isinstance(axes[0], (tuple, list)):
+            axes = tuple(axes[0])
+        axes = axes or None
+        return _invoke("np_transpose",
+                       lambda d: _jnp().transpose(d, axes), [self])
+
+    def reshape(self, *shape, **kwargs):
+        if len(shape) == 1 and isinstance(shape[0], (tuple, list)):
+            shape = tuple(shape[0])
+        order = kwargs.pop("order", "C")
+        if kwargs:
+            raise TypeError(f"unexpected kwargs {list(kwargs)}")
+        if order != "C":
+            raise MXNetError("only C-order reshape is supported")
+        return _invoke("np_reshape",
+                       lambda d: _jnp().reshape(d, shape), [self])
+
+    def astype(self, dtype, copy=True):
+        return _np_wrap(NDArray.astype(self, dtype))
+
+    def copy(self):
+        return _np_wrap(NDArray.copy(self))
+
+    def item(self, *args):
+        return self.asnumpy().item(*args)
+
+    def flatten(self, order="C"):
+        return self.reshape(-1)
+
+    def ravel(self):
+        return self.reshape(-1)
+
+    @property
+    def size(self):
+        return int(_onp.prod(self.shape)) if self.shape else 1
+
+    def _reduce(self, jname, axis=None, keepdims=False, **kw):
+        jf = getattr(_jnp(), jname)
+        return _invoke(
+            f"np_{jname}",
+            lambda d: jf(d, axis=axis, keepdims=keepdims, **kw), [self])
+
+    def sum(self, axis=None, dtype=None, keepdims=False, **kw):
+        out = self._reduce("sum", axis, keepdims)
+        return out.astype(dtype) if dtype is not None else out
+
+    def mean(self, axis=None, dtype=None, keepdims=False, **kw):
+        out = self._reduce("mean", axis, keepdims)
+        return out.astype(dtype) if dtype is not None else out
+
+    def prod(self, axis=None, keepdims=False, **kw):
+        return self._reduce("prod", axis, keepdims)
+
+    def max(self, axis=None, keepdims=False, **kw):
+        return self._reduce("max", axis, keepdims)
+
+    def min(self, axis=None, keepdims=False, **kw):
+        return self._reduce("min", axis, keepdims)
+
+    def std(self, axis=None, ddof=0, keepdims=False, **kw):
+        return self._reduce("std", axis, keepdims, ddof=ddof)
+
+    def var(self, axis=None, ddof=0, keepdims=False, **kw):
+        return self._reduce("var", axis, keepdims, ddof=ddof)
+
+    def argmax(self, axis=None):
+        return _invoke("np_argmax",
+                       lambda d: _jnp().argmax(d, axis=axis), [self])
+
+    def argmin(self, axis=None):
+        return _invoke("np_argmin",
+                       lambda d: _jnp().argmin(d, axis=axis), [self])
+
+    def all(self, axis=None, keepdims=False):
+        return self._reduce("all", axis, keepdims)
+
+    def any(self, axis=None, keepdims=False):
+        return self._reduce("any", axis, keepdims)
+
+    def cumsum(self, axis=None):
+        return _invoke("np_cumsum",
+                       lambda d: _jnp().cumsum(d, axis=axis), [self])
+
+    def squeeze(self, axis=None):
+        return _invoke("np_squeeze",
+                       lambda d: _jnp().squeeze(d, axis=axis), [self])
+
+    def clip(self, a_min=None, a_max=None):
+        return _invoke("np_clip",
+                       lambda d: _jnp().clip(d, a_min, a_max), [self])
+
+    def round(self, decimals=0):
+        return _invoke("np_round",
+                       lambda d: _jnp().round(d, decimals), [self])
+
+    def repeat(self, repeats, axis=None):
+        return _invoke("np_repeat",
+                       lambda d: _jnp().repeat(d, repeats, axis=axis), [self])
+
+    def take(self, indices, axis=None, mode="clip"):
+        idx = indices.data if isinstance(indices, NDArray) else indices
+        return _invoke("np_take",
+                       lambda d: _jnp().take(d, idx, axis=axis,
+                                             mode=mode), [self])
+
+    def dot(self, other):
+        return self._np_binop(other, "dot")
+
+    def tolist(self):
+        return self.asnumpy().tolist()
+
+
+# ---------------------------------------------------------------------------
+# module functions (generated: unary / binary / reduction families)
+# ---------------------------------------------------------------------------
+
+
+def _data(x):
+    return x.data if isinstance(x, NDArray) else x
+
+
+def array(obj, dtype=None, ctx=None):
+    import jax
+
+    ctx = ctx or current_context()
+    if isinstance(obj, NDArray):
+        src = obj.data
+        if dtype is not None:
+            src = src.astype(dtype)
+        return ndarray(data=src, ctx=ctx)
+    host = _onp.asarray(obj, dtype=dtype)
+    if host.dtype == _onp.float64 and dtype is None:
+        host = host.astype(_onp.float32)  # numpy-frontend default dtype
+    return ndarray(data=jax.device_put(host, ctx.jax_device()), ctx=ctx)
+
+
+def _creation(jname):
+    def f(shape=None, dtype=None, ctx=None, **kw):
+        import jax
+
+        ctx = ctx or current_context()
+        jf = getattr(_jnp(), jname)
+        with jax.default_device(ctx.jax_device()):
+            data = jf(shape, dtype=dtype or "float32", **kw)
+        return ndarray(data=data, ctx=ctx)
+
+    f.__name__ = jname
+    return f
+
+
+zeros = _creation("zeros")
+ones = _creation("ones")
+empty = _creation("empty")
+
+
+def full(shape, fill_value, dtype=None, ctx=None):
+    import jax
+
+    ctx = ctx or current_context()
+    with jax.default_device(ctx.jax_device()):
+        data = _jnp().full(shape, _data(fill_value), dtype=dtype)
+    return ndarray(data=data, ctx=ctx)
+
+
+def arange(start, stop=None, step=1, dtype=None, ctx=None):
+    import jax
+
+    ctx = ctx or current_context()
+    with jax.default_device(ctx.jax_device()):
+        data = _jnp().arange(start, stop, step, dtype=dtype or "float32")
+    return ndarray(data=data, ctx=ctx)
+
+
+def linspace(start, stop, num=50, endpoint=True, dtype=None, ctx=None, **kw):
+    import jax
+
+    ctx = ctx or current_context()
+    with jax.default_device(ctx.jax_device()):
+        data = _jnp().linspace(start, stop, num, endpoint=endpoint,
+                               dtype=dtype or "float32")
+    return ndarray(data=data, ctx=ctx)
+
+
+def eye(N, M=None, k=0, dtype=None, ctx=None):
+    import jax
+
+    ctx = ctx or current_context()
+    with jax.default_device(ctx.jax_device()):
+        data = _jnp().eye(N, M, k=k, dtype=dtype or "float32")
+    return ndarray(data=data, ctx=ctx)
+
+
+def zeros_like(a, dtype=None):
+    return _invoke("np_zeros_like",
+                   lambda d: _jnp().zeros_like(d, dtype=dtype), [a])
+
+
+def ones_like(a, dtype=None):
+    return _invoke("np_ones_like",
+                   lambda d: _jnp().ones_like(d, dtype=dtype), [a])
+
+
+def full_like(a, fill_value, dtype=None):
+    return _invoke("np_full_like",
+                   lambda d: _jnp().full_like(d, fill_value, dtype=dtype),
+                   [a])
+
+
+_UNARY = [
+    "negative", "absolute", "abs", "exp", "expm1", "log", "log1p", "log2",
+    "log10", "sqrt", "cbrt", "square", "reciprocal", "sign", "floor",
+    "ceil", "trunc", "rint", "sin", "cos", "tan", "arcsin", "arccos",
+    "arctan", "sinh", "cosh", "tanh", "arcsinh", "arccosh", "arctanh",
+    "degrees", "radians", "isnan", "isinf", "isfinite", "logical_not",
+]
+_BINARY = [
+    "add", "subtract", "multiply", "divide", "true_divide", "floor_divide",
+    "mod", "remainder", "power", "maximum", "minimum", "arctan2", "hypot",
+    "matmul", "dot", "equal", "not_equal", "less", "less_equal", "greater",
+    "greater_equal", "logical_and", "logical_or", "logical_xor", "copysign",
+    "fmod", "outer", "vdot", "inner",
+]
+_REDUCE = ["sum", "mean", "prod", "std", "var", "amax", "amin", "max",
+           "min", "all", "any", "median", "nanmean", "nansum"]
+
+
+def _def_unary(jname):
+    def f(x, out=None, **kw):
+        jf = getattr(_jnp(), jname)
+        return _invoke(f"np_{jname}", lambda d: jf(d, **kw), [x], out=out)
+
+    f.__name__ = jname
+    return f
+
+
+def _def_binary(jname):
+    def f(x1, x2, out=None, **kw):
+        jf = getattr(_jnp(), jname)
+        return _invoke(f"np_{jname}", lambda a, b: jf(a, b, **kw),
+                       [x1, x2], out=out)
+
+    f.__name__ = jname
+    return f
+
+
+def _def_reduce(jname):
+    def f(a, axis=None, dtype=None, keepdims=False, out=None, **kw):
+        jf = getattr(_jnp(), jname)
+        def body(d):
+            r = jf(d, axis=axis, keepdims=keepdims, **kw)
+            return r.astype(dtype) if dtype is not None else r
+        return _invoke(f"np_{jname}", body, [a], out=out)
+
+    f.__name__ = jname
+    return f
+
+
+_g = globals()
+for _n in _UNARY:
+    _g[_n] = _def_unary(_n)
+for _n in _BINARY:
+    _g[_n] = _def_binary(_n)
+for _n in _REDUCE:
+    _g[_n] = _def_reduce(_n)
+
+# numpy's `divide` is true division
+divide = _g["true_divide"]
+
+
+def argmax(a, axis=None, out=None):
+    return _invoke("np_argmax", lambda d: _jnp().argmax(d, axis=axis), [a],
+                   out=out)
+
+
+def argmin(a, axis=None, out=None):
+    return _invoke("np_argmin", lambda d: _jnp().argmin(d, axis=axis), [a],
+                   out=out)
+
+
+def argsort(a, axis=-1):
+    return _invoke("np_argsort", lambda d: _jnp().argsort(d, axis=axis), [a])
+
+
+def sort(a, axis=-1):
+    return _invoke("np_sort", lambda d: _jnp().sort(d, axis=axis), [a])
+
+
+def cumsum(a, axis=None, dtype=None):
+    return _invoke("np_cumsum",
+                   lambda d: _jnp().cumsum(d, axis=axis, dtype=dtype), [a])
+
+
+def clip(a, a_min, a_max, out=None):
+    return _invoke("np_clip", lambda d: _jnp().clip(d, a_min, a_max), [a],
+                   out=out)
+
+
+def where(condition, x=None, y=None):
+    if x is None and y is None:
+        # numpy contract: a TUPLE of per-dimension index arrays
+        return _invoke("np_where_cond",
+                       lambda c: tuple(_jnp().where(c)), [condition])
+    return _invoke("np_where", lambda c, a, b: _jnp().where(c, a, b),
+                   [condition, x, y])
+
+
+def reshape(a, newshape, order="C"):
+    return _np_wrap(a.reshape(newshape) if isinstance(a, ndarray)
+                    else array(a).reshape(newshape))
+
+
+def transpose(a, axes=None):
+    return _invoke("np_transpose",
+                   lambda d: _jnp().transpose(d, axes), [a])
+
+
+def swapaxes(a, axis1, axis2):
+    return _invoke("np_swapaxes",
+                   lambda d: _jnp().swapaxes(d, axis1, axis2), [a])
+
+
+def moveaxis(a, source, destination):
+    return _invoke("np_moveaxis",
+                   lambda d: _jnp().moveaxis(d, source, destination), [a])
+
+
+def expand_dims(a, axis):
+    return _invoke("np_expand_dims",
+                   lambda d: _jnp().expand_dims(d, axis), [a])
+
+
+def squeeze(a, axis=None):
+    return _invoke("np_squeeze", lambda d: _jnp().squeeze(d, axis), [a])
+
+
+def broadcast_to(a, shape):
+    return _invoke("np_broadcast_to",
+                   lambda d: _jnp().broadcast_to(d, shape), [a])
+
+
+def concatenate(seq, axis=0, out=None):
+    return _invoke("np_concatenate",
+                   lambda *ds: _jnp().concatenate(ds, axis=axis),
+                   list(seq), out=out)
+
+
+def stack(seq, axis=0, out=None):
+    return _invoke("np_stack", lambda *ds: _jnp().stack(ds, axis=axis),
+                   list(seq), out=out)
+
+
+def vstack(seq):
+    return _invoke("np_vstack", lambda *ds: _jnp().vstack(ds), list(seq))
+
+
+def hstack(seq):
+    return _invoke("np_hstack", lambda *ds: _jnp().hstack(ds), list(seq))
+
+
+def dstack(seq):
+    return _invoke("np_dstack", lambda *ds: _jnp().dstack(ds), list(seq))
+
+
+def split(ary, indices_or_sections, axis=0):
+    sec = indices_or_sections
+    if isinstance(sec, (list, tuple)):
+        sec = tuple(sec)
+    return _invoke("np_split",
+                   lambda d: tuple(_jnp().split(d, sec, axis=axis)), [ary])
+
+
+def array_split(ary, indices_or_sections, axis=0):
+    sec = indices_or_sections
+    if isinstance(sec, (list, tuple)):
+        sec = tuple(sec)
+    return _invoke("np_array_split",
+                   lambda d: tuple(_jnp().array_split(d, sec, axis=axis)),
+                   [ary])
+
+
+def tile(a, reps):
+    return _invoke("np_tile", lambda d: _jnp().tile(d, reps), [a])
+
+
+def repeat(a, repeats, axis=None):
+    return _invoke("np_repeat",
+                   lambda d: _jnp().repeat(d, repeats, axis=axis), [a])
+
+
+def flip(a, axis=None):
+    return _invoke("np_flip", lambda d: _jnp().flip(d, axis=axis), [a])
+
+
+def roll(a, shift, axis=None):
+    return _invoke("np_roll",
+                   lambda d: _jnp().roll(d, shift, axis=axis), [a])
+
+
+def take(a, indices, axis=None, mode="clip"):
+    idx = _data(indices)
+    return _invoke("np_take",
+                   lambda d: _jnp().take(d, idx, axis=axis, mode=mode), [a])
+
+
+def unique(a, return_index=False, return_inverse=False,
+           return_counts=False, axis=None):
+    res = _onp.unique(a.asnumpy() if isinstance(a, NDArray) else a,
+                      return_index=return_index,
+                      return_inverse=return_inverse,
+                      return_counts=return_counts, axis=axis)
+    if isinstance(res, tuple):
+        return tuple(array(r) for r in res)
+    return array(res)
+
+
+def tensordot(a, b, axes=2):
+    if isinstance(axes, (list, tuple)):
+        axes = tuple(tuple(ax) if isinstance(ax, (list, tuple)) else ax
+                     for ax in axes)
+    return _invoke("np_tensordot",
+                   lambda x, y: _jnp().tensordot(x, y, axes=axes), [a, b])
+
+
+def einsum(subscripts, *operands):
+    return _invoke("np_einsum",
+                   lambda *ds: _jnp().einsum(subscripts, *ds),
+                   list(operands))
+
+
+def meshgrid(*xi, indexing="xy"):
+    return _invoke("np_meshgrid",
+                   lambda *ds: tuple(_jnp().meshgrid(*ds,
+                                                     indexing=indexing)),
+                   list(xi))
+
+
+def atleast_1d(*arys):
+    def one(a):
+        a = a if isinstance(a, ndarray) else array(a)
+        return a.reshape(-1) if a.ndim == 0 else a
+
+    res = [one(a) for a in arys]
+    return res[0] if len(res) == 1 else res
+
+
+def may_share_memory(a, b):
+    return False
+
+
+def shape(a):
+    return tuple(a.shape)
+
+
+def ndim(a):
+    return len(a.shape) if hasattr(a, "shape") else _onp.ndim(a)
+
+
+# constants / dtypes (reference: numpy/__init__.py re-exports)
+pi = _math.pi
+e = _math.e
+inf = float("inf")
+nan = float("nan")
+newaxis = None
+euler_gamma = _onp.euler_gamma
+
+float16 = _onp.float16
+float32 = _onp.float32
+float64 = _onp.float64
+int8 = _onp.int8
+int16 = _onp.int16
+int32 = _onp.int32
+int64 = _onp.int64
+uint8 = _onp.uint8
+bool_ = _onp.bool_
+dtype = _onp.dtype
+
+
+# ---------------------------------------------------------------------------
+# linalg / random submodules
+# ---------------------------------------------------------------------------
+
+
+class _Linalg:
+    """mx.np.linalg (reference: numpy/linalg.py)."""
+
+    @staticmethod
+    def _u(name, *tensors, **kw):
+        import jax.numpy.linalg as jla
+
+        jf = getattr(jla, name)
+        return _invoke(f"np_linalg_{name}",
+                       lambda *ds: jf(*ds, **kw), list(tensors))
+
+    def norm(self, x, ord=None, axis=None, keepdims=False):
+        return self._u("norm", x, ord=ord, axis=axis, keepdims=keepdims)
+
+    def inv(self, a):
+        return self._u("inv", a)
+
+    def det(self, a):
+        return self._u("det", a)
+
+    def slogdet(self, a):
+        return self._u("slogdet", a)
+
+    def cholesky(self, a):
+        return self._u("cholesky", a)
+
+    def qr(self, a):
+        return self._u("qr", a)
+
+    def svd(self, a):
+        return self._u("svd", a)
+
+    def eigh(self, a):
+        return self._u("eigh", a)
+
+    def solve(self, a, b):
+        return self._u("solve", a, b)
+
+    def lstsq(self, a, b, rcond=None):
+        return self._u("lstsq", a, b, rcond=rcond)
+
+    def pinv(self, a):
+        return self._u("pinv", a)
+
+    def matrix_rank(self, a):
+        return self._u("matrix_rank", a)
+
+
+linalg = _Linalg()
+
+
+class _Random:
+    """mx.np.random (reference: numpy/random.py) — drives the framework's
+    counter-based PRNG stream (mx.random.seed applies)."""
+
+    @staticmethod
+    def _size(size):
+        if size is None:
+            return ()
+        if isinstance(size, (tuple, list)):
+            return tuple(size)
+        return (size,)
+
+    @staticmethod
+    def _sample(name, sampler, ctx=None):
+        # sampling is non-differentiable — draw from the framework stream
+        # directly (imperative_invoke only threads rng into registry ops)
+        import jax
+
+        from .. import random_state
+
+        ctx = ctx or current_context()
+        data = sampler(random_state.next_key())
+        return ndarray(data=jax.device_put(data, ctx.jax_device()), ctx=ctx)
+
+    def uniform(self, low=0.0, high=1.0, size=None, dtype=None, ctx=None):
+        import jax
+
+        size = self._size(size)
+        return self._sample("uniform", lambda rng: jax.random.uniform(
+            rng, size, minval=low, maxval=high,
+            dtype=dtype or "float32"), ctx)
+
+    def normal(self, loc=0.0, scale=1.0, size=None, dtype=None, ctx=None):
+        import jax
+
+        size = self._size(size)
+        return self._sample("normal", lambda rng: jax.random.normal(
+            rng, size, dtype=dtype or "float32") * scale + loc, ctx)
+
+    def randn(self, *size):
+        return self.normal(size=tuple(size) or None)
+
+    def rand(self, *size):
+        return self.uniform(size=tuple(size) or None)
+
+    def randint(self, low, high=None, size=None, dtype=None, ctx=None):
+        import jax
+
+        if high is None:
+            low, high = 0, low
+        size = self._size(size)
+        return self._sample("randint", lambda rng: jax.random.randint(
+            rng, size, low, high, dtype=dtype or "int32"), ctx)
+
+    def choice(self, a, size=None, replace=True, p=None, ctx=None):
+        import jax
+
+        size = self._size(size)
+        a_val = _data(a) if isinstance(a, NDArray) else a
+        pv = _data(p) if isinstance(p, NDArray) else p
+        return self._sample("choice", lambda rng: jax.random.choice(
+            rng, a_val, size, replace=replace, p=pv), ctx)
+
+    def shuffle(self, x):
+        import jax
+
+        from .. import random_state
+
+        x._set_data(jax.random.permutation(random_state.next_key(), x.data))
+
+    def permutation(self, x):
+        import jax
+
+        if isinstance(x, int):
+            return self._sample(
+                "permutation",
+                lambda rng: jax.random.permutation(rng, x))
+        return self._sample(
+            "permutation",
+            lambda rng: jax.random.permutation(rng, _data(x)))
+
+    def seed(self, seed=None):
+        from .. import random_state
+
+        random_state.seed(seed)
+
+
+random = _Random()
+
+__all__ = sorted(
+    [n for n in globals()
+     if not n.startswith("_") and n not in ("builtins", "NDArray",
+                                            "Context", "MXNetError",
+                                            "current_context",
+                                            "imperative_invoke")])

@@ -1,0 +1,259 @@
+"""``mx.npx`` — NumPy-extension namespace (reference:
+``python/mxnet/numpy_extension/__init__.py`` + ``util.py::set_np``).
+
+Deep-learning operators that plain NumPy lacks (activations, softmax,
+one_hot, topk, ...) plus the ``set_np``/``reset_np`` frontend switch. In
+the reference, ``set_np`` flips both np_shape (zero-size shape semantics —
+native here, jax shapes are numpy shapes) and np_array (Gluon blocks
+produce ``mx.np.ndarray``); here it toggles the np_array flag consulted by
+``is_np_array``.
+"""
+from __future__ import annotations
+
+import threading
+
+from ..base import MXNetError
+from ..ndarray.ndarray import NDArray
+from ..numpy import ndarray as np_ndarray, _invoke, _np_wrap, _jnp, _data
+
+_state = threading.local()
+
+
+def set_np(shape=True, array=True):
+    """Activate NumPy semantics (reference: util.py::set_np)."""
+    if shape and not array:
+        raise ValueError("setting np_shape without np_array is not useful "
+                         "here: shapes are always NumPy-semantic on JAX")
+    _state.np_array = bool(array)
+
+
+def reset_np():
+    _state.np_array = False
+
+
+def is_np_array():
+    return getattr(_state, "np_array", False)
+
+
+def is_np_shape():
+    # jax/XLA shapes ARE numpy shapes (zero-size dims legal); constant True
+    # mirrors the reference's semantic once set_np_shape(True) is active
+    return True
+
+
+def set_np_shape(active=True):
+    return True
+
+
+def use_np(func):
+    """Decorator form (reference: util.py::use_np) — runs ``func`` with the
+    np-array flag active, restoring it afterwards."""
+    import functools
+
+    @functools.wraps(func)
+    def wrapper(*args, **kwargs):
+        prev = is_np_array()
+        set_np()
+        try:
+            return func(*args, **kwargs)
+        finally:
+            _state.np_array = prev
+
+    return wrapper
+
+
+# ---------------------------------------------------------------------------
+# nn extension ops (reference: _npx namespace, src/operator/numpy_extension)
+# ---------------------------------------------------------------------------
+
+
+def relu(data):
+    return _invoke("npx_relu", lambda d: _jnp().maximum(d, 0), [data])
+
+
+def sigmoid(data):
+    import jax
+
+    return _invoke("npx_sigmoid", jax.nn.sigmoid, [data])
+
+
+def softmax(data, axis=-1, length=None, temperature=None):
+    import jax
+
+    t = temperature or 1.0
+    if length is None:
+        return _invoke("npx_softmax",
+                       lambda d: jax.nn.softmax(d / t, axis=axis), [data])
+
+    def body(d, lens):
+        # length-masked softmax (reference: softmax(..., use_length=True)):
+        # positions >= length along `axis` get zero probability; lengths
+        # are per-batch (leading dim)
+        ax = axis % d.ndim
+        pshape = [1] * d.ndim
+        pshape[ax] = d.shape[ax]
+        pos = _jnp().arange(d.shape[ax]).reshape(pshape)
+        lshape = [1] * d.ndim
+        lshape[0] = lens.shape[0]
+        mask = pos < lens.astype("int32").reshape(lshape)
+        masked = _jnp().where(mask, d / t, -1e30)
+        out = jax.nn.softmax(masked, axis=ax)
+        return _jnp().where(mask, out, 0.0)
+
+    return _invoke("npx_softmax_len", body, [data, length])
+
+
+def log_softmax(data, axis=-1):
+    import jax
+
+    return _invoke("npx_log_softmax",
+                   lambda d: jax.nn.log_softmax(d, axis=axis), [data])
+
+
+def leaky_relu(data, act_type="leaky", slope=0.25):
+    import jax
+
+    acts = {
+        "leaky": lambda d: jax.nn.leaky_relu(d, slope),
+        "elu": lambda d: jax.nn.elu(d, slope),
+        "selu": jax.nn.selu,
+        "gelu": jax.nn.gelu,
+    }
+    if act_type not in acts:
+        raise MXNetError(f"leaky_relu: unsupported act_type {act_type!r} "
+                         f"(have {sorted(acts)})")
+    return _invoke(f"npx_{act_type}", acts[act_type], [data])
+
+
+def gelu(data):
+    import jax
+
+    return _invoke("npx_gelu", jax.nn.gelu, [data])
+
+
+def one_hot(data, depth, on_value=1.0, off_value=0.0, dtype="float32"):
+    import jax
+
+    def body(d):
+        oh = jax.nn.one_hot(d.astype("int32"), depth, dtype=dtype)
+        return oh * on_value + (1 - oh) * off_value
+
+    return _invoke("npx_one_hot", body, [data])
+
+
+def pick(data, index, axis=-1, mode="clip", keepdims=False):
+    from ..ops.registry import get_op
+    from ..ndarray.ndarray import imperative_invoke
+
+    return _np_wrap(imperative_invoke(
+        get_op("pick"), [data, index],
+        {"axis": axis, "keepdims": keepdims}))
+
+
+def topk(data, axis=-1, k=1, ret_typ="indices", is_ascend=False):
+    import jax
+
+    def body(d):
+        dd = _jnp().moveaxis(d, axis, -1)
+        neg = -dd if is_ascend else dd
+        vals, idx = jax.lax.top_k(neg, k)
+        if is_ascend:
+            vals = -vals
+        vals = _jnp().moveaxis(vals, -1, axis)
+        idx = _jnp().moveaxis(idx, -1, axis)
+        if ret_typ == "value":
+            return vals
+        if ret_typ == "both":
+            return vals, idx.astype("float32")
+        return idx.astype("float32")
+
+    return _invoke("npx_topk", body, [data])
+
+
+def reshape_like(lhs, rhs):
+    return _invoke("npx_reshape_like",
+                   lambda a, b: _jnp().reshape(a, b.shape), [lhs, rhs])
+
+
+def batch_flatten(data):
+    return _invoke("npx_batch_flatten",
+                   lambda d: _jnp().reshape(d, (d.shape[0], -1)), [data])
+
+
+def batch_dot(a, b, transpose_a=False, transpose_b=False):
+    def body(x, y):
+        if transpose_a:
+            x = _jnp().swapaxes(x, -1, -2)
+        if transpose_b:
+            y = _jnp().swapaxes(y, -1, -2)
+        return _jnp().matmul(x, y)
+
+    return _invoke("npx_batch_dot", body, [a, b])
+
+
+def gather_nd(data, indices):
+    def body(d, idx):
+        return d[tuple(idx.astype("int32"))]
+
+    return _invoke("npx_gather_nd", body, [data, indices])
+
+
+def sequence_mask(data, sequence_length=None, use_sequence_length=False,
+                  value=0.0, axis=0):
+    if not use_sequence_length or sequence_length is None:
+        return _np_wrap(data if isinstance(data, NDArray)
+                        else __import__("mxnet_tpu.numpy",
+                                        fromlist=["array"]).array(data))
+
+    def body(d, lens):
+        steps = _jnp().arange(d.shape[axis])
+        mask = steps[:, None] < lens[None, :] if axis == 0 else \
+            steps[None, :] < lens[:, None]
+        # the axis distinction is fully handled in the mask construction;
+        # both layouts broadcast over the trailing feature dims
+        mask = mask.reshape(d.shape[:2] + (1,) * (d.ndim - 2))
+        return _jnp().where(mask, d, value)
+
+    return _invoke("npx_sequence_mask", body, [data, sequence_length])
+
+
+def arange_like(data, start=0.0, step=1.0, axis=None):
+    def body(d):
+        n = d.size if axis is None else d.shape[axis]
+        out = start + step * _jnp().arange(n, dtype="float32")
+        return out if axis is not None else out.reshape(d.shape)
+
+    return _invoke("npx_arange_like", body, [data])
+
+
+# waitall/load/save mirrors (reference exposes them in npx too)
+def waitall():
+    from ..ndarray import waitall as _w
+
+    _w()
+
+
+def load(fname):
+    from ..ndarray import serialization
+
+    loaded = serialization.load(fname)
+    if isinstance(loaded, dict):
+        return {k: v.as_np_ndarray() for k, v in loaded.items()}
+    return [v.as_np_ndarray() for v in loaded]
+
+
+def save(fname, data):
+    from ..ndarray import serialization
+
+    if isinstance(data, dict):
+        data = {k: v.as_nd_ndarray() for k, v in data.items()}
+    elif isinstance(data, (list, tuple)):
+        data = [v.as_nd_ndarray() for v in data]
+    else:
+        data = [data.as_nd_ndarray()]
+    serialization.save(fname, data)
+
+
+__all__ = sorted(n for n in globals() if not n.startswith("_")
+                 and n not in ("threading", "NDArray", "MXNetError",
+                               "np_ndarray"))

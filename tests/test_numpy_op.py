@@ -1,0 +1,268 @@
+"""mx.np / mx.npx frontend tests (reference:
+tests/python/unittest/test_numpy_op.py + test_numpy_ndarray.py).
+
+Oracle = real NumPy on the same values; autograd checked through the tape.
+"""
+import numpy as onp
+import pytest
+
+import mxnet_tpu as mx
+from mxnet_tpu import autograd
+np = mx.np
+npx = mx.npx
+
+
+def _rs():
+    return onp.random.RandomState(0)
+
+
+class TestNdarray:
+    def test_round_trip_and_types(self):
+        x = mx.nd.ones((2, 3))
+        xn = x.as_np_ndarray()
+        assert isinstance(xn, np.ndarray)
+        back = xn.as_nd_ndarray()
+        assert type(back) is mx.NDArray
+        onp.testing.assert_allclose(back.asnumpy(), onp.ones((2, 3)))
+
+    def test_default_dtype_is_float32(self):
+        x = np.array([1.0, 2.0])
+        assert str(x.dtype) == "float32"
+        z = np.zeros((2, 2))
+        assert str(z.dtype) == "float32"
+
+    def test_operators_match_numpy(self):
+        a = _rs().randn(3, 4).astype("float32")
+        b = _rs().rand(3, 4).astype("float32") + 1.0
+        xa, xb = np.array(a), np.array(b)
+        for op in ["__add__", "__sub__", "__mul__", "__truediv__",
+                   "__pow__", "__floordiv__", "__mod__"]:
+            want = getattr(a, op)(b)
+            got = getattr(xa, op)(xb)
+            onp.testing.assert_allclose(got.asnumpy(), want, rtol=1e-5,
+                                        err_msg=op)
+        onp.testing.assert_allclose((2.0 - xa).asnumpy(), 2.0 - a, rtol=1e-6)
+        onp.testing.assert_allclose((xa @ xb.T).asnumpy(), a @ b.T,
+                                    rtol=1e-5)
+        assert ((xa > xb).asnumpy() == (a > b)).all()
+
+    def test_true_division_int(self):
+        x = np.array([1, 2, 3], dtype="int32")
+        out = x / 2
+        assert "float" in str(out.dtype)
+
+    def test_reductions(self):
+        a = _rs().randn(4, 5).astype("float32")
+        x = np.array(a)
+        for name in ["sum", "mean", "max", "min", "prod", "std", "var"]:
+            onp.testing.assert_allclose(
+                getattr(x, name)().asnumpy(), getattr(a, name)(),
+                rtol=1e-4, err_msg=name)
+            onp.testing.assert_allclose(
+                getattr(x, name)(axis=1).asnumpy(),
+                getattr(a, name)(axis=1), rtol=1e-4, err_msg=name)
+        onp.testing.assert_allclose(
+            x.sum(axis=(0, 1), keepdims=True).asnumpy(),
+            a.sum(axis=(0, 1), keepdims=True), rtol=1e-5)
+        assert int(x.argmax()) == int(a.argmax())
+
+    def test_indexing_basic_and_advanced(self):
+        a = _rs().randn(5, 6).astype("float32")
+        x = np.array(a)
+        onp.testing.assert_allclose(x[1:4, ::2].asnumpy(), a[1:4, ::2])
+        mask = a[:, 0] > 0
+        got = x[np.array(mask)]
+        onp.testing.assert_allclose(got.asnumpy(), a[mask])
+        idx = onp.array([0, 2, 4])
+        onp.testing.assert_allclose(x[np.array(idx, dtype="int32")].asnumpy(),
+                                    a[idx])
+
+    def test_shape_manipulation(self):
+        a = _rs().randn(2, 3, 4).astype("float32")
+        x = np.array(a)
+        onp.testing.assert_allclose(x.T.asnumpy(), a.T)
+        onp.testing.assert_allclose(x.reshape(6, 4).asnumpy(),
+                                    a.reshape(6, 4))
+        onp.testing.assert_allclose(x.transpose(2, 0, 1).asnumpy(),
+                                    a.transpose(2, 0, 1))
+        onp.testing.assert_allclose(np.expand_dims(x, 1).asnumpy(),
+                                    onp.expand_dims(a, 1))
+        onp.testing.assert_allclose(np.moveaxis(x, 0, -1).asnumpy(),
+                                    onp.moveaxis(a, 0, -1))
+
+
+class TestFunctions:
+    def test_creation(self):
+        onp.testing.assert_allclose(np.arange(2, 10, 2).asnumpy(),
+                                    onp.arange(2, 10, 2, dtype="float32"))
+        onp.testing.assert_allclose(np.linspace(0, 1, 5).asnumpy(),
+                                    onp.linspace(0, 1, 5, dtype="float32"))
+        onp.testing.assert_allclose(np.eye(3, k=1).asnumpy(),
+                                    onp.eye(3, k=1))
+        onp.testing.assert_allclose(np.full((2, 2), 7.0).asnumpy(),
+                                    onp.full((2, 2), 7.0))
+
+    def test_unary_family(self):
+        a = _rs().rand(3, 3).astype("float32") + 0.1
+        x = np.array(a)
+        for name in ["exp", "log", "sqrt", "sin", "cos", "tanh", "abs",
+                     "floor", "ceil", "square", "sign"]:
+            onp.testing.assert_allclose(
+                getattr(np, name)(x).asnumpy(),
+                getattr(onp, name if name != "abs" else "abs")(a),
+                rtol=1e-5, atol=1e-6, err_msg=name)
+
+    def test_binary_and_logic(self):
+        a = _rs().randn(3, 3).astype("float32")
+        b = _rs().rand(3, 3).astype("float32")
+        x, y = np.array(a), np.array(b)
+        onp.testing.assert_allclose(np.maximum(x, y).asnumpy(),
+                                    onp.maximum(a, b))
+        onp.testing.assert_allclose(np.where(x > 0, x, y).asnumpy(),
+                                    onp.where(a > 0, a, b))
+        assert bool(np.isfinite(x).all())
+
+    def test_concat_stack_split(self):
+        a = _rs().randn(2, 3).astype("float32")
+        x = np.array(a)
+        onp.testing.assert_allclose(np.concatenate([x, x], axis=1).asnumpy(),
+                                    onp.concatenate([a, a], axis=1))
+        onp.testing.assert_allclose(np.stack([x, x]).asnumpy(),
+                                    onp.stack([a, a]))
+        parts = np.split(np.array(onp.arange(12.0)), 3)
+        assert len(parts) == 3 and parts[0].shape == (4,)
+
+    def test_einsum_tensordot(self):
+        a = _rs().randn(2, 3).astype("float32")
+        b = _rs().randn(3, 4).astype("float32")
+        onp.testing.assert_allclose(
+            np.einsum("ij,jk->ik", np.array(a), np.array(b)).asnumpy(),
+            onp.einsum("ij,jk->ik", a, b), rtol=1e-5)
+        onp.testing.assert_allclose(
+            np.tensordot(np.array(a), np.array(b), axes=([1], [0])).asnumpy(),
+            onp.tensordot(a, b, axes=([1], [0])), rtol=1e-5)
+
+    def test_linalg(self):
+        a = _rs().randn(3, 3).astype("float32")
+        spd = a @ a.T + 3 * onp.eye(3, dtype="float32")
+        x = np.array(spd)
+        onp.testing.assert_allclose(np.linalg.norm(x).asnumpy(),
+                                    onp.linalg.norm(spd), rtol=1e-5)
+        onp.testing.assert_allclose(
+            (np.linalg.inv(x) @ x).asnumpy(), onp.eye(3),
+            rtol=1e-3, atol=1e-3)
+        l = np.linalg.cholesky(x)
+        onp.testing.assert_allclose((l @ l.T).asnumpy(), spd, rtol=1e-4)
+
+    def test_random(self):
+        mx.random.seed(7)
+        u = np.random.uniform(2.0, 3.0, size=(100,))
+        assert 2.0 <= float(u.min()) and float(u.max()) <= 3.0
+        n = np.random.normal(0.0, 1.0, size=(500,))
+        assert abs(float(n.mean())) < 0.3
+        r = np.random.randint(0, 5, size=(50,))
+        vals = set(onp.unique(r.asnumpy()).tolist())
+        assert vals <= {0, 1, 2, 3, 4}
+
+
+class TestAutograd:
+    def test_grad_through_np_ops(self):
+        a = _rs().randn(3, 3).astype("float32")
+        x = np.array(a)
+        x.attach_grad()
+        with autograd.record():
+            y = (x * x).sum()
+        y.backward()
+        onp.testing.assert_allclose(x.grad.asnumpy(), 2 * a, rtol=1e-5)
+
+    def test_grad_mixed_chain(self):
+        a = _rs().rand(4).astype("float32") + 0.5
+        x = np.array(a)
+        x.attach_grad()
+        with autograd.record():
+            y = np.log(x).sum() + (x ** 2).mean()
+        y.backward()
+        want = 1.0 / a + 2 * a / 4
+        onp.testing.assert_allclose(x.grad.asnumpy(), want, rtol=1e-5)
+
+
+class TestNpx:
+    def test_activations(self):
+        a = _rs().randn(3, 4).astype("float32")
+        x = np.array(a)
+        onp.testing.assert_allclose(npx.relu(x).asnumpy(),
+                                    onp.maximum(a, 0))
+        s = npx.softmax(x).asnumpy()
+        onp.testing.assert_allclose(s.sum(-1), onp.ones(3), rtol=1e-5)
+        onp.testing.assert_allclose(npx.log_softmax(x).asnumpy(),
+                                    onp.log(s), rtol=1e-4, atol=1e-5)
+
+    def test_one_hot_topk_pick(self):
+        x = np.array(onp.array([0.0, 2.0, 1.0]))
+        oh = npx.one_hot(x, 3)
+        onp.testing.assert_allclose(oh.asnumpy(), onp.eye(3)[[0, 2, 1]])
+        data = np.array(onp.array([[1.0, 3.0, 2.0], [9.0, 7.0, 8.0]]))
+        idx = npx.topk(data, k=2)
+        assert idx.asnumpy().tolist() == [[1.0, 2.0], [0.0, 2.0]]
+
+    def test_set_np_flags(self):
+        assert not npx.is_np_array()
+        npx.set_np()
+        assert npx.is_np_array()
+        npx.reset_np()
+        assert not npx.is_np_array()
+
+        @npx.use_np
+        def inner():
+            return npx.is_np_array()
+
+        assert inner() and not npx.is_np_array()
+
+    def test_npx_save_load(self, tmp_path):
+        f = str(tmp_path / "arrs")
+        x = np.array(onp.arange(6.0).reshape(2, 3))
+        npx.save(f, {"w": x})
+        loaded = npx.load(f)
+        assert isinstance(loaded["w"], np.ndarray)
+        onp.testing.assert_allclose(loaded["w"].asnumpy(), x.asnumpy())
+
+
+class TestReviewFindings:
+    """Round-2 review regressions for the np frontend."""
+
+    def test_where_single_arg_tuple(self):
+        c = np.array(onp.array([[True, False], [False, True]]))
+        idx = np.where(c)
+        assert isinstance(idx, (tuple, list)) and len(idx) == 2
+        assert idx[0].asnumpy().tolist() == [0.0, 1.0]
+        assert idx[1].asnumpy().tolist() == [0.0, 1.0]
+
+    def test_eq_none(self):
+        x = np.array([1.0])
+        assert (x == None) is False  # noqa: E711
+        assert (x != None) is True   # noqa: E711
+
+    def test_atleast_1d_scalar(self):
+        out = np.atleast_1d(5.0)
+        assert out.shape == (1,)
+
+    def test_random_list_size(self):
+        u = np.random.uniform(size=[2, 3])
+        assert u.shape == (2, 3)
+
+    def test_softmax_length_masked(self):
+        x = np.array(onp.zeros((2, 4), dtype="float32"))
+        out = npx.softmax(x, axis=-1, length=np.array(
+            onp.array([2, 4], dtype="int32")))
+        got = out.asnumpy()
+        onp.testing.assert_allclose(got[0], [0.5, 0.5, 0.0, 0.0], atol=1e-6)
+        onp.testing.assert_allclose(got[1], [0.25] * 4, atol=1e-6)
+
+    def test_leaky_relu_act_types(self):
+        x = np.array(onp.array([-1.0, 1.0], dtype="float32"))
+        onp.testing.assert_allclose(
+            npx.leaky_relu(x, slope=0.1).asnumpy(), [-0.1, 1.0], rtol=1e-5)
+        elu = npx.leaky_relu(x, act_type="elu", slope=1.0).asnumpy()
+        onp.testing.assert_allclose(elu, [onp.expm1(-1.0), 1.0], rtol=1e-5)
+        with pytest.raises(mx.MXNetError, match="act_type"):
+            npx.leaky_relu(x, act_type="bogus")
