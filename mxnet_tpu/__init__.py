@@ -78,6 +78,10 @@ from . import callback
 from . import monitor
 from . import numpy as np
 from . import numpy_extension as npx
+from . import contrib
+from . import recordio
+from . import image
+from . import amp
 
 from .ndarray import NDArray
 from .optimizer import Optimizer

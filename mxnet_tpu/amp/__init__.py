@@ -1,0 +1,269 @@
+"""``mx.amp`` — automatic mixed precision (reference:
+``python/mxnet/contrib/amp/amp.py`` + ``loss_scaler.py``).
+
+The reference's AMP rewrites the op namespace so whitelisted (MXU-friendly)
+ops run fp16 and blacklisted (range-sensitive) ops stay fp32, and wraps the
+Trainer with a dynamic loss scaler. The TPU-native counterpart is the same
+three pieces with bf16 as the default target:
+
+* ``init()`` — patch the op registry: TARGET_DTYPE_OPS run in bf16 (their
+  float inputs are cast at the boundary; XLA fuses the converts), FP32_OPS
+  get f32 inputs. Under jit these casts trace into the one compiled step.
+* ``init_trainer()`` / ``scale_loss()`` — dynamic loss scaling. bf16 has
+  f32's exponent range so the scaler is a no-op there by default; for
+  ``float16`` (and for API parity) the full grow/backoff scaler runs.
+* ``convert_model`` / ``convert_hybrid_block`` — cast a trained model's
+  params to the target dtype.
+
+Reference parity notes: list names follow ``amp/lists/symbol_fp16.py``'s
+roles (TARGET/FP32/WIDEST); unlisted ops run in their input dtype.
+"""
+from __future__ import annotations
+
+import contextlib
+import logging
+import warnings
+
+from ..base import MXNetError
+
+__all__ = ["init", "init_trainer", "scale_loss", "unscale",
+           "convert_model", "convert_hybrid_block", "DynamicLossScaler",
+           "TARGET_DTYPE_OPS", "FP32_OPS"]
+
+# MXU-bound ops: run in the target dtype (reference: FP16_FUNCS)
+TARGET_DTYPE_OPS = [
+    "FullyConnected", "Convolution", "Deconvolution", "batch_dot", "dot",
+    "RNN",
+]
+# range/precision-sensitive ops: force f32 inputs (reference: FP32_FUNCS)
+FP32_OPS = [
+    "softmax", "log_softmax", "SoftmaxOutput", "softmax_cross_entropy",
+    "smooth_l1", "exp", "log", "log2", "log10", "norm", "mean", "sum",
+    "L2Normalization", "InstanceNorm", "LayerNorm", "BatchNorm", "erfinv",
+]
+
+_state = {"initialized": False, "target_dtype": None, "orig_fns": {}}
+
+
+def _cast_tensors(args, dtype):
+    import jax.numpy as jnp
+
+    def cast(a):
+        if hasattr(a, "dtype") and jnp.issubdtype(
+                jnp.asarray(a).dtype, jnp.floating):
+            return jnp.asarray(a).astype(dtype)
+        return a
+
+    return [cast(a) for a in args]
+
+
+def init(target_dtype="bfloat16", target_precision_ops=None,
+         conditional_fp32_ops=None, fp32_ops=None):
+    """Enable AMP op-level autocasting (reference: amp.init).
+
+    Idempotent; patches the op registry in place so every frontend
+    (nd/np/gluon/symbol/TrainStep — they all dispatch through the
+    registry) autocasts identically, eagerly and under jit.
+    """
+    import jax.numpy as jnp
+
+    from ..ops import registry as reg
+
+    if _state["initialized"]:
+        if str(target_dtype) != _state["target_dtype"]:
+            raise MXNetError(
+                f"amp.init already active with target_dtype="
+                f"{_state['target_dtype']!r}; it cannot be re-initialized "
+                f"with {target_dtype!r}")
+        return
+    if str(target_dtype) not in ("bfloat16", "float16"):
+        raise MXNetError(
+            f"amp.init: target_dtype must be bfloat16 or float16, got "
+            f"{target_dtype!r} (bfloat16 is the TPU-native choice)")
+    target = jnp.bfloat16 if str(target_dtype) == "bfloat16" else jnp.float16
+    logging.info("AMP init: target dtype %s", target_dtype)
+
+    def wrap(opdef, dtype):
+        orig = opdef.fn
+
+        def autocast_fn(*tensors, **attrs):
+            return orig(*_cast_tensors(tensors, dtype), **attrs)
+
+        # OpDef is an immutable NamedTuple: swap every registry alias that
+        # points at this op for a _replace'd copy
+        new = opdef._replace(fn=autocast_fn)
+        for key, val in list(reg._REGISTRY.items()):
+            if val is opdef:
+                reg._REGISTRY[key] = new
+        _state["orig_fns"][opdef.name] = opdef
+
+    for name in (target_precision_ops or TARGET_DTYPE_OPS):
+        try:
+            wrap(reg.get_op(name), target)
+        except Exception:
+            pass  # op families differ per build; mirror reference leniency
+    for name in (fp32_ops or FP32_OPS):
+        try:
+            wrap(reg.get_op(name), jnp.float32)
+        except Exception:
+            pass
+    # invalidate the per-op executable cache: it closed over original fns
+    try:
+        reg._cached_call.cache_clear()
+    except Exception:
+        pass
+    _state.update(initialized=True, target_dtype=str(target_dtype))
+
+
+def _deinit_for_tests():
+    """Undo init() — test isolation helper (not in the reference API)."""
+    from ..ops import registry as reg
+
+    for name, orig_opdef in _state["orig_fns"].items():
+        patched = reg._REGISTRY.get(orig_opdef.name)
+        for key, val in list(reg._REGISTRY.items()):
+            if val is patched:
+                reg._REGISTRY[key] = orig_opdef
+    _state["orig_fns"].clear()
+    _state.update(initialized=False, target_dtype=None)
+    try:
+        reg._cached_call.cache_clear()
+    except Exception:
+        pass
+
+
+class DynamicLossScaler:
+    """Grow/backoff loss scaler (reference: amp/loss_scaler.py::LossScaler).
+
+    Scale doubles after ``scale_window`` consecutive finite-gradient steps
+    and halves on overflow (the update that overflowed is skipped by
+    Trainer.step)."""
+
+    def __init__(self, init_scale=2.0 ** 16, scale_factor=2.0,
+                 scale_window=2000, tolerance=0.0):
+        self.loss_scale = float(init_scale)
+        self._scale_factor = float(scale_factor)
+        self._scale_window = int(scale_window)
+        self._unskipped = 0
+
+    def has_overflow(self, params):
+        """True if any gradient is non-finite (post-unscale check input)."""
+        import jax.numpy as jnp
+
+        for p in params:
+            if p.grad_req == "null":
+                continue
+            for g in p.list_grad():
+                if not bool(jnp.isfinite(g.data).all()):
+                    return True
+        return False
+
+    def update_scale(self, overflow):
+        if overflow:
+            self.loss_scale = max(1.0, self.loss_scale / self._scale_factor)
+            self._unskipped = 0
+        else:
+            self._unskipped += 1
+            if self._unskipped >= self._scale_window:
+                self.loss_scale *= self._scale_factor
+                self._unskipped = 0
+
+
+def init_trainer(trainer):
+    """Attach a dynamic loss scaler to a Trainer (reference:
+    amp.init_trainer). bf16 targets get scale 1 (bf16 keeps f32's exponent
+    range — scaling exists for f16's narrow range)."""
+    if getattr(trainer, "_amp_loss_scaler", None) is not None:
+        return
+    if not _state["initialized"]:
+        raise MXNetError("call amp.init() before amp.init_trainer()")
+    if _state["target_dtype"] == "bfloat16":
+        scaler = DynamicLossScaler(init_scale=1.0, scale_window=10 ** 9)
+    else:
+        scaler = DynamicLossScaler()
+    trainer._amp_loss_scaler = scaler
+    _patch_trainer_step(trainer)
+
+
+def _patch_trainer_step(trainer):
+    orig_step = trainer.step
+
+    def amp_step(batch_size, ignore_stale_grad=False):
+        scaler = trainer._amp_loss_scaler
+        # fold the loss scale into rescale_grad so the unscale happens
+        # inside the (compiled) updater — unless amp.unscale() already
+        # divided the gradients this iteration
+        already = getattr(trainer, "_amp_grads_unscaled", False)
+        trainer._amp_grads_unscaled = False
+        prev_scale = trainer._scale
+        if not already:
+            trainer._scale = prev_scale / scaler.loss_scale
+        try:
+            overflow = scaler.has_overflow(trainer._params)
+            if overflow:
+                logging.warning(
+                    "AMP: gradient overflow, skipping step "
+                    "(loss scale %.1f -> %.1f)", scaler.loss_scale,
+                    scaler.loss_scale / scaler._scale_factor)
+            else:
+                orig_step(batch_size, ignore_stale_grad)
+            scaler.update_scale(overflow)
+        finally:
+            trainer._scale = prev_scale
+    trainer._amp_orig_step = orig_step
+    trainer.step = amp_step
+
+
+@contextlib.contextmanager
+def scale_loss(loss, trainer):
+    """Scale the loss before backward (reference: amp.scale_loss)."""
+    if getattr(trainer, "_amp_loss_scaler", None) is None:
+        init_trainer(trainer)
+    scale = trainer._amp_loss_scaler.loss_scale
+    if isinstance(loss, (list, tuple)):
+        yield [l * scale for l in loss]
+    else:
+        yield loss * scale
+
+
+def unscale(trainer):
+    """Divide current gradients by the loss scale in place (reference:
+    amp.unscale) — for clipping between backward and step."""
+    scaler = getattr(trainer, "_amp_loss_scaler", None)
+    if scaler is None or scaler.loss_scale == 1.0:
+        return
+    inv = 1.0 / scaler.loss_scale
+    for p in trainer._params:
+        if p.grad_req == "null":
+            continue
+        for g in p.list_grad():
+            g._set_data((g.data * g.data.dtype.type(inv))
+                        if hasattr(g.data.dtype, "type") else g.data * inv)
+    # tell the patched step not to divide again this iteration (the scale
+    # itself is untouched — next scale_loss uses it as usual)
+    trainer._amp_grads_unscaled = True
+
+
+def convert_model(sym, arg_params, aux_params, target_dtype="bfloat16",
+                  fp32_params=None):
+    """Cast a symbolic checkpoint's params (reference: amp.convert_model)."""
+    fp32 = set(fp32_params or ())
+    import jax.numpy as jnp
+
+    def conv(d):
+        out = {}
+        for k, v in d.items():
+            if k in fp32 or not jnp.issubdtype(
+                    jnp.asarray(v.data).dtype, jnp.floating):
+                out[k] = v
+            else:
+                out[k] = v.astype(target_dtype)
+        return out
+
+    return sym, conv(arg_params), conv(aux_params)
+
+
+def convert_hybrid_block(block, target_dtype="bfloat16"):
+    """Cast a Gluon block in place (reference: amp.convert_hybrid_block)."""
+    block.cast(target_dtype)
+    return block

@@ -1,0 +1,8 @@
+"""``mx.contrib`` namespace (reference: ``python/mxnet/contrib/``).
+
+The pieces with TPU-native equivalents live at top level and are
+re-exported here under their reference import paths:
+``mx.contrib.amp`` -> mxnet_tpu.amp. Gluon-side contribs (SyncBatchNorm,
+Estimator) are under ``mxnet_tpu.gluon.contrib``.
+"""
+from .. import amp  # noqa: F401  (reference path: mxnet.contrib.amp)

@@ -1,0 +1,479 @@
+"""``mx.image`` — image decode + augmentation pipeline (reference:
+``python/mxnet/image/image.py``).
+
+The reference wraps OpenCV; here PIL decodes/encodes (the only codec in
+this environment) and the augmenters are pure numpy on HWC arrays — they
+run in DataLoader / iterator worker threads on host, exactly like the
+reference's C++ augmenter zoo runs on CPU, and the device only ever sees
+the final batched tensor.
+"""
+from __future__ import annotations
+
+import io as _io
+import random as _pyrandom
+
+import numpy as np
+
+from ..base import MXNetError
+from ..ndarray import NDArray, array as nd_array
+
+__all__ = [
+    "imdecode", "imread", "imresize", "resize_short", "fixed_crop",
+    "center_crop", "random_crop", "random_size_crop", "color_normalize",
+    "Augmenter", "ResizeAug", "ForceResizeAug", "CenterCropAug",
+    "RandomCropAug", "RandomSizedCropAug", "HorizontalFlipAug", "CastAug",
+    "ColorNormalizeAug", "BrightnessJitterAug", "ContrastJitterAug",
+    "SaturationJitterAug", "ColorJitterAug", "LightingAug", "RandomGrayAug",
+    "CreateAugmenter", "ImageIter",
+]
+
+
+def _to_np(img):
+    if isinstance(img, NDArray):
+        return img.asnumpy()
+    return np.asarray(img)
+
+
+def _wrap(img, out=None):
+    if out is not None:
+        out._set_data(nd_array(img).data)
+        return out
+    return nd_array(img)
+
+
+def imdecode(buf, flag=1, to_rgb=1, out=None):
+    """Decode an encoded image buffer to HWC uint8 (reference: imdecode)."""
+    from PIL import Image
+
+    if isinstance(buf, NDArray):
+        buf = buf.asnumpy().tobytes()
+    img = Image.open(_io.BytesIO(bytes(buf)))
+    img = img.convert("RGB" if flag else "L")
+    arr = np.asarray(img)
+    if not flag:
+        arr = arr[:, :, None]
+    if flag and not to_rgb:
+        arr = arr[:, :, ::-1]  # BGR, the reference's cv2 default
+    return _wrap(arr, out)
+
+
+def imread(filename, flag=1, to_rgb=1):
+    with open(filename, "rb") as f:
+        return imdecode(f.read(), flag=flag, to_rgb=to_rgb)
+
+
+def imresize(src, w, h, interp=1):
+    from PIL import Image
+
+    arr = _to_np(src).astype(np.uint8)
+    resample = {0: Image.NEAREST, 1: Image.BILINEAR, 2: Image.BICUBIC,
+                3: Image.NEAREST, 4: Image.LANCZOS}.get(interp,
+                                                        Image.BILINEAR)
+    squeeze = arr.shape[-1] == 1
+    pil = Image.fromarray(arr[..., 0] if squeeze else arr)
+    out = np.asarray(pil.resize((w, h), resample))
+    if squeeze:
+        out = out[:, :, None]
+    return nd_array(out)
+
+
+def resize_short(src, size, interp=2):
+    """Resize so the SHORT side equals size (reference: resize_short)."""
+    h, w = _to_np(src).shape[:2]
+    if h > w:
+        new_w, new_h = size, int(h * size / w)
+    else:
+        new_w, new_h = int(w * size / h), size
+    return imresize(src, new_w, new_h, interp)
+
+
+def fixed_crop(src, x0, y0, w, h, size=None, interp=2):
+    arr = _to_np(src)[y0:y0 + h, x0:x0 + w]
+    if size is not None and (w, h) != size:
+        return imresize(arr, size[0], size[1], interp)
+    return nd_array(arr)
+
+
+def center_crop(src, size, interp=2):
+    h, w = _to_np(src).shape[:2]
+    new_w, new_h = size
+    x0 = max(0, (w - new_w) // 2)
+    y0 = max(0, (h - new_h) // 2)
+    out = fixed_crop(src, x0, y0, min(new_w, w), min(new_h, h), size, interp)
+    return out, (x0, y0, new_w, new_h)
+
+
+def random_crop(src, size, interp=2):
+    h, w = _to_np(src).shape[:2]
+    new_w, new_h = min(size[0], w), min(size[1], h)
+    x0 = _pyrandom.randint(0, w - new_w)
+    y0 = _pyrandom.randint(0, h - new_h)
+    out = fixed_crop(src, x0, y0, new_w, new_h, size, interp)
+    return out, (x0, y0, new_w, new_h)
+
+
+def random_size_crop(src, size, area, ratio, interp=2, max_attempts=10):
+    """Random area+aspect crop (the Inception-style crop)."""
+    h, w = _to_np(src).shape[:2]
+    src_area = h * w
+    if isinstance(area, (int, float)):
+        area = (area, 1.0)
+    for _ in range(max_attempts):
+        target = _pyrandom.uniform(*area) * src_area
+        log_ratio = (np.log(ratio[0]), np.log(ratio[1]))
+        ar = np.exp(_pyrandom.uniform(*log_ratio))
+        new_w = int(round(np.sqrt(target * ar)))
+        new_h = int(round(np.sqrt(target / ar)))
+        if new_w <= w and new_h <= h:
+            x0 = _pyrandom.randint(0, w - new_w)
+            y0 = _pyrandom.randint(0, h - new_h)
+            return (fixed_crop(src, x0, y0, new_w, new_h, size, interp),
+                    (x0, y0, new_w, new_h))
+    return center_crop(src, size, interp)
+
+
+def color_normalize(src, mean, std=None):
+    arr = _to_np(src).astype(np.float32)
+    arr = arr - _to_np(mean)
+    if std is not None:
+        arr = arr / _to_np(std)
+    return nd_array(arr)
+
+
+# ---------------------------------------------------------------------------
+# augmenters (reference: image.py Augmenter zoo)
+# ---------------------------------------------------------------------------
+
+
+class Augmenter:
+    def __init__(self, **kwargs):
+        self._kwargs = kwargs
+
+    def dumps(self):
+        import json
+
+        return json.dumps([self.__class__.__name__, self._kwargs])
+
+    def __call__(self, src):
+        raise NotImplementedError
+
+
+class ResizeAug(Augmenter):
+    def __init__(self, size, interp=2):
+        super().__init__(size=size, interp=interp)
+        self.size, self.interp = size, interp
+
+    def __call__(self, src):
+        return resize_short(src, self.size, self.interp)
+
+
+class ForceResizeAug(Augmenter):
+    def __init__(self, size, interp=2):
+        super().__init__(size=size, interp=interp)
+        self.size, self.interp = size, interp
+
+    def __call__(self, src):
+        return imresize(src, self.size[0], self.size[1], self.interp)
+
+
+class CenterCropAug(Augmenter):
+    def __init__(self, size, interp=2):
+        super().__init__(size=size, interp=interp)
+        self.size, self.interp = size, interp
+
+    def __call__(self, src):
+        return center_crop(src, self.size, self.interp)[0]
+
+
+class RandomCropAug(Augmenter):
+    def __init__(self, size, interp=2):
+        super().__init__(size=size, interp=interp)
+        self.size, self.interp = size, interp
+
+    def __call__(self, src):
+        return random_crop(src, self.size, self.interp)[0]
+
+
+class RandomSizedCropAug(Augmenter):
+    def __init__(self, size, area, ratio, interp=2):
+        super().__init__(size=size, area=area, ratio=ratio, interp=interp)
+        self.size, self.area, self.ratio, self.interp = \
+            size, area, ratio, interp
+
+    def __call__(self, src):
+        return random_size_crop(src, self.size, self.area, self.ratio,
+                                self.interp)[0]
+
+
+class HorizontalFlipAug(Augmenter):
+    def __init__(self, p=0.5):
+        super().__init__(p=p)
+        self.p = p
+
+    def __call__(self, src):
+        if _pyrandom.random() < self.p:
+            return nd_array(_to_np(src)[:, ::-1])
+        return src if isinstance(src, NDArray) else nd_array(src)
+
+
+class CastAug(Augmenter):
+    def __init__(self, typ="float32"):
+        super().__init__(typ=typ)
+        self.typ = typ
+
+    def __call__(self, src):
+        return nd_array(_to_np(src).astype(self.typ))
+
+
+class ColorNormalizeAug(Augmenter):
+    def __init__(self, mean, std):
+        super().__init__()
+        self.mean = np.asarray(mean, np.float32)
+        self.std = np.asarray(std, np.float32) if std is not None else None
+
+    def __call__(self, src):
+        return color_normalize(src, self.mean, self.std)
+
+
+class BrightnessJitterAug(Augmenter):
+    def __init__(self, brightness):
+        super().__init__(brightness=brightness)
+        self.brightness = brightness
+
+    def __call__(self, src):
+        alpha = 1.0 + _pyrandom.uniform(-self.brightness, self.brightness)
+        return nd_array(_to_np(src).astype(np.float32) * alpha)
+
+
+class ContrastJitterAug(Augmenter):
+    _coef = np.array([0.299, 0.587, 0.114], np.float32)
+
+    def __init__(self, contrast):
+        super().__init__(contrast=contrast)
+        self.contrast = contrast
+
+    def __call__(self, src):
+        alpha = 1.0 + _pyrandom.uniform(-self.contrast, self.contrast)
+        arr = _to_np(src).astype(np.float32)
+        gray = (arr * self._coef).sum(-1).mean()
+        return nd_array(arr * alpha + gray * (1 - alpha))
+
+
+class SaturationJitterAug(Augmenter):
+    _coef = np.array([0.299, 0.587, 0.114], np.float32)
+
+    def __init__(self, saturation):
+        super().__init__(saturation=saturation)
+        self.saturation = saturation
+
+    def __call__(self, src):
+        alpha = 1.0 + _pyrandom.uniform(-self.saturation, self.saturation)
+        arr = _to_np(src).astype(np.float32)
+        gray = (arr * self._coef).sum(-1, keepdims=True)
+        return nd_array(arr * alpha + gray * (1 - alpha))
+
+
+class ColorJitterAug(Augmenter):
+    def __init__(self, brightness=0.0, contrast=0.0, saturation=0.0):
+        super().__init__(brightness=brightness, contrast=contrast,
+                         saturation=saturation)
+        self._augs = []
+        if brightness:
+            self._augs.append(BrightnessJitterAug(brightness))
+        if contrast:
+            self._augs.append(ContrastJitterAug(contrast))
+        if saturation:
+            self._augs.append(SaturationJitterAug(saturation))
+
+    def __call__(self, src):
+        augs = list(self._augs)
+        _pyrandom.shuffle(augs)
+        for a in augs:
+            src = a(src)
+        return src if isinstance(src, NDArray) else nd_array(src)
+
+
+class LightingAug(Augmenter):
+    """PCA lighting noise (AlexNet-style)."""
+
+    def __init__(self, alphastd, eigval, eigvec):
+        super().__init__(alphastd=alphastd)
+        self.alphastd = alphastd
+        self.eigval = np.asarray(eigval, np.float32)
+        self.eigvec = np.asarray(eigvec, np.float32)
+
+    def __call__(self, src):
+        alpha = np.random.normal(0, self.alphastd, size=(3,))
+        rgb = (self.eigvec * alpha * self.eigval).sum(-1)
+        return nd_array(_to_np(src).astype(np.float32) + rgb)
+
+
+class RandomGrayAug(Augmenter):
+    _coef = np.array([0.299, 0.587, 0.114], np.float32)
+
+    def __init__(self, p=0.5):
+        super().__init__(p=p)
+        self.p = p
+
+    def __call__(self, src):
+        if _pyrandom.random() < self.p:
+            arr = _to_np(src).astype(np.float32)
+            gray = (arr * self._coef).sum(-1, keepdims=True)
+            return nd_array(np.broadcast_to(gray, arr.shape).copy())
+        return src if isinstance(src, NDArray) else nd_array(src)
+
+
+def CreateAugmenter(data_shape, resize=0, rand_crop=False, rand_resize=False,
+                    rand_mirror=False, mean=None, std=None, brightness=0,
+                    contrast=0, saturation=0, pca_noise=0, rand_gray=0,
+                    inter_method=2):
+    """Standard augmenter list builder (reference: CreateAugmenter)."""
+    auglist = []
+    crop_size = (data_shape[2], data_shape[1])
+    if resize > 0:
+        auglist.append(ResizeAug(resize, inter_method))
+    if rand_resize:
+        assert rand_crop
+        auglist.append(RandomSizedCropAug(crop_size, (0.08, 1.0),
+                                          (3 / 4.0, 4 / 3.0), inter_method))
+    elif rand_crop:
+        auglist.append(RandomCropAug(crop_size, inter_method))
+    else:
+        auglist.append(CenterCropAug(crop_size, inter_method))
+    if rand_mirror:
+        auglist.append(HorizontalFlipAug(0.5))
+    auglist.append(CastAug())
+    if brightness or contrast or saturation:
+        auglist.append(ColorJitterAug(brightness, contrast, saturation))
+    if pca_noise > 0:
+        eigval = np.array([55.46, 4.794, 1.148])
+        eigvec = np.array([[-0.5675, 0.7192, 0.4009],
+                           [-0.5808, -0.0045, -0.8140],
+                           [-0.5836, -0.6948, 0.4203]])
+        auglist.append(LightingAug(pca_noise, eigval, eigvec))
+    if rand_gray > 0:
+        auglist.append(RandomGrayAug(rand_gray))
+    if mean is True:
+        mean = np.array([123.68, 116.28, 103.53])
+    if std is True:
+        std = np.array([58.395, 57.12, 57.375])
+    if mean is not None and len(np.shape(mean)):
+        auglist.append(ColorNormalizeAug(mean, std))
+    return auglist
+
+
+class ImageIter:
+    """Record-file / list-backed image iterator (reference: ImageIter).
+
+    Feeds NCHW float32 batches; decode + augmentation run on host (worker
+    role of the reference's C++ ImageRecordIter), the device sees only the
+    final batch.
+    """
+
+    def __init__(self, batch_size, data_shape, path_imgrec=None,
+                 path_imgidx=None, shuffle=False, aug_list=None,
+                 label_width=1, last_batch_handle="pad", **kwargs):
+        from ..io import DataDesc
+        from ..recordio import MXIndexedRecordIO, MXRecordIO
+
+        if len(data_shape) != 3:
+            raise MXNetError("data_shape must be (channels, height, width)")
+        self.batch_size = batch_size
+        self.data_shape = tuple(data_shape)
+        self.label_width = label_width
+        self.shuffle = shuffle
+        self.auglist = aug_list if aug_list is not None else \
+            CreateAugmenter(data_shape)
+        self._rec = None
+        self._keys = None
+        if path_imgrec is None:
+            raise MXNetError("ImageIter requires path_imgrec (use "
+                             "gluon.data for folder datasets)")
+        if path_imgidx:
+            self._rec = MXIndexedRecordIO(path_imgidx, path_imgrec, "r")
+            self._keys = list(self._rec.keys)
+        else:
+            if shuffle:
+                raise MXNetError(
+                    "ImageIter(shuffle=True) requires path_imgidx — "
+                    "sequential record files cannot be reordered")
+            self._rec = MXRecordIO(path_imgrec, "r")
+        self._order = None
+        self._cursor = 0
+        self.provide_data = [DataDesc("data",
+                                      (batch_size,) + self.data_shape,
+                                      "float32", "NCHW")]
+        lshape = (batch_size,) if label_width == 1 else (batch_size,
+                                                         label_width)
+        self.provide_label = [DataDesc("softmax_label", lshape, "float32",
+                                       "N")]
+        self.reset()
+
+    def reset(self):
+        self._cursor = 0
+        if self._keys is not None:
+            self._order = list(self._keys)
+            if self.shuffle:
+                _pyrandom.shuffle(self._order)
+        else:
+            self._rec.reset()
+
+    def _next_sample(self):
+        from ..recordio import unpack
+
+        if self._keys is not None:
+            if self._cursor >= len(self._order):
+                return None
+            rec = self._rec.read_idx(self._order[self._cursor])
+            self._cursor += 1
+        else:
+            rec = self._rec.read()
+            if rec is None:
+                return None
+        header, payload = unpack(rec)
+        label = header.label
+        if isinstance(label, (np.ndarray, list)):
+            label = np.asarray(label, np.float32)
+        else:
+            label = np.float32(label)
+        return label, payload
+
+    def __iter__(self):
+        return self
+
+    def __next__(self):
+        return self.next()
+
+    def next(self):
+        from ..io import DataBatch
+
+        c, h, w = self.data_shape
+        data = np.zeros((self.batch_size, c, h, w), np.float32)
+        labels = np.zeros((self.batch_size,) if self.label_width == 1
+                          else (self.batch_size, self.label_width),
+                          np.float32)
+        i = 0
+        while i < self.batch_size:
+            sample = self._next_sample()
+            if sample is None:
+                break
+            label, payload = sample
+            img = imdecode(payload, flag=1 if c == 3 else 0)
+            for aug in self.auglist:
+                img = aug(img)
+            arr = img.asnumpy() if isinstance(img, NDArray) else img
+            data[i] = arr.transpose(2, 0, 1)
+            labels[i] = label
+            i += 1
+        if i == 0:
+            raise StopIteration
+        pad = self.batch_size - i
+        if pad:
+            # pad by recycling real samples (NDArrayIter's wrap behavior —
+            # io.py) so fit() never trains on fabricated zero images; pad
+            # rows are discounted by score/predict via DataBatch.pad
+            for j in range(i, self.batch_size):
+                data[j] = data[j % i]
+                labels[j] = labels[j % i]
+        return DataBatch(data=[nd_array(data)], label=[nd_array(labels)],
+                         pad=pad)

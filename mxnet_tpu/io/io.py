@@ -12,7 +12,8 @@ from ..base import MXNetError
 from ..context import cpu
 from ..ndarray import NDArray, array as nd_array
 
-__all__ = ["DataDesc", "DataBatch", "DataIter", "NDArrayIter", "ResizeIter",
+__all__ = ["ImageRecordIter",
+           "DataDesc", "DataBatch", "DataIter", "NDArrayIter", "ResizeIter",
            "PrefetchingIter", "CSVIter", "MNISTIter"]
 
 
@@ -345,3 +346,32 @@ class MNISTIter(NDArrayIter):
                                     *images.shape[1:])
         super().__init__(images, labels, batch_size=batch_size,
                          shuffle=shuffle, **kwargs)
+
+
+def ImageRecordIter(path_imgrec=None, path_imgidx=None, data_shape=None,
+                    batch_size=128, shuffle=False, rand_crop=False,
+                    rand_mirror=False, mean_r=0.0, mean_g=0.0, mean_b=0.0,
+                    std_r=1.0, std_g=1.0, std_b=1.0, resize=0,
+                    label_width=1, **kwargs):
+    """Record-file image iterator (reference: the C++ ImageRecordIter of
+    ``iter_image_recordio_2.cc``, exposed via io.py). Thin factory over
+    ``mx.image.ImageIter`` with the classic flat-kwargs interface."""
+    import numpy as _np
+
+    from ..image import CreateAugmenter, ImageIter
+
+    if data_shape is None:
+        raise MXNetError("ImageRecordIter requires data_shape")
+    mean = None
+    std = None
+    if any(v != 1.0 for v in (std_r, std_g, std_b)):
+        std = _np.array([std_r, std_g, std_b], _np.float32)
+    if any(v != 0.0 for v in (mean_r, mean_g, mean_b)) or std is not None:
+        # std-only normalization still needs the ColorNormalizeAug (a
+        # zero mean), matching the C++ iterator's independent std divide
+        mean = _np.array([mean_r, mean_g, mean_b], _np.float32)
+    aug = CreateAugmenter(data_shape, resize=resize, rand_crop=rand_crop,
+                          rand_mirror=rand_mirror, mean=mean, std=std)
+    return ImageIter(batch_size=batch_size, data_shape=data_shape,
+                     path_imgrec=path_imgrec, path_imgidx=path_imgidx,
+                     shuffle=shuffle, aug_list=aug, label_width=label_width)

@@ -1,0 +1,287 @@
+"""``mx.recordio`` — RecordIO container + MXNet record packing (reference:
+``python/mxnet/recordio.py`` over dmlc-core's recordio.h).
+
+Byte-compatible with upstream: files written here load in upstream MXNet
+and vice versa. The container hot path (framing scan, multi-part
+reassembly, index builds) runs in C++ (``_native/recordio.cpp``, the role
+of dmlc-core's C++ reader inside ``iter_image_recordio_2.cc``) with a
+pure-Python fallback when no toolchain is available.
+
+Format: ``uint32 magic=0xced7230a; uint32 lrec = cflag<<29 | len;
+payload; pad to 4``. IRHeader packs ``<IfQQ`` (flag, label, id, id2);
+``flag > 0`` means `flag` extra float labels follow the header.
+"""
+from __future__ import annotations
+
+import ctypes
+import os
+import struct
+from collections import namedtuple
+
+import numpy as np
+
+from .base import MXNetError
+
+__all__ = ["MXRecordIO", "MXIndexedRecordIO", "IRHeader", "pack", "unpack",
+           "pack_img", "unpack_img"]
+
+_MAGIC = 0xced7230a
+_LEN_MASK = (1 << 29) - 1
+
+
+class MXRecordIO:
+    """Sequential record file (reference: recordio.py::MXRecordIO)."""
+
+    def __init__(self, uri, flag):
+        self.uri = str(uri)
+        self.flag = flag
+        self._h = None
+        self._lib = None
+        self._pyf = None
+        self.is_open = False
+        self.open()
+
+    def open(self):
+        from ._native import recordio_lib
+
+        if self.flag not in ("r", "w"):
+            raise MXNetError(f"invalid flag {self.flag!r} (use 'r' or 'w')")
+        self._lib = recordio_lib()
+        if self._lib is not None:
+            fn = self._lib.rio_open if self.flag == "r" else \
+                self._lib.rio_create
+            self._h = fn(self.uri.encode())
+            if not self._h:
+                raise MXNetError(f"cannot open {self.uri}")
+        else:
+            self._pyf = open(self.uri, "rb" if self.flag == "r" else "wb")
+        self.is_open = True
+
+    def close(self):
+        if not self.is_open:
+            return
+        if self._h:
+            self._lib.rio_close(self._h)
+            self._h = None
+        if self._pyf:
+            self._pyf.close()
+            self._pyf = None
+        self.is_open = False
+
+    def reset(self):
+        self.close()
+        self.open()
+
+    def __del__(self):
+        self.close()
+
+    def __getstate__(self):
+        d = dict(self.__dict__)
+        d["_h"] = None
+        d["_lib"] = None
+        d["_pyf"] = None
+        is_open = d.pop("is_open")
+        d["_reopen"] = is_open
+        return d
+
+    def __setstate__(self, d):
+        reopen = d.pop("_reopen", False)
+        self.__dict__.update(d)
+        self.is_open = False
+        if reopen:
+            self.open()
+
+    # -- write ---------------------------------------------------------
+    def write(self, buf: bytes):
+        if self.flag != "w":
+            raise MXNetError("record file opened for reading")
+        if self._h:
+            pos = self._lib.rio_write(self._h, bytes(buf), len(buf))
+            if pos == ctypes.c_uint64(-1).value:
+                raise MXNetError("recordio write failed")
+            return pos
+        return self._py_write(buf)
+
+    def _py_write(self, buf):
+        f = self._pyf
+        start = f.tell()
+        data = bytes(buf)
+        kmax = _LEN_MASK
+        off, part = 0, 0
+        while True:
+            n = min(len(data) - off, kmax)
+            remain_after = len(data) - off - n
+            if part == 0 and remain_after == 0:
+                flag = 0
+            elif part == 0:
+                flag = 1
+            elif remain_after == 0:
+                flag = 3
+            else:
+                flag = 2
+            f.write(struct.pack("<II", _MAGIC, (flag << 29) | n))
+            f.write(data[off:off + n])
+            pad = (4 - (n & 3)) & 3
+            if pad:
+                f.write(b"\x00" * pad)
+            off += n
+            part += 1
+            if off >= len(data):
+                return start
+
+    def tell(self):
+        if self._h:
+            return self._lib.rio_tell(self._h)
+        return self._pyf.tell()
+
+    # -- read ----------------------------------------------------------
+    def read(self):
+        """Next record's payload bytes, or None at EOF."""
+        if self.flag != "r":
+            raise MXNetError("record file opened for writing")
+        if self._h:
+            out = ctypes.POINTER(ctypes.c_uint8)()
+            n = self._lib.rio_next(self._h, ctypes.byref(out))
+            if n == 0:
+                return None
+            if n == ctypes.c_uint64(-1).value:
+                raise MXNetError(f"corrupt recordio file {self.uri}")
+            return ctypes.string_at(out, n)
+        return self._py_read()
+
+    def _py_read(self):
+        f = self._pyf
+        parts = []
+        while True:
+            head = f.read(8)
+            if len(head) < 8:
+                return None if not parts else _corrupt(self.uri)
+            magic, lrec = struct.unpack("<II", head)
+            if magic != _MAGIC:
+                _corrupt(self.uri)
+            flag, n = lrec >> 29, lrec & _LEN_MASK
+            payload = f.read(n)
+            if len(payload) < n:
+                _corrupt(self.uri)
+            f.seek((4 - (n & 3)) & 3, os.SEEK_CUR)
+            parts.append(payload)
+            if flag in (0, 3):
+                return b"".join(parts)
+
+    def seek(self, pos):
+        if self.flag != "r":
+            raise MXNetError("seek on write-mode record file")
+        if self._h:
+            self._lib.rio_seek(self._h, int(pos))
+        else:
+            self._pyf.seek(int(pos))
+
+
+def _corrupt(uri):
+    raise MXNetError(f"corrupt recordio file {uri}")
+
+
+class MXIndexedRecordIO(MXRecordIO):
+    """Random-access record file with a text .idx sidecar
+    (reference: recordio.py::MXIndexedRecordIO; idx lines "key\\tpos")."""
+
+    def __init__(self, idx_path, uri, flag, key_type=int):
+        self.idx_path = str(idx_path)
+        self.idx = {}
+        self.keys = []
+        self.key_type = key_type
+        super().__init__(uri, flag)
+
+    def open(self):
+        super().open()
+        self.idx = {}
+        self.keys = []
+        if self.flag == "r" and os.path.exists(self.idx_path):
+            with open(self.idx_path) as f:
+                for line in f:
+                    line = line.strip()
+                    if not line:
+                        continue
+                    k, pos = line.split("\t")
+                    key = self.key_type(k)
+                    self.idx[key] = int(pos)
+                    self.keys.append(key)
+
+    def close(self):
+        if not self.is_open:
+            return
+        if self.flag == "w":
+            with open(self.idx_path, "w") as f:
+                for k in self.keys:
+                    f.write(f"{k}\t{self.idx[k]}\n")
+        super().close()
+
+    def read_idx(self, idx):
+        self.seek(self.idx[idx])
+        return self.read()
+
+    def write_idx(self, idx, buf):
+        key = self.key_type(idx)
+        pos = self.write(buf)
+        self.idx[key] = int(pos)
+        self.keys.append(key)
+
+
+IRHeader = namedtuple("IRHeader", ["flag", "label", "id", "id2"])
+_IR_FORMAT = "<IfQQ"
+_IR_SIZE = struct.calcsize(_IR_FORMAT)
+
+
+def pack(header: IRHeader, s: bytes) -> bytes:
+    """Pack a header + payload into one record (reference: recordio.pack)."""
+    header = IRHeader(*header)
+    label = header.label
+    if isinstance(label, (np.ndarray, list, tuple)):
+        label = np.asarray(label, dtype=np.float32)
+        header = header._replace(flag=label.size, label=0.0)
+        extra = label.tobytes()
+    else:
+        extra = b""
+    return struct.pack(_IR_FORMAT, header.flag, float(header.label),
+                       header.id, header.id2) + extra + bytes(s)
+
+
+def unpack(s: bytes):
+    """Inverse of pack: (IRHeader, payload)."""
+    header = IRHeader(*struct.unpack(_IR_FORMAT, s[:_IR_SIZE]))
+    s = s[_IR_SIZE:]
+    if header.flag > 0:
+        label = np.frombuffer(s[:header.flag * 4], dtype=np.float32)
+        header = header._replace(label=label)
+        s = s[header.flag * 4:]
+    return header, s
+
+
+def pack_img(header, img, quality=95, img_fmt=".jpg"):
+    """Encode an HWC uint8 image and pack it (reference: pack_img; PIL
+    replaces cv2)."""
+    import io as _io
+
+    from PIL import Image
+
+    img = np.asarray(img, dtype=np.uint8)
+    buf = _io.BytesIO()
+    fmt = img_fmt.lower().lstrip(".")
+    fmt = {"jpg": "JPEG", "jpeg": "JPEG", "png": "PNG"}.get(fmt)
+    if fmt is None:
+        raise MXNetError(f"unsupported image format {img_fmt!r}")
+    Image.fromarray(img).save(buf, format=fmt,
+                              **({"quality": quality} if fmt == "JPEG" else {}))
+    return pack(header, buf.getvalue())
+
+
+def unpack_img(s, iscolor=1):
+    """Inverse of pack_img: (IRHeader, HWC uint8 ndarray)."""
+    import io as _io
+
+    from PIL import Image
+
+    header, payload = unpack(s)
+    img = Image.open(_io.BytesIO(payload))
+    img = img.convert("RGB" if iscolor else "L")
+    return header, np.asarray(img)

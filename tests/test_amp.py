@@ -1,0 +1,150 @@
+"""AMP tests (reference: tests/python/gpu/test_amp.py, loss scaler tests)."""
+import numpy as onp
+import pytest
+
+import mxnet_tpu as mx
+from mxnet_tpu import amp, autograd, gluon
+from mxnet_tpu.gluon import nn
+from mxnet_tpu.gluon.loss import L2Loss
+
+
+@pytest.fixture
+def amp_initialized():
+    amp.init("bfloat16")
+    yield
+    amp._deinit_for_tests()
+
+
+class TestOpCasting:
+    def test_target_ops_autocast_to_bf16(self, amp_initialized):
+        x = mx.nd.ones((2, 4))          # float32 input
+        w = mx.nd.ones((3, 4))
+        b = mx.nd.zeros((3,))
+        out = mx.nd.FullyConnected(x, w, b, num_hidden=3)
+        assert str(out.dtype) == "bfloat16"
+
+    def test_fp32_ops_stay_f32(self, amp_initialized):
+        x = mx.nd.ones((2, 4)).astype("bfloat16")
+        out = mx.nd.softmax(x)
+        assert str(out.dtype) == "float32"
+
+    def test_uninitialized_is_untouched(self):
+        out = mx.nd.FullyConnected(mx.nd.ones((2, 4)), mx.nd.ones((3, 4)),
+                                   mx.nd.zeros((3,)), num_hidden=3)
+        assert str(out.dtype) == "float32"
+
+    def test_bad_dtype_rejected(self):
+        with pytest.raises(mx.MXNetError, match="bfloat16"):
+            amp.init("int8")
+
+
+class TestLossScaler:
+    def test_grow_and_backoff(self):
+        s = amp.DynamicLossScaler(init_scale=64.0, scale_factor=2.0,
+                                  scale_window=2)
+        s.update_scale(False)
+        assert s.loss_scale == 64.0
+        s.update_scale(False)           # window hit -> grow
+        assert s.loss_scale == 128.0
+        s.update_scale(True)            # overflow -> backoff
+        assert s.loss_scale == 64.0
+        s.update_scale(False)
+        s.update_scale(True)            # overflow resets the window
+        assert s.loss_scale == 32.0
+
+    def test_overflow_skips_step_and_halves(self, amp_initialized):
+        net = nn.Dense(2, in_units=3)
+        net.initialize()
+        tr = gluon.Trainer(net.collect_params(), "sgd",
+                           {"learning_rate": 0.1})
+        amp.init_trainer(tr)
+        tr._amp_loss_scaler = amp.DynamicLossScaler(init_scale=8.0,
+                                                    scale_window=100)
+        w0 = net.weight.data().asnumpy().copy()
+        x = mx.nd.ones((2, 3))
+        with autograd.record():
+            loss = L2Loss()(net(x), mx.nd.ones((2, 2)))
+        loss.backward()
+        # poison the gradient with inf -> step must be skipped
+        g = net.weight.grad()
+        g._set_data((g * float("inf")).data)
+        tr.step(2)
+        onp.testing.assert_allclose(net.weight.data().asnumpy(), w0)
+        assert tr._amp_loss_scaler.loss_scale == 4.0
+
+    def test_scale_loss_trains_equivalently(self, amp_initialized):
+        def train(with_amp):
+            rs = onp.random.RandomState(3)
+            net = nn.Dense(1, in_units=2)
+            net.initialize()
+            net.weight.set_data(mx.nd.array([[0.5, -0.5]]))
+            net.bias.set_data(mx.nd.zeros((1,)))
+            tr = gluon.Trainer(net.collect_params(), "sgd",
+                               {"learning_rate": 0.1})
+            if with_amp:
+                amp.init_trainer(tr)
+                tr._amp_loss_scaler = amp.DynamicLossScaler(
+                    init_scale=128.0, scale_window=10 ** 9)
+            x = mx.nd.array(rs.randn(8, 2).astype("float32"))
+            y = mx.nd.array(rs.randn(8, 1).astype("float32"))
+            for _ in range(5):
+                with autograd.record():
+                    loss = L2Loss()(net(x), y)
+                    if with_amp:
+                        with amp.scale_loss(loss, tr) as scaled:
+                            scaled.backward()
+                    else:
+                        loss.backward()
+                tr.step(8)
+            return net.weight.data().asnumpy()
+
+        onp.testing.assert_allclose(train(True), train(False),
+                                    rtol=2e-2, atol=1e-3)
+
+    def test_bf16_trainer_scale_is_one(self, amp_initialized):
+        net = nn.Dense(1, in_units=2)
+        net.initialize()
+        tr = gluon.Trainer(net.collect_params(), "sgd", {})
+        amp.init_trainer(tr)
+        assert tr._amp_loss_scaler.loss_scale == 1.0
+
+
+class TestConvert:
+    def test_convert_hybrid_block(self):
+        net = nn.Dense(2, in_units=3)
+        net.initialize()
+        amp.convert_hybrid_block(net)
+        assert str(net.weight.data().dtype) == "bfloat16"
+
+    def test_convert_model_keeps_fp32_list(self):
+        from mxnet_tpu import symbol as sym
+
+        data = sym.var("data")
+        net = sym.FullyConnected(data, name="fc", num_hidden=2)
+        args = {"fc_weight": mx.nd.ones((2, 3)), "fc_bias": mx.nd.ones((2,))}
+        _, cargs, _ = amp.convert_model(net, args, {},
+                                        fp32_params=["fc_bias"])
+        assert str(cargs["fc_weight"].dtype) == "bfloat16"
+        assert str(cargs["fc_bias"].dtype) == "float32"
+
+    def test_unscale_for_clipping(self, amp_initialized):
+        net = nn.Dense(1, in_units=2)
+        net.initialize()
+        net.weight.set_data(mx.nd.array([[1.0, 1.0]]))
+        net.bias.set_data(mx.nd.zeros((1,)))
+        tr = gluon.Trainer(net.collect_params(), "sgd",
+                           {"learning_rate": 0.0})
+        amp.init_trainer(tr)
+        tr._amp_loss_scaler = amp.DynamicLossScaler(init_scale=16.0,
+                                                    scale_window=10 ** 9)
+        x = mx.nd.ones((1, 2))
+        with autograd.record():
+            loss = L2Loss()(net(x), mx.nd.zeros((1, 1)))
+            with amp.scale_loss(loss, tr) as scaled:
+                scaled.backward()
+        g_scaled = net.weight.grad().asnumpy().copy()
+        amp.unscale(tr)
+        g = net.weight.grad().asnumpy()
+        onp.testing.assert_allclose(g * 16.0, g_scaled, rtol=1e-5)
+        tr.step(1)  # lr 0: just exercises the no-double-divide path
+        assert tr._amp_loss_scaler.loss_scale == 16.0

@@ -166,7 +166,7 @@ store.pull(0, outs)
 for o in outs:
     got = o.asnumpy()
     assert np.allclose(got, 10.0), (rank, got[0, 0])  # 1+2+3+4
-print("DIST_OK", store.rank)
+sys.stdout.write(f"DIST_OK {store.rank}\n"); sys.stdout.flush()
 """
 
 

@@ -1,0 +1,219 @@
+"""recordio + mx.image + ImageRecordIter tests (reference:
+tests/python/unittest/test_recordio.py, test_image.py).
+
+Includes the VERDICT #8 'done' criterion: training can be fed from a
+generated recordio file end to end.
+"""
+import os
+import subprocess
+import sys
+
+import numpy as onp
+import pytest
+
+import mxnet_tpu as mx
+from mxnet_tpu import image as img_mod
+from mxnet_tpu import recordio as rio
+from mxnet_tpu.base import MXNetError
+
+
+def _img(i, size=32):
+    rs = onp.random.RandomState(i)
+    return (rs.rand(size, size, 3) * 255).astype("uint8")
+
+
+class TestRecordIO:
+    def test_sequential_round_trip(self, tmp_path):
+        path = str(tmp_path / "t.rec")
+        w = rio.MXRecordIO(path, "w")
+        payloads = [bytes([i]) * (i * 7 + 1) for i in range(20)]
+        for p in payloads:
+            w.write(p)
+        w.close()
+        r = rio.MXRecordIO(path, "r")
+        got = []
+        while True:
+            rec = r.read()
+            if rec is None:
+                break
+            got.append(rec)
+        assert got == payloads
+
+    def test_byte_layout_is_upstream_format(self, tmp_path):
+        """First 8 bytes: magic 0xced7230a, then cflag<<29|len — the
+        dmlc-core recordio framing upstream files use."""
+        import struct
+
+        path = str(tmp_path / "l.rec")
+        w = rio.MXRecordIO(path, "w")
+        w.write(b"abcde")
+        w.close()
+        raw = open(path, "rb").read()
+        magic, lrec = struct.unpack("<II", raw[:8])
+        assert magic == 0xced7230a
+        assert lrec & ((1 << 29) - 1) == 5 and lrec >> 29 == 0
+        assert len(raw) == 8 + 8  # payload padded 5 -> 8
+
+    def test_python_and_native_interop(self, tmp_path):
+        """Files written by the C++ writer parse with the pure-python
+        reader and vice versa."""
+        from mxnet_tpu._native import recordio_lib
+
+        if recordio_lib() is None:
+            pytest.skip("no native toolchain")
+        path = str(tmp_path / "i.rec")
+        w = rio.MXRecordIO(path, "w")     # native writer
+        w.write(b"x" * 10)
+        w.close()
+        r = rio.MXRecordIO(path, "r")
+        r._h = None                        # force python reader
+        r._pyf = open(path, "rb")
+        assert r._py_read() == b"x" * 10
+
+    def test_indexed_random_access(self, tmp_path):
+        idx, recp = str(tmp_path / "r.idx"), str(tmp_path / "r.rec")
+        w = rio.MXIndexedRecordIO(idx, recp, "w")
+        for i in range(10):
+            w.write_idx(i, f"payload-{i}".encode())
+        w.close()
+        r = rio.MXIndexedRecordIO(idx, recp, "r")
+        assert r.keys == list(range(10))
+        assert r.read_idx(7) == b"payload-7"
+        assert r.read_idx(2) == b"payload-2"
+
+    def test_pack_img_unpack_img(self, tmp_path):
+        arr = _img(0)
+        rec = rio.pack_img(rio.IRHeader(0, 3.0, 1, 0), arr, img_fmt=".png")
+        header, out = rio.unpack_img(rec)
+        assert header.label == 3.0
+        onp.testing.assert_array_equal(out, arr)  # png is lossless
+
+    def test_multi_label_pack(self):
+        rec = rio.pack(rio.IRHeader(0, [1.0, 2.0], 5, 0), b"d")
+        h, payload = rio.unpack(rec)
+        assert list(h.label) == [1.0, 2.0] and payload == b"d"
+
+
+class TestImage:
+    def test_imdecode_imresize(self):
+        arr = _img(1, 40)
+        rec = rio.pack_img(rio.IRHeader(0, 0.0, 0, 0), arr, img_fmt=".png")
+        _, payload = rio.unpack(rec)
+        img = img_mod.imdecode(payload)
+        assert img.shape == (40, 40, 3)
+        small = img_mod.imresize(img, 16, 24)
+        assert small.shape == (24, 16, 3)
+
+    def test_resize_short_and_crops(self):
+        arr = _img(2, 48)
+        wide = onp.concatenate([arr, arr], axis=1)  # 48 x 96
+        r = img_mod.resize_short(wide, 32)
+        assert r.shape[0] == 32 and r.shape[1] == 64
+        c, box = img_mod.center_crop(r, (32, 32))
+        assert c.shape == (32, 32, 3)
+        rc, _ = img_mod.random_crop(r, (16, 16))
+        assert rc.shape == (16, 16, 3)
+
+    def test_augmenter_list(self):
+        augs = img_mod.CreateAugmenter((3, 24, 24), resize=28,
+                                       rand_crop=True, rand_mirror=True,
+                                       mean=True, std=True)
+        img = _img(3, 64)
+        out = img
+        for a in augs:
+            out = a(out)
+        arr = out.asnumpy()
+        assert arr.shape == (24, 24, 3) and arr.dtype == onp.float32
+
+    def test_color_jitter_types(self):
+        img = _img(4)
+        for aug in (img_mod.BrightnessJitterAug(0.3),
+                    img_mod.ContrastJitterAug(0.3),
+                    img_mod.SaturationJitterAug(0.3),
+                    img_mod.RandomGrayAug(1.0),
+                    img_mod.LightingAug(0.1, [1.0, 1.0, 1.0],
+                                        onp.eye(3))):
+            out = aug(img)
+            assert out.shape == (32, 32, 3)
+
+
+def _make_dataset(tmp_path, n=12, size=40):
+    idx, recp = str(tmp_path / "d.idx"), str(tmp_path / "d.rec")
+    w = rio.MXIndexedRecordIO(idx, recp, "w")
+    for i in range(n):
+        w.write_idx(i, rio.pack_img(
+            rio.IRHeader(0, float(i % 3), i, 0), _img(i, size),
+            img_fmt=".png"))
+    w.close()
+    return idx, recp
+
+
+class TestImageRecordIter:
+    def test_batches_and_labels(self, tmp_path):
+        idx, recp = _make_dataset(tmp_path)
+        it = mx.io.ImageRecordIter(path_imgrec=recp, path_imgidx=idx,
+                                   data_shape=(3, 32, 32), batch_size=4)
+        batches = list(it)
+        assert len(batches) == 3
+        b = batches[0]
+        assert b.data[0].shape == (4, 3, 32, 32)
+        assert b.label[0].shape == (4,)
+        onp.testing.assert_allclose(b.label[0].asnumpy(),
+                                    [0.0, 1.0, 2.0, 0.0])
+
+    def test_shuffle_reorders(self, tmp_path):
+        import random
+
+        idx, recp = _make_dataset(tmp_path)
+        it = mx.io.ImageRecordIter(path_imgrec=recp, path_imgidx=idx,
+                                   data_shape=(3, 32, 32), batch_size=12,
+                                   shuffle=True)
+        random.seed(3)
+        it.reset()
+        labels = next(it).label[0].asnumpy().tolist()
+        assert sorted(labels) == sorted([float(i % 3) for i in range(12)])
+        assert labels != [float(i % 3) for i in range(12)]
+
+    def test_module_fit_from_recordio(self, tmp_path):
+        """VERDICT #8 done criterion: train from a generated record file."""
+        from mxnet_tpu import symbol as sym
+        from mxnet_tpu.module import Module
+
+        idx, recp = _make_dataset(tmp_path, n=24, size=12)
+        it = mx.io.ImageRecordIter(path_imgrec=recp, path_imgidx=idx,
+                                   data_shape=(3, 8, 8), batch_size=8)
+        data = sym.var("data")
+        net = sym.Flatten(data, name="flat")
+        net = sym.FullyConnected(net, name="fc", num_hidden=3)
+        net = sym.SoftmaxOutput(net, name="softmax")
+        mod = Module(net, data_names=("data",),
+                     label_names=("softmax_label",))
+        mod.fit(it, num_epoch=2, optimizer="sgd",
+                optimizer_params=(("learning_rate", 0.01),))
+        # loss decreased enough to show real training happened
+        score = mod.score(it, "acc")
+        assert score[0][1] >= 0.3
+
+
+class TestIm2Rec:
+    def test_im2rec_tool(self, tmp_path):
+        from PIL import Image
+
+        root = tmp_path / "imgs"
+        for cls in ("cat", "dog"):
+            (root / cls).mkdir(parents=True)
+            for i in range(3):
+                Image.fromarray(_img(i)).save(root / cls / f"{i}.png")
+        prefix = str(tmp_path / "set")
+        repo = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+        out = subprocess.run(
+            [sys.executable, os.path.join(repo, "tools", "im2rec.py"),
+             prefix, str(root)],
+            capture_output=True, text=True, timeout=120)
+        assert out.returncode == 0, out.stdout + out.stderr
+        it = mx.io.ImageRecordIter(path_imgrec=prefix + ".rec",
+                                   path_imgidx=prefix + ".idx",
+                                   data_shape=(3, 32, 32), batch_size=6)
+        b = next(it)
+        assert sorted(b.label[0].asnumpy().tolist()) == [0., 0., 0.,
+                                                         1., 1., 1.]

@@ -1,0 +1,88 @@
+#!/usr/bin/env python
+"""Create an image RecordIO dataset (reference: ``tools/im2rec.py``).
+
+Two modes, like the reference:
+  list mode:   python tools/im2rec.py --list prefix image_root
+  record mode: python tools/im2rec.py prefix image_root [--resize N]
+
+The .lst format is "index\\tlabel\\trelative_path" (one per line); record
+mode packs each listed image into prefix.rec/prefix.idx via
+``mx.recordio.pack_img`` (PIL codecs).
+"""
+from __future__ import annotations
+
+import argparse
+import os
+import sys
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+_EXTS = {".jpg", ".jpeg", ".png", ".bmp"}
+
+
+def make_list(prefix, root):
+    entries = []
+    classes = sorted(d for d in os.listdir(root)
+                     if os.path.isdir(os.path.join(root, d)))
+    label_map = {c: i for i, c in enumerate(classes)}
+    if classes:
+        for c in classes:
+            cdir = os.path.join(root, c)
+            for fn in sorted(os.listdir(cdir)):
+                if os.path.splitext(fn)[1].lower() in _EXTS:
+                    entries.append((label_map[c], os.path.join(c, fn)))
+    else:
+        for fn in sorted(os.listdir(root)):
+            if os.path.splitext(fn)[1].lower() in _EXTS:
+                entries.append((0, fn))
+    with open(prefix + ".lst", "w") as f:
+        for i, (label, rel) in enumerate(entries):
+            f.write(f"{i}\t{label}\t{rel}\n")
+    print(f"wrote {len(entries)} entries to {prefix}.lst")
+
+
+def make_record(prefix, root, resize=0, quality=95):
+    from mxnet_tpu import image as img_mod
+    from mxnet_tpu import recordio as rio
+
+    lst = prefix + ".lst"
+    if not os.path.exists(lst):
+        make_list(prefix, root)
+    rec = rio.MXIndexedRecordIO(prefix + ".idx", prefix + ".rec", "w")
+    n = 0
+    with open(lst) as f:
+        for line in f:
+            line = line.strip()
+            if not line:
+                continue
+            idx, label, rel = line.split("\t")
+            arr = img_mod.imread(os.path.join(root, rel)).asnumpy()
+            if resize:
+                arr = img_mod.resize_short(arr, resize).asnumpy()
+            header = rio.IRHeader(0, float(label), int(idx), 0)
+            rec.write_idx(int(idx), rio.pack_img(header, arr,
+                                                 quality=quality))
+            n += 1
+    rec.close()
+    print(f"packed {n} images into {prefix}.rec / {prefix}.idx")
+
+
+def main(argv=None):
+    ap = argparse.ArgumentParser(description=__doc__)
+    ap.add_argument("prefix")
+    ap.add_argument("root")
+    ap.add_argument("--list", action="store_true", dest="list_mode",
+                    help="only generate the .lst file")
+    ap.add_argument("--resize", type=int, default=0,
+                    help="resize short side before packing")
+    ap.add_argument("--quality", type=int, default=95)
+    args = ap.parse_args(argv)
+    if args.list_mode:
+        make_list(args.prefix, args.root)
+    else:
+        make_record(args.prefix, args.root, args.resize, args.quality)
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())
