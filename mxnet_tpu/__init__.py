@@ -81,6 +81,8 @@ from . import numpy_extension as npx
 from . import contrib
 from . import recordio
 from . import image
+from . import test_utils
+from . import runtime
 from . import amp
 
 from .ndarray import NDArray

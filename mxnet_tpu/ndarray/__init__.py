@@ -121,6 +121,15 @@ for _name in list_ops():
         # forms above keep the short names, matching mx.nd.random's API)
         setattr(random, _name[1:], _w)
 
+from . import sparse  # noqa: E402  (mx.nd.sparse)
+
+# higher-order control flow (python-function arguments — not registry ops)
+from ..ops import control_flow as _control_flow  # noqa: E402
+
+contrib.foreach = _control_flow.foreach
+contrib.while_loop = _control_flow.while_loop
+contrib.cond = _control_flow.cond
+
 # mx.nd.random has MXNet names: uniform/normal/... already set above;
 # add the multisample aliases whose broadcast-parameter form differs.
 random.seed = None  # patched by mxnet_tpu.random module import

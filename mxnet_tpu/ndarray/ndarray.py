@@ -383,10 +383,11 @@ class NDArray:
                                  {"transpose_a": transpose_a, "transpose_b": transpose_b})
 
     def tostype(self, stype):
-        if stype != "default":
-            raise NotImplementedError(
-                "sparse storage types are dense-backed in mxnet_tpu (SURVEY.md §7.3.5)")
-        return self
+        if stype == "default":
+            return self
+        from .sparse import _convert
+
+        return _convert(self, stype)
 
     # ------------------------------------------------------------------
     # indexing

@@ -1,0 +1,209 @@
+"""``mx.nd.sparse`` — sparse storage types (reference:
+``python/mxnet/ndarray/sparse.py`` :: CSRNDArray / RowSparseNDArray).
+
+Dense-backed by design (SURVEY.md §7.3.5): XLA/TPU has no general sparse
+kernel library, and the reference's dominant sparse uses — embedding
+gradients (row_sparse) and bag-of-words batches (csr) — compile to
+efficient dense/gather-scatter XLA today. These classes keep the full
+reference API (indices/indptr/data views, tostype conversions, retain,
+sparse.dot) over a dense payload, so ported code runs unchanged; the
+`aux_data` views are materialized lazily from the payload.
+"""
+from __future__ import annotations
+
+import numpy as _np
+
+from ..base import MXNetError
+from .ndarray import NDArray, _wrap_jax
+
+__all__ = ["BaseSparseNDArray", "CSRNDArray", "RowSparseNDArray",
+           "csr_matrix", "row_sparse_array", "array", "zeros", "empty",
+           "dot", "retain"]
+
+
+def _jnp():
+    import jax.numpy as jnp
+
+    return jnp
+
+
+class BaseSparseNDArray(NDArray):
+    """Common sparse behavior; payload is dense, views are lazy."""
+
+    _stype = "base_sparse"
+
+    @property
+    def stype(self):
+        return self._stype
+
+    def asnumpy(self):
+        return super().asnumpy()
+
+    def tostype(self, stype):
+        return _convert(self, stype)
+
+    def as_nd_ndarray(self):
+        return NDArray(data=self.data, ctx=self._ctx)
+
+    def __repr__(self):
+        return (f"\n<{type(self).__name__} {self.shape} "
+                f"@{self.context}>")
+
+
+class CSRNDArray(BaseSparseNDArray):
+    """Compressed sparse row matrix (reference: sparse.py::CSRNDArray)."""
+
+    _stype = "csr"
+
+    @property
+    def indices(self):
+        """Column indices aux array (per-row concatenated)."""
+        dense = self.asnumpy()
+        cols = [_np.nonzero(row)[0] for row in dense]
+        return NDArray(data=_jnp().asarray(
+            _np.concatenate(cols) if cols else _np.zeros(0),
+            dtype="int64"), ctx=self._ctx)
+
+    @property
+    def indptr(self):
+        dense = self.asnumpy()
+        counts = [0] + [int((row != 0).sum()) for row in dense]
+        return NDArray(data=_jnp().asarray(_np.cumsum(counts),
+                                           dtype="int64"), ctx=self._ctx)
+
+    @property
+    def values(self):
+        dense = self.asnumpy()
+        return NDArray(data=_jnp().asarray(dense[dense != 0]),
+                       ctx=self._ctx)
+
+    # MXNet calls the values view `.data` on sparse arrays, but `.data`
+    # is this framework's payload accessor; `values` is the sparse view.
+
+
+class RowSparseNDArray(BaseSparseNDArray):
+    """Row-sparse array (reference: sparse.py::RowSparseNDArray)."""
+
+    _stype = "row_sparse"
+
+    @property
+    def indices(self):
+        dense = self.asnumpy()
+        rows = _np.nonzero(dense.reshape(dense.shape[0], -1).any(axis=1))[0]
+        return NDArray(data=_jnp().asarray(rows, dtype="int64"),
+                       ctx=self._ctx)
+
+    @property
+    def values(self):
+        dense = self.asnumpy()
+        rows = _np.nonzero(dense.reshape(dense.shape[0], -1).any(axis=1))[0]
+        return NDArray(data=_jnp().asarray(dense[rows]), ctx=self._ctx)
+
+    def retain(self, rows):
+        """Keep only ``rows`` (reference: sparse.retain)."""
+        jnp = _jnp()
+        rows = rows.data.astype("int32") if isinstance(rows, NDArray) \
+            else jnp.asarray(rows, dtype="int32")
+        mask = jnp.zeros((self.shape[0],), bool).at[rows].set(True)
+        kept = jnp.where(mask.reshape((-1,) + (1,) * (len(self.shape) - 1)),
+                         self.data, 0)
+        return RowSparseNDArray(data=kept, ctx=self._ctx)
+
+
+def _convert(arr, stype):
+    cls = {"default": NDArray, "csr": CSRNDArray,
+           "row_sparse": RowSparseNDArray}.get(stype)
+    if cls is None:
+        raise MXNetError(f"unknown storage type {stype!r}")
+    if stype == "csr" and len(arr.shape) != 2:
+        raise MXNetError("csr storage requires a 2-D array")
+    return cls(data=arr.data, ctx=arr.context)
+
+
+def csr_matrix(arg1, shape=None, ctx=None, dtype=None):
+    """Build a CSRNDArray from (data, indices, indptr) or a dense source
+    (reference: sparse.csr_matrix)."""
+    from . import array as nd_array
+
+    if isinstance(arg1, tuple) and len(arg1) == 3:
+        data, indices, indptr = (a.asnumpy() if isinstance(a, NDArray)
+                                 else _np.asarray(a) for a in arg1)
+        if shape is None:
+            raise MXNetError("csr_matrix from aux arrays requires shape")
+        dense = _np.zeros(shape, dtype=dtype or data.dtype)
+        for r in range(shape[0]):
+            lo, hi = int(indptr[r]), int(indptr[r + 1])
+            dense[r, indices[lo:hi].astype(int)] = data[lo:hi]
+        src = nd_array(dense, ctx=ctx)
+    else:
+        src = arg1 if isinstance(arg1, NDArray) else nd_array(
+            _np.asarray(arg1, dtype=dtype), ctx=ctx)
+    return _convert(src, "csr")
+
+
+def row_sparse_array(arg1, shape=None, ctx=None, dtype=None):
+    """Build a RowSparseNDArray from (values, row indices) or a dense
+    source (reference: sparse.row_sparse_array)."""
+    from . import array as nd_array
+
+    if isinstance(arg1, tuple) and len(arg1) == 2:
+        values, indices = (a.asnumpy() if isinstance(a, NDArray)
+                           else _np.asarray(a) for a in arg1)
+        if shape is None:
+            shape = (int(indices.max()) + 1,) + values.shape[1:]
+        dense = _np.zeros(shape, dtype=dtype or values.dtype)
+        dense[indices.astype(int)] = values
+        src = nd_array(dense, ctx=ctx)
+    else:
+        src = arg1 if isinstance(arg1, NDArray) else nd_array(
+            _np.asarray(arg1, dtype=dtype), ctx=ctx)
+    return _convert(src, "row_sparse")
+
+
+def array(source_array, ctx=None, dtype=None, stype=None):
+    """Build a sparse array from a sparse source (reference signature:
+    ``sparse.array(source_array, ctx=None, dtype=None)``). The source's
+    storage type is kept; scipy.sparse inputs become csr; dense inputs
+    need an explicit ``stype=`` (the reference directs them to
+    ``mx.nd.array``)."""
+    from . import array as nd_array
+
+    if isinstance(source_array, BaseSparseNDArray) and stype is None:
+        stype = source_array.stype
+    elif hasattr(source_array, "tocsr") and hasattr(source_array, "toarray"):
+        # scipy.sparse-style object
+        source_array = source_array.toarray()
+        stype = stype or "csr"
+    if stype is None:
+        raise MXNetError(
+            "sparse.array requires a sparse source (or pass stype=); use "
+            "mx.nd.array for dense sources")
+    src = source_array if isinstance(source_array, NDArray) else nd_array(
+        _np.asarray(source_array, dtype=dtype), ctx=ctx)
+    return _convert(src, stype)
+
+
+def zeros(stype, shape, ctx=None, dtype="float32"):
+    from . import zeros as nd_zeros
+
+    return _convert(nd_zeros(shape, ctx=ctx, dtype=dtype), stype)
+
+
+def empty(stype, shape, ctx=None, dtype="float32"):
+    return zeros(stype, shape, ctx=ctx, dtype=dtype)
+
+
+def dot(lhs, rhs, transpose_a=False, transpose_b=False):
+    """sparse.dot — dense-backed matmul; XLA fuses the zero structure."""
+    from .ndarray import imperative_invoke
+    from ..ops.registry import get_op
+
+    return imperative_invoke(get_op("dot"), [lhs, rhs],
+                             {"transpose_a": transpose_a,
+                              "transpose_b": transpose_b})
+
+
+def retain(data, indices):
+    if not isinstance(data, RowSparseNDArray):
+        raise MXNetError("retain expects a RowSparseNDArray")
+    return data.retain(indices)

@@ -1,0 +1,232 @@
+"""Control-flow operators (reference: ``src/operator/control_flow.cc`` +
+``python/mxnet/ndarray/contrib.py`` :: foreach / while_loop / cond).
+
+Dual lowering, mirroring the reference's imperative/symbolic split:
+
+* concrete (eager) inputs — plain Python loops/branches, exactly like the
+  reference's imperative implementations; ops inside the body record on
+  the autograd tape as usual, so gradients flow with no special casing.
+* traced inputs (hybridize / TrainStep / jit) — ``lax.scan`` /
+  ``lax.while_loop`` / ``lax.cond``, the XLA-native forms (SURVEY.md §2.1:
+  data-dependent Python control flow cannot appear inside a jit trace).
+
+Shape contract under tracing: ``while_loop`` requires ``max_iterations``
+and emits fixed-length outputs (steps beyond the dynamic trip count hold
+zeros), the same contract as the reference's symbolic while_loop.
+"""
+from __future__ import annotations
+
+from ..base import MXNetError
+
+__all__ = ["foreach", "while_loop", "cond"]
+
+
+def _nd():
+    from ..ndarray.ndarray import NDArray
+
+    return NDArray
+
+
+def _flatten(x):
+    """Flatten an NDArray / (nested) list-tuple of NDArrays."""
+    NDArray = _nd()
+    if isinstance(x, NDArray):
+        return [x], "leaf"
+    if isinstance(x, (list, tuple)):
+        flat, trees = [], []
+        for item in x:
+            f, t = _flatten(item)
+            flat.extend(f)
+            trees.append((t, len(f)))
+        return flat, ("list", type(x) is tuple, trees)
+    raise MXNetError(f"control flow expects NDArrays or lists, got {type(x)}")
+
+
+def _unflatten(tree, flat, pos=0):
+    if tree == "leaf":
+        return flat[pos], pos + 1
+    _, is_tuple, trees = tree
+    items = []
+    for sub, _ in trees:
+        item, pos = _unflatten(sub, flat, pos)
+        items.append(item)
+    return (tuple(items) if is_tuple else items), pos
+
+
+def _stack_steps(steps):
+    """Stack per-step outputs (list of same-structure results) on axis 0,
+    flattening each step once."""
+    from ..ndarray import stack as nd_stack
+
+    flats = [_flatten(s)[0] for s in steps]
+    _, out_tree = _flatten(steps[0])
+    stacked = []
+    for k in range(len(flats[0])):
+        cols = [f[k] for f in flats]
+        stacked.append(nd_stack(*cols, axis=0) if len(cols) > 1
+                       else cols[0].expand_dims(axis=0))
+    out, _ = _unflatten(out_tree, stacked)
+    return out
+
+
+def _is_traced(arrs):
+    import jax
+
+    return any(isinstance(a.data, jax.core.Tracer) for a in arrs)
+
+
+def _wrap(vals, ctx):
+    NDArray = _nd()
+    return [NDArray(data=v, ctx=ctx) for v in vals]
+
+
+# ---------------------------------------------------------------------------
+
+
+def foreach(body, data, init_states):
+    """Scan ``body`` over axis 0 of ``data`` (reference: contrib.foreach).
+
+    ``body(data_slice, states) -> (outputs, new_states)``. Returns
+    ``(outputs stacked on axis 0, final_states)``.
+    """
+    import jax
+
+    data_flat, data_tree = _flatten(data)
+    states_flat, states_tree = _flatten(init_states)
+    ctx = data_flat[0].context
+    length = data_flat[0].shape[0]
+    for d in data_flat:
+        if d.shape[0] != length:
+            raise MXNetError("foreach: all data inputs must share axis-0 "
+                             f"length; got {d.shape[0]} != {length}")
+
+    if length > 0 and not _is_traced(data_flat + states_flat):
+        # imperative path: python loop; tape records body ops directly.
+        # (length 0 falls through to lax.scan, which traces the body and
+        # emits correctly-structured zero-length outputs.)
+        states = init_states
+        outs_steps = []
+        for i in range(length):
+            sl_flat = [d[i] for d in data_flat]
+            sl, _ = _unflatten(data_tree, sl_flat)
+            outs, states = body(sl, states)
+            outs_steps.append(outs)
+        return _stack_steps(outs_steps), states
+
+    # traced path: one lax.scan
+    cell = {}
+
+    def step(carry, xs):
+        st, _ = _unflatten(states_tree, _wrap(list(carry), ctx))
+        sl, _ = _unflatten(data_tree, _wrap(list(xs), ctx))
+        outs, new_states = body(sl, st)
+        out_flat, out_tree = _flatten(outs)
+        new_flat, _ = _flatten(new_states)
+        cell["out_tree"] = out_tree
+        return (tuple(a.data for a in new_flat),
+                tuple(o.data for o in out_flat))
+
+    final, stacked = jax.lax.scan(
+        step, tuple(s.data for s in states_flat),
+        tuple(d.data for d in data_flat))
+    out, _ = _unflatten(cell["out_tree"], _wrap(list(stacked), ctx))
+    states, _ = _unflatten(states_tree, _wrap(list(final), ctx))
+    return out, states
+
+
+def while_loop(cond, func, loop_vars, max_iterations=None):
+    """Run ``func`` while ``cond`` holds (reference: contrib.while_loop).
+
+    ``cond(*loop_vars) -> scalar``; ``func(*loop_vars) -> (step_output,
+    new_loop_vars)``. Returns ``(stacked step outputs, final loop_vars)``.
+    """
+    import jax
+    import jax.numpy as jnp
+
+    vars_flat, vars_tree = _flatten(list(loop_vars))
+    ctx = vars_flat[0].context
+
+    if not _is_traced(vars_flat):
+        if max_iterations is None:
+            raise MXNetError("while_loop requires max_iterations")
+        steps = []
+        n = 0
+        lv = list(loop_vars)
+        while n < max_iterations and bool(cond(*lv).asnumpy().reshape(())):
+            out, lv = func(*lv)
+            lv = list(lv) if isinstance(lv, (list, tuple)) else [lv]
+            steps.append(out)
+            n += 1
+        if not steps:
+            return [], lv
+        return _stack_steps(steps), lv
+
+    if max_iterations is None:
+        raise MXNetError(
+            "while_loop under trace requires max_iterations (XLA needs "
+            "static output shapes — the reference's symbolic contract)")
+    cell = {}
+
+    # scan over max_iterations with an active mask: differentiable (unlike
+    # lax.while_loop) and keeps the fixed-shape output contract
+    def step(carry, _):
+        active, var_vals = carry
+        lv, _ = _unflatten(vars_tree, _wrap(list(var_vals), ctx))
+        lv = lv if isinstance(lv, list) else [lv]
+        pred = cond(*lv).data.reshape(()).astype(bool)
+        run = jnp.logical_and(active, pred)
+        out, new_lv = func(*lv)
+        new_lv = list(new_lv) if isinstance(new_lv, (list, tuple)) \
+            else [new_lv]
+        out_flat, out_tree = _flatten(out)
+        new_flat, _ = _flatten(new_lv)
+        cell["out_tree"] = out_tree
+        kept = tuple(jnp.where(run, n.data, o)
+                     for n, o in zip(new_flat, var_vals))
+        outs = tuple(jnp.where(run, o.data, jnp.zeros_like(o.data))
+                     for o in out_flat)
+        return (run, kept), outs
+
+    (_, final), stacked = jax.lax.scan(
+        step, (jnp.bool_(True), tuple(v.data for v in vars_flat)),
+        None, length=int(max_iterations))
+    out, _ = _unflatten(cell["out_tree"], _wrap(list(stacked), ctx))
+    fin, _ = _unflatten(vars_tree, _wrap(list(final), ctx))
+    return out, (fin if isinstance(fin, list) else [fin])
+
+
+def cond(pred, then_func, else_func):
+    """Branch on a scalar predicate (reference: contrib.cond).
+
+    ``then_func()``/``else_func()`` are nullary closures returning the same
+    output structure."""
+    import jax
+
+    NDArray = _nd()
+    if not isinstance(pred, NDArray):
+        raise MXNetError("cond: pred must be an NDArray scalar")
+    if not _is_traced([pred]):
+        branch = then_func if bool(pred.asnumpy().reshape(())) else else_func
+        return branch()
+
+    ctx = pred.context
+    cell = {}
+
+    def run(branch, key):
+        def inner(_):
+            out = branch()
+            flat, tree = _flatten(out)
+            cell[key] = tree
+            return tuple(o.data for o in flat)
+
+        return inner
+
+    vals = jax.lax.cond(pred.data.reshape(()).astype(bool),
+                        run(then_func, "then"), run(else_func, "else"), None)
+    if cell["then"] != cell["else"]:
+        raise MXNetError(
+            "cond: then_func and else_func must return the same structure "
+            f"(got {cell['then']} vs {cell['else']})")
+    # both branches trace; the output container follows the then branch
+    out, _ = _unflatten(cell["then"], _wrap(list(vals), ctx))
+    return out

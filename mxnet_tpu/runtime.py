@@ -1,0 +1,83 @@
+"""``mx.runtime`` — build/runtime feature detection (reference:
+``python/mxnet/runtime.py`` over ``src/libinfo.cc``).
+
+The reference enumerates compile-time flags (CUDA, CUDNN, MKLDNN, OPENCV,
+...). Here features are *runtime-probed*: what matters on a JAX/TPU stack
+is which backends, kernels, and native components this process can
+actually use.
+"""
+from __future__ import annotations
+
+import collections
+
+__all__ = ["Feature", "feature_list", "Features"]
+
+Feature = collections.namedtuple("Feature", ["name", "enabled"])
+
+
+def _probe():
+    feats = {}
+
+    def add(name, fn):
+        try:
+            feats[name] = bool(fn())
+        except Exception:
+            feats[name] = False
+
+    import jax
+
+    add("TPU", lambda: any(d.platform != "cpu" for d in jax.devices()))
+    add("CPU", lambda: True)
+    add("BF16", lambda: True)                      # native on XLA everywhere
+    add("X64", lambda: jax.config.read("jax_enable_x64"))
+    add("PALLAS", lambda: __import__(
+        "jax.experimental.pallas", fromlist=["pallas"]) is not None)
+    add("FLASH_ATTENTION", lambda: __import__(
+        "mxnet_tpu.pallas_kernels", fromlist=["flash_attention"]
+    ).flash_attention is not None)
+    # build-level capability (like the reference's compile-time flag):
+    # the coordination-service entry point exists in this jax build
+    add("DIST_KVSTORE",
+        lambda: callable(getattr(jax.distributed, "initialize", None)))
+    add("NATIVE_RECORDIO", lambda: __import__(
+        "mxnet_tpu._native", fromlist=["recordio_lib"]
+    ).recordio_lib() is not None)
+
+    def _pil():
+        import PIL  # noqa: F401
+
+        return True
+
+    add("IMAGE_CODECS", _pil)                       # reference: OPENCV
+    add("AMP", lambda: True)
+    add("INT64_TENSOR_SIZE", lambda: True)
+    # reference flags with no TPU meaning, reported disabled for parity
+    for off in ("CUDA", "CUDNN", "NCCL", "TENSORRT", "MKLDNN", "OPENCV"):
+        feats[off] = False
+    return feats
+
+
+class Features(dict):
+    """Mapping name -> Feature (reference: runtime.Features)."""
+
+    instance = None
+
+    def __init__(self):
+        super().__init__(
+            {n: Feature(n, on) for n, on in _probe().items()})
+
+    def __repr__(self):
+        on = [n for n, f in sorted(self.items()) if f.enabled]
+        off = [n for n, f in sorted(self.items()) if not f.enabled]
+        return f"[✔ {', '.join(on)}] [✖ {', '.join(off)}]"
+
+    def is_enabled(self, feature_name: str) -> bool:
+        name = feature_name.upper()
+        if name not in self:
+            raise RuntimeError(f"unknown feature {feature_name!r}")
+        return self[name].enabled
+
+
+def feature_list():
+    """List of Feature namedtuples (reference: runtime.feature_list)."""
+    return list(Features().values())

@@ -1,0 +1,173 @@
+"""``mx.test_utils`` — the public testing/oracle surface (reference:
+``python/mxnet/test_utils.py``).
+
+SURVEY.md §4 calls this the kernel oracle: numeric-gradient checks by
+central difference, cross-device consistency runs, tolerance-aware
+comparison with located mismatches. The TPU-native consistency check runs
+a function on the CPU oracle device vs the accelerator, replacing the
+reference's cpu-vs-gpu ctx list.
+"""
+from __future__ import annotations
+
+import numpy as _np
+
+from .base import MXNetError
+from .context import Context, cpu, current_context, default_accelerator
+
+__all__ = ["default_context", "set_default_context", "rand_ndarray",
+           "assert_almost_equal", "almost_equal", "same",
+           "check_numeric_gradient", "check_consistency", "rand_shape_2d",
+           "rand_shape_3d", "rand_shape_nd", "effective_dtype",
+           "default_rtols", "default_atols"]
+
+_DEFAULT_RTOL = {
+    _np.dtype(_np.float16): 1e-2, _np.dtype(_np.float32): 1e-4,
+    _np.dtype(_np.float64): 1e-6,
+}
+_DEFAULT_ATOL = {
+    _np.dtype(_np.float16): 1e-3, _np.dtype(_np.float32): 1e-5,
+    _np.dtype(_np.float64): 1e-8,
+}
+
+
+def default_rtols():
+    return dict(_DEFAULT_RTOL)
+
+
+def default_atols():
+    return dict(_DEFAULT_ATOL)
+
+
+def default_context() -> Context:
+    return current_context()
+
+
+def set_default_context(ctx: Context):
+    Context._default_ctx.value = ctx
+
+
+def effective_dtype(arr):
+    """The dtype tolerances should be judged at (bf16 counts as f16-ish)."""
+    dt = getattr(arr, "dtype", None)
+    if str(dt) == "bfloat16":
+        return _np.dtype(_np.float16)
+    try:
+        return _np.dtype(dt)
+    except TypeError:
+        return _np.dtype(_np.float64)
+
+
+def _as_np(a):
+    if hasattr(a, "asnumpy"):
+        return a.asnumpy()
+    return _np.asarray(a)
+
+
+def rand_shape_2d(dim0=10, dim1=10):
+    return tuple(_np.random.randint(1, d + 1) for d in (dim0, dim1))
+
+
+def rand_shape_3d(dim0=10, dim1=10, dim2=10):
+    return tuple(_np.random.randint(1, d + 1) for d in (dim0, dim1, dim2))
+
+
+def rand_shape_nd(num_dim, dim=10):
+    return tuple(_np.random.randint(1, dim + 1, size=num_dim))
+
+
+def rand_ndarray(shape, dtype="float32", ctx=None):
+    from .ndarray import array
+
+    return array(_np.random.randn(*shape).astype(dtype), ctx=ctx)
+
+
+def same(a, b):
+    return _np.array_equal(_as_np(a), _as_np(b))
+
+
+def almost_equal(a, b, rtol=None, atol=None, equal_nan=False):
+    a_np, b_np = _as_np(a), _as_np(b)
+    dt = max(effective_dtype(a), effective_dtype(b),
+             key=lambda d: _DEFAULT_RTOL.get(d, 1e-6))
+    rtol = rtol if rtol is not None else _DEFAULT_RTOL.get(dt, 1e-5)
+    atol = atol if atol is not None else _DEFAULT_ATOL.get(dt, 1e-6)
+    return _np.allclose(a_np.astype(_np.float64), b_np.astype(_np.float64),
+                        rtol=rtol, atol=atol, equal_nan=equal_nan)
+
+
+def assert_almost_equal(a, b, rtol=None, atol=None, names=("a", "b"),
+                        equal_nan=False):
+    """Tolerance-aware comparison with located mismatch report (reference:
+    test_utils.assert_almost_equal)."""
+    a_np = _as_np(a).astype(_np.float64)
+    b_np = _as_np(b).astype(_np.float64)
+    dt = max(effective_dtype(a), effective_dtype(b),
+             key=lambda d: _DEFAULT_RTOL.get(d, 1e-6))
+    rtol = rtol if rtol is not None else _DEFAULT_RTOL.get(dt, 1e-5)
+    atol = atol if atol is not None else _DEFAULT_ATOL.get(dt, 1e-6)
+    if _np.allclose(a_np, b_np, rtol=rtol, atol=atol, equal_nan=equal_nan):
+        return
+    diff = _np.abs(a_np - b_np)
+    denom = _np.abs(b_np) + atol / max(rtol, 1e-300)
+    rel = diff / _np.maximum(denom, 1e-300)
+    idx = _np.unravel_index(_np.argmax(rel), rel.shape) if rel.size else ()
+    raise AssertionError(
+        f"{names[0]} and {names[1]} differ beyond rtol={rtol} atol={atol}: "
+        f"max rel err {rel.max():.3g} at {tuple(int(i) for i in idx)} "
+        f"({names[0]}={a_np[idx]!r}, {names[1]}={b_np[idx]!r}); "
+        f"max abs err {diff.max():.3g}")
+
+
+def check_numeric_gradient(fn, inputs, eps=1e-3, rtol=1e-2, atol=1e-4):
+    """Central-difference gradient oracle for a scalar-output function
+    (reference: check_numeric_gradient; here fn is a python callable over
+    NDArrays so it covers ops, blocks, and compositions alike)."""
+    from . import autograd
+    from .ndarray import array
+
+    inputs = [array(_as_np(x).astype(_np.float64)) for x in inputs]
+    for x in inputs:
+        x.attach_grad()
+    with autograd.record():
+        out = fn(*inputs)
+        if out.shape not in ((), (1,)):
+            out = out.sum()
+    out.backward()
+    for k, x in enumerate(inputs):
+        x_np = x.asnumpy()
+        num = _np.zeros_like(x_np)
+        flat = x_np.reshape(-1)
+        for i in range(flat.size):
+            for sgn in (+1, -1):
+                pert = flat.copy()
+                pert[i] += sgn * eps
+                val = fn(*[array(pert.reshape(x_np.shape))
+                           if j == k else inputs[j]
+                           for j in range(len(inputs))])
+                val = val.sum() if val.shape not in ((), (1,)) else val
+                num.reshape(-1)[i] += sgn * float(val.asnumpy().reshape(()))
+        num /= 2 * eps
+        assert_almost_equal(x.grad, num, rtol=rtol, atol=atol,
+                            names=(f"autograd[{k}]", f"numeric[{k}]"))
+
+
+def check_consistency(fn, inputs, ctx_list=None, rtol=None, atol=None):
+    """Run ``fn`` on each context and compare results against the first
+    (reference: check_consistency over a cpu/gpu ctx_list; here the list
+    defaults to [cpu oracle, local accelerator])."""
+    from .ndarray import array
+
+    ctx_list = ctx_list or [cpu(0), default_accelerator()]
+    results = []
+    for ctx in ctx_list:
+        xs = [array(_as_np(x), ctx=ctx) for x in inputs]
+        out = fn(*xs)
+        outs = out if isinstance(out, (list, tuple)) else [out]
+        results.append([_as_np(o) for o in outs])
+    base = results[0]
+    for ctx, res in zip(ctx_list[1:], results[1:]):
+        for i, (a, b) in enumerate(zip(base, res)):
+            assert_almost_equal(
+                a, b, rtol=rtol, atol=atol,
+                names=(f"{ctx_list[0]}[{i}]", f"{ctx}[{i}]"))
+    return results

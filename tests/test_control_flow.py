@@ -1,0 +1,196 @@
+"""Control-flow op tests (reference:
+tests/python/unittest/test_contrib_control_flow.py).
+
+Each op is checked eager (python-loop path), under autograd, and
+hybridized (lax lowering inside one jit executable) against a numpy
+oracle.
+"""
+import numpy as onp
+import pytest
+
+import mxnet_tpu as mx
+from mxnet_tpu import autograd
+from mxnet_tpu.base import MXNetError
+from mxnet_tpu.gluon import HybridBlock
+
+
+class TestForeach:
+    def test_cumsum_eager(self):
+        data = mx.nd.array(onp.arange(12.0).reshape(4, 3))
+        init = mx.nd.zeros((3,))
+
+        def body(x, s):
+            new = s[0] + x
+            return new, [new]
+
+        outs, states = mx.nd.contrib.foreach(body, data, [init])
+        want = onp.cumsum(onp.arange(12.0).reshape(4, 3), axis=0)
+        onp.testing.assert_allclose(outs.asnumpy(), want)
+        onp.testing.assert_allclose(states[0].asnumpy(), want[-1])
+
+    def test_autograd(self):
+        data = mx.nd.array(onp.ones((3, 2)))
+        data.attach_grad()
+        init = mx.nd.zeros((2,))
+
+        def body(x, s):
+            new = s[0] + 2.0 * x
+            return new, [new]
+
+        with autograd.record():
+            outs, _ = mx.nd.contrib.foreach(body, data, [init])
+            loss = outs.sum()
+        loss.backward()
+        # out_i = 2*sum_{j<=i} x_j; dloss/dx_j = 2*(n - j)
+        onp.testing.assert_allclose(data.grad.asnumpy(),
+                                    onp.array([[6., 6.], [4., 4.],
+                                               [2., 2.]]))
+
+    def test_hybridized_scan(self):
+        class Cum(HybridBlock):
+            def hybrid_forward(self, F, data, init):
+                out, states = F.contrib.foreach(
+                    lambda x, s: (s[0] + x, [s[0] + x]), data, [init])
+                return out, states[0]
+
+        net = Cum()
+        net.hybridize()
+        data = mx.nd.array(onp.arange(10.0).reshape(5, 2))
+        init = mx.nd.zeros((2,))
+        out, last = net(data, init)
+        want = onp.cumsum(onp.arange(10.0).reshape(5, 2), axis=0)
+        onp.testing.assert_allclose(out.asnumpy(), want)
+        onp.testing.assert_allclose(last.asnumpy(), want[-1])
+
+    def test_multi_input_output(self):
+        a = mx.nd.array(onp.ones((4, 2)))
+        b = mx.nd.array(onp.full((4, 2), 2.0))
+
+        def body(xs, s):
+            x, y = xs
+            new = s[0] + x * y
+            return [new, x - y], [new]
+
+        outs, states = mx.nd.contrib.foreach(body, [a, b],
+                                             [mx.nd.zeros((2,))])
+        onp.testing.assert_allclose(outs[0].asnumpy()[-1], [8.0, 8.0])
+        onp.testing.assert_allclose(outs[1].asnumpy()[0], [-1.0, -1.0])
+
+
+class TestWhileLoop:
+    def test_eager_accumulate(self):
+        def cond(i, s):
+            return i < 5
+
+        def func(i, s):
+            return s + i, [i + 1, s + i]
+
+        outs, (i_fin, s_fin) = mx.nd.contrib.while_loop(
+            cond, func, [mx.nd.array([0.0]), mx.nd.array([0.0])],
+            max_iterations=10)
+        assert float(i_fin.asnumpy()) == 5.0
+        assert float(s_fin.asnumpy()) == 10.0   # 0+1+2+3+4
+        assert outs.shape[0] == 5               # actual trip count eagerly
+
+    def test_requires_max_iterations(self):
+        with pytest.raises(MXNetError, match="max_iterations"):
+            mx.nd.contrib.while_loop(lambda i: i < 1,
+                                     lambda i: (i, [i + 1]),
+                                     [mx.nd.array([0.0])])
+
+    def test_hybridized_fixed_shape(self):
+        class Pow(HybridBlock):
+            def hybrid_forward(self, F, x, n):
+                out, (acc, i) = F.contrib.while_loop(
+                    lambda acc, i: i < n.reshape(()),
+                    lambda acc, i: (acc * x, [acc * x, i + 1]),
+                    [F.ones_like(x), F.zeros((1,))],
+                    max_iterations=8)
+                return acc
+
+        net = Pow()
+        net.hybridize()
+        x = mx.nd.array([2.0])
+        for n, want in ((3, 8.0), (5, 32.0)):
+            got = float(net(x, mx.nd.array([float(n)])).asnumpy())
+            assert got == want, (n, got)
+
+    def test_autograd_through_loop(self):
+        x = mx.nd.array([3.0])
+        x.attach_grad()
+        with autograd.record():
+            outs, (acc,) = mx.nd.contrib.while_loop(
+                lambda a: a < 100.0, lambda a: (a, [a * x]),
+                [x * 1.0], max_iterations=10)
+            loss = acc.sum()
+        loss.backward()
+        # acc = x^k first exceeding 100 -> x^5=243; dacc/dx = 5x^4
+        onp.testing.assert_allclose(x.grad.asnumpy(), [5 * 3.0 ** 4])
+
+
+class TestCond:
+    def test_eager_branch(self):
+        x = mx.nd.array([2.0])
+        out = mx.nd.contrib.cond(x.sum() > 1.0,
+                                 lambda: x * 10.0, lambda: x - 1.0)
+        assert float(out.asnumpy()) == 20.0
+        out = mx.nd.contrib.cond(x.sum() < 1.0,
+                                 lambda: x * 10.0, lambda: x - 1.0)
+        assert float(out.asnumpy()) == 1.0
+
+    def test_autograd_chosen_branch(self):
+        x = mx.nd.array([4.0])
+        x.attach_grad()
+        with autograd.record():
+            out = mx.nd.contrib.cond(x.sum() > 0.0,
+                                     lambda: x * x, lambda: x)
+        out.backward()
+        onp.testing.assert_allclose(x.grad.asnumpy(), [8.0])
+
+    def test_hybridized_lax_cond(self):
+        class AbsLike(HybridBlock):
+            def hybrid_forward(self, F, x):
+                return F.contrib.cond(x.sum() >= 0.0,
+                                      lambda: x * 1.0, lambda: -x)
+
+        net = AbsLike()
+        net.hybridize()
+        assert float(net(mx.nd.array([-3.0])).asnumpy()) == 3.0
+        assert float(net(mx.nd.array([5.0])).asnumpy()) == 5.0
+
+
+class TestReviewRegressions:
+    def test_foreach_zero_length(self):
+        out, states = mx.nd.contrib.foreach(
+            lambda x, s: (x * 2, [s[0] + x]),
+            mx.nd.array(onp.zeros((0, 3), "float32")), [mx.nd.ones((3,))])
+        assert out.shape == (0, 3)
+        onp.testing.assert_allclose(states[0].asnumpy(), onp.ones(3))
+
+    def test_cond_mismatched_structures_traced(self):
+        from mxnet_tpu.gluon import HybridBlock
+
+        class Bad(HybridBlock):
+            def hybrid_forward(self, F, x):
+                return F.contrib.cond(x.sum() > 0,
+                                      lambda: (x, x),
+                                      lambda: [x, x])
+
+        net = Bad()
+        net.hybridize()
+        with pytest.raises(MXNetError, match="same structure"):
+            net(mx.nd.array([1.0]))
+
+    def test_cond_traced_container_follows_then(self):
+        from mxnet_tpu.gluon import HybridBlock
+
+        class Pair(HybridBlock):
+            def hybrid_forward(self, F, x):
+                return F.contrib.cond(x.sum() > 0,
+                                      lambda: [x * 2, x],
+                                      lambda: [x, x * 2])
+
+        net = Pair()
+        net.hybridize()
+        out = net(mx.nd.array([1.0]))
+        assert isinstance(out, list) and len(out) == 2

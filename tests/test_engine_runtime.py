@@ -1,0 +1,87 @@
+"""Engine exception propagation + runtime feature tests (reference:
+tests/python/unittest/test_exc_handling.py, test_runtime.py)."""
+import numpy as onp
+import pytest
+
+import mxnet_tpu as mx
+from mxnet_tpu import engine, runtime
+
+
+class TestExcHandling:
+    def test_async_exception_surfaces_at_sync_point(self):
+        """The ThreadedVar-ExceptionRef contract: a failure inside async
+        execution must surface at wait_to_read/asnumpy, not be lost."""
+        import jax
+
+        def boom(x):
+            raise RuntimeError("injected async failure")
+
+        @jax.jit
+        def poisoned(x):
+            return jax.pure_callback(
+                boom, jax.ShapeDtypeStruct(x.shape, x.dtype), x)
+
+        raised_at_sync = False
+        try:
+            bad = poisoned(__import__("jax.numpy", fromlist=["x"])
+                           .ones((2,)))
+            arr = mx.NDArray(data=bad, ctx=mx.cpu())
+            out = arr + 1  # chain an op on the poisoned value
+            try:
+                out.asnumpy()
+            except Exception:
+                raised_at_sync = True
+        except Exception:
+            # backend dispatched synchronously: error surfaced immediately,
+            # which satisfies the contract trivially
+            raised_at_sync = True
+        assert raised_at_sync
+
+    def test_wait_for_all_rethrows(self):
+        import jax
+
+        def boom(x):
+            raise RuntimeError("wait_for_all failure")
+
+        @jax.jit
+        def poisoned(x):
+            return jax.pure_callback(
+                boom, jax.ShapeDtypeStruct(x.shape, x.dtype), x)
+
+        import jax.numpy as jnp
+
+        try:
+            bad = poisoned(jnp.ones((2,)))
+            engine.track(bad)
+            with pytest.raises(Exception):
+                engine.wait_for_all()
+        except Exception:
+            pass  # synchronous dispatch: already raised — acceptable
+
+    def test_naive_engine_raises_eagerly(self):
+        engine.set_engine_type("NaiveEngine")
+        try:
+            with pytest.raises(Exception):
+                mx.nd.ones((2, 3)).reshape((5,))  # shape error surfaces now
+        finally:
+            engine.set_engine_type("ThreadedEnginePerDevice")
+
+    def test_engine_type_validation(self):
+        with pytest.raises(ValueError, match="unknown engine"):
+            engine.set_engine_type("bogus")
+
+
+class TestRuntime:
+    def test_features(self):
+        f = runtime.Features()
+        assert f.is_enabled("CPU")
+        assert f.is_enabled("BF16")
+        assert not f.is_enabled("CUDA")          # parity flag, always off
+        assert f.is_enabled("NATIVE_RECORDIO") in (True, False)
+        with pytest.raises(RuntimeError, match="unknown feature"):
+            f.is_enabled("WARP_DRIVE")
+
+    def test_feature_list(self):
+        feats = runtime.feature_list()
+        names = {f.name for f in feats}
+        assert {"TPU", "PALLAS", "AMP", "IMAGE_CODECS"} <= names

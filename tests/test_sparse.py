@@ -1,0 +1,97 @@
+"""Sparse surface tests (reference: tests/python/unittest/test_sparse_ndarray.py).
+
+Dense-backed semantics per SURVEY.md §7.3.5: the API round-trips and the
+views (indices/indptr/values) match scipy-style expectations."""
+import numpy as onp
+import pytest
+
+import mxnet_tpu as mx
+from mxnet_tpu.base import MXNetError
+from mxnet_tpu.ndarray import sparse
+
+
+def _dense():
+    d = onp.zeros((4, 5), "float32")
+    d[0, 1] = 1.0
+    d[0, 4] = 2.0
+    d[2, 0] = 3.0
+    return d
+
+
+class TestCSR:
+    def test_from_dense_and_views(self):
+        a = mx.nd.array(_dense()).tostype("csr")
+        assert a.stype == "csr" and isinstance(a, sparse.CSRNDArray)
+        onp.testing.assert_array_equal(a.indices.asnumpy(), [1, 4, 0])
+        onp.testing.assert_array_equal(a.indptr.asnumpy(), [0, 2, 2, 3, 3])
+        onp.testing.assert_allclose(a.values.asnumpy(), [1.0, 2.0, 3.0])
+        onp.testing.assert_allclose(a.asnumpy(), _dense())
+
+    def test_from_aux_arrays(self):
+        a = sparse.csr_matrix(([1.0, 2.0, 3.0], [1, 4, 0],
+                               [0, 2, 2, 3, 3]), shape=(4, 5))
+        onp.testing.assert_allclose(a.asnumpy(), _dense())
+
+    def test_tostype_round_trip(self):
+        a = mx.nd.array(_dense()).tostype("csr")
+        back = a.tostype("default")
+        assert back.stype == "default"
+        onp.testing.assert_allclose(back.asnumpy(), _dense())
+
+    def test_csr_requires_2d(self):
+        with pytest.raises(MXNetError, match="2-D"):
+            mx.nd.ones((2, 3, 4)).tostype("csr")
+
+    def test_dot_with_dense(self):
+        a = sparse.csr_matrix(_dense())
+        b = mx.nd.array(onp.arange(10.0).reshape(5, 2).astype("float32"))
+        out = sparse.dot(a, b)
+        onp.testing.assert_allclose(out.asnumpy(), _dense() @ b.asnumpy())
+
+
+class TestRowSparse:
+    def test_views_and_retain(self):
+        a = mx.nd.array(_dense()).tostype("row_sparse")
+        assert a.stype == "row_sparse"
+        onp.testing.assert_array_equal(a.indices.asnumpy(), [0, 2])
+        onp.testing.assert_allclose(a.values.asnumpy(),
+                                    _dense()[[0, 2]])
+        kept = a.retain(mx.nd.array([0.0]))
+        want = _dense().copy()
+        want[2] = 0
+        onp.testing.assert_allclose(kept.asnumpy(), want)
+
+    def test_from_values_indices(self):
+        vals = onp.ones((2, 3), "float32")
+        a = sparse.row_sparse_array((vals, [1, 3]), shape=(5, 3))
+        want = onp.zeros((5, 3), "float32")
+        want[[1, 3]] = 1.0
+        onp.testing.assert_allclose(a.asnumpy(), want)
+
+    def test_zeros_and_bad_stype(self):
+        z = sparse.zeros("row_sparse", (3, 2))
+        assert z.stype == "row_sparse" and float(z.asnumpy().sum()) == 0
+        with pytest.raises(MXNetError, match="storage type"):
+            mx.nd.ones((2, 2)).tostype("bogus")
+
+
+class TestKVStoreRowSparsePull:
+    def test_row_sparse_pull_dense_backed(self):
+        from mxnet_tpu import kvstore as kv
+
+        store = kv.create("local")
+        store.init("emb", mx.nd.ones((6, 2)))
+        out = mx.nd.zeros((6, 2))
+        store.row_sparse_pull("emb", out, row_ids=mx.nd.array([0.0, 3.0]))
+        onp.testing.assert_allclose(out.asnumpy(), onp.ones((6, 2)))
+
+
+class TestReviewRegressions:
+    def test_array_reference_signature(self):
+        src = mx.nd.array(_dense()).tostype("csr")
+        out = sparse.array(src, mx.cpu())   # positional ctx must work
+        assert out.stype == "csr"
+        with pytest.raises(MXNetError, match="mx.nd.array"):
+            sparse.array(onp.ones((2, 2)))
+        out2 = sparse.array(onp.ones((2, 2)), stype="row_sparse")
+        assert out2.stype == "row_sparse"

@@ -1,0 +1,63 @@
+"""mx.test_utils oracle-surface tests (reference: the module is itself the
+test infrastructure — these verify the oracles catch what they must)."""
+import numpy as onp
+import pytest
+
+import mxnet_tpu as mx
+from mxnet_tpu import test_utils as tu
+
+
+class TestAssertAlmostEqual:
+    def test_pass_and_locate_failure(self):
+        a = onp.zeros((3, 4), "float32")
+        b = a.copy()
+        tu.assert_almost_equal(a, b)
+        b[1, 2] = 1.0
+        with pytest.raises(AssertionError, match=r"\(1, 2\)"):
+            tu.assert_almost_equal(a, b)
+
+    def test_dtype_scaled_tolerance(self):
+        a = mx.nd.ones((4,)).astype("bfloat16")
+        b = mx.nd.array([1.004, 1.0, 1.0, 1.0]).astype("bfloat16")
+        tu.assert_almost_equal(a, b)  # within bf16-class tolerance
+        with pytest.raises(AssertionError):
+            tu.assert_almost_equal(onp.ones(4, "float64"),
+                                   onp.ones(4, "float64") + 1e-4)
+
+
+class TestNumericGradient:
+    def test_composite_function(self):
+        tu.check_numeric_gradient(
+            lambda x, y: (x * y + (x ** 2)).sum(),
+            [onp.random.RandomState(0).randn(3, 2),
+             onp.random.RandomState(1).randn(3, 2)])
+
+    def test_catches_wrong_gradient(self):
+        import mxnet_tpu.autograd as ag
+
+        class Bad(ag.Function):
+            def forward(self, x):
+                return x * x
+
+            def backward(self, dy):
+                return dy  # wrong: should be 2x*dy
+
+        def f(x):
+            return Bad()(x).sum()
+
+        with pytest.raises(AssertionError):
+            tu.check_numeric_gradient(f, [onp.array([1.0, 2.0])])
+
+
+class TestConsistency:
+    def test_op_across_contexts(self):
+        res = tu.check_consistency(
+            lambda x: mx.nd.softmax(x),
+            [onp.random.RandomState(2).randn(4, 5).astype("float32")])
+        assert len(res) == 2
+
+    def test_rand_helpers(self):
+        onp.random.seed(0)
+        assert len(tu.rand_shape_nd(4, 6)) == 4
+        arr = tu.rand_ndarray((2, 3))
+        assert arr.shape == (2, 3)
