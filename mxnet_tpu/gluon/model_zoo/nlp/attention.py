@@ -25,7 +25,8 @@ class MultiHeadAttention(HybridBlock):
     """
 
     def __init__(self, units, num_heads, dropout=0.0, use_bias=True,
-                 causal=False, cross=False, prefix=None, params=None):
+                 causal=False, cross=False, ring_axis=None, prefix=None,
+                 params=None):
         super().__init__(prefix=prefix, params=params)
         if units % num_heads:
             raise ValueError(f"units {units} not divisible by heads {num_heads}")
@@ -33,6 +34,9 @@ class MultiHeadAttention(HybridBlock):
         self._num_heads = num_heads
         self._causal = causal
         self._cross = cross
+        # sequence-parallel ring attention over this mesh axis (long
+        # contexts; requires mask-free attention)
+        self._ring_axis = ring_axis
         with self.name_scope():
             if cross:
                 self.q_proj = nn.Dense(units, flatten=False,
@@ -76,7 +80,8 @@ class MultiHeadAttention(HybridBlock):
         if mask is not None:
             out = F._contrib_sdp_attention(q, k, v, mask, causal=self._causal)
         else:
-            out = F._contrib_sdp_attention(q, k, v, causal=self._causal)
+            out = F._contrib_sdp_attention(q, k, v, causal=self._causal,
+                                           ring_axis=self._ring_axis)
         out = self._merge_heads(F, out)
         out = self.out_proj(out)
         if self.dropout is not None:

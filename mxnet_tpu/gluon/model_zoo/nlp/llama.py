@@ -36,7 +36,7 @@ class RMSNorm(HybridBlock):
 
 class LlamaAttention(HybridBlock):
     def __init__(self, units, num_heads, num_kv_heads=None, rope_theta=10000.0,
-                 prefix=None, params=None):
+                 ring_axis=None, prefix=None, params=None):
         super().__init__(prefix=prefix, params=params)
         num_kv_heads = num_kv_heads or num_heads
         if num_heads % num_kv_heads:
@@ -46,6 +46,7 @@ class LlamaAttention(HybridBlock):
         self._kv = num_kv_heads
         self._d = units // num_heads
         self._theta = rope_theta
+        self._ring_axis = ring_axis  # sequence-parallel ring attention
         with self.name_scope():
             self.q_proj = nn.Dense(units, flatten=False, use_bias=False,
                                    prefix="q_")
@@ -69,7 +70,8 @@ class LlamaAttention(HybridBlock):
             rep = self._h // self._kv
             k = F.repeat(k, repeats=rep, axis=1)
             v = F.repeat(v, repeats=rep, axis=1)
-        out = F._contrib_sdp_attention(q, k, v, causal=True)
+        out = F._contrib_sdp_attention(q, k, v, causal=True,
+                                       ring_axis=self._ring_axis)
         out = out.transpose((0, 2, 1, 3)).reshape((b, l, self._units))
         return self.out_proj(out)
 
@@ -94,12 +96,14 @@ class LlamaMLP(HybridBlock):
 
 class LlamaBlock(HybridBlock):
     def __init__(self, units, hidden_size, num_heads, num_kv_heads=None,
-                 rope_theta=10000.0, eps=1e-6, prefix=None, params=None):
+                 rope_theta=10000.0, eps=1e-6, ring_axis=None, prefix=None,
+                 params=None):
         super().__init__(prefix=prefix, params=params)
         with self.name_scope():
             self.attn_norm = RMSNorm(units, eps, prefix="attnnorm_")
             self.attention = LlamaAttention(units, num_heads, num_kv_heads,
-                                            rope_theta, prefix="attn_")
+                                            rope_theta, ring_axis=ring_axis,
+                                            prefix="attn_")
             self.mlp_norm = RMSNorm(units, eps, prefix="mlpnorm_")
             self.mlp = LlamaMLP(units, hidden_size, prefix="mlp_")
 
@@ -114,7 +118,7 @@ class LlamaModel(HybridBlock):
     def __init__(self, vocab_size=128256, num_layers=32, units=4096,
                  hidden_size=14336, num_heads=32, num_kv_heads=8,
                  rope_theta=500000.0, eps=1e-5, tie_weights=False,
-                 prefix=None, params=None):
+                 ring_axis=None, prefix=None, params=None):
         super().__init__(prefix=prefix, params=params)
         self._units = units
         with self.name_scope():
@@ -122,7 +126,8 @@ class LlamaModel(HybridBlock):
             self.blocks = []
             for i in range(num_layers):
                 blk = LlamaBlock(units, hidden_size, num_heads, num_kv_heads,
-                                 rope_theta, eps, prefix=f"layer{i}_")
+                                 rope_theta, eps, ring_axis=ring_axis,
+                                 prefix=f"layer{i}_")
                 self.blocks.append(blk)
                 self.register_child(blk, f"layer{i}")
             self.norm = RMSNorm(units, eps, prefix="norm_")

@@ -43,7 +43,7 @@ def _sdpa_reference(q, k, v, mask, scale, causal, layout="bhld"):
 
 @register("_contrib_sdp_attention", aliases=["sdp_attention"])
 def sdp_attention(query, key, value, mask=None, *, scale=None, causal=False,
-                  flash=True, layout="bhld"):
+                  flash=True, layout="bhld", ring_axis=None):
     """Scaled dot-product attention.
 
     ``layout``: "bhld" (batch, heads, seq, head_dim) or "blhd" (batch, seq,
@@ -58,6 +58,22 @@ def sdp_attention(query, key, value, mask=None, *, scale=None, causal=False,
     """
     if scale is None:
         scale = 1.0 / math.sqrt(query.shape[-1])
+    from ..parallel.ring_attention import ring_active
+
+    if ring_axis is not None and mask is None and ring_active(ring_axis):
+        # sequence-parallel exact attention over the mesh ring; when no
+        # mesh/axis is active we fall through to the normal flash/
+        # reference dispatch below instead of pinning the dense path
+        from ..parallel.ring_attention import ring_attention
+
+        if layout == "blhd":
+            out = ring_attention(query.transpose(0, 2, 1, 3),
+                                 key.transpose(0, 2, 1, 3),
+                                 value.transpose(0, 2, 1, 3),
+                                 axis=ring_axis, causal=causal, scale=scale)
+            return out.transpose(0, 2, 1, 3)
+        return ring_attention(query, key, value, axis=ring_axis,
+                              causal=causal, scale=scale)
     if flash and mask is None:
         from ..pallas_kernels import (flash_attention, flash_attention_scan,
                                       flash_supported)

@@ -15,8 +15,10 @@ from .sharding import (PartitionSpec, ShardingRules, named_sharding,
                        replicated, shard_array, shard_parameters,
                        spec_for_param)
 from .step import TrainStep
+from .ring_attention import ring_attention, ring_attention_sharded
 
-__all__ = ["AXES", "make_mesh", "current_mesh", "use_mesh", "local_devices",
+__all__ = ["ring_attention", "ring_attention_sharded",
+           "AXES", "make_mesh", "current_mesh", "use_mesh", "local_devices",
            "mesh_axis_size", "PartitionSpec", "ShardingRules",
            "named_sharding", "replicated", "shard_array", "shard_parameters",
            "spec_for_param", "TrainStep"]

@@ -1,0 +1,144 @@
+"""Ring attention — sequence/context parallelism over a mesh axis
+(reference capability: long-context training; design follows the Ring
+Attention construction — arXiv:2310.01889 — expressed TPU-natively as
+``shard_map`` + ``lax.ppermute`` over ICI).
+
+Each device holds a sequence shard of Q/K/V. K/V blocks rotate around the
+ring while every device folds them into an online-softmax accumulator for
+its local Q shard, so
+
+* memory per device is O(L_local) — no device ever materializes the full
+  (L, L) score matrix or the full K/V;
+* communication is nearest-neighbor ``ppermute`` riding ICI, overlapping
+  with the per-block attention math;
+* the math is EXACTLY softmax(QK^T)V (the same online-softmax algebra as
+  the Pallas flash kernel, accumulated across ring steps).
+
+Gradients flow by differentiating through the scan (``ppermute``'s
+transpose is the reverse rotation, inserted by AD). Residual note: the
+scan saves the rotating K/V carries, so training memory is O(L) per
+device like gather-based attention — a custom recompute VJP is the
+planned upgrade; inference/scoring is O(L_local).
+"""
+from __future__ import annotations
+
+import math
+
+__all__ = ["ring_attention", "ring_attention_sharded"]
+
+_NEG = -1e30
+
+
+def ring_attention_sharded(q, k, v, axis_name, causal=False, scale=None):
+    """Per-shard body: call inside ``shard_map`` over ``axis_name``.
+
+    q/k/v: (B, H, L_local, D) — this device's sequence shard.
+    """
+    import jax.numpy as jnp
+    from jax import lax
+
+    if scale is None:
+        scale = 1.0 / math.sqrt(q.shape[-1])
+    n = lax.psum(1, axis_name)
+    idx = lax.axis_index(axis_name)
+    b, h, lq, d = q.shape
+    qf = q.astype(jnp.float32) * jnp.float32(scale)
+    perm = [(i, (i + 1) % n) for i in range(n)]
+
+    q_pos = idx * lq + jnp.arange(lq)                     # global positions
+
+    lk = k.shape[2]
+
+    def step(carry, s):
+        acc, m, l, kb, vb = carry
+        k_idx = (idx - s) % n
+
+        def attend(args):
+            acc, m, l = args
+            kf = kb.astype(jnp.float32)
+            scores = jnp.einsum("bhqd,bhkd->bhqk", qf, kf)
+            if causal:
+                k_pos = k_idx * lk + jnp.arange(lk)
+                mask = k_pos[None, :] <= q_pos[:, None]
+                scores = jnp.where(mask[None, None], scores, _NEG)
+            m_new = jnp.maximum(m, scores.max(axis=-1, keepdims=True))
+            p = jnp.exp(scores - m_new)
+            if causal:
+                p = jnp.where(mask[None, None], p, 0.0)
+            alpha = jnp.exp(m - m_new)
+            l_new = l * alpha + p.sum(axis=-1, keepdims=True)
+            acc_new = acc * alpha + jnp.einsum(
+                "bhqk,bhkd->bhqd", p, vb.astype(jnp.float32))
+            return acc_new, m_new, l_new
+
+        if causal:
+            # skip blocks entirely above the diagonal (the ~half of ring
+            # steps whose keys are all in this shard's future)
+            any_visible = k_idx * lk <= idx * lq + (lq - 1)
+            acc, m, l = lax.cond(any_visible, attend,
+                                 lambda args: args, (acc, m, l))
+        else:
+            acc, m, l = attend((acc, m, l))
+        # rotate K/V to the next device; the last step's rotation closes
+        # the ring (XLA elides unused outputs if it can)
+        kb = lax.ppermute(kb, axis_name, perm)
+        vb = lax.ppermute(vb, axis_name, perm)
+        return (acc, m, l, kb, vb), None
+
+    acc0 = jnp.zeros((b, h, lq, d), jnp.float32)
+    m0 = jnp.full((b, h, lq, 1), _NEG, jnp.float32)
+    l0 = jnp.zeros((b, h, lq, 1), jnp.float32)
+    # constants start device-invariant; the scan carries become varying
+    # per shard, so mark the initial values varying over the ring axis
+    mark = getattr(lax, "pcast", None)
+    if mark is not None:
+        acc0 = mark(acc0, (axis_name,), to="varying")
+        m0 = mark(m0, (axis_name,), to="varying")
+        l0 = mark(l0, (axis_name,), to="varying")
+    (acc, m, l, _, _), _ = lax.scan(step, (acc0, m0, l0, k, v),
+                                    jnp.arange(n))
+    out = acc / jnp.where(l == 0.0, 1.0, l)
+    return out.astype(q.dtype)
+
+
+def ring_attention(q, k, v, mesh=None, axis="sp", causal=False, scale=None):
+    """Sequence-parallel exact attention over ``mesh[axis]``.
+
+    q/k/v: GLOBAL (B, H, L, D) arrays (sharded or replicated — the
+    shard_map in_spec lays them on the axis). Returns (B, H, L, D) with
+    the same sequence sharding. Falls back to dense attention when the
+    mesh axis has a single device.
+    """
+    from jax import shard_map
+    from jax.sharding import PartitionSpec as P
+
+    from .mesh import current_mesh
+
+    mesh = mesh or current_mesh()
+    if not ring_active(axis, mesh):
+        from ..ops.attention import _sdpa_reference
+
+        if scale is None:
+            scale = 1.0 / math.sqrt(q.shape[-1])
+        return _sdpa_reference(q, k, v, None, scale, causal)
+    # ONLY the ring axis is manual (axis_names): batch (dp) and head (tp)
+    # shardings stay with GSPMD — making every axis manual would
+    # all-gather q/k/v over the other mesh axes and replicate the
+    # attention compute per dp/tp shard
+    spec = P(None, None, axis, None)
+    fn = shard_map(
+        lambda a, b_, c: ring_attention_sharded(a, b_, c, axis,
+                                                causal=causal, scale=scale),
+        mesh=mesh, in_specs=(spec, spec, spec), out_specs=spec,
+        axis_names=frozenset({axis}))
+    return fn(q, k, v)
+
+
+def ring_active(axis, mesh=None):
+    """True when ring attention would actually run over ``axis`` (a mesh
+    is active and the axis spans more than one device)."""
+    from .mesh import current_mesh
+
+    mesh = mesh or current_mesh()
+    return (mesh is not None and axis in mesh.axis_names
+            and mesh.shape[axis] > 1)

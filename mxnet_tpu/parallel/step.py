@@ -306,8 +306,12 @@ class TrainStep:
                       for v, sh in zip(data_tuple + label_tuple,
                                        entry["batch_sh"])]
         from ..base import execution_platform
+        from .mesh import use_mesh
 
-        with execution_platform(self.mesh.devices.flat[0].platform):
+        # mesh context active during trace: in-graph mesh consumers (ring
+        # attention's shard_map) resolve the step's mesh
+        with execution_platform(self.mesh.devices.flat[0].platform), \
+                use_mesh(self.mesh):
             new_params, new_states, loss_val, outs, aux = jitted(
                 param_vals, state_vals, t, lr, rng, *batch_vals)
 

@@ -1,0 +1,138 @@
+"""Ring attention tests — sequence/context parallelism over the mesh
+(capability row: SURVEY §5.7 long context; Ring Attention construction).
+
+Oracle = dense f32 attention on the full sequence; the ring must be
+numerically exact (same online-softmax algebra), fwd and bwd, causal and
+not, and must compose with the sharded TrainStep on a dp x sp mesh.
+"""
+import numpy as onp
+import pytest
+
+import jax
+import jax.numpy as jnp
+
+import mxnet_tpu as mx
+from mxnet_tpu import autograd, parallel as par
+from mxnet_tpu.ops.attention import _sdpa_reference
+
+
+def _qkv(B=2, H=3, L=32, D=16, seed=0):
+    rs = onp.random.RandomState(seed)
+    return tuple(jnp.asarray(rs.randn(B, H, L, D), jnp.float32)
+                 for _ in range(3))
+
+
+class TestRingExactness:
+    @pytest.mark.parametrize("causal", [False, True])
+    def test_matches_dense_fwd_bwd(self, causal):
+        q, k, v = _qkv()
+        mesh = par.make_mesh({"sp": 8}, devices=jax.devices()[:8])
+        out = par.ring_attention(q, k, v, mesh=mesh, causal=causal)
+        want = _sdpa_reference(q, k, v, None, 1.0 / 4.0, causal)
+        onp.testing.assert_allclose(onp.asarray(out), onp.asarray(want),
+                                    rtol=2e-5, atol=2e-5)
+
+        def loss_ring(a, b, c):
+            return (par.ring_attention(a, b, c, mesh=mesh,
+                                       causal=causal) ** 2).sum()
+
+        def loss_ref(a, b, c):
+            return (_sdpa_reference(a, b, c, None, 1.0 / 4.0,
+                                    causal) ** 2).sum()
+
+        gr = jax.grad(loss_ring, argnums=(0, 1, 2))(q, k, v)
+        gw = jax.grad(loss_ref, argnums=(0, 1, 2))(q, k, v)
+        for a, b, nm in zip(gr, gw, "qkv"):
+            onp.testing.assert_allclose(onp.asarray(a), onp.asarray(b),
+                                        rtol=2e-4, atol=2e-4,
+                                        err_msg=f"d{nm}")
+
+    def test_under_jit_with_sharded_inputs(self):
+        from jax.sharding import NamedSharding, PartitionSpec as P
+
+        q, k, v = _qkv(L=64)
+        mesh = par.make_mesh({"sp": 4}, devices=jax.devices()[:4])
+        sh = NamedSharding(mesh, P(None, None, "sp", None))
+        qs, ks, vs = (jax.device_put(x, sh) for x in (q, k, v))
+        f = jax.jit(lambda a, b, c: par.ring_attention(
+            a, b, c, mesh=mesh, causal=True))
+        out = f(qs, ks, vs)
+        want = _sdpa_reference(q, k, v, None, 1.0 / 4.0, True)
+        onp.testing.assert_allclose(onp.asarray(out), onp.asarray(want),
+                                    rtol=2e-5, atol=2e-5)
+        # output keeps the sequence sharding (no implicit gather)
+        assert out.sharding.spec == P(None, None, "sp", None)
+
+    def test_single_device_axis_falls_back(self):
+        q, k, v = _qkv()
+        mesh = par.make_mesh({"dp": 1}, devices=jax.devices()[:1])
+        out = par.ring_attention(q, k, v, mesh=mesh, axis="sp")
+        want = _sdpa_reference(q, k, v, None, 1.0 / 4.0, False)
+        onp.testing.assert_allclose(onp.asarray(out), onp.asarray(want),
+                                    rtol=1e-5, atol=1e-6)
+
+
+class TestRingInModel:
+    def test_mha_cell_ring_vs_dense(self):
+        """The same MultiHeadAttention weights must produce identical
+        outputs with and without ring_axis under a dp x sp TrainStep."""
+        from mxnet_tpu.gluon import loss as gloss
+        from mxnet_tpu.gluon.model_zoo.nlp.attention import \
+            MultiHeadAttention
+
+        def build(ring):
+            onp.random.seed(0)
+            mx.random.seed(0)
+            cell = MultiHeadAttention(units=16, num_heads=4, causal=True,
+                                      ring_axis="sp" if ring else None)
+            cell.initialize()
+            return cell
+
+        rs = onp.random.RandomState(1)
+        x = mx.nd.array(rs.randn(4, 16, 16).astype(onp.float32))
+        y = mx.nd.array(rs.randn(4, 16, 16).astype(onp.float32))
+
+        losses = {}
+        for ring in (False, True):
+            cell = build(ring)
+            mesh = par.make_mesh({"dp": 2, "sp": 4},
+                                 devices=jax.devices()[:8])
+            step = par.TrainStep(cell, gloss.L2Loss(), "sgd", mesh=mesh,
+                                 seq_axis="sp",
+                                 optimizer_params={"learning_rate": 0.1})
+            l, _ = step(x, y)
+            losses[ring] = float(l.asnumpy())
+        assert losses[True] == pytest.approx(losses[False], rel=1e-5), \
+            losses
+
+
+class TestShardingPreservation:
+    def test_no_allgather_over_other_axes(self):
+        """Round-2 review finding: only the ring axis may be manual —
+        dp/tp shardings must survive and no all-gather may appear."""
+        from jax.sharding import NamedSharding, PartitionSpec as P
+
+        mesh = par.make_mesh({"dp": 2, "sp": 4}, devices=jax.devices()[:8])
+        q = jnp.ones((4, 2, 32, 8), jnp.float32)
+        qs = jax.device_put(q, NamedSharding(mesh,
+                                             P("dp", None, "sp", None)))
+        f = jax.jit(lambda a, b, c: par.ring_attention(
+            a, b, c, mesh=mesh, causal=True))
+        hlo = f.lower(qs, qs, qs).compile().as_text()
+        assert "all-gather" not in hlo
+        out = f(qs, qs, qs)
+        assert out.sharding.spec == P("dp", None, "sp", None)
+
+    def test_ring_axis_without_mesh_takes_normal_dispatch(self):
+        """ring_axis on the op must fall through to flash/reference
+        dispatch when no mesh is active (not pin the dense path)."""
+        import mxnet_tpu as mxx
+
+        q = mxx.nd.array(onp.random.RandomState(0)
+                         .randn(1, 2, 16, 8).astype("float32"))
+        out = mxx.nd.contrib.sdp_attention(q, q, q, causal=True,
+                                           ring_axis="sp")
+        want = _sdpa_reference(q.data, q.data, q.data, None,
+                               1.0 / onp.sqrt(8), True)
+        onp.testing.assert_allclose(out.asnumpy(), onp.asarray(want),
+                                    rtol=1e-5, atol=1e-6)
