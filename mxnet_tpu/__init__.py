@@ -83,6 +83,7 @@ from . import recordio
 from . import image
 from . import test_utils
 from . import runtime
+from . import rtc
 from . import amp
 
 from .ndarray import NDArray

@@ -7,6 +7,7 @@ Gluon API", named in BASELINE.json configs 2-4). Families here:
 * Transformer NMT (`get_transformer`, capability: transformer_en_de_512)
 * BERT (`bert_12_768_12`, `bert_24_1024_16`)
 * Llama-style decoder LM (`llama_3_8b` — stretch config, new capability)
+* MoE expert-parallel FFN (`MoEMLP`, GShard-style — the `ep` mesh axis)
 
 Each family ships Megatron-style tensor-parallel ShardingRules
 (`*_sharding_rules`) consumed by mxnet_tpu.parallel.TrainStep.
@@ -21,6 +22,7 @@ from .bert import (BERTEncoder, BERTModel, bert_12_768_12, bert_24_1024_16,
 from .llama import (RMSNorm, LlamaAttention, LlamaMLP, LlamaBlock,
                     LlamaModel, llama_tiny, llama_3_8b,
                     llama_sharding_rules)
+from .moe import MoEMLP, moe_sharding_rules
 
 _models = {
     "transformer": get_transformer,

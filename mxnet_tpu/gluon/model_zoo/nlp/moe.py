@@ -1,0 +1,78 @@
+"""Mixture-of-Experts layer with expert parallelism (capability row:
+GShard/Switch-style sparse FFN; no upstream-MXNet counterpart — this is
+the `ep` axis of the parallelism zoo).
+
+TPU-native formulation: dense dispatch/combine einsums over an
+``(experts, capacity)`` layout — the GShard construction — so the layer
+is pure tensor algebra inside the jitted step and GSPMD inserts the
+token all-to-alls when expert weights are sharded over the ``ep`` mesh
+axis (``moe_sharding_rules``). No data-dependent shapes: dropped tokens
+(capacity overflow) contribute zero, exactly like the reference GShard
+capacity semantics.
+"""
+from __future__ import annotations
+
+import math
+
+from ...block import HybridBlock
+from ... import nn
+
+__all__ = ["MoEMLP", "moe_sharding_rules"]
+
+
+class MoEMLP(HybridBlock):
+    """Top-k routed expert FFN (drop-in for a dense MLP on (B, L, U)).
+
+    Parameters: ``num_experts`` experts, each a SwiGLU MLP with
+    ``hidden_size`` units; ``top_k`` experts per token; ``capacity_factor``
+    bounds per-expert load (tokens beyond capacity are dropped — their
+    combine weight is zero, the GShard contract).
+    """
+
+    def __init__(self, units, hidden_size, num_experts, top_k=2,
+                 capacity_factor=1.25, prefix=None, params=None):
+        super().__init__(prefix=prefix, params=params)
+        if top_k > num_experts:
+            raise ValueError("top_k cannot exceed num_experts")
+        self._units = units
+        self._hidden = hidden_size
+        self._e = num_experts
+        self._k = top_k
+        self._cf = float(capacity_factor)
+        with self.name_scope():
+            self.router = nn.Dense(num_experts, flatten=False,
+                                   use_bias=False, prefix="router_")
+            # per-expert weights as stacked tensors: ONE einsum per matmul
+            # across all experts (the MXU-friendly layout; 'ep' shards
+            # the leading expert dim)
+            self.gate_up_weight = self.params.get(
+                "gate_up_weight", shape=(num_experts, units,
+                                         2 * hidden_size),
+                init="xavier")
+            self.down_weight = self.params.get(
+                "down_weight", shape=(num_experts, hidden_size, units),
+                init="xavier")
+
+    def hybrid_forward(self, F, x, gate_up_weight, down_weight):
+        b, l, u = x.shape
+        n = b * l
+        tokens = x.reshape((n, u))
+        logits = self.router(tokens)                      # (N, E)
+        probs = F.softmax(logits, axis=-1)
+
+        capacity = max(1, int(math.ceil(n * self._cf * self._k / self._e)))
+        out = F._contrib_moe_dispatch_combine(
+            tokens, probs, gate_up_weight, down_weight,
+            top_k=self._k, capacity=capacity)
+        return out.reshape((b, l, u))
+
+
+def moe_sharding_rules(ep_axis="ep", extra=()):
+    """Expert-parallel layout: expert-stacked weights shard on the expert
+    dim; compose with tensor/data rules via ``extra``."""
+    from ....parallel import ShardingRules
+    from jax.sharding import PartitionSpec as P
+
+    return ShardingRules(list(extra) + [
+        (r"(gate_up|down)_weight$", P(ep_axis, None, None)),
+    ])

@@ -159,3 +159,52 @@ def quantize_v2(data, *, out_type="int8", min_calib_range=None, max_calib_range=
 def dequantize(data, min_range, max_range, *, out_type="float32"):
     scale = jnp.maximum(jnp.abs(min_range), jnp.abs(max_range)) / 127.0
     return data.astype(jnp.float32) * scale
+
+
+@register("_contrib_moe_dispatch_combine", aliases=["moe_dispatch_combine"])
+def moe_dispatch_combine(tokens, probs, gate_up_weight, down_weight, *,
+                         top_k=2, capacity=0):
+    """GShard dense dispatch -> per-expert SwiGLU -> combine.
+
+    tokens (N, U); probs (N, E) router softmax; gate_up (E, U, 2H);
+    down (E, H, U). Top-k gates renormalized over the selected experts;
+    per-expert capacity enforced by position-in-expert cumsum (overflow
+    tokens get zero combine weight — GShard semantics). All dense einsums:
+    under GSPMD with 'ep'-sharded weights these lower to token all-to-alls
+    plus expert-local matmuls on the MXU.
+    """
+    if capacity < 1:
+        raise ValueError(
+            f"moe_dispatch_combine requires capacity >= 1, got {capacity} "
+            "(capacity 0 would silently drop every token)")
+    n, e = probs.shape
+    # top-k selection per token
+    gate_vals, gate_idx = jax.lax.top_k(probs, top_k)       # (N, K)
+    gate_vals = gate_vals / jnp.maximum(
+        gate_vals.sum(-1, keepdims=True), 1e-9)             # renormalize
+    # queue counting in int32: a low-precision cumsum (bf16 tokens under
+    # AMP) stops incrementing past 256 and collides capacity slots
+    sel_i = jax.nn.one_hot(gate_idx, e, dtype=jnp.int32)    # (N, K, E)
+
+    # position of each (token, k) within its expert queue, k-major so a
+    # token's higher-priority assignment claims capacity first
+    flat_i = sel_i.transpose(1, 0, 2).reshape(top_k * n, e)   # (K*N, E)
+    pos = jnp.cumsum(flat_i, axis=0) - flat_i                 # pre-count
+    keep = pos < capacity
+    flat_i = flat_i * keep
+    flat_sel = flat_i.astype(tokens.dtype)
+    pos_idx = jnp.sum(pos * flat_i, axis=-1)                  # (K*N,)
+    cap_oh = jax.nn.one_hot(pos_idx, capacity, dtype=tokens.dtype)
+    # dispatch tensor (N, K, E, C) -> fold K: (N, E, C)
+    disp = (flat_sel[:, :, None] * cap_oh[:, None, :]).reshape(
+        top_k, n, e, capacity)
+    gates = gate_vals.transpose(1, 0)[:, :, None, None]       # (K, N, 1, 1)
+    dispatch = disp.sum(0)                                    # (N, E, C)
+    combine = (disp * gates).sum(0)                           # (N, E, C)
+
+    expert_in = jnp.einsum("nec,nu->ecu", dispatch, tokens)   # (E, C, U)
+    gu = jnp.einsum("ecu,euh->ech", expert_in, gate_up_weight)
+    h = gu.shape[-1] // 2
+    act = jax.nn.silu(gu[..., :h]) * gu[..., h:]
+    expert_out = jnp.einsum("ech,ehu->ecu", act, down_weight)
+    return jnp.einsum("nec,ecu->nu", combine, expert_out)

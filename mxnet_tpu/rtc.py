@@ -1,0 +1,121 @@
+"""``mx.rtc`` — user runtime kernels (reference: ``src/common/rtc.cc`` ::
+``CudaModule``/``CudaKernel``, exposed as ``mx.rtc.CudaModule``).
+
+The reference compiles user CUDA source with NVRTC at runtime. The
+TPU-native counterpart compiles user **Pallas** kernels: a ``PallasModule``
+holds Python kernel functions (the Pallas analogue of a .cu source blob)
+and ``get_kernel`` binds one with block/grid metadata into a callable that
+launches on NDArrays — same two-level API shape as CudaModule, with Mosaic
+as the runtime compiler and VMEM refs instead of raw pointers.
+
+    mod = mx.rtc.PallasModule(dict(
+        axpy=lambda x_ref, y_ref, o_ref, *, alpha: o_ref.__setitem__(
+            ..., alpha * x_ref[...] + y_ref[...])))
+    k = mod.get_kernel("axpy", out_shapes=[("o", "float32", (128, 128))],
+                       alpha=2.0)
+    out, = k.launch([x, y])
+
+``mx.rtc.CudaModule`` raises with guidance (CUDA source cannot target
+the MXU); the name is kept so ported code fails loudly, not with
+AttributeError.
+"""
+from __future__ import annotations
+
+import functools
+
+from .base import MXNetError
+from .context import current_context
+from .ndarray import NDArray
+
+__all__ = ["PallasModule", "PallasKernel", "CudaModule"]
+
+
+class PallasKernel:
+    """A bound user kernel (reference: rtc.cc::CudaKernel).
+
+    ``launch(args)`` maps NDArray inputs to VMEM refs positionally, then
+    the declared outputs; compiled once per input-signature by Mosaic and
+    cached (the reference caches PTX per device the same way).
+    """
+
+    def __init__(self, name, fn, out_shapes, grid=None, interpret=False,
+                 **attrs):
+        self.name = name
+        self._fn = fn
+        self._outs = list(out_shapes)
+        self._grid = grid
+        self._interpret = bool(interpret)
+        self._attrs = dict(attrs)
+        self._cache = {}
+
+    def _build(self, interpret):
+        import jax
+        from jax.experimental import pallas as pl
+
+        out_shape = [jax.ShapeDtypeStruct(tuple(shape), dtype)
+                     for (_n, dtype, shape) in self._outs]
+        kern = functools.partial(self._fn, **self._attrs) if self._attrs \
+            else self._fn
+        kwargs = {}
+        if self._grid is not None:
+            kwargs["grid"] = self._grid
+        return pl.pallas_call(kern, out_shape=out_shape,
+                              interpret=interpret, **kwargs)
+
+    def launch(self, args, ctx=None):
+        """Run on NDArray inputs; returns a list of output NDArrays."""
+        from .base import current_execution_platform
+
+        if ctx is None:
+            ctx = next((a.context for a in args
+                        if isinstance(a, NDArray)), current_context())
+        vals = [a.data if isinstance(a, NDArray) else a for a in args]
+        platform = current_execution_platform(vals[0] if vals else None)
+        interpret = self._interpret or platform != "tpu"
+        # the platform is part of the key: the same shapes may launch both
+        # a Mosaic build (TPU) and an interpreted build (CPU oracle)
+        sig = (interpret,) + tuple((tuple(v.shape), str(v.dtype))
+                                   for v in vals)
+        call = self._cache.get(sig)
+        if call is None:
+            call = self._build(interpret)
+            self._cache[sig] = call
+        outs = call(*vals)
+        outs = outs if isinstance(outs, (list, tuple)) else [outs]
+        return [NDArray(data=o, ctx=ctx) for o in outs]
+
+    __call__ = launch
+
+
+class PallasModule:
+    """A bag of user kernels (reference: rtc.cc::CudaModule).
+
+    ``kernels``: mapping name -> Pallas kernel function (refs first, then
+    keyword attrs). ``get_kernel(name, out_shapes, grid=None, **attrs)``
+    binds launch metadata, mirroring CudaModule.get_kernel's signature
+    declaration step.
+    """
+
+    def __init__(self, kernels, exports=None):
+        if callable(kernels):
+            kernels = {getattr(kernels, "__name__", "kernel"): kernels}
+        self._kernels = dict(kernels)
+        self.exports = list(exports or self._kernels)
+
+    def get_kernel(self, name, out_shapes, grid=None, interpret=False,
+                   **attrs):
+        if name not in self._kernels:
+            raise MXNetError(
+                f"kernel {name!r} not in module (have {self.exports})")
+        if not out_shapes:
+            raise MXNetError("out_shapes is required: [(name, dtype, shape)]")
+        return PallasKernel(name, self._kernels[name], out_shapes,
+                            grid=grid, interpret=interpret, **attrs)
+
+
+class CudaModule:
+    def __init__(self, *a, **k):
+        raise MXNetError(
+            "mx.rtc.CudaModule compiles CUDA source, which cannot target "
+            "the TPU MXU; port the kernel to mx.rtc.PallasModule "
+            "(jax.experimental.pallas) — see SURVEY.md §2.1 RTC row")

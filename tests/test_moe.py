@@ -1,0 +1,111 @@
+"""MoE / expert-parallelism tests (GShard construction; no upstream-MXNet
+counterpart — capability addition, SURVEY §2.4 parallelism zoo).
+
+Oracle: a per-token python loop applying the same top-k routing and
+per-expert SwiGLU with unlimited capacity.
+"""
+import numpy as onp
+import pytest
+
+import jax
+import jax.numpy as jnp
+
+import mxnet_tpu as mx
+from mxnet_tpu import autograd, parallel as par
+from mxnet_tpu.gluon.model_zoo.nlp import MoEMLP, moe_sharding_rules
+
+
+def _oracle(tokens, router_w, gu_w, down_w, top_k):
+    """Unlimited-capacity reference: loop tokens, apply top-k experts."""
+    n, u = tokens.shape
+    logits = tokens @ router_w.T
+    probs = onp.exp(logits - logits.max(-1, keepdims=True))
+    probs /= probs.sum(-1, keepdims=True)
+    out = onp.zeros_like(tokens)
+    for i in range(n):
+        top = onp.argsort(-probs[i])[:top_k]
+        gates = probs[i][top]
+        gates = gates / gates.sum()
+        for g, e in zip(gates, top):
+            gu = tokens[i] @ gu_w[e]
+            h = gu.shape[-1] // 2
+            silu = gu[:h] / (1.0 + onp.exp(-gu[:h]))
+            act = silu * gu[h:]
+            out[i] += g * (act @ down_w[e])
+    return out
+
+
+class TestMoECorrectness:
+    @pytest.mark.parametrize("top_k", [1, 2])
+    def test_matches_per_token_oracle(self, top_k):
+        rs = onp.random.RandomState(0)
+        B, L, U, H, E = 2, 6, 8, 16, 4
+        layer = MoEMLP(U, H, num_experts=E, top_k=top_k,
+                       capacity_factor=8.0)  # ample capacity: no drops
+        layer.initialize()
+        x = mx.nd.array(rs.randn(B, L, U).astype("float32"))
+        out = layer(x).asnumpy()
+        params = {p.name: p.data().asnumpy()
+                  for p in layer.collect_params().values()}
+        router_w = params[layer.router.weight.name]
+        gu_w = params[layer.gate_up_weight.name]
+        down_w = params[layer.down_weight.name]
+        want = _oracle(x.asnumpy().reshape(-1, U), router_w, gu_w, down_w,
+                       top_k).reshape(B, L, U)
+        onp.testing.assert_allclose(out, want, rtol=1e-4, atol=1e-5)
+
+    def test_capacity_drops_tokens(self):
+        rs = onp.random.RandomState(1)
+        layer = MoEMLP(8, 16, num_experts=2, top_k=1, capacity_factor=0.25)
+        layer.initialize()
+        x = mx.nd.array(rs.randn(2, 8, 8).astype("float32"))
+        out = layer(x).asnumpy()
+        assert onp.isfinite(out).all()
+        # with capacity 2 per expert over 16 tokens, most rows are dropped
+        assert (onp.abs(out).sum(axis=-1) == 0).sum() >= 8
+
+    def test_gradients_flow(self):
+        rs = onp.random.RandomState(2)
+        layer = MoEMLP(8, 16, num_experts=4, top_k=2)
+        layer.initialize()
+        x = mx.nd.array(rs.randn(2, 4, 8).astype("float32"))
+        with autograd.record():
+            loss = (layer(x) ** 2).sum()
+        loss.backward()
+        for p in layer.collect_params().values():
+            g = p.grad()
+            assert onp.isfinite(g.asnumpy()).all(), p.name
+        assert onp.abs(layer.gate_up_weight.grad().asnumpy()).max() > 0
+
+
+class TestExpertParallel:
+    def test_trainstep_ep_sharding(self):
+        """dp x ep mesh: expert weights shard over ep, training works, and
+        the loss matches the same model trained on a single device."""
+        from mxnet_tpu import gluon
+        from mxnet_tpu.gluon import loss as gloss
+        from jax.sharding import PartitionSpec as P
+
+        rs = onp.random.RandomState(3)
+        x = mx.nd.array(rs.randn(4, 4, 8).astype("float32"))
+        y = mx.nd.array(rs.randn(4, 4, 8).astype("float32"))
+
+        def run(n_dev, axes, rules):
+            onp.random.seed(0)
+            mx.random.seed(0)
+            layer = MoEMLP(8, 16, num_experts=4, top_k=2,
+                           capacity_factor=8.0)
+            layer.initialize()
+            mesh = par.make_mesh(axes, devices=jax.devices()[:n_dev])
+            step = par.TrainStep(layer, gloss.L2Loss(), "sgd", mesh=mesh,
+                                 rules=rules,
+                                 optimizer_params={"learning_rate": 0.1})
+            losses = [float(step(x, y)[0].asnumpy()) for _ in range(3)]
+            return losses, step, layer
+
+        l1, _, _ = run(1, {"dp": 1}, None)
+        l8, step8, layer8 = run(8, {"dp": 2, "ep": 4},
+                                moe_sharding_rules())
+        onp.testing.assert_allclose(l8, l1, rtol=1e-4)
+        spec = layer8.gate_up_weight.data().data.sharding.spec
+        assert spec == P("ep", None, None), spec

@@ -1,0 +1,66 @@
+"""mx.rtc user-kernel tests (reference: tests/python/gpu/test_rtc.py —
+CudaModule compile/launch round trip, here over Pallas interpret mode)."""
+import numpy as onp
+import pytest
+
+import mxnet_tpu as mx
+from mxnet_tpu import rtc
+from mxnet_tpu.base import MXNetError
+
+
+def _axpy(x_ref, y_ref, o_ref, *, alpha):
+    o_ref[...] = alpha * x_ref[...] + y_ref[...]
+
+
+def _scale_block(x_ref, o_ref):
+    import jax.experimental.pallas as pl
+
+    i = pl.program_id(0)
+    o_ref[...] = x_ref[...] * (i + 1)
+
+
+class TestPallasModule:
+    def test_axpy_launch(self):
+        mod = rtc.PallasModule({"axpy": _axpy})
+        rs = onp.random.RandomState(0)
+        x = mx.nd.array(rs.randn(16, 128).astype("float32"))
+        y = mx.nd.array(rs.randn(16, 128).astype("float32"))
+        k = mod.get_kernel("axpy",
+                           out_shapes=[("o", "float32", (16, 128))],
+                           alpha=2.5)
+        out, = k.launch([x, y])
+        onp.testing.assert_allclose(out.asnumpy(),
+                                    2.5 * x.asnumpy() + y.asnumpy(),
+                                    rtol=1e-5, atol=1e-6)
+        # second launch reuses the compiled executable
+        out2, = k([x, y])
+        assert len(k._cache) == 1
+        onp.testing.assert_allclose(out2.asnumpy(), out.asnumpy())
+
+    def test_grid_kernel(self):
+        from jax.experimental import pallas as pl
+
+        def blocky(x_ref, o_ref):
+            o_ref[...] = x_ref[...] * 2.0
+
+        mod = rtc.PallasModule({"blocky": blocky})
+        k = mod.get_kernel("blocky",
+                           out_shapes=[("o", "float32", (8, 128))])
+        x = mx.nd.ones((8, 128))
+        out, = k.launch([x])
+        onp.testing.assert_allclose(out.asnumpy(), 2.0 * onp.ones((8, 128)))
+
+    def test_unknown_kernel_and_missing_outs(self):
+        mod = rtc.PallasModule({"axpy": _axpy})
+        with pytest.raises(MXNetError, match="not in module"):
+            mod.get_kernel("nope", out_shapes=[("o", "float32", (4,))])
+        with pytest.raises(MXNetError, match="out_shapes"):
+            mod.get_kernel("axpy", out_shapes=[])
+
+    def test_cuda_module_guidance(self):
+        with pytest.raises(MXNetError, match="PallasModule"):
+            rtc.CudaModule("extern C __global__ void k() {}")
+
+    def test_single_function_module(self):
+        mod = rtc.PallasModule(_axpy)
+        assert mod.exports == ["_axpy"]
