@@ -21,7 +21,8 @@ from .bert import (BERTEncoder, BERTModel, bert_12_768_12, bert_24_1024_16,
                    bert_sharding_rules)
 from .llama import (RMSNorm, LlamaAttention, LlamaMLP, LlamaBlock,
                     LlamaModel, llama_tiny, llama_3_8b,
-                    llama_sharding_rules)
+                    llama_sharding_rules, LlamaModelPP, llama_tiny_pp,
+                    llama_pp_sharding_rules)
 from .moe import MoEMLP, moe_sharding_rules
 
 _models = {
@@ -30,6 +31,7 @@ _models = {
     "bert_24_1024_16": bert_24_1024_16,
     "llama_tiny": llama_tiny,
     "llama_3_8b": llama_3_8b,
+    "llama_tiny_pp": llama_tiny_pp,
 }
 
 

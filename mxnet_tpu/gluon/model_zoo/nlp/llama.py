@@ -19,7 +19,8 @@ from ... import nn
 from ...parameter import Parameter
 
 __all__ = ["RMSNorm", "LlamaAttention", "LlamaMLP", "LlamaBlock",
-           "LlamaModel", "llama_tiny", "llama_3_8b", "llama_sharding_rules"]
+           "LlamaModel", "llama_tiny", "llama_3_8b", "llama_sharding_rules",
+           "LlamaModelPP", "llama_tiny_pp", "llama_pp_sharding_rules"]
 
 
 class RMSNorm(HybridBlock):
@@ -145,6 +146,70 @@ class LlamaModel(HybridBlock):
         for blk in self.blocks:
             x = blk(x)
         return self.lm_head(self.norm(x))
+
+
+class LlamaModelPP(HybridBlock):
+    """Llama with the layer trunk pipelined over the mesh's ``pp`` axis.
+
+    ``num_layers = n_stages * layers_per_stage``; the trunk is ONE
+    :class:`~mxnet_tpu.parallel.Pipelined` block whose stage-stacked
+    parameters shard over ``pp`` while embed/norm/head stay GSPMD-managed
+    (replicated over ``pp``, shardable over ``tp``/``dp`` as usual).
+    Off-mesh it computes the identical function sequentially.
+    """
+
+    def __init__(self, vocab_size=256, n_stages=4, layers_per_stage=1,
+                 units=64, hidden_size=128, num_heads=4, num_kv_heads=None,
+                 rope_theta=10000.0, eps=1e-6, n_microbatches=None,
+                 remat=False, ring_axis=None, prefix=None, params=None):
+        super().__init__(prefix=prefix, params=params)
+        from ....parallel.pipeline import Pipelined
+
+        self._units = units
+        with self.name_scope():
+            self.embed = nn.Embedding(vocab_size, units, prefix="embed_")
+            self.trunk = Pipelined(
+                lambda: LlamaBlock(units, hidden_size, num_heads,
+                                   num_kv_heads, rope_theta, eps,
+                                   ring_axis=ring_axis, prefix="stage_"),
+                n_stages=n_stages, layers_per_stage=layers_per_stage,
+                n_microbatches=n_microbatches, remat=remat,
+                prefix="trunk_")
+            self.norm = RMSNorm(units, eps, prefix="norm_")
+            self.lm_head = nn.Dense(vocab_size, flatten=False,
+                                    use_bias=False, prefix="lm_head_")
+
+    def hybrid_forward(self, F, tokens):
+        x = self.embed(tokens)
+        x = self.trunk(x)
+        return self.lm_head(self.norm(x))
+
+
+def llama_tiny_pp(n_stages=4, **kwargs):
+    """Test-sized pipelined config (CI / dry-run)."""
+    cfg = dict(vocab_size=256, n_stages=n_stages, layers_per_stage=1,
+               units=64, hidden_size=128, num_heads=4, num_kv_heads=2,
+               rope_theta=10000.0)
+    cfg.update(kwargs)
+    return LlamaModelPP(**cfg)
+
+
+def llama_pp_sharding_rules(pp_axis="pp", tp_axis="tp"):
+    """PP stage axis on the stacked trunk params, composed with the
+    Megatron TP splits (shifted by the (stage, layer) lead dims) and the
+    usual vocab-parallel embed/head."""
+    from ....parallel import ShardingRules
+    from ....parallel.pipeline import pipeline_sharding_rules
+    from jax.sharding import PartitionSpec as P
+
+    rules = ShardingRules([
+        (r"(embed|lm_head)_weight$", P(tp_axis, None)),
+    ])
+    rules.extend(pipeline_sharding_rules(pp_axis, extra=[
+        (r"pp_.*(q|kv|gateup)_weight$", (tp_axis,)),
+        (r"pp_.*(out|down)_weight$", (None, tp_axis)),
+    ]))
+    return rules
 
 
 def llama_sharding_rules(tp_axis="tp"):

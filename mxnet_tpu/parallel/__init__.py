@@ -16,8 +16,12 @@ from .sharding import (PartitionSpec, ShardingRules, named_sharding,
                        spec_for_param)
 from .step import TrainStep
 from .ring_attention import ring_attention, ring_attention_sharded
+from .pipeline import (Pipelined, pipeline_apply, pipeline_active,
+                       pipeline_sharding_rules)
 
 __all__ = ["ring_attention", "ring_attention_sharded",
+           "Pipelined", "pipeline_apply", "pipeline_active",
+           "pipeline_sharding_rules",
            "AXES", "make_mesh", "current_mesh", "use_mesh", "local_devices",
            "mesh_axis_size", "PartitionSpec", "ShardingRules",
            "named_sharding", "replicated", "shard_array", "shard_parameters",

@@ -1,0 +1,335 @@
+"""Pipeline parallelism — GPipe over a ``pp`` mesh axis.
+
+The reference has no pipeline parallelism (SURVEY.md §2.4 — PP row: "NO";
+listed as the stretch capability for the Llama-scale config). TPU-native
+design: the repeated trunk of a deep network becomes ONE block whose
+parameters carry a leading ``(n_stages, layers_per_stage)`` stage axis
+sharded over the mesh's ``pp`` axis. The forward runs a GPipe schedule
+inside ``shard_map``: every device applies its own stage's layers to a
+circulating activation while microbatches stream in, and activations hop
+stage→stage over ICI via ``lax.ppermute``. The bubble is the usual
+``(n_stages - 1) / (n_microbatches + n_stages - 1)``.
+
+Because the schedule is pure jax (``lax.scan`` + ``ppermute``) it nests
+inside the fused :class:`~mxnet_tpu.parallel.step.TrainStep` executable
+exactly like ring attention does: GSPMD keeps handling the ``dp``/``tp``
+axes while only ``pp`` is manual, and gradients flow by differentiating
+through the scan (``ppermute``'s transpose is the reverse rotation).
+
+Usage::
+
+    trunk = Pipelined(lambda: LlamaBlock(...), n_stages=4,
+                      layers_per_stage=2, n_microbatches=8)
+    # trunk is an ordinary HybridBlock: compose, initialize, train with
+    # TrainStep(net, ..., rules=pipeline_sharding_rules() + model rules)
+"""
+from __future__ import annotations
+
+import math
+import re
+from typing import Optional
+
+import numpy as _np
+
+from .. import initializer as _initializer
+from .. import random_state
+from ..autograd import is_training
+from ..gluon.block import HybridBlock, make_pure_fn
+from ..ndarray import NDArray, array as nd_array
+from .mesh import current_mesh
+
+__all__ = ["pipeline_apply", "Pipelined", "pipeline_sharding_rules",
+           "pipeline_active"]
+
+
+def pipeline_active(axis="pp", mesh=None):
+    """True when a mesh is live and ``axis`` spans more than one device."""
+    mesh = mesh or current_mesh()
+    return (mesh is not None and axis in mesh.axis_names
+            and mesh.shape[axis] > 1)
+
+
+def pipeline_apply(stage_fn, stacked_leaves, x, rng, *, mesh=None,
+                   axis="pp", n_microbatches=None, remat=False):
+    """Run ``x`` through ``n_stages * layers_per_stage`` layers, pipelined.
+
+    Parameters
+    ----------
+    stage_fn : ``stage_fn(leaves, h, key) -> h`` — one layer applied to
+        activation ``h``; ``leaves`` is a tuple of per-layer parameter
+        arrays, ``key`` a PRNG key. Must preserve ``h``'s shape/dtype.
+    stacked_leaves : tuple of arrays, each ``(n_stages, layers_per_stage)
+        + param_shape`` — the stage-stacked parameters.
+    x : ``(B, ...)`` activations (batch-leading).
+    rng : PRNG key (folded per stage/layer/tick for e.g. dropout).
+    mesh : jax Mesh holding the ``axis``; ``None`` → sequential fallback
+        (identical math — the schedule only changes WHERE layers run).
+    n_microbatches : microbatch count (must divide B); default n_stages.
+    remat : rematerialize each layer in the backward (``jax.checkpoint``)
+        — the standard GPipe memory/flops trade.
+    """
+    import jax
+    import jax.numpy as jnp
+    from jax import lax
+
+    n_stages = int(stacked_leaves[0].shape[0])
+    l_per = int(stacked_leaves[0].shape[1])
+    if remat:
+        stage_fn = jax.checkpoint(stage_fn)
+
+    def run_layers(leaves, h, key):
+        def one(hc, sl):
+            lp, i = sl
+            return stage_fn(lp, hc, jax.random.fold_in(key, i)), None
+
+        h, _ = lax.scan(one, h, (leaves, jnp.arange(l_per)))
+        return h
+
+    active = (mesh is not None and axis in mesh.axis_names
+              and mesh.shape[axis] > 1)
+    if not active:
+        # one device (or no mesh): the same layers, applied in order
+        flat = tuple(a.reshape((n_stages * l_per,) + a.shape[2:])
+                     for a in stacked_leaves)
+
+        def one(hc, sl):
+            lp, i = sl
+            return stage_fn(lp, hc, jax.random.fold_in(rng, i)), None
+
+        y, _ = lax.scan(one, x, (flat, jnp.arange(n_stages * l_per)))
+        return y
+
+    if n_stages != mesh.shape[axis]:
+        raise ValueError(
+            f"pipeline has {n_stages} stages but mesh axis '{axis}' spans "
+            f"{mesh.shape[axis]} devices; they must match")
+    n_micro = int(n_microbatches or n_stages)
+    b = x.shape[0]
+    if b % n_micro:
+        raise ValueError(
+            f"batch {b} not divisible by n_microbatches={n_micro}")
+    stream = x.reshape((n_micro, b // n_micro) + x.shape[1:])
+
+    from jax import shard_map
+    from jax.sharding import PartitionSpec as P
+
+    last = n_stages - 1
+    perm = [(i, (i + 1) % n_stages) for i in range(n_stages)]
+
+    def body(local_stacked, stream, key):
+        # local_stacked leaves: (1, l_per, ...) — this device's stage
+        local = tuple(a[0] for a in local_stacked)
+        stage = lax.axis_index(axis)
+        key = jax.random.fold_in(key, stage)
+        state = jnp.zeros_like(stream[0])
+        out = jnp.zeros_like(stream)
+        mark = getattr(lax, "pcast", None)
+        if mark is not None:
+            # invariant zeros become pp-varying carries (see ring_attention)
+            state = mark(state, (axis,), to="varying")
+            out = mark(out, (axis,), to="varying")
+            stream = mark(stream, (axis,), to="varying")
+
+        def tick(carry, t):
+            state, out = carry
+            inj = stream[jnp.minimum(t, n_micro - 1)]
+            h = jnp.where(stage == 0, inj, state)
+            y = run_layers(local, h, jax.random.fold_in(key, t))
+            widx = jnp.clip(t - last, 0, n_micro - 1)
+            take = jnp.logical_and(stage == last, t >= last)
+            out = jnp.where(take, out.at[widx].set(y), out)
+            state = lax.ppermute(y, axis, perm)
+            return (state, out), None
+
+        (_, out), _ = lax.scan(tick, (state, out),
+                               jnp.arange(n_micro + last))
+        # results live on the last stage; broadcast so the (replicated-
+        # over-pp) head/loss sees them everywhere
+        out = lax.psum(jnp.where(stage == last, out, jnp.zeros_like(out)),
+                       axis)
+        return out
+
+    fn = shard_map(body, mesh=mesh,
+                   in_specs=(P(axis), P(), P()), out_specs=P(),
+                   axis_names=frozenset({axis}))
+    out = fn(stacked_leaves, stream, rng)
+    return out.reshape(x.shape)
+
+
+class _StackedInit(_initializer.Initializer):
+    """Initialize a stage-stacked parameter by applying the template
+    layer's own initializer independently per (stage, layer) copy — so a
+    pipelined trunk starts from the same distribution as ``n`` separately
+    constructed layers."""
+
+    def __init__(self, base, lead):
+        super().__init__()
+        self._base = base
+        self._lead = tuple(lead)
+
+    def __call__(self, desc, arr):
+        copies = 1
+        for d in self._lead:
+            copies *= int(d)
+        sub = tuple(arr.shape[len(self._lead):])
+        outs = []
+        for _ in range(copies):
+            host = nd_array(_np.zeros(sub, dtype="float32"))
+            self._base(_initializer.InitDesc(str(desc),
+                                             global_init=self._base), host)
+            outs.append(host.asnumpy())
+        arr[:] = _np.stack(outs).reshape(arr.shape)
+
+
+class Pipelined(HybridBlock):
+    """A stack of identical layers executed as a GPipe pipeline.
+
+    ``stage_factory() -> HybridBlock`` builds ONE layer (activation-in,
+    activation-out, shape-preserving). The trunk owns
+    ``n_stages * layers_per_stage`` independent copies of that layer's
+    parameters, stacked with a leading ``(n_stages, layers_per_stage)``
+    axis; :func:`pipeline_sharding_rules` lays the stage axis over the
+    mesh's ``pp`` dimension and :class:`TrainStep` compiles the schedule
+    into the fused training step.
+
+    Off-mesh (no ``pp`` axis, or a single device) the block computes the
+    identical function sequentially, so models build/run/test anywhere.
+
+    Notes: the template layer must not mutate aux state (BatchNorm-style
+    moving stats) — stages run inside ``lax.scan`` where write-back has
+    no defined order; use normalization without running stats (LayerNorm/
+    RMSNorm), as transformer trunks do.
+    """
+
+    def __init__(self, stage_factory, n_stages, layers_per_stage=1,
+                 axis="pp", n_microbatches=None, remat=False,
+                 prefix=None, params=None):
+        super().__init__(prefix=prefix, params=params)
+        self._n_stages = int(n_stages)
+        self._l_per = int(layers_per_stage)
+        self._axis = axis
+        self._n_micro = n_microbatches
+        self._remat = bool(remat)
+        with self.name_scope():
+            tmpl = stage_factory()
+        if not isinstance(tmpl, HybridBlock):
+            raise TypeError("stage_factory must build a HybridBlock")
+        # NOT registered as a child: the template's own parameters are a
+        # shape/init donor, never trained — the stacked copies are.
+        self._template_holder = [tmpl]
+        self._stacked = None       # list[Parameter], 1:1 with _tmpl_params
+        self._tmpl_params = None   # list[Parameter] of the template
+
+    # -- parameter lifecycle -------------------------------------------
+    def _ensure_stub_params(self):
+        """Create the stacked Parameters (deferred shapes allowed) so
+        ``collect_params``/``initialize`` see them before first forward."""
+        if self._stacked is not None:
+            return
+        tmpl = self._template_holder[0]
+        tparams = list(tmpl.collect_params().values())
+        lead = (self._n_stages, self._l_per)
+        stacked = []
+        for p in tparams:
+            base = _initializer.create(p.init)
+            shape = None
+            if p.shape is not None and all(s > 0 for s in p.shape):
+                shape = lead + tuple(p.shape)
+            name = p.name
+            if name.startswith(self.prefix):
+                name = name[len(self.prefix):]
+            name = "pp_" + name.replace(".", "_")
+            sp = self.params.get(name, shape=shape,
+                                 init=_StackedInit(base, lead),
+                                 allow_deferred_init=True)
+            stacked.append(sp)
+        self._tmpl_params = tparams
+        self._stacked = stacked
+
+    def collect_params(self, select=None):
+        self._ensure_stub_params()
+        return super().collect_params(select)
+
+    def _settle(self, x):
+        """Resolve deferred shapes: settle the template on a sample
+        microbatch, then size + initialize the stacked parameters."""
+        ctx = x.context
+        tmpl = self._template_holder[0]
+        tparams = self._tmpl_params
+        if any(p._data is None for p in tparams):
+            tmpl.initialize(ctx=ctx)
+            sample = x[0:1]
+            tmpl(sample)
+        lead = (self._n_stages, self._l_per)
+        for p, sp in zip(tparams, self._stacked):
+            if sp._data is not None:
+                continue
+            sp.shape = lead + tuple(p.shape)
+            if sp._deferred_init is not None:
+                sp._finish_deferred_init()
+            else:
+                sp.initialize(ctx=ctx)
+
+    # -- forward --------------------------------------------------------
+    def _eager_forward(self, x):
+        import jax
+
+        self._ensure_stub_params()
+        if any(sp._data is None for sp in self._stacked):
+            if isinstance(x.data, jax.core.Tracer):
+                raise RuntimeError(
+                    "Pipelined parameters have deferred shapes; run one "
+                    "eager forward (or TrainStep, which does) before "
+                    "tracing")
+            self._settle(x)
+        ctx = x.context
+        tmpl = self._template_holder[0]
+        tmpl_arrays = [p.data(ctx) for p in self._tmpl_params]
+        pure, _cell = make_pure_fn(tmpl, tmpl_arrays, ctx, is_training())
+
+        def stage_fn(leaves, h, key):
+            out_vals, aux_vals = pure(tuple(leaves), key, h)
+            if aux_vals:
+                raise RuntimeError(
+                    "Pipelined stage mutates aux state (e.g. BatchNorm "
+                    "running stats) — unsupported inside the pipeline "
+                    "scan; use LayerNorm/RMSNorm in the stage")
+            return out_vals[0]
+
+        stacked_vals = tuple(sp.data(ctx).data for sp in self._stacked)
+        mesh = current_mesh()
+        y = pipeline_apply(stage_fn, stacked_vals, x.data,
+                           random_state.get_state_key(), mesh=mesh,
+                           axis=self._axis, n_microbatches=self._n_micro,
+                           remat=self._remat)
+        return NDArray(data=y, ctx=ctx)
+
+    def hybrid_forward(self, F, x, **params):  # pragma: no cover
+        raise NotImplementedError(
+            "Pipelined lowers through _eager_forward (jit/TrainStep); the "
+            "legacy symbolic composition path is not supported")
+
+
+def pipeline_sharding_rules(axis="pp", extra=None):
+    """Rules laying every ``pp_*`` stacked parameter's stage axis over the
+    mesh's pipeline axis. ``extra`` maps inner-dimension rules for
+    composing with tensor parallelism, e.g.::
+
+        pipeline_sharding_rules(extra=[
+            (r"pp_.*(q|kv|gateup)_weight$", (None, "tp")),   # dims after
+            (r"pp_.*(out|down)_weight$",    (None, None, "tp")),
+        ])
+
+    where the tuple gives entries for dims AFTER the (stage, layer) lead.
+    """
+    from jax.sharding import PartitionSpec as P
+
+    from .sharding import ShardingRules
+
+    rules = []
+    for pat, inner in (extra or []):
+        rules.append((pat, P(axis, None, *inner)))
+    # plain substring (not \b-anchored): '_' is a word character, so a
+    # boundary would never match inside prefixed names like 'trunk_pp_...'
+    rules.append((r"pp_", P(axis)))
+    return ShardingRules(rules)

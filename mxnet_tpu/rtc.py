@@ -74,8 +74,11 @@ class PallasKernel:
         interpret = self._interpret or platform != "tpu"
         # the platform is part of the key: the same shapes may launch both
         # a Mosaic build (TPU) and an interpreted build (CPU oracle)
-        sig = (interpret,) + tuple((tuple(v.shape), str(v.dtype))
-                                   for v in vals)
+        import numpy as _np
+
+        # np.shape/np.result_type so raw scalars/lists are legal operands
+        sig = (interpret,) + tuple(
+            (tuple(_np.shape(v)), _np.result_type(v).name) for v in vals)
         call = self._cache.get(sig)
         if call is None:
             call = self._build(interpret)

@@ -1,0 +1,189 @@
+"""Pipeline parallelism tests (SURVEY §2.4 PP row — new TPU capability).
+
+Oracle = the sequential fallback: the GPipe schedule over the ``pp`` mesh
+axis must compute the SAME function as applying the stacked layers in
+order on one device — fwd and bwd — and must compose with the fused
+sharded TrainStep (dp x pp, and dp x pp x tp).
+"""
+import numpy as onp
+import pytest
+
+import jax
+import jax.numpy as jnp
+
+import mxnet_tpu as mx
+from mxnet_tpu import autograd, parallel as par
+from mxnet_tpu.gluon import loss as gloss, nn
+from mxnet_tpu.gluon.model_zoo import nlp
+from mxnet_tpu.parallel.pipeline import pipeline_apply
+
+
+def _stacked_mlp(n_stages, l_per, d, seed=0):
+    """Stage params for a toy residual-MLP layer: h + tanh(h @ W + b)."""
+    rs = onp.random.RandomState(seed)
+    w = jnp.asarray(rs.randn(n_stages, l_per, d, d) * 0.3, jnp.float32)
+    b = jnp.asarray(rs.randn(n_stages, l_per, d) * 0.1, jnp.float32)
+    return (w, b)
+
+
+def _stage_fn(leaves, h, key):
+    w, b = leaves
+    return h + jnp.tanh(h @ w + b)
+
+
+class TestPipelineApply:
+    @pytest.mark.parametrize("n_stages,l_per,n_micro",
+                             [(4, 1, 4), (4, 2, 8), (2, 3, 2), (8, 1, 4)])
+    def test_matches_sequential(self, n_stages, l_per, n_micro):
+        d, B = 16, 8
+        stacked = _stacked_mlp(n_stages, l_per, d)
+        rs = onp.random.RandomState(1)
+        x = jnp.asarray(rs.randn(B, 6, d), jnp.float32)
+        key = jax.random.PRNGKey(0)
+        mesh = par.make_mesh({"pp": n_stages},
+                             devices=jax.devices()[:n_stages])
+        want = pipeline_apply(_stage_fn, stacked, x, key, mesh=None)
+        got = pipeline_apply(_stage_fn, stacked, x, key, mesh=mesh,
+                             n_microbatches=n_micro)
+        onp.testing.assert_allclose(onp.asarray(got), onp.asarray(want),
+                                    rtol=2e-5, atol=2e-5)
+
+    def test_grads_match_sequential(self):
+        n_stages, l_per, d, B = 4, 2, 12, 8
+        stacked = _stacked_mlp(n_stages, l_per, d, seed=2)
+        rs = onp.random.RandomState(3)
+        x = jnp.asarray(rs.randn(B, 4, d), jnp.float32)
+        key = jax.random.PRNGKey(0)
+        mesh = par.make_mesh({"pp": n_stages},
+                             devices=jax.devices()[:n_stages])
+
+        def loss(params, xx, m):
+            y = pipeline_apply(_stage_fn, params, xx, key, mesh=m,
+                               n_microbatches=4)
+            return (y ** 2).sum()
+
+        gw = jax.grad(loss)(stacked, x, None)
+        gp = jax.grad(loss)(stacked, x, mesh)
+        for a, b, nm in zip(gp, gw, "wb"):
+            onp.testing.assert_allclose(onp.asarray(a), onp.asarray(b),
+                                        rtol=2e-4, atol=2e-4,
+                                        err_msg=f"d{nm}")
+
+    def test_remat_matches(self):
+        n_stages, d = 4, 8
+        stacked = _stacked_mlp(n_stages, 1, d, seed=4)
+        x = jnp.asarray(onp.random.RandomState(5).randn(4, 3, d),
+                        jnp.float32)
+        key = jax.random.PRNGKey(0)
+        mesh = par.make_mesh({"pp": n_stages},
+                             devices=jax.devices()[:n_stages])
+
+        def loss(params, remat):
+            y = pipeline_apply(_stage_fn, params, x, key, mesh=mesh,
+                               remat=remat)
+            return (y ** 2).sum()
+
+        g0 = jax.grad(loss)(stacked, False)
+        g1 = jax.grad(loss)(stacked, True)
+        for a, b in zip(g1, g0):
+            onp.testing.assert_allclose(onp.asarray(a), onp.asarray(b),
+                                        rtol=2e-5, atol=2e-5)
+
+    def test_bad_shapes_raise(self):
+        stacked = _stacked_mlp(4, 1, 8)
+        x = jnp.zeros((6, 8), jnp.float32)  # 6 not divisible by 4
+        mesh = par.make_mesh({"pp": 4}, devices=jax.devices()[:4])
+        with pytest.raises(ValueError, match="divisible"):
+            pipeline_apply(_stage_fn, stacked, x, jax.random.PRNGKey(0),
+                           mesh=mesh, n_microbatches=4)
+        mesh2 = par.make_mesh({"pp": 2}, devices=jax.devices()[:2])
+        with pytest.raises(ValueError, match="stages"):
+            pipeline_apply(_stage_fn, stacked, x, jax.random.PRNGKey(0),
+                           mesh=mesh2)
+
+
+class TestPipelinedBlock:
+    def test_offmesh_forward_and_param_surface(self):
+        net = nlp.llama_tiny_pp(n_stages=2, layers_per_stage=2)
+        net.initialize()
+        tokens = mx.nd.array(onp.random.RandomState(0).randint(
+            0, 256, (4, 8)), dtype="int32")
+        out = net(tokens)
+        assert out.shape == (4, 8, 256)
+        names = list(net.collect_params())
+        stacked = [n for n in names if "pp_" in n]
+        # 2 norms + 3 attn denses + 2 mlp denses per stage template
+        assert len(stacked) == 7
+        for n in stacked:
+            p = net.collect_params()[n]
+            assert tuple(p.shape[:2]) == (2, 2), n
+        # template's own (donor) params are NOT in the trainable surface
+        assert not any("stage_" in n and "pp_" not in n for n in names)
+
+    def test_trainstep_pp_matches_offmesh_loss(self):
+        """Same init → first-step loss identical on-mesh and off-mesh."""
+        onp.random.seed(7)
+        rs = onp.random.RandomState(11)
+        tokens = rs.randint(0, 256, (8, 8)).astype("int32")
+        labels = rs.randint(0, 256, (8, 8)).astype("int32")
+
+        def build():
+            onp.random.seed(42)  # initializers draw from numpy global RNG
+            net = nlp.llama_tiny_pp(n_stages=4, n_microbatches=4)
+            net.initialize()
+            return net
+
+        class LMLoss(gloss.Loss):
+            def __init__(self):
+                super().__init__(weight=None, batch_axis=0)
+                self._ce = gloss.SoftmaxCrossEntropyLoss()
+
+            def hybrid_forward(self, F, pred, label):
+                return self._ce(pred.reshape((-1, pred.shape[-1])),
+                                label.reshape((-1,)))
+
+        losses = []
+        for mesh_axes in (None, {"dp": 2, "pp": 4}):
+            net = build()
+            mesh = par.make_mesh(mesh_axes) if mesh_axes else \
+                par.make_mesh({"dp": 1}, devices=jax.devices()[:1])
+            rules = nlp.llama_pp_sharding_rules() if mesh_axes else None
+            step = par.TrainStep(net, LMLoss(), "sgd", mesh=mesh,
+                                 rules=rules, loss_only=True,
+                                 optimizer_params={"learning_rate": 0.1})
+            loss, _ = step(mx.nd.array(tokens, dtype="int32"),
+                           mx.nd.array(labels, dtype="int32"))
+            losses.append(float(loss.asnumpy()))
+        assert abs(losses[0] - losses[1]) < 2e-4, losses
+
+    def test_trainstep_pp_tp_dp_converges(self):
+        onp.random.seed(13)
+        net = nlp.llama_tiny_pp(n_stages=2, layers_per_stage=2,
+                                n_microbatches=4)
+        net.initialize()
+        mesh = par.make_mesh({"dp": 2, "pp": 2, "tp": 2})
+
+        class LMLoss(gloss.Loss):
+            def __init__(self):
+                super().__init__(weight=None, batch_axis=0)
+                self._ce = gloss.SoftmaxCrossEntropyLoss()
+
+            def hybrid_forward(self, F, pred, label):
+                return self._ce(pred.reshape((-1, pred.shape[-1])),
+                                label.reshape((-1,)))
+
+        step = par.TrainStep(net, LMLoss(), "adam", mesh=mesh,
+                             rules=nlp.llama_pp_sharding_rules(),
+                             loss_only=True,
+                             optimizer_params={"learning_rate": 3e-3})
+        rs = onp.random.RandomState(17)
+        tokens = mx.nd.array(rs.randint(0, 256, (8, 8)), dtype="int32")
+        # memorize a fixed batch: loss must drop hard
+        first = last = None
+        for i in range(30):
+            loss, _ = step(tokens, tokens)
+            v = float(loss.asnumpy())
+            if first is None:
+                first = v
+            last = v
+        assert last < first * 0.6, (first, last)

@@ -41,14 +41,19 @@ class TestPallasModule:
         from jax.experimental import pallas as pl
 
         def blocky(x_ref, o_ref):
-            o_ref[...] = x_ref[...] * 2.0
+            # each program scales its own 2-row band by its program id
+            i = pl.program_id(0)
+            band = pl.ds(2 * i, 2)
+            o_ref[band, :] = x_ref[band, :] * (i + 1).astype("float32")
 
         mod = rtc.PallasModule({"blocky": blocky})
-        k = mod.get_kernel("blocky",
+        k = mod.get_kernel("blocky", grid=(4,),
                            out_shapes=[("o", "float32", (8, 128))])
         x = mx.nd.ones((8, 128))
         out, = k.launch([x])
-        onp.testing.assert_allclose(out.asnumpy(), 2.0 * onp.ones((8, 128)))
+        want = onp.repeat(onp.arange(1.0, 5.0), 2)[:, None] * \
+            onp.ones((8, 128))
+        onp.testing.assert_allclose(out.asnumpy(), want)
 
     def test_unknown_kernel_and_missing_outs(self):
         mod = rtc.PallasModule({"axpy": _axpy})
