@@ -1,0 +1,18 @@
+"""``mx.contrib.onnx`` — ONNX interop (reference:
+``python/mxnet/contrib/onnx/{mx2onnx,onnx2mx}``).
+
+Self-contained: speaks the ONNX protobuf wire format directly (the
+``onnx`` pip package is not required — see ``onnx_pb``). Files written
+here are stock ONNX; files from other exporters import here as long as
+their ops fall in the supported table.
+
+    from mxnet_tpu.contrib import onnx as onnx_mxnet
+    onnx_mxnet.export_model(sym, params, [(1, 3, 224, 224)],
+                            onnx_file_path="resnet.onnx")
+    sym, arg, aux = onnx_mxnet.import_model("resnet.onnx")
+"""
+from .mx2onnx import export_model
+from .onnx2mx import import_model, import_to_gluon, get_model_metadata
+
+__all__ = ["export_model", "import_model", "import_to_gluon",
+           "get_model_metadata"]

@@ -1,0 +1,415 @@
+"""Symbol graph → ONNX export (reference surface:
+``python/mxnet/contrib/onnx/mx2onnx/export_model.py :: export_model``).
+
+Walks the Symbol's JSON graph (the same artifact ``HybridBlock.export``
+writes) and emits an ONNX ModelProto through the self-contained codec in
+``onnx_pb``. Converters cover the op families the model zoos lower to;
+unknown ops raise with the op name so gaps fail loudly.
+"""
+from __future__ import annotations
+
+import ast
+import json
+import logging
+
+import numpy as _np
+
+from ...base import MXNetError
+from . import onnx_pb as pb
+
+__all__ = ["export_model"]
+
+
+def _tuple_attr(attrs, key, default=None):
+    v = attrs.get(key)
+    if v is None:
+        return default
+    if isinstance(v, (tuple, list)):
+        return tuple(int(x) for x in v)
+    v = str(v).strip()
+    try:
+        parsed = ast.literal_eval(v)
+    except (ValueError, SyntaxError):
+        return default
+    if isinstance(parsed, (tuple, list)):
+        return tuple(int(x) for x in parsed)
+    return (int(parsed),)
+
+
+def _bool_attr(attrs, key, default=False):
+    v = attrs.get(key)
+    if v is None:
+        return default
+    return str(v).lower() in ("true", "1")
+
+
+def _pads(pad):
+    # mxnet pad is per-dim begin==end; ONNX wants begins then ends
+    return list(pad) + list(pad)
+
+
+_CONVERTERS = {}
+
+
+def _converts(*names):
+    def deco(fn):
+        for n in names:
+            _CONVERTERS[n] = fn
+        return fn
+    return deco
+
+
+class _Ctx:
+    """Per-export state: name maps, initializers, emitted nodes."""
+
+    def __init__(self, params, dtype):
+        self.params = params
+        self.dtype = dtype
+        self.nodes = []
+        self.initializers = []
+        self.init_names = set()
+
+    def emit(self, op_type, inputs, outputs, name=None, **attrs):
+        self.nodes.append(pb.NodeProto(op_type, inputs, outputs,
+                                       name=name or outputs[0],
+                                       attrs=attrs))
+        return outputs[0]
+
+    def constant(self, name, arr):
+        if name not in self.init_names:
+            self.initializers.append(
+                pb.TensorProto.from_array(_np.asarray(arr), name=name))
+            self.init_names.add(name)
+        return name
+
+
+# -- converters ---------------------------------------------------------
+@_converts("FullyConnected")
+def _fc(ctx, name, ins, attrs):
+    no_bias = _bool_attr(attrs, "no_bias")
+    flatten = _bool_attr(attrs, "flatten", True)
+    x, w = ins[0], ins[1]
+    if flatten:
+        x = ctx.emit("Flatten", [x], [name + "_flat"], axis=1)
+        if no_bias:
+            zero = ctx.constant(
+                name + "_zero_bias",
+                _np.zeros((int(attrs["num_hidden"]),), ctx.dtype))
+            return ctx.emit("Gemm", [x, w, zero], [name], alpha=1.0,
+                            beta=1.0, transA=0, transB=1)
+        return ctx.emit("Gemm", [x, w, ins[2]], [name], alpha=1.0,
+                        beta=1.0, transA=0, transB=1)
+    # N-D input: MatMul against W^T, then Add bias
+    wt = ctx.emit("Transpose", [w], [name + "_wT"], perm=[1, 0])
+    y = ctx.emit("MatMul", [x, wt],
+                 [name if no_bias else name + "_mm"])
+    if not no_bias:
+        y = ctx.emit("Add", [y, ins[2]], [name])
+    return y
+
+
+@_converts("Convolution")
+def _conv(ctx, name, ins, attrs):
+    kernel = _tuple_attr(attrs, "kernel")
+    nd = len(kernel)
+    conv_attrs = dict(
+        kernel_shape=list(kernel),
+        strides=list(_tuple_attr(attrs, "stride", (1,) * nd)),
+        dilations=list(_tuple_attr(attrs, "dilate", (1,) * nd)),
+        pads=_pads(_tuple_attr(attrs, "pad", (0,) * nd)),
+        group=int(attrs.get("num_group", 1)),
+    )
+    return ctx.emit("Conv", list(ins), [name], **conv_attrs)
+
+
+@_converts("Deconvolution")
+def _deconv(ctx, name, ins, attrs):
+    kernel = _tuple_attr(attrs, "kernel")
+    nd = len(kernel)
+    return ctx.emit(
+        "ConvTranspose", list(ins), [name],
+        kernel_shape=list(kernel),
+        strides=list(_tuple_attr(attrs, "stride", (1,) * nd)),
+        dilations=list(_tuple_attr(attrs, "dilate", (1,) * nd)),
+        pads=_pads(_tuple_attr(attrs, "pad", (0,) * nd)),
+        group=int(attrs.get("num_group", 1)))
+
+
+@_converts("Activation")
+def _act(ctx, name, ins, attrs):
+    act = attrs.get("act_type", "relu")
+    table = {"relu": "Relu", "sigmoid": "Sigmoid", "tanh": "Tanh",
+             "softsign": "Softsign", "silu": None, "softrelu": None}
+    if act not in table:
+        raise MXNetError(f"ONNX export: unsupported act_type {act!r}")
+    if act == "silu":
+        s = ctx.emit("Sigmoid", [ins[0]], [name + "_sig"])
+        return ctx.emit("Mul", [ins[0], s], [name])
+    if act == "softrelu":
+        return ctx.emit("Softplus", [ins[0]], [name])
+    return ctx.emit(table[act], [ins[0]], [name])
+
+
+@_converts("LeakyReLU")
+def _leaky(ctx, name, ins, attrs):
+    act = attrs.get("act_type", "leaky")
+    if act == "leaky":
+        return ctx.emit("LeakyRelu", [ins[0]], [name],
+                        alpha=float(attrs.get("slope", 0.25)))
+    if act == "elu":
+        return ctx.emit("Elu", [ins[0]], [name],
+                        alpha=float(attrs.get("slope", 0.25)))
+    if act == "prelu":
+        return ctx.emit("PRelu", list(ins), [name])
+    if act == "gelu":
+        # erf formulation: x * 0.5 * (1 + erf(x / sqrt(2)))
+        c = ctx.constant(name + "_rsqrt2",
+                         _np.asarray(1.0 / _np.sqrt(2.0), ctx.dtype))
+        h = ctx.emit("Mul", [ins[0], c], [name + "_h"])
+        e = ctx.emit("Erf", [h], [name + "_erf"])
+        one = ctx.constant(name + "_one", _np.asarray(1.0, ctx.dtype))
+        half = ctx.constant(name + "_half", _np.asarray(0.5, ctx.dtype))
+        e1 = ctx.emit("Add", [e, one], [name + "_e1"])
+        xh = ctx.emit("Mul", [ins[0], half], [name + "_xh"])
+        return ctx.emit("Mul", [xh, e1], [name])
+    raise MXNetError(f"ONNX export: unsupported LeakyReLU {act!r}")
+
+
+@_converts("BatchNorm")
+def _bn(ctx, name, ins, attrs):
+    return ctx.emit("BatchNormalization", list(ins[:5]), [name],
+                    epsilon=float(attrs.get("eps", 1e-5)),
+                    momentum=float(attrs.get("momentum", 0.9)))
+
+
+@_converts("LayerNorm")
+def _ln(ctx, name, ins, attrs):
+    return ctx.emit("LayerNormalization", list(ins[:3]), [name],
+                    axis=int(attrs.get("axis", -1)),
+                    epsilon=float(attrs.get("eps", 1e-5)))
+
+
+@_converts("Pooling")
+def _pool(ctx, name, ins, attrs):
+    ptype = attrs.get("pool_type", "max")
+    if _bool_attr(attrs, "global_pool"):
+        op = {"max": "GlobalMaxPool", "avg": "GlobalAveragePool"}.get(ptype)
+        if op is None:
+            raise MXNetError(f"ONNX export: global {ptype} pooling")
+        return ctx.emit(op, [ins[0]], [name])
+    kernel = _tuple_attr(attrs, "kernel")
+    nd = len(kernel)
+    kw = dict(kernel_shape=list(kernel),
+              strides=list(_tuple_attr(attrs, "stride", (1,) * nd)),
+              pads=_pads(_tuple_attr(attrs, "pad", (0,) * nd)))
+    if ptype == "max":
+        return ctx.emit("MaxPool", [ins[0]], [name], **kw)
+    if ptype == "avg":
+        kw["count_include_pad"] = 0 if _bool_attr(
+            attrs, "count_include_pad", True) is False else 1
+        return ctx.emit("AveragePool", [ins[0]], [name], **kw)
+    raise MXNetError(f"ONNX export: unsupported pool_type {ptype!r}")
+
+
+@_converts("Flatten")
+def _flatten(ctx, name, ins, attrs):
+    return ctx.emit("Flatten", [ins[0]], [name], axis=1)
+
+
+@_converts("Reshape")
+def _reshape(ctx, name, ins, attrs):
+    shape = _tuple_attr(attrs, "shape")
+    c = ctx.constant(name + "_shape", _np.asarray(shape, _np.int64))
+    return ctx.emit("Reshape", [ins[0], c], [name])
+
+
+@_converts("transpose")
+def _transpose(ctx, name, ins, attrs):
+    axes = _tuple_attr(attrs, "axes")
+    kw = {"perm": list(axes)} if axes else {}
+    return ctx.emit("Transpose", [ins[0]], [name], **kw)
+
+
+@_converts("softmax", "log_softmax")
+def _softmax(ctx, name, ins, attrs):
+    axis = int(attrs.get("axis", -1))
+    op = "LogSoftmax" if attrs.get("__op__") == "log_softmax" else "Softmax"
+    return ctx.emit(op, [ins[0]], [name], axis=axis)
+
+
+@_converts("SoftmaxOutput")
+def _softmax_out(ctx, name, ins, attrs):
+    # inference surface: plain softmax over the last axis
+    return ctx.emit("Softmax", [ins[0]], [name], axis=-1)
+
+
+@_converts("Concat")
+def _concat(ctx, name, ins, attrs):
+    return ctx.emit("Concat", list(ins), [name],
+                    axis=int(attrs.get("dim", 1)))
+
+
+@_converts("Dropout")
+def _dropout(ctx, name, ins, attrs):
+    # inference graph: identity
+    return ctx.emit("Identity", [ins[0]], [name])
+
+
+@_converts("Embedding")
+def _embedding(ctx, name, ins, attrs):
+    idx = ctx.emit("Cast", [ins[0]], [name + "_i64"], to=pb.INT64)
+    return ctx.emit("Gather", [ins[1], idx], [name], axis=0)
+
+
+@_converts("Cast")
+def _cast(ctx, name, ins, attrs):
+    dt = pb.NP_TO_ONNX[str(_np.dtype(attrs.get("dtype", "float32")))]
+    return ctx.emit("Cast", [ins[0]], [name], to=dt)
+
+
+@_converts("clip")
+def _clip(ctx, name, ins, attrs):
+    lo = ctx.constant(name + "_min",
+                      _np.asarray(float(attrs["a_min"]), ctx.dtype))
+    hi = ctx.constant(name + "_max",
+                      _np.asarray(float(attrs["a_max"]), ctx.dtype))
+    return ctx.emit("Clip", [ins[0], lo, hi], [name])
+
+
+@_converts("Pad")
+def _pad(ctx, name, ins, attrs):
+    width = _tuple_attr(attrs, "pad_width")
+    # mxnet interleaves (before, after) per dim; ONNX: all befores, afters
+    befores, afters = list(width[0::2]), list(width[1::2])
+    c = ctx.constant(name + "_pads",
+                     _np.asarray(befores + afters, _np.int64))
+    mode = attrs.get("mode", "constant")
+    return ctx.emit("Pad", [ins[0], c], [name], mode=mode)
+
+
+def _binary(onnx_op):
+    def conv(ctx, name, ins, attrs):
+        return ctx.emit(onnx_op, list(ins), [name])
+    return conv
+
+
+for _mx, _ox in [
+        ("elemwise_add", "Add"), ("_plus", "Add"), ("broadcast_add", "Add"),
+        ("_Plus", "Add"),
+        ("elemwise_sub", "Sub"), ("broadcast_sub", "Sub"),
+        ("elemwise_mul", "Mul"), ("broadcast_mul", "Mul"),
+        ("elemwise_div", "Div"), ("broadcast_div", "Div"),
+        ("dot", "MatMul"), ("batch_dot", "MatMul"),
+        ("broadcast_maximum", "Max"), ("broadcast_minimum", "Min"),
+        ("broadcast_power", "Pow")]:
+    _CONVERTERS[_mx] = _binary(_ox)
+
+for _mx, _ox in [
+        ("relu", "Relu"), ("sigmoid", "Sigmoid"), ("tanh", "Tanh"),
+        ("exp", "Exp"), ("log", "Log"), ("sqrt", "Sqrt"), ("abs", "Abs"),
+        ("negative", "Neg"), ("floor", "Floor"), ("ceil", "Ceil"),
+        ("erf", "Erf"), ("identity", "Identity"), ("BlockGrad", "Identity"),
+        ("add_n", "Sum")]:
+    def _mk(_op):
+        def conv(ctx, name, ins, attrs):
+            return ctx.emit(_op, list(ins), [name])
+        return conv
+    _CONVERTERS[_mx] = _mk(_ox)
+
+
+@_converts("mean", "sum", "max", "min")
+def _reduce(ctx, name, ins, attrs):
+    op = {"mean": "ReduceMean", "sum": "ReduceSum", "max": "ReduceMax",
+          "min": "ReduceMin"}[attrs["__op__"]]
+    axes = _tuple_attr(attrs, "axis")
+    kw = dict(keepdims=1 if _bool_attr(attrs, "keepdims") else 0)
+    inputs = [ins[0]]
+    if axes is not None:
+        if op == "ReduceSum":
+            # axes moved from attribute to input at opset 13
+            inputs.append(ctx.constant(
+                name + "_axes", _np.asarray(axes, _np.int64)))
+        else:
+            kw["axes"] = list(axes)
+    return ctx.emit(op, inputs, [name], **kw)
+
+
+# -- driver -------------------------------------------------------------
+def export_model(sym, params, input_shapes=None, input_dtype="float32",
+                 onnx_file_path="model.onnx", verbose=False,
+                 in_shapes=None, in_types=None):
+    """Export a Symbol (or symbol-file path) + params to an ONNX file.
+
+    ``sym``: Symbol or path to ``*-symbol.json``; ``params``: dict of
+    NDArray/ndarray (``arg:``/``aux:`` prefixes accepted — the ``.params``
+    artifact of ``HybridBlock.export``) or a path to such a file.
+    Returns ``onnx_file_path``.
+    """
+    from ... import ndarray as nd_mod
+    from ...symbol import symbol as sym_mod
+
+    if isinstance(sym, str):
+        sym = sym_mod.load(sym)
+    if isinstance(params, str):
+        params = nd_mod.load(params)
+    if input_shapes is None:
+        input_shapes = in_shapes
+    if in_types is not None:
+        input_dtype = in_types if isinstance(in_types, str) else in_types[0]
+    dtype = _np.dtype(input_dtype)
+
+    clean = {}
+    for k, v in (params or {}).items():
+        k = k.split(":", 1)[1] if k.startswith(("arg:", "aux:")) else k
+        clean[k] = _np.asarray(v.asnumpy() if hasattr(v, "asnumpy") else v)
+
+    graph = json.loads(sym.tojson())
+    nodes = graph["nodes"]
+    heads = graph["heads"]
+
+    ctx = _Ctx(clean, dtype)
+    out_of = {}          # node index -> onnx value name
+    graph_inputs = []
+    data_idx = 0
+    for i, node in enumerate(nodes):
+        name = node["name"]
+        if node["op"] == "null":
+            out_of[i] = name
+            if name in clean:
+                ctx.constant(name, clean[name])
+            else:
+                shape = None
+                if isinstance(input_shapes, dict):
+                    shape = input_shapes.get(name)
+                elif input_shapes is not None:
+                    if data_idx < len(input_shapes):
+                        shape = input_shapes[data_idx]
+                    data_idx += 1
+                graph_inputs.append(pb.ValueInfoProto(
+                    name, pb.NP_TO_ONNX[str(dtype)],
+                    shape if shape is not None else ()))
+            continue
+        op = node["op"]
+        conv = _CONVERTERS.get(op)
+        if conv is None:
+            raise MXNetError(
+                f"ONNX export: no converter for op {op!r} (node {name}); "
+                "see mxnet_tpu/contrib/onnx/mx2onnx.py")
+        ins = [out_of[a[0]] if a[1] == 0 else f"{out_of[a[0]]}__{a[1]}"
+               for a in node["inputs"]]
+        attrs = dict(node.get("attrs", {}))
+        attrs["__op__"] = op
+        out_of[i] = conv(ctx, name, ins, attrs)
+        if verbose:
+            logging.info("converted %s (%s)", name, op)
+
+    outputs = [pb.ValueInfoProto(out_of[h[0]] if h[1] == 0
+                                 else f"{out_of[h[0]]}__{h[1]}",
+                                 pb.NP_TO_ONNX[str(dtype)], ())
+               for h in heads]
+    g = pb.GraphProto(nodes=ctx.nodes, inputs=graph_inputs,
+                      outputs=outputs, initializers=ctx.initializers)
+    model = pb.ModelProto(g)
+    with open(onnx_file_path, "wb") as f:
+        f.write(model.encode())
+    return onnx_file_path

@@ -1,0 +1,374 @@
+"""ONNX → Symbol import (reference surface:
+``python/mxnet/contrib/onnx/onnx2mx/import_model.py :: import_model``,
+``import_to_gluon.py``, ``import_model.py::get_model_metadata``).
+
+Parses an ONNX file with the self-contained codec (``onnx_pb``) and
+rebuilds the graph through ``mx.sym`` operators; initializers become
+arg/aux params (aux = whatever the rebuilt symbol lists as auxiliary,
+e.g. BatchNorm running stats — same split upstream's importer makes).
+"""
+from __future__ import annotations
+
+import numpy as _np
+
+from ...base import MXNetError
+from . import onnx_pb as pb
+
+__all__ = ["import_model", "import_to_gluon", "get_model_metadata"]
+
+_IMPORTERS = {}
+
+
+def _imports(*names):
+    def deco(fn):
+        for n in names:
+            _IMPORTERS[n] = fn
+        return fn
+    return deco
+
+
+def _first_half_pads(pads):
+    if not pads:
+        return None
+    n = len(pads) // 2
+    begins, ends = tuple(pads[:n]), tuple(pads[n:])
+    if begins != ends:
+        raise MXNetError(
+            f"ONNX import: asymmetric pads {pads} need an explicit Pad op")
+    return begins
+
+
+@_imports("Conv")
+def _conv(sym, ins, attrs, ctx):
+    w = ctx.param_array(1)
+    group = int(attrs.get("group", 1))
+    return sym.Convolution(
+        *ins, kernel=tuple(attrs["kernel_shape"]),
+        stride=tuple(attrs.get("strides", ())) or None,
+        dilate=tuple(attrs.get("dilations", ())) or None,
+        pad=_first_half_pads(attrs.get("pads")),
+        num_filter=int(w.shape[0]), num_group=group,
+        no_bias=(len(ins) == 2))
+
+
+@_imports("ConvTranspose")
+def _deconv(sym, ins, attrs, ctx):
+    w = ctx.param_array(1)
+    group = int(attrs.get("group", 1))
+    return sym.Deconvolution(
+        *ins, kernel=tuple(attrs["kernel_shape"]),
+        stride=tuple(attrs.get("strides", ())) or None,
+        dilate=tuple(attrs.get("dilations", ())) or None,
+        pad=_first_half_pads(attrs.get("pads")),
+        num_filter=int(w.shape[1]) * group, num_group=group,
+        no_bias=(len(ins) == 2))
+
+
+@_imports("Gemm")
+def _gemm(sym, ins, attrs, ctx):
+    alpha = float(attrs.get("alpha", 1.0))
+    beta = float(attrs.get("beta", 1.0))
+    if alpha != 1.0 or beta != 1.0 or int(attrs.get("transA", 0)):
+        raise MXNetError("ONNX import: Gemm with alpha/beta/transA != "
+                         "defaults is not supported")
+    w = ctx.param_array(1)
+    if not int(attrs.get("transB", 0)):
+        ctx.set_param(1, _np.ascontiguousarray(w.T))
+        w = ctx.param_array(1)
+    return sym.FullyConnected(*ins, num_hidden=int(w.shape[0]),
+                              no_bias=(len(ins) == 2), flatten=False)
+
+
+@_imports("BatchNormalization")
+def _bn(sym, ins, attrs, ctx):
+    return sym.BatchNorm(*ins, eps=float(attrs.get("epsilon", 1e-5)),
+                         momentum=float(attrs.get("momentum", 0.9)),
+                         fix_gamma=False)
+
+
+@_imports("LayerNormalization")
+def _ln(sym, ins, attrs, ctx):
+    return sym.LayerNorm(*ins, axis=int(attrs.get("axis", -1)),
+                         eps=float(attrs.get("epsilon", 1e-5)))
+
+
+@_imports("MaxPool", "AveragePool")
+def _pool(sym, ins, attrs, ctx):
+    ptype = "max" if ctx.op_type == "MaxPool" else "avg"
+    return sym.Pooling(
+        ins[0], kernel=tuple(attrs["kernel_shape"]),
+        stride=tuple(attrs.get("strides", ())) or None,
+        pad=_first_half_pads(attrs.get("pads")), pool_type=ptype,
+        count_include_pad=bool(attrs.get("count_include_pad", 1)))
+
+
+@_imports("GlobalMaxPool", "GlobalAveragePool")
+def _gpool(sym, ins, attrs, ctx):
+    ptype = "max" if "Max" in ctx.op_type else "avg"
+    return sym.Pooling(ins[0], kernel=(1, 1), pool_type=ptype,
+                       global_pool=True)
+
+
+@_imports("Reshape")
+def _reshape(sym, ins, attrs, ctx):
+    if "shape" in attrs:          # opset < 5 form
+        shape = tuple(attrs["shape"])
+    else:
+        shape = tuple(int(x) for x in ctx.take_constant(1))
+    return sym.Reshape(ins[0], shape=shape)
+
+
+@_imports("Clip")
+def _clip(sym, ins, attrs, ctx):
+    if len(ins) > 1:
+        lo = float(ctx.take_constant(1))
+        hi = float(ctx.take_constant(2)) if len(ins) > 2 else _np.inf
+    else:
+        lo = float(attrs.get("min", -_np.inf))
+        hi = float(attrs.get("max", _np.inf))
+    return sym.clip(ins[0], a_min=lo, a_max=hi)
+
+
+@_imports("Pad")
+def _pad(sym, ins, attrs, ctx):
+    if "pads" in attrs:
+        pads = list(attrs["pads"])
+    else:
+        pads = [int(x) for x in ctx.take_constant(1)]
+    n = len(pads) // 2
+    width = []
+    for b, e in zip(pads[:n], pads[n:]):
+        width += [b, e]
+    return sym.Pad(ins[0], mode=attrs.get("mode", "constant"),
+                   pad_width=tuple(width))
+
+
+@_imports("Gather")
+def _gather(sym, ins, attrs, ctx):
+    axis = int(attrs.get("axis", 0))
+    w = ctx.maybe_param_array(0)
+    if axis == 0 and w is not None and w.ndim == 2:
+        return sym.Embedding(ins[1], ins[0], input_dim=int(w.shape[0]),
+                             output_dim=int(w.shape[1]))
+    return sym.take(ins[0], ins[1], axis=axis)
+
+
+@_imports("Cast")
+def _cast(sym, ins, attrs, ctx):
+    return sym.Cast(ins[0], dtype=pb.ONNX_TO_NP[int(attrs["to"])])
+
+
+@_imports("Transpose")
+def _transpose(sym, ins, attrs, ctx):
+    perm = attrs.get("perm")
+    return sym.transpose(ins[0], axes=tuple(perm) if perm else None)
+
+
+@_imports("Concat")
+def _concat(sym, ins, attrs, ctx):
+    return sym.Concat(*ins, dim=int(attrs.get("axis", 1)))
+
+
+@_imports("Softmax", "LogSoftmax")
+def _softmax(sym, ins, attrs, ctx):
+    fn = sym.log_softmax if ctx.op_type == "LogSoftmax" else sym.softmax
+    return fn(ins[0], axis=int(attrs.get("axis", -1)))
+
+
+@_imports("Dropout")
+def _dropout(sym, ins, attrs, ctx):
+    return sym.identity(ins[0])
+
+
+@_imports("ReduceMean", "ReduceSum", "ReduceMax", "ReduceMin")
+def _reduce(sym, ins, attrs, ctx):
+    fn = {"ReduceMean": sym.mean, "ReduceSum": sym.sum,
+          "ReduceMax": sym.max, "ReduceMin": sym.min}[ctx.op_type]
+    axes = attrs.get("axes")
+    if axes is None and len(ins) > 1:
+        axes = [int(x) for x in ctx.take_constant(1)]
+    return fn(ins[0], axis=tuple(axes) if axes else None,
+              keepdims=bool(attrs.get("keepdims", 1)))
+
+
+@_imports("Flatten")
+def _flatten(sym, ins, attrs, ctx):
+    if int(attrs.get("axis", 1)) != 1:
+        raise MXNetError("ONNX import: Flatten axis != 1")
+    return sym.Flatten(ins[0])
+
+
+def _simple(op):
+    def imp(sym, ins, attrs, ctx):
+        return getattr(sym, op)(*ins)
+    return imp
+
+
+for _ox, _mx in [
+        ("Add", "broadcast_add"), ("Sub", "broadcast_sub"),
+        ("Mul", "broadcast_mul"), ("Div", "broadcast_div"),
+        ("Pow", "broadcast_power"), ("Max", "broadcast_maximum"),
+        ("Min", "broadcast_minimum"), ("MatMul", "dot"),
+        ("Relu", "relu"), ("Sigmoid", "sigmoid"), ("Tanh", "tanh"),
+        ("Exp", "exp"), ("Log", "log"), ("Sqrt", "sqrt"), ("Abs", "abs"),
+        ("Neg", "negative"), ("Floor", "floor"), ("Ceil", "ceil"),
+        ("Erf", "erf"), ("Identity", "identity"), ("Sum", "add_n")]:
+    _IMPORTERS[_ox] = _simple(_mx)
+
+
+@_imports("LeakyRelu")
+def _leaky(sym, ins, attrs, ctx):
+    return sym.LeakyReLU(ins[0], act_type="leaky",
+                         slope=float(attrs.get("alpha", 0.01)))
+
+
+@_imports("Elu")
+def _elu(sym, ins, attrs, ctx):
+    return sym.LeakyReLU(ins[0], act_type="elu",
+                         slope=float(attrs.get("alpha", 1.0)))
+
+
+@_imports("PRelu")
+def _prelu(sym, ins, attrs, ctx):
+    return sym.LeakyReLU(*ins, act_type="prelu")
+
+
+@_imports("Softplus")
+def _softplus(sym, ins, attrs, ctx):
+    return sym.Activation(ins[0], act_type="softrelu")
+
+
+@_imports("Constant")
+def _constant(sym, ins, attrs, ctx):
+    t = attrs.get("value")
+    ctx.add_initializer(ctx.node_name, t.to_array())
+    return sym.var(ctx.node_name)
+
+
+class _ImportCtx:
+    def __init__(self, params):
+        self.params = params           # name -> np array
+        self.consumed = set()
+        self.op_type = ""
+        self.node_name = ""
+        self.in_names = []             # current node's ONNX input names
+
+    def param_array(self, i):
+        name = self.in_names[i]
+        if name not in self.params:
+            raise MXNetError(f"ONNX import: {name!r} is not an initializer")
+        return self.params[name]
+
+    def maybe_param_array(self, i):
+        return self.params.get(self.in_names[i])
+
+    def set_param(self, i, arr):
+        self.params[self.in_names[i]] = arr
+
+    def add_initializer(self, name, arr):
+        self.params[name] = _np.asarray(arr)
+
+    def take_constant(self, i):
+        """Consume an initializer used as graph metadata (Reshape shape,
+        Clip bounds …) — it must NOT surface as a learnable param."""
+        arr = self.param_array(i)
+        self.consumed.add(self.in_names[i])
+        return arr
+
+
+def _parse(filename):
+    with open(filename, "rb") as f:
+        model = pb.dec_model(f.read())
+    if model.graph is None:
+        raise MXNetError(f"{filename}: no graph in ONNX model")
+    return model
+
+
+def import_model(model_file):
+    """Returns ``(sym, arg_params, aux_params)`` — the reference
+    ``onnx2mx.import_model`` contract."""
+    from ... import ndarray as nd_mod
+    from ... import symbol as sym_ns
+
+    model = _parse(model_file)
+    g = model.graph
+    params = {t.name: t.to_array() for t in g.initializer}
+    ctx = _ImportCtx(params)
+
+    outputs_of = {}
+    for vi in g.input:
+        if vi.name not in params:
+            outputs_of[vi.name] = sym_ns.var(vi.name)
+    for name in params:
+        outputs_of[name] = sym_ns.var(name)
+
+    for node in g.node:
+        imp = _IMPORTERS.get(node.op_type)
+        if imp is None:
+            raise MXNetError(
+                f"ONNX import: no importer for op {node.op_type!r} "
+                f"(node {node.name or node.output[0]}); see "
+                "mxnet_tpu/contrib/onnx/onnx2mx.py")
+        ctx.op_type = node.op_type
+        ctx.node_name = node.name or node.output[0]
+        ctx.in_names = [i for i in node.input if i != ""]
+        ins = []
+        for i in ctx.in_names:
+            if i not in outputs_of:      # late initializer (Constant etc.)
+                outputs_of[i] = sym_ns.var(i)
+            ins.append(outputs_of[i])
+        out = imp(sym_ns, ins, node.attribute, ctx)
+        outs = out if isinstance(out, (list, tuple)) else [out]
+        for name, s in zip(node.output, outs):
+            # graph edges are keyed by ONNX value names; rebind the symbol
+            outputs_of[name] = s
+
+    heads = [outputs_of[vi.name] for vi in g.output]
+    sym = heads[0] if len(heads) == 1 else sym_ns.Group(heads)
+
+    aux_names = set(sym.list_auxiliary_states())
+    arg_names = set(sym.list_arguments())
+    arg_params, aux_params = {}, {}
+    for name, arr in ctx.params.items():
+        if name in ctx.consumed:
+            continue
+        nd = nd_mod.array(arr)
+        if name in aux_names:
+            aux_params[name] = nd
+        elif name in arg_names:
+            arg_params[name] = nd
+    return sym, arg_params, aux_params
+
+
+def import_to_gluon(model_file, ctx=None):
+    """Import an ONNX model as a :class:`gluon.SymbolBlock`."""
+    from ...gluon.block import SymbolBlock
+
+    sym, arg_params, aux_params = import_model(model_file)
+    data_names = [n for n in sym.list_arguments()
+                  if n not in arg_params and n not in aux_params]
+    from ... import symbol as sym_ns
+
+    inputs = [sym_ns.var(n) for n in data_names]
+    net = SymbolBlock(sym, inputs)
+    net_params = net.collect_params()
+    for name, arr in list(arg_params.items()) + list(aux_params.items()):
+        p = net_params[name]
+        p.shape = tuple(arr.shape)
+        p.initialize(ctx=ctx, force_reinit=True)
+        p.set_data(arr)
+    return net
+
+
+def get_model_metadata(model_file):
+    """Input/output names+shapes without building the graph (reference:
+    onnx2mx.import_model.get_model_metadata)."""
+    model = _parse(model_file)
+    g = model.graph
+    init = {t.name for t in g.initializer}
+    return {
+        "input_tensor_data": [(vi.name, tuple(vi.shape))
+                              for vi in g.input if vi.name not in init],
+        "output_tensor_data": [(vi.name, tuple(vi.shape))
+                               for vi in g.output],
+    }

@@ -1,0 +1,460 @@
+"""Self-contained ONNX protobuf wire codec.
+
+Reference surface: ``python/mxnet/contrib/onnx`` (mx2onnx/onnx2mx) sits on
+the ``onnx`` pip package. That package is not in this image, so this
+module speaks the protobuf WIRE FORMAT for the subset of ``onnx.proto``
+the converters need (Model/Graph/Node/Attribute/Tensor/ValueInfo). Files
+written here load in stock onnxruntime/netron, and files produced by real
+``onnx`` load here — the format is the contract, not the library.
+
+Wire format recap: each field is ``(field_number << 3 | wire_type)`` as a
+varint, then the payload; wire types 0 = varint, 1 = fixed64,
+2 = length-delimited (strings, bytes, sub-messages, packed scalars),
+5 = fixed32.
+"""
+from __future__ import annotations
+
+import struct
+
+import numpy as _np
+
+# onnx.proto TensorProto.DataType
+FLOAT, UINT8, INT8, UINT16, INT16, INT32, INT64 = 1, 2, 3, 4, 5, 6, 7
+STRING, BOOL, FLOAT16, DOUBLE, UINT32, UINT64 = 8, 9, 10, 11, 12, 13
+BFLOAT16 = 16
+
+NP_TO_ONNX = {
+    "float32": FLOAT, "uint8": UINT8, "int8": INT8, "uint16": UINT16,
+    "int16": INT16, "int32": INT32, "int64": INT64, "bool": BOOL,
+    "float16": FLOAT16, "float64": DOUBLE, "uint32": UINT32,
+    "uint64": UINT64, "bfloat16": BFLOAT16,
+}
+ONNX_TO_NP = {v: k for k, v in NP_TO_ONNX.items()}
+
+# AttributeProto.AttributeType
+AT_FLOAT, AT_INT, AT_STRING, AT_TENSOR, AT_GRAPH = 1, 2, 3, 4, 5
+AT_FLOATS, AT_INTS, AT_STRINGS = 6, 7, 8
+
+
+# ---------------------------------------------------------------- encode
+def _varint(n: int) -> bytes:
+    n &= (1 << 64) - 1
+    out = bytearray()
+    while True:
+        b = n & 0x7F
+        n >>= 7
+        if n:
+            out.append(b | 0x80)
+        else:
+            out.append(b)
+            return bytes(out)
+
+
+def _tag(field: int, wire: int) -> bytes:
+    return _varint((field << 3) | wire)
+
+
+def _f_varint(field: int, value: int) -> bytes:
+    return _tag(field, 0) + _varint(int(value))
+
+
+def _f_bytes(field: int, payload: bytes) -> bytes:
+    return _tag(field, 2) + _varint(len(payload)) + payload
+
+
+def _f_str(field: int, s: str) -> bytes:
+    return _f_bytes(field, s.encode("utf-8"))
+
+
+def _f_float(field: int, v: float) -> bytes:
+    return _tag(field, 5) + struct.pack("<f", float(v))
+
+
+class _Msg:
+    """Base: encodes to bytes via ``encode``; fields set in __init__."""
+
+    def encode(self) -> bytes:  # pragma: no cover - abstract
+        raise NotImplementedError
+
+
+class TensorProto(_Msg):
+    def __init__(self, name="", dims=(), data_type=FLOAT, raw_data=b""):
+        self.name = name
+        self.dims = list(dims)
+        self.data_type = data_type
+        self.raw_data = raw_data
+
+    @classmethod
+    def from_array(cls, arr, name=""):
+        arr = _np.ascontiguousarray(arr)
+        dt = NP_TO_ONNX.get(str(arr.dtype))
+        if dt is None:
+            raise ValueError(f"no ONNX dtype for {arr.dtype}")
+        little = arr.astype(arr.dtype.newbyteorder("<"), copy=False)
+        return cls(name=name, dims=arr.shape, data_type=dt,
+                   raw_data=little.tobytes())
+
+    def to_array(self):
+        dtype = _np.dtype(ONNX_TO_NP[self.data_type]).newbyteorder("<")
+        if self.raw_data:
+            a = _np.frombuffer(self.raw_data, dtype=dtype)
+        else:
+            a = _np.asarray(self.typed_data, dtype=dtype)
+        return a.reshape(self.dims).astype(dtype.newbyteorder("="))
+
+    def encode(self) -> bytes:
+        out = b"".join(_f_varint(1, d) for d in self.dims)
+        out += _f_varint(2, self.data_type)
+        out += _f_str(8, self.name)
+        out += _f_bytes(9, self.raw_data)
+        return out
+
+
+class ValueInfoProto(_Msg):
+    def __init__(self, name="", elem_type=FLOAT, shape=()):
+        self.name = name
+        self.elem_type = elem_type
+        self.shape = list(shape)  # ints or strings (symbolic dims)
+
+    def encode(self) -> bytes:
+        dims = b""
+        for d in self.shape:
+            if isinstance(d, str):
+                dims += _f_bytes(1, _f_str(2, d))
+            else:
+                dims += _f_bytes(1, _f_varint(1, int(d)))
+        tensor = _f_varint(1, self.elem_type) + _f_bytes(2, dims)
+        return _f_str(1, self.name) + _f_bytes(2, _f_bytes(1, tensor))
+
+
+class AttributeProto(_Msg):
+    def __init__(self, name, value):
+        self.name = name
+        self.value = value
+
+    def encode(self) -> bytes:
+        out = _f_str(1, self.name)
+        v = self.value
+        if isinstance(v, bool):
+            out += _f_varint(3, int(v)) + _f_varint(20, AT_INT)
+        elif isinstance(v, int):
+            out += _f_varint(3, v) + _f_varint(20, AT_INT)
+        elif isinstance(v, float):
+            out += _f_float(2, v) + _f_varint(20, AT_FLOAT)
+        elif isinstance(v, str):
+            out += _f_bytes(4, v.encode()) + _f_varint(20, AT_STRING)
+        elif isinstance(v, bytes):
+            out += _f_bytes(4, v) + _f_varint(20, AT_STRING)
+        elif isinstance(v, TensorProto):
+            out += _f_bytes(5, v.encode()) + _f_varint(20, AT_TENSOR)
+        elif isinstance(v, (list, tuple)):
+            if all(isinstance(x, (int, bool)) for x in v):
+                out += b"".join(_f_varint(8, int(x)) for x in v)
+                out += _f_varint(20, AT_INTS)
+            elif all(isinstance(x, float) for x in v):
+                out += b"".join(_tag(7, 5) + struct.pack("<f", x) for x in v)
+                out += _f_varint(20, AT_FLOATS)
+            elif all(isinstance(x, (str, bytes)) for x in v):
+                out += b"".join(
+                    _f_bytes(9, x.encode() if isinstance(x, str) else x)
+                    for x in v)
+                out += _f_varint(20, AT_STRINGS)
+            else:
+                raise TypeError(f"mixed attribute list: {v!r}")
+        else:
+            raise TypeError(f"unsupported attribute value: {v!r}")
+        return out
+
+
+class NodeProto(_Msg):
+    def __init__(self, op_type, inputs, outputs, name="", attrs=None,
+                 domain=""):
+        self.op_type = op_type
+        self.input = list(inputs)
+        self.output = list(outputs)
+        self.name = name
+        self.domain = domain
+        self.attribute = [AttributeProto(k, v)
+                          for k, v in (attrs or {}).items()
+                          if v is not None]
+
+    def encode(self) -> bytes:
+        out = b"".join(_f_str(1, s) for s in self.input)
+        out += b"".join(_f_str(2, s) for s in self.output)
+        out += _f_str(3, self.name)
+        out += _f_str(4, self.op_type)
+        out += b"".join(_f_bytes(5, a.encode()) for a in self.attribute)
+        if self.domain:
+            out += _f_str(7, self.domain)
+        return out
+
+
+class GraphProto(_Msg):
+    def __init__(self, name="mxnet_tpu", nodes=(), inputs=(), outputs=(),
+                 initializers=()):
+        self.node = list(nodes)
+        self.name = name
+        self.input = list(inputs)
+        self.output = list(outputs)
+        self.initializer = list(initializers)
+
+    def encode(self) -> bytes:
+        out = b"".join(_f_bytes(1, n.encode()) for n in self.node)
+        out += _f_str(2, self.name)
+        out += b"".join(_f_bytes(5, t.encode()) for t in self.initializer)
+        out += b"".join(_f_bytes(11, v.encode()) for v in self.input)
+        out += b"".join(_f_bytes(12, v.encode()) for v in self.output)
+        return out
+
+
+class ModelProto(_Msg):
+    # opset 17: ReduceSum takes axes as input (>=13) and
+    # LayerNormalization exists (==17); ReduceMean/Max/Min still take the
+    # axes attribute (they switch at 18)
+    def __init__(self, graph, ir_version=8, opset=17,
+                 producer_name="mxnet_tpu", producer_version="2.0"):
+        self.ir_version = ir_version
+        self.opset = opset
+        self.producer_name = producer_name
+        self.producer_version = producer_version
+        self.graph = graph
+
+    def encode(self) -> bytes:
+        out = _f_varint(1, self.ir_version)
+        out += _f_str(2, self.producer_name)
+        out += _f_str(3, self.producer_version)
+        out += _f_bytes(7, self.graph.encode())
+        out += _f_bytes(8, _f_varint(2, self.opset))  # opset_import{version}
+        return out
+
+
+# ---------------------------------------------------------------- decode
+def _read_varint(buf, i):
+    shift = 0
+    val = 0
+    while True:
+        b = buf[i]
+        i += 1
+        val |= (b & 0x7F) << shift
+        if not b & 0x80:
+            return val, i
+        shift += 7
+
+
+def _fields(buf):
+    """Yield (field_number, wire_type, value) triples."""
+    i = 0
+    n = len(buf)
+    while i < n:
+        tag, i = _read_varint(buf, i)
+        field, wire = tag >> 3, tag & 7
+        if wire == 0:
+            val, i = _read_varint(buf, i)
+        elif wire == 2:
+            ln, i = _read_varint(buf, i)
+            val = buf[i:i + ln]
+            i += ln
+        elif wire == 5:
+            val = buf[i:i + 4]
+            i += 4
+        elif wire == 1:
+            val = buf[i:i + 8]
+            i += 8
+        else:
+            raise ValueError(f"unsupported wire type {wire}")
+        yield field, wire, val
+
+
+def _signed64(v):
+    return v - (1 << 64) if v >= (1 << 63) else v
+
+
+def _ints(wire, val, width="q"):
+    """A repeated-int field entry: packed (wire 2) or single varint."""
+    if wire == 0:
+        return [_signed64(val)]
+    out = []
+    i = 0
+    while i < len(val):
+        v, i = _read_varint(val, i)
+        out.append(_signed64(v))
+    return out
+
+
+class _D:  # decoded-message namespace
+    def __repr__(self):
+        return f"{self.__class__.__name__}({self.__dict__})"
+
+
+def dec_tensor(buf) -> TensorProto:
+    t = TensorProto()
+    t.typed_data = []
+    for f, w, v in _fields(buf):
+        if f == 1:
+            t.dims += _ints(w, v)
+        elif f == 2:
+            t.data_type = v
+        elif f == 4:
+            if w == 5:
+                t.typed_data.append(struct.unpack("<f", v)[0])
+            else:
+                t.typed_data += [x[0] for x in struct.iter_unpack("<f", v)]
+        elif f in (5, 7, 11):
+            t.typed_data += _ints(w, v)
+        elif f == 8:
+            t.name = v.decode()
+        elif f == 9:
+            t.raw_data = v
+        elif f == 10:
+            if w == 1:
+                t.typed_data.append(struct.unpack("<d", v)[0])
+            else:
+                t.typed_data += [x[0] for x in struct.iter_unpack("<d", v)]
+    return t
+
+
+def dec_attribute(buf):
+    a = _D()
+    a.name = ""
+    a.f = None
+    a.i = None
+    a.s = None
+    a.t = None
+    a.floats = []
+    a.ints = []
+    a.strings = []
+    a.type = 0
+    for f, w, v in _fields(buf):
+        if f == 1:
+            a.name = v.decode()
+        elif f == 2:
+            a.f = struct.unpack("<f", v)[0]
+        elif f == 3:
+            a.i = _signed64(v)
+        elif f == 4:
+            a.s = v
+        elif f == 5:
+            a.t = dec_tensor(v)
+        elif f == 7:
+            if w == 5:
+                a.floats.append(struct.unpack("<f", v)[0])
+            else:
+                a.floats += [x[0] for x in struct.iter_unpack("<f", v)]
+        elif f == 8:
+            a.ints += _ints(w, v)
+        elif f == 9:
+            a.strings.append(v)
+        elif f == 20:
+            a.type = v
+    return a
+
+
+def attr_value(a):
+    """Collapse a decoded AttributeProto to its python value."""
+    if a.type == AT_FLOAT:
+        return a.f
+    if a.type == AT_INT:
+        return a.i
+    if a.type == AT_STRING:
+        return a.s.decode()
+    if a.type == AT_TENSOR:
+        return a.t
+    if a.type == AT_FLOATS:
+        return list(a.floats)
+    if a.type == AT_INTS:
+        return list(a.ints)
+    if a.type == AT_STRINGS:
+        return [s.decode() for s in a.strings]
+    # untyped (some writers omit field 20): first non-empty wins
+    for v in (a.i, a.f, a.s):
+        if v is not None:
+            return v.decode() if isinstance(v, bytes) else v
+    return a.ints or a.floats or a.t
+
+
+def dec_node(buf):
+    n = _D()
+    n.input, n.output, n.attribute = [], [], {}
+    n.name = n.op_type = n.domain = ""
+    for f, w, v in _fields(buf):
+        if f == 1:
+            n.input.append(v.decode())
+        elif f == 2:
+            n.output.append(v.decode())
+        elif f == 3:
+            n.name = v.decode()
+        elif f == 4:
+            n.op_type = v.decode()
+        elif f == 5:
+            a = dec_attribute(v)
+            n.attribute[a.name] = attr_value(a)
+        elif f == 7:
+            n.domain = v.decode()
+    return n
+
+
+def dec_value_info(buf):
+    vi = _D()
+    vi.name = ""
+    vi.elem_type = FLOAT
+    vi.shape = []
+    for f, w, v in _fields(buf):
+        if f == 1:
+            vi.name = v.decode()
+        elif f == 2:
+            for f2, _w2, v2 in _fields(v):
+                if f2 != 1:    # tensor_type
+                    continue
+                for f3, _w3, v3 in _fields(v2):
+                    if f3 == 1:
+                        vi.elem_type = v3
+                    elif f3 == 2:
+                        for f4, _w4, v4 in _fields(v3):
+                            if f4 != 1:
+                                continue
+                            dim = None
+                            for f5, w5, v5 in _fields(v4):
+                                if f5 == 1:
+                                    dim = _signed64(v5)
+                                elif f5 == 2:
+                                    dim = v5.decode()
+                            vi.shape.append(dim if dim is not None else 0)
+    return vi
+
+
+def dec_graph(buf):
+    g = _D()
+    g.node, g.initializer, g.input, g.output = [], [], [], []
+    g.name = ""
+    for f, w, v in _fields(buf):
+        if f == 1:
+            g.node.append(dec_node(v))
+        elif f == 2:
+            g.name = v.decode()
+        elif f == 5:
+            g.initializer.append(dec_tensor(v))
+        elif f == 11:
+            g.input.append(dec_value_info(v))
+        elif f == 12:
+            g.output.append(dec_value_info(v))
+    return g
+
+
+def dec_model(buf):
+    m = _D()
+    m.ir_version = 0
+    m.producer_name = ""
+    m.graph = None
+    m.opset = 0
+    for f, w, v in _fields(buf):
+        if f == 1:
+            m.ir_version = v
+        elif f == 2:
+            m.producer_name = v.decode()
+        elif f == 7:
+            m.graph = dec_graph(v)
+        elif f == 8:
+            for f2, _w2, v2 in _fields(v):
+                if f2 == 2:
+                    m.opset = max(m.opset, _signed64(v2))
+    return m

@@ -69,17 +69,23 @@ _scope = _BlockScope()
 
 
 class _NameScopeCtx:
+    """One ctx per Block, REUSED across ``with`` statements — so saved
+    outer scopes live on a stack: re-entering the same block's scope
+    (e.g. a helper taking ``parent.name_scope()`` while the parent's
+    __init__ is already inside it) must not clobber the saved outer
+    scope with ``self`` and leak the scope process-wide."""
+
     def __init__(self, block):
         self._block = block
-        self._old = None
+        self._olds = []
 
     def __enter__(self):
-        self._old = _scope.current
+        self._olds.append(_scope.current)
         _scope.current = self
         return self
 
     def __exit__(self, *exc):
-        _scope.current = self._old
+        _scope.current = self._olds.pop()
 
 
 class Block:

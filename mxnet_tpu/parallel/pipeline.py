@@ -85,9 +85,7 @@ def pipeline_apply(stage_fn, stacked_leaves, x, rng, *, mesh=None,
         h, _ = lax.scan(one, h, (leaves, jnp.arange(l_per)))
         return h
 
-    active = (mesh is not None and axis in mesh.axis_names
-              and mesh.shape[axis] > 1)
-    if not active:
+    if not pipeline_active(axis, mesh):
         # one device (or no mesh): the same layers, applied in order
         flat = tuple(a.reshape((n_stages * l_per,) + a.shape[2:])
                      for a in stacked_leaves)
@@ -270,6 +268,19 @@ class Pipelined(HybridBlock):
             else:
                 sp.initialize(ctx=ctx)
 
+    def _ensure_template_ready(self, ctx):
+        """The template's arrays are pure-fn swap vehicles: their VALUES
+        are never read, but they must exist. When the stacked params are
+        already sized (concrete-shape template, or restored checkpoint),
+        derive template shapes from them — no sample forward needed."""
+        for p, sp in zip(self._tmpl_params, self._stacked):
+            if p._data is not None:
+                continue
+            if p.shape is None or any(s <= 0 for s in p.shape):
+                p.shape = tuple(sp.shape[2:])
+            p._deferred_init = None
+            p.initialize(ctx=ctx)
+
     # -- forward --------------------------------------------------------
     def _eager_forward(self, x):
         import jax
@@ -284,6 +295,7 @@ class Pipelined(HybridBlock):
             self._settle(x)
         ctx = x.context
         tmpl = self._template_holder[0]
+        self._ensure_template_ready(ctx)
         tmpl_arrays = [p.data(ctx) for p in self._tmpl_params]
         pure, _cell = make_pure_fn(tmpl, tmpl_arrays, ctx, is_training())
 
@@ -316,11 +328,12 @@ def pipeline_sharding_rules(axis="pp", extra=None):
     composing with tensor parallelism, e.g.::
 
         pipeline_sharding_rules(extra=[
-            (r"pp_.*(q|kv|gateup)_weight$", (None, "tp")),   # dims after
-            (r"pp_.*(out|down)_weight$",    (None, None, "tp")),
+            (r"pp_.*(q|kv|gateup)_weight$", ("tp",)),      # column-parallel
+            (r"pp_.*(out|down)_weight$",    (None, "tp")),  # row-parallel
         ])
 
-    where the tuple gives entries for dims AFTER the (stage, layer) lead.
+    where the tuple gives entries for the dims AFTER the (stage, layer)
+    lead — ("tp",) shards a stacked (S, L, out, in) weight's out dim.
     """
     from jax.sharding import PartitionSpec as P
 

@@ -432,8 +432,21 @@ def _apply_op(opname: str, inputs: List[Symbol], attrs: dict,
     node_name = name or _auto_name(opname.lower().lstrip("_"))
     nout = opdef.num_outputs or 1
     node = _Node(opname, node_name, dict(attrs), entries, nout)
+    _mark_aux_inputs(node, opdef)
     return Symbol([(node, 0)]) if nout == 1 else \
         Symbol([(node, i) for i in range(nout)])
+
+
+def _mark_aux_inputs(node, opdef):
+    """FMutateInputs-style aux detection: plain vars fed to an op's
+    mutated params (AUX_PARAMS) are auxiliary states — applied both when
+    composing (`_apply_op`) and when loading JSON (`load_json`)."""
+    if node.op not in AUX_PARAMS:
+        return
+    aux_names = AUX_PARAMS[node.op]
+    for pname, (parent, _) in zip(opdef.tensor_params, node.inputs):
+        if pname in aux_names and parent.op is None:
+            parent.attrs["__aux__"] = True
 
 
 def var(name, attr=None, shape=None, lr_mult=None, wd_mult=None, dtype=None,
@@ -481,14 +494,9 @@ def load_json(json_str: str) -> Symbol:
                          opdef.num_outputs or 1)
         nodes.append(node)
     heads = data.get("heads") or [[len(nodes) - 1, 0, 0]]
-    # aux detection: inputs of ops feeding aux tensor params become aux vars
     for node in nodes:
-        if node.op in AUX_PARAMS:
-            opdef = get_op(node.op)
-            aux_names = AUX_PARAMS[node.op]
-            for pname, (parent, _) in zip(opdef.tensor_params, node.inputs):
-                if pname in aux_names and parent.op is None:
-                    parent.attrs["__aux__"] = True
+        if node.op is not None:
+            _mark_aux_inputs(node, get_op(node.op))
     return Symbol([(nodes[i], oi) for i, oi, *_ in heads])
 
 

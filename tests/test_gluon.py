@@ -292,3 +292,17 @@ def test_cast_dtype():
     net.cast("bfloat16")
     out = net(mx.nd.ones((2, 2), dtype="bfloat16"))
     assert str(out.dtype) == "bfloat16"
+
+
+def test_name_scope_not_leaked_by_reentrant_blocks():
+    """Regression: Dense(activation=...) re-enters its own name_scope in
+    __init__ (via _make_activation); the scope stack must unwind to None
+    or every later top-level block inherits a bogus prefix."""
+    from mxnet_tpu.gluon.block import _scope
+
+    before = _scope.current
+    net = nn.HybridSequential()
+    net.add(nn.Dense(4, activation="relu"), nn.Dense(2))
+    assert _scope.current is before
+    d = nn.Dense(3)
+    assert not d.prefix.startswith(net.prefix)

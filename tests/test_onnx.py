@@ -1,0 +1,161 @@
+"""ONNX export/import tests (reference: tests/python-pytest/onnx/
+test_onnxruntime*, mx2onnx/onnx2mx converter suites).
+
+Oracle = numerical round-trip: a gluon net exported to ONNX and imported
+back must produce the same outputs; the wire codec must survive an
+encode→decode cycle field-for-field.
+"""
+import os
+
+import numpy as onp
+import pytest
+
+import mxnet_tpu as mx
+from mxnet_tpu.contrib import onnx as onnx_mxnet
+from mxnet_tpu.contrib.onnx import onnx_pb as pb
+from mxnet_tpu.gluon import nn
+
+
+def _export_block(net, x, tmp_path, name):
+    net.hybridize()
+    net(x)
+    prefix = str(tmp_path / name)
+    net.export(prefix)
+    onnx_file = prefix + ".onnx"
+    onnx_mxnet.export_model(prefix + "-symbol.json",
+                            prefix + "-0000.params",
+                            input_shapes=[tuple(x.shape)],
+                            onnx_file_path=onnx_file)
+    return onnx_file
+
+
+class TestCodec:
+    def test_tensor_roundtrip(self):
+        for dtype in ("float32", "int64", "int32", "float16", "bool"):
+            a = (onp.random.RandomState(0).randn(3, 4) * 5).astype(dtype)
+            t = pb.TensorProto.from_array(a, name="w")
+            back = pb.dec_tensor(t.encode())
+            assert back.name == "w" and list(back.dims) == [3, 4]
+            onp.testing.assert_array_equal(back.to_array(), a)
+
+    def test_typed_data_fallback(self):
+        # writers that use float_data/int64_data instead of raw_data
+        t = pb.TensorProto(name="f", dims=(2, 2), data_type=pb.FLOAT)
+        enc = (pb._f_varint(1, 2) + pb._f_varint(1, 2)
+               + pb._f_varint(2, pb.FLOAT) + pb._f_str(8, "f")
+               + b"".join(pb._tag(4, 5) + __import__("struct").pack("<f", v)
+                          for v in (1.0, 2.0, 3.0, 4.0)))
+        back = pb.dec_tensor(enc)
+        onp.testing.assert_allclose(back.to_array(),
+                                    [[1.0, 2.0], [3.0, 4.0]])
+
+    def test_model_roundtrip_fields(self):
+        node = pb.NodeProto("Relu", ["x"], ["y"], name="r",
+                            attrs={"axis": 1, "alpha": 0.5, "mode": "nn",
+                                   "axes": [1, 2], "scales": [1.0, 2.0]})
+        g = pb.GraphProto(
+            nodes=[node],
+            inputs=[pb.ValueInfoProto("x", pb.FLOAT, (1, "N", 3))],
+            outputs=[pb.ValueInfoProto("y", pb.FLOAT, (1, 3))],
+            initializers=[pb.TensorProto.from_array(
+                onp.ones((2,), onp.float32), name="w")])
+        m = pb.ModelProto(g, opset=13)
+        back = pb.dec_model(m.encode())
+        assert back.producer_name == "mxnet_tpu" and back.opset == 13
+        bg = back.graph
+        assert bg.input[0].shape == [1, "N", 3]
+        assert bg.node[0].op_type == "Relu"
+        assert bg.node[0].attribute["axis"] == 1
+        assert bg.node[0].attribute["alpha"] == pytest.approx(0.5)
+        assert bg.node[0].attribute["mode"] == "nn"
+        assert bg.node[0].attribute["axes"] == [1, 2]
+        assert bg.node[0].attribute["scales"] == [1.0, 2.0]
+        assert bg.initializer[0].name == "w"
+
+
+class TestRoundTrip:
+    def test_mlp(self, tmp_path):
+        net = nn.HybridSequential()
+        net.add(nn.Dense(16, activation="relu"), nn.Dropout(0.5),
+                nn.Dense(4))
+        net.initialize()
+        x = mx.nd.array(onp.random.RandomState(0).randn(2, 8)
+                        .astype("float32"))
+        want = net(x).asnumpy()
+        f = _export_block(net, x, tmp_path, "mlp")
+
+        meta = onnx_mxnet.get_model_metadata(f)
+        assert meta["input_tensor_data"][0][1] == (2, 8)
+
+        sym, arg, aux = onnx_mxnet.import_model(f)
+        assert not aux
+        from mxnet_tpu.gluon import SymbolBlock  # noqa: F401  (API parity)
+        net2 = onnx_mxnet.import_to_gluon(f)
+        got = net2(x).asnumpy()
+        onp.testing.assert_allclose(got, want, rtol=1e-5, atol=1e-5)
+
+    def test_convnet_with_bn(self, tmp_path):
+        net = nn.HybridSequential()
+        net.add(nn.Conv2D(8, kernel_size=3, padding=1, strides=2),
+                nn.BatchNorm(), nn.Activation("relu"),
+                nn.MaxPool2D(pool_size=2),
+                nn.GlobalAvgPool2D(), nn.Flatten(), nn.Dense(5))
+        net.initialize()
+        x = mx.nd.array(onp.random.RandomState(1).randn(2, 3, 16, 16)
+                        .astype("float32"))
+        net(x)  # settle + give BN stats a step
+        want = net(x).asnumpy()
+        f = _export_block(net, x, tmp_path, "conv")
+
+        sym, arg, aux = onnx_mxnet.import_model(f)
+        # BN running stats come back as AUX params, like upstream
+        assert any("running" in k or "moving" in k for k in aux), aux
+        net2 = onnx_mxnet.import_to_gluon(f)
+        got = net2(x).asnumpy()
+        onp.testing.assert_allclose(got, want, rtol=1e-4, atol=1e-4)
+
+    def test_embedding_transformerish(self, tmp_path):
+        class Tiny(nn.HybridSequential):
+            pass
+
+        net = nn.HybridSequential()
+        net.add(nn.Embedding(32, 12), nn.LayerNorm(),
+                nn.Dense(6, flatten=False))
+        net.initialize()
+        x = mx.nd.array(onp.random.RandomState(2).randint(0, 32, (2, 5)),
+                        dtype="float32")
+        want = net(x).asnumpy()
+        f = _export_block(net, x, tmp_path, "emb")
+        net2 = onnx_mxnet.import_to_gluon(f)
+        got = net2(x).asnumpy()
+        onp.testing.assert_allclose(got, want, rtol=1e-5, atol=1e-5)
+
+    def test_unsupported_op_raises(self, tmp_path):
+        s = mx.sym.var("data")
+        y = mx.sym.gamma(s) if hasattr(mx.sym, "gamma") else None
+        if y is None:
+            pytest.skip("no un-mapped op available")
+        with pytest.raises(mx.base.MXNetError, match="no converter"):
+            onnx_mxnet.export_model(y, {}, [(2, 2)],
+                                    onnx_file_path=str(tmp_path / "x.onnx"))
+
+
+def test_gemm_transb0_import(tmp_path):
+    """Regression: Gemm(transB=0) — the layout non-MXNet exporters emit —
+    must import (weight gets pre-transposed into FC layout)."""
+    w = onp.random.RandomState(0).randn(8, 4).astype("float32")
+    b = onp.random.RandomState(1).randn(4).astype("float32")
+    g = pb.GraphProto(
+        nodes=[pb.NodeProto("Gemm", ["x", "w", "b"], ["y"], name="g",
+                            attrs={"transB": 0})],
+        inputs=[pb.ValueInfoProto("x", pb.FLOAT, (2, 8))],
+        outputs=[pb.ValueInfoProto("y", pb.FLOAT, (2, 4))],
+        initializers=[pb.TensorProto.from_array(w, "w"),
+                      pb.TensorProto.from_array(b, "b")])
+    f = str(tmp_path / "gemm.onnx")
+    with open(f, "wb") as fh:
+        fh.write(pb.ModelProto(g).encode())
+    net = onnx_mxnet.import_to_gluon(f)
+    x = onp.random.RandomState(2).randn(2, 8).astype("float32")
+    got = net(mx.nd.array(x)).asnumpy()
+    onp.testing.assert_allclose(got, x @ w + b, rtol=1e-5, atol=1e-5)

@@ -187,3 +187,25 @@ class TestPipelinedBlock:
                 first = v
             last = v
         assert last < first * 0.6, (first, last)
+
+
+def test_concrete_shape_template():
+    """Regression: a stage template with fully concrete shapes (no
+    deferred init) must still forward — the template donor params are
+    initialized lazily from the stacked shapes."""
+    from mxnet_tpu.gluon import nn
+
+    class Res(nn.HybridSequential):
+        pass
+
+    def factory():
+        blk = Res()
+        blk.add(nn.Dense(8, in_units=8, flatten=False))
+        return blk
+
+    net = par.Pipelined(factory, n_stages=2)
+    net.initialize()
+    x = mx.nd.array(onp.random.RandomState(0).randn(4, 8).astype("float32"))
+    y = net(x)
+    assert y.shape == (4, 8)
+    assert onp.isfinite(y.asnumpy()).all()
