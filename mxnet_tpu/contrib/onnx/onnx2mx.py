@@ -99,7 +99,7 @@ def _pool(sym, ins, attrs, ctx):
         ins[0], kernel=tuple(attrs["kernel_shape"]),
         stride=tuple(attrs.get("strides", ())) or None,
         pad=_first_half_pads(attrs.get("pads")), pool_type=ptype,
-        count_include_pad=bool(attrs.get("count_include_pad", 1)))
+        count_include_pad=bool(attrs.get("count_include_pad", 0)))
 
 
 @_imports("GlobalMaxPool", "GlobalAveragePool")
@@ -121,8 +121,9 @@ def _reshape(sym, ins, attrs, ctx):
 @_imports("Clip")
 def _clip(sym, ins, attrs, ctx):
     if len(ins) > 1:
-        lo = float(ctx.take_constant(1))
-        hi = float(ctx.take_constant(2)) if len(ins) > 2 else _np.inf
+        lo = float(ctx.take_constant(1)) if ins[1] is not None else -_np.inf
+        hi = float(ctx.take_constant(2)) if len(ins) > 2 and \
+            ins[2] is not None else _np.inf
     else:
         lo = float(attrs.get("min", -_np.inf))
         hi = float(attrs.get("max", _np.inf))
@@ -311,9 +312,18 @@ def import_model(model_file):
                 "mxnet_tpu/contrib/onnx/onnx2mx.py")
         ctx.op_type = node.op_type
         ctx.node_name = node.name or node.output[0]
-        ctx.in_names = [i for i in node.input if i != ""]
+        # trailing empty names = omitted optional inputs (drop); interior
+        # empties keep their POSITION as None so later inputs don't shift
+        # (e.g. Clip with min omitted: ['x', '', 'max'])
+        names = list(node.input)
+        while names and names[-1] == "":
+            names.pop()
+        ctx.in_names = names
         ins = []
-        for i in ctx.in_names:
+        for i in names:
+            if i == "":
+                ins.append(None)
+                continue
             if i not in outputs_of:      # late initializer (Constant etc.)
                 outputs_of[i] = sym_ns.var(i)
             ins.append(outputs_of[i])

@@ -1,0 +1,392 @@
+"""Post-training int8 quantization (reference:
+``python/mxnet/contrib/quantization.py`` :: ``quantize_model``,
+``quantize_net``, ``_LayerOutputMinMaxCollector``,
+``_LayerHistogramCollector`` / KL-entropy calibration).
+
+TPU-native design: weights are stored int8 with per-output-channel
+symmetric scales; activations are fake-quantized onto the int8 grid with
+calibrated (naive min/max or KL-entropy) or dynamic ranges, so the f32
+MXU matmul reproduces the integer arithmetic exactly while parameter
+memory drops 4x. Both surfaces are provided: ``quantize_net`` rewrites a
+Gluon net's Dense/Conv children in place; ``quantize_model`` rewrites a
+Symbol graph + params (the Module-era API).
+"""
+from __future__ import annotations
+
+import logging
+from typing import Dict, List, Optional
+
+import numpy as _np
+
+from ..base import MXNetError
+
+__all__ = ["quantize_model", "quantize_net", "quantize_weight",
+           "LayerOutputMinMaxCollector", "LayerHistogramCollector",
+           "optimal_threshold_kl"]
+
+_QUANTIZABLE = {"FullyConnected": "_contrib_quantized_dense",
+                "Convolution": "_contrib_quantized_conv"}
+
+
+# ---------------------------------------------------------------- weights
+def quantize_weight(w: _np.ndarray):
+    """Symmetric per-output-channel int8: returns (wq int8, scale f32[out])
+    with ``w ≈ wq * scale[:, None, ...]``."""
+    w = _np.asarray(w, _np.float32)
+    flat = _np.abs(w.reshape(w.shape[0], -1))
+    t = _np.maximum(flat.max(axis=1), 1e-12)
+    scale = (t / 127.0).astype(_np.float32)
+    wq = _np.clip(_np.round(w / scale.reshape((-1,) + (1,) * (w.ndim - 1))),
+                  -127, 127).astype(_np.int8)
+    return wq, scale
+
+
+# ---------------------------------------------------------------- calib
+class LayerOutputMinMaxCollector:
+    """Naive calibration: running min/max per collected name."""
+
+    def __init__(self):
+        self.min_max: Dict[str, tuple] = {}
+
+    def collect(self, name, arr):
+        arr = _np.asarray(arr)
+        lo, hi = float(arr.min()), float(arr.max())
+        if name in self.min_max:
+            plo, phi = self.min_max[name]
+            lo, hi = min(lo, plo), max(hi, phi)
+        self.min_max[name] = (lo, hi)
+
+    def ranges(self):
+        return dict(self.min_max)
+
+
+class LayerHistogramCollector:
+    """Entropy calibration: symmetric histograms, thresholds by KL."""
+
+    def __init__(self, num_bins=2048):
+        self.num_bins = num_bins
+        self.hist: Dict[str, _np.ndarray] = {}
+        self.edges: Dict[str, _np.ndarray] = {}
+
+    def collect(self, name, arr):
+        arr = _np.abs(_np.asarray(arr, _np.float32)).ravel()
+        t = float(arr.max()) if arr.size else 0.0
+        if name not in self.hist:
+            t = max(t, 1e-12)
+            self.edges[name] = _np.linspace(0.0, t, self.num_bins + 1)
+            self.hist[name] = _np.histogram(arr, bins=self.edges[name])[0] \
+                .astype(_np.float64)
+        else:
+            edges = self.edges[name]
+            if t > edges[-1]:
+                # grow the range: re-bin the old histogram into new edges
+                new_edges = _np.linspace(0.0, t, self.num_bins + 1)
+                centers = (edges[:-1] + edges[1:]) / 2
+                idx = _np.clip(_np.searchsorted(new_edges, centers) - 1,
+                               0, self.num_bins - 1)
+                re_binned = _np.zeros(self.num_bins)
+                _np.add.at(re_binned, idx, self.hist[name])
+                self.hist[name] = re_binned
+                self.edges[name] = new_edges
+            self.hist[name] += _np.histogram(
+                arr, bins=self.edges[name])[0].astype(_np.float64)
+
+    def ranges(self):
+        out = {}
+        for name, hist in self.hist.items():
+            t = optimal_threshold_kl(hist, self.edges[name])
+            out[name] = (-t, t)
+        return out
+
+
+def _kl_divergence(p, q):
+    p = p / max(p.sum(), 1e-12)
+    q = q / max(q.sum(), 1e-12)
+    mask = p > 0
+    return float(_np.sum(p[mask] * _np.log(
+        p[mask] / _np.maximum(q[mask], 1e-12))))
+
+
+def optimal_threshold_kl(hist, edges, num_quantized_bins=255):
+    """The TensorRT-style KL sweep (reference:
+    quantization.py::_get_optimal_threshold): pick the clip threshold
+    whose 255-bin quantized distribution diverges least from the
+    reference distribution."""
+    hist = _np.asarray(hist, _np.float64).copy()
+    # TensorRT's rule: bin 0 (zeros — e.g. half a relu's mass) is not part
+    # of the distribution being matched; keeping it biases the sweep
+    # toward clipping the real positive tail
+    hist[0] = 0
+    n = len(hist)
+    if hist.sum() == 0:
+        return float(edges[-1])
+    best_t, best_kl = float(edges[-1]), _np.inf
+    start = max(num_quantized_bins // 2, num_quantized_bins)
+    for i in range(start, n + 1, max(1, n // 128)):
+        ref = hist[:i].copy()
+        ref[i - 1] += hist[i:].sum()        # clip outliers into last bin
+        # quantize first i bins down to num_quantized_bins, then expand
+        factor = i / num_quantized_bins
+        q = _np.zeros(i)
+        for j in range(num_quantized_bins):
+            lo, hi = int(_np.floor(j * factor)), int(_np.ceil((j + 1) * factor))
+            hi = min(hi, i)
+            chunk = hist[lo:hi]
+            nz = (chunk > 0).sum()
+            if nz:
+                q[lo:hi] = _np.where(chunk > 0, chunk.sum() / nz, 0.0)
+        kl = _kl_divergence(ref, q)
+        if kl < best_kl:
+            best_kl, best_t = kl, float(edges[i])
+    return best_t
+
+
+def _make_collector(calib_mode):
+    if calib_mode == "naive":
+        return LayerOutputMinMaxCollector()
+    if calib_mode == "entropy":
+        return LayerHistogramCollector()
+    raise MXNetError(f"unknown calib_mode {calib_mode!r} "
+                     "(expected 'naive', 'entropy' or 'none')")
+
+
+def _iter_batches(calib_data, num_calib_batches):
+    from ..ndarray import NDArray
+
+    if isinstance(calib_data, NDArray):
+        yield calib_data
+        return
+    count = 0
+    for batch in calib_data:
+        if hasattr(batch, "data"):      # io.DataBatch
+            batch = batch.data[0]
+        if isinstance(batch, (list, tuple)):
+            batch = batch[0]
+        yield batch
+        count += 1
+        if num_calib_batches is not None and count >= num_calib_batches:
+            return
+
+
+# ---------------------------------------------------------------- gluon
+class _QuantizedLayer:
+    """Mixin: holds int8 weight + scale (+bias) as frozen Parameters."""
+
+    def _setup_qparams(self, w, bias):
+        wq, scale = quantize_weight(w.asnumpy())
+        from ..ndarray import array as nd_array
+
+        with self.name_scope():
+            self.weight_q = self.params.get(
+                "weight_quant", shape=wq.shape, dtype="int8",
+                grad_req="null", init="zeros", differentiable=False)
+            self.w_scale = self.params.get(
+                "weight_scale", shape=scale.shape, grad_req="null",
+                init="ones", differentiable=False)
+            self.bias = None
+            if bias is not None:
+                self.bias = self.params.get(
+                    "bias", shape=bias.shape, grad_req="null",
+                    init="zeros", differentiable=False)
+        self.weight_q.initialize()
+        self.weight_q.set_data(nd_array(wq, dtype="int8"))
+        self.w_scale.initialize()
+        self.w_scale.set_data(nd_array(scale))
+        if bias is not None:
+            self.bias.initialize()
+            self.bias.set_data(bias.data())
+
+
+def _quantized_dense_cls():
+    from ..gluon.block import HybridBlock
+
+    class QuantizedDense(HybridBlock, _QuantizedLayer):
+        def __init__(self, src, calib_range, prefix=None, params=None):
+            super().__init__(prefix=prefix, params=params)
+            self._units = src._units
+            self._flatten = src._flatten
+            self._range = calib_range      # (min, max) or None = dynamic
+            self.act = src.act
+            self._setup_qparams(src.weight.data(), src.bias)
+
+        def hybrid_forward(self, F, x, weight_q, w_scale, bias=None):
+            lo, hi = self._range or (None, None)
+            out = F._contrib_quantized_dense(
+                x, weight_q, w_scale, bias, num_hidden=self._units,
+                no_bias=bias is None, flatten=self._flatten,
+                min_calib_range=lo, max_calib_range=hi)
+            return self.act(out) if self.act is not None else out
+
+    return QuantizedDense
+
+
+def _quantized_conv_cls():
+    from ..gluon.block import HybridBlock
+
+    class QuantizedConv(HybridBlock, _QuantizedLayer):
+        def __init__(self, src, calib_range, prefix=None, params=None):
+            super().__init__(prefix=prefix, params=params)
+            self._kwargs = dict(src._kwargs)
+            self._range = calib_range
+            self.act = src.act
+            self._setup_qparams(src.weight.data(), src.bias)
+
+        def hybrid_forward(self, F, x, weight_q, w_scale, bias=None):
+            lo, hi = self._range or (None, None)
+            out = F._contrib_quantized_conv(
+                x, weight_q, w_scale, bias, no_bias=bias is None,
+                min_calib_range=lo, max_calib_range=hi, **self._kwargs)
+            return self.act(out) if self.act is not None else out
+
+    return QuantizedConv
+
+
+def _find_targets(block, exclude, path=""):
+    """Yield (parent, child_key, attr_name, block) for quantizable layers."""
+    from ..gluon import nn
+
+    for key, child in list(block._children.items()):
+        name = child.name
+        quantizable = isinstance(child, nn.Dense) or (
+            isinstance(child, nn.Conv2D))
+        if quantizable and name not in exclude:
+            attr = next((a for a, v in vars(block).items() if v is child),
+                        None)
+            yield block, key, attr, child
+        else:
+            yield from _find_targets(child, exclude, path + key + ".")
+
+
+def quantize_net(network, calib_data=None, calib_mode="naive",
+                 exclude_layers=None, num_calib_batches=None,
+                 quantized_dtype="int8", logger=None):
+    """Quantize a Gluon net's Dense/Conv2D layers in place (reference:
+    quantization.py::quantize_net). ``calib_mode='none'`` → dynamic
+    per-batch activation ranges (no calib_data needed). Returns the net.
+    """
+    from .. import autograd
+
+    if quantized_dtype != "int8":
+        raise MXNetError("only int8 quantization is supported")
+    exclude = set(exclude_layers or ())
+    targets = list(_find_targets(network, exclude))
+    if not targets:
+        raise MXNetError("quantize_net: no quantizable (Dense/Conv2D) "
+                         "layers found")
+
+    ranges: Dict[str, tuple] = {}
+    if calib_mode != "none":
+        if calib_data is None:
+            raise MXNetError(
+                f"calib_mode={calib_mode!r} needs calib_data "
+                "(use calib_mode='none' for dynamic quantization)")
+        collector = _make_collector(calib_mode)
+        handles = []
+        for _parent, _key, _attr, child in targets:
+            def hook(blk, inputs, _name=child.name):
+                collector.collect(_name, inputs[0].asnumpy())
+            handles.append(child.register_forward_pre_hook(hook))
+        with autograd.pause():
+            for batch in _iter_batches(calib_data, num_calib_batches):
+                network(batch)
+        for h in handles:
+            h.detach()
+        ranges = collector.ranges()
+
+    dense_cls, conv_cls = _quantized_dense_cls(), _quantized_conv_cls()
+    from ..gluon import nn
+
+    for parent, key, attr, child in targets:
+        calib = ranges.get(child.name)
+        cls = dense_cls if isinstance(child, nn.Dense) else conv_cls
+        q = cls(child, calib, prefix=child.prefix + "quant_")
+        parent._children[key] = q
+        if attr is not None:
+            object.__setattr__(parent, attr, q)
+        if logger:
+            logger.info("quantized %s (calib=%s)", child.name, calib)
+    # any compiled CachedOp graphs are stale now
+    for blk in _walk(network):
+        if getattr(blk, "_cached_graph", None) is not None:
+            blk._cached_graph = None
+    return network
+
+
+def _walk(block):
+    yield block
+    for child in block._children.values():
+        yield from _walk(child)
+
+
+# ---------------------------------------------------------------- symbol
+def quantize_model(sym, arg_params, aux_params, data_names=("data",),
+                   excluded_sym_names=None, calib_mode="naive",
+                   calib_data=None, num_calib_batches=None,
+                   quantized_dtype="int8", logger=None):
+    """Quantize a Symbol graph + params (reference:
+    quantization.py::quantize_model). Returns (qsym, qarg_params,
+    aux_params); FullyConnected/Convolution nodes whose weights live in
+    ``arg_params`` become ``_contrib_quantized_*`` nodes with int8
+    weights + per-channel scales."""
+    from ..symbol import symbol as sym_mod
+    from ..ndarray import array as nd_array
+
+    if quantized_dtype != "int8":
+        raise MXNetError("only int8 quantization is supported")
+    excluded = set(excluded_sym_names or ())
+    qsym = sym_mod.load_json(sym.tojson())
+
+    targets = []
+    for node in qsym._topo():
+        if node.op in _QUANTIZABLE and node.name not in excluded:
+            wnode = node.inputs[1][0]
+            if wnode.op is None and wnode.name in arg_params:
+                targets.append(node)
+    if not targets:
+        raise MXNetError("quantize_model: no quantizable nodes found")
+
+    # calibration: evaluate every target's data input over calib batches
+    ranges: Dict[int, tuple] = {}
+    if calib_mode != "none":
+        if calib_data is None:
+            raise MXNetError(
+                f"calib_mode={calib_mode!r} needs calib_data "
+                "(use calib_mode='none' for dynamic quantization)")
+        from ..symbol.executor import eval_symbol
+
+        probe = sym_mod.Symbol([node.inputs[0] for node in targets])
+        collector = _make_collector(calib_mode)
+        base_feed = {k: v for k, v in arg_params.items()}
+        base_feed.update(aux_params or {})
+        for batch in _iter_batches(calib_data, num_calib_batches):
+            feed = dict(base_feed)
+            feed[data_names[0]] = batch
+            outs = eval_symbol(probe, feed)
+            outs = outs if isinstance(outs, (list, tuple)) else [outs]
+            for node, out in zip(targets, outs):
+                collector.collect(node.name, out.asnumpy())
+        named = collector.ranges()
+        ranges = {id(node): named[node.name] for node in targets}
+
+    qarg = {k: v for k, v in arg_params.items()}
+    for node in targets:
+        wname = node.inputs[1][0].name
+        wq, scale = quantize_weight(qarg.pop(wname).asnumpy())
+        qarg[wname + "_quant"] = nd_array(wq, dtype="int8")
+        qarg[wname + "_scale"] = nd_array(scale)
+        wq_var = sym_mod.var(wname + "_quant")._entries[0]
+        ws_var = sym_mod.var(wname + "_scale")._entries[0]
+        new_inputs = [node.inputs[0], wq_var, ws_var] + list(node.inputs[2:])
+        attrs = dict(node.attrs)
+        if node.op == "FullyConnected":
+            attrs.pop("num_group", None)
+        attrs.pop("no_bias", None)
+        lo, hi = ranges.get(id(node), (None, None))
+        attrs["min_calib_range"] = lo
+        attrs["max_calib_range"] = hi
+        node.op = _QUANTIZABLE[node.op]
+        node.inputs = new_inputs
+        node.attrs = attrs
+        if logger:
+            logger.info("quantized %s -> %s", node.name, node.op)
+    return qsym, qarg, dict(aux_params or {})

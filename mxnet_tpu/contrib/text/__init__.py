@@ -1,0 +1,16 @@
+"""``mx.contrib.text`` — vocabulary + token embeddings (reference:
+``python/mxnet/contrib/text/{vocab,embedding,utils}.py``).
+
+Offline-first: pretrained-embedding downloads are unavailable in this
+environment, so ``CustomEmbedding`` loads any GloVe/fastText-format text
+file and ``get_pretrained_file_names`` documents the gap instead of
+silently failing.
+"""
+from . import utils
+from .vocab import Vocabulary
+from .embedding import (TokenEmbedding, CustomEmbedding, CompositeEmbedding,
+                        register, create, get_pretrained_file_names)
+
+__all__ = ["Vocabulary", "TokenEmbedding", "CustomEmbedding",
+           "CompositeEmbedding", "register", "create",
+           "get_pretrained_file_names", "utils"]

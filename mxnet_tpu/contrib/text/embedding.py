@@ -1,0 +1,190 @@
+"""Token embeddings (reference: ``python/mxnet/contrib/text/embedding.py``
+:: ``_TokenEmbedding``/``GloVe``/``FastText``/``CustomEmbedding``/
+``CompositeEmbedding`` + the ``register``/``create`` registry).
+
+Pretrained weight DOWNLOADS are impossible in this offline environment;
+``CustomEmbedding`` loads the same on-disk text format (one token per
+line followed by its vector), which is what GloVe/fastText files contain
+once fetched — point it at a local copy and the API matches upstream."""
+from __future__ import annotations
+
+import io
+import logging
+from typing import Dict, List, Optional
+
+import numpy as _np
+
+from ...base import MXNetError
+
+__all__ = ["TokenEmbedding", "CustomEmbedding", "CompositeEmbedding",
+           "register", "create", "get_pretrained_file_names"]
+
+_REGISTRY: Dict[str, type] = {}
+
+
+def register(cls):
+    """Register an embedding class (reference: embedding.py::register)."""
+    _REGISTRY[cls.__name__.lower()] = cls
+    return cls
+
+
+def create(embedding_name, **kwargs):
+    key = str(embedding_name).lower()
+    if key not in _REGISTRY:
+        raise MXNetError(
+            f"unknown embedding {embedding_name!r}; registered: "
+            f"{sorted(_REGISTRY)}. Pretrained GloVe/fastText downloads "
+            "are unavailable offline — load a local vector file with "
+            "CustomEmbedding(pretrained_file_path=...)")
+    return _REGISTRY[key](**kwargs)
+
+
+def get_pretrained_file_names(embedding_name=None):
+    """Upstream lists downloadable archives; offline there are none."""
+    return {} if embedding_name is None else []
+
+
+class TokenEmbedding:
+    """Base: idx<->token plus an (N, dim) vector table; index 0 is the
+    unknown token whose vector comes from ``init_unknown_vec``."""
+
+    def __init__(self, unknown_token="<unk>"):
+        self._unknown_token = unknown_token
+        self._idx_to_token: List[str] = [unknown_token]
+        self._token_to_idx: Dict[str, int] = {unknown_token: 0}
+        self._idx_to_vec = None     # numpy (N, dim)
+
+    # -- loading --------------------------------------------------------
+    def _load_embedding_txt(self, path, elem_delim=" ",
+                            encoding="utf8", init_unknown_vec=_np.zeros):
+        vecs = []
+        dim = None
+        with io.open(path, "r", encoding=encoding) as f:
+            for lineno, line in enumerate(f):
+                parts = line.rstrip().split(elem_delim)
+                if lineno == 0 and len(parts) == 2 and \
+                        parts[0].isdigit() and parts[1].isdigit():
+                    continue            # fastText header "N dim"
+                token, elems = parts[0], parts[1:]
+                if dim is None:
+                    dim = len(elems)
+                    if dim < 2:
+                        raise MXNetError(
+                            f"{path}:{lineno}: vector dim {dim} < 2 — "
+                            "wrong elem_delim?")
+                if len(elems) != dim:
+                    logging.warning("%s:%d: dim %d != %d, skipped",
+                                    path, lineno, len(elems), dim)
+                    continue
+                if token in self._token_to_idx:
+                    logging.warning("%s:%d: duplicate token %r, skipped",
+                                    path, lineno, token)
+                    continue
+                self._token_to_idx[token] = len(self._idx_to_token)
+                self._idx_to_token.append(token)
+                vecs.append(_np.asarray(elems, _np.float32))
+        if dim is None:
+            raise MXNetError(f"{path}: no vectors found")
+        table = _np.vstack([init_unknown_vec((1, dim)).reshape(1, dim)]
+                           + [v[None] for v in vecs]).astype(_np.float32)
+        self._idx_to_vec = table
+
+    # -- surface --------------------------------------------------------
+    def __len__(self):
+        return len(self._idx_to_token)
+
+    def __contains__(self, token):
+        return token in self._token_to_idx
+
+    @property
+    def vec_len(self):
+        return 0 if self._idx_to_vec is None else self._idx_to_vec.shape[1]
+
+    @property
+    def unknown_token(self):
+        return self._unknown_token
+
+    @property
+    def token_to_idx(self):
+        return self._token_to_idx
+
+    @property
+    def idx_to_token(self):
+        return self._idx_to_token
+
+    @property
+    def idx_to_vec(self):
+        from ...ndarray import array as nd_array
+
+        return nd_array(self._idx_to_vec)
+
+    def get_vecs_by_tokens(self, tokens, lower_case_backup=False):
+        from ...ndarray import array as nd_array
+
+        single = isinstance(tokens, str)
+        toks = [tokens] if single else list(tokens)
+        idx = []
+        for t in toks:
+            i = self._token_to_idx.get(t)
+            if i is None and lower_case_backup:
+                i = self._token_to_idx.get(t.lower())
+            idx.append(0 if i is None else i)
+        out = self._idx_to_vec[idx]
+        return nd_array(out[0] if single else out)
+
+    def update_token_vectors(self, tokens, new_vectors):
+        vecs = _np.asarray(
+            new_vectors.asnumpy() if hasattr(new_vectors, "asnumpy")
+            else new_vectors, _np.float32)
+        toks = [tokens] if isinstance(tokens, str) else list(tokens)
+        vecs = vecs.reshape(len(toks), -1)
+        for t, v in zip(toks, vecs):
+            if t not in self._token_to_idx:
+                raise MXNetError(f"token {t!r} is not in the embedding")
+            self._idx_to_vec[self._token_to_idx[t]] = v
+
+
+@register
+class CustomEmbedding(TokenEmbedding):
+    """Load any GloVe/fastText-format text file of vectors (reference:
+    embedding.py::CustomEmbedding)."""
+
+    def __init__(self, pretrained_file_path, elem_delim=" ",
+                 encoding="utf8", init_unknown_vec=_np.zeros,
+                 vocabulary=None, unknown_token="<unk>"):
+        super().__init__(unknown_token=unknown_token)
+        self._load_embedding_txt(pretrained_file_path, elem_delim,
+                                 encoding, init_unknown_vec)
+        if vocabulary is not None:
+            self._restrict_to_vocab(vocabulary)
+
+    def _restrict_to_vocab(self, vocab):
+        table = _np.zeros((len(vocab), self.vec_len), _np.float32)
+        for i, tok in enumerate(vocab.idx_to_token):
+            j = self._token_to_idx.get(tok)
+            if j is not None:
+                table[i] = self._idx_to_vec[j]
+        self._idx_to_token = list(vocab.idx_to_token)
+        self._token_to_idx = dict(vocab.token_to_idx)
+        self._idx_to_vec = table
+
+
+class CompositeEmbedding(TokenEmbedding):
+    """Concatenate several embeddings over one vocabulary (reference:
+    embedding.py::CompositeEmbedding)."""
+
+    def __init__(self, vocabulary, token_embeddings):
+        if not isinstance(token_embeddings, (list, tuple)):
+            token_embeddings = [token_embeddings]
+        super().__init__(unknown_token=vocabulary.unknown_token)
+        self._idx_to_token = list(vocabulary.idx_to_token)
+        self._token_to_idx = dict(vocabulary.token_to_idx)
+        parts = []
+        for emb in token_embeddings:
+            sub = _np.zeros((len(vocabulary), emb.vec_len), _np.float32)
+            for i, tok in enumerate(vocabulary.idx_to_token):
+                j = emb.token_to_idx.get(tok)
+                if j is not None:
+                    sub[i] = emb._idx_to_vec[j]
+            parts.append(sub)
+        self._idx_to_vec = _np.concatenate(parts, axis=1)

@@ -1,0 +1,82 @@
+"""Vocabulary (reference: ``python/mxnet/contrib/text/vocab.py`` ::
+``Vocabulary``) — token/index mapping built from a frequency counter."""
+from __future__ import annotations
+
+from collections import Counter
+from typing import Dict, List, Optional
+
+__all__ = ["Vocabulary"]
+
+
+class Vocabulary:
+    """Indexes tokens by frequency (ties broken alphabetically), with an
+    unknown token at index 0 and optional reserved tokens after it —
+    the reference's ordering contract."""
+
+    def __init__(self, counter: Optional[Counter] = None, most_freq_count=None,
+                 min_freq=1, unknown_token="<unk>", reserved_tokens=None):
+        if min_freq < 1:
+            raise ValueError("min_freq must be >= 1")
+        reserved_tokens = list(reserved_tokens or [])
+        if unknown_token in reserved_tokens:
+            raise ValueError("unknown_token must not be in reserved_tokens")
+        if len(set(reserved_tokens)) != len(reserved_tokens):
+            raise ValueError("reserved_tokens must be unique")
+        self._unknown_token = unknown_token
+        self._reserved_tokens = reserved_tokens or None
+        self._idx_to_token: List[str] = [unknown_token] + reserved_tokens
+        self._token_to_idx: Dict[str, int] = {
+            t: i for i, t in enumerate(self._idx_to_token)}
+        if counter is not None:
+            special = set(self._idx_to_token)
+            pairs = sorted(counter.items(), key=lambda kv: (-kv[1], kv[0]))
+            kept = 0
+            for token, freq in pairs:
+                if freq < min_freq:
+                    break
+                if most_freq_count is not None and kept >= most_freq_count:
+                    break
+                if token in special:
+                    continue
+                self._token_to_idx[token] = len(self._idx_to_token)
+                self._idx_to_token.append(token)
+                kept += 1
+
+    def __len__(self):
+        return len(self._idx_to_token)
+
+    def __contains__(self, token):
+        return token in self._token_to_idx
+
+    @property
+    def token_to_idx(self):
+        return self._token_to_idx
+
+    @property
+    def idx_to_token(self):
+        return self._idx_to_token
+
+    @property
+    def unknown_token(self):
+        return self._unknown_token
+
+    @property
+    def reserved_tokens(self):
+        return self._reserved_tokens
+
+    def to_indices(self, tokens):
+        """Token(s) -> index/indices; unknown tokens map to index 0."""
+        single = isinstance(tokens, str)
+        toks = [tokens] if single else list(tokens)
+        idx = [self._token_to_idx.get(t, 0) for t in toks]
+        return idx[0] if single else idx
+
+    def to_tokens(self, indices):
+        single = isinstance(indices, int)
+        idxs = [indices] if single else list(indices)
+        for i in idxs:
+            if not 0 <= i < len(self):
+                raise ValueError(f"token index {i} out of range [0, "
+                                 f"{len(self)})")
+        toks = [self._idx_to_token[i] for i in idxs]
+        return toks[0] if single else toks

@@ -95,6 +95,9 @@ class Trainer:
                 "nothing to aggregate); pass kvstore='tpu_sync' or drop "
                 "update_on_kvstore")
         if self._kvstore is not None:
+            if self._compression_params is not None:
+                self._kvstore.set_gradient_compression(
+                    self._compression_params)
             if self._update_on_kvstore is None:
                 # tpu_sync performs in-graph allreduce; the optimizer always
                 # runs worker-side (SURVEY.md §5.8 end-state)

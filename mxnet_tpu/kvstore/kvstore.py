@@ -38,6 +38,7 @@ class KVStore:
         self._type = type_name
         self._updater = None
         self._optimizer = None
+        self._compression = None
 
     @property
     def type(self):
@@ -73,9 +74,12 @@ class KVStore:
         self._updater = opt.get_updater(optimizer)
 
     def set_gradient_compression(self, compression_params):
-        raise MXNetError(
-            "gradient compression is a PS-path feature; not applicable to "
-            "the XLA-collective backend (planned for DCN in a later round)")
+        """2-bit threshold quantization with error feedback on every
+        pushed gradient (reference: kvstore.py::set_gradient_compression
+        → gradient_compression.cc)."""
+        from .gradient_compression import create_compression
+
+        self._compression = create_compression(compression_params)
 
     def save_optimizer_states(self, fname, dump_optimizer=False):
         if self._updater is None:
@@ -131,6 +135,11 @@ class KVStoreLocal(KVStore):
         key = self._canon(key)
         self._check_init(key)
         vals = list(value) if isinstance(value, (list, tuple)) else [value]
+        if self._compression is not None:
+            # quantize each worker-slot's gradient before the reduce —
+            # the same point the reference compresses before the wire
+            vals = [self._compression.compress(key, i, v)
+                    for i, v in enumerate(vals)]
         agg = self._aggregate(vals)
         if self._updater is not None:
             # server-side optimizer path (update_on_kvstore=True). The key

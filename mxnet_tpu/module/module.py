@@ -208,6 +208,13 @@ class Module(BaseModule):
         # time, optimizer states applied at init_optimizer time
         self._preloaded = None
         self._preloaded_states = None
+        self._compression = None
+        if compression_params is not None:
+            # single-context Module has no wire, but the semantics (2-bit
+            # quantized grads + error feedback) are honored in update()
+            from ..kvstore.gradient_compression import create_compression
+
+            self._compression = create_compression(compression_params)
 
     @property
     def symbol(self):
@@ -372,6 +379,8 @@ class Module(BaseModule):
             grad = self._exec.grad_dict.get(name)
             if grad is None:
                 continue
+            if self._compression is not None:
+                grad = self._compression.compress(name, 0, grad)
             self._updater(i, grad, self._exec.arg_dict[name])
 
     def get_outputs(self, merge_multi_context=True):

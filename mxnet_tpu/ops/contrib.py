@@ -208,3 +208,54 @@ def moe_dispatch_combine(tokens, probs, gate_up_weight, down_weight, *,
     act = jax.nn.silu(gu[..., :h]) * gu[..., h:]
     expert_out = jnp.einsum("ech,ehu->ecu", act, down_weight)
     return jnp.einsum("nec,ecu->nu", combine, expert_out)
+
+
+def _fake_quant_act(data, min_calib_range, max_calib_range):
+    """Snap activations onto the symmetric int8 grid — calibrated range
+    when given, dynamic (per-batch max) otherwise. Values stay exactly on
+    the grid, so downstream f32 math reproduces integer arithmetic."""
+    if min_calib_range is None:
+        t = jnp.max(jnp.abs(data)).astype(jnp.float32) + 1e-12  # dynamic
+    else:
+        t = jnp.maximum(jnp.float32(abs(float(min_calib_range))),
+                        jnp.float32(abs(float(max_calib_range)))) + 1e-12
+    s = 127.0 / t
+    return jnp.clip(jnp.round(data.astype(jnp.float32) * s), -127, 127) / s
+
+
+@register("_contrib_quantized_dense")
+def quantized_dense(data, weight_q, w_scale, bias=None, *, num_hidden,
+                    no_bias=False, flatten=True,
+                    min_calib_range=None, max_calib_range=None):
+    """Int8-weight dense with calibrated (or dynamic) activation fake-quant.
+
+    Numerically identical to int8xint8->int32 GEMM rescaled: the fake-
+    quantized activations and per-channel-dequantized weights sit exactly
+    on the int8 grid, so the f32 MXU matmul reproduces the integer
+    arithmetic while storage stays int8 (reference capability:
+    quantization.py::quantize_model int8 inference).
+    """
+    from .registry import get_op
+
+    xq = _fake_quant_act(data, min_calib_range, max_calib_range)
+    w = weight_q.astype(jnp.float32) * w_scale[:, None]
+    return get_op("FullyConnected").fn(
+        xq, w, bias, num_hidden=num_hidden,
+        no_bias=no_bias or bias is None, flatten=flatten)
+
+
+@register("_contrib_quantized_conv")
+def quantized_conv(data, weight_q, w_scale, bias=None, *, kernel,
+                   num_filter, stride=None, pad=None, dilate=None,
+                   num_group=1, no_bias=False,
+                   min_calib_range=None, max_calib_range=None):
+    """Int8-weight convolution; activation fake-quant as quantized_dense."""
+    from .registry import get_op
+
+    xq = _fake_quant_act(data, min_calib_range, max_calib_range)
+    scale = w_scale.reshape((-1,) + (1,) * (weight_q.ndim - 1))
+    w = weight_q.astype(jnp.float32) * scale
+    return get_op("Convolution").fn(
+        xq, w, bias, kernel=kernel, num_filter=num_filter, stride=stride,
+        pad=pad, dilate=dilate, num_group=num_group,
+        no_bias=no_bias or bias is None)

@@ -19,13 +19,11 @@ __all__ = ["export_hybrid_block", "mark_aux_states"]
 def mark_aux_states(sym: Symbol) -> None:
     """Mark variables feeding aux slots of stateful ops (BatchNorm moving
     stats) with __aux__, mirroring nnvm's FMutateInputs classification."""
+    from .symbol import _mark_aux_inputs
+
     for node in sym._topo():
-        if node.op in AUX_PARAMS:
-            opdef = get_op(node.op)
-            aux_names = AUX_PARAMS[node.op]
-            for pname, (parent, _) in zip(opdef.tensor_params, node.inputs):
-                if pname in aux_names and parent.op is None:
-                    parent.attrs["__aux__"] = True
+        if node.op is not None:
+            _mark_aux_inputs(node, get_op(node.op))
 
 
 def export_hybrid_block(block, path: str, epoch: int = 0):

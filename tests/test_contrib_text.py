@@ -1,0 +1,80 @@
+"""mx.contrib.text tests (reference:
+tests/python/unittest/test_contrib_text.py — vocab ordering, embedding
+loading, composite concat)."""
+from collections import Counter
+
+import numpy as onp
+import pytest
+
+import mxnet_tpu as mx
+from mxnet_tpu.base import MXNetError
+from mxnet_tpu.contrib import text
+
+
+class TestVocabulary:
+    def test_ordering_and_lookup(self):
+        counter = text.utils.count_tokens_from_str(
+            "a b b c c c\nd d d d", to_lower=False)
+        v = text.Vocabulary(counter, unknown_token="<unk>",
+                            reserved_tokens=["<pad>"])
+        # unk, reserved, then frequency-desc with alphabetical ties
+        assert v.idx_to_token == ["<unk>", "<pad>", "d", "c", "b", "a"]
+        assert v.to_indices("c") == 3
+        assert v.to_indices(["b", "zzz"]) == [4, 0]
+        assert v.to_tokens([2, 3]) == ["d", "c"]
+        assert "d" in v and "zzz" not in v
+        with pytest.raises(ValueError):
+            v.to_tokens(99)
+
+    def test_limits(self):
+        counter = Counter({"a": 5, "b": 3, "c": 1})
+        v = text.Vocabulary(counter, most_freq_count=1, min_freq=2)
+        assert v.idx_to_token == ["<unk>", "a"]
+        with pytest.raises(ValueError):
+            text.Vocabulary(counter, reserved_tokens=["<unk>"])
+
+
+class TestEmbedding:
+    def _write_vectors(self, tmp_path):
+        p = tmp_path / "vec.txt"
+        p.write_text("hello 1.0 2.0 3.0\n"
+                     "world 4.0 5.0 6.0\n"
+                     "hello 9.0 9.0 9.0\n")       # duplicate: skipped
+        return str(p)
+
+    def test_custom_embedding(self, tmp_path):
+        emb = text.CustomEmbedding(self._write_vectors(tmp_path))
+        assert len(emb) == 3 and emb.vec_len == 3
+        onp.testing.assert_allclose(
+            emb.get_vecs_by_tokens("world").asnumpy(), [4.0, 5.0, 6.0])
+        got = emb.get_vecs_by_tokens(["hello", "nope"]).asnumpy()
+        onp.testing.assert_allclose(got[0], [1.0, 2.0, 3.0])
+        onp.testing.assert_allclose(got[1], [0.0, 0.0, 0.0])  # unk
+        emb.update_token_vectors("hello", mx.nd.array([7.0, 7.0, 7.0]))
+        onp.testing.assert_allclose(
+            emb.get_vecs_by_tokens("hello").asnumpy(), [7.0, 7.0, 7.0])
+
+    def test_registry_and_composite(self, tmp_path):
+        path = self._write_vectors(tmp_path)
+        emb = text.create("customembedding", pretrained_file_path=path)
+        assert isinstance(emb, text.CustomEmbedding)
+        with pytest.raises(MXNetError, match="offline"):
+            text.create("glove")
+        assert text.get_pretrained_file_names() == {}
+
+        vocab = text.Vocabulary(Counter({"hello": 2, "world": 1}))
+        comp = text.CompositeEmbedding(vocab, [emb, emb])
+        assert comp.vec_len == 6
+        onp.testing.assert_allclose(
+            comp.get_vecs_by_tokens("world").asnumpy(),
+            [4.0, 5.0, 6.0, 4.0, 5.0, 6.0])
+
+    def test_embedding_feeds_gluon(self, tmp_path):
+        from mxnet_tpu.gluon import nn
+
+        emb = text.CustomEmbedding(self._write_vectors(tmp_path))
+        layer = nn.Embedding(len(emb), emb.vec_len)
+        layer.initialize()
+        layer.weight.set_data(emb.idx_to_vec)
+        out = layer(mx.nd.array([1, 2], dtype="int32")).asnumpy()
+        onp.testing.assert_allclose(out[0], [1.0, 2.0, 3.0])

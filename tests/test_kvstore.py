@@ -252,3 +252,67 @@ class TestLauncher:
         store.init("fc_weight", mx.nd.ones((2,)))
         store.push("fc_weight", mx.nd.ones((2,)))
         assert "fc_weight" in store._updater.states
+
+
+class TestGradientCompression:
+    def test_2bit_quantization_and_error_feedback(self):
+        from mxnet_tpu.kvstore.gradient_compression import (
+            GradientCompression, create_compression)
+
+        comp = GradientCompression(threshold=0.5)
+        g = mx.nd.array(np.array([0.9, -0.7, 0.1, -0.2, 0.0],
+                                  dtype="float32"))
+        q = comp.compress("w", 0, g)
+        np.testing.assert_allclose(q.asnumpy(), [0.5, -0.5, 0.0, 0.0, 0.0])
+        # error feedback: for gradients within +-t, repeated pushes
+        # transmit the true mean in the limit (residual carries the
+        # remainder; |g| > t saturates at t/round by construction)
+        g2 = mx.nd.array(np.array([0.4, -0.3, 0.1, -0.2, 0.0],
+                                  dtype="float32"))
+        total = np.zeros(5, dtype="float32")
+        for _ in range(40):
+            total += comp.compress("w2", 0, g2).asnumpy()
+        np.testing.assert_allclose(total / 40.0, g2.asnumpy(),
+                                   atol=0.5 / 40)
+
+        with pytest.raises(mx.base.MXNetError, match="type"):
+            create_compression({"type": "1bit"})
+        with pytest.raises(mx.base.MXNetError, match="threshold"):
+            create_compression({"type": "2bit", "threshold": -1.0})
+
+    def test_kvstore_push_compressed(self):
+        kv = mx.kv.create("local")
+        kv.set_gradient_compression({"type": "2bit", "threshold": 0.25})
+        v = mx.nd.zeros((4,))
+        kv.init("x", v)
+        kv.push("x", mx.nd.array(np.array([1.0, -1.0, 0.1, 0.0],
+                                           dtype="float32")))
+        out = mx.nd.zeros((4,))
+        kv.pull("x", out)
+        # every transmitted value is on the {-t, 0, +t} grid
+        got = out.asnumpy()
+        assert set(np.round(got / 0.25).astype(int)) <= {-1, 0, 1}, got
+
+    def test_trainer_with_compression_converges(self):
+        from mxnet_tpu.gluon import Trainer, nn
+        from mxnet_tpu import autograd
+
+        np.random.seed(21)
+        net = nn.Dense(1)
+        net.initialize()
+        rs = np.random.RandomState(22)
+        x = mx.nd.array(rs.randn(64, 4).astype("float32"))
+        w_true = np.array([[1.0, -2.0, 0.5, 3.0]], dtype="float32")
+        y = mx.nd.array(x.asnumpy() @ w_true.T)
+        trainer = Trainer(net.collect_params(), "sgd",
+                          {"learning_rate": 0.05}, kvstore="tpu_sync",
+                          compression_params={"type": "2bit",
+                                              "threshold": 2.0})
+        losses = []
+        for _ in range(200):
+            with autograd.record():
+                loss = ((net(x) - y) ** 2).mean()
+            loss.backward()
+            trainer.step(1)
+            losses.append(float(loss.asnumpy()))
+        assert losses[-1] < losses[0] * 0.1, (losses[0], losses[-1])

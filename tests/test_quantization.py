@@ -1,0 +1,138 @@
+"""Post-training int8 quantization tests (reference:
+tests/python/quantization/test_quantization.py — calibration modes,
+quantize_model, quantized op numerics).
+
+Oracle = the fp32 net: int8 inference must track it closely on
+in-distribution data; weights must actually be stored int8.
+"""
+import numpy as onp
+import pytest
+
+import mxnet_tpu as mx
+from mxnet_tpu.base import MXNetError
+from mxnet_tpu.contrib import quantization as qz
+from mxnet_tpu.gluon import nn
+
+
+def _rel_err(a, b):
+    return onp.abs(a - b).max() / max(onp.abs(b).max(), 1e-9)
+
+
+def _mlp():
+    net = nn.HybridSequential()
+    net.add(nn.Dense(32, activation="relu"), nn.Dense(8))
+    net.initialize()
+    return net
+
+
+class TestWeightQuant:
+    def test_roundtrip_error_small(self):
+        w = onp.random.RandomState(0).randn(16, 32).astype("float32")
+        wq, scale = qz.quantize_weight(w)
+        assert wq.dtype == onp.int8 and scale.shape == (16,)
+        back = wq.astype("float32") * scale[:, None]
+        assert _rel_err(back, w) < 1e-2
+
+    def test_kl_threshold_gaussian(self):
+        rs = onp.random.RandomState(1)
+        x = onp.abs(rs.randn(200_000)) * 0.5
+        x[:10] = 8.0          # outliers the KL sweep should clip away
+        hist, edges = onp.histogram(x, bins=2048)
+        t = qz.optimal_threshold_kl(hist, edges[1:])
+        assert 1.0 < t < 8.0   # tighter than max, looser than the bulk
+
+
+class TestQuantizeNet:
+    @pytest.mark.parametrize("calib_mode", ["naive", "entropy", "none"])
+    def test_mlp_close_to_fp32(self, calib_mode):
+        onp.random.seed(2)
+        net = _mlp()
+        rs = onp.random.RandomState(3)
+        x = mx.nd.array(rs.randn(16, 20).astype("float32"))
+        want = net(x).asnumpy()
+        calib = None if calib_mode == "none" else x
+        qz.quantize_net(net, calib_data=calib, calib_mode=calib_mode)
+        got = net(x).asnumpy()
+        assert _rel_err(got, want) < 0.05, _rel_err(got, want)
+        qparams = [p for p in net.collect_params().values()
+                   if str(p.dtype) == "int8"]
+        assert len(qparams) == 2       # both Dense weights stored int8
+
+    def test_convnet_and_exclude(self):
+        onp.random.seed(4)
+        net = nn.HybridSequential()
+        net.add(nn.Conv2D(8, kernel_size=3, padding=1),
+                nn.Activation("relu"), nn.Flatten(), nn.Dense(4))
+        net.initialize()
+        rs = onp.random.RandomState(5)
+        x = mx.nd.array(rs.randn(4, 3, 8, 8).astype("float32"))
+        want = net(x).asnumpy()
+        dense_name = [c.name for c in net._children.values()
+                      if isinstance(c, nn.Dense)][0]
+        qz.quantize_net(net, calib_data=x, calib_mode="naive",
+                        exclude_layers=[dense_name])
+        got = net(x).asnumpy()
+        assert _rel_err(got, want) < 0.05
+        qparams = [p for p in net.collect_params().values()
+                   if str(p.dtype) == "int8"]
+        assert len(qparams) == 1       # conv quantized, dense excluded
+
+    def test_hybridized_after_quantize(self):
+        onp.random.seed(6)
+        net = _mlp()
+        x = mx.nd.array(onp.random.RandomState(7).randn(8, 10)
+                        .astype("float32"))
+        net.hybridize()
+        net(x)
+        qz.quantize_net(net, calib_data=x, calib_mode="naive")
+        eager = net(x).asnumpy()
+        net.hybridize()
+        jit = net(x).asnumpy()
+        onp.testing.assert_allclose(jit, eager, rtol=1e-5, atol=1e-5)
+
+    def test_errors(self):
+        net = _mlp()
+        with pytest.raises(MXNetError, match="calib_data"):
+            qz.quantize_net(net, calib_mode="naive")
+        with pytest.raises(MXNetError, match="calib_mode"):
+            qz.quantize_net(_mlp(), calib_data=mx.nd.ones((2, 4)),
+                            calib_mode="bogus")
+
+
+class TestQuantizeModel:
+    def test_symbol_path(self, tmp_path):
+        onp.random.seed(8)
+        net = _mlp()
+        x = mx.nd.array(onp.random.RandomState(9).randn(8, 12)
+                        .astype("float32"))
+        want = net(x).asnumpy()
+        net.hybridize()
+        net(x)
+        prefix = str(tmp_path / "mlp")
+        net.export(prefix)
+        sym = mx.sym.load(prefix + "-symbol.json")
+        saved = mx.nd.load(prefix + "-0000.params")
+        arg_params = {k.split(":", 1)[1]: v for k, v in saved.items()
+                      if k.startswith("arg:")}
+        aux_params = {k.split(":", 1)[1]: v for k, v in saved.items()
+                      if k.startswith("aux:")}
+
+        qsym, qarg, qaux = qz.quantize_model(
+            sym, arg_params, aux_params, calib_mode="naive", calib_data=x)
+        assert any(k.endswith("_quant") for k in qarg)
+        assert not any(k.endswith("weight") and qarg[k].dtype == "float32"
+                       for k in qarg if "_scale" not in k and
+                       "_quant" not in k and "dense" in k and
+                       k.endswith("weight"))
+        from mxnet_tpu.symbol.executor import eval_symbol
+
+        feed = dict(qarg)
+        feed.update(qaux)
+        feed["data"] = x
+        got = eval_symbol(qsym, feed).asnumpy()
+        assert _rel_err(got, want) < 0.05
+
+        # the rewritten graph still serializes/loads
+        qsym2 = mx.sym.load_json(qsym.tojson())
+        got2 = eval_symbol(qsym2, feed).asnumpy()
+        onp.testing.assert_allclose(got2, got, rtol=1e-6, atol=1e-6)
