@@ -85,6 +85,9 @@ from . import test_utils
 from . import runtime
 from . import rtc
 from . import amp
+from . import library
+from . import subgraph
+from . import storage
 
 from .ndarray import NDArray
 from .optimizer import Optimizer

@@ -286,12 +286,27 @@ def quantize_net(network, calib_data=None, calib_mode="naive",
             def hook(blk, inputs, _name=child.name):
                 collector.collect(_name, inputs[0].asnumpy())
             handles.append(child.register_forward_pre_hook(hook))
-        with autograd.pause():
-            for batch in _iter_batches(calib_data, num_calib_batches):
-                network(batch)
+        # calibration must run EAGERLY: a hybridized net dispatches
+        # through the compiled CachedOp, bypassing children's __call__
+        # (hooks never fire) — temporarily drop to the eager path
+        saved_active = [(b, b._active) for b in _walk(network)
+                        if hasattr(b, "_active")]
+        for b, _ in saved_active:
+            b._active = False
+        try:
+            with autograd.pause():
+                for batch in _iter_batches(calib_data, num_calib_batches):
+                    network(batch)
+        finally:
+            for b, was in saved_active:
+                b._active = was
         for h in handles:
             h.detach()
         ranges = collector.ranges()
+        if not ranges:
+            raise MXNetError(
+                "quantize_net: calibration collected no activations — "
+                "calib_data produced no batches?")
 
     dense_cls, conv_cls = _quantized_dense_cls(), _quantized_conv_cls()
     from ..gluon import nn
@@ -369,11 +384,27 @@ def quantize_model(sym, arg_params, aux_params, data_names=("data",),
         ranges = {id(node): named[node.name] for node in targets}
 
     qarg = {k: v for k, v in arg_params.items()}
+    # a weight var may feed several nodes (tied weights) or non-quantized
+    # consumers: drop the f32 original only once every consumer is a
+    # rewritten target, and quantize each weight once
+    target_ids = {id(n) for n in targets}
+    uses: Dict[str, int] = {}
+    target_uses: Dict[str, int] = {}
+    for node in qsym._topo():
+        for slot, (parent, _) in enumerate(node.inputs):
+            if parent.op is None and parent.name in qarg:
+                uses[parent.name] = uses.get(parent.name, 0) + 1
+                if id(node) in target_ids and slot == 1:
+                    target_uses[parent.name] = \
+                        target_uses.get(parent.name, 0) + 1
     for node in targets:
         wname = node.inputs[1][0].name
-        wq, scale = quantize_weight(qarg.pop(wname).asnumpy())
-        qarg[wname + "_quant"] = nd_array(wq, dtype="int8")
-        qarg[wname + "_scale"] = nd_array(scale)
+        if wname + "_quant" not in qarg:
+            wq, scale = quantize_weight(qarg[wname].asnumpy())
+            qarg[wname + "_quant"] = nd_array(wq, dtype="int8")
+            qarg[wname + "_scale"] = nd_array(scale)
+        if uses.get(wname, 0) == target_uses.get(wname, 0):
+            qarg.pop(wname, None)
         wq_var = sym_mod.var(wname + "_quant")._entries[0]
         ws_var = sym_mod.var(wname + "_scale")._entries[0]
         new_inputs = [node.inputs[0], wq_var, ws_var] + list(node.inputs[2:])

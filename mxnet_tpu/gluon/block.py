@@ -459,6 +459,10 @@ class HybridBlock(Block):
 
         if args and isinstance(args[0], _Sym):
             return self._symbolic_forward(*args)
+        opt = getattr(self, "_optimized_block", None)
+        if opt is not None and args and isinstance(args[0], NDArray):
+            # optimize_for swapped in a backend-transformed graph
+            return opt(*args)
         if self._active and args and isinstance(args[0], NDArray) \
                 and not tracing.is_tracing():
             if self._cached_graph is None:
@@ -521,10 +525,37 @@ class HybridBlock(Block):
         return export_hybrid_block(self, path, epoch)
 
     def optimize_for(self, x, backend=None, **kwargs):
-        """Custom graph-pass hook (reference: HybridBlock.optimize_for).
-        XLA performs fusion natively; this triggers hybridization."""
+        """Apply a subgraph backend to this block (reference:
+        HybridBlock.optimize_for). With a backend: symbolically trace,
+        run the backend's registered passes (mxnet_tpu.subgraph), and
+        swap the block's forward to the transformed graph — the same
+        replace-in-place contract as upstream. Without: just hybridize
+        (XLA fuses natively)."""
         self.hybridize()
+        if backend is None:
+            return self(x)
+        from .. import subgraph
+        from ..symbol.export import trace_symbol
+
+        sym, arg_params, aux_params = trace_symbol(self)
+        sym = subgraph.apply_backend(backend, sym, arg_params, aux_params,
+                                     **kwargs)
+        opt = SymbolBlock(sym, self._sym_trace_inputs(sym, arg_params,
+                                                      aux_params))
+        for name, arr in list(arg_params.items()) + list(aux_params.items()):
+            p = opt.collect_params()[name]
+            p.shape = tuple(arr.shape)
+            p.initialize(force_reinit=True)
+            p.set_data(arr)
+        self._optimized_block = opt
         return self(x)
+
+    @staticmethod
+    def _sym_trace_inputs(sym, arg_params, aux_params):
+        from ..symbol import var
+
+        return [var(n) for n in sym.list_arguments()
+                if n not in arg_params and n not in aux_params]
 
 
 class SymbolBlock(HybridBlock):

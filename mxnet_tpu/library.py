@@ -1,0 +1,151 @@
+"""``mx.library`` — load external native operator libraries (reference:
+``python/mxnet/library.py`` :: ``load``, C side ``include/mxnet/lib_api.h``
+:: ``CustomOp`` + ``src/c_api/c_api.cc::MXLoadLib``).
+
+The reference dlopens a user ``.so`` that registers ops through a C ABI.
+TPU-native equivalent: the ``.so`` exports the small C ABI below; loaded
+ops are registered into the op registry (so they appear as ``mx.nd.*`` /
+``mx.sym.*`` like every other op) and execute on the HOST via
+``jax.pure_callback`` — callable under ``jit``/``hybridize``, with XLA
+treating the call as an opaque host op. This is the honest TPU mapping:
+user-native kernels cannot target the MXU (use ``mx.rtc`` Pallas kernels
+for that); what a native library provides is host compute plumbed into
+the graph.
+
+Required C ABI (all symbols ``extern "C"``):
+
+    int  mxlib_num_ops(void);
+    const char* mxlib_op_name(int op);
+    int  mxlib_op_num_inputs(int op);
+    //  out_shape has room for 8 dims; return 0 on success
+    int  mxlib_op_infer_shape(int op, int nin, const int64_t** in_shapes,
+                              const int* in_ndims, int64_t* out_shape,
+                              int* out_ndim);
+    //  f32 buffers, contiguous; return 0 on success
+    int  mxlib_op_compute(int op, int nin, const float** in,
+                          const int64_t** in_shapes, const int* in_ndims,
+                          float* out);
+"""
+from __future__ import annotations
+
+import ctypes
+import os
+from typing import List
+
+import numpy as _np
+
+from .base import MXNetError
+
+__all__ = ["load", "loaded_libs"]
+
+_LOADED: List[str] = []
+
+
+def loaded_libs():
+    return list(_LOADED)
+
+
+def _shape_args(shapes):
+    n = len(shapes)
+    arrs = [(_np.asarray(s, _np.int64) if len(s) else
+             _np.zeros(1, _np.int64)) for s in shapes]
+    ptrs = (ctypes.POINTER(ctypes.c_int64) * n)(*[
+        a.ctypes.data_as(ctypes.POINTER(ctypes.c_int64)) for a in arrs])
+    ndims = (ctypes.c_int * n)(*[len(s) for s in shapes])
+    return arrs, ptrs, ndims
+
+
+def load(path, verbose=True):
+    """Load a native op library; returns the list of registered op names
+    (reference contract: ``mx.library.load`` prints/exposes them)."""
+    path = os.path.abspath(path)
+    if not os.path.exists(path):
+        raise MXNetError(f"library not found: {path}")
+    try:
+        lib = ctypes.CDLL(path)
+    except OSError as e:
+        raise MXNetError(f"cannot dlopen {path}: {e}") from e
+    for sym, restype in [("mxlib_num_ops", ctypes.c_int),
+                         ("mxlib_op_name", ctypes.c_char_p),
+                         ("mxlib_op_num_inputs", ctypes.c_int),
+                         ("mxlib_op_infer_shape", ctypes.c_int),
+                         ("mxlib_op_compute", ctypes.c_int)]:
+        if not hasattr(lib, sym):
+            raise MXNetError(
+                f"{path}: missing ABI symbol {sym!r} — see "
+                "mxnet_tpu/library.py for the required C ABI")
+        getattr(lib, sym).restype = restype
+
+    from .ops.registry import register
+
+    names = []
+    for op_idx in range(lib.mxlib_num_ops()):
+        name = lib.mxlib_op_name(op_idx).decode()
+        nin = lib.mxlib_op_num_inputs(op_idx)
+
+        def make(op_idx=op_idx, name=name, nin=nin):
+            def infer_shape(shapes):
+                _keep, ptrs, ndims = _shape_args(shapes)
+                out_shape = (_np.zeros(8, _np.int64))
+                out_ndim = ctypes.c_int(0)
+                rc = lib.mxlib_op_infer_shape(
+                    op_idx, nin, ptrs, ndims,
+                    out_shape.ctypes.data_as(
+                        ctypes.POINTER(ctypes.c_int64)),
+                    ctypes.byref(out_ndim))
+                if rc != 0:
+                    raise MXNetError(
+                        f"{name}: infer_shape failed (rc={rc}) for input "
+                        f"shapes {shapes}")
+                return tuple(int(d) for d in out_shape[:out_ndim.value])
+
+            def host_compute(*arrays):
+                arrays = [_np.ascontiguousarray(a, _np.float32)
+                          for a in arrays]
+                shapes = [a.shape for a in arrays]
+                out = _np.zeros(infer_shape(shapes), _np.float32)
+                _keep, ptrs, ndims = _shape_args(shapes)
+                in_ptrs = (ctypes.POINTER(ctypes.c_float) * nin)(*[
+                    a.ctypes.data_as(ctypes.POINTER(ctypes.c_float))
+                    for a in arrays])
+                rc = lib.mxlib_op_compute(
+                    op_idx, nin, in_ptrs, ptrs, ndims,
+                    out.ctypes.data_as(ctypes.POINTER(ctypes.c_float)))
+                if rc != 0:
+                    raise MXNetError(f"{name}: compute failed (rc={rc})")
+                return out
+
+            def op_fn(*args):
+                import jax
+                import jax.numpy as jnp
+
+                if len(args) != nin:
+                    raise MXNetError(
+                        f"{name} expects {nin} inputs, got {len(args)}")
+                out_shape = infer_shape([tuple(a.shape) for a in args])
+                return jax.pure_callback(
+                    host_compute,
+                    jax.ShapeDtypeStruct(out_shape, jnp.float32),
+                    *args, vmap_method="sequential")
+
+            op_fn.__name__ = name
+            op_fn.__doc__ = (f"custom native op {name!r} from {path} "
+                             "(host compute via pure_callback)")
+            return op_fn
+
+        register(name, variadic=False)(make())
+        names.append(name)
+    # regenerate the nd/sym wrapper namespaces to pick up the new ops
+    from . import ndarray as nd_mod
+    from . import symbol as sym_mod
+
+    for mod in (nd_mod, sym_mod):
+        refresh = getattr(mod, "_refresh_ops", None)
+        if refresh is not None:
+            refresh()
+    _LOADED.append(path)
+    if verbose:
+        import logging
+
+        logging.info("loaded library %s: ops %s", path, names)
+    return names

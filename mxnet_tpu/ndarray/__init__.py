@@ -105,21 +105,29 @@ sys.modules[contrib.__name__] = contrib
 sys.modules[linalg.__name__] = linalg
 sys.modules[image.__name__] = image
 
-for _name in list_ops():
-    _w = _make_wrapper(_name)
-    setattr(_this, _name, _w)
-    if _name.startswith("_contrib_"):
-        setattr(contrib, _name[len("_contrib_"):], _w)
-    if _name.startswith("_linalg_"):
-        setattr(linalg, _name[len("_linalg_"):], _w)
-    if _name.startswith("_image_"):
-        setattr(image, _name[len("_image_"):], _w)
-    if _name.startswith("_random_"):
-        setattr(random, _name[len("_random_"):], _w)
-    elif _name.startswith("_sample_"):
-        # NDArray-parameterized forms live as random.sample_* (the scalar
-        # forms above keep the short names, matching mx.nd.random's API)
-        setattr(random, _name[1:], _w)
+def _refresh_ops():
+    """(Re)generate op wrappers from the registry — called at import and
+    again by mx.library.load after native ops register."""
+    for _name in list_ops():
+        if hasattr(_this, _name):
+            continue
+        _w = _make_wrapper(_name)
+        setattr(_this, _name, _w)
+        if _name.startswith("_contrib_"):
+            setattr(contrib, _name[len("_contrib_"):], _w)
+        if _name.startswith("_linalg_"):
+            setattr(linalg, _name[len("_linalg_"):], _w)
+        if _name.startswith("_image_"):
+            setattr(image, _name[len("_image_"):], _w)
+        if _name.startswith("_random_"):
+            setattr(random, _name[len("_random_"):], _w)
+        elif _name.startswith("_sample_"):
+            # NDArray-parameterized forms live as random.sample_* (the
+            # scalar forms keep the short names, matching mx.nd.random)
+            setattr(random, _name[1:], _w)
+
+
+_refresh_ops()
 
 from . import sparse  # noqa: E402  (mx.nd.sparse)
 

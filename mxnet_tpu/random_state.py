@@ -22,23 +22,54 @@ _DEFAULT_SEED = 0
 
 
 def _global():
-    if not hasattr(_state, "key"):
-        import jax
-
-        _state.key = jax.random.PRNGKey(_DEFAULT_SEED)
+    if not hasattr(_state, "keys"):
+        _state.keys = {}            # (dev_type, dev_id) -> PRNGKey
+        _state.base_seed = _DEFAULT_SEED
     return _state
 
 
+def _ctx_sig(ctx=None):
+    from .context import current_context
+
+    c = ctx if ctx is not None else current_context()
+    return (c.device_type, c.device_id)
+
+
+def _stream(st, sig):
+    """Per-device stream (reference: resource.cc kRandom is PER-DEVICE).
+    Lazily derived from the base seed folded with the device id, so
+    devices draw independent streams from one logical seed."""
+    key = st.keys.get(sig)
+    if key is None:
+        import zlib
+
+        import jax
+
+        # crc32, NOT hash(): str hashing is salted per process, which
+        # would break run-to-run reproducibility of mx.random.seed
+        fold = zlib.crc32(repr(sig).encode()) & 0x7FFFFFFF
+        key = jax.random.fold_in(
+            jax.random.PRNGKey(int(st.base_seed)), fold)
+        st.keys[sig] = key
+    return key
+
+
 def seed(seed_state, ctx="all") -> None:
-    """Seed the global generator (reference: mx.random.seed)."""
+    """Seed the generator(s) (reference: mx.random.seed(seed, ctx) —
+    ctx='all' reseeds every device's stream; a Context reseeds one)."""
     import jax
 
-    _global().key = jax.random.PRNGKey(int(seed_state))
+    st = _global()
+    if isinstance(ctx, str) and ctx == "all":
+        st.base_seed = int(seed_state)
+        st.keys = {}
+    else:
+        st.keys[_ctx_sig(ctx)] = jax.random.PRNGKey(int(seed_state))
 
 
 def next_key():
     """Return a fresh subkey. Inside a trace scope, split from the scoped
-    (traced) key; otherwise split the stateful global key."""
+    (traced) key; otherwise split the current device's stateful stream."""
     import jax
 
     st = _global()
@@ -47,8 +78,9 @@ def next_key():
         key, sub = jax.random.split(scoped[-1])
         scoped[-1] = key
         return sub
-    key, sub = jax.random.split(st.key)
-    st.key = key
+    sig = _ctx_sig()
+    key, sub = jax.random.split(_stream(st, sig))
+    st.keys[sig] = key
     return sub
 
 

@@ -1,0 +1,67 @@
+"""``mx.storage`` — device memory introspection & pool controls
+(reference: ``src/storage/storage.cc`` :: ``StorageImpl`` /
+``GPUPooledStorageManager``, python surface ``mx.context.gpu_memory_info``
+and the ``MXNET_GPU_MEM_POOL_*`` env plane).
+
+ADR — why there is no allocator here: the reference owns a caching device
+allocator (round/naive pools, shared-memory segments for dataloader IPC)
+because CUDA malloc is slow and workers share tensors over shm. On TPU,
+PjRt owns HBM with its own BFC pool — re-implementing a pool UNDER it
+would double-count memory and fight the XLA scheduler. What remains
+framework-level, and lives here, is:
+
+* observability — per-device pool stats (bytes in use, peak, limit),
+  the data `mx.profiler`'s memory view and OOM messages need;
+* the env-plane mapping (reference knob → XLA/PjRt knob), so ported
+  run-scripts can be translated mechanically;
+* host-side sharing — the dataloader's worker IPC uses OS shared memory
+  on the host path (gluon.data), never device shm, because batches are
+  device_put once per step anyway.
+
+Env mapping (reference → here):
+  MXNET_GPU_MEM_POOL_RESERVE  → XLA_PYTHON_CLIENT_MEM_FRACTION
+  MXNET_GPU_MEM_POOL_TYPE     → (PjRt BFC; not selectable)
+  MXNET_USE_FUSION            → (always on — XLA fusion)
+"""
+from __future__ import annotations
+
+from typing import Dict, Optional
+
+from .base import MXNetError
+from .context import Context, current_context
+
+__all__ = ["memory_info", "pool_stats", "empty_cache"]
+
+
+def _dev(ctx: Optional[Context]):
+    ctx = ctx or current_context()
+    return ctx.jax_device()
+
+
+def memory_info(ctx: Optional[Context] = None):
+    """(free_bytes, total_bytes) for a device (reference:
+    ``mx.context.gpu_memory_info``). Falls back to (0, 0) when the
+    platform exposes no stats (CPU)."""
+    stats = _dev(ctx).memory_stats() or {}
+    total = stats.get("bytes_limit", 0)
+    used = stats.get("bytes_in_use", 0)
+    return (max(total - used, 0), total)
+
+
+def pool_stats(ctx: Optional[Context] = None) -> Dict[str, int]:
+    """Allocator statistics for one device — PjRt's BFC pool counters,
+    the storage.cc pool observability equivalent."""
+    stats = _dev(ctx).memory_stats() or {}
+    return {
+        "bytes_in_use": stats.get("bytes_in_use", 0),
+        "peak_bytes_in_use": stats.get("peak_bytes_in_use", 0),
+        "bytes_limit": stats.get("bytes_limit", 0),
+        "num_allocs": stats.get("num_allocs", 0),
+        "largest_alloc_size": stats.get("largest_alloc_size", 0),
+    }
+
+
+def empty_cache(ctx: Optional[Context] = None):
+    """Best-effort pool release (reference: Context::empty_cache). PjRt
+    frees buffers on GC; this forces a collection pass."""
+    (ctx or current_context()).empty_cache()

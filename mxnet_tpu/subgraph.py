@@ -1,0 +1,168 @@
+"""Subgraph backends / custom graph passes (reference:
+``src/operator/subgraph/subgraph_property.h`` :: ``SubgraphProperty``,
+``build_subgraph.cc``, python ``Symbol.optimize_for`` /
+``HybridBlock.optimize_for``).
+
+XLA already performs operator fusion natively, so the reference's main
+subgraph use case (oneDNN conv+bn+relu fusion) is mostly subsumed — what
+remains valuable is the PLUGGABLE pass hook: users register graph→graph
+passes (plus built-ins like inference conv+BN weight folding, which XLA
+cannot do because it changes the *parameters*, not the compute graph).
+
+    @subgraph.register_pass("my_pass")
+    def my_pass(sym, arg_params, aux_params, **kwargs):
+        ...mutate/rebuild...
+        return sym, arg_params, aux_params
+
+    subgraph.register_backend("MY_BACKEND", ["fuse_conv_bn", "my_pass"])
+    qsym = sym.optimize_for("MY_BACKEND", arg_dict, aux_dict)
+"""
+from __future__ import annotations
+
+from typing import Callable, Dict, List
+
+import numpy as _np
+
+from .base import MXNetError
+
+__all__ = ["register_pass", "register_backend", "list_backends",
+           "apply_backend"]
+
+_PASSES: Dict[str, Callable] = {}
+_BACKENDS: Dict[str, List[str]] = {}
+
+
+def register_pass(name):
+    """Decorator: register ``fn(sym, arg_params, aux_params, **kw) ->
+    (sym, arg_params, aux_params)`` under ``name``."""
+    def deco(fn):
+        _PASSES[name] = fn
+        return fn
+    return deco
+
+
+def register_backend(name, passes):
+    """Register an ordered pass list as a backend (the reference's
+    SubgraphProperty registration, e.g. MXNET_SUBGRAPH_BACKEND=MKLDNN)."""
+    missing = [p for p in passes if p not in _PASSES]
+    if missing:
+        raise MXNetError(f"unknown passes {missing}; registered: "
+                         f"{sorted(_PASSES)}")
+    _BACKENDS[name.upper()] = list(passes)
+
+
+def list_backends():
+    return sorted(_BACKENDS)
+
+
+def apply_backend(backend, sym, arg_params=None, aux_params=None, **kwargs):
+    """Run a backend's passes; params dicts (if given) are updated in
+    place. Returns the transformed Symbol."""
+    key = str(backend).upper()
+    if key not in _BACKENDS:
+        raise MXNetError(f"unknown backend {backend!r}; registered: "
+                         f"{list_backends()}")
+    arg_params = arg_params if arg_params is not None else {}
+    aux_params = aux_params if aux_params is not None else {}
+    for pname in _BACKENDS[key]:
+        sym, arg_params, aux_params = _PASSES[pname](
+            sym, arg_params, aux_params, **kwargs)
+    return sym
+
+
+# ---------------------------------------------------------------- passes
+def _consumers(sym):
+    """Map id(node) -> list of (consumer_node, input_slot)."""
+    cons: Dict[int, list] = {}
+    for node in sym._topo():
+        for slot, (parent, _oi) in enumerate(node.inputs):
+            cons.setdefault(id(parent), []).append((node, slot))
+    return cons
+
+
+@register_pass("fuse_conv_bn")
+def fuse_conv_bn(sym, arg_params, aux_params, **kwargs):
+    """Fold inference BatchNorm into the preceding Convolution's weights
+    (the oneDNN subgraph fusion the reference ships):
+    ``w' = w * g/sqrt(v+eps)``, ``b' = (b - m) * g/sqrt(v+eps) + beta``.
+    Only applies when the conv output feeds ONLY the BN and all five BN
+    stats/params are known. INFERENCE-ONLY: training would need the batch
+    stats back."""
+    from .symbol import symbol as sym_mod
+
+    graph = sym_mod.load_json(sym.tojson())
+    cons = _consumers(graph)
+    fused = 0
+    for node in graph._topo():
+        if node.op != "BatchNorm":
+            continue
+        conv, _ = node.inputs[0]
+        if conv.op != "Convolution":
+            continue
+        if len(cons.get(id(conv), [])) != 1:
+            continue                      # conv output used elsewhere
+        names = [p.name for p, _ in node.inputs[1:]]
+        if len(names) < 4 or not all(
+                (n in arg_params) or (n in aux_params) for n in names):
+            continue
+        gname, bname, mname, vname = names[:4]
+
+        def take(name):
+            # checkpoints are often one flat dict — fetch (and later drop)
+            # from whichever dict holds the param
+            src = arg_params if name in arg_params else aux_params
+            return src[name].asnumpy(), src
+
+        gamma, gsrc = take(gname)
+        beta, bsrc = take(bname)
+        mean, _ = take(mname)
+        var, _ = take(vname)
+        eps = float(node.attrs.get("eps", 1e-5))
+        # default must match the OP's default (ops/nn.py batch_norm:
+        # fix_gamma=True), not False
+        if str(node.attrs.get("fix_gamma", True)).lower() in ("true", "1"):
+            gamma = _np.ones_like(gamma)
+        scale = gamma / _np.sqrt(var + eps)
+
+        wname = conv.inputs[1][0].name
+        from .ndarray import array as nd_array
+
+        w = arg_params[wname].asnumpy()
+        arg_params[wname] = nd_array(
+            (w * scale.reshape((-1,) + (1,) * (w.ndim - 1)))
+            .astype(w.dtype))
+        no_bias = str(conv.attrs.get("no_bias", False)).lower() in (
+            "true", "1")
+        if no_bias:
+            b = _np.zeros_like(beta)
+            bias_name = conv.name + "_fused_bias"
+            bias_var = sym_mod.var(bias_name)._entries[0]
+            conv.inputs = list(conv.inputs) + [bias_var]
+            conv.attrs = dict(conv.attrs)
+            conv.attrs["no_bias"] = False
+        else:
+            bias_name = conv.inputs[2][0].name
+            b = arg_params[bias_name].asnumpy()
+        arg_params[bias_name] = nd_array(
+            ((b - mean) * scale + beta).astype(b.dtype))
+
+        # rewire BN consumers to the conv output and drop the BN params
+        for user, slot in cons.get(id(node), []):
+            user.inputs[slot] = (conv, 0)
+        graph._entries = [(conv, 0) if n is node else (n, i)
+                          for n, i in graph._entries]
+        for name in (gname, bname, mname, vname):
+            arg_params.pop(name, None)
+            aux_params.pop(name, None)
+        fused += 1
+    if fused:
+        # rebuild through JSON so dropped nodes disappear from the graph
+        graph = sym_mod.load_json(graph.tojson())
+    return graph, arg_params, aux_params
+
+
+register_backend("TPU", ["fuse_conv_bn"])
+# reference script compat: ported `optimize_for('MKLDNN'/'ONEDNN')` calls
+# get the equivalent inference fusion here
+register_backend("MKLDNN", ["fuse_conv_bn"])
+register_backend("ONEDNN", ["fuse_conv_bn"])

@@ -95,16 +95,25 @@ sys.modules[random.__name__] = random
 sys.modules[contrib.__name__] = contrib
 sys.modules[linalg.__name__] = linalg
 
-for _name in list_ops():
-    _w = _make_symbol_function(_name)
-    if not hasattr(_this, _name):
-        setattr(_this, _name, _w)
-    if _name.startswith("_contrib_"):
-        setattr(contrib, _name[len("_contrib_"):], _w)
-    if _name.startswith("_linalg_"):
-        setattr(linalg, _name[len("_linalg_"):], _w)
-    if _name.startswith("_random_"):
-        setattr(random, _name[len("_random_"):], _w)
+def _refresh_ops():
+    """(Re)generate sym wrappers from the registry — called at import and
+    again by mx.library.load after native ops register."""
+    for _name in list_ops():
+        _w = _make_symbol_function(_name)
+        if not hasattr(_this, _name):
+            setattr(_this, _name, _w)
+        if _name.startswith("_contrib_"):
+            if not hasattr(contrib, _name[len("_contrib_"):]):
+                setattr(contrib, _name[len("_contrib_"):], _w)
+        if _name.startswith("_linalg_"):
+            if not hasattr(linalg, _name[len("_linalg_"):]):
+                setattr(linalg, _name[len("_linalg_"):], _w)
+        if _name.startswith("_random_"):
+            if not hasattr(random, _name[len("_random_"):]):
+                setattr(random, _name[len("_random_"):], _w)
+
+
+_refresh_ops()
 
 
 # ---------------------------------------------------------------------------

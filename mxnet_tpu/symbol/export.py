@@ -13,7 +13,7 @@ from ..ndarray.serialization import save as nd_save
 from .symbol import AUX_PARAMS, Symbol, var
 from ..ops.registry import get_op
 
-__all__ = ["export_hybrid_block", "mark_aux_states"]
+__all__ = ["export_hybrid_block", "mark_aux_states", "trace_symbol"]
 
 
 def mark_aux_states(sym: Symbol) -> None:
@@ -26,8 +26,10 @@ def mark_aux_states(sym: Symbol) -> None:
             _mark_aux_inputs(node, get_op(node.op))
 
 
-def export_hybrid_block(block, path: str, epoch: int = 0):
-    """Trace ``block`` symbolically and write the deployment artifact."""
+def trace_symbol(block):
+    """Symbolically trace an initialized block. Returns
+    ``(sym, arg_params, aux_params)`` with params as ``{name: NDArray}``
+    — the in-memory form export and ``optimize_for`` both consume."""
     params = block.collect_params()
     uninitialized = [p.name for p in params.values() if p._data is None]
     if uninitialized:
@@ -58,20 +60,28 @@ def export_hybrid_block(block, path: str, epoch: int = 0):
         walk(out)
         out = Group(flat)
     mark_aux_states(out)
-    sym_file = f"{path}-symbol.json"
-    out.save(sym_file)
     arg_names = set(out.list_arguments())
     aux_names = set(out.list_auxiliary_states())
-    payload = {}
+    arg_params, aux_params = {}, {}
     for p in params.values():
         if p._data is None:
             continue
         if p.name in aux_names:
-            payload[f"aux:{p.name}"] = p.data()
+            aux_params[p.name] = p.data()
         elif p.name in arg_names:
-            payload[f"arg:{p.name}"] = p.data()
-        # params not reached by the trace (e.g. unused heads) are dropped,
-        # matching the reference's export behaviour
+            arg_params[p.name] = p.data()
+    return out, arg_params, aux_params
+
+
+def export_hybrid_block(block, path: str, epoch: int = 0):
+    """Trace ``block`` symbolically and write the deployment artifact.
+    Params not reached by the trace (e.g. unused heads) are dropped,
+    matching the reference's export behaviour."""
+    out, arg_params, aux_params = trace_symbol(block)
+    sym_file = f"{path}-symbol.json"
+    out.save(sym_file)
+    payload = {f"arg:{k}": v for k, v in arg_params.items()}
+    payload.update({f"aux:{k}": v for k, v in aux_params.items()})
     params_file = f"{path}-{epoch:04d}.params"
     nd_save(params_file, payload)
     return sym_file, params_file

@@ -78,6 +78,16 @@ class Symbol:
     def _set_attr(self, **kwargs):
         self._entries[0][0]._attr_dict.update(kwargs)
 
+    def optimize_for(self, backend, arg_params=None, aux_params=None,
+                     **kwargs) -> "Symbol":
+        """Apply a registered subgraph backend's passes (reference:
+        Symbol.optimize_for → SubgraphProperty). Param dicts, when given,
+        are updated in place (weight-folding passes rewrite them)."""
+        from .. import subgraph
+
+        return subgraph.apply_backend(backend, self, arg_params,
+                                      aux_params, **kwargs)
+
     def get_internals(self) -> "Symbol":
         entries = []
         for node in self._topo():

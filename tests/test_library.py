@@ -1,0 +1,125 @@
+"""mx.library native custom-op tests (reference:
+tests/python/unittest/test_extensions.py — MXLoadLib + lib_api.h custom
+ops, built from example/extensions/lib_custom_op).
+
+A real C library is compiled at test time (g++ is part of the toolchain)
+and its ops must work through mx.nd, inside hybridized blocks, and under
+jit via pure_callback.
+"""
+import os
+import subprocess
+import shutil
+
+import numpy as onp
+import pytest
+
+import mxnet_tpu as mx
+from mxnet_tpu.base import MXNetError
+
+_C_SRC = r"""
+#include <cstdint>
+#include <cstring>
+
+extern "C" {
+
+int mxlib_num_ops(void) { return 2; }
+
+const char* mxlib_op_name(int op) {
+    return op == 0 ? "my_gemm_relu" : "my_l2norm";
+}
+
+int mxlib_op_num_inputs(int op) { return op == 0 ? 2 : 1; }
+
+int mxlib_op_infer_shape(int op, int nin, const int64_t** in_shapes,
+                         const int* in_ndims, int64_t* out_shape,
+                         int* out_ndim) {
+    if (op == 0) {                       // (M,K) x (K,N) -> (M,N)
+        if (nin != 2 || in_ndims[0] != 2 || in_ndims[1] != 2) return 1;
+        if (in_shapes[0][1] != in_shapes[1][0]) return 2;
+        out_shape[0] = in_shapes[0][0];
+        out_shape[1] = in_shapes[1][1];
+        *out_ndim = 2;
+        return 0;
+    }
+    out_shape[0] = 1;                    // scalar-ish (1,)
+    *out_ndim = 1;
+    return 0;
+}
+
+int mxlib_op_compute(int op, int nin, const float** in,
+                     const int64_t** in_shapes, const int* in_ndims,
+                     float* out) {
+    if (op == 0) {
+        int64_t m = in_shapes[0][0], k = in_shapes[0][1],
+                n = in_shapes[1][1];
+        for (int64_t i = 0; i < m; ++i)
+            for (int64_t j = 0; j < n; ++j) {
+                float acc = 0.f;
+                for (int64_t kk = 0; kk < k; ++kk)
+                    acc += in[0][i * k + kk] * in[1][kk * n + j];
+                out[i * n + j] = acc > 0.f ? acc : 0.f;   // fused relu
+            }
+        return 0;
+    }
+    int64_t total = 1;
+    for (int d = 0; d < in_ndims[0]; ++d) total *= in_shapes[0][d];
+    float acc = 0.f;
+    for (int64_t i = 0; i < total; ++i) acc += in[0][i] * in[0][i];
+    out[0] = acc;
+    return 0;
+}
+
+}  // extern "C"
+"""
+
+
+@pytest.fixture(scope="module")
+def libpath(tmp_path_factory):
+    if shutil.which("g++") is None:
+        pytest.skip("no g++ in environment")
+    d = tmp_path_factory.mktemp("libcustom")
+    src = d / "ops.cc"
+    src.write_text(_C_SRC)
+    so = d / "libcustom.so"
+    subprocess.run(["g++", "-O2", "-shared", "-fPIC", str(src),
+                    "-o", str(so)], check=True)
+    return str(so)
+
+
+class TestLibrary:
+    def test_load_and_compute(self, libpath):
+        names = mx.library.load(libpath)
+        assert names == ["my_gemm_relu", "my_l2norm"]
+        assert libpath in mx.library.loaded_libs()
+        rs = onp.random.RandomState(0)
+        a = mx.nd.array(rs.randn(3, 4).astype("float32"))
+        b = mx.nd.array(rs.randn(4, 5).astype("float32"))
+        got = mx.nd.my_gemm_relu(a, b).asnumpy()
+        want = onp.maximum(a.asnumpy() @ b.asnumpy(), 0.0)
+        onp.testing.assert_allclose(got, want, rtol=1e-5, atol=1e-5)
+        nrm = mx.nd.my_l2norm(a).asnumpy()
+        onp.testing.assert_allclose(
+            nrm, [(a.asnumpy() ** 2).sum()], rtol=1e-5)
+
+    def test_under_jit_and_hybridize(self, libpath):
+        mx.library.load(libpath, verbose=False)
+        from mxnet_tpu.gluon import nn
+
+        class Net(nn.HybridSequential):
+            def hybrid_forward(self, F, x):
+                return F.my_l2norm(F.relu(x))
+
+        net = Net()
+        x = mx.nd.array(onp.array([[-1.0, 2.0], [3.0, -4.0]], "float32"))
+        want = net(x).asnumpy()
+        net.hybridize()
+        got = net(x).asnumpy()
+        onp.testing.assert_allclose(got, want, rtol=1e-5)
+        onp.testing.assert_allclose(got, [13.0], rtol=1e-5)
+
+    def test_bad_shapes_and_missing_lib(self, libpath):
+        mx.library.load(libpath, verbose=False)
+        with pytest.raises(MXNetError, match="infer_shape failed"):
+            mx.nd.my_gemm_relu(mx.nd.ones((2, 3)), mx.nd.ones((4, 5)))
+        with pytest.raises(MXNetError, match="not found"):
+            mx.library.load("/nonexistent/lib.so")

@@ -85,6 +85,11 @@ class TestQuantizeNet:
         net.hybridize()
         net(x)
         qz.quantize_net(net, calib_data=x, calib_mode="naive")
+        # calibration must have run eagerly (hooks bypass CachedOp):
+        # every quantized layer carries a real calibrated range
+        qlayers = [c for c in net._children.values()
+                   if hasattr(c, "_range")]
+        assert qlayers and all(c._range is not None for c in qlayers)
         eager = net(x).asnumpy()
         net.hybridize()
         jit = net(x).asnumpy()

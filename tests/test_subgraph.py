@@ -1,0 +1,112 @@
+"""Subgraph backend / custom pass tests (reference:
+tests/python/mkl/test_subgraph.py — conv+BN fusion parity, backend
+registration; SURVEY §2.1 subgraph partitioning row).
+
+Oracle = the unfused graph: a backend pass must preserve inference
+outputs exactly (up to float assoc) while changing the graph/params.
+"""
+import json
+
+import numpy as onp
+import pytest
+
+import mxnet_tpu as mx
+from mxnet_tpu import subgraph
+from mxnet_tpu.base import MXNetError
+from mxnet_tpu.gluon import nn
+
+
+def _convnet():
+    net = nn.HybridSequential()
+    net.add(nn.Conv2D(8, kernel_size=3, padding=1),
+            nn.BatchNorm(),
+            nn.Activation("relu"),
+            nn.Conv2D(4, kernel_size=1, use_bias=False),
+            nn.BatchNorm(),
+            nn.Flatten(), nn.Dense(3))
+    net.initialize()
+    return net
+
+
+class TestFuseConvBN:
+    def test_symbol_path_matches_and_shrinks(self, tmp_path):
+        onp.random.seed(0)
+        net = _convnet()
+        x = mx.nd.array(onp.random.RandomState(1).randn(2, 3, 8, 8)
+                        .astype("float32"))
+        net(x)          # settle + BN stats step (nontrivial mean/var)
+        want = net(x).asnumpy()
+        net.hybridize()
+        net(x)
+        prefix = str(tmp_path / "m")
+        net.export(prefix)
+        sym = mx.sym.load(prefix + "-symbol.json")
+        saved = mx.nd.load(prefix + "-0000.params")
+        arg = {k[4:]: v for k, v in saved.items() if k.startswith("arg:")}
+        aux = {k[4:]: v for k, v in saved.items() if k.startswith("aux:")}
+
+        fused = sym.optimize_for("TPU", arg, aux)
+        ops = [n["op"] for n in json.loads(fused.tojson())["nodes"]]
+        assert "BatchNorm" not in ops
+        assert not aux                      # moving stats consumed
+        assert not any("gamma" in k or "beta" in k for k in arg)
+
+        from mxnet_tpu.symbol.executor import eval_symbol
+
+        feed = dict(arg)
+        feed["data"] = x
+        got = eval_symbol(fused, feed).asnumpy()
+        onp.testing.assert_allclose(got, want, rtol=1e-4, atol=1e-5)
+
+    def test_gluon_optimize_for(self):
+        onp.random.seed(2)
+        net = _convnet()
+        x = mx.nd.array(onp.random.RandomState(3).randn(2, 3, 8, 8)
+                        .astype("float32"))
+        net(x)
+        want = net(x).asnumpy()
+        got = net.optimize_for(x, backend="TPU").asnumpy()
+        onp.testing.assert_allclose(got, want, rtol=1e-4, atol=1e-5)
+        # swapped-in graph serves later calls too
+        again = net(x).asnumpy()
+        onp.testing.assert_allclose(again, want, rtol=1e-4, atol=1e-5)
+
+    def test_shared_conv_output_not_fused(self):
+        # conv output consumed by BN AND a residual add: must not fold
+        d = mx.sym.var("data")
+        w = mx.sym.var("conv_weight")
+        c = mx.sym.Convolution(d, w, kernel=(1, 1), num_filter=2,
+                               no_bias=True, name="conv")
+        g_, b_, m_, v_ = (mx.sym.var(n) for n in ("g", "b", "m", "v"))
+        bn = mx.sym.BatchNorm(c, g_, b_, m_, v_, name="bn")
+        out = bn + c
+        rs = onp.random.RandomState(4)
+        arg = {"conv_weight": mx.nd.array(rs.randn(2, 2, 1, 1)
+                                          .astype("float32")),
+               "g": mx.nd.ones((2,)), "b": mx.nd.zeros((2,))}
+        aux = {"m": mx.nd.zeros((2,)), "v": mx.nd.ones((2,))}
+        fused = out.optimize_for("TPU", arg, aux)
+        ops = [n["op"] for n in json.loads(fused.tojson())["nodes"]]
+        assert "BatchNorm" in ops          # fusion correctly skipped
+
+
+class TestPassRegistry:
+    def test_custom_pass_and_backend(self):
+        calls = []
+
+        @subgraph.register_pass("test_noop_pass")
+        def _noop(sym, arg, aux, **kw):
+            calls.append(kw)
+            return sym, arg, aux
+
+        subgraph.register_backend("TEST_BE", ["test_noop_pass"])
+        assert "TEST_BE" in subgraph.list_backends()
+        s = mx.sym.var("x") + 1.0
+        s.optimize_for("test_be", marker=42)   # case-insensitive
+        assert calls and calls[0]["marker"] == 42
+
+    def test_unknown_backend_and_pass(self):
+        with pytest.raises(MXNetError, match="unknown backend"):
+            (mx.sym.var("x") + 1.0).optimize_for("NOPE")
+        with pytest.raises(MXNetError, match="unknown passes"):
+            subgraph.register_backend("BAD", ["does_not_exist"])
