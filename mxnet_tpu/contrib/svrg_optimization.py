@@ -1,0 +1,167 @@
+"""SVRG optimization (reference:
+``python/mxnet/contrib/svrg_optimization/{svrg_module,svrg_optimizer}.py``
+:: ``SVRGModule``) — Johnson & Zhang (2013) stochastic variance-reduced
+gradient.
+
+Every ``update_freq`` epochs the module snapshots the weights ``w~`` and
+accumulates the FULL dataset gradient ``mu = mean_i grad_i(w~)``; each
+minibatch then steps with the variance-reduced direction
+``g_i(w) - g_i(w~) + mu``. The special-cased SGD the reference implements
+as ``_SVRGOptimizer`` is here a gradient rewrite in ``update()``, so ANY
+registered optimizer drives the corrected gradient."""
+from __future__ import annotations
+
+import logging
+from typing import Dict, Optional
+
+from ..base import MXNetError
+from ..module.module import Module
+
+__all__ = ["SVRGModule"]
+
+
+class SVRGModule(Module):
+    """Module with SVRG gradient correction (reference: SVRGModule).
+
+    Extra parameter: ``update_freq`` — snapshot + full-gradient refresh
+    period, in epochs.
+    """
+
+    def __init__(self, symbol, data_names=("data",),
+                 label_names=("softmax_label",), update_freq=2, **kwargs):
+        super().__init__(symbol, data_names=data_names,
+                         label_names=label_names, **kwargs)
+        if int(update_freq) < 1:
+            raise MXNetError("update_freq must be >= 1 (epochs)")
+        self.update_freq = int(update_freq)
+        # snapshot weights w~ and full gradient mu, by param name
+        self._snapshot: Dict[str, object] = {}
+        self._full_grads: Dict[str, object] = {}
+        # batch gradients at w~ for the CURRENT batch
+        self._snap_batch_grads: Dict[str, object] = {}
+
+    # -- SVRG machinery -------------------------------------------------
+    def take_snapshot(self):
+        """w~ <- w (reference: SVRGModule._update_svrg_weights)."""
+        self._snapshot = {name: self._exec.arg_dict[name].copy()
+                          for name in self._param_names
+                          if name in self._exec.arg_dict}
+
+    def update_full_grads(self, train_data):
+        """mu <- mean over ``train_data`` of grad(w~) (reference:
+        SVRGModule.update_full_grads). Call after take_snapshot()."""
+        if not self._snapshot:
+            self.take_snapshot()
+        # .copy(): arg_dict holds the LIVE NDArrays; saving the objects
+        # and then _set_data'ing them would alias away the live weights
+        saved = {n: self._exec.arg_dict[n].copy() for n in self._snapshot}
+        totals = {n: None for n in self._snapshot}
+        nbatch = 0
+        try:
+            for n, w in self._snapshot.items():
+                self._exec.arg_dict[n]._set_data(w.data)
+            train_data.reset()
+            for batch in train_data:
+                self.forward_backward(batch)
+                nbatch += 1
+                for n in totals:
+                    g = self._exec.grad_dict.get(n)
+                    if g is None:
+                        continue
+                    totals[n] = g.copy() if totals[n] is None \
+                        else totals[n] + g
+        finally:
+            for n, w in saved.items():
+                self._exec.arg_dict[n]._set_data(w.data)
+            train_data.reset()
+        if nbatch == 0:
+            raise MXNetError("update_full_grads: empty train_data")
+        self._full_grads = {n: t / float(nbatch)
+                            for n, t in totals.items() if t is not None}
+
+    def _compute_snapshot_batch_grads(self, data_batch):
+        """grad_i(w~) for one batch, leaving live weights untouched."""
+        saved = {n: self._exec.arg_dict[n].copy() for n in self._snapshot}
+        try:
+            for n, w in self._snapshot.items():
+                self._exec.arg_dict[n]._set_data(w.data)
+            self.forward_backward(data_batch)
+            self._snap_batch_grads = {
+                n: self._exec.grad_dict[n].copy()
+                for n in self._snapshot
+                if self._exec.grad_dict.get(n) is not None}
+        finally:
+            for n, w in saved.items():
+                self._exec.arg_dict[n]._set_data(w.data)
+
+    def forward_backward(self, data_batch):
+        super().forward_backward(data_batch)
+
+    def svrg_forward_backward(self, data_batch):
+        """One SVRG step's gradients: runs the snapshot pass FIRST (it
+        clobbers grad buffers), then the live pass, so ``update()`` sees
+        live ``g_i(w)`` plus the stored correction terms."""
+        if self._full_grads:
+            self._compute_snapshot_batch_grads(data_batch)
+        self.forward_backward(data_batch)
+
+    def update(self):
+        """Apply w -= lr * (g_i(w) - g_i(w~) + mu) via the bound
+        optimizer (reference: _SVRGOptimizer's corrected update)."""
+        if not self._full_grads:
+            return super().update()
+        for i, name in enumerate(self._param_names):
+            grad = self._exec.grad_dict.get(name)
+            if grad is None:
+                continue
+            g_snap = self._snap_batch_grads.get(name)
+            mu = self._full_grads.get(name)
+            if g_snap is not None and mu is not None:
+                grad = grad - g_snap + mu
+            if self._compression is not None:
+                grad = self._compression.compress(name, 0, grad)
+            self._updater(i, grad, self._exec.arg_dict[name])
+
+    # -- training loop --------------------------------------------------
+    def fit(self, train_data, eval_data=None, eval_metric="acc",
+            epoch_end_callback=None, batch_end_callback=None,
+            kvstore="local", optimizer="sgd",
+            optimizer_params=(("learning_rate", 0.01),),
+            initializer=None, num_epoch=1, force_init=False,
+            validation_metric=None, **kwargs):
+        """SVRG training loop (reference: SVRGModule.fit): every
+        ``update_freq`` epochs, refresh w~ and mu over the whole data."""
+        from .. import metric as metric_mod
+
+        if not self.binded:
+            self.bind(data_shapes=train_data.provide_data,
+                      label_shapes=train_data.provide_label,
+                      for_training=True)
+        if not self.params_initialized or force_init:
+            self.init_params(initializer=initializer, force_init=force_init)
+        if not self.optimizer_initialized or force_init:
+            self.init_optimizer(kvstore=kvstore, optimizer=optimizer,
+                                optimizer_params=optimizer_params)
+        eval_metric = metric_mod.create(eval_metric) \
+            if not hasattr(eval_metric, "update") else eval_metric
+        for epoch in range(num_epoch):
+            if epoch % self.update_freq == 0:
+                self.take_snapshot()
+                self.update_full_grads(train_data)
+            eval_metric.reset()
+            train_data.reset()
+            for nbatch, batch in enumerate(train_data):
+                self.svrg_forward_backward(batch)
+                self.update()
+                self.update_metric(eval_metric, batch.label)
+                if batch_end_callback is not None:
+                    batch_end_callback(type("P", (), {
+                        "epoch": epoch, "nbatch": nbatch,
+                        "eval_metric": eval_metric})())
+            if epoch_end_callback is not None:
+                epoch_end_callback(epoch, self.symbol, None, None)
+            logging.info("SVRG epoch %d: %s", epoch,
+                         dict([eval_metric.get()]
+                              if not isinstance(eval_metric.get()[0], list)
+                              else zip(*eval_metric.get())))
+        return self

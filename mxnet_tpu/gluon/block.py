@@ -228,6 +228,10 @@ class Block:
         loaded = serialization.load(filename)
         if isinstance(loaded, list):
             raise MXNetError(f"{filename} holds a list, not a parameter dict")
+        # an optimize_for graph holds folded COPIES of the old params; it
+        # must not keep serving after a checkpoint restore
+        if getattr(self, "_optimized_block", None) is not None:
+            self._set_optimized_block(None)
         loaded = {k[4:] if k.startswith(("arg:", "aux:")) else k: v
                   for k, v in loaded.items()}
         params = self._collect_params_with_prefix()
@@ -435,6 +439,9 @@ class HybridBlock(Block):
         self._active = active
         self._flags = {"static_alloc": static_alloc, "static_shape": static_shape}
         self._cached_graph = None
+        # drop any optimize_for graph: its params are a folded COPY, so
+        # it must not shadow the live params after a re-hybridize
+        self._set_optimized_block(None)
         super().hybridize(active, static_alloc=static_alloc,
                           static_shape=static_shape, **kwargs)
 
@@ -530,7 +537,12 @@ class HybridBlock(Block):
         run the backend's registered passes (mxnet_tpu.subgraph), and
         swap the block's forward to the transformed graph — the same
         replace-in-place contract as upstream. Without: just hybridize
-        (XLA fuses natively)."""
+        (XLA fuses natively).
+
+        The swapped-in graph holds its own (possibly weight-FOLDED)
+        parameter copies — an inference artifact. ``hybridize()`` or
+        ``load_parameters()`` clears it and reconnects the live params;
+        re-run optimize_for afterwards if wanted."""
         self.hybridize()
         if backend is None:
             return self(x)
@@ -547,8 +559,15 @@ class HybridBlock(Block):
             p.shape = tuple(arr.shape)
             p.initialize(force_reinit=True)
             p.set_data(arr)
-        self._optimized_block = opt
+        self._set_optimized_block(opt)
         return self(x)
+
+    def _set_optimized_block(self, blk):
+        # bypass __setattr__: the swapped-in graph is an inference
+        # artifact, NOT a child (its folded param copies must not appear
+        # in collect_params / save_parameters)
+        self.__dict__["_optimized_block"] = blk
+        self._children.pop("_optimized_block", None)
 
     @staticmethod
     def _sym_trace_inputs(sym, arg_params, aux_params):

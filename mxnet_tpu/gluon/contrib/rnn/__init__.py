@@ -1,0 +1,13 @@
+"""``gluon.contrib.rnn`` — convolutional RNN cells + VariationalDropout
+(reference: ``python/mxnet/gluon/contrib/rnn/conv_rnn_cell.py`` ::
+``_BaseConvRNNCell``/``Conv{1,2,3}D{RNN,LSTM,GRU}Cell`` and
+``rnn_cell.py::VariationalDropoutCell``)."""
+from .conv_rnn_cell import (Conv1DRNNCell, Conv2DRNNCell, Conv3DRNNCell,
+                            Conv1DLSTMCell, Conv2DLSTMCell, Conv3DLSTMCell,
+                            Conv1DGRUCell, Conv2DGRUCell, Conv3DGRUCell)
+from .rnn_cell import VariationalDropoutCell
+
+__all__ = ["Conv1DRNNCell", "Conv2DRNNCell", "Conv3DRNNCell",
+           "Conv1DLSTMCell", "Conv2DLSTMCell", "Conv3DLSTMCell",
+           "Conv1DGRUCell", "Conv2DGRUCell", "Conv3DGRUCell",
+           "VariationalDropoutCell"]

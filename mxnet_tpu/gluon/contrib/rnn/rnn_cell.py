@@ -1,0 +1,62 @@
+"""VariationalDropoutCell (reference:
+``python/mxnet/gluon/contrib/rnn/rnn_cell.py`` ::
+``VariationalDropoutCell``) — Gal & Ghahramani (2016): ONE dropout mask
+per sequence, reused across every timestep, applied to inputs / states /
+outputs independently."""
+from __future__ import annotations
+
+from ...rnn.rnn_cell import ModifierCell
+
+__all__ = ["VariationalDropoutCell"]
+
+
+class VariationalDropoutCell(ModifierCell):
+    def __init__(self, base_cell, drop_inputs=0.0, drop_states=0.0,
+                 drop_outputs=0.0):
+        self._alias_name = "vardrop"
+        super().__init__(base_cell)
+        self.drop_inputs = drop_inputs
+        self.drop_states = drop_states
+        self.drop_outputs = drop_outputs
+        self._input_mask = None
+        self._state_mask = None
+        self._output_mask = None
+
+    def _alias(self):
+        return "vardrop"
+
+    def reset(self):
+        super().reset()
+        self._input_mask = None
+        self._state_mask = None
+        self._output_mask = None
+
+    def _mask(self, F, which, rate, like):
+        """Sample the per-sequence mask lazily at the first step, then
+        reuse it — the variational-RNN contract."""
+        mask = getattr(self, which)
+        if mask is None:
+            mask = F.Dropout(F.ones_like(like), p=rate)
+            setattr(self, which, mask)
+        return mask
+
+    def hybrid_forward(self, F, inputs, states):
+        from .... import autograd
+
+        training = autograd.is_training()
+        if training and self.drop_inputs:
+            inputs = inputs * self._mask(F, "_input_mask",
+                                         self.drop_inputs, inputs)
+        if training and self.drop_states:
+            mask = self._mask(F, "_state_mask", self.drop_states, states[0])
+            states = [states[0] * mask] + list(states[1:])
+        output, next_states = self.base_cell(inputs, states)
+        if training and self.drop_outputs:
+            output = output * self._mask(F, "_output_mask",
+                                         self.drop_outputs, output)
+        return output, next_states
+
+    def __repr__(self):
+        return (f"VariationalDropoutCell(in={self.drop_inputs}, "
+                f"state={self.drop_states}, out={self.drop_outputs}, "
+                f"base={self.base_cell.__class__.__name__})")

@@ -78,9 +78,20 @@ def load(path, verbose=True):
 
     from .ops.registry import register
 
+    from .ops.registry import get_op as _get_op
+
     names = []
     for op_idx in range(lib.mxlib_num_ops()):
         name = lib.mxlib_op_name(op_idx).decode()
+        try:
+            _get_op(name)
+            exists = True
+        except Exception:
+            exists = False
+        if exists and path not in _LOADED:
+            raise MXNetError(
+                f"{path}: op {name!r} collides with an already-registered "
+                "op; loading it would silently redirect existing graphs")
         nin = lib.mxlib_op_num_inputs(op_idx)
 
         def make(op_idx=op_idx, name=name, nin=nin):

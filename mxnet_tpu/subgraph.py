@@ -117,7 +117,10 @@ def fuse_conv_bn(sym, arg_params, aux_params, **kwargs):
         beta, bsrc = take(bname)
         mean, _ = take(mname)
         var, _ = take(vname)
-        eps = float(node.attrs.get("eps", 1e-5))
+        if int(node.attrs.get("axis", 1)) != 1:
+            continue                  # channels-last BN: fold axis differs
+        # defaults must match the OP's defaults (ops/nn.py batch_norm)
+        eps = float(node.attrs.get("eps", 1e-3))
         # default must match the OP's default (ops/nn.py batch_norm:
         # fix_gamma=True), not False
         if str(node.attrs.get("fix_gamma", True)).lower() in ("true", "1"):

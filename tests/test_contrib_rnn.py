@@ -1,0 +1,141 @@
+"""gluon.contrib.rnn tests (reference:
+tests/python/unittest/test_gluon_contrib.py — conv RNN cells,
+VariationalDropoutCell).
+
+Oracles: shape algebra (state preserves spatial dims), a numpy ConvLSTM
+step, mask-reuse semantics for variational dropout.
+"""
+import numpy as onp
+import pytest
+
+import mxnet_tpu as mx
+from mxnet_tpu import autograd
+from mxnet_tpu.gluon import rnn
+from mxnet_tpu.gluon.contrib import rnn as crnn
+
+
+class TestConvCells:
+    @pytest.mark.parametrize("cls,n_states", [
+        (crnn.Conv2DRNNCell, 1), (crnn.Conv2DLSTMCell, 2),
+        (crnn.Conv2DGRUCell, 1)])
+    def test_2d_shapes_and_unroll(self, cls, n_states):
+        cell = cls(input_shape=(3, 8, 8), hidden_channels=5,
+                   i2h_kernel=3, h2h_kernel=3, i2h_pad=1)
+        cell.initialize()
+        x = mx.nd.array(onp.random.RandomState(0)
+                        .randn(2, 3, 8, 8).astype("float32"))
+        out, states = cell(x)
+        assert out.shape == (2, 5, 8, 8)
+        assert len(states) == n_states
+        for s in states:
+            assert s.shape == (2, 5, 8, 8)
+        seq = mx.nd.array(onp.random.RandomState(1)
+                          .randn(2, 4, 3, 8, 8).astype("float32"))
+        cell.reset()
+        outs, final = cell.unroll(4, seq, layout="NTC")
+        assert len(outs) == 4 and outs[0].shape == (2, 5, 8, 8)
+
+    @pytest.mark.parametrize("cls,dims", [
+        (crnn.Conv1DLSTMCell, 1), (crnn.Conv3DLSTMCell, 3)])
+    def test_1d_3d(self, cls, dims):
+        spatial = (6,) * dims
+        cell = cls(input_shape=(2,) + spatial, hidden_channels=4,
+                   i2h_kernel=3, h2h_kernel=3, i2h_pad=1)
+        cell.initialize()
+        x = mx.nd.array(onp.random.RandomState(2)
+                        .randn(2, 2, *spatial).astype("float32"))
+        out, states = cell(x)
+        assert out.shape == (2, 4) + spatial
+
+    def test_convlstm_matches_numpy(self):
+        cell = crnn.Conv2DLSTMCell(input_shape=(1, 4, 4), hidden_channels=1,
+                                   i2h_kernel=3, h2h_kernel=3, i2h_pad=1)
+        cell.initialize()
+        rs = onp.random.RandomState(3)
+        x = rs.randn(1, 1, 4, 4).astype("float32")
+        h0 = rs.randn(1, 1, 4, 4).astype("float32")
+        c0 = rs.randn(1, 1, 4, 4).astype("float32")
+        out, (h1, c1) = cell(mx.nd.array(x),
+                             [mx.nd.array(h0), mx.nd.array(c0)])
+
+        def conv(inp, w, b):
+            from scipy.signal import correlate  # noqa: F401
+            pad = onp.pad(inp[0], ((0, 0), (1, 1), (1, 1)))
+            out = onp.zeros((w.shape[0], 4, 4), "float32")
+            for o in range(w.shape[0]):
+                for ci in range(w.shape[1]):
+                    for i in range(4):
+                        for j in range(4):
+                            out[o, i, j] += (pad[ci, i:i + 3, j:j + 3]
+                                             * w[o, ci]).sum()
+                out[o] += b[o]
+            return out[None]
+
+        wi = cell.i2h_weight.data().asnumpy()
+        wh = cell.h2h_weight.data().asnumpy()
+        bi = cell.i2h_bias.data().asnumpy()
+        bh = cell.h2h_bias.data().asnumpy()
+        gates = conv(x, wi, bi) + conv(h0, wh, bh)
+        ig, fg, it, og = onp.split(gates, 4, axis=1)
+        sig = lambda v: 1.0 / (1.0 + onp.exp(-v))
+        c_want = sig(fg) * c0 + sig(ig) * onp.tanh(it)
+        h_want = sig(og) * onp.tanh(c_want)
+        onp.testing.assert_allclose(h1.asnumpy(), h_want,
+                                    rtol=1e-4, atol=1e-5)
+        onp.testing.assert_allclose(c1.asnumpy(), c_want,
+                                    rtol=1e-4, atol=1e-5)
+
+    def test_even_h2h_kernel_rejected(self):
+        with pytest.raises(ValueError, match="odd"):
+            crnn.Conv2DLSTMCell(input_shape=(1, 4, 4), hidden_channels=1,
+                                i2h_kernel=3, h2h_kernel=2)
+
+
+class TestVariationalDropout:
+    def test_mask_reused_across_steps(self):
+        base = rnn.RNNCell(6)
+        cell = crnn.VariationalDropoutCell(base, drop_inputs=0.5)
+        cell.initialize()
+        rs = onp.random.RandomState(4)
+        ones = mx.nd.array(onp.ones((2, 6), "float32"))
+        with autograd.record():  # training mode
+            autograd.set_training(True)
+            cell.reset()
+            _o1, s = cell(ones)
+            m1 = cell._input_mask.asnumpy()
+            _o2, s = cell(ones, s)
+            m2 = cell._input_mask.asnumpy()
+        onp.testing.assert_array_equal(m1, m2)   # SAME mask, both steps
+        cell.reset()
+        with autograd.record():
+            autograd.set_training(True)
+            cell(ones)
+            m3 = cell._input_mask.asnumpy()
+        assert not (m1 == m3).all()              # fresh mask after reset
+
+    def test_inference_identity(self):
+        base = rnn.LSTMCell(5)
+        cell = crnn.VariationalDropoutCell(base, drop_inputs=0.9,
+                                           drop_states=0.9,
+                                           drop_outputs=0.9)
+        cell.initialize()
+        x = mx.nd.array(onp.random.RandomState(5).randn(3, 4)
+                        .astype("float32"))
+        out, _ = cell(x)
+        base.reset()
+        want, _ = base(x)
+        onp.testing.assert_allclose(out.asnumpy(), want.asnumpy(),
+                                    rtol=1e-6)
+
+    def test_unroll_trains(self):
+        base = rnn.GRUCell(4)
+        cell = crnn.VariationalDropoutCell(base, drop_states=0.3)
+        cell.initialize()
+        seq = mx.nd.array(onp.random.RandomState(6).randn(2, 5, 3)
+                          .astype("float32"))
+        with autograd.record():
+            outs, _ = cell.unroll(5, seq, layout="NTC", merge_outputs=True)
+            loss = (outs ** 2).mean()
+        loss.backward()
+        g = base.i2h_weight.grad()
+        assert onp.isfinite(g.asnumpy()).all()

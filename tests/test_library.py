@@ -123,3 +123,15 @@ class TestLibrary:
             mx.nd.my_gemm_relu(mx.nd.ones((2, 3)), mx.nd.ones((4, 5)))
         with pytest.raises(MXNetError, match="not found"):
             mx.library.load("/nonexistent/lib.so")
+
+
+def test_colliding_op_name_rejected(tmp_path):
+    if shutil.which("g++") is None:
+        pytest.skip("no g++")
+    src = tmp_path / "bad.cc"
+    src.write_text(_C_SRC.replace('"my_gemm_relu"', '"relu"'))
+    so = tmp_path / "bad.so"
+    subprocess.run(["g++", "-O2", "-shared", "-fPIC", str(src),
+                    "-o", str(so)], check=True)
+    with pytest.raises(MXNetError, match="collides"):
+        mx.library.load(str(so))

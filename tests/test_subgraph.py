@@ -110,3 +110,48 @@ class TestPassRegistry:
             (mx.sym.var("x") + 1.0).optimize_for("NOPE")
         with pytest.raises(MXNetError, match="unknown passes"):
             subgraph.register_backend("BAD", ["does_not_exist"])
+
+
+def test_optimized_block_cleared_on_reload(tmp_path):
+    """Regression: the optimize_for graph holds folded param COPIES;
+    load_parameters / hybridize must reconnect the live params."""
+    onp.random.seed(9)
+    net = _convnet()
+    x = mx.nd.array(onp.random.RandomState(10).randn(2, 3, 8, 8)
+                    .astype("float32"))
+    net(x)
+    f = str(tmp_path / "w.params")
+    net.save_parameters(f)
+    net.optimize_for(x, backend="TPU")
+    assert getattr(net, "_optimized_block", None) is not None
+    net.load_parameters(f)
+    assert getattr(net, "_optimized_block", None) is None
+    net.optimize_for(x, backend="TPU")
+    net.hybridize()
+    assert getattr(net, "_optimized_block", None) is None
+
+
+def test_fuse_eps_default_matches_op():
+    """Regression: a BN node with no eps attr runs with the OP default
+    (1e-3); the fold must use the same value."""
+    d = mx.sym.var("data")
+    w = mx.sym.var("w")
+    c = mx.sym.Convolution(d, w, kernel=(1, 1), num_filter=2,
+                           no_bias=True, name="c")
+    g_, b_, m_, v_ = (mx.sym.var(n) for n in "gbmv")
+    out = mx.sym.BatchNorm(c, g_, b_, m_, v_, fix_gamma=False, name="bn")
+    rs = onp.random.RandomState(11)
+    arg = {"w": mx.nd.array(rs.randn(2, 2, 1, 1).astype("float32")),
+           "g": mx.nd.array(rs.rand(2).astype("float32") + 0.5),
+           "b": mx.nd.zeros((2,))}
+    aux = {"m": mx.nd.zeros((2,)),
+           "v": mx.nd.array(onp.full(2, 1e-3, "float32"))}  # eps-sized var
+    from mxnet_tpu.symbol.executor import eval_symbol
+
+    x = mx.nd.array(rs.randn(2, 2, 4, 4).astype("float32"))
+    feed = dict(arg); feed.update(aux); feed["data"] = x
+    want = eval_symbol(out, feed).asnumpy()
+    fused = out.optimize_for("TPU", arg, aux)
+    feed2 = dict(arg); feed2["data"] = x
+    got = eval_symbol(fused, feed2).asnumpy()
+    onp.testing.assert_allclose(got, want, rtol=1e-4, atol=1e-5)

@@ -1,0 +1,115 @@
+"""SVRG optimization tests (reference:
+tests/python/unittest/test_contrib_svrg_module.py /
+test_contrib_svrg_optimizer.py).
+
+Oracles: mu == mean of batch gradients at the snapshot; the corrected
+direction reduces to plain SGD at the snapshot point; end-to-end fit
+converges on least squares.
+"""
+import numpy as onp
+import pytest
+
+import mxnet_tpu as mx
+from mxnet_tpu.base import MXNetError
+from mxnet_tpu.contrib.svrg_optimization import SVRGModule
+
+
+def _linreg_symbol():
+    data = mx.sym.var("data")
+    label = mx.sym.var("lin_label")
+    fc = mx.sym.FullyConnected(data, mx.sym.var("fc_weight"),
+                               mx.sym.var("fc_bias"), num_hidden=1,
+                               name="fc")
+    return mx.sym.LinearRegressionOutput(fc, label, name="lin")
+
+
+def _data(n=64, batch=16, seed=0):
+    rs = onp.random.RandomState(seed)
+    x = rs.randn(n, 4).astype("float32")
+    w = onp.array([[1.5, -2.0, 0.5, 3.0]], "float32")
+    y = x @ w.T + 0.01 * rs.randn(n, 1).astype("float32")
+    return mx.io.NDArrayIter(x, y, batch_size=batch,
+                             label_name="lin_label")
+
+
+class TestSVRGModule:
+    def test_full_grads_is_mean_of_batch_grads(self):
+        it = _data()
+        mod = SVRGModule(_linreg_symbol(), data_names=("data",),
+                         label_names=("lin_label",), update_freq=1)
+        mod.bind(data_shapes=it.provide_data,
+                 label_shapes=it.provide_label)
+        mod.init_params()
+        mod.init_optimizer(optimizer="sgd",
+                           optimizer_params=(("learning_rate", 0.0),))
+        mod.take_snapshot()
+        mod.update_full_grads(it)
+        # hand-accumulate batch grads at the same (unchanged) weights
+        it.reset()
+        totals, nb = None, 0
+        for batch in it:
+            mod.forward_backward(batch)
+            g = mod._exec.grad_dict["fc_weight"].asnumpy()
+            totals = g.copy() if totals is None else totals + g
+            nb += 1
+        onp.testing.assert_allclose(
+            mod._full_grads["fc_weight"].asnumpy(), totals / nb,
+            rtol=1e-5, atol=1e-6)
+
+    def test_correction_vanishes_at_snapshot(self):
+        """At w == w~, g_i(w) - g_i(w~) + mu == mu: the applied update
+        equals the full-gradient step for every batch."""
+        it = _data()
+        mod = SVRGModule(_linreg_symbol(), data_names=("data",),
+                         label_names=("lin_label",), update_freq=1)
+        mod.bind(data_shapes=it.provide_data,
+                 label_shapes=it.provide_label)
+        mod.init_params()
+        mod.init_optimizer(optimizer="sgd",
+                           optimizer_params=(("learning_rate", 0.1),))
+        mod.take_snapshot()
+        mod.update_full_grads(it)
+        w0 = mod._exec.arg_dict["fc_weight"].asnumpy().copy()
+        it.reset()
+        batch = next(iter(it))
+        mod.svrg_forward_backward(batch)
+        mod.update()
+        w1 = mod._exec.arg_dict["fc_weight"].asnumpy()
+        want = w0 - 0.1 * mod._full_grads["fc_weight"].asnumpy()
+        onp.testing.assert_allclose(w1, want, rtol=1e-4, atol=1e-5)
+
+    def test_fit_converges(self):
+        it = _data(n=128, batch=16, seed=3)
+        mod = SVRGModule(_linreg_symbol(), data_names=("data",),
+                         label_names=("lin_label",), update_freq=2)
+        mod.fit(it, eval_metric="mse", num_epoch=12, optimizer="sgd",
+                optimizer_params=(("learning_rate", 0.05),))
+        w = mod._exec.arg_dict["fc_weight"].asnumpy()
+        onp.testing.assert_allclose(
+            w, [[1.5, -2.0, 0.5, 3.0]], rtol=0.1, atol=0.05)
+
+    def test_bad_update_freq(self):
+        with pytest.raises(MXNetError, match="update_freq"):
+            SVRGModule(_linreg_symbol(), update_freq=0)
+
+
+def test_snapshot_grads_leave_live_weights_intact():
+    """Regression: computing snapshot-point gradients must not clobber
+    the live weights (save/restore must copy, not alias)."""
+    it = _data()
+    mod = SVRGModule(_linreg_symbol(), data_names=("data",),
+                     label_names=("lin_label",), update_freq=1)
+    mod.bind(data_shapes=it.provide_data, label_shapes=it.provide_label)
+    mod.init_params()
+    mod.init_optimizer(optimizer="sgd",
+                       optimizer_params=(("learning_rate", 0.1),))
+    mod.take_snapshot()
+    mod.update_full_grads(it)
+    # move the live weights away from the snapshot
+    live = mod._exec.arg_dict["fc_weight"]
+    moved = live.asnumpy() + 1.0
+    live._set_data(mx.nd.array(moved).data)
+    it.reset()
+    mod._compute_snapshot_batch_grads(next(iter(it)))
+    onp.testing.assert_allclose(
+        mod._exec.arg_dict["fc_weight"].asnumpy(), moved)

@@ -1,0 +1,85 @@
+#!/usr/bin/env python
+"""Allreduce bandwidth measurement (reference: ``tools/bandwidth/`` —
+``measure.py`` benchmarks kvstore push+pull GB/s across devices; tracked
+metric "KVStore allreduce GB/s" in BASELINE.json).
+
+Measures the COMPILED collective path the tpu_sync kvstore and the fused
+TrainStep use: a psum over the mesh's ``dp`` axis, timed end-to-end with
+a device sync. Reports algorithmic bandwidth (payload bytes / time) and
+bus bandwidth (2*(n-1)/n scaling — the ring-allreduce wire bytes).
+
+    python tools/bandwidth.py [--size-mb 64] [--devices N] [--iters 20]
+
+On the virtual CPU mesh (XLA_FLAGS=--xla_force_host_platform_device_count=8
+JAX_PLATFORMS=cpu) this exercises the code path; real numbers need real
+ICI-connected chips.
+"""
+from __future__ import annotations
+
+import argparse
+import json
+import time
+
+
+def measure(size_mb=64.0, n_devices=None, iters=20, dtype="float32"):
+    import jax
+    import jax.numpy as jnp
+    import numpy as np
+    from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+    devs = jax.devices()
+    n = int(n_devices or len(devs))
+    devs = devs[:n]
+    if n < 2:
+        raise SystemExit("allreduce needs >= 2 devices "
+                         "(set --xla_force_host_platform_device_count)")
+    mesh = Mesh(np.array(devs), ("dp",))
+    itemsize = jnp.dtype(dtype).itemsize
+    elems = int(size_mb * 1e6 / itemsize)
+    elems = max(elems - elems % n, n)
+
+    # per-device distinct payloads, laid out sharded over dp so the psum
+    # is a real cross-device reduction, not a local fold
+    x = jnp.arange(n * elems, dtype=dtype).reshape(n, elems)
+    x = jax.device_put(x, NamedSharding(mesh, P("dp")))
+
+    from jax import shard_map
+
+    @jax.jit
+    def allreduce(v):
+        return shard_map(lambda s: jax.lax.psum(s, "dp"), mesh=mesh,
+                         in_specs=P("dp"), out_specs=P())(v)
+
+    out = allreduce(x)
+    out.block_until_ready()                      # compile + warm
+    t0 = time.perf_counter()
+    for _ in range(iters):
+        out = allreduce(x)
+    out.block_until_ready()
+    dt = time.perf_counter() - t0
+
+    payload = elems * itemsize                   # bytes reduced per device
+    algo_gbps = payload * iters / dt / 1e9
+    bus_gbps = algo_gbps * 2 * (n - 1) / n
+    return {
+        "metric": "kvstore_allreduce_bandwidth",
+        "value": round(algo_gbps, 3),
+        "unit": "GB/s (algorithmic)",
+        "bus_gb_s": round(bus_gbps, 3),
+        "devices": n,
+        "payload_mb": round(payload / 1e6, 2),
+        "platform": devs[0].platform,
+    }
+
+
+def main():
+    ap = argparse.ArgumentParser(description=__doc__)
+    ap.add_argument("--size-mb", type=float, default=64.0)
+    ap.add_argument("--devices", type=int, default=None)
+    ap.add_argument("--iters", type=int, default=20)
+    args = ap.parse_args()
+    print(json.dumps(measure(args.size_mb, args.devices, args.iters)))
+
+
+if __name__ == "__main__":
+    main()
