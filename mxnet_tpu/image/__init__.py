@@ -477,3 +477,12 @@ class ImageIter:
                 labels[j] = labels[j % i]
         return DataBatch(data=[nd_array(data)], label=[nd_array(labels)],
                          pad=pad)
+
+
+from .detection import (DetAugmenter, DetBorrowAug,  # noqa: E402
+                        DetHorizontalFlipAug, DetRandomCropAug,
+                        DetRandomPadAug, CreateDetAugmenter, ImageDetIter)
+
+__all__ += ["DetAugmenter", "DetBorrowAug", "DetHorizontalFlipAug",
+            "DetRandomCropAug", "DetRandomPadAug", "CreateDetAugmenter",
+            "ImageDetIter"]

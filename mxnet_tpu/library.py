@@ -80,18 +80,25 @@ def load(path, verbose=True):
 
     from .ops.registry import get_op as _get_op
 
+    # validate EVERY name before registering ANY: a collision must not
+    # leave earlier ops from the rejected library behind
+    all_names = [lib.mxlib_op_name(i).decode()
+                 for i in range(lib.mxlib_num_ops())]
+    if path not in _LOADED:
+        for name in all_names:
+            try:
+                _get_op(name)
+                exists = True
+            except Exception:
+                exists = False
+            if exists:
+                raise MXNetError(
+                    f"{path}: op {name!r} collides with an already-"
+                    "registered op; loading it would silently redirect "
+                    "existing graphs")
+
     names = []
-    for op_idx in range(lib.mxlib_num_ops()):
-        name = lib.mxlib_op_name(op_idx).decode()
-        try:
-            _get_op(name)
-            exists = True
-        except Exception:
-            exists = False
-        if exists and path not in _LOADED:
-            raise MXNetError(
-                f"{path}: op {name!r} collides with an already-registered "
-                "op; loading it would silently redirect existing graphs")
+    for op_idx, name in enumerate(all_names):
         nin = lib.mxlib_op_num_inputs(op_idx)
 
         def make(op_idx=op_idx, name=name, nin=nin):

@@ -1,0 +1,194 @@
+"""Spatial sampling + box ops (reference: ``src/operator/bilinear_sampler.cc``,
+``spatial_transformer.cc``, ``src/operator/contrib/bounding_box.cc`` ::
+``box_nms``/``box_iou``).
+
+All fixed-shape and mask-based (suppressed boxes become -1 rows, never a
+dynamic filter) so everything jits onto the TPU — the reference's
+CPU/GPU NMS kernels use dynamic output lists, which XLA cannot."""
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+
+from .registry import register
+
+
+@register("hard_sigmoid")
+def hard_sigmoid(data, *, alpha=0.2, beta=0.5):
+    return jnp.clip(alpha * data + beta, 0.0, 1.0)
+
+
+@register("unravel_index")
+def unravel_index(data, *, shape):
+    idx = jnp.unravel_index(data.astype(jnp.int64), tuple(shape))
+    return jnp.stack(idx, axis=0)
+
+
+@register("multi_all_finite", variadic=True)
+def multi_all_finite(*arrays, num_arrays=1, init_output=True):
+    """1 if every element of every input is finite (AMP's global-finite
+    check; reference: multi_all_finite.cc). ``init_output`` controls the
+    reference's in-place output-buffer reuse; functionally the result is
+    always the all-finite predicate of THESE inputs."""
+    ok = jnp.bool_(True)
+    for a in arrays:
+        ok = jnp.logical_and(ok, jnp.all(jnp.isfinite(
+            a.astype(jnp.float32))))
+    return ok.astype(jnp.float32).reshape((1,))
+
+
+def _corner_iou(a, b):
+    """Pairwise IoU of corner boxes a (..., M, 4) x b (..., N, 4)."""
+    ax1, ay1, ax2, ay2 = [a[..., i] for i in range(4)]
+    bx1, by1, bx2, by2 = [b[..., i] for i in range(4)]
+    ix1 = jnp.maximum(ax1[..., :, None], bx1[..., None, :])
+    iy1 = jnp.maximum(ay1[..., :, None], by1[..., None, :])
+    ix2 = jnp.minimum(ax2[..., :, None], bx2[..., None, :])
+    iy2 = jnp.minimum(ay2[..., :, None], by2[..., None, :])
+    inter = jnp.clip(ix2 - ix1, 0) * jnp.clip(iy2 - iy1, 0)
+    area_a = jnp.clip(ax2 - ax1, 0) * jnp.clip(ay2 - ay1, 0)
+    area_b = jnp.clip(bx2 - bx1, 0) * jnp.clip(by2 - by1, 0)
+    union = area_a[..., :, None] + area_b[..., None, :] - inter
+    return jnp.where(union > 0, inter / union, 0.0)
+
+
+def _to_corner(boxes, fmt):
+    if fmt == "corner":
+        return boxes
+    # center: (x, y, w, h) -> corners
+    x, y, w, h = [boxes[..., i] for i in range(4)]
+    return jnp.stack([x - w / 2, y - h / 2, x + w / 2, y + h / 2], axis=-1)
+
+
+def _convert_format(boxes, src, dst):
+    if src == dst:
+        return boxes
+    if dst == "corner":
+        return _to_corner(boxes, src)
+    x1, y1, x2, y2 = [boxes[..., i] for i in range(4)]
+    return jnp.stack([(x1 + x2) / 2, (y1 + y2) / 2, x2 - x1, y2 - y1],
+                     axis=-1)
+
+
+@register("_contrib_box_iou", aliases=["box_iou"])
+def box_iou(lhs, rhs, *, format="corner"):
+    return _corner_iou(_to_corner(lhs.astype(jnp.float32), format),
+                       _to_corner(rhs.astype(jnp.float32), format))
+
+
+@register("_contrib_box_nms", aliases=["box_nms"])
+def box_nms(data, *, overlap_thresh=0.5, valid_thresh=0.0, topk=-1,
+            coord_start=2, score_index=1, id_index=-1,
+            force_suppress=False, in_format="corner",
+            out_format="corner"):
+    """Greedy per-batch NMS (reference: bounding_box.cc::BoxNMS).
+
+    data: (..., N, K) rows [.., score, .., x1, y1, x2, y2, ..]; returns
+    the same shape, score-sorted, suppressed/invalid rows filled -1.
+    """
+    x = data.astype(jnp.float32)
+    batched = x.ndim > 2
+    flat = x.reshape((-1,) + x.shape[-2:]) if batched else x[None]
+
+    def one(rows):
+        n = rows.shape[0]
+        scores = rows[:, score_index]
+        order = jnp.argsort(-scores)
+        rows = rows[order]
+        scores = rows[:, score_index]
+        boxes = _to_corner(
+            lax.dynamic_slice_in_dim(rows, coord_start, 4, axis=1),
+            in_format)
+        iou = _corner_iou(boxes, boxes)
+        if force_suppress or id_index < 0:
+            same_cls = jnp.ones((n, n), bool)
+        else:
+            ids = rows[:, id_index]
+            same_cls = ids[:, None] == ids[None, :]
+        valid = scores > valid_thresh
+        if topk > 0:
+            valid = jnp.logical_and(valid, jnp.arange(n) < topk)
+
+        def step(keep, i):
+            kept_i = jnp.logical_and(keep[i], valid[i])
+            sup = jnp.logical_and(
+                jnp.logical_and(iou[i] > overlap_thresh, same_cls[i]),
+                jnp.arange(n) > i)
+            keep = jnp.where(jnp.logical_and(kept_i, sup), False, keep)
+            return keep, None
+
+        keep, _ = lax.scan(step, jnp.ones(n, bool), jnp.arange(n))
+        keep = jnp.logical_and(keep, valid)
+        if out_format != in_format:
+            # convert kept rows BEFORE masking so -1 sentinels stay -1
+            conv = _convert_format(
+                rows[:, coord_start:coord_start + 4], in_format, out_format)
+            rows = lax.dynamic_update_slice_in_dim(rows, conv, coord_start,
+                                                   axis=1)
+        return jnp.where(keep[:, None], rows, -jnp.ones_like(rows))
+
+    out = jax.vmap(one)(flat)
+    return out.reshape(x.shape) if batched else out[0]
+
+
+def _bilinear_gather(img, xs, ys):
+    """img (C, H, W) sampled at float pixel coords xs/ys (...,) with
+    zero padding outside (the reference's border behavior for sampler)."""
+    c, h, w = img.shape
+    x0 = jnp.floor(xs)
+    y0 = jnp.floor(ys)
+    dx = xs - x0
+    dy = ys - y0
+
+    def at(ix, iy):
+        inb = (ix >= 0) & (ix < w) & (iy >= 0) & (iy < h)
+        ixc = jnp.clip(ix, 0, w - 1).astype(jnp.int32)
+        iyc = jnp.clip(iy, 0, h - 1).astype(jnp.int32)
+        vals = img[:, iyc, ixc]                   # (C, ...)
+        return jnp.where(inb, vals, 0.0)
+
+    v00 = at(x0, y0)
+    v01 = at(x0 + 1, y0)
+    v10 = at(x0, y0 + 1)
+    v11 = at(x0 + 1, y0 + 1)
+    top = v00 * (1 - dx) + v01 * dx
+    bot = v10 * (1 - dx) + v11 * dx
+    return top * (1 - dy) + bot * dy
+
+
+@register("BilinearSampler")
+def bilinear_sampler(data, grid):
+    """data (B, C, H, W); grid (B, 2, Ho, Wo) normalized [-1, 1] (x, y)
+    (reference: bilinear_sampler.cc)."""
+    data = data.astype(jnp.float32)
+    b, c, h, w = data.shape
+
+    def one(img, g):
+        xs = (g[0] + 1.0) * (w - 1) / 2.0
+        ys = (g[1] + 1.0) * (h - 1) / 2.0
+        return _bilinear_gather(img, xs, ys)
+
+    return jax.vmap(one)(data, grid.astype(jnp.float32))
+
+
+@register("SpatialTransformer")
+def spatial_transformer(data, loc, *, target_shape,
+                        transform_type="affine",
+                        sampler_type="bilinear", cudnn_off=None):
+    """Affine spatial transformer network (reference:
+    spatial_transformer.cc): loc (B, 6) affine thetas -> sampling grid ->
+    bilinear sample."""
+    if transform_type != "affine" or sampler_type != "bilinear":
+        raise NotImplementedError(
+            "SpatialTransformer supports affine + bilinear")
+    ho, wo = int(target_shape[0]), int(target_shape[1])
+    b = data.shape[0]
+    theta = loc.astype(jnp.float32).reshape(b, 2, 3)
+    ys, xs = jnp.meshgrid(jnp.linspace(-1.0, 1.0, ho),
+                          jnp.linspace(-1.0, 1.0, wo), indexing="ij")
+    ones = jnp.ones_like(xs)
+    coords = jnp.stack([xs, ys, ones], axis=0).reshape(3, -1)  # (3, Ho*Wo)
+    grid = jnp.einsum("bij,jk->bik", theta, coords)            # (B, 2, N)
+    grid = grid.reshape(b, 2, ho, wo)
+    return bilinear_sampler(data, grid)

@@ -1,0 +1,117 @@
+"""ImageDetIter / detection augmenter tests (reference:
+tests/python/unittest/test_image.py::TestImageDetIter).
+
+Oracle: box algebra — flips/crops/pads must keep boxes consistent with
+the pixels they cover; the iterator must pad labels to a fixed block.
+"""
+import numpy as onp
+import pytest
+
+import mxnet_tpu as mx
+from mxnet_tpu import image as img_mod, recordio
+from mxnet_tpu.base import MXNetError
+
+
+def _png(arr):
+    """Minimal uncompressed image container: use pack_img's jpeg? —
+    encode via PIL-free path: mx.image.imdecode consumes raw encodings;
+    recordio.pack_img handles encoding."""
+    return arr
+
+
+def _make_det_rec(tmp_path, n=8, size=24, max_objs=3, seed=0):
+    rs = onp.random.RandomState(seed)
+    rec_path = str(tmp_path / "det.rec")
+    idx_path = str(tmp_path / "det.idx")
+    rec = recordio.MXIndexedRecordIO(idx_path, rec_path, "w")
+    for i in range(n):
+        im = rs.randint(0, 255, (size, size, 3)).astype("uint8")
+        k = rs.randint(1, max_objs + 1)
+        objs = []
+        for _ in range(k):
+            x1, y1 = rs.uniform(0, 0.5, 2)
+            objs.append([rs.randint(0, 4), x1, y1,
+                         x1 + rs.uniform(0.2, 0.45),
+                         y1 + rs.uniform(0.2, 0.45)])
+        label = onp.concatenate([[2, 5], onp.asarray(objs).ravel()]) \
+            .astype("float32")
+        header = recordio.IRHeader(0, label, i, 0)
+        rec.write_idx(i, recordio.pack_img(header, im, quality=95))
+    rec.close()
+    return rec_path, idx_path
+
+
+class TestDetAugmenters:
+    def test_hflip_boxes(self):
+        im = onp.zeros((10, 10, 3), "uint8")
+        label = onp.array([[1, 0.1, 0.2, 0.4, 0.6],
+                           [-1, -1, -1, -1, -1]], "float32")
+        aug = img_mod.DetHorizontalFlipAug(p=1.0)
+        _im2, l2 = aug(im, label)
+        onp.testing.assert_allclose(l2[0], [1, 0.6, 0.2, 0.9, 0.6],
+                                    rtol=1e-6)
+        assert (l2[1] == -1).all()
+
+    def test_random_crop_keeps_covered_boxes(self):
+        onp.random.seed(1)
+        im = onp.zeros((20, 20, 3), "uint8")
+        label = onp.array([[0, 0.3, 0.3, 0.7, 0.7]], "float32")
+        aug = img_mod.DetRandomCropAug(min_object_covered=0.5,
+                                       area_range=(0.5, 1.0))
+        for _ in range(5):
+            out, l2 = aug(im, label.copy())
+            kept = l2[l2[:, 0] >= 0]
+            if len(kept):
+                assert (kept[:, 1:5] >= 0).all() and \
+                    (kept[:, 1:5] <= 1).all()
+
+    def test_random_pad_shrinks_boxes(self):
+        im = onp.full((10, 10, 3), 255, "uint8")
+        label = onp.array([[0, 0.0, 0.0, 1.0, 1.0]], "float32")
+        aug = img_mod.DetRandomPadAug(area_range=(2.0, 2.5))
+        out, l2 = aug(im, label.copy())
+        w = l2[0, 3] - l2[0, 1]
+        h = l2[0, 4] - l2[0, 2]
+        assert w < 1.0 and h < 1.0          # box shrank on bigger canvas
+        assert out.shape[0] >= 10 and out.shape[1] >= 10
+
+
+class TestImageDetIter:
+    def test_batches_and_label_padding(self, tmp_path):
+        rec, idx = _make_det_rec(tmp_path)
+        it = img_mod.ImageDetIter(
+            batch_size=4, data_shape=(3, 16, 16), path_imgrec=rec,
+            path_imgidx=idx,
+            aug_list=img_mod.CreateDetAugmenter((3, 16, 16)))
+        assert it.label_shape[0] >= 1 and it.label_shape[1] == 5
+        nb = 0
+        for batch in it:
+            assert batch.data[0].shape == (4, 3, 16, 16)
+            lab = batch.label[0].asnumpy()
+            assert lab.shape == (4,) + it.label_shape
+            valid = lab[lab[:, :, 0] >= 0]
+            assert len(valid)                      # real objects present
+            assert (valid[:, 1:5] >= 0).all()
+            nb += 1
+        assert nb == 2
+        it.reset()
+        assert next(iter(it)) is not None
+
+    def test_mirror_pipeline_and_reshape(self, tmp_path):
+        rec, idx = _make_det_rec(tmp_path, seed=2)
+        it = img_mod.ImageDetIter(
+            batch_size=2, data_shape=(3, 16, 16), path_imgrec=rec,
+            path_imgidx=idx,
+            aug_list=img_mod.CreateDetAugmenter((3, 16, 16),
+                                                rand_mirror=True,
+                                                rand_crop=0.5, mean=True,
+                                                std=True))
+        batch = next(iter(it))
+        assert onp.isfinite(batch.data[0].asnumpy()).all()
+        it.reshape(data_shape=(3, 20, 20))
+        assert it.provide_data[0].shape == (2, 3, 20, 20)
+
+    def test_bad_label_rejected(self):
+        with pytest.raises(MXNetError, match="object_width"):
+            img_mod.ImageDetIter._parse_label(
+                onp.array([2, 3, 0, 0.1, 0.2], "float32"))

@@ -1,0 +1,130 @@
+"""Spatial/box op tests (reference: test_operator.py::test_bilinear_sampler,
+test_spatial_transformer, tests for contrib box_nms/box_iou).
+
+Oracles: identity-transform passthrough, hand-computed IoU, reference
+greedy NMS in numpy.
+"""
+import numpy as onp
+import pytest
+
+import mxnet_tpu as mx
+
+
+class TestScalarOps:
+    def test_hard_sigmoid(self):
+        x = mx.nd.array(onp.array([-10.0, 0.0, 10.0, 1.0], "float32"))
+        got = mx.nd.hard_sigmoid(x).asnumpy()
+        onp.testing.assert_allclose(got, [0.0, 0.5, 1.0, 0.7], rtol=1e-6)
+
+    def test_unravel_index(self):
+        got = mx.nd.unravel_index(mx.nd.array([5, 11], dtype="int32"),
+                                  shape=(3, 4)).asnumpy()
+        onp.testing.assert_array_equal(got, [[1, 2], [1, 3]])
+
+    def test_multi_all_finite(self):
+        a = mx.nd.ones((3,))
+        b = mx.nd.array(onp.array([1.0, onp.inf], "float32"))
+        assert mx.nd.multi_all_finite(a, a, num_arrays=2).asnumpy()[0] == 1
+        assert mx.nd.multi_all_finite(a, b, num_arrays=2).asnumpy()[0] == 0
+
+
+class TestBoxOps:
+    def test_box_iou(self):
+        a = mx.nd.array(onp.array([[0, 0, 2, 2]], "float32"))
+        b = mx.nd.array(onp.array([[1, 1, 3, 3], [0, 0, 2, 2],
+                                   [5, 5, 6, 6]], "float32"))
+        got = mx.nd.contrib.box_iou(a, b).asnumpy()
+        onp.testing.assert_allclose(got, [[1 / 7, 1.0, 0.0]], rtol=1e-5)
+
+    def test_box_iou_center_format(self):
+        a = mx.nd.array(onp.array([[1, 1, 2, 2]], "float32"))  # ctr 1,1 2x2
+        b = mx.nd.array(onp.array([[0, 0, 2, 2]], "float32"))  # corners
+        got_center = mx.nd.contrib.box_iou(a, a, format="center").asnumpy()
+        onp.testing.assert_allclose(got_center, [[1.0]], rtol=1e-6)
+
+    def test_box_nms_suppresses(self):
+        # rows: [cls, score, x1, y1, x2, y2]
+        rows = onp.array([
+            [0, 0.9, 0.0, 0.0, 1.0, 1.0],
+            [0, 0.8, 0.05, 0.05, 1.0, 1.0],   # overlaps #0 -> suppressed
+            [0, 0.7, 2.0, 2.0, 3.0, 3.0],     # far away -> kept
+            [1, 0.6, 0.0, 0.0, 1.0, 1.0],     # other class -> kept
+            [0, 0.0, 0.0, 0.0, 1.0, 1.0],     # below valid_thresh
+        ], "float32")
+        got = mx.nd.contrib.box_nms(
+            mx.nd.array(rows), overlap_thresh=0.5, valid_thresh=0.01,
+            coord_start=2, score_index=1, id_index=0).asnumpy()
+        scores = got[:, 1]
+        kept = scores[scores >= 0]
+        onp.testing.assert_allclose(sorted(kept, reverse=True),
+                                    [0.9, 0.7, 0.6], rtol=1e-6)
+
+    def test_box_nms_force_suppress_and_batch(self):
+        rows = onp.array([
+            [0, 0.9, 0.0, 0.0, 1.0, 1.0],
+            [1, 0.8, 0.0, 0.0, 1.0, 1.0],
+        ], "float32")
+        batch = onp.stack([rows, rows])
+        got = mx.nd.contrib.box_nms(
+            mx.nd.array(batch), overlap_thresh=0.5, valid_thresh=0.01,
+            coord_start=2, score_index=1, id_index=0,
+            force_suppress=True).asnumpy()
+        assert got.shape == batch.shape
+        for b in range(2):
+            kept = got[b][got[b][:, 1] >= 0]
+            assert len(kept) == 1 and kept[0, 1] == pytest.approx(0.9)
+
+
+class TestSamplers:
+    def test_bilinear_sampler_identity(self):
+        rs = onp.random.RandomState(0)
+        data = rs.rand(2, 3, 5, 7).astype("float32")
+        ys, xs = onp.meshgrid(onp.linspace(-1, 1, 5),
+                              onp.linspace(-1, 1, 7), indexing="ij")
+        grid = onp.stack([xs, ys])[None].repeat(2, axis=0).astype("float32")
+        got = mx.nd.BilinearSampler(mx.nd.array(data),
+                                    mx.nd.array(grid)).asnumpy()
+        onp.testing.assert_allclose(got, data, rtol=1e-5, atol=1e-5)
+
+    def test_bilinear_sampler_outside_zero(self):
+        data = mx.nd.ones((1, 1, 4, 4))
+        grid = mx.nd.array(onp.full((1, 2, 1, 1), -5.0, "float32"))
+        got = mx.nd.BilinearSampler(data, grid).asnumpy()
+        onp.testing.assert_allclose(got, onp.zeros((1, 1, 1, 1)))
+
+    def test_spatial_transformer_identity(self):
+        rs = onp.random.RandomState(1)
+        data = rs.rand(2, 3, 6, 6).astype("float32")
+        theta = onp.tile(onp.array([1, 0, 0, 0, 1, 0], "float32"), (2, 1))
+        got = mx.nd.SpatialTransformer(
+            mx.nd.array(data), mx.nd.array(theta),
+            target_shape=(6, 6)).asnumpy()
+        onp.testing.assert_allclose(got, data, rtol=1e-5, atol=1e-5)
+
+    def test_spatial_transformer_translate(self):
+        # shift right by one pixel-step in normalized coords
+        data = onp.zeros((1, 1, 1, 5), "float32")
+        data[0, 0, 0] = onp.arange(5)
+        theta = onp.array([[1, 0, 0.5, 0, 1, 0]], "float32")
+        got = mx.nd.SpatialTransformer(
+            mx.nd.array(data), mx.nd.array(theta),
+            target_shape=(1, 5)).asnumpy()
+        # x' = x + 0.5 in [-1,1] coords = +1 source pixel at 5 wide
+        onp.testing.assert_allclose(got[0, 0, 0, :3],
+                                    [1.0, 2.0, 3.0], rtol=1e-5)
+
+
+def test_box_nms_out_format_center():
+    """Regression: out_format='center' must actually convert kept rows
+    while suppressed rows stay all -1."""
+    rows = onp.array([
+        [0, 0.9, 0.0, 0.0, 1.0, 1.0],
+        [0, 0.8, 0.0, 0.0, 1.0, 1.0],     # suppressed duplicate
+    ], "float32")
+    got = mx.nd.contrib.box_nms(
+        mx.nd.array(rows), overlap_thresh=0.5, valid_thresh=0.01,
+        coord_start=2, score_index=1, id_index=0,
+        out_format="center").asnumpy()
+    onp.testing.assert_allclose(got[0, 2:6], [0.5, 0.5, 1.0, 1.0],
+                                rtol=1e-6)
+    assert (got[1] == -1).all()

@@ -28,7 +28,7 @@ def measure(size_mb=64.0, n_devices=None, iters=20, dtype="float32"):
     from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 
     devs = jax.devices()
-    n = int(n_devices or len(devs))
+    n = min(int(n_devices or len(devs)), len(devs))
     devs = devs[:n]
     if n < 2:
         raise SystemExit("allreduce needs >= 2 devices "
