@@ -80,7 +80,7 @@ def index_copy(old_tensor, index_vector, new_tensor):
     return old_tensor.at[index_vector.astype(jnp.int32)].set(new_tensor)
 
 
-@register("_contrib_index_array")
+@register("_contrib_index_array", aliases=["index_array"])
 def index_array(data, *, axes=None):
     shape = data.shape
     axes_ = tuple(axes) if axes else tuple(range(len(shape)))

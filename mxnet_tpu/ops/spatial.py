@@ -192,3 +192,67 @@ def spatial_transformer(data, loc, *, target_shape,
     grid = jnp.einsum("bij,jk->bik", theta, coords)            # (B, 2, N)
     grid = grid.reshape(b, 2, ho, wo)
     return bilinear_sampler(data, grid)
+
+
+@register("ravel_multi_index")
+def ravel_multi_index(data, *, shape):
+    """Inverse of unravel_index: (ndim, N) indices -> flat (N,)."""
+    dims = tuple(int(s) for s in shape)
+    idx = [data[i].astype(jnp.int64) for i in range(len(dims))]
+    return jnp.ravel_multi_index(idx, dims, mode="clip")
+
+
+@register("all_finite")
+def all_finite(data, *, init_output=True):
+    return multi_all_finite(data, num_arrays=1, init_output=init_output)
+
+
+@register("moments", num_outputs=2)
+def moments(data, *, axes=None, keepdims=False):
+    """(mean, variance) over ``axes`` (reference: moments.cc)."""
+    ax = tuple(axes) if axes is not None else None
+    mean = jnp.mean(data, axis=ax, keepdims=True)
+    var = jnp.mean((data - mean) ** 2, axis=ax, keepdims=keepdims)
+    if not keepdims:
+        mean = jnp.squeeze(mean, axis=ax) if ax is not None \
+            else jnp.squeeze(mean)
+    return mean, var
+
+
+@register("digamma")
+def digamma(data):
+    return jax.scipy.special.digamma(data)
+
+
+def _logical(fn):
+    def op(lhs, rhs):
+        # result follows the input dtype (reference elemwise logical ops;
+        # matches broadcast_logical_* in elemwise.py)
+        return fn(lhs.astype(bool), rhs.astype(bool)).astype(
+            jnp.result_type(lhs))
+    return op
+
+
+for _n, _f in [("logical_and", jnp.logical_and),
+               ("logical_or", jnp.logical_or),
+               ("logical_xor", jnp.logical_xor)]:
+    register(_n)(_logical(_f))
+
+
+@register("SoftmaxActivation")
+def softmax_activation(data, *, mode="instance"):
+    """reference: softmax_activation.cc — softmax over the channel dim
+    ('channel' mode) or over all non-batch dims flattened ('instance')."""
+    if mode == "channel":
+        return jax.nn.softmax(data, axis=1)
+    flat = data.reshape(data.shape[0], -1)
+    return jax.nn.softmax(flat, axis=-1).reshape(data.shape)
+
+
+@register("SVMOutput")
+def svm_output(data, label, *, margin=1.0, regularization_coefficient=1.0,
+               use_linear=False, _training=False):
+    """reference: svm_output.cc — forward is identity (scores); the hinge
+    gradient lives in the loss wiring, matching the reference's
+    inference-output contract."""
+    return data

@@ -39,6 +39,11 @@ def _block_sizes(lq, lk):
     is the VMEM comfort cap: the f32 score tile is bq*bk*4 = 1 MB.
     """
     try:
+        # NOTE: an isolated-attention microbench prefers bq=256 at seq 512
+        # (~20% on the kernel alone), but the END-TO-END BERT step is
+        # consistently FASTER with 512x512 (197-199 vs 182-191 samples/s)
+        # — in-context VMEM pressure and step pipelining differ; trust the
+        # end-to-end number
         bq = next(b for b in (MAX_BLOCK, 256, 128) if lq % b == 0)
         bk = next(b for b in (MAX_BLOCK, 256, 128) if lk % b == 0)
     except StopIteration:
@@ -183,12 +188,17 @@ def _fwd_kernel(q_ref, k_ref, v_ref, o_ref, lse_ref, acc_ref, m_ref, l_ref,
     qi = pl.program_id(1)
 
     def compute():
-        q = q_ref[...].astype(jnp.float32) * scale         # (BQ, D)
-        k = k_ref[...].astype(jnp.float32)                 # (BK, D)
-        v = v_ref[...].astype(jnp.float32)
+        # operands stay in the INPUT dtype: casting bf16 to f32 before
+        # the dot forces multi-pass f32 MXU matmuls — the bf16 native
+        # single-pass with f32 accumulate is the whole fast path. The
+        # scale moves onto the f32 scores (exact there).
+        q = q_ref[...]                                     # (BQ, D)
+        k = k_ref[...]                                     # (BK, D)
+        v = v_ref[...]
         s = jax.lax.dot_general(
             q, k, (((1,), (1,)), ((), ())),
-            preferred_element_type=jnp.float32, precision=prec)  # (BQ, BK)
+            preferred_element_type=jnp.float32,
+            precision=prec) * scale                        # (BQ, BK) f32
         if causal:
             # bottom-right alignment: offset = lk - lq
             q_pos = causal_offset + qi * bq + jax.lax.broadcasted_iota(
@@ -203,7 +213,8 @@ def _fwd_kernel(q_ref, k_ref, v_ref, o_ref, lse_ref, acc_ref, m_ref, l_ref,
         l_ref[:] = l_ref[:] * alpha + jnp.broadcast_to(
             jnp.sum(p, axis=-1, keepdims=True), l_ref.shape)
         acc_ref[:] = acc_ref[:] * alpha + jnp.dot(
-            p, v, preferred_element_type=jnp.float32, precision=prec)
+            p.astype(v.dtype), v, preferred_element_type=jnp.float32,
+            precision=prec)
         m_ref[:] = jnp.broadcast_to(m_new, m_ref.shape)
 
     if causal:
@@ -329,10 +340,12 @@ def _bwd_dkdv_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref,
         dv_acc[:] = jnp.zeros_like(dv_acc)
 
     def compute():
-        q = q_ref[...].astype(jnp.float32)                 # (BQ, D)
-        k = k_ref[...].astype(jnp.float32)                 # (BK, D)
-        v = v_ref[...].astype(jnp.float32)
-        do = do_ref[...].astype(jnp.float32)               # (BQ, D)
+        # native-dtype MXU operands (see _fwd_kernel note); f32
+        # intermediates (p, ds) cast down before their dots
+        q = q_ref[...]                                     # (BQ, D)
+        k = k_ref[...]                                     # (BK, D)
+        v = v_ref[...]
+        do = do_ref[...]                                   # (BQ, D)
         lse = lse_ref[0:1, :]                               # (1, BQ)
         delta = delta_ref[0:1, :]                           # (1, BQ)
         s_t = jax.lax.dot_general(
@@ -345,13 +358,15 @@ def _bwd_dkdv_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref,
                 jnp.int32, (bk, bq), 0)
             s_t = jnp.where(k_pos <= q_pos, s_t, _NEG_INF32)
         p_t = jnp.exp(s_t - lse)                            # (BK, BQ)
-        dv_acc[:] += jnp.dot(p_t, do, preferred_element_type=jnp.float32,
+        dv_acc[:] += jnp.dot(p_t.astype(do.dtype), do,
+                             preferred_element_type=jnp.float32,
                              precision=prec)
         dp_t = jax.lax.dot_general(
             v, do, (((1,), (1,)), ((), ())),
             preferred_element_type=jnp.float32, precision=prec)  # (BK, BQ)
         ds_t = p_t * (dp_t - delta) * scale
-        dk_acc[:] += jnp.dot(ds_t, q, preferred_element_type=jnp.float32,
+        dk_acc[:] += jnp.dot(ds_t.astype(q.dtype), q,
+                             preferred_element_type=jnp.float32,
                              precision=prec)
 
     if causal:
@@ -381,10 +396,10 @@ def _bwd_dq_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref,
         dq_acc[:] = jnp.zeros_like(dq_acc)
 
     def compute():
-        q = q_ref[...].astype(jnp.float32)
-        k = k_ref[...].astype(jnp.float32)
-        v = v_ref[...].astype(jnp.float32)
-        do = do_ref[...].astype(jnp.float32)
+        q = q_ref[...]
+        k = k_ref[...]
+        v = v_ref[...]
+        do = do_ref[...]
         lse = lse_ref[0:1, :]                               # (1, BQ)
         delta = delta_ref[0:1, :]                           # (1, BQ)
         s_t = jax.lax.dot_general(
@@ -400,10 +415,10 @@ def _bwd_dq_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref,
         dp_t = jax.lax.dot_general(
             v, do, (((1,), (1,)), ((), ())),
             preferred_element_type=jnp.float32, precision=prec)
-        ds_t = p_t * (dp_t - delta) * scale                 # (BK, BQ)
+        ds_t = (p_t * (dp_t - delta) * scale)               # (BK, BQ)
         # dq = ds @ k = ds_t^T @ k : contract the BK dim of both
         dq_acc[:] += jax.lax.dot_general(
-            ds_t, k, (((0,), (0,)), ((), ())),
+            ds_t.astype(k.dtype), k, (((0,), (0,)), ((), ())),
             preferred_element_type=jnp.float32, precision=prec)  # (BQ, D)
 
     if causal:

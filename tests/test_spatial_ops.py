@@ -128,3 +128,49 @@ def test_box_nms_out_format_center():
     onp.testing.assert_allclose(got[0, 2:6], [0.5, 0.5, 1.0, 1.0],
                                 rtol=1e-6)
     assert (got[1] == -1).all()
+
+
+class TestLongTailOps:
+    def test_moments(self):
+        x = mx.nd.array(onp.arange(6.0).reshape(2, 3))
+        m, v = mx.nd.moments(x, axes=(1,))
+        onp.testing.assert_allclose(m.asnumpy(), [1.0, 4.0])
+        onp.testing.assert_allclose(v.asnumpy(), [2 / 3, 2 / 3], rtol=1e-6)
+        m2, v2 = mx.nd.moments(x, axes=(0, 1), keepdims=True)
+        assert v2.shape == (1, 1)
+
+    def test_ravel_unravel_roundtrip(self):
+        flat = mx.nd.array([5, 11, 0], dtype="int32")
+        multi = mx.nd.unravel_index(flat, shape=(3, 4))
+        back = mx.nd.ravel_multi_index(multi, shape=(3, 4))
+        onp.testing.assert_array_equal(back.asnumpy(), [5, 11, 0])
+
+    def test_index_array(self):
+        out = mx.nd.index_array(mx.nd.ones((2, 3))).asnumpy()
+        assert out.shape == (2, 3, 2)
+        onp.testing.assert_array_equal(out[1, 2], [1, 2])
+
+    def test_logicals(self):
+        a = mx.nd.array([1.0, 0.0, 2.0])
+        b = mx.nd.array([1.0, 1.0, 0.0])
+        onp.testing.assert_array_equal(
+            mx.nd.logical_and(a, b).asnumpy(), [1, 0, 0])
+        onp.testing.assert_array_equal(
+            mx.nd.logical_or(a, b).asnumpy(), [1, 1, 1])
+        onp.testing.assert_array_equal(
+            mx.nd.logical_xor(a, b).asnumpy(), [0, 1, 1])
+
+    def test_softmax_activation_modes(self):
+        x = mx.nd.array(onp.random.RandomState(0).randn(2, 3, 4)
+                        .astype("float32"))
+        inst = mx.nd.SoftmaxActivation(x).asnumpy()
+        onp.testing.assert_allclose(inst.reshape(2, -1).sum(1), [1.0, 1.0],
+                                    rtol=1e-5)
+        chan = mx.nd.SoftmaxActivation(x, mode="channel").asnumpy()
+        onp.testing.assert_allclose(chan.sum(1), onp.ones((2, 4)),
+                                    rtol=1e-5)
+
+    def test_digamma_all_finite(self):
+        g = mx.nd.digamma(mx.nd.array([1.0, 2.0])).asnumpy()
+        onp.testing.assert_allclose(g, [-0.5772157, 0.4227843], rtol=1e-4)
+        assert mx.nd.all_finite(mx.nd.ones((2,))).asnumpy()[0] == 1
