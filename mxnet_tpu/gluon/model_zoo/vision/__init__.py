@@ -25,8 +25,10 @@ from .mobilenet import *  # noqa: F401,F403
 from .resnet import *  # noqa: F401,F403
 from .squeezenet import *  # noqa: F401,F403
 from .vgg import *  # noqa: F401,F403
+from .ssd import SSD, SSDMultiBoxLoss, get_ssd, ssd_toy  # noqa: F401
 
 _models = {
+    "ssd_toy": ssd_toy,
     "resnet18_v1": _resnet.resnet18_v1,
     "resnet34_v1": _resnet.resnet34_v1,
     "resnet50_v1": _resnet.resnet50_v1,

@@ -1,0 +1,148 @@
+"""SSD single-shot detector family (reference capability: the SSD stack —
+``example/ssd`` + GluonCV ``ssd_*`` models — built on the multibox op
+trio ``src/operator/contrib/multibox_{prior,target,detection}.cc``).
+
+TPU-first shape discipline: anchors/predictions are fixed-size per input
+resolution (mask-based padding everywhere), so the whole detector —
+backbone, heads, target assignment, and NMS — jits into single
+executables for both the training step and inference.
+"""
+from __future__ import annotations
+
+from ...block import HybridBlock
+from ... import nn
+from ...loss import Loss
+
+__all__ = ["SSD", "SSDMultiBoxLoss", "get_ssd", "ssd_toy"]
+
+
+def _feature_trunk(base, pretrained_stages=None):
+    """A small downsampling trunk; SSD taps it at several strides."""
+    trunk = nn.HybridSequential(prefix="trunk_")
+    with trunk.name_scope():
+        filters = {"toy": (16, 32, 64), "small": (32, 64, 128)}[base]
+        for f in filters:
+            trunk.add(nn.Conv2D(f, 3, strides=2, padding=1),
+                      nn.BatchNorm(), nn.Activation("relu"))
+    return trunk
+
+
+class SSD(HybridBlock):
+    """Multi-scale SSD head over a trunk (reference: example/ssd
+    symbol_builder + GluonCV model_zoo.ssd.SSD).
+
+    forward(x) -> (anchors (1, N, 4), cls_preds (B, N, C+1),
+    box_preds (B, N*4)); ``detect(x)`` decodes + NMS to (B, N, 6).
+    """
+
+    def __init__(self, num_classes, base="toy", num_scales=3,
+                 sizes=None, ratios=None, nms_threshold=0.45,
+                 nms_topk=400, prefix=None, params=None):
+        super().__init__(prefix=prefix, params=params)
+        self.num_classes = num_classes
+        self.nms_threshold = nms_threshold
+        self.nms_topk = nms_topk
+        if sizes is None:
+            # linearly spaced scales per feature map (SSD paper recipe)
+            sizes = [(0.2 + 0.6 * i / num_scales,
+                      0.2 + 0.6 * (i + 0.5) / num_scales)
+                     for i in range(num_scales)]
+        if ratios is None:
+            ratios = [(1.0, 2.0, 0.5)] * num_scales
+        self._sizes = sizes
+        self._ratios = ratios
+        with self.name_scope():
+            self.trunk = _feature_trunk(base)
+            self.stages = []
+            self.cls_heads = []
+            self.box_heads = []
+            for i in range(num_scales):
+                a = len(sizes[i]) + len(ratios[i]) - 1
+                if i > 0:
+                    stage = nn.HybridSequential(prefix=f"stage{i}_")
+                    with stage.name_scope():
+                        stage.add(nn.Conv2D(64, 3, strides=2, padding=1),
+                                  nn.BatchNorm(), nn.Activation("relu"))
+                    self.register_child(stage, f"stage{i}")
+                    self.stages.append(stage)
+                ch = nn.Conv2D(a * (num_classes + 1), 3, padding=1,
+                               prefix=f"cls{i}_")
+                bh = nn.Conv2D(a * 4, 3, padding=1, prefix=f"box{i}_")
+                self.register_child(ch, f"cls_head{i}")
+                self.register_child(bh, f"box_head{i}")
+                self.cls_heads.append(ch)
+                self.box_heads.append(bh)
+
+    def hybrid_forward(self, F, x):
+        feats = [self.trunk(x)]
+        for stage in self.stages:
+            feats.append(stage(feats[-1]))
+        anchors, cls_preds, box_preds = [], [], []
+        for feat, ch, bh, sz, rt in zip(feats, self.cls_heads,
+                                        self.box_heads, self._sizes,
+                                        self._ratios):
+            anchors.append(F.contrib.MultiBoxPrior(
+                feat, sizes=tuple(sz), ratios=tuple(rt)))
+            # (B, A*(C+1), H, W) -> (B, H*W*A, C+1); reshape code 0 keeps
+            # the batch dim symbolic (export/Symbol trace has no concrete
+            # batch size)
+            cp = F.Reshape(ch(feat).transpose((0, 2, 3, 1)),
+                           shape=(0, -1, self.num_classes + 1))
+            bp = F.Reshape(bh(feat).transpose((0, 2, 3, 1)),
+                           shape=(0, -1))
+            cls_preds.append(cp)
+            box_preds.append(bp)
+        return (F.concat(*anchors, dim=1),
+                F.concat(*cls_preds, dim=1),
+                F.concat(*box_preds, dim=1))
+
+    def targets(self, anchors, labels, cls_preds,
+                negative_mining_ratio=3.0):
+        """MultiBoxTarget with the class-axis layout the op expects."""
+        from .... import ndarray as F
+
+        return F.contrib.MultiBoxTarget(
+            anchors, labels, cls_preds.transpose((0, 2, 1)),
+            negative_mining_ratio=negative_mining_ratio)
+
+    def detect(self, x, threshold=0.01):
+        """Inference: decode + per-class NMS -> (B, N, 6) rows
+        [cls_id, score, x1, y1, x2, y2] (-1 = suppressed)."""
+        from .... import ndarray as F
+
+        anchors, cls_preds, box_preds = self(x)
+        cls_prob = F.softmax(cls_preds, axis=-1).transpose((0, 2, 1))
+        return F.contrib.MultiBoxDetection(
+            cls_prob, box_preds, anchors, threshold=threshold,
+            nms_threshold=self.nms_threshold, nms_topk=self.nms_topk)
+
+
+class SSDMultiBoxLoss(Loss):
+    """Classification CE (with hard-negative-mined targets) + smooth-L1
+    localization (reference: GluonCV SSDMultiBoxLoss)."""
+
+    def __init__(self, lambd=1.0, weight=None, batch_axis=0, **kwargs):
+        super().__init__(weight, batch_axis, **kwargs)
+        self._lambd = lambd
+
+    def hybrid_forward(self, F, cls_preds, loc_preds, cls_target,
+                       loc_target, loc_mask):
+        # cls: ignore_label rows (-1) are masked out
+        valid = cls_target >= 0
+        logp = F.log_softmax(cls_preds, axis=-1)
+        picked = F.pick(logp, F.maximum(cls_target, 0), axis=-1)
+        n_pos = F.maximum(F.sum(cls_target > 0), 1.0)
+        cls_loss = -F.sum(F.where(valid, picked,
+                                  F.zeros_like(picked))) / n_pos
+        loc_loss = F.sum(F.smooth_l1(
+            (loc_preds - loc_target) * loc_mask, scalar=1.0)) / n_pos
+        return cls_loss + self._lambd * loc_loss
+
+
+def get_ssd(num_classes, base="toy", **kwargs):
+    return SSD(num_classes, base=base, **kwargs)
+
+
+def ssd_toy(num_classes=4, **kwargs):
+    """Test-sized SSD (CI / examples)."""
+    return SSD(num_classes, base="toy", **kwargs)

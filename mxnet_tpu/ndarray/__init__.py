@@ -29,6 +29,7 @@ from ..ops import contrib as _contrib_ops  # noqa: F401
 from ..ops import rnn as _rnn_ops  # noqa: F401
 from ..ops import attention as _attention_ops  # noqa: F401
 from ..ops import spatial as _spatial_ops  # noqa: F401
+from ..ops import multibox as _multibox_ops  # noqa: F401
 
 from .ndarray import NDArray, array, empty, imperative_invoke, waitall, _wrap_jax
 from .serialization import save, load, loads

@@ -1,0 +1,193 @@
+"""SSD multibox ops (reference: ``src/operator/contrib/multibox_prior.cc``,
+``multibox_target.cc``, ``multibox_detection.cc`` — the op trio behind the
+reference's SSD example and GluonCV's SSD family).
+
+All mask-based fixed shapes (XLA-friendly): targets use argmax bipartite
+matching + threshold matching like the reference; detection decodes
+center-variance boxes then routes through the jit-friendly box_nms.
+"""
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+
+from .registry import register
+from .spatial import box_nms as _box_nms
+
+
+@register("_contrib_MultiBoxPrior", aliases=["MultiBoxPrior"])
+def multibox_prior(data, *, sizes=(1.0,), ratios=(1.0,), clip=False,
+                   steps=(-1.0, -1.0), offsets=(0.5, 0.5)):
+    """Anchor boxes for one feature map (reference: multibox_prior.cc).
+
+    data: (B, C, H, W). Returns (1, H*W*A, 4) corner boxes in [0, 1]
+    units with A = len(sizes) + len(ratios) - 1 (first size pairs with
+    every ratio; remaining sizes use ratio 1 — the reference's layout).
+    """
+    h, w = data.shape[2], data.shape[3]
+    sizes = tuple(float(s) for s in sizes)
+    ratios = tuple(float(r) for r in ratios)
+    step_y = steps[0] if steps[0] > 0 else 1.0 / h
+    step_x = steps[1] if steps[1] > 0 else 1.0 / w
+    cy = (jnp.arange(h, dtype=jnp.float32) + offsets[0]) * step_y
+    cx = (jnp.arange(w, dtype=jnp.float32) + offsets[1]) * step_x
+    cyx = jnp.stack(jnp.meshgrid(cy, cx, indexing="ij"), axis=-1)  # (H,W,2)
+
+    # reference emission order (multibox_prior.cc): every size with the
+    # FIRST ratio, then the first size with each remaining ratio
+    wh = []
+    r0 = float(ratios[0]) ** 0.5
+    for s in sizes:
+        wh.append((s * r0, s / r0))
+    for r in ratios[1:]:
+        sr = float(r) ** 0.5
+        wh.append((sizes[0] * sr, sizes[0] / sr))
+    wh = jnp.asarray(wh, jnp.float32)                    # (A, 2) = (w, h)
+
+    a = wh.shape[0]
+    centers = jnp.broadcast_to(cyx[:, :, None, :], (h, w, a, 2))
+    half_w = wh[None, None, :, 0] / 2
+    half_h = wh[None, None, :, 1] / 2
+    boxes = jnp.stack([
+        centers[..., 1] - half_w, centers[..., 0] - half_h,
+        centers[..., 1] + half_w, centers[..., 0] + half_h], axis=-1)
+    boxes = boxes.reshape(1, h * w * a, 4)
+    if clip:
+        boxes = jnp.clip(boxes, 0.0, 1.0)
+    return boxes
+
+
+def _corners_to_center(b):
+    return jnp.stack([(b[..., 0] + b[..., 2]) / 2,
+                      (b[..., 1] + b[..., 3]) / 2,
+                      jnp.clip(b[..., 2] - b[..., 0], 1e-12),
+                      jnp.clip(b[..., 3] - b[..., 1], 1e-12)], axis=-1)
+
+
+from .spatial import _corner_iou as _iou_corner  # noqa: E402  (shared math)
+
+
+@register("_contrib_MultiBoxTarget", aliases=["MultiBoxTarget"],
+          num_outputs=3)
+def multibox_target(anchor, label, cls_pred, *, overlap_threshold=0.5,
+                    ignore_label=-1.0, negative_mining_ratio=-1.0,
+                    negative_mining_thresh=0.5, minimum_negative_samples=0,
+                    variances=(0.1, 0.1, 0.2, 0.2)):
+    """Training targets (reference: multibox_target.cc).
+
+    anchor (1, N, 4) corners; label (B, M, 5) [cls, x1, y1, x2, y2] with
+    -1 padding; cls_pred (B, num_cls+1, N) (used for hard negative
+    mining when negative_mining_ratio > 0). Returns
+    (loc_target (B, N*4), loc_mask (B, N*4), cls_target (B, N)) with
+    cls_target 0 = background, k+1 = object class k.
+    """
+    anchors = anchor.reshape(-1, 4).astype(jnp.float32)  # (N, 4)
+    n = anchors.shape[0]
+    var = jnp.asarray(variances, jnp.float32)
+    a_ctr = _corners_to_center(anchors)
+
+    def one(lab, cp):
+        valid = lab[:, 0] >= 0                            # (M,)
+        gt = lab[:, 1:5]
+        iou = _iou_corner(anchors, gt)                    # (N, M)
+        iou = jnp.where(valid[None, :], iou, -1.0)
+        best_gt = jnp.argmax(iou, axis=1)                 # (N,)
+        best_iou = jnp.max(iou, axis=1)
+        # iterative bipartite matching (reference multibox_target.cc):
+        # repeatedly claim the GLOBAL best (anchor, gt) pair and retire
+        # both, so gts sharing a best anchor each still get one — a
+        # single-shot argmax scatter would drop the loser
+        m_gt = lab.shape[0]
+
+        def bi_step(carry, _):
+            iou_c, claim = carry
+            flat = jnp.argmax(iou_c)
+            ai = (flat // m_gt).astype(jnp.int32)
+            gj = (flat % m_gt).astype(jnp.int32)
+            ok = iou_c[ai, gj] > 0
+            claim = claim.at[ai].set(jnp.where(ok, gj, claim[ai]))
+            iou_c = jnp.where(ok, iou_c.at[ai, :].set(-jnp.inf), iou_c)
+            iou_c = jnp.where(ok, iou_c.at[:, gj].set(-jnp.inf), iou_c)
+            return (iou_c, claim), None
+
+        masked = jnp.where(valid[None, :], iou, -jnp.inf)
+        (_, claim), _ = jax.lax.scan(
+            bi_step, (masked, jnp.full(n, -1, jnp.int32)), None,
+            length=m_gt)
+        forced = claim >= 0
+        matched = jnp.logical_or(forced, best_iou >= overlap_threshold)
+        gt_idx = jnp.where(forced, claim, best_gt)
+        g = gt[gt_idx]                                    # (N, 4)
+        g_ctr = _corners_to_center(g)
+        loc_t = jnp.stack([
+            (g_ctr[:, 0] - a_ctr[:, 0]) / a_ctr[:, 2] / var[0],
+            (g_ctr[:, 1] - a_ctr[:, 1]) / a_ctr[:, 3] / var[1],
+            jnp.log(g_ctr[:, 2] / a_ctr[:, 2]) / var[2],
+            jnp.log(g_ctr[:, 3] / a_ctr[:, 3]) / var[3]], axis=-1)
+        loc_t = jnp.where(matched[:, None], loc_t, 0.0).reshape(-1)
+        loc_m = jnp.where(matched[:, None],
+                          jnp.ones((n, 4), jnp.float32), 0.0).reshape(-1)
+        cls_t = jnp.where(matched, lab[gt_idx, 0] + 1.0, 0.0)
+        if negative_mining_ratio > 0:
+            # hard negatives: keep the top-k background anchors by
+            # background NEGATIVE-confidence (1 - p_bg proxy via max
+            # non-bg logit), others -> ignore_label
+            bg_score = cp[0]                              # (N,)
+            hardness = jnp.where(matched, -jnp.inf, -bg_score)
+            k = jnp.maximum(
+                (matched.sum() * negative_mining_ratio).astype(jnp.int32),
+                jnp.int32(minimum_negative_samples))
+            order = jnp.argsort(-hardness)
+            rank = jnp.zeros(n, jnp.int32).at[order].set(
+                jnp.arange(n, dtype=jnp.int32))
+            keep_neg = jnp.logical_and(~matched, rank < k)
+            cls_t = jnp.where(jnp.logical_or(matched, keep_neg), cls_t,
+                              jnp.float32(ignore_label))
+        return loc_t, loc_m, cls_t
+
+    return jax.vmap(one)(label.astype(jnp.float32),
+                         cls_pred.astype(jnp.float32))
+
+
+@register("_contrib_MultiBoxDetection", aliases=["MultiBoxDetection"])
+def multibox_detection(cls_prob, loc_pred, anchor, *, clip=True,
+                       threshold=0.01, background_id=0, nms_threshold=0.5,
+                       force_suppress=False,
+                       variances=(0.1, 0.1, 0.2, 0.2), nms_topk=-1):
+    """Decode + NMS (reference: multibox_detection.cc).
+
+    cls_prob (B, num_cls+1, N); loc_pred (B, N*4); anchor (1, N, 4).
+    Returns (B, N, 6) rows [cls_id, score, x1, y1, x2, y2], -1-filled for
+    suppressed/background.
+    """
+    anchors = anchor.reshape(-1, 4).astype(jnp.float32)
+    a_ctr = _corners_to_center(anchors)
+    var = jnp.asarray(variances, jnp.float32)
+
+    def one(cp, lp):
+        n = anchors.shape[0]
+        delta = lp.reshape(n, 4)
+        cx = a_ctr[:, 0] + delta[:, 0] * var[0] * a_ctr[:, 2]
+        cy = a_ctr[:, 1] + delta[:, 1] * var[1] * a_ctr[:, 3]
+        bw = a_ctr[:, 2] * jnp.exp(delta[:, 2] * var[2])
+        bh = a_ctr[:, 3] * jnp.exp(delta[:, 3] * var[3])
+        boxes = jnp.stack([cx - bw / 2, cy - bh / 2,
+                           cx + bw / 2, cy + bh / 2], axis=-1)
+        if clip:
+            boxes = jnp.clip(boxes, 0.0, 1.0)
+        # best non-background class per anchor (the reference's layout)
+        fg = jnp.delete(cp, background_id, axis=0,
+                        assume_unique_indices=True)        # (C, N)
+        cls_id = jnp.argmax(fg, axis=0).astype(jnp.float32)
+        score = jnp.max(fg, axis=0)
+        keep = score > threshold
+        rows = jnp.concatenate([
+            jnp.where(keep, cls_id, -1.0)[:, None],
+            jnp.where(keep, score, -1.0)[:, None], boxes], axis=-1)
+        return _box_nms(rows, overlap_thresh=nms_threshold,
+                        valid_thresh=max(threshold, 0.0), topk=nms_topk,
+                        coord_start=2, score_index=1, id_index=0,
+                        force_suppress=force_suppress)
+
+    return jax.vmap(one)(cls_prob.astype(jnp.float32),
+                         loc_pred.astype(jnp.float32))

@@ -251,8 +251,34 @@ def softmax_activation(data, *, mode="instance"):
 
 @register("SVMOutput")
 def svm_output(data, label, *, margin=1.0, regularization_coefficient=1.0,
-               use_linear=False, _training=False):
-    """reference: svm_output.cc — forward is identity (scores); the hinge
-    gradient lives in the loss wiring, matching the reference's
-    inference-output contract."""
-    return data
+               use_linear=False):
+    """reference: svm_output.cc — identity forward (scores), hinge-loss
+    backward (L1 with use_linear, else squared hinge), a loss-layer grad
+    like SoftmaxOutput's (the incoming cotangent is ignored)."""
+    reg = float(regularization_coefficient)
+    m = float(margin)
+
+    @jax.custom_vjp
+    def _svm(x, lab):
+        return x
+
+    def fwd(x, lab):
+        return x, (x, lab)
+
+    def bwd(res, g):
+        x, lab = res
+        li = lab.astype(jnp.int32)
+        c = x.shape[-1]
+        onehot = jax.nn.one_hot(li, c, dtype=x.dtype)
+        score_l = jnp.take_along_axis(x, li[..., None], axis=-1)
+        dist = x - score_l + m                      # margin violation
+        viol = jnp.logical_and(dist > 0, onehot == 0)
+        if use_linear:
+            gj = jnp.where(viol, reg, 0.0)
+        else:
+            gj = jnp.where(viol, 2.0 * reg * dist, 0.0)
+        grad = gj - onehot * jnp.sum(gj, axis=-1, keepdims=True)
+        return grad.astype(x.dtype), jnp.zeros_like(lab)
+
+    _svm.defvjp(fwd, bwd)
+    return _svm(data, label)
