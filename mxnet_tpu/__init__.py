@@ -88,6 +88,8 @@ from . import amp
 from . import library
 from . import subgraph
 from . import storage
+from . import visualization
+from . import visualization as viz
 
 from .ndarray import NDArray
 from .optimizer import Optimizer

@@ -136,7 +136,10 @@ class SSDMultiBoxLoss(Loss):
                                   F.zeros_like(picked))) / n_pos
         loc_loss = F.sum(F.smooth_l1(
             (loc_preds - loc_target) * loc_mask, scalar=1.0)) / n_pos
-        return cls_loss + self._lambd * loc_loss
+        total = cls_loss + self._lambd * loc_loss
+        if self._weight is not None:
+            total = total * self._weight
+        return total
 
 
 def get_ssd(num_classes, base="toy", **kwargs):

@@ -1,0 +1,97 @@
+"""``gluon.utils`` (reference: ``python/mxnet/gluon/utils.py`` ::
+``split_data``/``split_and_load``/``clip_global_norm``/``check_sha1``/
+``download``)."""
+from __future__ import annotations
+
+import hashlib
+import math
+
+from ..base import MXNetError
+from ..ndarray import NDArray
+
+__all__ = ["split_data", "split_and_load", "clip_global_norm",
+           "check_sha1", "download"]
+
+
+def split_data(data, num_slice, batch_axis=0, even_split=True):
+    """Slice a batch along ``batch_axis`` into ``num_slice`` pieces
+    (reference: utils.py::split_data)."""
+    size = data.shape[batch_axis]
+    if even_split and size % num_slice:
+        raise MXNetError(
+            f"cannot evenly split axis {batch_axis} of size {size} into "
+            f"{num_slice} slices (set even_split=False)")
+    if num_slice == 1:
+        return [data]
+    if size < num_slice:
+        raise MXNetError(
+            f"axis {batch_axis} of size {size} is smaller than "
+            f"num_slice {num_slice}")
+    # ALWAYS exactly num_slice slices (reference contract): the last
+    # slice absorbs the remainder under even_split=False
+    step = size // num_slice
+    slices = []
+    for i in range(num_slice):
+        lo = i * step
+        hi = (i + 1) * step if i < num_slice - 1 else size
+        idx = [slice(None)] * data.ndim
+        idx[batch_axis] = slice(lo, hi)
+        slices.append(data[tuple(idx)])
+    return slices
+
+
+def split_and_load(data, ctx_list, batch_axis=0, even_split=True):
+    """Split a batch and load each slice onto a context (reference:
+    utils.py::split_and_load — the classic multi-device data feed)."""
+    from ..ndarray import array as nd_array
+
+    if not isinstance(data, NDArray):
+        data = nd_array(data)
+    if len(ctx_list) == 1:
+        return [data.as_in_context(ctx_list[0])]
+    slices = split_data(data, len(ctx_list), batch_axis, even_split)
+    return [s.as_in_context(ctx) for s, ctx in zip(slices, ctx_list)]
+
+
+def clip_global_norm(arrays, max_norm, check_isfinite=True):
+    """Rescale gradients so their GLOBAL L2 norm is <= max_norm
+    (reference: utils.py::clip_global_norm). Returns the norm."""
+    if not arrays:
+        raise MXNetError("clip_global_norm: empty array list")
+    total = 0.0
+    for a in arrays:
+        v = a.asnumpy().astype("float64")
+        total += float((v * v).sum())
+    norm = math.sqrt(total)
+    if check_isfinite and not math.isfinite(norm):
+        import warnings
+
+        warnings.warn("nan or inf is detected. Clipping results will be "
+                      "undefined.", stacklevel=2)
+    scale = max_norm / (norm + 1e-8)
+    if scale < 1.0:
+        for a in arrays:
+            a *= scale
+    return norm
+
+
+def check_sha1(filename, sha1_hash):
+    """True iff the file's sha1 matches (reference: utils.py::check_sha1)."""
+    sha1 = hashlib.sha1()
+    with open(filename, "rb") as f:
+        while True:
+            chunk = f.read(1 << 20)
+            if not chunk:
+                break
+            sha1.update(chunk)
+    return sha1.hexdigest() == sha1_hash
+
+
+def download(url, path=None, overwrite=False, sha1_hash=None,
+             retries=5, verify_ssl=True):
+    """Offline environment: downloads are unavailable — raises with
+    guidance (reference surface: utils.py::download)."""
+    raise MXNetError(
+        f"download({url!r}): this environment has no network egress. "
+        "Place the file locally and pass its path to the consuming API "
+        "(e.g. CustomEmbedding, ImageRecordIter).")

@@ -131,9 +131,14 @@ def multibox_target(anchor, label, cls_pred, *, overlap_threshold=0.5,
         if negative_mining_ratio > 0:
             # hard negatives: keep the top-k background anchors by
             # background NEGATIVE-confidence (1 - p_bg proxy via max
-            # non-bg logit), others -> ignore_label
+            # non-bg logit), others -> ignore_label. Near-positives
+            # (IoU >= negative_mining_thresh but below the match
+            # threshold) are excluded from mining — the reference
+            # ignores them rather than training them as background
             bg_score = cp[0]                              # (N,)
-            hardness = jnp.where(matched, -jnp.inf, -bg_score)
+            excluded = jnp.logical_or(
+                matched, best_iou >= negative_mining_thresh)
+            hardness = jnp.where(excluded, -jnp.inf, -bg_score)
             k = jnp.maximum(
                 (matched.sum() * negative_mining_ratio).astype(jnp.int32),
                 jnp.int32(minimum_negative_samples))

@@ -1,0 +1,126 @@
+"""``mx.viz`` — network visualization (reference:
+``python/mxnet/visualization.py`` :: ``print_summary``/``plot_network``)."""
+from __future__ import annotations
+
+import json
+
+from .base import MXNetError
+
+__all__ = ["print_summary", "plot_network"]
+
+
+def print_summary(symbol, shape=None, line_length=120, positions=None):
+    """Layer-by-layer table of a Symbol graph with parameter counts
+    (reference: visualization.py::print_summary). Returns the text (and
+    prints it, like the reference)."""
+    from .symbol.symbol import Symbol
+
+    if not isinstance(symbol, Symbol):
+        raise MXNetError("print_summary expects a Symbol")
+    arg_shapes = {}
+    out_shapes = {}
+    if shape is not None:
+        try:
+            args, _outs, auxs = symbol.infer_shape(**shape)
+            names = symbol.list_arguments()
+            arg_shapes = dict(zip(names, args))
+            aux_names = symbol.list_auxiliary_states()
+            arg_shapes.update(zip(aux_names, auxs))
+        except Exception:
+            pass
+        try:
+            internals = symbol.get_internals()
+            _a, int_outs, _x = internals.infer_shape(**shape)
+            for (node, _oi), s in zip(internals._entries, int_outs):
+                out_shapes[node.name] = tuple(s)
+        except Exception:
+            pass
+    positions = positions or [0.44, 0.64, 0.74, 1.0]
+    cols = [int(line_length * p) for p in positions]
+    header = ["Layer (type)", "Output Shape", "Param #", "Previous Layer"]
+
+    def row(fields):
+        line = ""
+        for f, c in zip(fields, cols):
+            line = (line + str(f))[:c - 1].ljust(c)
+        return line.rstrip()
+
+    lines = ["_" * line_length, row(header), "=" * line_length]
+    graph = json.loads(symbol.tojson())
+    nodes = graph["nodes"]
+    total = 0
+    for node in nodes:
+        if node["op"] == "null":
+            continue
+        name = node["name"]
+        inputs = [nodes[i[0]]["name"] for i in node["inputs"]]
+        data_names = set(shape or ())
+        nparams = 0
+        for i in node["inputs"]:
+            parent = nodes[i[0]]
+            if parent["op"] == "null" and parent["name"] in arg_shapes \
+                    and parent["name"] not in data_names:
+                n = 1
+                for d in arg_shapes[parent["name"]]:
+                    n *= int(d)
+                nparams += n
+        total += nparams
+        prev = [nodes[i[0]]["name"] for i in node["inputs"]
+                if nodes[i[0]]["op"] != "null"]
+        lines.append(row([f"{name} ({node['op']})",
+                          out_shapes.get(name, ""), nparams,
+                          ", ".join(prev[:2])]))
+    lines += ["=" * line_length, f"Total params: {total}",
+              "_" * line_length]
+    text = "\n".join(lines)
+    print(text)
+    return text
+
+
+def plot_network(symbol, title="plot", save_format="pdf", shape=None,
+                 node_attrs=None, hide_weights=True):
+    """Graphviz rendering of a Symbol graph (reference:
+    visualization.py::plot_network). Requires the ``graphviz`` package;
+    raises with guidance when absent (offline image)."""
+    try:
+        from graphviz import Digraph
+    except ImportError as e:
+        raise MXNetError(
+            "plot_network requires the 'graphviz' python package, which "
+            "is not installed in this environment; use print_summary for "
+            "a text view") from e
+    from .symbol.symbol import Symbol
+
+    if not isinstance(symbol, Symbol):
+        raise MXNetError("plot_network expects a Symbol")
+    graph = json.loads(symbol.tojson())
+    nodes = graph["nodes"]
+    # hide PARAMETERS, not inputs: key off standard parameter suffixes
+    # (an input named 'x' must still render), like the reference's
+    # weight-like classification
+    param_suffixes = ("weight", "bias", "gamma", "beta", "moving_mean",
+                      "moving_var", "running_mean", "running_var",
+                      "quant", "scale")
+
+    def is_param(name):
+        return name.endswith(param_suffixes)
+
+    dot = Digraph(name=title, format=save_format)
+    dot.attr("node", **(node_attrs or {"shape": "box"}))
+    for i, node in enumerate(nodes):
+        if node["op"] == "null":
+            if hide_weights and is_param(node["name"]):
+                continue
+            dot.node(str(i), node["name"], shape="oval")
+        else:
+            dot.node(str(i), f"{node['name']}\n{node['op']}")
+    for i, node in enumerate(nodes):
+        if node["op"] == "null":
+            continue
+        for inp in node["inputs"]:
+            parent = nodes[inp[0]]
+            if parent["op"] == "null" and hide_weights and \
+                    is_param(parent["name"]):
+                continue
+            dot.edge(str(inp[0]), str(i))
+    return dot

@@ -1,0 +1,92 @@
+"""gluon.utils + mx.viz tests (reference:
+tests/python/unittest/test_gluon_utils.py, test_viz.py)."""
+import numpy as onp
+import pytest
+
+import mxnet_tpu as mx
+from mxnet_tpu.base import MXNetError
+from mxnet_tpu.gluon import utils
+
+
+class TestGluonUtils:
+    def test_split_data_even_and_uneven(self):
+        x = mx.nd.array(onp.arange(12.0).reshape(6, 2))
+        parts = utils.split_data(x, 3)
+        assert [p.shape for p in parts] == [(2, 2)] * 3
+        onp.testing.assert_allclose(parts[1].asnumpy(),
+                                    [[4, 5], [6, 7]])
+        with pytest.raises(MXNetError, match="evenly"):
+            utils.split_data(x, 4)
+        parts = utils.split_data(x, 4, even_split=False)
+        assert len(parts) == 4                     # ALWAYS num_slice
+        assert sum(p.shape[0] for p in parts) == 6
+        assert parts[-1].shape[0] == 3             # remainder in the last
+
+    def test_split_and_load(self):
+        x = onp.arange(8.0).reshape(4, 2)
+        out = utils.split_and_load(x, [mx.cpu(0), mx.cpu(0)])
+        assert len(out) == 2 and out[0].shape == (2, 2)
+        one = utils.split_and_load(mx.nd.array(x), [mx.cpu(0)])
+        assert one[0].shape == (4, 2)
+
+    def test_clip_global_norm(self):
+        a = mx.nd.array(onp.array([3.0, 0.0], "float32"))
+        b = mx.nd.array(onp.array([0.0, 4.0], "float32"))
+        norm = utils.clip_global_norm([a, b], 1.0)
+        assert norm == pytest.approx(5.0, rel=1e-6)
+        total = onp.concatenate([a.asnumpy(), b.asnumpy()])
+        assert onp.linalg.norm(total) == pytest.approx(1.0, rel=1e-4)
+        # under the limit: untouched
+        c = mx.nd.array(onp.array([0.3], "float32"))
+        utils.clip_global_norm([c], 10.0)
+        onp.testing.assert_allclose(c.asnumpy(), [0.3])
+
+    def test_check_sha1_and_download(self, tmp_path):
+        import hashlib
+
+        f = tmp_path / "x.bin"
+        f.write_bytes(b"hello")
+        good = hashlib.sha1(b"hello").hexdigest()
+        assert utils.check_sha1(str(f), good)
+        assert not utils.check_sha1(str(f), "0" * 40)
+        with pytest.raises(MXNetError, match="no network"):
+            utils.download("http://example.com/x")
+
+
+class TestViz:
+    def test_print_summary_counts_params(self, tmp_path):
+        from mxnet_tpu.gluon import nn
+
+        net = nn.HybridSequential()
+        net.add(nn.Dense(16, activation="relu"), nn.Dense(4))
+        net.initialize()
+        x = mx.nd.ones((2, 8))
+        net.hybridize()
+        net(x)
+        prefix = str(tmp_path / "m")
+        net.export(prefix)
+        sym = mx.sym.load(prefix + "-symbol.json")
+        text = mx.viz.print_summary(sym, shape={"data": (2, 8)})
+        assert "FullyConnected" in text
+        # 8*16+16 + 16*4+4 = 212
+        assert "Total params: 212" in text
+        assert "(2, 16)" in text                  # per-layer output shape
+
+    def test_plot_network_gated(self, tmp_path):
+        from mxnet_tpu.gluon import nn
+
+        net = nn.HybridSequential()
+        net.add(nn.Dense(2))
+        net.initialize()
+        net.hybridize()
+        net(mx.nd.ones((1, 3)))
+        prefix = str(tmp_path / "p")
+        net.export(prefix)
+        sym = mx.sym.load(prefix + "-symbol.json")
+        try:
+            import graphviz  # noqa: F401
+            dot = mx.viz.plot_network(sym)
+            assert "fullyconnected" in dot.source.lower()
+        except ImportError:
+            with pytest.raises(MXNetError, match="graphviz"):
+                mx.viz.plot_network(sym)
