@@ -37,6 +37,23 @@ import os as _os
 if not _os.environ.get("JAX_DEFAULT_PRNG_IMPL"):
     _jax.config.update("jax_default_prng_impl", "rbg")
 
+# Persistent XLA compilation cache (reference counterpart: MXNet's op-level
+# autotune caches / CUDA kernel cache). Training-step executables for
+# transformer-sized models take minutes to build; caching them on disk makes
+# the second process start in seconds. MXNET_XLA_CACHE_DIR overrides the
+# location; MXNET_XLA_CACHE=0 disables.
+if _os.environ.get("MXNET_XLA_CACHE", "1") != "0":
+    _cache_dir = _os.environ.get(
+        "MXNET_XLA_CACHE_DIR",
+        _os.path.join(_os.path.expanduser("~"), ".cache", "mxnet_tpu_xla"))
+    try:
+        _os.makedirs(_cache_dir, exist_ok=True)
+        _jax.config.update("jax_compilation_cache_dir", _cache_dir)
+        _jax.config.update("jax_persistent_cache_min_compile_time_secs", 1.0)
+        _jax.config.update("jax_persistent_cache_min_entry_size_bytes", 0)
+    except Exception:  # pragma: no cover - cache is best-effort
+        pass
+
 from . import base
 from .base import MXNetError
 from .context import (

@@ -1,0 +1,174 @@
+"""Per-op device-time breakdown of a compiled step from a jax.profiler trace.
+
+Usage:
+  python tools/trace_ops.py bert   # trace bench_bert's TrainStep
+  python tools/trace_ops.py resnet # trace bench.py's TrainStep
+
+Captures a few steps under jax.profiler.trace, parses the perfetto
+trace.json.gz, and prints device ops aggregated by fusion-name prefix,
+sorted by total time. The methodology behind PERF.md's trace tables.
+"""
+from __future__ import annotations
+
+import collections
+import glob
+import gzip
+import json
+import os
+import re
+import sys
+import tempfile
+
+import numpy as np
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+
+def build_bert_step():
+    import jax
+    import mxnet_tpu as mx
+    from mxnet_tpu import parallel as par
+    from mxnet_tpu.gluon import loss as gloss
+    from mxnet_tpu.gluon.model_zoo.nlp import bert
+
+    batch, seq = 16, 512
+    net = bert.bert_12_768_12(use_decoder=True, use_pooler=False,
+                              use_classifier=False)
+    net.initialize()
+    net.cast("bfloat16")
+    rs = np.random.RandomState(0)
+    tokens = mx.nd.array(rs.randint(0, 30000, (batch, seq)).astype(np.int32))
+    labels = mx.nd.array(rs.randint(0, 30000, (batch, seq)).astype(np.float32))
+
+    class MLMLoss(gloss.SoftmaxCrossEntropyLoss):
+        def hybrid_forward(self, F, pred, label):
+            return super().hybrid_forward(
+                F, pred.reshape(-1, pred.shape[-1]), label.reshape(-1))
+
+    class LossAdapter:
+        def __init__(self):
+            self._l = MLMLoss()
+
+        def __call__(self, outs, label):
+            mlm = outs[1] if isinstance(outs, (list, tuple)) else outs
+            return self._l(mlm, label)
+
+    mesh = par.make_mesh({"dp": 1}, devices=jax.devices()[:1])
+    step = par.TrainStep(net, LossAdapter(), "adam", mesh=mesh,
+                         optimizer_params={"learning_rate": 1e-4,
+                                           "multi_precision": True})
+    return step, (tokens, labels)
+
+
+def build_resnet_step():
+    import jax
+    import mxnet_tpu as mx
+    from mxnet_tpu import parallel as par
+    from mxnet_tpu.gluon import loss as gloss
+    from mxnet_tpu.gluon.model_zoo.vision import resnet50_v1
+
+    batch = 256
+    net = resnet50_v1(classes=1000)
+    net.initialize()
+    net.cast("bfloat16")
+    rs = np.random.RandomState(0)
+    images = mx.nd.array(rs.uniform(-1, 1, (batch, 3, 224, 224)).astype(
+        np.float32)).astype("bfloat16")
+    labels = mx.nd.array(rs.randint(0, 1000, (batch,)).astype(np.float32))
+    mesh = par.make_mesh({"dp": 1}, devices=jax.devices()[:1])
+    step = par.TrainStep(net, gloss.SoftmaxCrossEntropyLoss(), "sgd",
+                         mesh=mesh,
+                         optimizer_params={"learning_rate": 0.1,
+                                           "momentum": 0.9,
+                                           "multi_precision": True})
+    return step, (images, labels)
+
+
+GROUPS = [
+    ("flash_fwd", r"flash|_fwd_kernel"),
+    ("flash_bwd", r"dkdv|_bwd_"),
+    ("fusion", r"^fusion"),
+    ("copy", r"^copy|^bitcast"),
+    ("dot", r"^dot|convolution"),
+    ("custom-call", r"custom-call"),
+    ("transpose", r"transpose"),
+    ("rng", r"rng"),
+]
+
+
+def classify(name):
+    for g, pat in GROUPS:
+        if re.search(pat, name):
+            return g
+    return "other"
+
+
+def main():
+    which = sys.argv[1] if len(sys.argv) > 1 else "bert"
+    topn = int(sys.argv[2]) if len(sys.argv) > 2 else 40
+    import jax
+
+    step, batch = (build_bert_step if which == "bert"
+                   else build_resnet_step)()
+    loss, _ = step(*batch)
+    loss.asnumpy()
+    step.stage_batch(*batch)
+    loss, _ = step(*batch)
+    loss.asnumpy()
+
+    tdir = os.environ.get("TRACE_DIR") or tempfile.mkdtemp(prefix="mxtrace_")
+    nsteps = 3
+    with jax.profiler.trace(tdir):
+        for _ in range(nsteps):
+            loss, _ = step(*batch)
+        loss.asnumpy()
+
+    traces = glob.glob(os.path.join(tdir, "**", "*.trace.json.gz"),
+                       recursive=True)
+    if not traces:
+        print("no trace.json.gz found under", tdir)
+        return 1
+    with gzip.open(sorted(traces)[-1], "rt") as f:
+        data = json.load(f)
+
+    # device-side complete events: pick the pid whose thread names look like
+    # TPU/device lanes ("/device:" or "XLA Op" tracks carry the op names)
+    events = [e for e in data.get("traceEvents", []) if e.get("ph") == "X"]
+    pid_names = {}
+    for e in data.get("traceEvents", []):
+        if e.get("ph") == "M" and e.get("name") == "process_name":
+            pid_names[e["pid"]] = e["args"].get("name", "")
+    dev_pids = {p for p, n in pid_names.items()
+                if "TPU" in n or "/device" in n.lower() or "gpu" in n.lower()}
+    dev_events = [e for e in events if e["pid"] in dev_pids]
+    if not dev_events:
+        # fall back: everything that is not a python/host thread
+        dev_events = events
+
+    per_op = collections.Counter()
+    per_group = collections.Counter()
+    total = 0.0
+    for e in dev_events:
+        name = e.get("name", "?")
+        dur = e.get("dur", 0) / 1e3  # us -> ms
+        # skip obvious host-side module-level events
+        if name.startswith(("jit_", "Thread", "pjit")):
+            continue
+        per_op[name] += dur
+        per_group[classify(name)] += dur
+        total += dur
+
+    print(f"== {which}: {nsteps} steps, device op time total "
+          f"{total:.1f} ms ({total / nsteps:.1f} ms/step) ==")
+    print("-- by group (ms/step) --")
+    for g, t in per_group.most_common():
+        print(f"  {g:12s} {t / nsteps:8.2f}")
+    print(f"-- top {topn} ops (ms/step) --")
+    for name, t in per_op.most_common(topn):
+        print(f"  {t / nsteps:8.3f}  {name[:110]}")
+    print("trace dir:", tdir)
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())
