@@ -149,10 +149,11 @@ class ResNetV1(HybridBlock):
     """ResNet v1 ("Deep Residual Learning for Image Recognition")."""
 
     def __init__(self, block, layers, channels, classes=1000, thumbnail=False,
-                 **kwargs):
+                 layout="NCHW", **kwargs):
         super().__init__(**kwargs)
         assert len(layers) == len(channels) - 1
-        with self.name_scope():
+        self._layout = layout
+        with self.name_scope(), nn.conv_layout(layout):
             self.features = nn.HybridSequential(prefix="")
             if thumbnail:
                 self.features.add(_conv3x3(channels[0], 1, 0))
@@ -181,6 +182,10 @@ class ResNetV1(HybridBlock):
         return layer
 
     def hybrid_forward(self, F, x):
+        if self._layout == "NHWC":
+            # NCHW at the API edge (MXNet semantics), channels-last inside:
+            # one cheap input transpose instead of relayouts at every conv
+            x = F.transpose(x, (0, 2, 3, 1))
         x = self.features(x)
         return self.output(x)
 
@@ -189,10 +194,11 @@ class ResNetV2(HybridBlock):
     """ResNet v2 ("Identity Mappings in Deep Residual Networks")."""
 
     def __init__(self, block, layers, channels, classes=1000, thumbnail=False,
-                 **kwargs):
+                 layout="NCHW", **kwargs):
         super().__init__(**kwargs)
         assert len(layers) == len(channels) - 1
-        with self.name_scope():
+        self._layout = layout
+        with self.name_scope(), nn.conv_layout(layout):
             self.features = nn.HybridSequential(prefix="")
             self.features.add(nn.BatchNorm(scale=False, center=False))
             if thumbnail:
@@ -227,6 +233,10 @@ class ResNetV2(HybridBlock):
         return layer
 
     def hybrid_forward(self, F, x):
+        if self._layout == "NHWC":
+            # NCHW at the API edge (MXNet semantics), channels-last inside:
+            # one cheap input transpose instead of relayouts at every conv
+            x = F.transpose(x, (0, 2, 3, 1))
         x = self.features(x)
         return self.output(x)
 

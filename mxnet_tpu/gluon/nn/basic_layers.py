@@ -158,6 +158,12 @@ class BatchNorm(HybridBlock):
                  running_variance_initializer="ones", in_channels=0,
                  prefix=None, params=None):
         super().__init__(prefix=prefix, params=params)
+        if axis == 1:
+            # under conv_layout("NHWC") the default channel axis moves last
+            from .conv_layers import _layout_override
+
+            if _layout_override[0] == "channels_last":
+                axis = -1
         self._axis = axis
         self._momentum = momentum
         self._epsilon = epsilon

@@ -3,8 +3,18 @@
 Reference: ``python/mxnet/gluon/nn/conv_layers.py`` — `_Conv` base,
 Conv1D/2D/3D (+Transpose), Max/Avg pools 1/2/3D, Global pools,
 ReflectionPad2D.
+
+TPU layout note: MXNet's API default is channels-first (NCHW), but the
+TPU conv emitters want channels-last (the lane dimension is the channel
+dimension — NCHW convs compile with activation relayouts on both sides).
+``conv_layout("NHWC")`` switches the *default* layout of every
+conv/pool/BatchNorm block constructed inside the context, so a whole model
+can be built channels-last with one line while weights stay OIHW
+(checkpoints are layout-independent). See PERF.md round 3.
 """
 from __future__ import annotations
+
+import contextlib
 
 from ...base import MXNetError
 from ..block import HybridBlock
@@ -13,7 +23,53 @@ __all__ = ["Conv1D", "Conv2D", "Conv3D", "Conv1DTranspose", "Conv2DTranspose",
            "Conv3DTranspose", "MaxPool1D", "MaxPool2D", "MaxPool3D",
            "AvgPool1D", "AvgPool2D", "AvgPool3D", "GlobalMaxPool1D",
            "GlobalMaxPool2D", "GlobalMaxPool3D", "GlobalAvgPool1D",
-           "GlobalAvgPool2D", "GlobalAvgPool3D", "ReflectionPad2D"]
+           "GlobalAvgPool2D", "GlobalAvgPool3D", "ReflectionPad2D",
+           "conv_layout", "current_conv_layout"]
+
+_CHANNELS_LAST = {1: "NWC", 2: "NHWC", 3: "NDHWC"}
+_CHANNELS_FIRST = {1: "NCW", 2: "NCHW", 3: "NCDHW"}
+_layout_override = [None]  # "channels_last" | "channels_first" | None
+
+
+@contextlib.contextmanager
+def conv_layout(layout):
+    """Build-time default-layout context: ``with conv_layout("NHWC"): ...``.
+
+    Inside the context every conv/pool/BatchNorm block whose caller did not
+    choose a non-default layout is constructed channels-last ("NCHW" etc.
+    restores channels-first). Affects block CONSTRUCTION only — a built
+    block's layout is fixed.
+    """
+    mode = "channels_last" if layout.endswith("C") else "channels_first"
+    prev = _layout_override[0]
+    _layout_override[0] = mode
+    try:
+        yield
+    finally:
+        _layout_override[0] = prev
+
+
+def current_conv_layout(ndim=2):
+    """The layout a conv/pool block built right now would default to."""
+    if _layout_override[0] == "channels_last":
+        return _CHANNELS_LAST[ndim]
+    return _CHANNELS_FIRST[ndim]
+
+
+def _resolve_layout(layout, ndim):
+    """Apply the conv_layout override to a block's layout argument.
+
+    The override only replaces *default* (channels-first) layouts: a caller
+    who explicitly built an NHWC block outside the context keeps it.
+    """
+    if _layout_override[0] == "channels_last" \
+            and layout == _CHANNELS_FIRST.get(ndim):
+        return _CHANNELS_LAST[ndim]
+    return layout
+
+
+def channel_axis_of(layout):
+    return -1 if (layout or "").endswith("C") else 1
 
 
 def _tup(val, n):
@@ -30,10 +86,13 @@ class _Conv(HybridBlock):
         super().__init__(prefix=prefix, params=params)
         self._channels = channels
         self._in_channels = in_channels
+        layout = _resolve_layout(layout, len(kernel_size))
+        self._layout = layout
         ndim = len(kernel_size)
         self._kwargs = {
             "kernel": kernel_size, "stride": strides, "dilate": dilation,
             "pad": padding, "num_filter": channels, "num_group": groups,
+            "layout": layout,
         }
         self._op_name = op_name
         if adj is not None:
@@ -56,7 +115,7 @@ class _Conv(HybridBlock):
             self.act = _make_activation(activation, self)
 
     def _infer_param_shapes(self, x, *rest):
-        in_c = x.shape[1]
+        in_c = x.shape[-1 if (self._layout or "").endswith("C") else 1]
         w = list(self.weight.shape)
         if self._op_name == "Convolution":
             w[1] = in_c // self._kwargs["num_group"]
@@ -159,10 +218,12 @@ class _Pooling(HybridBlock):
         super().__init__(prefix=prefix, params=params)
         if strides is None:
             strides = pool_size
+        layout = _resolve_layout(layout, len(pool_size))
         self._kwargs = {
             "kernel": pool_size, "stride": strides, "pad": padding,
             "pool_type": pool_type, "global_pool": global_pool,
             "pooling_convention": "full" if ceil_mode else "valid",
+            "layout": layout,
         }
         if count_include_pad is not None:
             self._kwargs["count_include_pad"] = count_include_pad
@@ -180,21 +241,24 @@ class MaxPool1D(_Pooling):
     def __init__(self, pool_size=2, strides=None, padding=0, layout="NCW",
                  ceil_mode=False, **kwargs):
         super().__init__(_tup(pool_size, 1), _tup(strides, 1) if strides is not None else None,
-                         _tup(padding, 1), ceil_mode, False, "max", **kwargs)
+                         _tup(padding, 1), ceil_mode, False, "max",
+                         layout=layout, **kwargs)
 
 
 class MaxPool2D(_Pooling):
     def __init__(self, pool_size=(2, 2), strides=None, padding=0,
                  layout="NCHW", ceil_mode=False, **kwargs):
         super().__init__(_tup(pool_size, 2), _tup(strides, 2) if strides is not None else None,
-                         _tup(padding, 2), ceil_mode, False, "max", **kwargs)
+                         _tup(padding, 2), ceil_mode, False, "max",
+                         layout=layout, **kwargs)
 
 
 class MaxPool3D(_Pooling):
     def __init__(self, pool_size=(2, 2, 2), strides=None, padding=0,
                  layout="NCDHW", ceil_mode=False, **kwargs):
         super().__init__(_tup(pool_size, 3), _tup(strides, 3) if strides is not None else None,
-                         _tup(padding, 3), ceil_mode, False, "max", **kwargs)
+                         _tup(padding, 3), ceil_mode, False, "max",
+                         layout=layout, **kwargs)
 
 
 class AvgPool1D(_Pooling):
@@ -202,6 +266,7 @@ class AvgPool1D(_Pooling):
                  ceil_mode=False, count_include_pad=True, **kwargs):
         super().__init__(_tup(pool_size, 1), _tup(strides, 1) if strides is not None else None,
                          _tup(padding, 1), ceil_mode, False, "avg",
+                         layout=layout,
                          count_include_pad=count_include_pad, **kwargs)
 
 
@@ -211,6 +276,7 @@ class AvgPool2D(_Pooling):
                  **kwargs):
         super().__init__(_tup(pool_size, 2), _tup(strides, 2) if strides is not None else None,
                          _tup(padding, 2), ceil_mode, False, "avg",
+                         layout=layout,
                          count_include_pad=count_include_pad, **kwargs)
 
 
@@ -220,13 +286,14 @@ class AvgPool3D(_Pooling):
                  **kwargs):
         super().__init__(_tup(pool_size, 3), _tup(strides, 3) if strides is not None else None,
                          _tup(padding, 3), ceil_mode, False, "avg",
+                         layout=layout,
                          count_include_pad=count_include_pad, **kwargs)
 
 
 class _GlobalPool(_Pooling):
     def __init__(self, ndim, pool_type, layout, **kwargs):
         super().__init__((1,) * ndim, (1,) * ndim, (0,) * ndim, False, True,
-                         pool_type, **kwargs)
+                         pool_type, layout=layout, **kwargs)
 
 
 class GlobalMaxPool1D(_GlobalPool):

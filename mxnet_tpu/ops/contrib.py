@@ -247,7 +247,7 @@ def quantized_dense(data, weight_q, w_scale, bias=None, *, num_hidden,
 @register("_contrib_quantized_conv")
 def quantized_conv(data, weight_q, w_scale, bias=None, *, kernel,
                    num_filter, stride=None, pad=None, dilate=None,
-                   num_group=1, no_bias=False,
+                   num_group=1, no_bias=False, layout=None,
                    min_calib_range=None, max_calib_range=None):
     """Int8-weight convolution; activation fake-quant as quantized_dense."""
     from .registry import get_op
@@ -257,5 +257,5 @@ def quantized_conv(data, weight_q, w_scale, bias=None, *, kernel,
     w = weight_q.astype(jnp.float32) * scale
     return get_op("Convolution").fn(
         xq, w, bias, kernel=kernel, num_filter=num_filter, stride=stride,
-        pad=pad, dilate=dilate, num_group=num_group,
+        pad=pad, dilate=dilate, num_group=num_group, layout=layout,
         no_bias=no_bias or bias is None)

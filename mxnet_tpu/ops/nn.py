@@ -44,12 +44,23 @@ def _tuplize(v, n):
     return v
 
 
-def _conv_dnums(nd):
-    # MXNet default layouts: NCW / NCHW / NCDHW with OIHW-style weights.
+def _conv_dnums(nd, layout=None):
+    # MXNet layouts: NCW/NCHW/NCDHW (default) or NWC/NHWC/NDHWC
+    # (channels-last — the TPU-preferred internal layout; XLA then needs no
+    # activation relayout around the conv, see SURVEY.md §7.2 "fusion
+    # audit"). Weights stay OIHW-style in BOTH cases so checkpoints are
+    # layout-independent; XLA relayouts the (small) filter, not the
+    # activations.
     spatial = "DHW"[-nd:] if nd <= 3 else None
-    lhs = "NC" + spatial
+    lhs = ("N" + spatial + "C") if (layout and layout.endswith("C")) \
+        else ("NC" + spatial)
     rhs = "OI" + spatial
-    return jax.lax.conv_dimension_numbers((1, 1) + (1,) * nd, (1, 1) + (1,) * nd, (lhs, rhs, lhs))
+    return jax.lax.conv_dimension_numbers(
+        (1,) * (nd + 2), (1,) * (nd + 2), (lhs, rhs, lhs))
+
+
+def _channel_axis(layout, ndim):
+    return (ndim - 1) if (layout and layout.endswith("C")) else 1
 
 
 @register("Convolution", aliases=["convolution"])
@@ -61,7 +72,7 @@ def convolution(data, weight, bias=None, *, kernel=(), stride=(), dilate=(),
     stride = _tuplize(stride or 1, nd)
     dilate = _tuplize(dilate or 1, nd)
     pad = _tuplize(pad or 0, nd)
-    dnums = _conv_dnums(nd)
+    dnums = _conv_dnums(nd, layout)
     out = jax.lax.conv_general_dilated(
         data,
         weight.astype(data.dtype),
@@ -76,7 +87,9 @@ def convolution(data, weight, bias=None, *, kernel=(), stride=(), dilate=(),
     )
     out = out.astype(data.dtype)
     if not no_bias and bias is not None:
-        out = out + bias.astype(out.dtype).reshape((1, -1) + (1,) * nd)
+        bshape = [1] * out.ndim
+        bshape[_channel_axis(layout, out.ndim)] = bias.shape[0]
+        out = out + bias.astype(out.dtype).reshape(bshape)
     return out
 
 
@@ -123,9 +136,13 @@ def pooling(data, *, kernel=(), pool_type="max", stride=(), pad=(),
             global_pool=False, pooling_convention="valid", count_include_pad=True,
             cudnn_off=False, p_value=2, layout=None):
     # reference: src/operator/nn/pooling.cc :: PoolingCompute
+    # layout: channels-first (default) or channels-last ("NHWC"/"NWC"/
+    # "NDHWC") — spatial window axes shift accordingly
     nd = data.ndim - 2
+    channels_last = bool(layout) and layout.endswith("C")
+    spatial0 = 1 if channels_last else 2
     if global_pool:
-        ax = tuple(range(2, data.ndim))
+        ax = tuple(range(spatial0, spatial0 + nd))
         if pool_type == "max":
             return jnp.max(data, axis=ax, keepdims=True)
         if pool_type in ("avg", "sum"):
@@ -138,21 +155,27 @@ def pooling(data, *, kernel=(), pool_type="max", stride=(), pad=(),
     kernel = _tuplize(kernel, nd)
     stride = _tuplize(stride or 1, nd)
     pad = _tuplize(pad or 0, nd)
-    window = (1, 1) + kernel
-    strides = (1, 1) + stride
+    if channels_last:
+        window = (1,) + kernel + (1,)
+        strides = (1,) + stride + (1,)
+    else:
+        window = (1, 1) + kernel
+        strides = (1, 1) + stride
 
     def pads_for(convention):
-        out = [(0, 0), (0, 0)]
+        spatial = []
         for i in range(nd):
             lo = hi = pad[i]
             if convention == "full":
                 # ceil instead of floor output size: add extra hi padding
-                size = data.shape[2 + i] + 2 * pad[i] - kernel[i]
+                size = data.shape[spatial0 + i] + 2 * pad[i] - kernel[i]
                 rem = size % stride[i]
                 if rem != 0:
                     hi += stride[i] - rem
-            out.append((lo, hi))
-        return out
+            spatial.append((lo, hi))
+        if channels_last:
+            return [(0, 0)] + spatial + [(0, 0)]
+        return [(0, 0), (0, 0)] + spatial
 
     padding = pads_for(pooling_convention)
     if pool_type == "max":
@@ -201,6 +224,7 @@ def batch_norm(data, gamma, beta, moving_mean, moving_var, *, eps=1e-3,
     in-place aux-state mutation. In inference mode returns just `out`
     (matching mx.nd.BatchNorm's single visible output).
     """
+    axis = axis % data.ndim
     reduce_axes = tuple(i for i in range(data.ndim) if i != axis)
     bshape = [1] * data.ndim
     bshape[axis] = data.shape[axis]

@@ -382,6 +382,54 @@ def _bwd_dkdv_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref,
         dv_ref[...] = dv_acc[:].astype(dv_ref.dtype)
 
 
+def _bwd_fused_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref,
+                      dq_ref, dk_ref, dv_ref, *, scale, causal,
+                      causal_offset, prec, bq, bk):
+    """Fused dQ/dK/dV for the single-block case (nq == nk == 1).
+
+    The split dK/dV + dQ kernels each recompute the probability matrix —
+    7 MXU matmuls and 2 VPU exp sweeps per head per step. When the whole
+    head fits one (bq, bk) block there is nothing to stream, so one kernel
+    can share the recompute: 5 matmuls and 1 exp. At BERT shapes the
+    attention kernels are VPU(exp)-bound, so the saved exp sweep is the
+    dominant win (measured: see PERF.md round-3 attention table).
+
+    Score math transposed (s_t: (BK, BQ)) as in _bwd_dkdv_kernel so the
+    per-row stats broadcast from lane vectors.
+    """
+    q = q_ref[...]                                     # (BQ, D)
+    k = k_ref[...]                                     # (BK, D)
+    v = v_ref[...]
+    do = do_ref[...]                                   # (BQ, D)
+    lse = lse_ref[0:1, :]                              # (1, BQ)
+    delta = delta_ref[0:1, :]                          # (1, BQ)
+    s_t = jax.lax.dot_general(
+        k, q, (((1,), (1,)), ((), ())),
+        preferred_element_type=jnp.float32, precision=prec) * scale
+    if causal:
+        q_pos = causal_offset + jax.lax.broadcasted_iota(
+            jnp.int32, (bk, bq), 1)
+        k_pos = jax.lax.broadcasted_iota(jnp.int32, (bk, bq), 0)
+        s_t = jnp.where(k_pos <= q_pos, s_t, _NEG_INF32)
+    p_t = jnp.exp(s_t - lse)                           # (BK, BQ) f32
+    p_cast = p_t.astype(do.dtype)
+    dv_ref[...] = jnp.dot(p_cast, do,
+                          preferred_element_type=jnp.float32,
+                          precision=prec).astype(dv_ref.dtype)
+    dp_t = jax.lax.dot_general(
+        v, do, (((1,), (1,)), ((), ())),
+        preferred_element_type=jnp.float32, precision=prec)  # (BK, BQ)
+    ds_t = (p_t * (dp_t - delta) * scale).astype(q.dtype)
+    dk_ref[...] = jnp.dot(ds_t, q,
+                          preferred_element_type=jnp.float32,
+                          precision=prec).astype(dk_ref.dtype)
+    # dq = ds @ k = ds_t^T @ k : contract the BK dim of both
+    dq_ref[...] = jax.lax.dot_general(
+        ds_t, k, (((0,), (0,)), ((), ())),
+        preferred_element_type=jnp.float32,
+        precision=prec).astype(dq_ref.dtype)           # (BQ, D)
+
+
 def _bwd_dq_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref,
                    dq_ref, dq_acc, *, scale, causal, nk, causal_offset, prec,
                    bq, bk):
@@ -470,6 +518,30 @@ def _flash_bwd_pallas(q, k, v, o, lse, g, scale, causal, interpret=False,
                              (bh, nq, 8, bq))
     offset = lk - lq
     prec = _prec_for(q.dtype)
+
+    if nq == 1 and nk == 1:
+        # whole head in one block: fused dq/dk/dv kernel shares the p
+        # recompute (5 matmuls + 1 exp instead of 7 + 2)
+        q_spec = _tile_spec(layout, h, bq, d, 0)
+        k_spec = _tile_spec(layout, h, bk, d, 1)
+        row_spec = pl.BlockSpec((None, None, 8, bq),
+                                lambda bh_, qi, ki: (bh_, qi, 0, 0))
+        with _x32_mode():
+            dq, dk3, dv3 = pl.pallas_call(
+                functools.partial(_bwd_fused_kernel, scale=scale,
+                                  causal=causal, causal_offset=offset,
+                                  prec=prec, bq=bq, bk=bk),
+                grid=(bh, 1, 1),
+                in_specs=[q_spec, k_spec, k_spec, q_spec,
+                          row_spec, row_spec],
+                out_specs=[q_spec, k_spec, k_spec],
+                out_shape=[dq_shape, dk_shape, dv_shape],
+                interpret=interpret,
+            )(q, k, v, do, lse, delta)
+        if layout == "bhld":
+            return (dq.reshape(b, h, lq, d), dk3.reshape(b, h, lk, d),
+                    dv3.reshape(b, h, lk, d))
+        return dq, dk3, dv3
 
     # grid (bh, nk, nq): q/do/lse/delta stream on the inner (j) dim, so
     # their tiles index by grid dim 2 (seq_index=1) and K/V by dim 1
