@@ -68,10 +68,6 @@ def _resolve_layout(layout, ndim):
     return layout
 
 
-def channel_axis_of(layout):
-    return -1 if (layout or "").endswith("C") else 1
-
-
 def _tup(val, n):
     if isinstance(val, int):
         return (val,) * n

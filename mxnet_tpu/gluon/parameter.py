@@ -118,6 +118,17 @@ class Parameter:
         self._finish_init(init, list(ctx), default_init)
 
     def _finish_init(self, init, ctx_list, default_init):
+        # Deferred init can resolve while a trace is live (TrainStep's
+        # eval_shape settle, hybridize tracing). Initializer values are
+        # concrete by construction; ensure_compile_time_eval keeps the raw
+        # jnp calls inside initializers/__setitem__ from being captured as
+        # tracers by the surrounding trace.
+        import jax
+
+        with jax.ensure_compile_time_eval():
+            self._finish_init_concrete(init, ctx_list, default_init)
+
+    def _finish_init_concrete(self, init, ctx_list, default_init):
         host = _np.zeros(self._shape, dtype="float32")
         host_nd = nd_array(host, ctx=cpu(0), dtype="float32")
         ini = initializer.create(init) if init is not None else initializer.create(self.init) if self.init is not None else default_init

@@ -105,8 +105,10 @@ def deconvolution(data, weight, bias=None, *, kernel=(), stride=(), dilate=(),
     pad = _tuplize(pad or 0, nd)
     adj = _tuplize(adj or 0, nd)
     spatial = "DHW"[-nd:]
+    lhs = ("N" + spatial + "C") if (layout and layout.endswith("C")) \
+        else ("NC" + spatial)
     dn = jax.lax.conv_dimension_numbers(
-        data.shape, weight.shape, ("NC" + spatial, "IO" + spatial, "NC" + spatial)
+        data.shape, weight.shape, (lhs, "IO" + spatial, lhs)
     )
     # conv_transpose with MXNet padding semantics:
     # out = (in-1)*stride - 2*pad + dilate*(k-1) + 1 + adj
@@ -122,7 +124,9 @@ def deconvolution(data, weight, bias=None, *, kernel=(), stride=(), dilate=(),
     )
     out = out.astype(data.dtype)
     if not no_bias and bias is not None:
-        out = out + bias.astype(out.dtype).reshape((1, -1) + (1,) * nd)
+        bshape = [1] * out.ndim
+        bshape[_channel_axis(layout, out.ndim)] = bias.shape[0]
+        out = out + bias.astype(out.dtype).reshape(bshape)
     return out
 
 
@@ -484,8 +488,19 @@ def dropout_op(rng, data, *, p=0.5, mode="training", axes=(), cudnn_off=False,
     for a in axes:
         shape[a] = 1
     keep = 1.0 - p
-    mask = jax.random.bernoulli(rng, keep, tuple(shape))
-    return jnp.where(mask, data / keep, jnp.zeros_like(data))
+    # u16 threshold compare instead of jax.random.bernoulli's u32->f32
+    # uniform: half the generated bits and no convert, at 2^-16 keep-rate
+    # granularity (dropout masks on transformer activations are the
+    # single biggest RNG consumer — see PERF.md round 3). The inverse-keep
+    # scale is a multiply (divides don't strength-reduce for non-exact
+    # reciprocals).
+    import numpy as _np
+
+    thresh = _np.uint16(min(65535, int(round(keep * 65536.0))))
+    bits = jax.random.bits(rng, tuple(shape), dtype=jnp.uint16)
+    mask = bits < thresh
+    inv_keep = jnp.asarray(1.0 / keep, dtype=data.dtype)
+    return jnp.where(mask, data * inv_keep, jnp.zeros_like(data))
 
 
 # ---------------------------------------------------------------------------

@@ -59,6 +59,15 @@ _NEG_INF = -1e30
 _NEG_INF32 = _np.float32(-1e30)
 _ONE32 = _np.float32(1.0)
 _ZERO32 = _np.float32(0.0)
+# All kernels run softmax in BASE-2: log2(e) folds into the score scale
+# (one multiply that was already there) and exp2 is the VPU's native
+# transcendental — exp lowers to exp2 plus a scale per element, so at
+# attention sizes (50M+ exps/layer/step, the kernels' dominant VPU cost)
+# base-2 removes a full multiply sweep. The saved lse residual is
+# therefore in the base-2 domain: p == exp2(s2 - lse2) exactly equals
+# exp(s - lse); gradient math (ds = p*(dp-delta)*scale) is unchanged
+# because only the representation of p's computation moves, not p.
+_LOG2E = _np.float32(1.4426950408889634)
 
 
 def _x32_mode():
@@ -174,7 +183,7 @@ def flash_attention_scan(q, k, v, scale=None, causal=False,
 
 
 def _fwd_kernel(q_ref, k_ref, v_ref, o_ref, lse_ref, acc_ref, m_ref, l_ref,
-                *, scale, causal, nk, causal_offset, prec, bq, bk):
+                *, scale2, causal, nk, causal_offset, prec, bq, bk):
     from jax.experimental import pallas as pl
 
     ki = pl.program_id(2)
@@ -191,14 +200,14 @@ def _fwd_kernel(q_ref, k_ref, v_ref, o_ref, lse_ref, acc_ref, m_ref, l_ref,
         # operands stay in the INPUT dtype: casting bf16 to f32 before
         # the dot forces multi-pass f32 MXU matmuls — the bf16 native
         # single-pass with f32 accumulate is the whole fast path. The
-        # scale moves onto the f32 scores (exact there).
+        # base-2 scale moves onto the f32 scores (exact there).
         q = q_ref[...]                                     # (BQ, D)
         k = k_ref[...]                                     # (BK, D)
         v = v_ref[...]
         s = jax.lax.dot_general(
             q, k, (((1,), (1,)), ((), ())),
             preferred_element_type=jnp.float32,
-            precision=prec) * scale                        # (BQ, BK) f32
+            precision=prec) * scale2                       # (BQ, BK) f32
         if causal:
             # bottom-right alignment: offset = lk - lq
             q_pos = causal_offset + qi * bq + jax.lax.broadcasted_iota(
@@ -208,8 +217,8 @@ def _fwd_kernel(q_ref, k_ref, v_ref, o_ref, lse_ref, acc_ref, m_ref, l_ref,
             s = jnp.where(k_pos <= q_pos, s, _NEG_INF32)
         m_prev = m_ref[:, 0:1]                             # (BQ, 1)
         m_new = jnp.maximum(m_prev, jnp.max(s, axis=-1, keepdims=True))
-        p = jnp.exp(s - m_new)
-        alpha = jnp.exp(m_prev - m_new)
+        p = jnp.exp2(s - m_new)
+        alpha = jnp.exp2(m_prev - m_new)
         l_ref[:] = l_ref[:] * alpha + jnp.broadcast_to(
             jnp.sum(p, axis=-1, keepdims=True), l_ref.shape)
         acc_ref[:] = acc_ref[:] * alpha + jnp.dot(
@@ -231,14 +240,46 @@ def _fwd_kernel(q_ref, k_ref, v_ref, o_ref, lse_ref, acc_ref, m_ref, l_ref,
         l = l_ref[:, 0:1]
         o_ref[...] = (acc_ref[:] / jnp.where(l == _ZERO32, _ONE32, l)).astype(
             o_ref.dtype)
-        # per-row logsumexp residual for the backward kernels, stored as a
-        # lane vector broadcast over 8 sublanes — (8, BQ) is the smallest
-        # f32 tile, so the (BQ,) column transposes into it legally
+        # per-row base-2 logsumexp residual for the backward kernels,
+        # stored as a lane vector broadcast over 8 sublanes — (8, BQ) is
+        # the smallest f32 tile, so the (BQ,) column transposes in legally
         m_col = m_ref[:, 0:1]
         l_safe = jnp.where(l == _ZERO32, _ONE32, l)
-        lse_col = jnp.where(l == _ZERO32, _NEG_INF32, m_col + jnp.log(l_safe))
+        lse_col = jnp.where(l == _ZERO32, _NEG_INF32,
+                            m_col + jnp.log2(l_safe))
         lse_ref[...] = jnp.broadcast_to(
             lse_col.reshape(1, bq), (8, bq))
+
+
+def _fwd_kernel_single(q_ref, k_ref, v_ref, o_ref, lse_ref, *,
+                       scale2, causal, causal_offset, prec, bq, bk):
+    """Whole-head-in-one-block forward (nq == nk == 1, e.g. BERT seq 512).
+
+    No streaming means no running statistics: the scratch carries and the
+    alpha-rescale sweeps of the online-softmax kernel disappear — at these
+    shapes the kernel is VPU-bound, so fewer elementwise passes is the
+    win, not matmul shape.
+    """
+    q = q_ref[...]                                         # (BQ, D)
+    k = k_ref[...]
+    v = v_ref[...]
+    s = jax.lax.dot_general(
+        q, k, (((1,), (1,)), ((), ())),
+        preferred_element_type=jnp.float32, precision=prec) * scale2
+    if causal:
+        q_pos = causal_offset + jax.lax.broadcasted_iota(
+            jnp.int32, (bq, bk), 0)
+        k_pos = jax.lax.broadcasted_iota(jnp.int32, (bq, bk), 1)
+        s = jnp.where(k_pos <= q_pos, s, _NEG_INF32)
+    m = jnp.max(s, axis=-1, keepdims=True)                 # (BQ, 1)
+    p = jnp.exp2(s - m)
+    l = jnp.sum(p, axis=-1, keepdims=True)
+    l_safe = jnp.where(l == _ZERO32, _ONE32, l)
+    o_ref[...] = (jnp.dot(p.astype(v.dtype), v,
+                          preferred_element_type=jnp.float32,
+                          precision=prec) / l_safe).astype(o_ref.dtype)
+    lse_col = jnp.where(l == _ZERO32, _NEG_INF32, m + jnp.log2(l_safe))
+    lse_ref[...] = jnp.broadcast_to(lse_col.reshape(1, bq), (8, bq))
 
 
 def _dims(x, layout, is_q=True):
@@ -287,32 +328,43 @@ def _flash_fwd_pallas(q, k, v, scale, causal, interpret=False,
     bq, bk = _block_sizes(lq, lk)
     nq, nk = lq // bq, lk // bk
     prec = _prec_for(q.dtype)
-    kernel = functools.partial(_fwd_kernel, scale=scale, causal=causal,
-                               nk=nk, causal_offset=lk - lq, prec=prec,
-                               bq=bq, bk=bk)
+    scale2 = _np.float32(scale) * _LOG2E
+    in_specs = [
+        _tile_spec(layout, h, bq, d, 0),
+        _tile_spec(layout, h, bk, d, 1),
+        _tile_spec(layout, h, bk, d, 1),
+    ]
+    out_specs = [
+        _tile_spec(layout, h, bq, d, 0),
+        pl.BlockSpec((None, None, 8, bq),
+                     lambda bh_, qi, ki: (bh_, qi, 0, 0)),
+    ]
+    out_shape = [
+        o_shape,
+        jax.ShapeDtypeStruct((bh, nq, 8, bq), jnp.float32),
+    ]
+    if nq == 1 and nk == 1:
+        kernel = functools.partial(
+            _fwd_kernel_single, scale2=scale2, causal=causal,
+            causal_offset=lk - lq, prec=prec, bq=bq, bk=bk)
+        scratch = []
+    else:
+        kernel = functools.partial(
+            _fwd_kernel, scale2=scale2, causal=causal, nk=nk,
+            causal_offset=lk - lq, prec=prec, bq=bq, bk=bk)
+        scratch = [
+            pltpu.VMEM((bq, d), jnp.float32),
+            pltpu.VMEM((bq, 128), jnp.float32),
+            pltpu.VMEM((bq, 128), jnp.float32),
+        ]
     with _x32_mode():
         out, lse = pl.pallas_call(
             kernel,
             grid=(bh, nq, nk),
-            in_specs=[
-                _tile_spec(layout, h, bq, d, 0),
-                _tile_spec(layout, h, bk, d, 1),
-                _tile_spec(layout, h, bk, d, 1),
-            ],
-            out_specs=[
-                _tile_spec(layout, h, bq, d, 0),
-                pl.BlockSpec((None, None, 8, bq),
-                             lambda bh_, qi, ki: (bh_, qi, 0, 0)),
-            ],
-            out_shape=[
-                o_shape,
-                jax.ShapeDtypeStruct((bh, nq, 8, bq), jnp.float32),
-            ],
-            scratch_shapes=[
-                pltpu.VMEM((bq, d), jnp.float32),
-                pltpu.VMEM((bq, 128), jnp.float32),
-                pltpu.VMEM((bq, 128), jnp.float32),
-            ],
+            in_specs=in_specs,
+            out_specs=out_specs,
+            out_shape=out_shape,
+            scratch_shapes=scratch,
             interpret=interpret,
         )(q, k, v)
     if layout == "bhld":
@@ -322,7 +374,7 @@ def _flash_fwd_pallas(q, k, v, scale, causal, interpret=False,
 
 def _bwd_dkdv_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref,
                      dk_ref, dv_ref, dk_acc, dv_acc, *,
-                     scale, causal, nq, causal_offset, prec, bq, bk):
+                     scale, scale2, causal, nq, causal_offset, prec, bq, bk):
     """dK/dV for one K block; Q blocks stream on the innermost grid dim.
 
     All score math is done TRANSPOSED — s_T = (BK, BQ) — so the per-row
@@ -350,14 +402,14 @@ def _bwd_dkdv_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref,
         delta = delta_ref[0:1, :]                           # (1, BQ)
         s_t = jax.lax.dot_general(
             k, q, (((1,), (1,)), ((), ())),
-            preferred_element_type=jnp.float32, precision=prec) * scale
+            preferred_element_type=jnp.float32, precision=prec) * scale2
         if causal:
             q_pos = causal_offset + qi * bq + jax.lax.broadcasted_iota(
                 jnp.int32, (bk, bq), 1)
             k_pos = ki * bk + jax.lax.broadcasted_iota(
                 jnp.int32, (bk, bq), 0)
             s_t = jnp.where(k_pos <= q_pos, s_t, _NEG_INF32)
-        p_t = jnp.exp(s_t - lse)                            # (BK, BQ)
+        p_t = jnp.exp2(s_t - lse)                            # (BK, BQ)
         dv_acc[:] += jnp.dot(p_t.astype(do.dtype), do,
                              preferred_element_type=jnp.float32,
                              precision=prec)
@@ -383,7 +435,7 @@ def _bwd_dkdv_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref,
 
 
 def _bwd_fused_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref,
-                      dq_ref, dk_ref, dv_ref, *, scale, causal,
+                      dq_ref, dk_ref, dv_ref, *, scale, scale2, causal,
                       causal_offset, prec, bq, bk):
     """Fused dQ/dK/dV for the single-block case (nq == nk == 1).
 
@@ -405,13 +457,13 @@ def _bwd_fused_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref,
     delta = delta_ref[0:1, :]                          # (1, BQ)
     s_t = jax.lax.dot_general(
         k, q, (((1,), (1,)), ((), ())),
-        preferred_element_type=jnp.float32, precision=prec) * scale
+        preferred_element_type=jnp.float32, precision=prec) * scale2
     if causal:
         q_pos = causal_offset + jax.lax.broadcasted_iota(
             jnp.int32, (bk, bq), 1)
         k_pos = jax.lax.broadcasted_iota(jnp.int32, (bk, bq), 0)
         s_t = jnp.where(k_pos <= q_pos, s_t, _NEG_INF32)
-    p_t = jnp.exp(s_t - lse)                           # (BK, BQ) f32
+    p_t = jnp.exp2(s_t - lse)                           # (BK, BQ) f32
     p_cast = p_t.astype(do.dtype)
     dv_ref[...] = jnp.dot(p_cast, do,
                           preferred_element_type=jnp.float32,
@@ -431,8 +483,8 @@ def _bwd_fused_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref,
 
 
 def _bwd_dq_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref,
-                   dq_ref, dq_acc, *, scale, causal, nk, causal_offset, prec,
-                   bq, bk):
+                   dq_ref, dq_acc, *, scale, scale2, causal, nk,
+                   causal_offset, prec, bq, bk):
     """dQ for one Q block; K blocks stream on the innermost grid dim."""
     from jax.experimental import pallas as pl
 
@@ -452,14 +504,14 @@ def _bwd_dq_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref,
         delta = delta_ref[0:1, :]                           # (1, BQ)
         s_t = jax.lax.dot_general(
             k, q, (((1,), (1,)), ((), ())),
-            preferred_element_type=jnp.float32, precision=prec) * scale
+            preferred_element_type=jnp.float32, precision=prec) * scale2
         if causal:
             q_pos = causal_offset + qi * bq + jax.lax.broadcasted_iota(
                 jnp.int32, (bk, bq), 1)
             k_pos = ki * bk + jax.lax.broadcasted_iota(
                 jnp.int32, (bk, bq), 0)
             s_t = jnp.where(k_pos <= q_pos, s_t, _NEG_INF32)
-        p_t = jnp.exp(s_t - lse)
+        p_t = jnp.exp2(s_t - lse)
         dp_t = jax.lax.dot_general(
             v, do, (((1,), (1,)), ((), ())),
             preferred_element_type=jnp.float32, precision=prec)
@@ -529,6 +581,7 @@ def _flash_bwd_pallas(q, k, v, o, lse, g, scale, causal, interpret=False,
         with _x32_mode():
             dq, dk3, dv3 = pl.pallas_call(
                 functools.partial(_bwd_fused_kernel, scale=scale,
+                                  scale2=_np.float32(scale) * _LOG2E,
                                   causal=causal, causal_offset=offset,
                                   prec=prec, bq=bq, bk=bk),
                 grid=(bh, 1, 1),
@@ -551,8 +604,9 @@ def _flash_bwd_pallas(q, k, v, o, lse, g, scale, causal, interpret=False,
                               lambda bh_, i, j: (bh_, j, 0, 0))
     with _x32_mode():
         dk3, dv3 = pl.pallas_call(
-            functools.partial(_bwd_dkdv_kernel, scale=scale, causal=causal,
-                              nq=nq, causal_offset=offset,
+            functools.partial(_bwd_dkdv_kernel, scale=scale,
+                              scale2=_np.float32(scale) * _LOG2E,
+                              causal=causal, nq=nq, causal_offset=offset,
                               prec=prec, bq=bq, bk=bk),
             grid=(bh, nk, nq),
             in_specs=[q_spec_j, k_spec_i, k_spec_i, q_spec_j,
@@ -567,8 +621,9 @@ def _flash_bwd_pallas(q, k, v, o, lse, g, scale, causal, interpret=False,
         )(q, k, v, do, lse, delta)
 
         dq = pl.pallas_call(
-            functools.partial(_bwd_dq_kernel, scale=scale, causal=causal,
-                              nk=nk, causal_offset=offset,
+            functools.partial(_bwd_dq_kernel, scale=scale,
+                              scale2=_np.float32(scale) * _LOG2E,
+                              causal=causal, nk=nk, causal_offset=offset,
                               prec=prec, bq=bq, bk=bk),
             grid=(bh, nq, nk),
             in_specs=[

@@ -94,9 +94,37 @@ class TrainStep:
     def _settle_params(self, data_tuple):
         params = list(self.net.collect_params().values())
         if any(p._data is None for p in params):
-            # deferred shapes: one eager forward settles them (same move as
-            # HybridBlock.__call__ on DeferredInitializationError)
-            self.net(*data_tuple)
+            # deferred shapes: an abstract forward settles them without
+            # computing anything (shape inference is host-side; parameter
+            # initializers still run concretely when the deferred init
+            # resolves). Falls back to the eager forward — the reference
+            # move, HybridBlock.__call__ on DeferredInitializationError —
+            # for blocks whose forward needs concrete values.
+            import jax
+
+            net = self.net
+
+            def _shape_probe(*vals):
+                ctx = current_context()
+                nds = [NDArray(data=v, ctx=ctx) for v in vals]
+                net(*nds)
+                return 0
+
+            # the probe must not advance the global PRNG stream with traced
+            # keys (rng-consuming ops like Dropout run under the trace);
+            # snapshot the stream state and restore it after
+            st = random_state._global()
+            saved_keys = dict(st.keys)
+            try:
+                jax.eval_shape(_shape_probe,
+                               *[v.data for v in data_tuple])
+            except Exception:
+                net(*data_tuple)
+            finally:
+                st.keys = saved_keys
+            if any(p._data is None
+                   for p in net.collect_params().values()):
+                net(*data_tuple)
             params = list(self.net.collect_params().values())
         self._params = params
         self._trainable = [i for i, p in enumerate(params)
@@ -122,28 +150,50 @@ class TrainStep:
         import jax
         from jax.sharding import PartitionSpec as P
 
+        is_leaf = lambda x: x is None or isinstance(x, NDArray)
+        optimizer = self.optimizer
+        trainable = list(self._trainable)
+        params = self._params
+        ctx = params[0].data().context if params else current_context()
+        treedefs = [None] * len(trainable)
+
+        # ONE compiled dispatch for the whole state tree: building states
+        # eagerly costs hundreds of tiny device round-trips (~minutes of
+        # first-step latency through a remote TPU relay; PERF.md round 3).
+        def _all_states(param_vals):
+            flat = []
+            for k, i in enumerate(trainable):
+                w = NDArray(data=param_vals[k], ctx=ctx)
+                state = optimizer.create_state_multi_precision(k, w)
+                leaves, treedefs[k] = jax.tree_util.tree_flatten(
+                    state, is_leaf=is_leaf)
+                flat.append(tuple(None if leaf is None else leaf.data
+                                  for leaf in leaves))
+            return tuple(flat)
+
+        param_data = tuple(params[i].data().data for i in trainable)
+        # out_shardings: computed per leaf after a shape-only trace would
+        # need the tree; simpler and still single-dispatch — shard after
+        with jax.transfer_guard("allow"):
+            all_leaves = jax.jit(_all_states)(param_data)
+
         leaf_nds: List[NDArray] = []
         meta = []
-        is_leaf = lambda x: x is None or isinstance(x, NDArray)
-        for k, i in enumerate(self._trainable):
-            p = self._params[i]
-            state = self.optimizer.create_state_multi_precision(k, p.data())
-            leaves, treedef = jax.tree_util.tree_flatten(state, is_leaf=is_leaf)
-            # keep the NDArray objects alive: their payloads are replaced
-            # after every step (the persistent optimizer state). None leaves
-            # (stateless SGD) are recorded in `present` and rebuilt in-trace.
+        for k, i in enumerate(trainable):
+            p = params[i]
             spec = self._param_specs[i]
+            leaves = all_leaves[k]
             present = [leaf is not None for leaf in leaves]
             specs = []
             for leaf in leaves:
                 if leaf is None:
                     continue
                 leaf_spec = spec if tuple(leaf.shape) == tuple(p.shape) else P()
-                leaf._set_data(jax.device_put(
-                    leaf.data, named_sharding(self.mesh, leaf_spec)))
+                nd_leaf = NDArray(data=jax.device_put(
+                    leaf, named_sharding(self.mesh, leaf_spec)), ctx=ctx)
                 specs.append(leaf_spec)
-                leaf_nds.append(leaf)
-            meta.append((treedef, present, specs))
+                leaf_nds.append(nd_leaf)
+            meta.append((treedefs[k], present, specs))
         self._state_leaf_nds = leaf_nds
         self._state_meta = meta
 

@@ -63,6 +63,19 @@ def test_global_pool_nhwc():
                                y_ref.asnumpy(), rtol=1e-6, atol=1e-6)
 
 
+def test_deconvolution_nhwc_matches_nchw():
+    x = _rand((2, 4, 5, 5))
+    w = _rand((4, 6, 3, 3), seed=1)  # Deconvolution weight: (in, out, kh, kw)
+    b = _rand((6,), seed=2)
+    y_ref = nd.Deconvolution(x, w, b, kernel=(3, 3), num_filter=6,
+                             stride=(2, 2), pad=(1, 1), no_bias=False)
+    y_nhwc = nd.Deconvolution(x.transpose((0, 2, 3, 1)), w, b, kernel=(3, 3),
+                              num_filter=6, stride=(2, 2), pad=(1, 1),
+                              no_bias=False, layout="NHWC")
+    np.testing.assert_allclose(y_nhwc.transpose((0, 3, 1, 2)).asnumpy(),
+                               y_ref.asnumpy(), rtol=1e-5, atol=1e-5)
+
+
 def test_conv_layout_context_defaults():
     with nn.conv_layout("NHWC"):
         conv = nn.Conv2D(4, 3, padding=1)
