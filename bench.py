@@ -38,7 +38,10 @@ def main():
     batch = 256 if platform != "cpu" else 8
     steps = 30 if platform != "cpu" else 3
 
-    net = vision.resnet50_v1()
+    # channels-last internally (NCHW stays at the API edge — the model
+    # transposes its input once); kills the activation relayouts XLA
+    # otherwise inserts around every NCHW conv. See PERF.md round 3.
+    net = vision.resnet50_v1(layout="NHWC")
     net.initialize()
     net.cast("bfloat16")
 

@@ -1,0 +1,54 @@
+"""Benchmark: Llama pretrain proxy (~0.7B, Llama-3-8B recipe) on one chip.
+
+Prints ONE JSON line {"metric", "value", "unit", "mfu"}. The model is
+CONFIGS['proxy1b'] from tools/pretrain_llama.py — same blocks, same fused
+TrainStep + AdamW path, same remat policy as the 8B stretch config
+(BASELINE.json config[4]); only depth/width are scaled so weights + Adam
+state fit one v5e chip. MFU = 6 * N * tokens_per_sec / peak_flops.
+
+The full-size recipe artifact is produced by
+``tools/pretrain_llama.py --config 8b --compile-only`` (AOT compile of the
+sharded step on a virtual mesh; results recorded in PERF.md).
+"""
+from __future__ import annotations
+
+import json
+import os
+import sys
+
+sys.path.insert(0, os.path.dirname(os.path.abspath(__file__)))
+
+
+def main():
+    import jax
+
+    from tools.pretrain_llama import main as pretrain_main
+
+    platform = jax.devices()[0].platform
+    if platform == "cpu":
+        args = ["--config", "tiny", "--steps", "3"]
+    else:
+        args = ["--config", "proxy1b", "--steps", "12", "--batch", "8",
+                "--seq", "2048", "--remat"]
+    import contextlib
+    import io
+
+    buf = io.StringIO()
+    with contextlib.redirect_stdout(buf):
+        rc = pretrain_main(args)
+    if rc:
+        return rc
+    rec = json.loads(buf.getvalue().strip().splitlines()[-1])
+    print(json.dumps({
+        "metric": "llama_proxy_pretrain_tokens_per_sec_per_chip",
+        "value": rec["tokens_per_sec"],
+        "unit": "tokens/sec",
+        "params": rec["params"],
+        "mfu": rec["mfu"],
+        "final_loss": rec["final_loss"],
+    }))
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -33,7 +33,38 @@ from ..ndarray import NDArray
 from ..ndarray.ndarray import _wrap_jax, imperative_invoke, _LambdaOp
 from .parameter import DeferredInitializationError, Parameter, ParameterDict
 
-__all__ = ["Block", "HybridBlock", "SymbolBlock", "nested_flatten_nd"]
+__all__ = ["Block", "HybridBlock", "SymbolBlock", "nested_flatten_nd",
+           "remat_call"]
+
+
+def remat_call(block, *args):
+    """Call ``block`` under ``jax.checkpoint`` when inside a live trace.
+
+    Gradient rematerialization for big models (SURVEY.md §7.2 "remat
+    policy"): inside a compiled train step the block's activations are
+    recomputed in the backward pass instead of saved — HBM for FLOPs, the
+    standard trade for transformer trunks. Parameters reach the block as
+    closed-over trace inputs and stay saved; only intra-block activations
+    are recomputed. Outside a trace (eager) this is a plain call: eager
+    autograd replays the graph anyway, so there is nothing to save.
+    """
+    import jax
+
+    from ..ndarray import NDArray
+
+    if not args or not isinstance(args[0].data, jax.core.Tracer):
+        return block(*args)
+    ctx = args[0].context
+
+    def _pure(*vals):
+        out = block(*[NDArray(data=v, ctx=ctx) for v in vals])
+        flat, tree = nested_flatten_nd(out)
+        _pure.tree = tree
+        return tuple(o.data for o in flat)
+
+    out_vals = jax.checkpoint(_pure)(*[a.data for a in args])
+    out_nd = [NDArray(data=v, ctx=ctx) for v in out_vals]
+    return nested_unflatten_nd(_pure.tree, out_nd)
 
 
 class _BlockScope(threading.local):

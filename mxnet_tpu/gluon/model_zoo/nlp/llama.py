@@ -119,9 +119,12 @@ class LlamaModel(HybridBlock):
     def __init__(self, vocab_size=128256, num_layers=32, units=4096,
                  hidden_size=14336, num_heads=32, num_kv_heads=8,
                  rope_theta=500000.0, eps=1e-5, tie_weights=False,
-                 ring_axis=None, prefix=None, params=None):
+                 ring_axis=None, remat=False, prefix=None, params=None):
         super().__init__(prefix=prefix, params=params)
         self._units = units
+        # per-block gradient rematerialization (jax.checkpoint) inside
+        # compiled train steps — pretrain-scale memory policy
+        self._remat = bool(remat)
         with self.name_scope():
             self.embed = nn.Embedding(vocab_size, units, prefix="embed_")
             self.blocks = []
@@ -142,9 +145,11 @@ class LlamaModel(HybridBlock):
                                         use_bias=False, prefix="lm_head_")
 
     def hybrid_forward(self, F, tokens):
+        from ...block import remat_call
+
         x = self.embed(tokens)
         for blk in self.blocks:
-            x = blk(x)
+            x = remat_call(blk, x) if self._remat else blk(x)
         return self.lm_head(self.norm(x))
 
 

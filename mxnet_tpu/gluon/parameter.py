@@ -23,7 +23,31 @@ from ..context import Context, cpu, current_context
 from ..ndarray import NDArray, array as nd_array, zeros as nd_zeros
 from ..ndarray import ndarray as _ndarray_mod
 
-__all__ = ["Parameter", "Constant", "ParameterDict", "DeferredInitializationError"]
+__all__ = ["Parameter", "Constant", "ParameterDict",
+           "DeferredInitializationError", "abstract_init"]
+
+_ABSTRACT_INIT = [False]
+
+
+class abstract_init:
+    """Context: parameters initialize as zero-cost abstract placeholders.
+
+    For AOT compilation of models too large to materialize on the host
+    (e.g. validating an 8B-parameter sharded train step on a laptop-sized
+    machine): inside the context, ``_finish_init`` records shape/dtype and
+    stores abstract data instead of running the initializer. Such
+    parameters cannot be read — only their shapes/dtypes feed
+    ``jax.ShapeDtypeStruct``-based lowering (TrainStep.aot_compile).
+    """
+
+    def __enter__(self):
+        self._prev = _ABSTRACT_INIT[0]
+        _ABSTRACT_INIT[0] = True
+        return self
+
+    def __exit__(self, *exc):
+        _ABSTRACT_INIT[0] = self._prev
+        return False
 
 
 class DeferredInitializationError(MXNetError):
@@ -108,23 +132,45 @@ class Parameter:
             ctx = [current_context()]
         if isinstance(ctx, Context):
             ctx = [ctx]
+        if _ABSTRACT_INIT[0]:
+            # abstract-AOT mode: defer even known-shape params so their
+            # placeholder data is created inside the settle trace, where
+            # the zeros are free abstract values (no 2 GB embedding tables
+            # materializing on the host). The flag is CAPTURED here so the
+            # param stays abstract even if it resolves after the
+            # abstract_init context has exited (aot_compile's settle).
+            self._deferred_init = (init, list(ctx), default_init, True)
+            return
         if self._shape is None or any(s <= 0 for s in self._shape):
             if self.allow_deferred_init:
-                self._deferred_init = (init, list(ctx), default_init)
+                self._deferred_init = (init, list(ctx), default_init, False)
                 return
             raise MXNetError(
                 f"cannot initialize Parameter {self.name} with unknown shape "
                 f"{self._shape}; set allow_deferred_init=True or give the shape")
         self._finish_init(init, list(ctx), default_init)
 
-    def _finish_init(self, init, ctx_list, default_init):
+    def _finish_init(self, init, ctx_list, default_init, abstract=False):
+        import jax
+
+        if abstract or _ABSTRACT_INIT[0]:
+            # abstract placeholder: shape/dtype only, no initializer run —
+            # inside a live trace the zeros are a free abstract value, and
+            # the payload is only ever used as a slot (make_pure_fn swaps
+            # real/traced values in before any read)
+            import jax.numpy as jnp
+
+            self._data = OrderedDict(
+                (c, NDArray(data=jnp.zeros(self._shape,
+                                           dtype=str(self.dtype)), ctx=c))
+                for c in ctx_list)
+            self._deferred_init = None
+            return
         # Deferred init can resolve while a trace is live (TrainStep's
         # eval_shape settle, hybridize tracing). Initializer values are
         # concrete by construction; ensure_compile_time_eval keeps the raw
         # jnp calls inside initializers/__setitem__ from being captured as
         # tracers by the surrounding trace.
-        import jax
-
         with jax.ensure_compile_time_eval():
             self._finish_init_concrete(init, ctx_list, default_init)
 
@@ -149,8 +195,13 @@ class Parameter:
         if self._shape is None or any(s <= 0 for s in self._shape):
             raise DeferredInitializationError(
                 f"Parameter {self.name} shape still unknown: {self._shape}")
-        init, ctx_list, default_init = self._deferred_init
-        self._finish_init(init, ctx_list, default_init)
+        deferred = self._deferred_init
+        if len(deferred) == 4:
+            init, ctx_list, default_init, abstract = deferred
+        else:  # legacy 3-tuple
+            init, ctx_list, default_init = deferred
+            abstract = False
+        self._finish_init(init, ctx_list, default_init, abstract=abstract)
 
     def _init_grad(self):
         self._grad = OrderedDict()
@@ -175,6 +226,11 @@ class Parameter:
                 f"it lives on {list(self._data)}")
 
     def data(self, ctx: Optional[Context] = None) -> NDArray:
+        if self._data is None and self._deferred_init is not None \
+                and self._shape and all(s > 0 for s in self._shape):
+            # known-shape deferred param resolves on first touch (covers
+            # abstract_init, which defers everything)
+            self._finish_deferred_init()
         self._check_initialized(ctx)
         if ctx is None:
             return next(iter(self._data.values()))

@@ -26,6 +26,7 @@ from typing import Dict, List, Optional, Sequence
 
 from .. import optimizer as opt_mod
 from .. import random_state, tracing
+from ..base import MXNetError
 from ..context import current_context
 from ..ndarray import NDArray
 from ..gluon.block import make_pure_fn, nested_flatten_nd, nested_unflatten_nd
@@ -91,37 +92,46 @@ class TrainStep:
         self._state_meta = None      # per-trainable (treedef, n_leaves, shapes)
 
     # -- setup ----------------------------------------------------------
+    def _abstract_settle(self, shape_vals, fallback=None):
+        """Resolve deferred parameter shapes with an eval_shape probe.
+
+        Shape inference is host-side — nothing is computed (parameter
+        initializers still run concretely when a deferred init resolves,
+        unless the param was built under ``abstract_init``). The probe
+        must not advance the global PRNG stream with traced keys
+        (rng-consuming ops like Dropout run under the trace), so the
+        stream state is snapshotted and restored. ``fallback`` (the eager
+        forward — the reference move, HybridBlock.__call__ on
+        DeferredInitializationError) covers blocks whose forward needs
+        concrete values.
+        """
+        import jax
+
+        net = self.net
+
+        def _shape_probe(*vals):
+            ctx = current_context()
+            nds = [NDArray(data=v, ctx=ctx) for v in vals]
+            net(*nds)
+            return 0
+
+        st = random_state._global()
+        saved_keys = dict(st.keys)
+        try:
+            jax.eval_shape(_shape_probe, *shape_vals)
+        except Exception:
+            if fallback is None:
+                raise
+            fallback()
+        finally:
+            st.keys = saved_keys
+
     def _settle_params(self, data_tuple):
         params = list(self.net.collect_params().values())
         if any(p._data is None for p in params):
-            # deferred shapes: an abstract forward settles them without
-            # computing anything (shape inference is host-side; parameter
-            # initializers still run concretely when the deferred init
-            # resolves). Falls back to the eager forward — the reference
-            # move, HybridBlock.__call__ on DeferredInitializationError —
-            # for blocks whose forward needs concrete values.
-            import jax
-
             net = self.net
-
-            def _shape_probe(*vals):
-                ctx = current_context()
-                nds = [NDArray(data=v, ctx=ctx) for v in vals]
-                net(*nds)
-                return 0
-
-            # the probe must not advance the global PRNG stream with traced
-            # keys (rng-consuming ops like Dropout run under the trace);
-            # snapshot the stream state and restore it after
-            st = random_state._global()
-            saved_keys = dict(st.keys)
-            try:
-                jax.eval_shape(_shape_probe,
-                               *[v.data for v in data_tuple])
-            except Exception:
-                net(*data_tuple)
-            finally:
-                st.keys = saved_keys
+            self._abstract_settle([v.data for v in data_tuple],
+                                  fallback=lambda: net(*data_tuple))
             if any(p._data is None
                    for p in net.collect_params().values()):
                 net(*data_tuple)
@@ -146,20 +156,25 @@ class TrainStep:
             arr._set_data(
                 jax.device_put(arr.data, named_sharding(self.mesh, spec)))
 
-    def _init_states(self):
+    def _make_state_builder(self):
+        """The batched optimizer-state constructor + its treedef slots.
+
+        ONE traced function builds every state leaf: building states
+        eagerly costs hundreds of tiny device round-trips (~minutes of
+        first-step latency through a remote TPU relay; PERF.md round 3).
+        Shared by _init_states (jit, concrete) and aot_compile
+        (eval_shape, abstract) so the state layout can't diverge between
+        live training and AOT memory analysis.
+        """
         import jax
-        from jax.sharding import PartitionSpec as P
 
         is_leaf = lambda x: x is None or isinstance(x, NDArray)
         optimizer = self.optimizer
         trainable = list(self._trainable)
-        params = self._params
-        ctx = params[0].data().context if params else current_context()
+        ctx = self._params[0].data().context if self._params \
+            else current_context()
         treedefs = [None] * len(trainable)
 
-        # ONE compiled dispatch for the whole state tree: building states
-        # eagerly costs hundreds of tiny device round-trips (~minutes of
-        # first-step latency through a remote TPU relay; PERF.md round 3).
         def _all_states(param_vals):
             flat = []
             for k, i in enumerate(trainable):
@@ -171,29 +186,43 @@ class TrainStep:
                                   for leaf in leaves))
             return tuple(flat)
 
-        param_data = tuple(params[i].data().data for i in trainable)
-        # out_shardings: computed per leaf after a shape-only trace would
-        # need the tree; simpler and still single-dispatch — shard after
-        with jax.transfer_guard("allow"):
-            all_leaves = jax.jit(_all_states)(param_data)
+        return _all_states, treedefs, ctx
+
+    def _state_layout(self, k, i, leaves, treedef, on_leaf):
+        """Per-param state-leaf layout: ``(treedef, present, specs)`` meta
+        entry, calling ``on_leaf(leaf, leaf_spec)`` for each present leaf.
+        The rule: a leaf shaped like its param shards like the param;
+        everything else (scalars, row stats) replicates."""
+        from jax.sharding import PartitionSpec as P
+
+        p = self._params[i]
+        spec = self._param_specs[i]
+        present = [leaf is not None for leaf in leaves]
+        specs = []
+        for leaf in leaves:
+            if leaf is None:
+                continue
+            leaf_spec = spec if tuple(leaf.shape) == tuple(p.shape) else P()
+            specs.append(leaf_spec)
+            on_leaf(leaf, leaf_spec)
+        return (treedef, present, specs)
+
+    def _init_states(self):
+        import jax
+
+        _all_states, treedefs, ctx = self._make_state_builder()
+        trainable = list(self._trainable)
+        param_data = tuple(self._params[i].data().data for i in trainable)
+        all_leaves = jax.jit(_all_states)(param_data)
 
         leaf_nds: List[NDArray] = []
         meta = []
         for k, i in enumerate(trainable):
-            p = params[i]
-            spec = self._param_specs[i]
-            leaves = all_leaves[k]
-            present = [leaf is not None for leaf in leaves]
-            specs = []
-            for leaf in leaves:
-                if leaf is None:
-                    continue
-                leaf_spec = spec if tuple(leaf.shape) == tuple(p.shape) else P()
-                nd_leaf = NDArray(data=jax.device_put(
-                    leaf, named_sharding(self.mesh, leaf_spec)), ctx=ctx)
-                specs.append(leaf_spec)
-                leaf_nds.append(nd_leaf)
-            meta.append((treedefs[k], present, specs))
+            meta.append(self._state_layout(
+                k, i, all_leaves[k], treedefs[k],
+                lambda leaf, spec: leaf_nds.append(NDArray(
+                    data=jax.device_put(
+                        leaf, named_sharding(self.mesh, spec)), ctx=ctx))))
         self._state_leaf_nds = leaf_nds
         self._state_meta = meta
 
@@ -303,6 +332,105 @@ class TrainStep:
         return {"jitted": jitted, "cell": cell, "batch_sh": batch_sh,
                 "loss_only": loss_only}
 
+    def aot_compile(self, data, label=()):
+        """AOT-compile the sharded train step on ABSTRACT parameters.
+
+        For validating recipes whose weights don't fit the host (e.g. the
+        Llama-3-8B stretch config on a dev box): the net must have been
+        built and "initialized" under ``gluon.parameter.abstract_init()``.
+        Settle, state layout, step build, lowering and XLA compilation all
+        run the normal TrainStep code path — only buffers never
+        materialize. Returns the ``jax.stages.Compiled`` executable
+        (``.memory_analysis()`` gives per-device HBM numbers).
+
+        ``data``/``label``: host-shaped template NDArrays or
+        ``jax.ShapeDtypeStruct``s describing one global batch.
+        """
+        import jax
+
+        data_tuple = _as_tuple(data)
+        label_tuple = _as_tuple(label)
+
+        def _struct(v):
+            if isinstance(v, jax.ShapeDtypeStruct):
+                return v
+            return jax.ShapeDtypeStruct(tuple(v.shape),
+                                        jax.numpy.dtype(str(v.dtype)))
+
+        batch_structs = [_struct(v) for v in data_tuple + label_tuple]
+
+        # settle (abstract): eval_shape probe resolves deferred shapes with
+        # zero-cost placeholder data (no eager fallback — AOT nets must
+        # settle abstractly by definition)
+        net = self.net
+        params = list(net.collect_params().values())
+        if any(p._data is None for p in params):
+            self._abstract_settle(batch_structs[:len(data_tuple)])
+        self._params = params = list(net.collect_params().values())
+        self._trainable = [i for i, p in enumerate(params)
+                           if p.grad_req != "null"]
+        self.optimizer.param_dict = {
+            k: params[i] for k, i in enumerate(self._trainable)}
+        self._param_specs = [
+            spec_for_param(p.name, p.shape, self.rules, self.mesh)
+            for p in params]
+        # this instance now holds abstract params and no live state
+        # buffers — it can compile but never execute
+        self._aot_only = True
+
+        # optimizer states: shape-only evaluation of the SAME batched
+        # state builder _init_states compiles
+        _all_states, treedefs, ctx = self._make_state_builder()
+        trainable = list(self._trainable)
+        param_structs = tuple(
+            jax.ShapeDtypeStruct(tuple(p.shape),
+                                 jax.numpy.dtype(str(p.dtype)))
+            for p in params)
+        train_structs = tuple(param_structs[i] for i in trainable)
+        state_shapes = jax.eval_shape(_all_states, train_structs)
+
+        state_structs = []
+        meta = []
+        for k, i in enumerate(trainable):
+            meta.append(self._state_layout(
+                k, i, state_shapes[k], treedefs[k],
+                lambda leaf, spec: state_structs.append(
+                    jax.ShapeDtypeStruct(
+                        tuple(leaf.shape), leaf.dtype,
+                        sharding=named_sharding(self.mesh, spec)))))
+        self._state_meta = meta
+        self._state_leaf_nds = []  # aot: no live state buffers
+
+        entry = self._build(
+            tuple(NDArray(data=s, ctx=ctx) for s in
+                  batch_structs[:len(data_tuple)]),
+            tuple(NDArray(data=s, ctx=ctx) for s in
+                  batch_structs[len(data_tuple):]),
+            True)
+
+        import numpy as np
+
+        param_sharded = tuple(
+            jax.ShapeDtypeStruct(s.shape, s.dtype,
+                                 sharding=named_sharding(self.mesh, spec))
+            for s, spec in zip(param_structs, self._param_specs))
+        t = jax.ShapeDtypeStruct((), np.int32)
+        lr = jax.ShapeDtypeStruct((), np.float32)
+        key = random_state.get_state_key()
+        rng = jax.ShapeDtypeStruct(tuple(key.shape), key.dtype)
+        batch_in = tuple(
+            jax.ShapeDtypeStruct(s.shape, s.dtype, sharding=sh)
+            for s, sh in zip(batch_structs, entry["batch_sh"]))
+
+        from ..base import execution_platform
+        from .mesh import use_mesh
+
+        with execution_platform(self.mesh.devices.flat[0].platform), \
+                use_mesh(self.mesh):
+            lowered = entry["jitted"].lower(
+                param_sharded, tuple(state_structs), t, lr, rng, *batch_in)
+            return lowered.compile()
+
     def stage_batch(self, data, label=()):
         """Place host batches on the mesh with this step's input sharding.
 
@@ -320,6 +448,11 @@ class TrainStep:
     def __call__(self, data, label):
         import jax
 
+        if getattr(self, "_aot_only", False):
+            raise MXNetError(
+                "this TrainStep was used for aot_compile (abstract "
+                "parameters, no optimizer state buffers); build a fresh "
+                "TrainStep on a concretely initialized net to train")
         data_tuple = _as_tuple(data)
         label_tuple = _as_tuple(label)
         if self._params is None:

@@ -1,0 +1,102 @@
+"""AOT compilation of the sharded train step on abstract parameters.
+
+The Llama-3-8B stretch recipe (BASELINE.json config[4]) is validated by
+compiling — not executing — the full sharded TrainStep for meshes/host
+sizes that can't hold the weights. These tests pin that machinery at tiny
+size: abstract_init produces zero-cost placeholders, aot_compile runs the
+normal settle/state/build/lower path, and the instance refuses to train.
+"""
+import numpy as np
+import pytest
+
+import mxnet_tpu as mx
+from mxnet_tpu import parallel as par
+from mxnet_tpu.base import MXNetError
+from mxnet_tpu.gluon import loss as gloss
+from mxnet_tpu.gluon.parameter import abstract_init
+from mxnet_tpu.gluon.model_zoo.nlp.llama import (
+    LlamaModel, llama_sharding_rules)
+
+
+def _build_abstract_net():
+    with abstract_init():
+        net = LlamaModel(vocab_size=256, num_layers=2, units=64,
+                         hidden_size=128, num_heads=4, num_kv_heads=2,
+                         remat=True)
+        net.initialize()
+    return net
+
+
+def _aot(net, axes):
+    import jax
+    import jax.numpy as jnp
+
+    mesh = par.make_mesh(axes)
+    step = par.TrainStep(
+        net, lambda outs, l: gloss.SoftmaxCrossEntropyLoss()(
+            (outs[0] if isinstance(outs, (list, tuple)) else outs)
+            .reshape(-1, 256), l.reshape(-1)),
+        "adamw", mesh=mesh, rules=llama_sharding_rules(),
+        loss_only=True,
+        optimizer_params={"learning_rate": 1e-4, "multi_precision": True})
+    tok = jax.ShapeDtypeStruct((4, 128), jnp.int32)
+    lbl = jax.ShapeDtypeStruct((4, 128), jnp.float32)
+    return step, step.aot_compile(tok, lbl)
+
+
+def test_abstract_init_never_materializes():
+    net = _build_abstract_net()
+    # nothing concrete was allocated: every param is still deferred, and
+    # the captured flag keeps it abstract even outside the context
+    for p in net.collect_params().values():
+        assert p._data is None
+        assert p._deferred_init is not None and p._deferred_init[-1] is True
+
+
+def test_aot_compile_outside_context_stays_abstract():
+    import jax
+
+    net = _build_abstract_net()
+    step, compiled = _aot(net, {"dp": 2, "tp": 2, "sp": 2})
+    assert compiled is not None
+    # placeholders resolved inside the settle trace — no concrete arrays
+    for p in net.collect_params().values():
+        assert isinstance(p.data().data, jax.core.Tracer)
+
+
+def test_aot_instance_refuses_to_train():
+    net = _build_abstract_net()
+    step, _ = _aot(net, {"dp": 2, "tp": 2, "sp": 2})
+    tok = mx.nd.array(np.zeros((4, 128), dtype=np.int32))
+    lbl = mx.nd.array(np.zeros((4, 128), dtype=np.float32))
+    with pytest.raises(MXNetError, match="aot_compile"):
+        step(tok, lbl)
+
+
+def test_aot_state_layout_matches_live_training():
+    """The AOT state metadata must match what a live TrainStep builds —
+    the memory analysis is worthless if the layouts diverge."""
+    import jax
+
+    net = _build_abstract_net()
+    step, _ = _aot(net, {"dp": 2, "tp": 2, "sp": 2})
+
+    live_net = LlamaModel(vocab_size=256, num_layers=2, units=64,
+                          hidden_size=128, num_heads=4, num_kv_heads=2)
+    live_net.initialize()
+    mesh = par.make_mesh({"dp": 2, "tp": 2, "sp": 2})
+    live = par.TrainStep(
+        live_net, lambda outs, l: gloss.SoftmaxCrossEntropyLoss()(
+            (outs[0] if isinstance(outs, (list, tuple)) else outs)
+            .reshape(-1, 256), l.reshape(-1)),
+        "adamw", mesh=mesh, rules=llama_sharding_rules(),
+        loss_only=True,
+        optimizer_params={"learning_rate": 1e-4, "multi_precision": True})
+    tok = mx.nd.array(np.zeros((4, 128), dtype=np.int32))
+    lbl = mx.nd.array(np.zeros((4, 128), dtype=np.float32))
+    live(tok, lbl)
+
+    assert len(step._state_meta) == len(live._state_meta)
+    for (_, p1, s1), (_, p2, s2) in zip(step._state_meta, live._state_meta):
+        assert p1 == p2
+        assert [tuple(s) for s in s1] == [tuple(s) for s in s2]

@@ -68,7 +68,8 @@ def build_resnet_step():
     from mxnet_tpu.gluon.model_zoo.vision import resnet50_v1
 
     batch = 256
-    net = resnet50_v1(classes=1000)
+    net = resnet50_v1(classes=1000,
+                      layout=os.environ.get("RESNET_LAYOUT", "NHWC"))
     net.initialize()
     net.cast("bfloat16")
     rs = np.random.RandomState(0)
