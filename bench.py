@@ -78,6 +78,7 @@ def main():
         "vs_baseline": round(img_s / BASELINE_IMG_S, 4),
     }
     record.update(_bert_extra())
+    record.update(_llama_extra())
     print(json.dumps(record))
 
 
@@ -101,6 +102,30 @@ def _bert_extra():
             "bert_samples_per_sec_per_chip": rec["value"],
             "bert_vs_baseline": rec["vs_baseline"],
             "bert_mfu": rec.get("mfu"),
+        }
+    except Exception:
+        return {}
+
+
+def _llama_extra():
+    """Third headline: Llama pretrain proxy (bench_llama.py)."""
+    import json as _json
+    import os
+    import subprocess
+
+    if os.environ.get("BENCH_SKIP_LLAMA"):
+        return {}
+    try:
+        out = subprocess.run(
+            [sys.executable, os.path.join(os.path.dirname(
+                os.path.abspath(__file__)), "bench_llama.py")],
+            capture_output=True, text=True, timeout=1500)
+        line = out.stdout.strip().splitlines()[-1]
+        rec = _json.loads(line)
+        return {
+            "llama_proxy_tokens_per_sec_per_chip": rec["value"],
+            "llama_proxy_params": rec.get("params"),
+            "llama_proxy_mfu": rec.get("mfu"),
         }
     except Exception:
         return {}

@@ -157,9 +157,20 @@ class Parameter:
             # abstract placeholder: shape/dtype only, no initializer run —
             # inside a live trace the zeros are a free abstract value, and
             # the payload is only ever used as a slot (make_pure_fn swaps
-            # real/traced values in before any read)
+            # real/traced values in before any read). EAGER resolution
+            # (no live trace) would silently materialize dense zeros —
+            # multi-GB for the weights this mode exists for, and all-zero
+            # checkpoints if saved — so it is an error instead.
             import jax.numpy as jnp
 
+            # live-trace probe: under omnistaging a 0-size zeros is a
+            # tracer inside any trace and a concrete array outside
+            if not isinstance(jnp.zeros((0,)), jax.core.Tracer):
+                raise MXNetError(
+                    f"Parameter {self.name} was built under "
+                    "abstract_init() and holds no values; it can only be "
+                    "used through TrainStep.aot_compile (eager reads "
+                    "would materialize meaningless zeros)")
             self._data = OrderedDict(
                 (c, NDArray(data=jnp.zeros(self._shape,
                                            dtype=str(self.dtype)), ctx=c))

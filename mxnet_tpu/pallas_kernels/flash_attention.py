@@ -534,7 +534,10 @@ def _bwd_dq_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref,
 
 
 def _flash_bwd_pallas(q, k, v, o, lse, g, scale, causal, interpret=False,
-                      layout="bhld"):
+                      layout="bhld", delta=None):
+    """``delta``: optional precomputed rowsum(dO*O) of shape (B*H, Lq)
+    f32 — ring attention passes the GLOBAL delta so per-pair calls don't
+    recompute it; ``o`` may then be None."""
     from jax.experimental import pallas as pl
     from jax.experimental.pallas import tpu as pltpu
 
@@ -546,18 +549,20 @@ def _flash_bwd_pallas(q, k, v, o, lse, g, scale, causal, interpret=False,
         k = k.reshape(bh, lk, d)
         v = v.reshape(bh, lk, d)
         do = g.reshape(bh, lq, d)
-        do_f32 = do.astype(jnp.float32)
-        o_f32 = o.reshape(bh, lq, d).astype(jnp.float32)
+        if delta is None:
+            do_f32 = do.astype(jnp.float32)
+            o_f32 = o.reshape(bh, lq, d).astype(jnp.float32)
         dq_shape = jax.ShapeDtypeStruct((bh, lq, d), q.dtype)
         dk_shape = jax.ShapeDtypeStruct((bh, lk, d), k.dtype)
         dv_shape = jax.ShapeDtypeStruct((bh, lk, d), v.dtype)
     else:
         do = g
         # (B, L, H, D) -> (BH, L) rowsums for delta
-        do_f32 = g.astype(jnp.float32).transpose(0, 2, 1, 3).reshape(
-            bh, lq, d)
-        o_f32 = o.astype(jnp.float32).transpose(0, 2, 1, 3).reshape(
-            bh, lq, d)
+        if delta is None:
+            do_f32 = g.astype(jnp.float32).transpose(0, 2, 1, 3).reshape(
+                bh, lq, d)
+            o_f32 = o.astype(jnp.float32).transpose(0, 2, 1, 3).reshape(
+                bh, lq, d)
         dq_shape = jax.ShapeDtypeStruct((b, lq, h, d), q.dtype)
         dk_shape = jax.ShapeDtypeStruct((b, lk, h, d), k.dtype)
         dv_shape = jax.ShapeDtypeStruct((b, lk, h, d), v.dtype)
@@ -565,7 +570,8 @@ def _flash_bwd_pallas(q, k, v, o, lse, g, scale, causal, interpret=False,
     nq, nk = lq // bq, lk // bk
     # delta_i = rowsum(dO_i * O_i) — cheap, fused by XLA outside the
     # kernel; stored in the same sublane-padded layout as lse
-    delta = jnp.sum(do_f32 * o_f32, axis=-1)
+    if delta is None:
+        delta = jnp.sum(do_f32 * o_f32, axis=-1)
     delta = jnp.broadcast_to(delta.reshape(bh, nq, 1, bq),
                              (bh, nq, 8, bq))
     offset = lk - lq

@@ -126,6 +126,26 @@ class TrainStep:
         finally:
             st.keys = saved_keys
 
+    def _bind_params(self):
+        """Record the settled parameter list, trainable ordinals,
+        optimizer param_dict and per-param shardings — shared by the live
+        path and aot_compile so the two can't diverge.
+
+        Per-param lr_mult/wd_mult flow through the optimizer's
+        param_dict, keyed by the SAME trainable ordinals update() is
+        called with (mirrors Trainer._init_optimizer wiring).
+        """
+        params = list(self.net.collect_params().values())
+        self._params = params
+        self._trainable = [i for i, p in enumerate(params)
+                           if p.grad_req != "null"]
+        self.optimizer.param_dict = {
+            k: params[i] for k, i in enumerate(self._trainable)}
+        self._param_specs = [
+            spec_for_param(p.name, p.shape, self.rules, self.mesh)
+            for p in params]
+        return params
+
     def _settle_params(self, data_tuple):
         params = list(self.net.collect_params().values())
         if any(p._data is None for p in params):
@@ -135,18 +155,7 @@ class TrainStep:
             if any(p._data is None
                    for p in net.collect_params().values()):
                 net(*data_tuple)
-            params = list(self.net.collect_params().values())
-        self._params = params
-        self._trainable = [i for i, p in enumerate(params)
-                           if p.grad_req != "null"]
-        # per-param lr_mult/wd_mult flow through the optimizer's param_dict,
-        # keyed by the SAME trainable ordinals update() is called with
-        # (mirrors Trainer._init_optimizer wiring at trainer.py)
-        self.optimizer.param_dict = {
-            k: params[i] for k, i in enumerate(self._trainable)}
-        self._param_specs = [
-            spec_for_param(p.name, p.shape, self.rules, self.mesh)
-            for p in params]
+        params = self._bind_params()
         # lay params out on the mesh once (single-process view: one NDArray
         # per param; its payload becomes a sharded global jax.Array)
         import jax
@@ -213,7 +222,10 @@ class TrainStep:
         _all_states, treedefs, ctx = self._make_state_builder()
         trainable = list(self._trainable)
         param_data = tuple(self._params[i].data().data for i in trainable)
-        all_leaves = jax.jit(_all_states)(param_data)
+        # transfer-guard exemption: the builder may implicitly move host
+        # scalars/param copies across platforms (remote-relay context)
+        with jax.transfer_guard("allow"):
+            all_leaves = jax.jit(_all_states)(param_data)
 
         leaf_nds: List[NDArray] = []
         meta = []
@@ -366,14 +378,7 @@ class TrainStep:
         params = list(net.collect_params().values())
         if any(p._data is None for p in params):
             self._abstract_settle(batch_structs[:len(data_tuple)])
-        self._params = params = list(net.collect_params().values())
-        self._trainable = [i for i, p in enumerate(params)
-                           if p.grad_req != "null"]
-        self.optimizer.param_dict = {
-            k: params[i] for k, i in enumerate(self._trainable)}
-        self._param_specs = [
-            spec_for_param(p.name, p.shape, self.rules, self.mesh)
-            for p in params]
+        params = self._bind_params()
         # this instance now holds abstract params and no live state
         # buffers — it can compile but never execute
         self._aot_only = True
@@ -416,7 +421,14 @@ class TrainStep:
             for s, spec in zip(param_structs, self._param_specs))
         t = jax.ShapeDtypeStruct((), np.int32)
         lr = jax.ShapeDtypeStruct((), np.float32)
-        key = random_state.get_state_key()
+        # key shape/dtype only — snapshot the stream so the compile leaves
+        # the program's random sequence untouched (reproducibility)
+        st = random_state._global()
+        saved_keys = dict(st.keys)
+        try:
+            key = random_state.get_state_key()
+        finally:
+            st.keys = saved_keys
         rng = jax.ShapeDtypeStruct(tuple(key.shape), key.dtype)
         batch_in = tuple(
             jax.ShapeDtypeStruct(s.shape, s.dtype, sharding=sh)

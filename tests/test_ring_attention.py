@@ -136,3 +136,120 @@ class TestShardingPreservation:
                                1.0 / onp.sqrt(8), True)
         onp.testing.assert_allclose(out.asnumpy(), onp.asarray(want),
                                     rtol=1e-5, atol=1e-6)
+
+
+class TestMemoryScaling:
+    def test_no_full_L_residual_in_backward(self):
+        """Round-3 upgrade (VERDICT #4): training through ring attention
+        must keep O(L_local) residuals — the old implementation saved the
+        rotating K/V scan carries, a stacked (n_ring, B, H, L_local, D)
+        tensor = full L per device. Walk the gradient jaxpr (recursively,
+        shard_map/scan bodies included) and assert no intermediate holds
+        n_ring x the shard size."""
+        mesh = par.make_mesh({"sp": 8}, devices=jax.devices()[:8])
+        b, h, l, d = 1, 2, 256, 16
+        n_ring = 8
+        shard_elems = b * h * (l // n_ring) * d
+
+        q = jnp.ones((b, h, l, d), jnp.float32)
+
+        def loss(q, k, v):
+            return par.ring_attention(q, k, v, mesh=mesh,
+                                      causal=True).sum()
+
+        jaxpr = jax.make_jaxpr(jax.grad(loss, argnums=(0, 1, 2)))(q, q, q)
+
+        def as_jaxpr(val):
+            # duck-typed: ClosedJaxpr has .jaxpr, Jaxpr has .eqns
+            if hasattr(val, "jaxpr"):
+                val = val.jaxpr
+            return val if hasattr(val, "eqns") else None
+
+        def subjaxprs(eqn):
+            for val in eqn.params.values():
+                items = val if isinstance(val, (tuple, list)) else (val,)
+                for item in items:
+                    sub = as_jaxpr(item)
+                    if sub is not None:
+                        yield sub
+
+        def max_size(jx):
+            worst = 0
+            for eqn in jx.eqns:
+                for sub in subjaxprs(eqn):
+                    worst = max(worst, max_size(sub))
+                for var in list(eqn.outvars) + list(eqn.invars):
+                    aval = getattr(var, "aval", None)
+                    if aval is None or not hasattr(aval, "size"):
+                        continue
+                    worst = max(worst, int(aval.size))
+            return worst
+
+        worst = max_size(jaxpr.jaxpr)
+        # global arrays at the shard_map boundary are b*h*l*d = n *
+        # shard; a stacked scan residual would be n * that again
+        assert worst <= n_ring * shard_elems, \
+            f"found {worst}-element intermediate (> {n_ring}x shard)"
+
+    def test_8k_tokens_on_cpu_mesh(self):
+        """Long-context smoke: 8192 tokens ring-sharded over 8 devices,
+        forward AND backward, vs the dense oracle."""
+        mesh = par.make_mesh({"sp": 8}, devices=jax.devices()[:8])
+        rs = onp.random.RandomState(0)
+        b, h, l, d = 1, 1, 8192, 64
+        q = jnp.asarray(rs.randn(b, h, l, d), jnp.float32)
+        k = jnp.asarray(rs.randn(b, h, l, d), jnp.float32)
+        v = jnp.asarray(rs.randn(b, h, l, d), jnp.float32)
+
+        def ring_loss(q, k, v):
+            out = par.ring_attention(q, k, v, mesh=mesh, causal=True)
+            return (out * out).sum()
+
+        def dense_loss(q, k, v):
+            out = _sdpa_reference(q, k, v, None, 1.0 / onp.sqrt(d), True)
+            return (out * out).sum()
+
+        g_ring = jax.grad(ring_loss, argnums=(0, 1, 2))(q, k, v)
+        g_dense = jax.grad(dense_loss, argnums=(0, 1, 2))(q, k, v)
+        for gr, gd in zip(g_ring, g_dense):
+            onp.testing.assert_allclose(onp.asarray(gr), onp.asarray(gd),
+                                        rtol=2e-3, atol=2e-3)
+
+
+    def test_kernel_path_matches_einsum_path(self, monkeypatch):
+        """The Pallas-kernel per-pair path (used on TPU) must compute the
+        same ring as the einsum path — exercised here via interpret mode."""
+        import functools
+        import importlib
+
+        # the parallel package re-exports the ring_attention FUNCTION
+        # under the same name; get the module itself
+        ra = importlib.import_module(
+            "mxnet_tpu.parallel.ring_attention")
+
+        mesh = par.make_mesh({"sp": 4}, devices=jax.devices()[:4])
+        rs = onp.random.RandomState(1)
+        b, h, l, d = 1, 2, 512, 32
+        q = jnp.asarray(rs.randn(b, h, l, d), jnp.float32)
+        k = jnp.asarray(rs.randn(b, h, l, d), jnp.float32)
+        v = jnp.asarray(rs.randn(b, h, l, d), jnp.float32)
+
+        def loss(q, k, v):
+            out = par.ring_attention(q, k, v, mesh=mesh, causal=True)
+            return (out * out).sum()
+
+        g_einsum = jax.grad(loss, argnums=(0, 1, 2))(q, k, v)
+
+        orig_fwd, orig_bwd = ra._pair_fwd, ra._pair_bwd
+        monkeypatch.setattr(ra, "_use_kernel", lambda *a: True)
+        monkeypatch.setattr(
+            ra, "_pair_fwd",
+            functools.partial(orig_fwd, interpret=True))
+        monkeypatch.setattr(
+            ra, "_pair_bwd",
+            functools.partial(orig_bwd, interpret=True))
+        g_kernel = jax.grad(loss, argnums=(0, 1, 2))(q, k, v)
+        for ge, gk, nm in zip(g_einsum, g_kernel, "qkv"):
+            onp.testing.assert_allclose(onp.asarray(gk), onp.asarray(ge),
+                                        rtol=2e-4, atol=2e-4,
+                                        err_msg=f"d{nm}")

@@ -159,6 +159,8 @@ def main(argv=None):
 
     peak = device_peak_flops()
     steady = times[len(times) // 2:]
+    if not steady:  # --steps 1: only the compile step ran
+        steady = [time.time() - t0]
     tok_s = batch * seq * len(steady) / sum(steady)
     mfu = 6.0 * n_params * tok_s / peak if peak else None
     print(json.dumps({
