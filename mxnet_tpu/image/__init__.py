@@ -372,7 +372,8 @@ class ImageIter:
 
     def __init__(self, batch_size, data_shape, path_imgrec=None,
                  path_imgidx=None, shuffle=False, aug_list=None,
-                 label_width=1, last_batch_handle="pad", **kwargs):
+                 label_width=1, last_batch_handle="pad",
+                 preprocess_threads=4, **kwargs):
         from ..io import DataDesc
         from ..recordio import MXIndexedRecordIO, MXRecordIO
 
@@ -382,6 +383,16 @@ class ImageIter:
         self.data_shape = tuple(data_shape)
         self.label_width = label_width
         self.shuffle = shuffle
+        # threaded decode+augment (the reference C++ iterator's
+        # `preprocess_threads`): JPEG decode releases the GIL, so a small
+        # pool parallelizes the dominant cost. Augmenter RNG draws from
+        # the process-global streams — same per-image nondeterminism under
+        # threading as the reference's per-thread RNG.
+        import os as _os
+
+        self._n_threads = max(1, min(int(preprocess_threads),
+                                     _os.cpu_count() or 1))
+        self._pool = None
         self.auglist = aug_list if aug_list is not None else \
             CreateAugmenter(data_shape)
         self._rec = None
@@ -444,6 +455,14 @@ class ImageIter:
     def __next__(self):
         return self.next()
 
+    def _decode_one(self, payload):
+        c = self.data_shape[0]
+        img = imdecode(payload, flag=1 if c == 3 else 0)
+        for aug in self.auglist:
+            img = aug(img)
+        arr = img.asnumpy() if isinstance(img, NDArray) else img
+        return arr.transpose(2, 0, 1)
+
     def next(self):
         from ..io import DataBatch
 
@@ -452,19 +471,29 @@ class ImageIter:
         labels = np.zeros((self.batch_size,) if self.label_width == 1
                           else (self.batch_size, self.label_width),
                           np.float32)
-        i = 0
-        while i < self.batch_size:
+        # record reads are serial (cheap, stateful cursor); decode +
+        # augment fan out over the pool
+        payloads, lab_list = [], []
+        while len(payloads) < self.batch_size:
             sample = self._next_sample()
             if sample is None:
                 break
             label, payload = sample
-            img = imdecode(payload, flag=1 if c == 3 else 0)
-            for aug in self.auglist:
-                img = aug(img)
-            arr = img.asnumpy() if isinstance(img, NDArray) else img
-            data[i] = arr.transpose(2, 0, 1)
-            labels[i] = label
-            i += 1
+            payloads.append(payload)
+            lab_list.append(label)
+        i = len(payloads)
+        if i:
+            if self._n_threads > 1:
+                if self._pool is None:
+                    import concurrent.futures as _cf
+
+                    self._pool = _cf.ThreadPoolExecutor(self._n_threads)
+                decoded = list(self._pool.map(self._decode_one, payloads))
+            else:
+                decoded = [self._decode_one(p) for p in payloads]
+            for j, (arr, label) in enumerate(zip(decoded, lab_list)):
+                data[j] = arr
+                labels[j] = label
         if i == 0:
             raise StopIteration
         pad = self.batch_size - i

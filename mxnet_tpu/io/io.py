@@ -374,4 +374,5 @@ def ImageRecordIter(path_imgrec=None, path_imgidx=None, data_shape=None,
                           rand_mirror=rand_mirror, mean=mean, std=std)
     return ImageIter(batch_size=batch_size, data_shape=data_shape,
                      path_imgrec=path_imgrec, path_imgidx=path_imgidx,
-                     shuffle=shuffle, aug_list=aug, label_width=label_width)
+                     shuffle=shuffle, aug_list=aug, label_width=label_width,
+                     **kwargs)

@@ -268,6 +268,14 @@ def ring_attention_sharded(q, k, v, axis_name, causal=False, scale=None):
     """
     if scale is None:
         scale = 1.0 / math.sqrt(q.shape[-1])
+    if causal and q.shape[2] != k.shape[2]:
+        # the per-pair diagonal masks and shard-index visibility tests
+        # assume equal q/k shard lengths; unequal-length causal ring
+        # (chunked scoring against a longer cache) needs global-position
+        # masks — fail loudly rather than attend to the future
+        raise ValueError(
+            f"causal ring attention requires equal q/k shard lengths, "
+            f"got lq={q.shape[2]}, lk={k.shape[2]}")
     return _ring(q, k, v, axis_name, causal, float(scale))
 
 

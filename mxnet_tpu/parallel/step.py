@@ -115,16 +115,13 @@ class TrainStep:
             net(*nds)
             return 0
 
-        st = random_state._global()
-        saved_keys = dict(st.keys)
-        try:
-            jax.eval_shape(_shape_probe, *shape_vals)
-        except Exception:
-            if fallback is None:
-                raise
-            fallback()
-        finally:
-            st.keys = saved_keys
+        with random_state.preserved_stream():
+            try:
+                jax.eval_shape(_shape_probe, *shape_vals)
+            except Exception:
+                if fallback is None:
+                    raise
+                fallback()
 
     def _bind_params(self):
         """Record the settled parameter list, trainable ordinals,
@@ -421,14 +418,10 @@ class TrainStep:
             for s, spec in zip(param_structs, self._param_specs))
         t = jax.ShapeDtypeStruct((), np.int32)
         lr = jax.ShapeDtypeStruct((), np.float32)
-        # key shape/dtype only — snapshot the stream so the compile leaves
-        # the program's random sequence untouched (reproducibility)
-        st = random_state._global()
-        saved_keys = dict(st.keys)
-        try:
+        # key shape/dtype only — the stream snapshot keeps the compile
+        # from advancing the program's random sequence (reproducibility)
+        with random_state.preserved_stream():
             key = random_state.get_state_key()
-        finally:
-            st.keys = saved_keys
         rng = jax.ShapeDtypeStruct(tuple(key.shape), key.dtype)
         batch_in = tuple(
             jax.ShapeDtypeStruct(s.shape, s.dtype, sharding=sh)

@@ -91,6 +91,22 @@ def get_state_key():
 
 
 @contextlib.contextmanager
+def preserved_stream():
+    """Snapshot the stateful key streams and restore them on exit.
+
+    For shape probes / AOT compiles that must not advance the program's
+    random sequence (reproducibility) or leak traced keys into the
+    global state when run under a live trace.
+    """
+    st = _global()
+    saved = dict(st.keys)
+    try:
+        yield
+    finally:
+        st.keys = saved
+
+
+@contextlib.contextmanager
 def scoped_key(key):
     """Install a traced base key: all next_key() calls inside derive from it."""
     st = _global()
