@@ -305,12 +305,21 @@ class Embedding(HybridBlock):
         super().__init__(prefix=prefix, params=params)
         self._input_dim = input_dim
         self._output_dim = output_dim
+        self._sparse_grad = bool(sparse_grad)
         with self.name_scope():
             self.weight = self.params.get(
                 "weight", shape=(input_dim, output_dim),
-                init=weight_initializer, dtype=dtype)
+                init=weight_initializer, dtype=dtype,
+                grad_stype="row_sparse" if sparse_grad else "default")
 
     def hybrid_forward(self, F, x, weight):
+        if self._sparse_grad:
+            # uid = the weight parameter's full name: the train step maps
+            # scope-log entries back to optimizer slots by it
+            return F.Embedding(x, weight, input_dim=self._input_dim,
+                               output_dim=self._output_dim,
+                               sparse_grad=True,
+                               _sparse_uid=self.weight.name)
         return F.Embedding(x, weight, input_dim=self._input_dim,
                            output_dim=self._output_dim)
 

@@ -68,9 +68,10 @@ class Parameter:
         self.init = init
         self.allow_deferred_init = allow_deferred_init
         self._differentiable = differentiable
-        if stype != "default" or grad_stype != "default":
-            # sparse storage is dense-backed (SURVEY.md §7.3.5)
-            pass
+        # grad_stype='row_sparse' routes embedding weights through the
+        # lazy row-update path (parallel.sparse_grad); storage itself
+        # stays dense-backed (SURVEY.md §7.3.5)
+        self.grad_stype = grad_stype
         self._stype = stype
         self._data: Optional[OrderedDict] = None  # Context -> NDArray
         self._grad: Optional[OrderedDict] = None

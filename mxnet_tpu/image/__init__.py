@@ -455,6 +455,18 @@ class ImageIter:
     def __next__(self):
         return self.next()
 
+    def close(self):
+        """Shut down the decode pool (also runs on GC)."""
+        pool, self._pool = self._pool, None
+        if pool is not None:
+            pool.shutdown(wait=False)
+
+    def __del__(self):  # pragma: no cover - GC timing
+        try:
+            self.close()
+        except Exception:
+            pass
+
     def _decode_one(self, payload):
         c = self.data_shape[0]
         img = imdecode(payload, flag=1 if c == 3 else 0)

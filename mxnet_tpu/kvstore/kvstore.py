@@ -66,8 +66,44 @@ class KVStore:
         self.pull(key, out if out is not None else value, priority)
 
     def row_sparse_pull(self, key, out, priority=0, row_ids=None):
-        # sparse is dense-backed (SURVEY.md §7.3.5)
-        self.pull(key, out, priority)
+        """Pull ONLY the requested rows (reference: kvstore.py::
+        row_sparse_pull for RowSparseNDArray weights).
+
+        ``row_ids``: int NDArray of row indices (duplicates fine). The
+        pulled rows are gathered server-side — the traffic and the
+        ``out`` payload are O(len(row_ids) x dim), never the full table.
+        ``out`` RowSparseNDArrays get a factored (indices, values)
+        payload; dense NDArrays get rows written in place.
+        """
+        if row_ids is None:
+            return self.pull(key, out, priority)
+        if isinstance(key, (list, tuple)):
+            rids = row_ids if isinstance(row_ids, (list, tuple)) \
+                else [row_ids] * len(key)
+            for k, o, r in zip(key, out, rids):
+                self.row_sparse_pull(k, o, priority, r)
+            return
+        import jax.numpy as jnp
+
+        from ..ndarray.sparse import RowSparseNDArray
+
+        key = self._canon(key)
+        self._check_init(key)
+        src = self._store[key]
+        outs = out if isinstance(out, (list, tuple)) else [out]
+        rows = row_ids.data.astype(jnp.int32) \
+            if isinstance(row_ids, NDArray) else jnp.asarray(
+                row_ids, dtype=jnp.int32)
+        # pad/dedupe slots park on an OUT-OF-RANGE sentinel so they can
+        # never alias a real table row; scatter drops them, factored
+        # getters compress them out
+        rows = jnp.unique(rows, size=rows.size, fill_value=src.shape[0])
+        vals = src.data[rows]            # sentinel reads clamp (ignored)
+        for o in outs:
+            if isinstance(o, RowSparseNDArray):
+                o.set_rows(rows, vals, src.shape)
+            else:
+                o._set_data(o.data.at[rows].set(vals, mode="drop"))
 
     def set_optimizer(self, optimizer):
         self._optimizer = optimizer

@@ -82,12 +82,65 @@ class CSRNDArray(BaseSparseNDArray):
 
 
 class RowSparseNDArray(BaseSparseNDArray):
-    """Row-sparse array (reference: sparse.py::RowSparseNDArray)."""
+    """Row-sparse array (reference: sparse.py::RowSparseNDArray).
+
+    Two storage modes:
+
+    * dense-backed (default, SURVEY.md §7.3.5) — full payload, views
+      computed lazily;
+    * FACTORED — ``set_rows(rows, vals, full_shape)`` stores only the
+      touched rows (what ``kvstore.row_sparse_pull`` returns); the dense
+      payload materializes lazily only if something reads ``.data``,
+      while ``indices``/``values``/``retain`` work on the factored parts
+      directly at O(rows) cost.
+    """
 
     _stype = "row_sparse"
+    _rows = None
+    _vals = None
+    _full_shape = None
+
+    def set_rows(self, rows, vals, full_shape):
+        """Install a factored (indices, values) payload."""
+        self._rows = rows
+        self._vals = vals
+        self._full_shape = tuple(full_shape)
+        self._shape = tuple(full_shape)
+        self._data = None
+        self._version += 1
+
+    @property
+    def data(self):
+        if self._data is None and self._rows is not None:
+            jnp = _jnp()
+            self._data = jnp.zeros(
+                self._full_shape, self._vals.dtype).at[self._rows].set(
+                self._vals, mode="drop")
+        return NDArray.data.fget(self)
+
+    @property
+    def shape(self):
+        if self._data is None and self._full_shape is not None:
+            return self._full_shape
+        return NDArray.shape.fget(self)
+
+    def _live_factored(self):
+        """(sorted rows, values) with sentinel/padding slots compressed
+        out — the MXNet aux-array contract (sorted, in-range, exact nnz).
+        Host-side (eager) by nature: these getters are the user API."""
+        rows = _np.asarray(self._rows)
+        vals = _np.asarray(self._vals)
+        live = rows < self._full_shape[0]
+        rows, vals = rows[live], vals[live]
+        order = _np.argsort(rows)
+        return rows[order], vals[order]
 
     @property
     def indices(self):
+        if self._rows is not None:
+            rows, _ = self._live_factored()
+            return NDArray(data=_jnp().asarray(rows, dtype="int64"),
+                           ctx=self._ctx)
         dense = self.asnumpy()
         rows = _np.nonzero(dense.reshape(dense.shape[0], -1).any(axis=1))[0]
         return NDArray(data=_jnp().asarray(rows, dtype="int64"),
@@ -95,6 +148,9 @@ class RowSparseNDArray(BaseSparseNDArray):
 
     @property
     def values(self):
+        if self._rows is not None:
+            _, vals = self._live_factored()
+            return NDArray(data=_jnp().asarray(vals), ctx=self._ctx)
         dense = self.asnumpy()
         rows = _np.nonzero(dense.reshape(dense.shape[0], -1).any(axis=1))[0]
         return NDArray(data=_jnp().asarray(dense[rows]), ctx=self._ctx)
@@ -104,6 +160,16 @@ class RowSparseNDArray(BaseSparseNDArray):
         jnp = _jnp()
         rows = rows.data.astype("int32") if isinstance(rows, NDArray) \
             else jnp.asarray(rows, dtype="int32")
+        if self._rows is not None and self._data is None:
+            keep = jnp.isin(self._rows, rows)
+            out = RowSparseNDArray(
+                data=jnp.zeros((0,)), ctx=self._ctx)
+            out.set_rows(
+                jnp.where(keep, self._rows, self._full_shape[0]),
+                jnp.where(keep.reshape((-1,) + (1,) * (self._vals.ndim - 1)),
+                          self._vals, 0),
+                self._full_shape)
+            return out
         mask = jnp.zeros((self.shape[0],), bool).at[rows].set(True)
         kept = jnp.where(mask.reshape((-1,) + (1,) * (len(self.shape) - 1)),
                          self.data, 0)

@@ -471,10 +471,46 @@ def block_grad(data):
 
 @register("Embedding")
 def embedding(data, weight, *, input_dim=0, output_dim=0, dtype="float32",
-              sparse_grad=False):
+              sparse_grad=False, _sparse_uid=None):
     # reference: src/operator/tensor/indexing_op.cc :: EmbeddingOpForward
     idx = data.astype(jnp.int32)
+    if sparse_grad and _sparse_uid is not None:
+        from ..parallel.sparse_grad import sparse_grad_active
+
+        if sparse_grad_active():
+            # row-sparse gradient: the custom VJP logs (rows, dY) into
+            # the active scope and the train step does a lazy row update
+            # — the dense (vocab, dim) cotangent is never consumed
+            return _sparse_lookup(weight, idx, _sparse_uid)
     return jnp.take(weight, idx, axis=0)
+
+
+import functools as _functools
+
+import numpy as _np_mod
+
+
+@_functools.partial(jax.custom_vjp, nondiff_argnums=(2,))
+def _sparse_lookup(weight, idx, uid):
+    return jnp.take(weight, idx, axis=0)
+
+
+def _sparse_lookup_fwd(weight, idx, uid):
+    return jnp.take(weight, idx, axis=0), (idx, weight)
+
+
+def _sparse_lookup_bwd(uid, res, g):
+    from ..parallel.sparse_grad import log_sparse_grad
+
+    idx, weight = res
+    log_sparse_grad(uid, idx, g)
+    # symbolic-zero dense cotangent: dead unless the weight also feeds a
+    # dense-grad op, which the sparse path forbids (see sparse_grad.py)
+    return (jnp.zeros_like(weight),
+            _np_mod.zeros(idx.shape, jax.dtypes.float0))
+
+
+_sparse_lookup.defvjp(_sparse_lookup_fwd, _sparse_lookup_bwd)
 
 
 @register("Dropout", aliases=["dropout"], needs_rng=True, pass_training_flag=True)

@@ -220,6 +220,13 @@ def eager_call(opdef: OpDef, tensors, attrs, rng=None):
         uncached = opdef.eager_only
     except TypeError:  # unhashable attr (e.g. list) — run uncached
         uncached = True
+    if not uncached and attrs.get("_sparse_uid") is not None:
+        # row-sparse-grad ops must inline into the SURROUNDING trace:
+        # their custom-VJP side channel (parallel.sparse_grad) logs
+        # backward tracers, which would escape a per-op jit's scope
+        from ..parallel.sparse_grad import sparse_grad_active
+
+        uncached = sparse_grad_active()
     # pin the execution platform from the concrete operands so in-trace
     # kernel dispatch (Pallas flash) targets where the op actually runs
     sample = tensors[0] if tensors else None

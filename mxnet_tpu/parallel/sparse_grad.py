@@ -1,0 +1,141 @@
+"""Row-sparse embedding gradients — the TPU-native lazy-update path.
+
+Reference: MXNet's ``Embedding(sparse_grad=True)`` produces a
+``RowSparseNDArray`` gradient that kvstore + optimizer consume without
+densifying (``indexing_op.cc`` TakeNonzeroAxis0 backward +
+``optimizer.py`` lazy_update). XLA has no sparse gradient type, so the
+equivalent here is FACTORED, not typed:
+
+* the embedding lookup runs through a ``jax.custom_vjp`` whose backward
+  logs ``(rows, dY)`` into a trace-scoped side channel and returns a
+  symbolic-zero dense cotangent (dead code unless someone consumes it);
+* the train step replaces that parameter's optimizer call with a LAZY
+  ROW update: duplicate rows are combined with a static-shape dedupe
+  (sort + segment-sum, duplicate slots parked on an out-of-range
+  sentinel row that scatter ``mode='drop'`` discards), the weight and
+  its param-shaped optimizer-state rows are gathered, the REAL
+  ``Optimizer.update_multi_precision`` runs on the (N, D) row batch —
+  identical math, bias corrections and multi-precision dtype rules —
+  and the results scatter back.
+
+The HLO of such a step contains no (vocab, dim) gradient buffer: the
+only full-table tensors are the parameter and its states. Constraint
+(same as the reference): a sparse-grad embedding weight must not also
+receive dense gradients (e.g. tied softmax weights) — the dense
+cotangent from other uses would be silently dropped. TrainStep raises
+when the Parameter OBJECT is shared across blocks
+(``_check_sparse_sharing``); routing the same array through other ops
+manually is the user's responsibility, as with the reference's
+storage-type checks.
+"""
+from __future__ import annotations
+
+import contextlib
+
+__all__ = ["sparse_grad_scope", "sparse_grad_active", "log_sparse_grad",
+           "dedupe_rows", "lazy_row_update"]
+
+_SCOPE = [None]
+
+
+class _Log:
+    def __init__(self):
+        self.entries = {}  # uid -> list[(rows, vals)]
+
+    def add(self, uid, rows, vals):
+        self.entries.setdefault(uid, []).append((rows, vals))
+
+
+@contextlib.contextmanager
+def sparse_grad_scope():
+    """Activate the (rows, dY) side channel for embedding backwards."""
+    prev = _SCOPE[0]
+    log = _Log()
+    _SCOPE[0] = log
+    try:
+        yield log
+    finally:
+        _SCOPE[0] = prev
+
+
+def sparse_grad_active():
+    return _SCOPE[0] is not None
+
+
+def log_sparse_grad(uid, rows, vals):
+    if _SCOPE[0] is not None:
+        _SCOPE[0].add(uid, rows, vals)
+
+
+def dedupe_rows(rows, vals, n_total):
+    """Combine duplicate row ids with static shapes.
+
+    rows: (N,) int32; vals: (N, D). Returns (uniq_rows, summed) both of
+    length N: segment k holds the k-th distinct row's id and the SUM of
+    its values; surplus slots hold ``n_total`` (out of range — callers
+    scatter with ``mode='drop'``).
+    """
+    import jax
+    import jax.numpy as jnp
+
+    n = rows.shape[0]
+    order = jnp.argsort(rows)
+    r = rows[order]
+    v = vals[order]
+    first = jnp.concatenate([jnp.ones((1,), bool), r[1:] != r[:-1]])
+    seg = jnp.cumsum(first) - 1                     # segment id per entry
+    summed = jax.ops.segment_sum(v, seg, num_segments=n)
+    uniq = jnp.full((n,), n_total, dtype=rows.dtype).at[seg].set(r)
+    return uniq, summed
+
+
+def lazy_row_update(optimizer, k, param_nd, entries, state, ctx):
+    """Run the optimizer on only the touched rows of ``param_nd``.
+
+    entries: list[(rows, vals)] from the scope log (concatenated).
+    state: the param's optimizer-state pytree (leaves are NDArrays shaped
+    like the param, or None). Mutates the NDArray payloads in place like
+    ``Optimizer.update_multi_precision`` does on the dense path.
+    """
+    import jax
+    import jax.numpy as jnp
+
+    from ..ndarray import NDArray
+
+    V = param_nd.shape[0]
+    rows = jnp.concatenate(
+        [r.reshape(-1).astype(jnp.int32) for r, _ in entries])
+    vals = jnp.concatenate(
+        [v.reshape(-1, v.shape[-1]) for _, v in entries])
+    uniq, summed = dedupe_rows(rows, vals, V)
+
+    def gather(nd):
+        return nd.data[uniq]                        # OOB rows clamp-read
+
+    def scatter(nd, new_rows):
+        nd._set_data(nd.data.at[uniq].set(new_rows, mode="drop"))
+
+    w_rows = NDArray(data=gather(param_nd), ctx=ctx)
+    g_rows = NDArray(data=summed.astype(param_nd.dtype), ctx=ctx)
+
+    leaves, treedef = jax.tree_util.tree_flatten(
+        state, is_leaf=lambda x: x is None or isinstance(x, NDArray))
+    row_leaves = []
+    for leaf in leaves:
+        if leaf is None:
+            row_leaves.append(None)
+            continue
+        if tuple(leaf.shape) != tuple(param_nd.shape):
+            raise NotImplementedError(
+                "lazy_row_update: optimizer state leaf shaped "
+                f"{leaf.shape} != param {param_nd.shape}; this optimizer "
+                "has non-rowwise state — use a dense-grad embedding")
+        row_leaves.append(NDArray(data=gather(leaf), ctx=ctx))
+    row_state = jax.tree_util.tree_unflatten(treedef, row_leaves)
+
+    optimizer.update_multi_precision(k, w_rows, g_rows, row_state)
+
+    scatter(param_nd, w_rows.data)
+    for leaf, row_leaf in zip(leaves, row_leaves):
+        if leaf is not None:
+            scatter(leaf, row_leaf.data)

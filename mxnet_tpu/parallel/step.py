@@ -141,7 +141,36 @@ class TrainStep:
         self._param_specs = [
             spec_for_param(p.name, p.shape, self.rules, self.mesh)
             for p in params]
+        self._check_sparse_sharing()
         return params
+
+    def _check_sparse_sharing(self):
+        """A row-sparse-grad embedding weight must not be shared with
+        another block (weight-tied softmax head): the dense cotangent
+        from the other use would be silently dropped by the lazy row
+        update. Detects PARAMETER-OBJECT sharing across blocks; passing
+        the same array through other ops manually remains the user's
+        responsibility (same contract as the reference's stype checks).
+        """
+        owners = {}
+
+        def walk(block):
+            for p in getattr(block, "_reg_params", {}).values():
+                if getattr(p, "grad_stype", "default") == "row_sparse":
+                    owners.setdefault(id(p), [p, 0])
+                    owners[id(p)][1] += 1
+            for child in getattr(block, "_children", {}).values():
+                walk(child)
+
+        walk(self.net)
+        for p, count in owners.values():
+            if count > 1:
+                raise MXNetError(
+                    f"Parameter {p.name} has grad_stype='row_sparse' but "
+                    f"is shared by {count} blocks (weight tying); the "
+                    "lazy row update would drop the dense gradient from "
+                    "the other use — build the Embedding with "
+                    "sparse_grad=False for tied weights")
 
     def _settle_params(self, data_tuple):
         params = list(self.net.collect_params().values())
@@ -266,6 +295,7 @@ class TrainStep:
         optimizer = self.optimizer
         loss_fn = self.loss
         state_meta = self._state_meta
+        params_by_i = [p.name for p in self._params]
 
         def step_fn(param_vals, state_vals, t, lr, rng, *batch_vals):
             import jax.numpy as jnp
@@ -286,9 +316,20 @@ class TrainStep:
                 loss_val = jnp.mean(flat_loss[0].data.astype(jnp.float32))
                 return loss_val, (outs, aux)
 
+            from .sparse_grad import lazy_row_update, sparse_grad_scope
+
             train_vals = tuple(param_vals[i] for i in trainable)
-            (loss_val, (outs, aux)), grads = jax.value_and_grad(
-                loss_of, has_aux=True)(train_vals)
+            with sparse_grad_scope() as sp_log:
+                (loss_val, (outs, aux)), grads = jax.value_and_grad(
+                    loss_of, has_aux=True)(train_vals)
+            # scope entries are keyed by parameter NAME (the embedding
+            # op's _sparse_uid); map to trainable ordinals
+            sparse_by_k = {}
+            for uid, entries in sp_log.entries.items():
+                for k, i in enumerate(trainable):
+                    if params_by_i[i] == uid:
+                        sparse_by_k[k] = entries
+                        break
 
             new_params = list(param_vals)
             new_state_vals = list(state_vals)
@@ -298,7 +339,6 @@ class TrainStep:
                     for k, i in enumerate(trainable):
                         treedef, present, _ = state_meta[k]
                         w_nd = NDArray(data=param_vals[i], ctx=ctx)
-                        g_nd = NDArray(data=grads[k], ctx=ctx)
                         leaf_nds = []
                         live = []
                         cursor = pos
@@ -311,7 +351,16 @@ class TrainStep:
                             else:
                                 leaf_nds.append(None)
                         state = jax.tree_util.tree_unflatten(treedef, leaf_nds)
-                        optimizer.update_multi_precision(k, w_nd, g_nd, state)
+                        if k in sparse_by_k:
+                            # row-sparse embedding grad: lazy row update;
+                            # the dense zero cotangent in grads[k] stays
+                            # unconsumed and DCEs out of the executable
+                            lazy_row_update(optimizer, k, w_nd,
+                                            sparse_by_k[k], state, ctx)
+                        else:
+                            g_nd = NDArray(data=grads[k], ctx=ctx)
+                            optimizer.update_multi_precision(
+                                k, w_nd, g_nd, state)
                         new_params[i] = w_nd.data
                         for idx, nd_leaf in live:
                             new_state_vals[idx] = nd_leaf.data

@@ -1,0 +1,217 @@
+"""Row-sparse embedding gradients (VERDICT round-2 #6 / SURVEY §7.3.5).
+
+The TPU-native lazy path: Embedding(sparse_grad=True) logs (rows, dY)
+through a trace-scoped custom-VJP side channel; TrainStep runs the REAL
+optimizer on only the touched rows (static-shape dedupe, scatter
+mode='drop'). Pinned here:
+- the step's jaxpr contains no (vocab, dim) scatter-add (the dense
+  embedding cotangent) while the dense-grad step does;
+- lazy semantics: untouched rows and their optimizer state do not move
+  (dense Adam would decay every row's state);
+- numerical parity with the dense path for SGD (linear update);
+- duplicate-token accumulation; dedupe_rows; kvstore row_sparse_pull.
+"""
+import numpy as onp
+import pytest
+
+import jax
+import jax.numpy as jnp
+
+import mxnet_tpu as mx
+from mxnet_tpu import parallel as par
+from mxnet_tpu.gluon import loss as gloss, nn
+from mxnet_tpu.parallel.sparse_grad import dedupe_rows
+
+V, D = 64, 8
+
+
+class _TinyLM(nn.HybridSequential):
+    def __init__(self, sparse):
+        super().__init__()
+        self.add(nn.Embedding(V, D, sparse_grad=sparse))
+        self.add(nn.Dense(4, flatten=False))
+
+
+def _build_step(sparse, optimizer="sgd", **opt_kw):
+    onp.random.seed(0)
+    mx.random.seed(0)
+    net = _TinyLM(sparse)
+    net.initialize()
+    mesh = par.make_mesh({"dp": 1}, devices=jax.devices()[:1])
+    step = par.TrainStep(net, gloss.L2Loss(), optimizer, mesh=mesh,
+                         optimizer_params={"learning_rate": 0.1, **opt_kw})
+    return net, step
+
+
+def _batch():
+    rs = onp.random.RandomState(1)
+    tok = mx.nd.array(onp.array([[1, 5, 5, 9], [2, 5, 1, 60]],
+                                dtype=onp.int32))
+    y = mx.nd.array(rs.randn(2, 4, 4).astype(onp.float32))
+    return tok, y
+
+
+def test_dedupe_rows():
+    rows = jnp.array([7, 3, 7, 7, 1], jnp.int32)
+    vals = jnp.asarray(onp.arange(10, dtype=onp.float32).reshape(5, 2))
+    uniq, summed = dedupe_rows(rows, vals, 100)
+    got = {int(r): tuple(map(float, s)) for r, s in zip(uniq, summed)
+           if int(r) < 100}
+    assert got == {1: (8.0, 9.0), 3: (2.0, 3.0),
+                   7: (0.0 + 4.0 + 6.0, 1.0 + 5.0 + 7.0)}
+    # surplus slots carry the sentinel
+    assert sorted(int(r) for r in uniq)[-2:] == [100, 100]
+
+
+def test_sgd_parity_with_dense():
+    """scatter-add is linear, so lazy SGD == dense SGD exactly."""
+    tok, y = _batch()
+    net_d, step_d = _build_step(False)
+    loss_d, _ = step_d(tok, y)
+    net_s, step_s = _build_step(True)
+    loss_s, _ = step_s(tok, y)
+    assert float(loss_s.asnumpy()) == pytest.approx(
+        float(loss_d.asnumpy()), rel=1e-6)
+    wd = list(net_d.collect_params().values())[0].data().asnumpy()
+    ws = list(net_s.collect_params().values())[0].data().asnumpy()
+    onp.testing.assert_allclose(ws, wd, rtol=1e-5, atol=1e-6)
+
+
+def test_adam_is_lazy():
+    """Dense Adam moves EVERY row (state decay); lazy Adam must leave
+    untouched rows and their state bit-identical."""
+    tok, y = _batch()
+    net, step = _build_step(True, optimizer="adam")
+    emb_p = list(net.collect_params().values())[0]
+    w0 = emb_p.data().asnumpy().copy()
+    for _ in range(3):
+        step(tok, y)
+    w1 = emb_p.data().asnumpy()
+    touched = sorted(set(tok.asnumpy().astype(int).ravel().tolist()))
+    untouched = [r for r in range(V) if r not in touched]
+    onp.testing.assert_array_equal(w1[untouched], w0[untouched])
+    assert not onp.allclose(w1[touched], w0[touched])
+
+
+def test_no_dense_grad_in_jaxpr():
+    """The sparse step must contain no (V, D) scatter-add — that op IS
+    the dense embedding cotangent. The dense step has one."""
+
+    def jaxpr_of(sparse):
+        net, step = _build_step(sparse, optimizer="adam")
+        tok, y = _batch()
+        step(tok, y)  # build + cache
+        entry = list(step._cache.values())[0]
+        # retrace the cached step_fn abstractly for inspection
+        import numpy as np
+
+        from mxnet_tpu import random_state
+        from mxnet_tpu.base import execution_platform
+        from mxnet_tpu.parallel.mesh import use_mesh
+
+        param_vals = tuple(p.data().data for p in step._params)
+        state_vals = tuple(s.data for s in step._state_leaf_nds)
+        with random_state.preserved_stream():
+            key = random_state.get_state_key()
+        with execution_platform("cpu"), use_mesh(step.mesh):
+            return jax.make_jaxpr(
+                lambda *a: entry["jitted"].__wrapped__(*a))(
+                param_vals, state_vals, np.int32(1), np.float32(0.1),
+                key, tok.data, y.data)
+
+    def count_vd_scatter_add(jaxpr):
+        n = 0
+
+        def walk(jx):
+            nonlocal n
+            for eqn in jx.eqns:
+                for val in eqn.params.values():
+                    items = val if isinstance(val, (tuple, list)) else (val,)
+                    for it in items:
+                        sub = getattr(it, "jaxpr", it)
+                        if hasattr(sub, "eqns"):
+                            walk(sub)
+                if eqn.primitive.name == "scatter-add":
+                    for ov in eqn.outvars:
+                        if tuple(getattr(ov.aval, "shape", ())) == (V, D):
+                            n += 1
+        walk(jaxpr.jaxpr)
+        return n
+
+    assert count_vd_scatter_add(jaxpr_of(True)) == 0
+    assert count_vd_scatter_add(jaxpr_of(False)) >= 1
+
+
+def test_duplicate_rows_accumulate():
+    """Row 5 appears 3x in the batch; its SGD delta must be the sum."""
+    tok, y = _batch()
+    net, step = _build_step(True)
+    emb_p = list(net.collect_params().values())[0]
+    w0 = emb_p.data().asnumpy().copy()
+    step(tok, y)
+    # dense oracle
+    net_d, step_d = _build_step(False)
+    emb_d = list(net_d.collect_params().values())[0]
+    step_d(tok, y)
+    onp.testing.assert_allclose(emb_p.data().asnumpy()[5],
+                                emb_d.data().asnumpy()[5],
+                                rtol=1e-5, atol=1e-6)
+
+
+def test_kvstore_row_sparse_pull():
+    from mxnet_tpu import kvstore as kv_mod
+    from mxnet_tpu.ndarray.sparse import RowSparseNDArray
+
+    kv = kv_mod.create("local")
+    table = mx.nd.array(onp.arange(V * D, dtype=onp.float32).reshape(V, D))
+    kv.init("emb", table)
+    out = RowSparseNDArray(data=jnp.zeros((0,)), ctx=mx.cpu())
+    rows = mx.nd.array(onp.array([3, 7, 3], dtype=onp.int64))
+    kv.row_sparse_pull("emb", out=out, row_ids=rows)
+    # factored payload: O(rows) values, correct contents
+    idx = out.indices.asnumpy()
+    vals = out.values.asnumpy()
+    # aux-array contract: sorted, in-range, exact nnz (dup collapsed)
+    assert list(idx) == [3, 7]
+    assert vals.shape == (2, D)
+    by_row = {int(i): v for i, v in zip(idx, vals)}
+    onp.testing.assert_allclose(by_row[3], table.asnumpy()[3])
+    onp.testing.assert_allclose(by_row[7], table.asnumpy()[7])
+    # densification on demand matches the table on those rows
+    dense = out.asnumpy()
+    onp.testing.assert_allclose(dense[7], table.asnumpy()[7])
+    assert (dense[0] == 0).all()
+
+
+def test_tied_weight_sharing_raises():
+    """Weight tying + sparse_grad would silently drop the head's dense
+    cotangent; TrainStep must refuse (review finding, round 3)."""
+    from mxnet_tpu.base import MXNetError
+
+    from mxnet_tpu.gluon.block import HybridBlock
+
+    class Tied(HybridBlock):
+        def __init__(self):
+            super().__init__()
+            with self.name_scope():
+                # true weight tying: same prefix -> the Dense reuses the
+                # Embedding's weight Parameter object (LlamaModel's
+                # tie_weights pattern)
+                self.embed = nn.Embedding(V, D, sparse_grad=True,
+                                          prefix="tok_")
+                self.head = nn.Dense(V, flatten=False, use_bias=False,
+                                     params=self.embed.params,
+                                     prefix="tok_")
+
+        def hybrid_forward(self, F, x):
+            return self.head(self.embed(x))
+
+    net = Tied()
+    net.initialize()
+    mesh = par.make_mesh({"dp": 1}, devices=jax.devices()[:1])
+    step = par.TrainStep(net, gloss.L2Loss(), "sgd", mesh=mesh,
+                         optimizer_params={"learning_rate": 0.1})
+    tok, _ = _batch()
+    y = mx.nd.array(onp.zeros((2, 4, V), dtype=onp.float32))
+    with pytest.raises(MXNetError, match="row_sparse"):
+        step(tok, y)

@@ -76,14 +76,18 @@ class TestRowSparse:
 
 
 class TestKVStoreRowSparsePull:
-    def test_row_sparse_pull_dense_backed(self):
+    def test_row_sparse_pull_writes_requested_rows(self):
+        # round 3: row_sparse_pull gathers ONLY the requested rows
+        # (round 2 pulled the whole table — the dense-backed facade)
         from mxnet_tpu import kvstore as kv
 
         store = kv.create("local")
         store.init("emb", mx.nd.ones((6, 2)))
         out = mx.nd.zeros((6, 2))
         store.row_sparse_pull("emb", out, row_ids=mx.nd.array([0.0, 3.0]))
-        onp.testing.assert_allclose(out.asnumpy(), onp.ones((6, 2)))
+        got = out.asnumpy()
+        onp.testing.assert_allclose(got[[0, 3]], onp.ones((2, 2)))
+        onp.testing.assert_allclose(got[[1, 2, 4, 5]], onp.zeros((4, 2)))
 
 
 class TestReviewRegressions:
