@@ -158,7 +158,10 @@ def _iter_batches(calib_data, num_calib_batches):
         return
     count = 0
     for batch in calib_data:
-        if hasattr(batch, "data"):      # io.DataBatch
+        # io.DataBatch carries a LIST of arrays; NDArray.data is its jax
+        # payload — the duck test must not confuse the two
+        if hasattr(batch, "data") and isinstance(batch.data, (list, tuple)) \
+                and not isinstance(batch, NDArray):
             batch = batch.data[0]
         if isinstance(batch, (list, tuple)):
             batch = batch[0]

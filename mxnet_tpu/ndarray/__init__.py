@@ -93,7 +93,10 @@ def _make_wrapper(opname: str):
 
     wrapper.__name__ = opname
     wrapper.__qualname__ = f"nd.{opname}"
-    wrapper.__doc__ = (opdef.fn.__doc__ or f"{opname} operator.")
+    from ..ops.registry import render_attr_docs
+
+    wrapper.__doc__ = (opdef.fn.__doc__ or f"{opname} operator.") \
+        + render_attr_docs(opdef)
     return wrapper
 
 

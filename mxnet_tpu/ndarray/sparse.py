@@ -109,6 +109,12 @@ class RowSparseNDArray(BaseSparseNDArray):
         self._data = None
         self._version += 1
 
+    def _set_data(self, new_jax):
+        # a dense rewrite invalidates the factored views — they must
+        # never disagree with .data
+        self._rows = self._vals = None
+        super()._set_data(new_jax)
+
     @property
     def data(self):
         if self._data is None and self._rows is not None:

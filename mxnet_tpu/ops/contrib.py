@@ -213,29 +213,70 @@ def moe_dispatch_combine(tokens, probs, gate_up_weight, down_weight, *,
 def _fake_quant_act(data, min_calib_range, max_calib_range):
     """Snap activations onto the symmetric int8 grid — calibrated range
     when given, dynamic (per-batch max) otherwise. Values stay exactly on
-    the grid, so downstream f32 math reproduces integer arithmetic."""
+    the grid, so downstream f32 math reproduces integer arithmetic.
+    Derived from _quantize_act_s8 so the oracle and the s8 MXU path snap
+    identically by construction."""
+    codes, s = _quantize_act_s8(data, min_calib_range, max_calib_range)
+    return codes.astype(jnp.float32) / s
+
+
+def _quantize_act_s8(data, min_calib_range, max_calib_range):
+    """Integer-domain counterpart of _fake_quant_act: (int8 codes, scale)
+    with ``codes = round(clip(x * 127/t)) ; x ~ codes / scale``."""
     if min_calib_range is None:
         t = jnp.max(jnp.abs(data)).astype(jnp.float32) + 1e-12  # dynamic
     else:
         t = jnp.maximum(jnp.float32(abs(float(min_calib_range))),
                         jnp.float32(abs(float(max_calib_range)))) + 1e-12
     s = 127.0 / t
-    return jnp.clip(jnp.round(data.astype(jnp.float32) * s), -127, 127) / s
+    codes = jnp.clip(jnp.round(data.astype(jnp.float32) * s),
+                     -127, 127).astype(jnp.int8)
+    return codes, s
+
+
+def _int8_mxu_enabled():
+    """True when quantized ops should run REAL s8 x s8 -> s32 MXU math.
+
+    The v5e MXU's int8 rate is ~2x bf16 (measured 2.7x in the identical
+    chained-matmul harness, PERF.md round 3); off-TPU the fake-quant f32
+    path stays the oracle. MXNET_INT8_MXU=0 forces the oracle everywhere.
+    """
+    import os
+
+    from ..base import current_execution_platform
+
+    if os.environ.get("MXNET_INT8_MXU", "1") == "0":
+        return False
+    return current_execution_platform() == "tpu"
 
 
 @register("_contrib_quantized_dense")
 def quantized_dense(data, weight_q, w_scale, bias=None, *, num_hidden,
                     no_bias=False, flatten=True,
                     min_calib_range=None, max_calib_range=None):
-    """Int8-weight dense with calibrated (or dynamic) activation fake-quant.
+    """Int8-weight dense (reference capability: quantization.py::
+    quantize_model int8 inference).
 
-    Numerically identical to int8xint8->int32 GEMM rescaled: the fake-
-    quantized activations and per-channel-dequantized weights sit exactly
-    on the int8 grid, so the f32 MXU matmul reproduces the integer
-    arithmetic while storage stays int8 (reference capability:
-    quantization.py::quantize_model int8 inference).
+    On TPU the GEMM is REAL s8 x s8 -> s32 on the MXU (int8 runs ~2x the
+    bf16 rate), rescaled by ``w_scale / act_scale`` per output channel.
+    Elsewhere the fake-quant f32 path computes numerically identical
+    results (both operand sets sit exactly on the int8 grid, and the f32
+    MXU matmul reproduces the integer arithmetic up to f32 summation,
+    which the shared tolerance tests pin).
     """
     from .registry import get_op
+
+    if _int8_mxu_enabled():
+        xq, s_x = _quantize_act_s8(data, min_calib_range, max_calib_range)
+        if flatten and xq.ndim > 2:
+            xq = xq.reshape(xq.shape[0], -1)
+        acc = jax.lax.dot_general(
+            xq, weight_q, (((xq.ndim - 1,), (1,)), ((), ())),
+            preferred_element_type=jnp.int32)          # (..., num_hidden)
+        out = acc.astype(jnp.float32) * (w_scale / s_x)
+        if not (no_bias or bias is None):
+            out = out + bias.astype(jnp.float32)
+        return out.astype(data.dtype) if data.dtype != jnp.float32 else out
 
     xq = _fake_quant_act(data, min_calib_range, max_calib_range)
     w = weight_q.astype(jnp.float32) * w_scale[:, None]
@@ -249,8 +290,30 @@ def quantized_conv(data, weight_q, w_scale, bias=None, *, kernel,
                    num_filter, stride=None, pad=None, dilate=None,
                    num_group=1, no_bias=False, layout=None,
                    min_calib_range=None, max_calib_range=None):
-    """Int8-weight convolution; activation fake-quant as quantized_dense."""
+    """Int8-weight convolution; on TPU the conv itself runs s8 x s8 ->
+    s32 (see quantized_dense), elsewhere fake-quant f32."""
     from .registry import get_op
+
+    if _int8_mxu_enabled():
+        from .nn import _conv_dnums, _channel_axis, _tuplize
+
+        nd = len(kernel)
+        xq, s_x = _quantize_act_s8(data, min_calib_range, max_calib_range)
+        acc = jax.lax.conv_general_dilated(
+            xq, weight_q,
+            window_strides=_tuplize(stride or 1, nd),
+            padding=[(p, p) for p in _tuplize(pad or 0, nd)],
+            rhs_dilation=_tuplize(dilate or 1, nd),
+            dimension_numbers=_conv_dnums(nd, layout),
+            feature_group_count=num_group,
+            preferred_element_type=jnp.int32)
+        c_ax = _channel_axis(layout, acc.ndim)
+        sshape = [1] * acc.ndim
+        sshape[c_ax] = w_scale.shape[0]
+        out = acc.astype(jnp.float32) * (w_scale.reshape(sshape) / s_x)
+        if not (no_bias or bias is None):
+            out = out + bias.astype(jnp.float32).reshape(sshape)
+        return out.astype(data.dtype) if data.dtype != jnp.float32 else out
 
     xq = _fake_quant_act(data, min_calib_range, max_calib_range)
     scale = w_scale.reshape((-1,) + (1,) * (weight_q.ndim - 1))

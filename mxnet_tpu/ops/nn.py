@@ -17,14 +17,20 @@ from typing import Optional
 import jax
 import jax.numpy as jnp
 
-from .registry import register
+from .registry import attr, register
 
 # ---------------------------------------------------------------------------
 # dense / conv
 # ---------------------------------------------------------------------------
 
 
-@register("FullyConnected", aliases=["fully_connected"])
+@register("FullyConnected", aliases=["fully_connected"], attrs=[
+    attr("num_hidden", int, "Number of output hidden units.", low=0),
+    attr("no_bias", bool, "Whether to disable the bias term."),
+    attr("flatten", bool,
+         "Flatten trailing input dims into one (MXNet default) or apply "
+         "the projection to the last axis only."),
+])
 def fully_connected(data, weight, bias=None, *, num_hidden=0, no_bias=False, flatten=True):
     # reference: src/operator/nn/fully_connected.cc :: FullyConnectedCompute
     if flatten and data.ndim > 2:
@@ -63,7 +69,18 @@ def _channel_axis(layout, ndim):
     return (ndim - 1) if (layout and layout.endswith("C")) else 1
 
 
-@register("Convolution", aliases=["convolution"])
+@register("Convolution", aliases=["convolution"], attrs=[
+    attr("kernel", tuple, "Spatial kernel size, e.g. (3, 3)."),
+    attr("stride", tuple, "Strides per spatial dim (default 1).", low=1),
+    attr("dilate", tuple, "Dilation per spatial dim (default 1).", low=1),
+    attr("pad", tuple, "Zero padding per spatial dim.", low=0),
+    attr("num_filter", int, "Number of output channels.", low=1),
+    attr("num_group", int, "Grouped-convolution group count.", low=1),
+    attr("no_bias", bool, "Whether to disable the bias term."),
+    attr("layout", str, "Input/output layout; channels-last is the "
+         "TPU-preferred internal layout.",
+         choices=("NCW", "NCHW", "NCDHW", "NWC", "NHWC", "NDHWC")),
+])
 def convolution(data, weight, bias=None, *, kernel=(), stride=(), dilate=(),
                 pad=(), num_filter=1, num_group=1, no_bias=False,
                 layout=None, workspace=1024, cudnn_tune=None, cudnn_off=False):
@@ -135,7 +152,19 @@ def deconvolution(data, weight, bias=None, *, kernel=(), stride=(), dilate=(),
 # ---------------------------------------------------------------------------
 
 
-@register("Pooling", aliases=["pooling"])
+@register("Pooling", aliases=["pooling"], attrs=[
+    attr("kernel", tuple, "Pooling window size."),
+    attr("pool_type", str, "Pooling reduction.",
+         choices=("max", "avg", "sum", "lp")),
+    attr("stride", tuple, "Window strides (default 1).", low=1),
+    attr("pad", tuple, "Zero padding per spatial dim.", low=0),
+    attr("global_pool", bool, "Pool over the whole spatial extent."),
+    attr("pooling_convention", str, "Output-size rounding rule.",
+         choices=("valid", "full", "same")),
+    attr("p_value", int, "p of the Lp pooling norm.", low=1),
+    attr("layout", str, "Input layout.",
+         choices=("NCW", "NCHW", "NCDHW", "NWC", "NHWC", "NDHWC")),
+])
 def pooling(data, *, kernel=(), pool_type="max", stride=(), pad=(),
             global_pool=False, pooling_convention="valid", count_include_pad=True,
             cudnn_off=False, p_value=2, layout=None):
@@ -215,7 +244,16 @@ def roi_pooling(data, rois, *, pooled_size=(), spatial_scale=1.0):
 # ---------------------------------------------------------------------------
 
 
-@register("BatchNorm", aliases=["batch_norm"], pass_training_flag=True)
+@register("BatchNorm", aliases=["batch_norm"], pass_training_flag=True,
+          attrs=[
+    attr("eps", float, "Numerical-stability epsilon added to variance.",
+         low=0.0),
+    attr("momentum", float, "Moving-average momentum.", low=0.0, high=1.0),
+    attr("fix_gamma", bool, "Treat gamma as fixed at 1."),
+    attr("use_global_stats", bool,
+         "Normalize with moving stats even in training."),
+    attr("axis", int, "Channel axis (1 = channels-first, -1 = last)."),
+])
 def batch_norm(data, gamma, beta, moving_mean, moving_var, *, eps=1e-3,
                momentum=0.9, fix_gamma=True, use_global_stats=False,
                output_mean_var=False, axis=1, cudnn_off=False,
@@ -513,7 +551,12 @@ def _sparse_lookup_bwd(uid, res, g):
 _sparse_lookup.defvjp(_sparse_lookup_fwd, _sparse_lookup_bwd)
 
 
-@register("Dropout", aliases=["dropout"], needs_rng=True, pass_training_flag=True)
+@register("Dropout", aliases=["dropout"], needs_rng=True,
+          pass_training_flag=True, attrs=[
+    attr("p", float, "Fraction of units dropped.", low=0.0, high=1.0),
+    attr("mode", str, "When to apply dropout.",
+         choices=("training", "always")),
+])
 def dropout_op(rng, data, *, p=0.5, mode="training", axes=(), cudnn_off=False,
                _training=False):
     # reference: src/operator/nn/dropout.cc

@@ -28,7 +28,114 @@ import functools
 import inspect
 from typing import Callable, Dict, List, NamedTuple, Optional, Sequence
 
-__all__ = ["OpDef", "register", "get_op", "list_ops", "alias"]
+__all__ = ["OpDef", "AttrSpec", "attr", "register", "get_op", "list_ops",
+           "alias", "validate_attrs"]
+
+
+class AttrSpec(NamedTuple):
+    """Typed operator-attribute declaration.
+
+    The dmlc::Parameter equivalent (reference: ``include/dmlc/parameter.h``
+    — typed param structs with range checks whose descriptions flow into
+    the generated op docs). Declared per-op at ``register(attrs=[...])``;
+    validated on every call; rendered into the ``mx.nd.*`` / ``mx.sym.*``
+    wrapper docstrings.
+    """
+
+    name: str
+    type: object = None          # python type or tuple of types
+    doc: str = ""
+    low: Optional[float] = None  # inclusive numeric bounds
+    high: Optional[float] = None
+    choices: Optional[tuple] = None
+
+    def describe(self):
+        parts = []
+        if self.type is not None:
+            ts = self.type if isinstance(self.type, tuple) else (self.type,)
+            parts.append("/".join(t.__name__ for t in ts))
+        if self.choices is not None:
+            parts.append("one of " + ", ".join(map(repr, self.choices)))
+        if self.low is not None or self.high is not None:
+            lo = "-inf" if self.low is None else self.low
+            hi = "inf" if self.high is None else self.high
+            parts.append(f"range [{lo}, {hi}]")
+        return ", ".join(parts)
+
+
+def attr(name, type=None, doc="", low=None, high=None, choices=None):
+    return AttrSpec(name, type, doc, low, high,
+                    tuple(choices) if choices is not None else None)
+
+
+_COERCIBLE = {
+    int: (int,),
+    float: (int, float),
+    bool: (bool, int),
+    str: (str,),
+    tuple: (tuple, list, int),
+}
+
+
+def validate_attrs(opdef: "OpDef", attrs: Dict) -> None:
+    """Raise a typed MXNetError naming the op, attribute and constraint
+    for out-of-spec attribute values. Undeclared attributes pass (specs
+    cover the documented surface, not every internal knob)."""
+    specs = opdef.attr_specs
+    if not specs:
+        return
+    from ..base import MXNetError
+
+    import numpy as _np
+
+    for k, v in attrs.items():
+        spec = specs.get(k)
+        if spec is None or v is None:
+            continue
+        if isinstance(v, (_np.generic,)):
+            v = v.item()
+        if spec.type is not None:
+            want = spec.type if isinstance(spec.type, tuple) else (spec.type,)
+            ok = any(isinstance(v, _COERCIBLE.get(t, (t,))) for t in want)
+            # bools are ints in python — reject bool where int expected
+            if ok and bool not in want and isinstance(v, bool):
+                ok = False
+            if not ok:
+                raise MXNetError(
+                    f"{opdef.name}: attribute {k}={v!r} has type "
+                    f"{type(v).__name__}; expected {spec.describe()}")
+        if spec.choices is not None and v not in spec.choices:
+            raise MXNetError(
+                f"{opdef.name}: attribute {k}={v!r} must be "
+                f"{spec.describe()}")
+        vals = v if isinstance(v, (tuple, list)) else (v,)
+        for item in vals:
+            if not isinstance(item, (int, float)) or isinstance(item, bool):
+                continue
+            if spec.low is not None and item < spec.low:
+                raise MXNetError(
+                    f"{opdef.name}: attribute {k}={v!r} below "
+                    f"{spec.describe()}")
+            if spec.high is not None and item > spec.high:
+                raise MXNetError(
+                    f"{opdef.name}: attribute {k}={v!r} above "
+                    f"{spec.describe()}")
+
+
+def render_attr_docs(opdef: "OpDef") -> str:
+    """Numpy-style attribute section for generated wrapper docstrings."""
+    if not opdef.attr_specs:
+        return ""
+    lines = ["", "", "Attributes", "----------"]
+    for spec in opdef.attr_specs.values():
+        head = spec.name
+        desc = spec.describe()
+        if desc:
+            head += f" : {desc}"
+        lines.append(head)
+        if spec.doc:
+            lines.append(f"    {spec.doc}")
+    return "\n".join(lines)
 
 
 class OpDef(NamedTuple):
@@ -51,6 +158,8 @@ class OpDef(NamedTuple):
     variadic: bool
     # op must run untraced (dynamic output shapes — e.g. boolean_mask)
     eager_only: bool
+    # typed attribute declarations (AttrSpec by name); None = undeclared
+    attr_specs: Optional[Dict] = None
 
 
 _REGISTRY: Dict[str, OpDef] = {}
@@ -64,8 +173,13 @@ def register(
     pass_training_flag: bool = False,
     variadic: bool = False,
     eager_only: bool = False,
+    attrs: Sequence[AttrSpec] = (),
 ):
-    """Decorator registering a pure-JAX op implementation."""
+    """Decorator registering a pure-JAX op implementation.
+
+    ``attrs``: optional typed AttrSpec declarations (the dmlc::Parameter
+    equivalent) — validated on every call, rendered into wrapper docs.
+    """
 
     def deco(fn):
         opname = name or fn.__name__
@@ -103,6 +217,7 @@ def register(
                 p.kind == p.VAR_POSITIONAL for p in sig.parameters.values()
             ),
             eager_only=eager_only,
+            attr_specs={s.name: s for s in attrs} if attrs else None,
         )
         _REGISTRY[opname] = opdef
         for a in aliases:
@@ -213,6 +328,8 @@ def eager_call(opdef: OpDef, tensors, attrs, rng=None):
     """Execute an op eagerly through the per-op executable cache."""
     from ..base import current_execution_platform, execution_platform
 
+    if opdef.attr_specs:
+        validate_attrs(opdef, attrs)
     tensors = _harmonize_devices(tensors)
     attr_items = tuple(sorted(attrs.items(), key=lambda kv: kv[0]))
     try:

@@ -17,11 +17,11 @@ from .sharding import (PartitionSpec, ShardingRules, named_sharding,
 from .step import TrainStep
 from .ring_attention import ring_attention, ring_attention_sharded
 from .pipeline import (Pipelined, pipeline_apply, pipeline_active,
-                       pipeline_sharding_rules)
+                       pipeline_sharding_rules, pipeline_train_1f1b)
 
 __all__ = ["ring_attention", "ring_attention_sharded",
            "Pipelined", "pipeline_apply", "pipeline_active",
-           "pipeline_sharding_rules",
+           "pipeline_sharding_rules", "pipeline_train_1f1b",
            "AXES", "make_mesh", "current_mesh", "use_mesh", "local_devices",
            "mesh_axis_size", "PartitionSpec", "ShardingRules",
            "named_sharding", "replicated", "shard_array", "shard_parameters",

@@ -39,7 +39,7 @@ from ..ndarray import NDArray, array as nd_array
 from .mesh import current_mesh
 
 __all__ = ["pipeline_apply", "Pipelined", "pipeline_sharding_rules",
-           "pipeline_active"]
+           "pipeline_active", "pipeline_train_1f1b"]
 
 
 def pipeline_active(axis="pp", mesh=None):
@@ -201,8 +201,21 @@ class Pipelined(HybridBlock):
 
     def __init__(self, stage_factory, n_stages, layers_per_stage=1,
                  axis="pp", n_microbatches=None, remat=False,
-                 prefix=None, params=None):
+                 schedule="gpipe", prefix=None, params=None):
         super().__init__(prefix=prefix, params=params)
+        if schedule not in ("gpipe", "1f1b"):
+            raise ValueError(f"unknown pipeline schedule {schedule!r}")
+        if schedule == "1f1b":
+            # 1F1B bounds activation memory by starting each microbatch's
+            # backward as soon as it drains — which requires the LOSS
+            # inside the schedule, so it cannot hide behind this
+            # AD-transparent block. Use the explicit entry point.
+            raise ValueError(
+                "schedule='1f1b' folds the loss into the pipeline and is "
+                "not AD-transparent; call parallel.pipeline_train_1f1b("
+                "stage_fn, loss_fn, ...) directly (grads/bubble math in "
+                "its docstring)")
+        self._schedule = schedule
         self._n_stages = int(n_stages)
         self._l_per = int(layers_per_stage)
         self._axis = axis
@@ -346,3 +359,178 @@ def pipeline_sharding_rules(axis="pp", extra=None):
     # boundary would never match inside prefixed names like 'trunk_pp_...'
     rules.append((r"pp_", P(axis)))
     return ShardingRules(rules)
+
+
+# ---------------------------------------------------------------------------
+# 1F1B (one-forward-one-backward) schedule
+# ---------------------------------------------------------------------------
+
+
+def pipeline_train_1f1b(stage_fn, loss_fn, stacked_leaves, x, labels, rng,
+                        *, mesh=None, axis="pp", n_microbatches=None):
+    """Fused forward+backward pipeline with the 1F1B schedule.
+
+    GPipe (``pipeline_apply`` + AD) runs ALL microbatch forwards before
+    any backward because the loss sits outside the schedule — every stage
+    holds ``n_micro`` boundary activations. 1F1B folds the loss into the
+    last stage so microbatch ``m``'s backward starts the tick its forward
+    drains, bounding live activations per stage to the in-flight count
+    (``<= n_stages``) instead of ``n_micro``. The bubble fraction stays
+    ``(S-1)/(M+S-1)`` per direction (the schedule overlaps the two
+    directions tick-for-tick: fwd of micro ``t-s`` and bwd of micro
+    ``t-(2(S-1)-s)`` share each tick); the win is MEMORY — which is why
+    this entry point takes the loss and cannot be AD-transparent.
+
+    Per-stage backward recomputes the stage from its saved INPUT (the
+    remat trade). The input stash is a RING of ``2*(n_stages-1)+1``
+    slots (a micro's input lives from its fwd tick ``m+s`` to its bwd
+    tick ``m+2(S-1)-s``, so at most ``2(S-1)+1`` are in flight), making
+    per-stage activation memory independent of ``n_microbatches``.
+
+    Parameters
+    ----------
+    stage_fn : ``stage_fn(leaves, h, key) -> h`` — one stage (all its
+        layers); shape/dtype-preserving.
+    loss_fn : ``loss_fn(h, labels_micro) -> scalar`` — head + loss on the
+        LAST stage's output (mean over the microbatch).
+    stacked_leaves : tuple of ``(n_stages,) + param_shape`` arrays.
+    x, labels : (B, ...) arrays, microbatched alongside each other.
+    rng : PRNG key.
+
+    Returns ``(mean_loss, grads_stacked, dx)``.
+    """
+    import jax
+    import jax.numpy as jnp
+    from jax import lax
+
+    n_stages = int(stacked_leaves[0].shape[0])
+    if not pipeline_active(axis, mesh):
+        # sequential reference: same math, one device
+        def full(leaves, x):
+            h = x
+            for s in range(n_stages):
+                h = stage_fn(tuple(a[s] for a in leaves), h,
+                             jax.random.fold_in(rng, s))
+            return loss_fn(h, labels)
+
+        loss, (gl, gx) = jax.value_and_grad(full, argnums=(0, 1))(
+            stacked_leaves, x)
+        return loss, gl, gx
+
+    mesh = mesh or current_mesh()
+    if n_stages != mesh.shape[axis]:
+        raise ValueError(
+            f"pipeline has {n_stages} stages but mesh axis '{axis}' spans "
+            f"{mesh.shape[axis]} devices")
+    n_micro = int(n_microbatches or n_stages)
+    b = x.shape[0]
+    if b % n_micro:
+        raise ValueError(f"batch {b} not divisible by {n_micro}")
+    xs = x.reshape((n_micro, b // n_micro) + x.shape[1:])
+    ys = labels.reshape((n_micro, b // n_micro) + labels.shape[1:])
+
+    from jax import shard_map
+    from jax.sharding import PartitionSpec as P
+
+    last = n_stages - 1
+    fwd_perm = [(i, (i + 1) % n_stages) for i in range(n_stages)]
+    bwd_perm = [((i + 1) % n_stages, i) for i in range(n_stages)]
+    # stage s: fwd of micro (t - s), bwd of micro (t - (2*last - s));
+    # the last backward is stage 0's micro M-1 at t = M - 1 + 2*last
+    total = n_micro + 2 * last
+
+    def body(local_stacked, xs, ys, key):
+        local = tuple(a[0] for a in local_stacked)
+        stage = lax.axis_index(axis)
+        key = jax.random.fold_in(key, stage)
+
+        def run_stage(leaves, h, m):
+            return stage_fn(leaves, h, jax.random.fold_in(key, m))
+
+        micro_shape = xs.shape[1:]
+        # in-flight input ring: micro m's input is saved at fwd tick
+        # m+s and read at bwd tick m+2*last-s; the gap is <= 2*last, so
+        # ring_n slots never collide and memory is O(n_stages), not
+        # O(n_micro)
+        ring_n = min(n_micro, 2 * last + 1)
+        saved = jnp.zeros((ring_n,) + micro_shape, xs.dtype)
+        fwd_state = jnp.zeros(micro_shape, xs.dtype)
+        bwd_state = jnp.zeros(micro_shape, jnp.float32)
+        gacc = tuple(jnp.zeros(a.shape[1:], jnp.float32)
+                     for a in local_stacked)
+        dx = jnp.zeros(xs.shape, jnp.float32)
+        loss_acc = jnp.zeros((), jnp.float32)
+        mark = getattr(lax, "pcast", None)
+        if mark is not None:
+            saved, fwd_state, bwd_state, dx, loss_acc = (
+                mark(v, (axis,), to="varying")
+                for v in (saved, fwd_state, bwd_state, dx, loss_acc))
+            gacc = tuple(mark(g, (axis,), to="varying") for g in gacc)
+
+        def tick(carry, t):
+            saved, fwd_state, bwd_state, gacc, dx, loss_acc = carry
+            mf = t - stage
+            mb = t - (2 * last - stage)
+            fwd_on = jnp.logical_and(mf >= 0, mf < n_micro)
+            bwd_on = jnp.logical_and(mb >= 0, mb < n_micro)
+            mf_c = jnp.clip(mf, 0, n_micro - 1)
+            mb_c = jnp.clip(mb, 0, n_micro - 1)
+
+            # ---- forward unit ----
+            h_in = jnp.where(stage == 0, xs[mf_c], fwd_state)
+            saved = jnp.where(fwd_on,
+                              saved.at[mf_c % ring_n].set(h_in), saved)
+            h_out = run_stage(local, h_in, mf_c)
+            # loss + seed cotangent: only the last stage pays for the
+            # head — shard_map manual mode gives each device its own
+            # control flow, so lax.cond here is a real branch
+            lval, dh_seed = lax.cond(
+                stage == last,
+                lambda: jax.value_and_grad(
+                    lambda hh: loss_fn(hh, ys[mf_c]))(h_out),
+                lambda: (jnp.zeros((), jnp.float32),
+                         jnp.zeros_like(h_out)))
+            loss_acc = loss_acc + jnp.where(fwd_on, lval, 0.0)
+
+            # ---- backward unit (recompute from the saved stage input);
+            # on the last stage fwd and bwd of a micro share the tick, so
+            # the seed is consumed immediately rather than hopped ----
+            g_in = jnp.where(stage == last,
+                             dh_seed.astype(jnp.float32), bwd_state)
+            h_saved = saved[mb_c % ring_n]
+            _, vjp = jax.vjp(
+                lambda lv, hh: run_stage(lv, hh, mb_c), local, h_saved)
+            gl, gh = vjp(g_in.astype(h_saved.dtype))
+            gacc = tuple(
+                jnp.where(bwd_on, a + gi.astype(jnp.float32), a)
+                for a, gi in zip(gacc, gl))
+            dx = jnp.where(
+                jnp.logical_and(stage == 0, bwd_on),
+                dx.at[mb_c].set(gh.astype(jnp.float32)), dx)
+
+            # ---- hops ----
+            fwd_state = lax.ppermute(h_out, axis, fwd_perm)
+            bwd_state = lax.ppermute(gh.astype(jnp.float32), axis,
+                                     bwd_perm)
+            return (saved, fwd_state, bwd_state, gacc, dx, loss_acc), None
+
+        (saved, fwd_state, bwd_state, gacc, dx, loss_acc), _ = lax.scan(
+            tick, (saved, fwd_state, bwd_state, gacc, dx, loss_acc),
+            jnp.arange(total))
+        # the reported loss is the mean of per-micro means; grads from
+        # per-micro losses therefore rescale by 1/n_micro to match the
+        # full-batch-mean convention of the sequential reference
+        loss_acc = lax.psum(loss_acc, axis) / n_micro
+        inv = jnp.float32(1.0 / n_micro)
+        dx = lax.psum(dx, axis) * inv
+        return (loss_acc, tuple((g * inv)[None] for g in gacc), dx)
+
+    fn = shard_map(
+        body, mesh=mesh,
+        in_specs=(P(axis), P(), P(), P()),
+        out_specs=(P(), P(axis), P()),
+        axis_names=frozenset({axis}), check_vma=False)
+    loss, grads, dx = fn(stacked_leaves, xs, ys, rng)
+    return (loss, tuple(g.astype(a.dtype) for g, a in
+                        zip(grads, stacked_leaves)),
+            dx.reshape(x.shape).astype(x.dtype))

@@ -403,6 +403,12 @@ def _abstract_op(node: _Node, in_shapes: List[tuple]):
 def _apply_opdef(opdef, tensors, attrs, rng, training):
     kw = {k: v for k, v in attrs.items() if not k.startswith("__")
           and k in opdef.attr_params}
+    if opdef.attr_specs:
+        # the typed AttrSpec contract holds on the graph-execution path
+        # too, not just eager calls
+        from ..ops.registry import validate_attrs
+
+        validate_attrs(opdef, kw)
     if opdef.pass_training_flag:
         kw["_training"] = training
     if opdef.needs_rng:

@@ -1,0 +1,52 @@
+"""Typed op-attribute system (VERDICT #9; reference: dmlc::Parameter —
+typed param structs with range validation and doc flow)."""
+import numpy as onp
+import pytest
+
+import mxnet_tpu as mx
+from mxnet_tpu.base import MXNetError
+
+
+def test_bad_choice_raises_named_error():
+    x = mx.nd.array(onp.ones((1, 2, 4, 4), onp.float32))
+    with pytest.raises(MXNetError, match="Pooling.*pool_type.*'max'"):
+        mx.nd.Pooling(x, kernel=(2, 2), pool_type="maxx")
+
+
+def test_out_of_range_raises():
+    x = mx.nd.array(onp.ones((2, 4), onp.float32))
+    rngkey = None
+    with pytest.raises(MXNetError, match="Dropout.*p=1.5.*range"):
+        mx.nd.Dropout(x, p=1.5, mode="always")
+
+
+def test_bad_type_raises():
+    x = mx.nd.array(onp.ones((1, 2, 4, 4), onp.float32))
+    w = mx.nd.array(onp.ones((3, 2, 3, 3), onp.float32))
+    with pytest.raises(MXNetError, match="Convolution.*num_filter"):
+        mx.nd.Convolution(x, w, kernel=(3, 3), num_filter="three",
+                          no_bias=True)
+
+
+def test_negative_pad_raises():
+    x = mx.nd.array(onp.ones((1, 2, 4, 4), onp.float32))
+    w = mx.nd.array(onp.ones((3, 2, 3, 3), onp.float32))
+    with pytest.raises(MXNetError, match="Convolution.*pad"):
+        mx.nd.Convolution(x, w, kernel=(3, 3), num_filter=3,
+                          pad=(-1, 0), no_bias=True)
+
+
+def test_docs_flow_into_wrapper():
+    doc = mx.nd.Convolution.__doc__
+    assert "Attributes" in doc
+    assert "kernel" in doc and "Spatial kernel size" in doc
+    assert "num_filter" in doc and "range [1, inf]" in doc
+    assert "NHWC" in doc  # layout choices rendered
+
+
+def test_valid_calls_unaffected():
+    x = mx.nd.array(onp.ones((1, 2, 4, 4), onp.float32))
+    w = mx.nd.array(onp.ones((3, 2, 3, 3), onp.float32))
+    out = mx.nd.Convolution(x, w, kernel=(3, 3), num_filter=3, pad=(1, 1),
+                            no_bias=True)
+    assert out.shape == (1, 3, 4, 4)

@@ -141,3 +141,72 @@ class TestQuantizeModel:
         qsym2 = mx.sym.load_json(qsym.tojson())
         got2 = eval_symbol(qsym2, feed).asnumpy()
         onp.testing.assert_allclose(got2, got, rtol=1e-6, atol=1e-6)
+
+
+class TestInt8MXUPath:
+    """Round 3 (VERDICT #7): on TPU the quantized ops run REAL s8xs8->s32
+    GEMMs. The path itself is platform-independent XLA — forced on here
+    via the execution-platform override — and must agree with the
+    fake-quant f32 oracle at the shared tolerances."""
+
+    def _force_tpu(self):
+        from mxnet_tpu.base import execution_platform
+
+        return execution_platform("tpu")
+
+    def test_dense_matches_oracle_and_emits_s8_dot(self):
+        import jax
+        import jax.numpy as jnp
+
+        from mxnet_tpu import nd
+
+        rs = onp.random.RandomState(0)
+        x = mx.nd.array(rs.randn(8, 32).astype(onp.float32))
+        wq = mx.nd.array(rs.randint(-127, 128, (16, 32)).astype(onp.int8))
+        ws = mx.nd.array((rs.rand(16).astype(onp.float32) + 0.5) / 100)
+        b = mx.nd.array(rs.randn(16).astype(onp.float32))
+
+        oracle = nd.contrib.quantized_dense(
+            x, wq, ws, b, num_hidden=16, min_calib_range=-3.0,
+            max_calib_range=3.0)
+        with self._force_tpu():
+            got = nd.contrib.quantized_dense(
+                x, wq, ws, b, num_hidden=16, min_calib_range=-3.0,
+                max_calib_range=3.0)
+        onp.testing.assert_allclose(got.asnumpy(), oracle.asnumpy(),
+                                    rtol=1e-5, atol=1e-5)
+
+        # the compiled path must contain an s8 x s8 -> s32 dot
+        from mxnet_tpu.ops.contrib import quantized_dense as qd_fn
+
+        with self._force_tpu():
+            jaxpr = jax.make_jaxpr(
+                lambda a, w, s, bb: qd_fn(a, w, s, bb, num_hidden=16,
+                                          min_calib_range=-3.0,
+                                          max_calib_range=3.0))(
+                x.data, wq.data, ws.data, b.data)
+        dots = [e for e in jaxpr.jaxpr.eqns
+                if e.primitive.name == "dot_general"]
+        assert dots, jaxpr
+        assert all(str(iv.aval.dtype) == "int8" for e in dots
+                   for iv in e.invars), jaxpr
+        assert all(str(ov.aval.dtype) == "int32" for e in dots
+                   for ov in e.outvars), jaxpr
+
+    def test_conv_matches_oracle(self):
+        from mxnet_tpu import nd
+
+        rs = onp.random.RandomState(1)
+        x = mx.nd.array(rs.randn(2, 4, 8, 8).astype(onp.float32))
+        wq = mx.nd.array(rs.randint(-127, 128, (6, 4, 3, 3)).astype(onp.int8))
+        ws = mx.nd.array((rs.rand(6).astype(onp.float32) + 0.5) / 100)
+
+        oracle = nd.contrib.quantized_conv(
+            x, wq, ws, kernel=(3, 3), num_filter=6, pad=(1, 1),
+            no_bias=True, min_calib_range=-3.0, max_calib_range=3.0)
+        with self._force_tpu():
+            got = nd.contrib.quantized_conv(
+                x, wq, ws, kernel=(3, 3), num_filter=6, pad=(1, 1),
+                no_bias=True, min_calib_range=-3.0, max_calib_range=3.0)
+        onp.testing.assert_allclose(got.asnumpy(), oracle.asnumpy(),
+                                    rtol=1e-4, atol=1e-4)

@@ -1,0 +1,66 @@
+"""Microbench: s8 x s8 -> s32 MXU matmul vs bf16 (VERDICT round-2 #7).
+
+Chained-matmul harness (300 dependent iterations inside one executable,
+data-dependent fetch — the PERF.md relay protocol). Prints one JSON line
+with both rates and the ratio; the quantized ops take the s8 path on TPU
+when this ratio is why you quantized.
+"""
+from __future__ import annotations
+
+import json
+import sys
+import time
+
+import numpy as np
+
+
+def main():
+    import jax
+    import jax.numpy as jnp
+
+    M = N = K = 4096
+    iters = 300
+    rs = np.random.RandomState(0)
+    a8 = jnp.asarray(rs.randint(-127, 128, (M, K)), jnp.int8)
+    b8 = jnp.asarray(rs.randint(-127, 128, (K, N)), jnp.int8)
+    abf = jnp.asarray(rs.randn(M, K), jnp.bfloat16)
+    bbf = jnp.asarray(rs.randn(K, N), jnp.bfloat16)
+
+    def bench(fn, x):
+        f = jax.jit(lambda x: jax.lax.fori_loop(
+            0, iters, lambda i, x: fn(x), x))
+        r = f(x)
+        _ = np.asarray(jax.device_get(r)).ravel()[0]
+        best = float("inf")
+        for _i in range(2):
+            t0 = time.perf_counter()
+            r = f(r)
+            _ = np.asarray(jax.device_get(r)).ravel()[0]
+            best = min(best, time.perf_counter() - t0)
+        return best / iters * 1e3
+
+    def mm_s8(x):
+        acc = jax.lax.dot_general(x, b8, (((1,), (0,)), ((), ())),
+                                  preferred_element_type=jnp.int32)
+        return jnp.clip(acc >> 7, -127, 127).astype(jnp.int8)
+
+    def mm_bf(x):
+        return jax.lax.dot_general(
+            x, bbf, (((1,), (0,)), ((), ())),
+            preferred_element_type=jnp.float32).astype(jnp.bfloat16)
+
+    tflop = 2 * M * N * K / 1e12
+    ms_s8 = bench(mm_s8, a8)
+    ms_bf = bench(mm_bf, abf)
+    print(json.dumps({
+        "metric": "int8_vs_bf16_matmul_speedup",
+        "value": round(ms_bf / ms_s8, 3),
+        "unit": "x",
+        "s8_tflops": round(tflop / (ms_s8 / 1e3), 1),
+        "bf16_tflops": round(tflop / (ms_bf / 1e3), 1),
+    }))
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())
