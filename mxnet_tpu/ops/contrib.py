@@ -276,7 +276,7 @@ def quantized_dense(data, weight_q, w_scale, bias=None, *, num_hidden,
         out = acc.astype(jnp.float32) * (w_scale / s_x)
         if not (no_bias or bias is None):
             out = out + bias.astype(jnp.float32)
-        return out.astype(data.dtype) if data.dtype != jnp.float32 else out
+        return out  # f32, matching the oracle path's output dtype
 
     xq = _fake_quant_act(data, min_calib_range, max_calib_range)
     w = weight_q.astype(jnp.float32) * w_scale[:, None]
@@ -313,7 +313,7 @@ def quantized_conv(data, weight_q, w_scale, bias=None, *, kernel,
         out = acc.astype(jnp.float32) * (w_scale.reshape(sshape) / s_x)
         if not (no_bias or bias is None):
             out = out + bias.astype(jnp.float32).reshape(sshape)
-        return out.astype(data.dtype) if data.dtype != jnp.float32 else out
+        return out  # f32, matching the oracle path's output dtype
 
     xq = _fake_quant_act(data, min_calib_range, max_calib_range)
     scale = w_scale.reshape((-1,) + (1,) * (weight_q.ndim - 1))

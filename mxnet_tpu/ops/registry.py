@@ -265,7 +265,13 @@ def list_ops() -> List[str]:
 
 
 @functools.lru_cache(maxsize=4096)
-def _cached_call(opname: str, attr_items: tuple, n_tensors: int, has_rng: bool):
+def _cached_call(opname: str, attr_items: tuple, n_tensors: int,
+                 has_rng: bool, platform: str):
+    # `platform` keys the cache even though the traced fn only reads it
+    # ambiently: op impls dispatch on current_execution_platform() at
+    # TRACE time (Pallas kernels, int8 MXU paths), so one executable per
+    # platform — otherwise the first-traced platform's body would be
+    # served everywhere (round-3 review finding, verified live)
     import jax
 
     opdef = _REGISTRY[opname]
@@ -347,13 +353,14 @@ def eager_call(opdef: OpDef, tensors, attrs, rng=None):
     # pin the execution platform from the concrete operands so in-trace
     # kernel dispatch (Pallas flash) targets where the op actually runs
     sample = tensors[0] if tensors else None
-    with execution_platform(current_execution_platform(sample)):
+    platform = current_execution_platform(sample)
+    with execution_platform(platform):
         if uncached:
             if rng is not None:
                 return opdef.fn(rng, *tensors, **attrs)
             return opdef.fn(*tensors, **attrs)
         fn = _cached_call(opdef.name, attr_items, len(tensors),
-                          rng is not None)
+                          rng is not None, platform)
         if rng is not None:
             return fn(rng, *tensors)
         return fn(*tensors)

@@ -404,14 +404,25 @@ def pipeline_train_1f1b(stage_fn, loss_fn, stacked_leaves, x, labels, rng,
     from jax import lax
 
     n_stages = int(stacked_leaves[0].shape[0])
+    n_micro_seq = int(n_microbatches or n_stages)
     if not pipeline_active(axis, mesh):
-        # sequential reference: same math, one device
+        # sequential reference: same math, one device — microbatched with
+        # the SAME per-(stage, micro) key folds as the pipelined
+        # schedule, so key-using stages (dropout) stay bit-identical
         def full(leaves, x):
-            h = x
-            for s in range(n_stages):
-                h = stage_fn(tuple(a[s] for a in leaves), h,
-                             jax.random.fold_in(rng, s))
-            return loss_fn(h, labels)
+            xs = x.reshape((n_micro_seq, x.shape[0] // n_micro_seq)
+                           + x.shape[1:])
+            ys = labels.reshape((n_micro_seq,) + xs.shape[1:2]
+                                + labels.shape[1:])
+            total = 0.0
+            for m in range(n_micro_seq):
+                h = xs[m]
+                for s in range(n_stages):
+                    key_s = jax.random.fold_in(rng, s)
+                    h = stage_fn(tuple(a[s] for a in leaves), h,
+                                 jax.random.fold_in(key_s, m))
+                total = total + loss_fn(h, ys[m])
+            return total / n_micro_seq
 
         loss, (gl, gx) = jax.value_and_grad(full, argnums=(0, 1))(
             stacked_leaves, x)

@@ -83,7 +83,10 @@ def _make_symbol_function(opname: str):
 
     wrapper.__name__ = opname
     wrapper.__qualname__ = f"sym.{opname}"
-    wrapper.__doc__ = opdef.fn.__doc__ or f"{opname} symbol operator."
+    from ..ops.registry import render_attr_docs
+
+    wrapper.__doc__ = (opdef.fn.__doc__ or f"{opname} symbol operator.") \
+        + render_attr_docs(opdef)
     return wrapper
 
 

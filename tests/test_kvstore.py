@@ -145,27 +145,31 @@ _DIST_WORKER = r"""
 import os, sys
 import numpy as np
 os.environ.setdefault("JAX_PLATFORMS", "cpu")
+DEVS = int(os.environ.get("TEST_DEVS_PER_PROC", "2"))
+NPROC = int(os.environ.get("TEST_NUM_PROC", "2"))
 os.environ["XLA_FLAGS"] = (os.environ.get("XLA_FLAGS", "")
-                           + " --xla_force_host_platform_device_count=2")
+                           + f" --xla_force_host_platform_device_count={DEVS}")
 import mxnet_tpu as mx
 from mxnet_tpu import kvstore as kv
 store = kv.create("dist_sync")
 import jax
-assert jax.process_count() == 2, jax.process_count()
-assert store.num_workers == 2
+assert jax.process_count() == NPROC, jax.process_count()
+assert store.num_workers == NPROC
 assert store.rank == int(os.environ["DMLC_WORKER_ID"])
-# real cross-host reduce: each of the 4 global devices (2 per process)
-# contributes rank*2+i+1; the psum must cross the process boundary
+# real cross-host reduce: each of the NPROC*DEVS global devices
+# contributes rank*DEVS+i+1; the psum must cross process boundaries
 rank = store.rank
+total = NPROC * DEVS
+want = total * (total + 1) / 2.0
 store.init(0, mx.nd.zeros((4, 8)))
-grads = [mx.nd.full((4, 8), float(rank * 2 + i + 1),
-                    ctx=mx.Context("cpu", i)) for i in range(2)]
+grads = [mx.nd.full((4, 8), float(rank * DEVS + i + 1),
+                    ctx=mx.Context("cpu", i)) for i in range(DEVS)]
 store.push(0, grads)
-outs = [mx.nd.zeros((4, 8), ctx=mx.Context("cpu", i)) for i in range(2)]
+outs = [mx.nd.zeros((4, 8), ctx=mx.Context("cpu", i)) for i in range(DEVS)]
 store.pull(0, outs)
 for o in outs:
     got = o.asnumpy()
-    assert np.allclose(got, 10.0), (rank, got[0, 0])  # 1+2+3+4
+    assert np.allclose(got, want), (rank, got[0, 0], want)
 sys.stdout.write(f"DIST_OK {store.rank}\n"); sys.stdout.flush()
 """
 
@@ -332,3 +336,85 @@ def test_bandwidth_tool():
     rec = bw.measure(size_mb=4, iters=3)
     assert rec["devices"] >= 2 and rec["value"] > 0
     assert rec["bus_gb_s"] > rec["value"]  # 2(n-1)/n > 1 for n >= 2
+
+
+class TestMultiHostHardening:
+    """Round-3 (VERDICT #8): beyond 2 localhost processes."""
+
+    def test_four_process_two_device_composition(self, tmp_path):
+        """4 processes x 2 local devices: per-process device meshes
+        compose with the cross-process (DCN) psum — 8 global devices."""
+        script = tmp_path / "worker.py"
+        script.write_text(_DIST_WORKER)
+        env_base = {k: v for k, v in os.environ.items()
+                    if not k.startswith(("DMLC_", "XLA_FLAGS"))}
+        import socket
+
+        with socket.socket() as s:
+            s.bind(("", 0))
+            port = s.getsockname()[1]
+        repo_root = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+        procs = []
+        for rank in range(4):
+            env = dict(env_base,
+                       JAX_PLATFORMS="cpu",
+                       PYTHONPATH=repo_root + os.pathsep
+                       + env_base.get("PYTHONPATH", ""),
+                       TEST_NUM_PROC="4", TEST_DEVS_PER_PROC="2",
+                       DMLC_PS_ROOT_URI="127.0.0.1",
+                       DMLC_PS_ROOT_PORT=str(port),
+                       DMLC_NUM_WORKER="4",
+                       DMLC_WORKER_ID=str(rank))
+            procs.append(subprocess.Popen(
+                [sys.executable, str(script)], env=env,
+                stdout=subprocess.PIPE, stderr=subprocess.STDOUT,
+                text=True))
+        outs = []
+        for p in procs:
+            try:
+                out, _ = p.communicate(timeout=240)
+            except subprocess.TimeoutExpired:
+                p.kill()
+                out, _ = p.communicate()
+            outs.append(out)
+        for rank, (p, out) in enumerate(zip(procs, outs)):
+            assert p.returncode == 0, f"rank {rank}:\n{out}"
+            assert f"DIST_OK {rank}" in out, f"rank {rank}:\n{out}"
+
+    def test_ssh_mode_dry_run(self, tmp_path):
+        """launch.py -H hostfile fans out over ssh; a stub ssh on PATH
+        executes the remote command locally, validating the full export
+        + quoting + cd contract without a real cluster."""
+        repo_root = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+        script = tmp_path / "worker.py"
+        script.write_text(_DIST_WORKER)
+        hostfile = tmp_path / "hosts"
+        # both "hosts" are loopback so the coordinator (hosts[0]) is
+        # reachable; the ssh fanout/quoting contract is what's under test
+        hostfile.write_text("127.0.0.1\n127.0.0.1\n")
+        ssh_stub = tmp_path / "ssh"
+        ssh_stub.write_text(
+            "#!/bin/sh\n"
+            "# drop ssh options (-o val pairs) and the host, run the rest\n"
+            'while [ "$1" = "-o" ]; do shift 2; done\n'
+            "host=$1; shift\n"
+            'echo "SSH_STUB host=$host" 1>&2\n'
+            'exec /bin/sh -c "$*"\n')
+        ssh_stub.chmod(0o755)
+        env = {k: v for k, v in os.environ.items()
+               if not k.startswith(("DMLC_", "XLA_FLAGS"))}
+        env["PATH"] = str(tmp_path) + os.pathsep + env.get("PATH", "")
+        env["PYTHONPATH"] = repo_root + os.pathsep + env.get("PYTHONPATH", "")
+        env["JAX_PLATFORMS"] = "cpu"
+        out = subprocess.run(
+            [sys.executable, os.path.join(repo_root, "tools", "launch.py"),
+             "-n", "2", "-H", str(hostfile),
+             "--env", "TEST_NUM_PROC=2", "--env", "TEST_DEVS_PER_PROC=2",
+             "--env", "JAX_PLATFORMS=cpu",
+             "--env", "PYTHONPATH=" + env["PYTHONPATH"],
+             sys.executable, str(script)],
+            env=env, capture_output=True, text=True, timeout=240)
+        assert out.returncode == 0, out.stdout + out.stderr
+        assert "DIST_OK 0" in out.stdout and "DIST_OK 1" in out.stdout, \
+            out.stdout + out.stderr
+        assert out.stderr.count("SSH_STUB host=127.0.0.1") == 2

@@ -175,6 +175,12 @@ class TestInt8MXUPath:
                 max_calib_range=3.0)
         onp.testing.assert_allclose(got.asnumpy(), oracle.asnumpy(),
                                     rtol=1e-5, atol=1e-5)
+        # the s8 executable must actually be a DIFFERENT trace than the
+        # oracle's: the per-op cache is platform-keyed (round-3 review
+        # finding — an unkeyed cache served the oracle under the
+        # override, making this comparison vacuous)
+        assert not onp.array_equal(got.asnumpy(), oracle.asnumpy()), \
+            "s8 path returned the oracle executable's exact bits"
 
         # the compiled path must contain an s8 x s8 -> s32 dot
         from mxnet_tpu.ops.contrib import quantized_dense as qd_fn

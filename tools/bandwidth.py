@@ -72,12 +72,80 @@ def measure(size_mb=64.0, n_devices=None, iters=20, dtype="float32"):
     }
 
 
+def measure_dist(size_mb=64.0, iters=20, dtype="float32"):
+    """Cross-PROCESS allreduce: run under tools/launch.py so the psum
+    rides the DCN transport between jax processes (loopback TCP when the
+    workers share a host — exercises the full multi-controller path).
+
+        python tools/launch.py -n 4 python tools/bandwidth.py --dist
+
+    Each process contributes its local devices to one global dp mesh;
+    rank 0 prints the JSON record.
+    """
+    import numpy as np
+
+    import mxnet_tpu  # noqa: F401 - env/bootstrap side effects
+    from mxnet_tpu.kvstore.kvstore import KVStoreTPUSync
+
+    store = KVStoreTPUSync("dist_sync")  # bootstraps jax.distributed
+    import jax
+    import jax.numpy as jnp
+    from jax import shard_map
+    from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+    devs = jax.devices()                 # GLOBAL device list
+    n = len(devs)
+    mesh = Mesh(np.array(devs), ("dp",))
+    itemsize = jnp.dtype(dtype).itemsize
+    elems = int(size_mb * 1e6 / itemsize)
+    elems = max(elems - elems % n, n)
+    local = jax.local_device_count()
+    # per-process shards of the global array
+    host_shard = np.arange(elems // n * local, dtype=dtype).reshape(
+        local, 1, elems // n)
+    arrs = [jax.device_put(host_shard[i], d)
+            for i, d in enumerate(jax.local_devices())]
+    x = jax.make_array_from_single_device_arrays(
+        (n, elems // n), NamedSharding(mesh, P("dp")), arrs)
+
+    @jax.jit
+    def allreduce(v):
+        return shard_map(lambda s: jax.lax.psum(s, "dp"), mesh=mesh,
+                         in_specs=P("dp"), out_specs=P())(v)
+
+    out = allreduce(x)
+    jax.block_until_ready(out)
+    t0 = time.perf_counter()
+    for _ in range(iters):
+        out = allreduce(x)
+    jax.block_until_ready(out)
+    dt = time.perf_counter() - t0
+
+    payload = elems // n * itemsize * local   # bytes/process reduced
+    algo_gbps = payload * iters / dt / 1e9
+    if jax.process_index() == 0:
+        print(json.dumps({
+            "metric": "kvstore_allreduce_bandwidth_cross_process",
+            "value": round(algo_gbps, 3),
+            "unit": "GB/s (algorithmic, per process)",
+            "processes": jax.process_count(),
+            "devices": n,
+            "payload_mb": round(payload / 1e6, 2),
+            "platform": devs[0].platform,
+        }))
+
+
 def main():
     ap = argparse.ArgumentParser(description=__doc__)
     ap.add_argument("--size-mb", type=float, default=64.0)
     ap.add_argument("--devices", type=int, default=None)
     ap.add_argument("--iters", type=int, default=20)
+    ap.add_argument("--dist", action="store_true",
+                    help="cross-process mode (run under tools/launch.py)")
     args = ap.parse_args()
+    if args.dist:
+        measure_dist(args.size_mb, args.iters)
+        return
     print(json.dumps(measure(args.size_mb, args.devices, args.iters)))
 
 
