@@ -30,51 +30,71 @@ def main():
     from mxnet_tpu.gluon import loss as gloss
     from mxnet_tpu.gluon.model_zoo.nlp import bert
 
+    import os
+
     platform = jax.devices()[0].platform
-    batch = 16 if platform != "cpu" else 2
+    batch = int(os.environ.get("BENCH_BERT_BATCH",
+                               32 if platform != "cpu" else 2))
     seq = 512 if platform != "cpu" else 128
     steps = 20 if platform != "cpu" else 2
 
-    net = bert.bert_12_768_12(use_decoder=True, use_pooler=False,
-                              use_classifier=False)
-    net.initialize()
-    net.cast("bfloat16")
-
+    fused = os.environ.get("BENCH_BERT_FUSED", "1") != "0"
     rs = np.random.RandomState(0)
     tokens = mx.nd.array(rs.randint(0, 30000, (batch, seq)).astype(np.int32))
-    labels = mx.nd.array(rs.randint(0, 30000, (batch, seq)).astype(np.float32))
-
-    class MLMLoss(gloss.SoftmaxCrossEntropyLoss):
-        def hybrid_forward(self, F, pred, label):
-            # pred: (B, L, vocab) MLM logits; CE over every position
-            return super().hybrid_forward(
-                F, pred.reshape(-1, pred.shape[-1]), label.reshape(-1))
-
-    def pick_output(outs, label):
-        # BERTModel returns (sequence, mlm_logits) with use_decoder
-        mlm = outs[1] if isinstance(outs, (list, tuple)) else outs
-        return mlm
-
-    class LossAdapter:
-        def __init__(self):
-            self._l = MLMLoss()
-
-        def __call__(self, outs, label):
-            return self._l(pick_output(outs, label), label)
-
     mesh = par.make_mesh({"dp": 1}, devices=jax.devices()[:1])
-    step = par.TrainStep(net, LossAdapter(), "adam", mesh=mesh,
-                         optimizer_params={"learning_rate": 1e-4,
-                                           "multi_precision": True})
-    loss, _ = step(tokens, labels)
+
+    if fused:
+        # fused projection+CE head: the (B, L, vocab) logits never
+        # materialize (ops/fused_loss.py; same params/math as the
+        # decoder path, labels ride as a second data input)
+        net = bert.BERTForPretrainFused(
+            dropout=0.1,
+            chunk=int(os.environ.get("BENCH_BERT_CHUNK", 5120)))
+        net.initialize()
+        net.cast("bfloat16")
+        labels = mx.nd.array(
+            rs.randint(0, 30000, (batch, seq)).astype(np.int32))
+        step = par.TrainStep(
+            net, lambda outs, *a: outs, "adam", mesh=mesh, loss_only=True,
+            optimizer_params={"learning_rate": 1e-4,
+                              "multi_precision": True})
+        batch_args = ((tokens, labels), ())
+    else:
+        net = bert.bert_12_768_12(use_decoder=True, use_pooler=False,
+                                  use_classifier=False)
+        net.initialize()
+        net.cast("bfloat16")
+        labels = mx.nd.array(
+            rs.randint(0, 30000, (batch, seq)).astype(np.float32))
+
+        class MLMLoss(gloss.SoftmaxCrossEntropyLoss):
+            def hybrid_forward(self, F, pred, label):
+                # pred: (B, L, vocab) MLM logits; CE over every position
+                return super().hybrid_forward(
+                    F, pred.reshape(-1, pred.shape[-1]), label.reshape(-1))
+
+        class LossAdapter:
+            def __init__(self):
+                self._l = MLMLoss()
+
+            def __call__(self, outs, label):
+                mlm = outs[1] if isinstance(outs, (list, tuple)) else outs
+                return self._l(mlm, label)
+
+        step = par.TrainStep(net, LossAdapter(), "adam", mesh=mesh,
+                             optimizer_params={"learning_rate": 1e-4,
+                                               "multi_precision": True})
+        batch_args = (tokens, labels)
+
+    loss, _ = step(*batch_args)
     loss.asnumpy()
-    step.stage_batch(tokens, labels)
-    loss, _ = step(tokens, labels)
+    step.stage_batch(*batch_args)
+    loss, _ = step(*batch_args)
     loss.asnumpy()
 
     t0 = time.perf_counter()
     for _ in range(steps):
-        loss, _ = step(tokens, labels)
+        loss, _ = step(*batch_args)
     loss.asnumpy()
     dt = time.perf_counter() - t0
 

@@ -14,7 +14,8 @@ from ...block import HybridBlock
 from ... import nn
 from .transformer import TransformerEncoderCell
 
-__all__ = ["BERTEncoder", "BERTModel", "bert_12_768_12", "bert_24_1024_16",
+__all__ = ["BERTEncoder", "BERTModel", "BERTForPretrainFused",
+           "bert_12_768_12", "bert_24_1024_16",
            "bert_sharding_rules"]
 
 
@@ -131,3 +132,60 @@ def bert_24_1024_16(**kwargs):
     cfg = dict(num_layers=24, units=1024, hidden_size=4096, num_heads=16)
     cfg.update(kwargs)
     return BERTModel(**cfg)
+
+
+class BERTForPretrainFused(HybridBlock):
+    """BERT masked-LM pretraining with the FUSED projection+CE head.
+
+    Identical parameters and math to ``BERTModel(use_decoder=True)`` + a
+    sparse softmax CE over the (B, L, vocab) logits — but the logits are
+    never materialized: ``_contrib_softmax_ce_head`` scans vocab chunks
+    with an online logsumexp (the SoftmaxOutput lineage taken one step
+    further; see ops/fused_loss.py). On BERT-base the logits tensor and
+    its relayout copies were ~6 GB of HBM traffic per step (PERF.md
+    round 3).
+
+    ``forward(token_ids, mlm_labels) -> (B, L)`` per-position loss; use
+    with ``TrainStep(net, loss_fn=mean, loss_only=True)`` passing the
+    labels as a second DATA input.
+
+    Parameter-name note: the head lives at THIS block's scope
+    (``decoder_transform_*`` / ``decoder_bias``), while
+    ``BERTModel(use_decoder=True)`` scopes its head inside the backbone
+    — checkpoints move between the two pretraining paths via name-mapped
+    ``load_parameters``, not byte-identical files.
+    """
+
+    def __init__(self, vocab_size=30522, token_type_vocab_size=2,
+                 max_length=512, num_layers=12, units=768, hidden_size=3072,
+                 num_heads=12, dropout=0.1, chunk=5120,
+                 prefix=None, params=None):
+        super().__init__(prefix=prefix, params=params)
+        self._units = units
+        self._chunk = chunk
+        with self.name_scope():
+            self.bert = BERTModel(
+                vocab_size=vocab_size,
+                token_type_vocab_size=token_type_vocab_size,
+                max_length=max_length, num_layers=num_layers, units=units,
+                hidden_size=hidden_size, num_heads=num_heads,
+                dropout=dropout, use_pooler=False, use_classifier=False,
+                use_decoder=False, prefix="bert_")
+            self.decoder_transform = nn.Dense(
+                units, flatten=False, activation="gelu",
+                prefix="decoder_transform_")
+            self.decoder_ln = nn.LayerNorm(prefix="decoder_ln_")
+            # output projection stays TIED to the word embedding; its bias
+            # is this block's own parameter (reference decoder layout)
+            self.vocab_bias = self.params.get(
+                "decoder_bias", shape=(vocab_size,), init="zeros")
+
+    def hybrid_forward(self, F, token_ids, mlm_labels, vocab_bias):
+        seq = self.bert(token_ids)
+        h = self.decoder_ln(self.decoder_transform(seq))
+        # the tied projection weight is the backbone's embedding table;
+        # under a TrainStep trace p.data() resolves to the traced value,
+        # so gradients flow to the shared parameter from BOTH uses
+        w = self.bert.word_embed.weight.data(token_ids.context)
+        return F._contrib_softmax_ce_head(h, w, vocab_bias, mlm_labels,
+                                          chunk=self._chunk)

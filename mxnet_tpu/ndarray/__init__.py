@@ -28,6 +28,7 @@ from ..ops import optimizer_op as _optimizer_op  # noqa: F401
 from ..ops import contrib as _contrib_ops  # noqa: F401
 from ..ops import rnn as _rnn_ops  # noqa: F401
 from ..ops import attention as _attention_ops  # noqa: F401
+from ..ops import fused_loss as _fused_loss_ops  # noqa: F401
 from ..ops import spatial as _spatial_ops  # noqa: F401
 from ..ops import multibox as _multibox_ops  # noqa: F401
 

@@ -251,6 +251,37 @@ def _fwd_kernel(q_ref, k_ref, v_ref, o_ref, lse_ref, acc_ref, m_ref, l_ref,
             lse_col.reshape(1, bq), (8, bq))
 
 
+def _fwd_kernel_single_g(q_ref, k_ref, v_ref, o_ref, lse_ref, *,
+                         scale2, causal, causal_offset, prec, bq, bk):
+    """g heads per grid step (refs (G, BQ/BK, D)): amortizes the
+    per-grid-step overhead that dominates once the softmax runs in
+    base-2 — the dots batch over the leading head dim on the MXU."""
+    q = q_ref[...]                                         # (G, BQ, D)
+    k = k_ref[...]
+    v = v_ref[...]
+    s = jax.lax.dot_general(
+        q, k, (((2,), (2,)), ((0,), (0,))),
+        preferred_element_type=jnp.float32, precision=prec) * scale2
+    if causal:
+        g = q.shape[0]
+        q_pos = causal_offset + jax.lax.broadcasted_iota(
+            jnp.int32, (g, bq, bk), 1)
+        k_pos = jax.lax.broadcasted_iota(jnp.int32, (g, bq, bk), 2)
+        s = jnp.where(k_pos <= q_pos, s, _NEG_INF32)
+    m = jnp.max(s, axis=-1, keepdims=True)                 # (G, BQ, 1)
+    p = jnp.exp2(s - m)
+    l = jnp.sum(p, axis=-1, keepdims=True)
+    l_safe = jnp.where(l == _ZERO32, _ONE32, l)
+    o = jax.lax.dot_general(
+        p.astype(v.dtype), v, (((2,), (1,)), ((0,), (0,))),
+        preferred_element_type=jnp.float32, precision=prec)
+    o_ref[...] = (o / l_safe).astype(o_ref.dtype)
+    g = q.shape[0]
+    lse_col = jnp.where(l == _ZERO32, _NEG_INF32, m + jnp.log2(l_safe))
+    lse_ref[...] = jnp.broadcast_to(
+        lse_col.reshape(g, 1, bq), (g, 8, bq))
+
+
 def _fwd_kernel_single(q_ref, k_ref, v_ref, o_ref, lse_ref, *,
                        scale2, causal, causal_offset, prec, bq, bk):
     """Whole-head-in-one-block forward (nq == nk == 1, e.g. BERT seq 512).
@@ -343,6 +374,38 @@ def _flash_fwd_pallas(q, k, v, scale, causal, interpret=False,
         o_shape,
         jax.ShapeDtypeStruct((bh, nq, 8, bq), jnp.float32),
     ]
+    if nq == 1 and nk == 1 and layout == "bhld":
+        # g heads per grid step; f32 score tile g*bq*bk*4 caps VMEM
+        # f32 score tile gg*bq*bk*4 plus double-buffered operands must
+        # fit the 16 MB VMEM scoped limit: g=8 at 512-blocks OOMs (18 MB)
+        # and g=6 measures ~1% SLOWER than g=4 end-to-end (BERT-base,
+        # PERF.md round 3) — pipelining beats raw occupancy here
+        g = next(gg for gg in (4, 3, 2, 1)
+                 if bh % gg == 0 and gg * bq * bk * 4 <= 4 << 20)
+        kernel = functools.partial(
+            _fwd_kernel_single_g, scale2=scale2, causal=causal,
+            causal_offset=lk - lq, prec=prec, bq=bq, bk=bk)
+        with _x32_mode():
+            out, lse = pl.pallas_call(
+                kernel,
+                grid=(bh // g, 1, 1),
+                in_specs=[
+                    pl.BlockSpec((g, bq, d), lambda b, qi, ki: (b, qi, 0)),
+                    pl.BlockSpec((g, bk, d), lambda b, qi, ki: (b, ki, 0)),
+                    pl.BlockSpec((g, bk, d), lambda b, qi, ki: (b, ki, 0)),
+                ],
+                out_specs=[
+                    pl.BlockSpec((g, bq, d), lambda b, qi, ki: (b, qi, 0)),
+                    pl.BlockSpec((g, None, 8, bq),
+                                 lambda b, qi, ki: (b, qi, 0, 0)),
+                ],
+                out_shape=[
+                    jax.ShapeDtypeStruct((bh, lq, d), q.dtype),
+                    jax.ShapeDtypeStruct((bh, nq, 8, bq), jnp.float32),
+                ],
+                interpret=interpret,
+            )(q, k, v)
+        return out.reshape(b, h, lq, d), lse
     if nq == 1 and nk == 1:
         kernel = functools.partial(
             _fwd_kernel_single, scale2=scale2, causal=causal,
@@ -432,6 +495,46 @@ def _bwd_dkdv_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref,
     def _final():
         dk_ref[...] = dk_acc[:].astype(dk_ref.dtype)
         dv_ref[...] = dv_acc[:].astype(dv_ref.dtype)
+
+
+def _bwd_fused_kernel_g(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref,
+                        dq_ref, dk_ref, dv_ref, *, scale, scale2, causal,
+                        causal_offset, prec, bq, bk):
+    """g-heads-per-step fused backward (refs (G, ., .)); see
+    _bwd_fused_kernel for the math, _fwd_kernel_single_g for why."""
+    q = q_ref[...]                                     # (G, BQ, D)
+    k = k_ref[...]
+    v = v_ref[...]
+    do = do_ref[...]
+    lse = lse_ref[:, 0:1, :]                           # (G, 1, BQ)
+    delta = delta_ref[:, 0:1, :]
+    s_t = jax.lax.dot_general(
+        k, q, (((2,), (2,)), ((0,), (0,))),
+        preferred_element_type=jnp.float32, precision=prec) * scale2
+    if causal:
+        g = q.shape[0]
+        q_pos = causal_offset + jax.lax.broadcasted_iota(
+            jnp.int32, (g, bk, bq), 2)
+        k_pos = jax.lax.broadcasted_iota(jnp.int32, (g, bk, bq), 1)
+        s_t = jnp.where(k_pos <= q_pos, s_t, _NEG_INF32)
+    p_t = jnp.exp2(s_t - lse)                          # (G, BK, BQ)
+    p_cast = p_t.astype(do.dtype)
+    dv_ref[...] = jax.lax.dot_general(
+        p_cast, do, (((2,), (1,)), ((0,), (0,))),
+        preferred_element_type=jnp.float32,
+        precision=prec).astype(dv_ref.dtype)
+    dp_t = jax.lax.dot_general(
+        v, do, (((2,), (2,)), ((0,), (0,))),
+        preferred_element_type=jnp.float32, precision=prec)
+    ds_t = (p_t * (dp_t - delta) * scale).astype(q.dtype)
+    dk_ref[...] = jax.lax.dot_general(
+        ds_t, q, (((2,), (1,)), ((0,), (0,))),
+        preferred_element_type=jnp.float32,
+        precision=prec).astype(dk_ref.dtype)
+    dq_ref[...] = jax.lax.dot_general(
+        ds_t, k, (((1,), (1,)), ((0,), (0,))),
+        preferred_element_type=jnp.float32,
+        precision=prec).astype(dq_ref.dtype)
 
 
 def _bwd_fused_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref,
@@ -577,6 +680,30 @@ def _flash_bwd_pallas(q, k, v, o, lse, g, scale, causal, interpret=False,
     offset = lk - lq
     prec = _prec_for(q.dtype)
 
+    if nq == 1 and nk == 1 and layout == "bhld":
+        # fused dq/dk/dv kernel, g heads per grid step (f32 score tiles
+        # are the VMEM cap: ~3 live (G, BK, BQ) intermediates)
+        g = next(gg for gg in (2, 1)
+                 if bh % gg == 0 and 3 * gg * bq * bk * 4 <= 7 << 20)
+        gq_spec = pl.BlockSpec((g, bq, d), lambda b_, qi, ki: (b_, qi, 0))
+        gk_spec = pl.BlockSpec((g, bk, d), lambda b_, qi, ki: (b_, ki, 0))
+        grow_spec = pl.BlockSpec((g, None, 8, bq),
+                                 lambda b_, qi, ki: (b_, qi, 0, 0))
+        with _x32_mode():
+            dq, dk3, dv3 = pl.pallas_call(
+                functools.partial(_bwd_fused_kernel_g, scale=scale,
+                                  scale2=_np.float32(scale) * _LOG2E,
+                                  causal=causal, causal_offset=offset,
+                                  prec=prec, bq=bq, bk=bk),
+                grid=(bh // g, 1, 1),
+                in_specs=[gq_spec, gk_spec, gk_spec, gq_spec,
+                          grow_spec, grow_spec],
+                out_specs=[gq_spec, gk_spec, gk_spec],
+                out_shape=[dq_shape, dk_shape, dv_shape],
+                interpret=interpret,
+            )(q, k, v, do, lse, delta)
+        return (dq.reshape(b, h, lq, d), dk3.reshape(b, h, lk, d),
+                dv3.reshape(b, h, lk, d))
     if nq == 1 and nk == 1:
         # whole head in one block: fused dq/dk/dv kernel shares the p
         # recompute (5 matmuls + 1 exp instead of 7 + 2)
@@ -597,9 +724,6 @@ def _flash_bwd_pallas(q, k, v, o, lse, g, scale, causal, interpret=False,
                 out_shape=[dq_shape, dk_shape, dv_shape],
                 interpret=interpret,
             )(q, k, v, do, lse, delta)
-        if layout == "bhld":
-            return (dq.reshape(b, h, lq, d), dk3.reshape(b, h, lk, d),
-                    dv3.reshape(b, h, lk, d))
         return dq, dk3, dv3
 
     # grid (bh, nk, nq): q/do/lse/delta stream on the inner (j) dim, so

@@ -31,11 +31,7 @@ def build_bert_step():
     from mxnet_tpu.gluon import loss as gloss
     from mxnet_tpu.gluon.model_zoo.nlp import bert
 
-    batch, seq = 16, 512
-    net = bert.bert_12_768_12(use_decoder=True, use_pooler=False,
-                              use_classifier=False)
-    net.initialize()
-    net.cast("bfloat16")
+    batch, seq = int(os.environ.get("BENCH_BERT_BATCH", 16)), 512
     rs = np.random.RandomState(0)
     tokens = mx.nd.array(rs.randint(0, 30000, (batch, seq)).astype(np.int32))
     labels = mx.nd.array(rs.randint(0, 30000, (batch, seq)).astype(np.float32))
@@ -54,6 +50,20 @@ def build_bert_step():
             return self._l(mlm, label)
 
     mesh = par.make_mesh({"dp": 1}, devices=jax.devices()[:1])
+    if os.environ.get("BENCH_BERT_FUSED", "1") != "0":
+        net = bert.BERTForPretrainFused(dropout=0.1)
+        net.initialize()
+        net.cast("bfloat16")
+        labels_i = mx.nd.array(labels.asnumpy().astype(np.int32))
+        step = par.TrainStep(net, lambda outs, *a: outs, "adam", mesh=mesh,
+                             loss_only=True,
+                             optimizer_params={"learning_rate": 1e-4,
+                                               "multi_precision": True})
+        return step, ((tokens, labels_i), ())
+    net = bert.bert_12_768_12(use_decoder=True, use_pooler=False,
+                              use_classifier=False)
+    net.initialize()
+    net.cast("bfloat16")
     step = par.TrainStep(net, LossAdapter(), "adam", mesh=mesh,
                          optimizer_params={"learning_rate": 1e-4,
                                            "multi_precision": True})
