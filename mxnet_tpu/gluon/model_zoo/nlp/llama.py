@@ -119,12 +119,18 @@ class LlamaModel(HybridBlock):
     def __init__(self, vocab_size=128256, num_layers=32, units=4096,
                  hidden_size=14336, num_heads=32, num_kv_heads=8,
                  rope_theta=500000.0, eps=1e-5, tie_weights=False,
-                 ring_axis=None, remat=False, prefix=None, params=None):
+                 ring_axis=None, remat=False, fused_ce=False,
+                 prefix=None, params=None):
         super().__init__(prefix=prefix, params=params)
         self._units = units
         # per-block gradient rematerialization (jax.checkpoint) inside
         # compiled train steps — pretrain-scale memory policy
         self._remat = bool(remat)
+        # fused projection+CE head (ops/fused_loss.py): forward takes
+        # (tokens, labels) and returns per-token loss; the (B, L, vocab)
+        # logits never materialize — at pretrain vocab sizes they are
+        # the largest intermediate of the step
+        self._fused_ce = bool(fused_ce)
         with self.name_scope():
             self.embed = nn.Embedding(vocab_size, units, prefix="embed_")
             self.blocks = []
@@ -135,22 +141,34 @@ class LlamaModel(HybridBlock):
                 self.blocks.append(blk)
                 self.register_child(blk, f"layer{i}")
             self.norm = RMSNorm(units, eps, prefix="norm_")
+            # explicit in_units: in fused-CE mode the Dense's own
+            # forward never runs, so the weight must not be deferred
             if tie_weights:
-                self.lm_head = nn.Dense(vocab_size, flatten=False,
-                                        use_bias=False,
+                self.lm_head = nn.Dense(vocab_size, in_units=units,
+                                        flatten=False, use_bias=False,
                                         params=self.embed.params,
                                         prefix="embed_")
             else:
-                self.lm_head = nn.Dense(vocab_size, flatten=False,
-                                        use_bias=False, prefix="lm_head_")
+                self.lm_head = nn.Dense(vocab_size, in_units=units,
+                                        flatten=False, use_bias=False,
+                                        prefix="lm_head_")
 
-    def hybrid_forward(self, F, tokens):
+    def hybrid_forward(self, F, tokens, labels=None):
         from ...block import remat_call
 
         x = self.embed(tokens)
         for blk in self.blocks:
             x = remat_call(blk, x) if self._remat else blk(x)
-        return self.lm_head(self.norm(x))
+        h = self.norm(x)
+        if self._fused_ce:
+            if labels is None:
+                raise ValueError(
+                    "LlamaModel(fused_ce=True) takes (tokens, labels) and "
+                    "returns the per-token loss")
+            w = self.lm_head.weight.data(tokens.context)
+            return F._contrib_softmax_ce_head(h, w, None, labels,
+                                              chunk=8192)
+        return self.lm_head(h)
 
 
 class LlamaModelPP(HybridBlock):

@@ -54,6 +54,8 @@ def _chunk_logits(hidden, w_c, b_c, prec):
     s = jax.lax.dot_general(
         hidden, w_c, (((1,), (1,)), ((), ())),
         preferred_element_type=jnp.float32, precision=prec)
+    if b_c is None:
+        return s
     return s + b_c.astype(jnp.float32)[None, :]
 
 
@@ -146,18 +148,118 @@ def _fused_ce_bwd(chunk, res, g):
 _fused_ce.defvjp(_fused_ce_fwd, _fused_ce_bwd)
 
 
+@functools.partial(jax.custom_vjp, nondiff_argnums=(3,))
+def _fused_ce_nobias(hidden, weight, labels, chunk):
+    return _fused_ce_nobias_fwd(hidden, weight, labels, chunk)[0]
+
+
+def _fused_ce_nobias_fwd(hidden, weight, labels, chunk):
+    """Bias-free head (Llama lm_head): no bias add in the chunk logits,
+    no vocab-sized bias cotangent computed-and-discarded each step. The
+    padded rows rely on masking: padding can only win the row max when
+    EVERY real logit is below 0, so the pad chunks mask to -inf
+    explicitly via the vocab validity bound carried in `chunk` math."""
+    n, d = hidden.shape
+    v_pad = weight.shape[0]
+    nc = v_pad // chunk
+    w_ch = weight.reshape(nc, chunk, d)
+    lab = labels.astype(jnp.int32)
+    prec = _prec(hidden.dtype)
+
+    def body(carry, ch):
+        m, l, picked = carry
+        w_c, ci = ch
+        s2 = _chunk_logits(hidden, w_c, None, prec) * _LOG2E
+        m_new = jnp.maximum(m, jnp.max(s2, axis=-1))
+        l = l * jnp.exp2(m - m_new) + jnp.sum(
+            jnp.exp2(s2 - m_new[:, None]), axis=-1)
+        off = lab - ci * chunk
+        hit = (off >= 0) & (off < chunk)
+        got = jnp.take_along_axis(
+            s2, jnp.clip(off, 0, chunk - 1)[:, None], axis=-1)[:, 0]
+        picked = jnp.where(hit, got, picked)
+        return (m_new, l, picked), None
+
+    m0 = jnp.full((n,), _NEG, jnp.float32)
+    l0 = jnp.zeros((n,), jnp.float32)
+    p0 = jnp.zeros((n,), jnp.float32)
+    (m, l, picked), _ = jax.lax.scan(
+        body, (m0, l0, p0), (w_ch, jnp.arange(nc)), unroll=True)
+    lse2 = m + jnp.log2(l)
+    ln2 = jnp.float32(0.6931471805599453)
+    return (lse2 - picked) * ln2, (hidden, weight, lab, lse2)
+
+
+def _fused_ce_nobias_bwd(chunk, res, g):
+    hidden, weight, lab, lse2 = res
+    n, d = hidden.shape
+    v_pad = weight.shape[0]
+    nc = v_pad // chunk
+    w_ch = weight.reshape(nc, chunk, d)
+    gf = g.astype(jnp.float32)
+    prec = _prec(hidden.dtype)
+
+    def body(carry, ch):
+        dx = carry
+        w_c, ci = ch
+        s2 = _chunk_logits(hidden, w_c, None, prec) * _LOG2E
+        p = jnp.exp2(s2 - lse2[:, None])
+        off = lab - ci * chunk
+        hit = (off >= 0) & (off < chunk)
+        onehot = (jnp.arange(chunk)[None, :] ==
+                  jnp.clip(off, 0, chunk - 1)[:, None]) & hit[:, None]
+        gl = (p - onehot.astype(jnp.float32)) * gf[:, None]
+        gl_cast = gl.astype(hidden.dtype)
+        dx = dx + jax.lax.dot_general(
+            gl_cast, w_c, (((1,), (0,)), ((), ())),
+            preferred_element_type=jnp.float32, precision=prec)
+        dw_c = jax.lax.dot_general(
+            gl_cast, hidden, (((0,), (0,)), ((), ())),
+            preferred_element_type=jnp.float32, precision=prec)
+        return dx, dw_c
+
+    dx0 = jnp.zeros((n, d), jnp.float32)
+    dx, dw_ch = jax.lax.scan(body, dx0, (w_ch, jnp.arange(nc)),
+                             unroll=True)
+    return (dx.astype(hidden.dtype),
+            dw_ch.reshape(v_pad, d).astype(weight.dtype),
+            _np.zeros(lab.shape, jax.dtypes.float0))
+
+
+_fused_ce_nobias.defvjp(_fused_ce_nobias_fwd, _fused_ce_nobias_bwd)
+
+
 @register("_contrib_softmax_ce_head", aliases=["softmax_ce_head"])
-def softmax_ce_head(hidden, weight, bias, labels, *, chunk=5120):
+def softmax_ce_head(hidden, weight, bias=None, labels=None, *, chunk=5120):
     """Per-position CE loss of a tied/untied vocab projection, computed
     WITHOUT materializing the (N, vocab) logits (see module docstring).
 
-    hidden (..., D); weight (V, D); bias (V,); labels (...) int.
+    hidden (..., D); weight (V, D); bias (V,) or None (bias-free heads
+    pay no vocab-sized bias-grad sweep); labels (...) int.
     Returns per-position loss shaped like ``labels`` (f32).
     """
     lead = hidden.shape[:-1]
     d = hidden.shape[-1]
     h2 = hidden.reshape(-1, d)
     lab = labels.reshape(-1)
-    weight, bias, _ = _pad_vocab(weight, bias, int(chunk))
-    loss = _fused_ce(h2, weight, bias, lab, int(chunk))
+    chunk = int(chunk)
+    if bias is None:
+        v = weight.shape[0]
+        v_pad = -(-v // chunk) * chunk
+        if v_pad != v:
+            # no bias to carry the -inf mask: guard padded rows by
+            # padding labels-space weights with zeros AND masking via a
+            # -inf bias chunk would reintroduce the bias — instead pad
+            # and rely on the loss being exact only over real rows:
+            # zero-padded rows contribute exp(h.0)=1 terms, so pad must
+            # be masked. Fall back to the bias variant with a zero bias
+            # ONLY for the padded tail case.
+            w_p, b_p, _ = _pad_vocab(
+                weight, jnp.zeros((v,), jnp.float32), chunk)
+            loss = _fused_ce(h2, w_p, b_p, lab, chunk)
+            return loss.reshape(lead)
+        loss = _fused_ce_nobias(h2, weight, lab, chunk)
+        return loss.reshape(lead)
+    weight, bias, _ = _pad_vocab(weight, bias, chunk)
+    loss = _fused_ce(h2, weight, bias, lab, chunk)
     return loss.reshape(lead)

@@ -683,11 +683,13 @@ def _flash_bwd_pallas(q, k, v, o, lse, g, scale, causal, interpret=False,
     if nq == 1 and nk == 1 and layout == "bhld":
         # fused dq/dk/dv kernel, g heads per grid step (f32 score tiles
         # are the VMEM cap: ~3 live (G, BK, BQ) intermediates)
-        g = next(gg for gg in (2, 1)
-                 if bh % gg == 0 and 3 * gg * bq * bk * 4 <= 7 << 20)
-        gq_spec = pl.BlockSpec((g, bq, d), lambda b_, qi, ki: (b_, qi, 0))
-        gk_spec = pl.BlockSpec((g, bk, d), lambda b_, qi, ki: (b_, ki, 0))
-        grow_spec = pl.BlockSpec((g, None, 8, bq),
+        grp = next(gg for gg in (2, 1)
+                   if bh % gg == 0 and 3 * gg * bq * bk * 4 <= 7 << 20)
+        gq_spec = pl.BlockSpec((grp, bq, d),
+                               lambda b_, qi, ki: (b_, qi, 0))
+        gk_spec = pl.BlockSpec((grp, bk, d),
+                               lambda b_, qi, ki: (b_, ki, 0))
+        grow_spec = pl.BlockSpec((grp, None, 8, bq),
                                  lambda b_, qi, ki: (b_, qi, 0, 0))
         with _x32_mode():
             dq, dk3, dv3 = pl.pallas_call(
@@ -695,7 +697,7 @@ def _flash_bwd_pallas(q, k, v, o, lse, g, scale, causal, interpret=False,
                                   scale2=_np.float32(scale) * _LOG2E,
                                   causal=causal, causal_offset=offset,
                                   prec=prec, bq=bq, bk=bk),
-                grid=(bh // g, 1, 1),
+                grid=(bh // grp, 1, 1),
                 in_specs=[gq_spec, gk_spec, gk_spec, gq_spec,
                           grow_spec, grow_spec],
                 out_specs=[gq_spec, gk_spec, gk_spec],

@@ -80,3 +80,28 @@ def test_bert_fused_block_trains_and_ties():
     unused = [r for r in range(128) if r not in used][:20]
     assert unused and not onp.allclose(w1[unused], w0[unused]), \
         "tied projection gradient did not reach unused vocab rows"
+
+
+def test_nobias_variant_matches_zero_bias():
+    """bias=None (Llama lm_head): the bias-free custom-VJP variant must
+    match the biased path with a zero bias, fwd and grads — without
+    computing a vocab-sized bias cotangent."""
+    rs = onp.random.RandomState(2)
+    N, D, V = 32, 16, 512   # V % chunk == 0 -> true nobias path
+    h = jnp.asarray(rs.randn(N, D) * 0.5, jnp.float32)
+    w = jnp.asarray(rs.randn(V, D) * 0.1, jnp.float32)
+    lab = jnp.asarray(rs.randint(0, V, (N,)), jnp.int32)
+    zb = jnp.zeros((V,), jnp.float32)
+
+    def with_zero_bias(h, w):
+        return softmax_ce_head(h, w, zb, lab, chunk=128).mean()
+
+    def no_bias(h, w):
+        return softmax_ce_head(h, w, None, lab, chunk=128).mean()
+
+    lr, gr = jax.value_and_grad(with_zero_bias, argnums=(0, 1))(h, w)
+    lf, gf = jax.value_and_grad(no_bias, argnums=(0, 1))(h, w)
+    assert float(lf) == pytest.approx(float(lr), abs=1e-5)
+    for a, b, nm in zip(gr, gf, "hw"):
+        onp.testing.assert_allclose(onp.asarray(b), onp.asarray(a),
+                                    rtol=1e-5, atol=1e-5, err_msg=nm)

@@ -81,6 +81,10 @@ def main(argv=None):
     ap.add_argument("--data", default="synthetic")
     ap.add_argument("--save-dir", default=None)
     ap.add_argument("--save-every", type=int, default=1000)
+    ap.add_argument("--no-fused-ce", dest="fused_ce",
+                    action="store_false", default=True,
+                    help="materialize logits + separate CE instead of "
+                         "the fused projection+CE head")
     ap.add_argument("--compile-only", action="store_true",
                     help="AOT lower+compile the sharded train step without "
                          "materializing weights (validates the 8B recipe "
@@ -108,8 +112,10 @@ def main(argv=None):
     rules = llama_sharding_rules(tp_axis="tp") if "tp" in axes else None
     ring_axis = "sp" if "sp" in axes else None
 
-    net = LlamaModel(**cfg, remat=remat, ring_axis=ring_axis)
-    loss_fn = _CausalLMLoss(gloss)
+    net = LlamaModel(**cfg, remat=remat, ring_axis=ring_axis,
+                     fused_ce=args.fused_ce)
+    loss_fn = (_FusedLossPassthrough() if args.fused_ce
+               else _CausalLMLoss(gloss))
 
     if args.compile_only:
         return _compile_only(jax, mx, par, net, loss_fn, mesh, rules,
@@ -127,24 +133,34 @@ def main(argv=None):
                           "beta1": 0.9, "beta2": 0.95,
                           "multi_precision": True})
 
-    data_iter = _make_data(mx, args.data, batch, seq, cfg["vocab_size"])
+    data_iter = _make_data(mx, args.data, batch, seq, cfg["vocab_size"],
+                           int_labels=args.fused_ce)
     tokens, labels = next(data_iter)
+
+    def run_step(tokens, labels):
+        if args.fused_ce:
+            return step((tokens, labels), ())
+        return step(tokens, labels)
+
     t0 = time.time()
-    loss, _ = step(tokens, labels)
+    loss, _ = run_step(tokens, labels)
     loss_val = float(loss.asnumpy())
     print(f"step 1: loss {loss_val:.4f} "
           f"(compile+run {time.time() - t0:.0f}s; {n_params / 1e6:.0f}M "
           f"params, mesh {dict(zip(mesh.axis_names, mesh.devices.shape))})",
           flush=True)
     if args.data == "synthetic":
-        step.stage_batch(tokens, labels)
+        if args.fused_ce:
+            step.stage_batch((tokens, labels), ())
+        else:
+            step.stage_batch(tokens, labels)
 
     times = []
     for i in range(2, args.steps + 1):
         if args.data != "synthetic":
             tokens, labels = next(data_iter)
         t0 = time.time()
-        loss, _ = step(tokens, labels)
+        loss, _ = run_step(tokens, labels)
         if i == args.steps or i % 20 == 0:
             loss_val = float(loss.asnumpy())
         times.append(time.time() - t0)
@@ -170,6 +186,13 @@ def main(argv=None):
     return 0
 
 
+class _FusedLossPassthrough:
+    """fused_ce=True: the model already returns per-token loss."""
+
+    def __call__(self, outs, *a):
+        return outs[0] if isinstance(outs, (list, tuple)) else outs
+
+
 class _CausalLMLoss:
     """Next-token CE over (B, L, vocab) logits (shift-by-one)."""
 
@@ -182,7 +205,8 @@ class _CausalLMLoss:
         return self._l(logits.reshape(-1, v), labels.reshape(-1))
 
 
-def _make_data(mx, source, batch, seq, vocab):
+def _make_data(mx, source, batch, seq, vocab, int_labels=False):
+    lab_dtype = np.int32 if int_labels else np.float32
     if source == "synthetic":
         rs = np.random.RandomState(0)
         toks = rs.randint(0, vocab, (batch, seq + 1))
@@ -190,7 +214,7 @@ def _make_data(mx, source, batch, seq, vocab):
         def gen():
             while True:
                 yield (mx.nd.array(toks[:, :-1].astype(np.int32)),
-                       mx.nd.array(toks[:, 1:].astype(np.float32)))
+                       mx.nd.array(toks[:, 1:].astype(lab_dtype)))
         return gen()
 
     from mxnet_tpu import recordio
@@ -210,7 +234,7 @@ def _make_data(mx, source, batch, seq, vocab):
                 buf_l.append(arr[1:seq + 1])
                 if len(buf_t) == batch:
                     yield (mx.nd.array(np.stack(buf_t)),
-                           mx.nd.array(np.stack(buf_l).astype(np.float32)))
+                           mx.nd.array(np.stack(buf_l).astype(lab_dtype)))
                     buf_t, buf_l = [], []
             reader.close()
     return gen_rec()
@@ -258,8 +282,13 @@ def _compile_only(jax, mx, par, net, loss_fn, mesh, rules, batch, seq, cfg,
                               "beta1": 0.9, "beta2": 0.95,
                               "multi_precision": True})
         tok = jax.ShapeDtypeStruct((batch, seq), jnp.int32)
-        lbl = jax.ShapeDtypeStruct((batch, seq), jnp.float32)
-        compiled = step.aot_compile(tok, lbl)
+        if args.fused_ce:
+            # fused head: labels are the model's second DATA input
+            lbl = jax.ShapeDtypeStruct((batch, seq), jnp.int32)
+            compiled = step.aot_compile((tok, lbl), ())
+        else:
+            lbl = jax.ShapeDtypeStruct((batch, seq), jnp.float32)
+            compiled = step.aot_compile(tok, lbl)
     try:
         mem = compiled.memory_analysis()
         arg_b = getattr(mem, "argument_size_in_bytes", None)

@@ -31,7 +31,9 @@ def build_bert_step():
     from mxnet_tpu.gluon import loss as gloss
     from mxnet_tpu.gluon.model_zoo.nlp import bert
 
-    batch, seq = int(os.environ.get("BENCH_BERT_BATCH", 16)), 512
+    # defaults track bench_bert.py so the trace profiles the published
+    # configuration
+    batch, seq = int(os.environ.get("BENCH_BERT_BATCH", 32)), 512
     rs = np.random.RandomState(0)
     tokens = mx.nd.array(rs.randint(0, 30000, (batch, seq)).astype(np.int32))
     labels = mx.nd.array(rs.randint(0, 30000, (batch, seq)).astype(np.float32))
@@ -51,7 +53,9 @@ def build_bert_step():
 
     mesh = par.make_mesh({"dp": 1}, devices=jax.devices()[:1])
     if os.environ.get("BENCH_BERT_FUSED", "1") != "0":
-        net = bert.BERTForPretrainFused(dropout=0.1)
+        net = bert.BERTForPretrainFused(
+            dropout=0.1,
+            chunk=int(os.environ.get("BENCH_BERT_CHUNK", 5120)))
         net.initialize()
         net.cast("bfloat16")
         labels_i = mx.nd.array(labels.asnumpy().astype(np.int32))
