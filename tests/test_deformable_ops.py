@@ -1,0 +1,103 @@
+"""Deformable conv / correlation / PSROIPooling (op long-tail,
+VERDICT round-2 missing #4). Oracles: zero-offset deformable conv ==
+plain Convolution; correlation at zero displacement == channel-mean
+product; PSROIPooling channel routing."""
+import numpy as onp
+import pytest
+
+import mxnet_tpu as mx
+from mxnet_tpu import nd
+
+
+def test_deformable_conv_zero_offset_matches_convolution():
+    rs = onp.random.RandomState(0)
+    x = mx.nd.array(rs.randn(2, 4, 9, 9).astype(onp.float32))
+    w = mx.nd.array(rs.randn(6, 4, 3, 3).astype(onp.float32))
+    off = mx.nd.array(onp.zeros((2, 2 * 9, 9, 9), onp.float32))
+    ref = nd.Convolution(x, w, None, kernel=(3, 3), num_filter=6,
+                         pad=(1, 1), no_bias=True)
+    got = nd.contrib.DeformableConvolution(
+        x, off, w, kernel=(3, 3), num_filter=6, pad=(1, 1), no_bias=True)
+    onp.testing.assert_allclose(got.asnumpy(), ref.asnumpy(),
+                                rtol=1e-4, atol=1e-4)
+
+
+def test_deformable_conv_integer_offset_shifts_sampling():
+    rs = onp.random.RandomState(1)
+    x = mx.nd.array(rs.randn(1, 2, 8, 8).astype(onp.float32))
+    w = mx.nd.array(onp.ones((1, 2, 1, 1), onp.float32))
+    # constant (dy, dx) = (0, 1): sampling shifts one column right
+    off = onp.zeros((1, 2, 8, 8), onp.float32)
+    off[:, 1] = 1.0
+    got = nd.contrib.DeformableConvolution(
+        x, mx.nd.array(off), w, kernel=(1, 1), num_filter=1, no_bias=True)
+    ref = x.asnumpy().sum(axis=1, keepdims=True)
+    onp.testing.assert_allclose(got.asnumpy()[:, :, :, :-1],
+                                ref[:, :, :, 1:], rtol=1e-5, atol=1e-5)
+    # border samples past the edge read zero
+    onp.testing.assert_allclose(got.asnumpy()[:, :, :, -1], 0.0,
+                                atol=1e-6)
+
+
+def test_correlation_zero_displacement_channel_mean():
+    rs = onp.random.RandomState(2)
+    a = mx.nd.array(rs.randn(1, 3, 6, 6).astype(onp.float32))
+    b = mx.nd.array(rs.randn(1, 3, 6, 6).astype(onp.float32))
+    out = nd.Correlation(a, b, kernel_size=1, max_displacement=0,
+                         stride1=1, stride2=1, pad_size=0)
+    want = (a.asnumpy() * b.asnumpy()).mean(axis=1, keepdims=True)
+    assert out.shape == (1, 1, 6, 6)
+    onp.testing.assert_allclose(out.asnumpy(), want, rtol=1e-5, atol=1e-5)
+
+
+def test_correlation_displacement_volume_shape():
+    rs = onp.random.RandomState(3)
+    a = mx.nd.array(rs.randn(2, 4, 12, 12).astype(onp.float32))
+    b = mx.nd.array(rs.randn(2, 4, 12, 12).astype(onp.float32))
+    out = nd.Correlation(a, b, kernel_size=1, max_displacement=2,
+                         stride1=1, stride2=1, pad_size=2)
+    assert out.shape[1] == 25  # (2*2+1)^2 displacement volume
+
+
+def test_psroipooling_routes_channel_groups():
+    # data where channel group (gy, gx) holds the constant gy*10+gx:
+    # each output bin must read ITS OWN group's constant
+    ps = 3
+    od = 2
+    data = onp.zeros((1, od * ps * ps, 12, 12), onp.float32)
+    for o in range(od):
+        for gy in range(ps):
+            for gx in range(ps):
+                cidx = o * ps * ps + gy * ps + gx
+                data[0, cidx] = gy * 10 + gx + 100 * o
+    rois = mx.nd.array(onp.array([[0, 0, 0, 11, 11]], onp.float32))
+    out = nd.contrib.PSROIPooling(mx.nd.array(data), rois,
+                                  spatial_scale=1.0, output_dim=od,
+                                  pooled_size=ps)
+    got = out.asnumpy()
+    assert got.shape == (1, od, ps, ps)
+    for o in range(od):
+        for gy in range(ps):
+            for gx in range(ps):
+                assert got[0, o, gy, gx] == pytest.approx(
+                    gy * 10 + gx + 100 * o, abs=1e-4)
+
+
+def test_deformable_conv_gradients_flow():
+    import jax
+
+    from mxnet_tpu.ops.deformable import deformable_convolution
+
+    rs = onp.random.RandomState(4)
+    x = rs.randn(1, 2, 6, 6).astype(onp.float32)
+    w = rs.randn(3, 2, 3, 3).astype(onp.float32)
+    off = rs.randn(1, 18, 6, 6).astype(onp.float32) * 0.3
+
+    def loss(x, off, w):
+        return (deformable_convolution(
+            x, off, w, kernel=(3, 3), num_filter=3, pad=(1, 1),
+            no_bias=True) ** 2).sum()
+
+    g = jax.grad(loss, argnums=(0, 1, 2))(x, off, w)
+    for gi, nm in zip(g, ("x", "off", "w")):
+        assert float(onp.abs(onp.asarray(gi)).sum()) > 0, nm
