@@ -99,6 +99,7 @@ from . import contrib
 from . import recordio
 from . import image
 from . import test_utils
+from . import operator
 from . import runtime
 from . import rtc
 from . import amp

@@ -1,4 +1,5 @@
 """Model zoo (reference: python/mxnet/gluon/model_zoo/ for vision; the nlp
 package covers the GluonNLP-zoo capability — SURVEY.md §1 L8)."""
+from . import model_store  # noqa: F401
 from . import vision  # noqa: F401
 from . import nlp  # noqa: F401

@@ -5,8 +5,9 @@ resnet v1/v2 (18-152), vgg (11-19, +bn), alexnet, densenet (121-201),
 squeezenet (1.0/1.1), inception-v3, mobilenet v1/v2 (4 multipliers each),
 plus mobilenet-v3 small/large (GluonCV milestone capability).
 
-``pretrained=True`` raises: weight download needs network access, absent in
-this environment. Use ``net.load_parameters(local_params_file)``.
+``pretrained=True`` resolves weights through ``model_store`` (sha1-verified
+cache; ``$MXNET_GLUON_REPO`` may be an ``http(s)://`` or ``file://`` repo,
+so air-gapped hosts serve weights from a shared filesystem).
 """
 from __future__ import annotations
 

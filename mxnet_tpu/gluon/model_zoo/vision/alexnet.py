@@ -38,9 +38,9 @@ class AlexNet(HybridBlock):
         return self.output(x)
 
 
-def alexnet(pretrained=False, ctx=None, **kwargs):
+def alexnet(pretrained=False, ctx=None, root="~/.mxnet/models", **kwargs):
+    net = AlexNet(**kwargs)
     if pretrained:
-        raise NotImplementedError(
-            "pretrained-weight download is unavailable (no network); use "
-            "load_parameters with a local .params file")
-    return AlexNet(**kwargs)
+        from ..model_store import get_model_file
+        net.load_parameters(get_model_file("alexnet", root=root), ctx=ctx)
+    return net

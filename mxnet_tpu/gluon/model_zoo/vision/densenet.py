@@ -82,13 +82,15 @@ densenet_spec = {
 }
 
 
-def get_densenet(num_layers, pretrained=False, ctx=None, **kwargs):
-    if pretrained:
-        raise NotImplementedError(
-            "pretrained-weight download is unavailable (no network); use "
-            "load_parameters with a local .params file")
+def get_densenet(num_layers, pretrained=False, ctx=None,
+                 root="~/.mxnet/models", **kwargs):
     num_init_features, growth_rate, block_config = densenet_spec[num_layers]
-    return DenseNet(num_init_features, growth_rate, block_config, **kwargs)
+    net = DenseNet(num_init_features, growth_rate, block_config, **kwargs)
+    if pretrained:
+        from ..model_store import get_model_file
+        net.load_parameters(
+            get_model_file(f"densenet{num_layers}", root=root), ctx=ctx)
+    return net
 
 
 def densenet121(**kwargs):

@@ -138,9 +138,10 @@ class Inception3(HybridBlock):
         return self.output(x)
 
 
-def inception_v3(pretrained=False, ctx=None, **kwargs):
+def inception_v3(pretrained=False, ctx=None, root="~/.mxnet/models",
+                 **kwargs):
+    net = Inception3(**kwargs)
     if pretrained:
-        raise NotImplementedError(
-            "pretrained-weight download is unavailable (no network); use "
-            "load_parameters with a local .params file")
-    return Inception3(**kwargs)
+        from ..model_store import get_model_file
+        net.load_parameters(get_model_file("inceptionv3", root=root), ctx=ctx)
+    return net

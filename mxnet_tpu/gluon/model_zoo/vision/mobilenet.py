@@ -242,16 +242,24 @@ class MobileNetV3(HybridBlock):
         return self.output(x)
 
 
-def get_mobilenet(multiplier, pretrained=False, ctx=None, **kwargs):
+def get_mobilenet(multiplier, pretrained=False, ctx=None,
+                  root="~/.mxnet/models", **kwargs):
+    net = MobileNet(multiplier, **kwargs)
     if pretrained:
-        raise NotImplementedError("pretrained download unavailable (no network)")
-    return MobileNet(multiplier, **kwargs)
+        from ..model_store import get_model_file
+        net.load_parameters(
+            get_model_file(f"mobilenet{multiplier}", root=root), ctx=ctx)
+    return net
 
 
-def get_mobilenet_v2(multiplier, pretrained=False, ctx=None, **kwargs):
+def get_mobilenet_v2(multiplier, pretrained=False, ctx=None,
+                     root="~/.mxnet/models", **kwargs):
+    net = MobileNetV2(multiplier, **kwargs)
     if pretrained:
-        raise NotImplementedError("pretrained download unavailable (no network)")
-    return MobileNetV2(multiplier, **kwargs)
+        from ..model_store import get_model_file
+        net.load_parameters(
+            get_model_file(f"mobilenetv2_{multiplier}", root=root), ctx=ctx)
+    return net
 
 
 def mobilenet1_0(**kwargs):
@@ -286,13 +294,21 @@ def mobilenet_v2_0_25(**kwargs):
     return get_mobilenet_v2(0.25, **kwargs)
 
 
-def mobilenet_v3_large(pretrained=False, ctx=None, **kwargs):
+def mobilenet_v3_large(pretrained=False, ctx=None, root="~/.mxnet/models",
+                       **kwargs):
+    net = MobileNetV3("large", **kwargs)
     if pretrained:
-        raise NotImplementedError("pretrained download unavailable (no network)")
-    return MobileNetV3("large", **kwargs)
+        from ..model_store import get_model_file
+        net.load_parameters(
+            get_model_file("mobilenetv3_large", root=root), ctx=ctx)
+    return net
 
 
-def mobilenet_v3_small(pretrained=False, ctx=None, **kwargs):
+def mobilenet_v3_small(pretrained=False, ctx=None, root="~/.mxnet/models",
+                       **kwargs):
+    net = MobileNetV3("small", **kwargs)
     if pretrained:
-        raise NotImplementedError("pretrained download unavailable (no network)")
-    return MobileNetV3("small", **kwargs)
+        from ..model_store import get_model_file
+        net.load_parameters(
+            get_model_file("mobilenetv3_small", root=root), ctx=ctx)
+    return net

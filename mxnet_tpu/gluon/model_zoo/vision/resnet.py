@@ -255,21 +255,27 @@ resnet_block_versions = [
 ]
 
 
-def get_resnet(version, num_layers, pretrained=False, ctx=None, **kwargs):
-    """Build a ResNet.  ``pretrained`` weight download needs network access
-    and is unsupported in this environment; pass a local ``root`` .params
-    file via ``Block.load_parameters`` instead."""
+def get_resnet(version, num_layers, pretrained=False, ctx=None,
+               root="~/.mxnet/models", **kwargs):
+    """Build a ResNet (reference: vision/resnet.py::get_resnet).
+
+    ``pretrained=True`` resolves weights through
+    :mod:`~mxnet_tpu.gluon.model_zoo.model_store` (sha1-verified cache
+    under ``root``, fetched from ``$MXNET_GLUON_REPO`` — ``file://`` repos
+    work without network access)."""
     assert num_layers in resnet_spec, \
         f"Invalid number of layers: {num_layers}. Options are {sorted(resnet_spec)}"
-    if pretrained:
-        raise NotImplementedError(
-            "pretrained-weight download is unavailable (no network); use "
-            "load_parameters with a local .params file")
     block_type, layers, channels = resnet_spec[num_layers]
     assert 1 <= version <= 2, f"Invalid resnet version: {version}."
     resnet_class = resnet_net_versions[version - 1]
     block_class = resnet_block_versions[version - 1][block_type]
-    return resnet_class(block_class, layers, channels, **kwargs)
+    net = resnet_class(block_class, layers, channels, **kwargs)
+    if pretrained:
+        from ..model_store import get_model_file
+        net.load_parameters(
+            get_model_file(f"resnet{num_layers}_v{version}", root=root),
+            ctx=ctx)
+    return net
 
 
 def resnet18_v1(**kwargs):

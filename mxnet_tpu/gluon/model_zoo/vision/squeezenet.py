@@ -82,12 +82,14 @@ class SqueezeNet(HybridBlock):
         return self.output(x)
 
 
-def get_squeezenet(version, pretrained=False, ctx=None, **kwargs):
+def get_squeezenet(version, pretrained=False, ctx=None,
+                   root="~/.mxnet/models", **kwargs):
+    net = SqueezeNet(version, **kwargs)
     if pretrained:
-        raise NotImplementedError(
-            "pretrained-weight download is unavailable (no network); use "
-            "load_parameters with a local .params file")
-    return SqueezeNet(version, **kwargs)
+        from ..model_store import get_model_file
+        net.load_parameters(
+            get_model_file(f"squeezenet{version}", root=root), ctx=ctx)
+    return net
 
 
 def squeezenet1_0(**kwargs):

@@ -52,13 +52,15 @@ vgg_spec = {
 }
 
 
-def get_vgg(num_layers, pretrained=False, ctx=None, **kwargs):
-    if pretrained:
-        raise NotImplementedError(
-            "pretrained-weight download is unavailable (no network); use "
-            "load_parameters with a local .params file")
+def get_vgg(num_layers, pretrained=False, ctx=None,
+            root="~/.mxnet/models", **kwargs):
     layers, filters = vgg_spec[num_layers]
-    return VGG(layers, filters, **kwargs)
+    net = VGG(layers, filters, **kwargs)
+    if pretrained:
+        from ..model_store import get_model_file
+        name = f"vgg{num_layers}" + ("_bn" if kwargs.get("batch_norm") else "")
+        net.load_parameters(get_model_file(name, root=root), ctx=ctx)
+    return net
 
 
 def vgg11(**kwargs):

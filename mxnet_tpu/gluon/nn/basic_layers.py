@@ -143,6 +143,13 @@ class Dropout(HybridBlock):
         return f"Dropout(p = {self._rate}, axes={self._axes})"
 
 
+class _DefaultAxis(int):
+    """Signature-default axis marker (see conv_layers._DefaultLayout)."""
+
+
+_DEFAULT_BN_AXIS = _DefaultAxis(1)
+
+
 class BatchNorm(HybridBlock):
     """Batch normalization with functional moving-stat updates
     (reference: basic_layers.py::BatchNorm + src/operator/nn/batch_norm.cc).
@@ -152,19 +159,21 @@ class BatchNorm(HybridBlock):
     buffers — in-place in eager mode, via the mutation log when traced.
     """
 
-    def __init__(self, axis=1, momentum=0.9, epsilon=1e-5, center=True,
+    def __init__(self, axis=_DEFAULT_BN_AXIS, momentum=0.9, epsilon=1e-5,
+                 center=True,
                  scale=True, use_global_stats=False, beta_initializer="zeros",
                  gamma_initializer="ones", running_mean_initializer="zeros",
                  running_variance_initializer="ones", in_channels=0,
                  prefix=None, params=None):
         super().__init__(prefix=prefix, params=params)
-        if axis == 1:
-            # under conv_layout("NHWC") the default channel axis moves last
+        if isinstance(axis, _DefaultAxis):
+            # under conv_layout("NHWC") the DEFAULT channel axis moves
+            # last; an explicitly passed axis=1 is kept (round-3 advisor
+            # finding — same sentinel rule as conv_layers._DefaultLayout)
             from .conv_layers import _layout_override
 
-            if _layout_override[0] == "channels_last":
-                axis = -1
-        self._axis = axis
+            axis = -1 if _layout_override[0] == "channels_last" else 1
+        self._axis = int(axis)
         self._momentum = momentum
         self._epsilon = epsilon
         self._center = center

@@ -31,6 +31,19 @@ _CHANNELS_FIRST = {1: "NCW", 2: "NCHW", 3: "NCDHW"}
 _layout_override = [None]  # "channels_last" | "channels_first" | None
 
 
+class _DefaultLayout(str):
+    """Signature-default layout marker: compares/prints as the plain
+    string, but lets ``conv_layout`` distinguish "caller kept the
+    default" from "caller explicitly asked for channels-first" — an
+    explicit ``layout='NCHW'`` inside ``conv_layout('NHWC')`` is kept
+    (round-3 advisor finding: it used to be silently flipped)."""
+
+
+_NCW = _DefaultLayout("NCW")
+_NCHW = _DefaultLayout("NCHW")
+_NCDHW = _DefaultLayout("NCDHW")
+
+
 @contextlib.contextmanager
 def conv_layout(layout):
     """Build-time default-layout context: ``with conv_layout("NHWC"): ...``.
@@ -59,13 +72,14 @@ def current_conv_layout(ndim=2):
 def _resolve_layout(layout, ndim):
     """Apply the conv_layout override to a block's layout argument.
 
-    The override only replaces *default* (channels-first) layouts: a caller
-    who explicitly built an NHWC block outside the context keeps it.
+    The override only replaces SIGNATURE-DEFAULT layouts (the
+    ``_DefaultLayout`` sentinels): any layout the caller passed
+    explicitly — channels-first included — is kept.
     """
     if _layout_override[0] == "channels_last" \
-            and layout == _CHANNELS_FIRST.get(ndim):
+            and isinstance(layout, _DefaultLayout):
         return _CHANNELS_LAST[ndim]
-    return layout
+    return str(layout)
 
 
 def _tup(val, n):
@@ -134,7 +148,7 @@ class _Conv(HybridBlock):
 
 class Conv1D(_Conv):
     def __init__(self, channels, kernel_size, strides=1, padding=0, dilation=1,
-                 groups=1, layout="NCW", activation=None, use_bias=True,
+                 groups=1, layout=_NCW, activation=None, use_bias=True,
                  weight_initializer=None, bias_initializer="zeros",
                  in_channels=0, **kwargs):
         super().__init__(channels, _tup(kernel_size, 1), _tup(strides, 1),
@@ -145,7 +159,7 @@ class Conv1D(_Conv):
 
 class Conv2D(_Conv):
     def __init__(self, channels, kernel_size, strides=(1, 1), padding=(0, 0),
-                 dilation=(1, 1), groups=1, layout="NCHW", activation=None,
+                 dilation=(1, 1), groups=1, layout=_NCHW, activation=None,
                  use_bias=True, weight_initializer=None,
                  bias_initializer="zeros", in_channels=0, **kwargs):
         super().__init__(channels, _tup(kernel_size, 2), _tup(strides, 2),
@@ -157,7 +171,7 @@ class Conv2D(_Conv):
 class Conv3D(_Conv):
     def __init__(self, channels, kernel_size, strides=(1, 1, 1),
                  padding=(0, 0, 0), dilation=(1, 1, 1), groups=1,
-                 layout="NCDHW", activation=None, use_bias=True,
+                 layout=_NCDHW, activation=None, use_bias=True,
                  weight_initializer=None, bias_initializer="zeros",
                  in_channels=0, **kwargs):
         super().__init__(channels, _tup(kernel_size, 3), _tup(strides, 3),
@@ -168,7 +182,7 @@ class Conv3D(_Conv):
 
 class Conv1DTranspose(_Conv):
     def __init__(self, channels, kernel_size, strides=1, padding=0,
-                 output_padding=0, dilation=1, groups=1, layout="NCW",
+                 output_padding=0, dilation=1, groups=1, layout=_NCW,
                  activation=None, use_bias=True, weight_initializer=None,
                  bias_initializer="zeros", in_channels=0, **kwargs):
         super().__init__(channels, _tup(kernel_size, 1), _tup(strides, 1),
@@ -182,7 +196,7 @@ class Conv1DTranspose(_Conv):
 class Conv2DTranspose(_Conv):
     def __init__(self, channels, kernel_size, strides=(1, 1), padding=(0, 0),
                  output_padding=(0, 0), dilation=(1, 1), groups=1,
-                 layout="NCHW", activation=None, use_bias=True,
+                 layout=_NCHW, activation=None, use_bias=True,
                  weight_initializer=None, bias_initializer="zeros",
                  in_channels=0, **kwargs):
         super().__init__(channels, _tup(kernel_size, 2), _tup(strides, 2),
@@ -196,7 +210,7 @@ class Conv2DTranspose(_Conv):
 class Conv3DTranspose(_Conv):
     def __init__(self, channels, kernel_size, strides=(1, 1, 1),
                  padding=(0, 0, 0), output_padding=(0, 0, 0),
-                 dilation=(1, 1, 1), groups=1, layout="NCDHW", activation=None,
+                 dilation=(1, 1, 1), groups=1, layout=_NCDHW, activation=None,
                  use_bias=True, weight_initializer=None,
                  bias_initializer="zeros", in_channels=0, **kwargs):
         super().__init__(channels, _tup(kernel_size, 3), _tup(strides, 3),
@@ -234,7 +248,7 @@ class _Pooling(HybridBlock):
 
 
 class MaxPool1D(_Pooling):
-    def __init__(self, pool_size=2, strides=None, padding=0, layout="NCW",
+    def __init__(self, pool_size=2, strides=None, padding=0, layout=_NCW,
                  ceil_mode=False, **kwargs):
         super().__init__(_tup(pool_size, 1), _tup(strides, 1) if strides is not None else None,
                          _tup(padding, 1), ceil_mode, False, "max",
@@ -243,7 +257,7 @@ class MaxPool1D(_Pooling):
 
 class MaxPool2D(_Pooling):
     def __init__(self, pool_size=(2, 2), strides=None, padding=0,
-                 layout="NCHW", ceil_mode=False, **kwargs):
+                 layout=_NCHW, ceil_mode=False, **kwargs):
         super().__init__(_tup(pool_size, 2), _tup(strides, 2) if strides is not None else None,
                          _tup(padding, 2), ceil_mode, False, "max",
                          layout=layout, **kwargs)
@@ -251,14 +265,14 @@ class MaxPool2D(_Pooling):
 
 class MaxPool3D(_Pooling):
     def __init__(self, pool_size=(2, 2, 2), strides=None, padding=0,
-                 layout="NCDHW", ceil_mode=False, **kwargs):
+                 layout=_NCDHW, ceil_mode=False, **kwargs):
         super().__init__(_tup(pool_size, 3), _tup(strides, 3) if strides is not None else None,
                          _tup(padding, 3), ceil_mode, False, "max",
                          layout=layout, **kwargs)
 
 
 class AvgPool1D(_Pooling):
-    def __init__(self, pool_size=2, strides=None, padding=0, layout="NCW",
+    def __init__(self, pool_size=2, strides=None, padding=0, layout=_NCW,
                  ceil_mode=False, count_include_pad=True, **kwargs):
         super().__init__(_tup(pool_size, 1), _tup(strides, 1) if strides is not None else None,
                          _tup(padding, 1), ceil_mode, False, "avg",
@@ -268,7 +282,7 @@ class AvgPool1D(_Pooling):
 
 class AvgPool2D(_Pooling):
     def __init__(self, pool_size=(2, 2), strides=None, padding=0,
-                 layout="NCHW", ceil_mode=False, count_include_pad=True,
+                 layout=_NCHW, ceil_mode=False, count_include_pad=True,
                  **kwargs):
         super().__init__(_tup(pool_size, 2), _tup(strides, 2) if strides is not None else None,
                          _tup(padding, 2), ceil_mode, False, "avg",
@@ -278,7 +292,7 @@ class AvgPool2D(_Pooling):
 
 class AvgPool3D(_Pooling):
     def __init__(self, pool_size=(2, 2, 2), strides=None, padding=0,
-                 layout="NCDHW", ceil_mode=False, count_include_pad=True,
+                 layout=_NCDHW, ceil_mode=False, count_include_pad=True,
                  **kwargs):
         super().__init__(_tup(pool_size, 3), _tup(strides, 3) if strides is not None else None,
                          _tup(padding, 3), ceil_mode, False, "avg",
@@ -293,32 +307,32 @@ class _GlobalPool(_Pooling):
 
 
 class GlobalMaxPool1D(_GlobalPool):
-    def __init__(self, layout="NCW", **kwargs):
+    def __init__(self, layout=_NCW, **kwargs):
         super().__init__(1, "max", layout, **kwargs)
 
 
 class GlobalMaxPool2D(_GlobalPool):
-    def __init__(self, layout="NCHW", **kwargs):
+    def __init__(self, layout=_NCHW, **kwargs):
         super().__init__(2, "max", layout, **kwargs)
 
 
 class GlobalMaxPool3D(_GlobalPool):
-    def __init__(self, layout="NCDHW", **kwargs):
+    def __init__(self, layout=_NCDHW, **kwargs):
         super().__init__(3, "max", layout, **kwargs)
 
 
 class GlobalAvgPool1D(_GlobalPool):
-    def __init__(self, layout="NCW", **kwargs):
+    def __init__(self, layout=_NCW, **kwargs):
         super().__init__(1, "avg", layout, **kwargs)
 
 
 class GlobalAvgPool2D(_GlobalPool):
-    def __init__(self, layout="NCHW", **kwargs):
+    def __init__(self, layout=_NCHW, **kwargs):
         super().__init__(2, "avg", layout, **kwargs)
 
 
 class GlobalAvgPool3D(_GlobalPool):
-    def __init__(self, layout="NCDHW", **kwargs):
+    def __init__(self, layout=_NCDHW, **kwargs):
         super().__init__(3, "avg", layout, **kwargs)
 
 

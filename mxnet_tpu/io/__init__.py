@@ -7,7 +7,9 @@ TPU note: iterators produce host-side batches; device placement happens at
 bind/step time (per-host sharded `device_put` on pods).
 """
 from .io import (DataDesc, DataBatch, DataIter, NDArrayIter, ResizeIter,
-                 PrefetchingIter, CSVIter, MNISTIter, ImageRecordIter)
+                 PrefetchingIter, CSVIter, LibSVMIter, MNISTIter,
+                 ImageRecordIter)
 
 __all__ = ["DataDesc", "DataBatch", "DataIter", "NDArrayIter", "ResizeIter",
-           "PrefetchingIter", "CSVIter", "MNISTIter", "ImageRecordIter"]
+           "PrefetchingIter", "CSVIter", "LibSVMIter", "MNISTIter",
+           "ImageRecordIter"]

@@ -321,6 +321,118 @@ class CSVIter(NDArrayIter):
         super().__init__(data, label, batch_size=batch_size, **kwargs)
 
 
+class LibSVMIter(DataIter):
+    """reference: ``src/io/iter_libsvm.cc`` — sparse LibSVM-format reader.
+
+    Batches carry a FACTORED ``CSRNDArray`` (values/indices/indptr built
+    straight from the text — the dense (batch, dim) matrix is never
+    formed; ``sparse.dot`` consumes the factored parts on device). Lines
+    are ``label idx:val idx:val ...`` with 0-based indices, matching the
+    upstream iterator's contract (its docs call out that it deviates from
+    the 1-based libsvm convention).
+    """
+
+    def __init__(self, data_libsvm, data_shape, batch_size,
+                 label_libsvm=None, label_shape=None, round_batch=True,
+                 dtype="float32", **kwargs):
+        super().__init__(batch_size)
+        self._dim = int(data_shape[0] if isinstance(
+            data_shape, (tuple, list)) else data_shape)
+        vals, cols, lens, labels = [], [], [], []
+        with open(data_libsvm) as f:
+            for line in f:
+                toks = line.split()
+                if not toks:
+                    continue
+                labels.append(float(toks[0]))
+                n = 0
+                for t in toks[1:]:
+                    i, v = t.split(":")
+                    cols.append(int(i))
+                    vals.append(float(v))
+                    n += 1
+                lens.append(n)
+        self._vals = _np.asarray(vals, dtype=dtype)
+        self._cols = _np.asarray(cols, dtype="int64")
+        self._ends = _np.concatenate([[0], _np.cumsum(lens)]).astype("int64")
+        self._labels = _np.asarray(labels, dtype="float32")
+        if label_libsvm is not None:
+            # separate label file: whitespace-separated floats per line
+            # (possibly multi-label); shape honored via label_shape
+            lab_rows = []
+            with open(label_libsvm) as f:
+                for line in f:
+                    toks = line.split()
+                    if toks:
+                        lab_rows.append([float(t) for t in toks])
+            self._labels = _np.asarray(lab_rows, dtype="float32")
+            if label_shape is not None:
+                self._labels = self._labels.reshape(
+                    (-1,) + tuple(label_shape))
+            elif self._labels.shape[-1] == 1:
+                self._labels = self._labels.reshape(-1)
+            if len(self._labels) != len(lens):
+                raise MXNetError(
+                    f"LibSVMIter: {len(self._labels)} labels != "
+                    f"{len(lens)} data rows")
+        self._n = len(self._labels)
+        if self._n < batch_size:
+            raise MXNetError(
+                f"LibSVMIter: {self._n} rows < batch_size {batch_size}")
+        self._round = bool(round_batch)
+        self._cursor = 0
+        self.provide_data = [DataDesc("data", (batch_size, self._dim),
+                                      dtype)]
+        self.provide_label = [DataDesc("softmax_label", (batch_size,),
+                                       "float32")]
+
+    def reset(self):
+        self._cursor = 0
+
+    def iter_next(self):
+        return self._cursor < self._n
+
+    def _rows(self):
+        idx = _np.arange(self._cursor, self._cursor + self.batch_size)
+        pad = int((idx >= self._n).sum())
+        idx = idx % self._n if self._round else idx[idx < self._n]
+        return idx, pad
+
+    def getdata(self):
+        from ..ndarray import sparse as _sparse
+
+        idx, _ = self._rows()
+        lens = (self._ends[idx + 1] - self._ends[idx])
+        data = _np.concatenate(
+            [self._vals[self._ends[r]:self._ends[r + 1]] for r in idx]) \
+            if len(idx) else self._vals[:0]
+        cols = _np.concatenate(
+            [self._cols[self._ends[r]:self._ends[r + 1]] for r in idx]) \
+            if len(idx) else self._cols[:0]
+        indptr = _np.concatenate([[0], _np.cumsum(lens)])
+        return [_sparse.csr_matrix((data, cols, indptr),
+                                   shape=(len(idx), self._dim))]
+
+    def getlabel(self):
+        from ..ndarray import array as nd_array
+
+        idx, _ = self._rows()
+        return [nd_array(self._labels[idx])]
+
+    def getpad(self):
+        return self._rows()[1]
+
+    def next(self):
+        if not self.iter_next():
+            raise StopIteration
+        batch = DataBatch(data=self.getdata(), label=self.getlabel(),
+                          pad=self.getpad(),
+                          provide_data=self.provide_data,
+                          provide_label=self.provide_label)
+        self._cursor += self.batch_size
+        return batch
+
+
 class MNISTIter(NDArrayIter):
     """reference: src/io/iter_mnist.cc — reads the IDX-format MNIST files."""
 

@@ -51,13 +51,75 @@ class BaseSparseNDArray(NDArray):
 
 
 class CSRNDArray(BaseSparseNDArray):
-    """Compressed sparse row matrix (reference: sparse.py::CSRNDArray)."""
+    """Compressed sparse row matrix (reference: sparse.py::CSRNDArray).
+
+    Two storage modes (mirroring RowSparseNDArray):
+
+    * dense-backed — full payload; ``indices``/``indptr``/``values``
+      views computed lazily from it;
+    * FACTORED — ``set_csr(values, indices, indptr, full_shape)`` keeps
+      only the aux arrays (what ``csr_matrix((data, indices, indptr))``
+      and ``LibSVMIter`` produce). The dense payload materializes lazily
+      only if something reads ``.data``; :func:`dot` consumes the
+      factored parts directly via a gather + ``segment_sum`` formulation
+      that never builds the (M, K) dense matrix on device.
+    """
 
     _stype = "csr"
+    _vals = None
+    _cols = None
+    _iptr = None
+    _full_shape = None
+    _row_ids_cache = None
+
+    def set_csr(self, values, indices, indptr, full_shape):
+        """Install a factored (values, col indices, indptr) payload."""
+        jnp = _jnp()
+        self._vals = jnp.asarray(values)
+        self._cols = jnp.asarray(indices, dtype="int32")
+        self._iptr = jnp.asarray(indptr, dtype="int32")
+        self._full_shape = tuple(full_shape)
+        self._shape = tuple(full_shape)
+        self._row_ids_cache = None
+        self._data = None
+        self._version += 1
+
+    def _set_data(self, new_jax):
+        # a dense rewrite invalidates the factored views
+        self._vals = self._cols = self._iptr = None
+        self._row_ids_cache = None
+        super()._set_data(new_jax)
+
+    def _row_ids(self):
+        """Per-nnz row ids (host-computed once from indptr) — the
+        segment ids of the segment-sum matmul."""
+        if self._row_ids_cache is None:
+            iptr = _np.asarray(self._iptr)
+            counts = _np.diff(iptr)
+            self._row_ids_cache = _jnp().asarray(
+                _np.repeat(_np.arange(len(counts)), counts), dtype="int32")
+        return self._row_ids_cache
+
+    @property
+    def data(self):
+        if self._data is None and self._vals is not None:
+            jnp = _jnp()
+            self._data = jnp.zeros(
+                self._full_shape, self._vals.dtype).at[
+                self._row_ids(), self._cols].add(self._vals)
+        return NDArray.data.fget(self)
+
+    @property
+    def shape(self):
+        if self._data is None and self._full_shape is not None:
+            return self._full_shape
+        return NDArray.shape.fget(self)
 
     @property
     def indices(self):
         """Column indices aux array (per-row concatenated)."""
+        if self._vals is not None:
+            return NDArray(data=self._cols.astype("int64"), ctx=self._ctx)
         dense = self.asnumpy()
         cols = [_np.nonzero(row)[0] for row in dense]
         return NDArray(data=_jnp().asarray(
@@ -66,6 +128,8 @@ class CSRNDArray(BaseSparseNDArray):
 
     @property
     def indptr(self):
+        if self._vals is not None:
+            return NDArray(data=self._iptr.astype("int64"), ctx=self._ctx)
         dense = self.asnumpy()
         counts = [0] + [int((row != 0).sum()) for row in dense]
         return NDArray(data=_jnp().asarray(_np.cumsum(counts),
@@ -73,6 +137,8 @@ class CSRNDArray(BaseSparseNDArray):
 
     @property
     def values(self):
+        if self._vals is not None:
+            return NDArray(data=self._vals, ctx=self._ctx)
         dense = self.asnumpy()
         return NDArray(data=_jnp().asarray(dense[dense != 0]),
                        ctx=self._ctx)
@@ -187,6 +253,8 @@ def _convert(arr, stype):
            "row_sparse": RowSparseNDArray}.get(stype)
     if cls is None:
         raise MXNetError(f"unknown storage type {stype!r}")
+    if type(arr) is cls:
+        return arr
     if stype == "csr" and len(arr.shape) != 2:
         raise MXNetError("csr storage requires a 2-D array")
     return cls(data=arr.data, ctx=arr.context)
@@ -194,22 +262,24 @@ def _convert(arr, stype):
 
 def csr_matrix(arg1, shape=None, ctx=None, dtype=None):
     """Build a CSRNDArray from (data, indices, indptr) or a dense source
-    (reference: sparse.csr_matrix)."""
+    (reference: sparse.csr_matrix). The aux-triple form stays FACTORED —
+    no dense (M, K) payload is built unless something reads ``.data``."""
     from . import array as nd_array
+    from ..context import current_context
 
     if isinstance(arg1, tuple) and len(arg1) == 3:
         data, indices, indptr = (a.asnumpy() if isinstance(a, NDArray)
                                  else _np.asarray(a) for a in arg1)
         if shape is None:
             raise MXNetError("csr_matrix from aux arrays requires shape")
-        dense = _np.zeros(shape, dtype=dtype or data.dtype)
-        for r in range(shape[0]):
-            lo, hi = int(indptr[r]), int(indptr[r + 1])
-            dense[r, indices[lo:hi].astype(int)] = data[lo:hi]
-        src = nd_array(dense, ctx=ctx)
-    else:
-        src = arg1 if isinstance(arg1, NDArray) else nd_array(
-            _np.asarray(arg1, dtype=dtype), ctx=ctx)
+        if dtype is not None:
+            data = data.astype(dtype)
+        out = CSRNDArray(data=_jnp().zeros((0,), data.dtype),
+                         ctx=ctx or current_context())
+        out.set_csr(data, indices, indptr, shape)
+        return out
+    src = arg1 if isinstance(arg1, NDArray) else nd_array(
+        _np.asarray(arg1, dtype=dtype), ctx=ctx)
     return _convert(src, "csr")
 
 
@@ -265,11 +335,51 @@ def empty(stype, shape, ctx=None, dtype="float32"):
     return zeros(stype, shape, ctx=ctx, dtype=dtype)
 
 
+def csr_matmul(values, col_idx, row_ids, n_rows, n_cols, rhs,
+               transpose_a=False):
+    """Pure-JAX CSR×dense matmul over factored parts — gather rows of
+    ``rhs`` per nonzero, scale, ``segment_sum`` by destination row. The
+    (n_rows, n_cols) dense lhs never exists on device; FLOPs and memory
+    are O(nnz·N). TPU-shaped: the gather/segment-sum lower to efficient
+    one-hot-free scatter-adds, and XLA fuses the scale into the gather.
+
+    ``transpose_a=True`` computes ``lhs.T @ rhs`` ((n_cols, N)) by
+    swapping the gather/segment roles — the same trick upstream's
+    ``dot(csr, dense, transpose_a=True)`` kernel uses
+    (src/operator/tensor/dot-inl.h).
+    """
+    import jax
+
+    if transpose_a:
+        gather_ids, seg_ids, n_seg = row_ids, col_idx, n_cols
+    else:
+        gather_ids, seg_ids, n_seg = col_idx, row_ids, n_rows
+    contrib = values[:, None] * rhs[gather_ids]
+    return jax.ops.segment_sum(contrib, seg_ids, num_segments=n_seg)
+
+
 def dot(lhs, rhs, transpose_a=False, transpose_b=False):
-    """sparse.dot — dense-backed matmul; XLA fuses the zero structure."""
-    from .ndarray import imperative_invoke
+    """sparse.dot (reference: mx.nd.sparse.dot / dot-inl.h).
+
+    Factored CSR lhs × dense rhs runs the O(nnz) segment-sum kernel;
+    everything else falls back to the dense matmul (XLA fuses the zero
+    structure)."""
+    from .ndarray import NDArray as _ND, imperative_invoke
     from ..ops.registry import get_op
 
+    if (isinstance(lhs, CSRNDArray) and lhs._vals is not None
+            and not transpose_b and getattr(rhs, "ndim", 2) == 2):
+        m, k = lhs._full_shape
+        inner = m if transpose_a else k
+        if rhs.shape[0] != inner:
+            # the gather would silently clamp out-of-range indices —
+            # validate like the dense path does
+            raise MXNetError(
+                f"dot: csr lhs {'T' if transpose_a else ''}{(m, k)} is "
+                f"incompatible with rhs {tuple(rhs.shape)}")
+        out = csr_matmul(lhs._vals, lhs._cols, lhs._row_ids(), m, k,
+                         rhs.data, transpose_a=transpose_a)
+        return _ND(data=out, ctx=lhs.context)
     return imperative_invoke(get_op("dot"), [lhs, rhs],
                              {"transpose_a": transpose_a,
                               "transpose_b": transpose_b})

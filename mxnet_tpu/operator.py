@@ -1,0 +1,119 @@
+"""``mx.operator`` — user-defined Python operators (the ``Custom`` op).
+
+Reference: ``src/operator/custom/custom.cc`` (the C++ trampoline that calls
+back into Python for forward/backward) + ``python/mxnet/operator.py``
+(``CustomOp`` / ``CustomOpProp`` / ``register``). Upstream routes each
+forward through the engine to a Python callback on a dedicated thread; the
+TPU-native equivalent routes it through ``jax.pure_callback`` — the op
+participates in traced/jitted graphs (Symbol executors, hybridized blocks)
+as a host call with statically inferred output shapes, and a
+``jax.custom_vjp`` wires the user's ``backward`` into autograd, since XLA
+cannot differentiate through an opaque host callback.
+
+Semantic deltas from upstream, by design:
+
+* ``aux`` states are read-only inside the op (functional XLA graphs have
+  no side-channel mutation; upstream lets ``forward`` write aux).
+* The host callback always runs on CPU NDArrays regardless of the graph's
+  device — data round-trips device->host->device at the callback boundary,
+  which is also true upstream (``custom.cc`` copies to CPU unless the op
+  declares device support).
+"""
+from __future__ import annotations
+
+from typing import Dict, List, Tuple, Type
+
+from .base import MXNetError
+
+__all__ = ["CustomOp", "CustomOpProp", "register", "get_prop_cls"]
+
+_PROPS: Dict[str, Type["CustomOpProp"]] = {}
+
+
+class CustomOp:
+    """Base class for the imperative body of a custom operator
+    (reference: python/mxnet/operator.py::CustomOp)."""
+
+    def forward(self, is_train, req, in_data, out_data, aux):
+        raise NotImplementedError
+
+    def backward(self, req, out_grad, in_data, out_data, in_grad, aux):
+        raise NotImplementedError(
+            "backward not implemented — required to train through this op")
+
+    def assign(self, dst, req, src):
+        """Write ``src`` into ``dst`` honoring the req mode."""
+        if req == "null":
+            return
+        if req == "add":
+            dst[:] = dst + src
+        else:  # "write" / "inplace"
+            dst[:] = src
+
+
+class CustomOpProp:
+    """Shape/type inference + operator factory
+    (reference: python/mxnet/operator.py::CustomOpProp)."""
+
+    def __init__(self, need_top_grad=True):
+        self.need_top_grad_ = need_top_grad
+
+    def list_arguments(self) -> List[str]:
+        return ["data"]
+
+    def list_outputs(self) -> List[str]:
+        return ["output"]
+
+    def list_auxiliary_states(self) -> List[str]:
+        return []
+
+    def infer_shape(self, in_shape):
+        """Default: all outputs shaped like the first input; override for
+        anything else. Returns (arg_shapes, out_shapes, aux_shapes)."""
+        return (in_shape,
+                [in_shape[0]] * len(self.list_outputs()),
+                [])
+
+    def infer_type(self, in_type):
+        return (in_type,
+                [in_type[0]] * len(self.list_outputs()),
+                [in_type[0]] * len(self.list_auxiliary_states()))
+
+    def declare_backward_dependency(self, out_grad, in_data, out_data):
+        """Upstream trims the residuals the backward needs; the functional
+        custom_vjp keeps (inputs, outputs) alive regardless, so this is
+        advisory here and kept only for API parity."""
+        deps = []
+        if self.need_top_grad_:
+            deps.extend(out_grad)
+        deps.extend(in_data)
+        deps.extend(out_data)
+        return deps
+
+    def create_operator(self, ctx, in_shapes, in_dtypes) -> CustomOp:
+        raise NotImplementedError
+
+
+def register(reg_name: str):
+    """Register a CustomOpProp subclass under ``op_type=reg_name``
+    (reference: mx.operator.register). Usable afterwards as
+    ``mx.nd.Custom(..., op_type=reg_name)`` / ``mx.sym.Custom(...)``."""
+
+    def deco(prop_cls):
+        if not issubclass(prop_cls, CustomOpProp):
+            raise MXNetError(
+                f"register({reg_name!r}) requires a CustomOpProp subclass")
+        _PROPS[reg_name] = prop_cls
+        prop_cls._register_name = reg_name
+        return prop_cls
+
+    return deco
+
+
+def get_prop_cls(op_type: str) -> Type[CustomOpProp]:
+    try:
+        return _PROPS[op_type]
+    except KeyError:
+        raise MXNetError(
+            f"Custom op type {op_type!r} is not registered; decorate its "
+            "CustomOpProp with @mx.operator.register(name)") from None

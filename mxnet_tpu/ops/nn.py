@@ -198,17 +198,33 @@ def pooling(data, *, kernel=(), pool_type="max", stride=(), pad=(),
     def pads_for(convention):
         spatial = []
         for i in range(nd):
-            lo = hi = pad[i]
-            if convention == "full":
-                # ceil instead of floor output size: add extra hi padding
-                size = data.shape[spatial0 + i] + 2 * pad[i] - kernel[i]
-                rem = size % stride[i]
-                if rem != 0:
-                    hi += stride[i] - rem
+            if convention == "same":
+                # TF-style SAME: out = ceil(in / stride); symmetric split
+                # with the extra cell at the end. Explicit pad is part of
+                # the convention, not additive (reference pooling.cc
+                # requires pad=0 with convention=same).
+                size = data.shape[spatial0 + i]
+                out = -(-size // stride[i])
+                total = max((out - 1) * stride[i] + kernel[i] - size, 0)
+                lo = total // 2
+                hi = total - lo
+            else:
+                lo = hi = pad[i]
+                if convention == "full":
+                    # ceil instead of floor output size: extra hi padding
+                    size = data.shape[spatial0 + i] + 2 * pad[i] - kernel[i]
+                    rem = size % stride[i]
+                    if rem != 0:
+                        hi += stride[i] - rem
             spatial.append((lo, hi))
         if channels_last:
             return [(0, 0)] + spatial + [(0, 0)]
         return [(0, 0), (0, 0)] + spatial
+
+    if pooling_convention == "same" and any(p != 0 for p in pad):
+        raise ValueError(
+            "Pooling: pooling_convention='same' requires pad=0 "
+            "(reference: src/operator/nn/pooling.cc parameter check)")
 
     padding = pads_for(pooling_convention)
     if pool_type == "max":

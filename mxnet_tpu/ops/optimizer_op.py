@@ -203,6 +203,142 @@ def lamb_update_phase1(weight, grad, mean, var, *, beta1=0.9, beta2=0.999,
     return update, new_mean, new_var
 
 
+# ---------------------------------------------------------------------------
+# Multi-tensor updates (reference: optimizer_op.cc `multi_sgd_update`,
+# `multi_sgd_mom_update`, `multi_mp_sgd_*`, `preloaded_multi_*`,
+# `multi_sum_sq`). Upstream fuses one kernel launch over a whole parameter
+# list and mutates momenta in place via mutable inputs; the functional
+# equivalent returns every updated tensor, interleaved per weight in input
+# order (same convention as the single-tensor ops above, which return
+# updated state as extra outputs). Inside a jitted step XLA fuses the whole
+# list into few kernels, which is the performance point of the upstream op.
+# ---------------------------------------------------------------------------
+
+
+def _per_weight(v, i):
+    """lrs/wds arrive as a python tuple (attr) or a 1-D tensor (preloaded)."""
+    if isinstance(v, (tuple, list)):
+        return v[i]
+    if hasattr(v, "shape") and getattr(v, "ndim", 0) >= 1:
+        return v[i]
+    return v
+
+
+@register("multi_sgd_update", variadic=True)
+def multi_sgd_update(*inputs, lrs, wds, rescale_grad=1.0, clip_gradient=-1.0,
+                     num_weights=None):
+    """Fused SGD over a parameter list. Inputs: w0, g0, w1, g1, ...;
+    outputs: updated weights in order."""
+    n = num_weights if num_weights is not None else len(inputs) // 2
+    outs = []
+    for i in range(n):
+        w, g = inputs[2 * i], inputs[2 * i + 1]
+        outs.append(sgd_update(w, g, lr=_per_weight(lrs, i),
+                               wd=_per_weight(wds, i),
+                               rescale_grad=rescale_grad,
+                               clip_gradient=clip_gradient))
+    return tuple(outs)
+
+
+@register("multi_sgd_mom_update", variadic=True)
+def multi_sgd_mom_update(*inputs, lrs, wds, momentum=0.0, rescale_grad=1.0,
+                         clip_gradient=-1.0, num_weights=None):
+    """Inputs: w0, g0, m0, w1, g1, m1, ...; outputs: w0', m0', w1', m1', ..."""
+    n = num_weights if num_weights is not None else len(inputs) // 3
+    outs = []
+    for i in range(n):
+        w, g, m = inputs[3 * i], inputs[3 * i + 1], inputs[3 * i + 2]
+        new_w, new_m = sgd_mom_update(
+            w, g, m, lr=_per_weight(lrs, i), momentum=momentum,
+            wd=_per_weight(wds, i), rescale_grad=rescale_grad,
+            clip_gradient=clip_gradient)
+        outs.extend((new_w, new_m))
+    return tuple(outs)
+
+
+@register("multi_mp_sgd_update", variadic=True)
+def multi_mp_sgd_update(*inputs, lrs, wds, rescale_grad=1.0,
+                        clip_gradient=-1.0, num_weights=None):
+    """Inputs: w0, g0, w32_0, ...; outputs: w0', w32_0', ..."""
+    n = num_weights if num_weights is not None else len(inputs) // 3
+    outs = []
+    for i in range(n):
+        w, g, w32 = inputs[3 * i], inputs[3 * i + 1], inputs[3 * i + 2]
+        new_w, new_w32 = mp_sgd_update(
+            w, g, w32, lr=_per_weight(lrs, i), wd=_per_weight(wds, i),
+            rescale_grad=rescale_grad, clip_gradient=clip_gradient)
+        outs.extend((new_w, new_w32))
+    return tuple(outs)
+
+
+@register("multi_mp_sgd_mom_update", variadic=True)
+def multi_mp_sgd_mom_update(*inputs, lrs, wds, momentum=0.0, rescale_grad=1.0,
+                            clip_gradient=-1.0, num_weights=None):
+    """Inputs: w0, g0, m0, w32_0, ...; outputs: w0', m0', w32_0', ..."""
+    n = num_weights if num_weights is not None else len(inputs) // 4
+    outs = []
+    for i in range(n):
+        w, g, m, w32 = inputs[4 * i:4 * i + 4]
+        new_w, new_m, new_w32 = mp_sgd_mom_update(
+            w, g, m, w32, lr=_per_weight(lrs, i), momentum=momentum,
+            wd=_per_weight(wds, i), rescale_grad=rescale_grad,
+            clip_gradient=clip_gradient)
+        outs.extend((new_w, new_m, new_w32))
+    return tuple(outs)
+
+
+@register("preloaded_multi_sgd_update", variadic=True)
+def preloaded_multi_sgd_update(*inputs, rescale_grad=1.0, clip_gradient=-1.0,
+                               num_weights=None):
+    """`multi_sgd_update` with lrs/wds as trailing 1-D tensor inputs
+    (reference: preloaded_multi_sgd_update — keeps the schedule on-device)."""
+    lrs, wds = inputs[-2], inputs[-1]
+    return multi_sgd_update(*inputs[:-2], lrs=lrs, wds=wds,
+                            rescale_grad=rescale_grad,
+                            clip_gradient=clip_gradient,
+                            num_weights=num_weights)
+
+
+@register("preloaded_multi_sgd_mom_update", variadic=True)
+def preloaded_multi_sgd_mom_update(*inputs, momentum=0.0, rescale_grad=1.0,
+                                   clip_gradient=-1.0, num_weights=None):
+    lrs, wds = inputs[-2], inputs[-1]
+    return multi_sgd_mom_update(*inputs[:-2], lrs=lrs, wds=wds,
+                                momentum=momentum, rescale_grad=rescale_grad,
+                                clip_gradient=clip_gradient,
+                                num_weights=num_weights)
+
+
+@register("preloaded_multi_mp_sgd_update", variadic=True)
+def preloaded_multi_mp_sgd_update(*inputs, rescale_grad=1.0,
+                                  clip_gradient=-1.0, num_weights=None):
+    lrs, wds = inputs[-2], inputs[-1]
+    return multi_mp_sgd_update(*inputs[:-2], lrs=lrs, wds=wds,
+                               rescale_grad=rescale_grad,
+                               clip_gradient=clip_gradient,
+                               num_weights=num_weights)
+
+
+@register("preloaded_multi_mp_sgd_mom_update", variadic=True)
+def preloaded_multi_mp_sgd_mom_update(*inputs, momentum=0.0, rescale_grad=1.0,
+                                      clip_gradient=-1.0, num_weights=None):
+    lrs, wds = inputs[-2], inputs[-1]
+    return multi_mp_sgd_mom_update(*inputs[:-2], lrs=lrs, wds=wds,
+                                   momentum=momentum,
+                                   rescale_grad=rescale_grad,
+                                   clip_gradient=clip_gradient,
+                                   num_weights=num_weights)
+
+
+@register("multi_sum_sq", variadic=True)
+def multi_sum_sq(*inputs, num_arrays=None):
+    """Per-tensor sum of squares, stacked into one 1-D result (reference:
+    multi_sum_sq — the LARS trust-ratio building block)."""
+    n = num_arrays if num_arrays is not None else len(inputs)
+    return jnp.stack([jnp.sum(jnp.square(x.astype(jnp.float32)))
+                      for x in inputs[:n]])
+
+
 @register("lamb_update_phase2")
 def lamb_update_phase2(weight, g_update, r1, r2, *, lr, lower_bound=-1.0,
                        upper_bound=-1.0):

@@ -160,6 +160,9 @@ class OpDef(NamedTuple):
     eager_only: bool
     # typed attribute declarations (AttrSpec by name); None = undeclared
     attr_specs: Optional[Dict] = None
+    # fn has **kwargs: forward ALL attrs, not just declared attr_params
+    # (the `Custom` op's user-defined attribute surface)
+    var_attrs: bool = False
 
 
 _REGISTRY: Dict[str, OpDef] = {}
@@ -218,6 +221,8 @@ def register(
             ),
             eager_only=eager_only,
             attr_specs={s.name: s for s in attrs} if attrs else None,
+            var_attrs=any(p.kind == p.VAR_KEYWORD
+                          for p in sig.parameters.values()),
         )
         _REGISTRY[opname] = opdef
         for a in aliases:

@@ -205,16 +205,13 @@ class Pipelined(HybridBlock):
         super().__init__(prefix=prefix, params=params)
         if schedule not in ("gpipe", "1f1b"):
             raise ValueError(f"unknown pipeline schedule {schedule!r}")
-        if schedule == "1f1b":
-            # 1F1B bounds activation memory by starting each microbatch's
-            # backward as soon as it drains — which requires the LOSS
-            # inside the schedule, so it cannot hide behind this
-            # AD-transparent block. Use the explicit entry point.
-            raise ValueError(
-                "schedule='1f1b' folds the loss into the pipeline and is "
-                "not AD-transparent; call parallel.pipeline_train_1f1b("
-                "stage_fn, loss_fn, ...) directly (grads/bubble math in "
-                "its docstring)")
+        # 1F1B bounds activation memory by starting each microbatch's
+        # backward as soon as it drains — which requires the LOSS inside
+        # the schedule, so it cannot hide behind this AD-transparent
+        # block's forward. TrainStep detects schedule='1f1b' and routes
+        # training through pipeline_train_1f1b (loss folded into the last
+        # stage); plain forward (inference/eval) uses the GPipe schedule,
+        # which computes the identical function.
         self._schedule = schedule
         self._n_stages = int(n_stages)
         self._l_per = int(layers_per_stage)
@@ -333,6 +330,41 @@ class Pipelined(HybridBlock):
         raise NotImplementedError(
             "Pipelined lowers through _eager_forward (jit/TrainStep); the "
             "legacy symbolic composition path is not supported")
+
+    # -- 1F1B integration (TrainStep) -----------------------------------
+    def _stage_fn_1f1b(self, ctx, training):
+        """Build ``stage_fn(leaves, h, key) -> h`` running this stage's
+        ``layers_per_stage`` layers — the :func:`pipeline_train_1f1b`
+        contract, where ``leaves`` are one stage's parameter slices
+        (``(layers_per_stage,) + param_shape``)."""
+        import jax
+        import jax.numpy as jnp
+        from jax import lax
+
+        tmpl = self._template_holder[0]
+        self._ensure_template_ready(ctx)
+        tmpl_arrays = [p.data(ctx) for p in self._tmpl_params]
+        pure, _cell = make_pure_fn(tmpl, tmpl_arrays, ctx, training)
+        l_per = self._l_per
+
+        def layer(lp, hc, key):
+            out_vals, aux_vals = pure(tuple(lp), key, hc)
+            if aux_vals:
+                raise RuntimeError(
+                    "Pipelined stage mutates aux state (e.g. BatchNorm "
+                    "running stats) — unsupported inside the pipeline "
+                    "scan; use LayerNorm/RMSNorm in the stage")
+            return out_vals[0]
+
+        def stage_all(leaves, h, key):
+            def one(hc, sl):
+                lp, i = sl
+                return layer(lp, hc, jax.random.fold_in(key, i)), None
+
+            h, _ = lax.scan(one, h, (leaves, jnp.arange(l_per)))
+            return h
+
+        return stage_all
 
 
 def pipeline_sharding_rules(axis="pp", extra=None):

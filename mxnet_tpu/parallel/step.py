@@ -282,20 +282,64 @@ class TrainStep:
         return P(*entries)
 
     # -- build ----------------------------------------------------------
+    def _pipelined_1f1b(self):
+        """The net itself as a 1F1B-scheduled Pipelined block, or None.
+
+        The 1F1B schedule folds the loss into the last pipeline stage, so
+        the step cannot be built as grad(loss(net(x))) — TrainStep routes
+        it through :func:`pipeline_train_1f1b` instead. Supported shape:
+        ``net`` IS the Pipelined trunk (embedding/head belong in the loss
+        callable, which runs on the last stage)."""
+        from .pipeline import Pipelined
+
+        net = self.net
+        if isinstance(net, Pipelined) and net._schedule == "1f1b":
+            return net
+        return None
+
+    # -- build ----------------------------------------------------------
     def _build(self, data_tuple, label_tuple, training):
         import jax
         from jax.sharding import PartitionSpec as P
 
         ctx = self._params[0].data().context if self._params else current_context()
-        param_arrays = [p.data() for p in self._params]
-        pure, cell = make_pure_fn(self.net, param_arrays, ctx, training)
-        loss_only = self.loss_only
+        pipe = self._pipelined_1f1b()
+        if pipe is not None:
+            from .pipeline import pipeline_train_1f1b
+
+            stage_all = pipe._stage_fn_1f1b(ctx, training)
+            pipe_axis, pipe_micro = pipe._axis, pipe._n_micro
+            pure, cell = None, {"aux_arrays": [], "treedef": None,
+                                "n_out": 0}
+            if len(data_tuple) != 1 or len(label_tuple) != 1:
+                raise MXNetError(
+                    "TrainStep over a 1F1B Pipelined takes exactly one "
+                    "data and one label array")
+        else:
+            param_arrays = [p.data() for p in self._params]
+            pure, cell = make_pure_fn(self.net, param_arrays, ctx, training)
+        loss_only = self.loss_only or pipe is not None
         trainable = list(self._trainable)
+        if pipe is not None:
+            id2k = {id(self._params[i]): k for k, i in enumerate(trainable)}
+            try:
+                stacked_ks = [id2k[id(sp)] for sp in pipe._stacked]
+            except KeyError:
+                raise MXNetError(
+                    "1F1B TrainStep requires every stacked pipeline "
+                    "parameter to be trainable (grad_req != 'null')")
+            if len(stacked_ks) != len(trainable):
+                raise MXNetError(
+                    "TrainStep(schedule='1f1b') supports a net whose "
+                    "trainable params are exactly the Pipelined trunk's "
+                    "stacked parameters; put embedding/head inside the "
+                    "loss callable")
         n_data = len(data_tuple)
         optimizer = self.optimizer
         loss_fn = self.loss
         state_meta = self._state_meta
         params_by_i = [p.name for p in self._params]
+        mesh = self.mesh
 
         def step_fn(param_vals, state_vals, t, lr, rng, *batch_vals):
             import jax.numpy as jnp
@@ -319,17 +363,37 @@ class TrainStep:
             from .sparse_grad import lazy_row_update, sparse_grad_scope
 
             train_vals = tuple(param_vals[i] for i in trainable)
-            with sparse_grad_scope() as sp_log:
-                (loss_val, (outs, aux)), grads = jax.value_and_grad(
-                    loss_of, has_aux=True)(train_vals)
-            # scope entries are keyed by parameter NAME (the embedding
-            # op's _sparse_uid); map to trainable ordinals
-            sparse_by_k = {}
-            for uid, entries in sp_log.entries.items():
-                for k, i in enumerate(trainable):
-                    if params_by_i[i] == uid:
-                        sparse_by_k[k] = entries
-                        break
+            if pipe is not None:
+                # 1F1B: loss folded into the last stage; grads come from
+                # the schedule, not from AD over the block forward
+                def head_loss(h, y):
+                    l_out = loss_fn(NDArray(data=h, ctx=ctx),
+                                    NDArray(data=y, ctx=ctx))
+                    flat_l, _ = nested_flatten_nd(l_out)
+                    return jnp.mean(flat_l[0].data.astype(jnp.float32))
+
+                leaves = tuple(train_vals[k] for k in stacked_ks)
+                loss_val, g_stacked, _dx = pipeline_train_1f1b(
+                    stage_all, head_loss, leaves, data_vals[0],
+                    label_vals[0], rng, mesh=mesh, axis=pipe_axis,
+                    n_microbatches=pipe_micro)
+                grads = [None] * len(trainable)
+                for k, g in zip(stacked_ks, g_stacked):
+                    grads[k] = g
+                outs, aux = (), ()
+                sparse_by_k = {}
+            else:
+                with sparse_grad_scope() as sp_log:
+                    (loss_val, (outs, aux)), grads = jax.value_and_grad(
+                        loss_of, has_aux=True)(train_vals)
+                # scope entries are keyed by parameter NAME (the embedding
+                # op's _sparse_uid); map to trainable ordinals
+                sparse_by_k = {}
+                for uid, entries in sp_log.entries.items():
+                    for k, i in enumerate(trainable):
+                        if params_by_i[i] == uid:
+                            sparse_by_k[k] = entries
+                            break
 
             new_params = list(param_vals)
             new_state_vals = list(state_vals)

@@ -402,7 +402,7 @@ def _abstract_op(node: _Node, in_shapes: List[tuple]):
 
 def _apply_opdef(opdef, tensors, attrs, rng, training):
     kw = {k: v for k, v in attrs.items() if not k.startswith("__")
-          and k in opdef.attr_params}
+          and (opdef.var_attrs or k in opdef.attr_params)}
     if opdef.attr_specs:
         # the typed AttrSpec contract holds on the graph-execution path
         # too, not just eager calls
@@ -530,7 +530,7 @@ def _coerce_attrs(opdef, attrs_raw: dict) -> dict:
     sig = inspect.signature(opdef.fn)
     out = {}
     for k, v in attrs_raw.items():
-        if k not in opdef.attr_params:
+        if k not in opdef.attr_params and not opdef.var_attrs:
             continue
         if not isinstance(v, str):
             out[k] = v

@@ -1,0 +1,136 @@
+"""Python ``Custom`` op tests.
+
+Reference strategy: ``tests/python/unittest/test_operator.py::test_custom_op``
+— register a CustomOpProp, run it eagerly, through autograd, inside a
+hybridized block (traced/jitted graph), and from a Symbol graph.
+"""
+import numpy as np
+import pytest
+
+import mxnet_tpu as mx
+from mxnet_tpu import autograd
+from mxnet_tpu.base import MXNetError
+
+
+class _Sigmoid(mx.operator.CustomOp):
+    def forward(self, is_train, req, in_data, out_data, aux):
+        x = in_data[0].asnumpy()
+        self.assign(out_data[0], req[0], mx.nd.array(1.0 / (1.0 + np.exp(-x))))
+
+    def backward(self, req, out_grad, in_data, out_data, in_grad, aux):
+        y = out_data[0].asnumpy()
+        gy = out_grad[0].asnumpy()
+        self.assign(in_grad[0], req[0], mx.nd.array(gy * y * (1.0 - y)))
+
+
+@mx.operator.register("test_sigmoid")
+class _SigmoidProp(mx.operator.CustomOpProp):
+    def __init__(self):
+        super().__init__(need_top_grad=True)
+
+    def create_operator(self, ctx, in_shapes, in_dtypes):
+        return _Sigmoid()
+
+
+class _ScaleShift(mx.operator.CustomOp):
+    """Two inputs, attr-parameterized: out = scale * x + b."""
+
+    def __init__(self, scale):
+        self.scale = scale
+
+    def forward(self, is_train, req, in_data, out_data, aux):
+        x, b = in_data[0].asnumpy(), in_data[1].asnumpy()
+        self.assign(out_data[0], req[0], mx.nd.array(self.scale * x + b))
+
+    def backward(self, req, out_grad, in_data, out_data, in_grad, aux):
+        gy = out_grad[0].asnumpy()
+        self.assign(in_grad[0], req[0], mx.nd.array(self.scale * gy))
+        self.assign(in_grad[1], req[1], mx.nd.array(gy))
+
+
+@mx.operator.register("test_scale_shift")
+class _ScaleShiftProp(mx.operator.CustomOpProp):
+    def __init__(self, scale=1.0):
+        super().__init__(need_top_grad=True)
+        self.scale = float(scale)
+
+    def list_arguments(self):
+        return ["data", "bias"]
+
+    def create_operator(self, ctx, in_shapes, in_dtypes):
+        return _ScaleShift(self.scale)
+
+
+def test_custom_eager_forward():
+    x = mx.nd.array(np.linspace(-3, 3, 12).reshape(3, 4).astype(np.float32))
+    y = mx.nd.Custom(x, op_type="test_sigmoid")
+    np.testing.assert_allclose(
+        y.asnumpy(), 1 / (1 + np.exp(-x.asnumpy())), rtol=1e-6)
+
+
+def test_custom_autograd_uses_user_backward():
+    x = mx.nd.array(np.array([[0.5, -1.0], [2.0, 0.0]], np.float32))
+    x.attach_grad()
+    with autograd.record():
+        y = mx.nd.Custom(x, op_type="test_sigmoid")
+        loss = (y * y).sum()
+    loss.backward()
+    s = 1 / (1 + np.exp(-x.asnumpy()))
+    want = 2 * s * s * (1 - s)  # d(y^2)/dx through the user's backward
+    np.testing.assert_allclose(x.grad.asnumpy(), want, rtol=1e-5)
+
+
+def test_custom_attrs_flow_to_prop():
+    x = mx.nd.ones((2, 3))
+    b = mx.nd.full((2, 3), 0.5)
+    y = mx.nd.Custom(x, b, op_type="test_scale_shift", scale=3.0)
+    np.testing.assert_allclose(y.asnumpy(), 3.5 * np.ones((2, 3)), rtol=1e-6)
+
+
+def test_custom_hybridized_training():
+    """Train a hybridized block containing a Custom op: the graph is traced
+    and jitted, the custom forward/backward run as host callbacks."""
+    from mxnet_tpu.gluon import nn
+
+    class Net(mx.gluon.HybridBlock):
+        def __init__(self, **kw):
+            super().__init__(**kw)
+            self.fc = nn.Dense(4)
+
+        def hybrid_forward(self, F, x):
+            h = self.fc(x)
+            return F.Custom(h, op_type="test_sigmoid")
+
+    net = Net()
+    net.initialize()
+    net.hybridize()
+    trainer = mx.gluon.Trainer(net.collect_params(), "sgd",
+                               {"learning_rate": 0.5})
+    x = mx.nd.array(np.random.RandomState(0).randn(8, 5).astype(np.float32))
+    losses = []
+    for _ in range(3):
+        with autograd.record():
+            y = net(x)
+            loss = (y * y).mean()
+        loss.backward()
+        trainer.step(1)
+        losses.append(float(loss.asnumpy()))
+    assert losses[-1] < losses[0]  # user backward produced usable grads
+
+
+def test_custom_symbol_graph():
+    data = mx.sym.Variable("data")
+    out = mx.sym.Custom(data, op_type="test_sigmoid", name="sig")
+    ex = out.simple_bind(mx.cpu(), data=(2, 3))
+    x = np.random.RandomState(1).randn(2, 3).astype(np.float32)
+    (y,) = ex.forward(is_train=True, data=mx.nd.array(x))
+    np.testing.assert_allclose(y.asnumpy(), 1 / (1 + np.exp(-x)), rtol=1e-5)
+    ex.backward(mx.nd.ones((2, 3)))
+    s = 1 / (1 + np.exp(-x))
+    np.testing.assert_allclose(ex.grad_arrays[0].asnumpy(), s * (1 - s),
+                               rtol=1e-5)
+
+
+def test_custom_unregistered_raises():
+    with pytest.raises(MXNetError, match="not registered"):
+        mx.nd.Custom(mx.nd.ones((2,)), op_type="nope")

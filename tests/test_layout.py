@@ -134,3 +134,39 @@ def test_resnet_nhwc_parity_and_train_step():
     out.backward()
     g = net2.collect_params()[list(p2)[0]].grad()
     assert float(np.abs(g.asnumpy()).sum()) > 0
+
+
+def test_conv_layout_keeps_explicit_channels_first():
+    """Round-3 advisor finding: an EXPLICIT layout='NCHW' (or BatchNorm
+    axis=1) inside conv_layout('NHWC') must be kept, not flipped."""
+    with nn.conv_layout("NHWC"):
+        default_conv = nn.Conv2D(4, 3)
+        explicit_conv = nn.Conv2D(4, 3, layout="NCHW")
+        default_bn = nn.BatchNorm()
+        explicit_bn = nn.BatchNorm(axis=1)
+    assert default_conv._layout == "NHWC"
+    assert explicit_conv._layout == "NCHW"
+    assert default_bn._axis == -1
+    assert explicit_bn._axis == 1
+    # outside any context the defaults are channels-first
+    assert nn.Conv2D(4, 3)._layout == "NCHW"
+    assert nn.BatchNorm()._axis == 1
+
+
+def test_pooling_convention_same():
+    """pooling_convention='same' implements TF SAME: out = ceil(in/stride),
+    avg excludes the implicit pad cells only via count_include_pad."""
+    x = _rand((1, 1, 5, 5))
+    out = nd.Pooling(x, kernel=(3, 3), stride=(2, 2), pool_type="max",
+                     pooling_convention="same")
+    assert out.shape == (1, 1, 3, 3)
+    # oracle: manual pad to SAME then valid pooling
+    xa = x.asnumpy()[0, 0]
+    padded = np.full((7, 7), -np.inf, "float32")
+    padded[1:6, 1:6] = xa
+    want = np.stack([[padded[r:r + 3, c:c + 3].max()
+                      for c in (0, 2, 4)] for r in (0, 2, 4)])
+    np.testing.assert_allclose(out.asnumpy()[0, 0], want, rtol=1e-6)
+    with pytest.raises(Exception, match="same"):
+        nd.Pooling(x, kernel=(3, 3), stride=(2, 2), pad=(1, 1),
+                   pooling_convention="same")

@@ -1,0 +1,98 @@
+"""Multi-tensor fused optimizer ops vs the single-tensor oracle.
+
+Reference strategy: upstream tests multi_sgd_* against looped sgd_update
+(tests/python/unittest/test_optimizer.py::test_multi_sgd).
+"""
+import numpy as np
+import pytest
+
+import mxnet_tpu as mx
+
+
+def _params(n=3, seed=0, dtype=np.float32):
+    rs = np.random.RandomState(seed)
+    shapes = [(4, 5), (7,), (2, 3, 2)][:n]
+    ws = [mx.nd.array(rs.randn(*s).astype(dtype)) for s in shapes]
+    gs = [mx.nd.array(rs.randn(*s).astype(dtype)) for s in shapes]
+    return ws, gs
+
+
+LRS = (0.1, 0.01, 0.2)
+WDS = (0.0, 1e-4, 1e-3)
+
+
+def test_multi_sgd_update_matches_loop():
+    ws, gs = _params()
+    inputs = [t for pair in zip(ws, gs) for t in pair]
+    outs = mx.nd.multi_sgd_update(*inputs, lrs=LRS, wds=WDS,
+                                  rescale_grad=0.5, num_weights=3)
+    for i, (w, g) in enumerate(zip(ws, gs)):
+        want = mx.nd.sgd_update(w, g, lr=LRS[i], wd=WDS[i], rescale_grad=0.5)
+        np.testing.assert_allclose(outs[i].asnumpy(), want.asnumpy(),
+                                   rtol=1e-6)
+
+
+def test_multi_sgd_mom_update_matches_loop():
+    ws, gs = _params()
+    ms = [mx.nd.zeros(w.shape) + 0.1 for w in ws]
+    inputs = [t for trip in zip(ws, gs, ms) for t in trip]
+    outs = mx.nd.multi_sgd_mom_update(*inputs, lrs=LRS, wds=WDS,
+                                      momentum=0.9, num_weights=3)
+    for i, (w, g, m) in enumerate(zip(ws, gs, ms)):
+        w2, m2 = mx.nd.sgd_mom_update(w, g, m, lr=LRS[i], wd=WDS[i],
+                                      momentum=0.9)
+        np.testing.assert_allclose(outs[2 * i].asnumpy(), w2.asnumpy(),
+                                   rtol=1e-6)
+        np.testing.assert_allclose(outs[2 * i + 1].asnumpy(), m2.asnumpy(),
+                                   rtol=1e-6)
+
+
+def test_multi_mp_sgd_mom_update_matches_loop():
+    ws, gs = _params(dtype=np.float16)
+    ms = [mx.nd.zeros(w.shape, dtype="float32") for w in ws]
+    w32s = [w.astype("float32") for w in ws]
+    inputs = [t for quad in zip(ws, gs, ms, w32s) for t in quad]
+    outs = mx.nd.multi_mp_sgd_mom_update(*inputs, lrs=LRS, wds=WDS,
+                                         momentum=0.9, num_weights=3)
+    for i, (w, g, m, w32) in enumerate(zip(ws, gs, ms, w32s)):
+        w2, m2, w322 = mx.nd.mp_sgd_mom_update(w, g, m, w32, lr=LRS[i],
+                                               wd=WDS[i], momentum=0.9)
+        np.testing.assert_allclose(outs[3 * i].asnumpy(), w2.asnumpy(),
+                                   rtol=1e-3)
+        np.testing.assert_allclose(outs[3 * i + 2].asnumpy(), w322.asnumpy(),
+                                   rtol=1e-6)
+    assert outs[0].dtype == np.float16  # low-precision weight kept
+    assert outs[2].dtype == np.float32  # master copy fp32
+
+
+def test_preloaded_multi_sgd_update_tensor_lrs():
+    ws, gs = _params()
+    inputs = [t for pair in zip(ws, gs) for t in pair]
+    lrs_t = mx.nd.array(np.array(LRS, np.float32))
+    wds_t = mx.nd.array(np.array(WDS, np.float32))
+    outs = mx.nd.preloaded_multi_sgd_update(*inputs, lrs_t, wds_t,
+                                            num_weights=3)
+    for i, (w, g) in enumerate(zip(ws, gs)):
+        want = mx.nd.sgd_update(w, g, lr=LRS[i], wd=WDS[i])
+        np.testing.assert_allclose(outs[i].asnumpy(), want.asnumpy(),
+                                   rtol=1e-6)
+
+
+def test_multi_sum_sq():
+    ws, _ = _params()
+    out = mx.nd.multi_sum_sq(*ws, num_arrays=3)
+    want = np.array([float((w.asnumpy() ** 2).sum()) for w in ws], np.float32)
+    np.testing.assert_allclose(out.asnumpy(), want, rtol=1e-5)
+
+
+def test_multi_mp_sgd_update_matches_loop():
+    ws, gs = _params(dtype=np.float16)
+    w32s = [w.astype("float32") for w in ws]
+    inputs = [t for trip in zip(ws, gs, w32s) for t in trip]
+    outs = mx.nd.multi_mp_sgd_update(*inputs, lrs=LRS, wds=WDS, num_weights=3)
+    for i, (w, g, w32) in enumerate(zip(ws, gs, w32s)):
+        w2, w322 = mx.nd.mp_sgd_update(w, g, w32, lr=LRS[i], wd=WDS[i])
+        np.testing.assert_allclose(outs[2 * i].asnumpy(), w2.asnumpy(),
+                                   rtol=1e-3)
+        np.testing.assert_allclose(outs[2 * i + 1].asnumpy(),
+                                   w322.asnumpy(), rtol=1e-6)

@@ -178,9 +178,21 @@ class TestInt8MXUPath:
         # the s8 executable must actually be a DIFFERENT trace than the
         # oracle's: the per-op cache is platform-keyed (round-3 review
         # finding — an unkeyed cache served the oracle under the
-        # override, making this comparison vacuous)
-        assert not onp.array_equal(got.asnumpy(), oracle.asnumpy()), \
-            "s8 path returned the oracle executable's exact bits"
+        # override). Assert the cache keying DIRECTLY: same op+attrs,
+        # different platform -> distinct executables. (Bit-inequality of
+        # the outputs is not asserted — the grid-snapped arithmetic can
+        # legitimately agree bit-for-bit; round-3 advisor finding.)
+        from mxnet_tpu.ops import registry as _registry
+
+        attr_items = tuple(sorted({
+            "num_hidden": 16, "min_calib_range": -3.0,
+            "max_calib_range": 3.0}.items()))
+        f_cpu = _registry._cached_call("_contrib_quantized_dense",
+                                       attr_items, 4, False, "cpu")
+        f_tpu = _registry._cached_call("_contrib_quantized_dense",
+                                       attr_items, 4, False, "tpu")
+        assert f_cpu is not f_tpu, \
+            "per-op executable cache is not platform-keyed"
 
         # the compiled path must contain an s8 x s8 -> s32 dot
         from mxnet_tpu.ops.contrib import quantized_dense as qd_fn
