@@ -76,7 +76,8 @@ def main():
     from mxnet_tpu.gluon.model_zoo import vision
 
     platform = jax.devices()[0].platform
-    batch = 256 if platform != "cpu" else 8
+    batch = int(os.environ.get("BENCH_RESNET_BATCH",
+                               256 if platform != "cpu" else 8))
     steps = 30 if platform != "cpu" else 3
 
     step = _make_resnet_step(batch)

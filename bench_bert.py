@@ -13,6 +13,7 @@ staged on device before the timed loop.
 from __future__ import annotations
 
 import json
+import os
 import sys
 import time
 

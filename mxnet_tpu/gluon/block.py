@@ -37,7 +37,7 @@ __all__ = ["Block", "HybridBlock", "SymbolBlock", "nested_flatten_nd",
            "remat_call"]
 
 
-def remat_call(block, *args):
+def remat_call(block, *args, policy=None):
     """Call ``block`` under ``jax.checkpoint`` when inside a live trace.
 
     Gradient rematerialization for big models (SURVEY.md §7.2 "remat
@@ -47,10 +47,32 @@ def remat_call(block, *args):
     closed-over trace inputs and stay saved; only intra-block activations
     are recomputed. Outside a trace (eager) this is a plain call: eager
     autograd replays the graph anyway, so there is nothing to save.
+
+    ``policy``:
+      None / "full"  save nothing — recompute the whole block (max memory
+                     savings, ~+1 forward of FLOPs per backward);
+      "dots"         ``dots_with_no_batch_dims_saveable`` — matmul outputs
+                     are SAVED, only elementwise/norm/rotary recompute.
+                     The backward re-runs no MXU work, so the remat FLOPs
+                     tax ~vanishes for ~the matmul-output bytes per block
+                     (the middle ground when full activations don't fit
+                     but matmul outputs do — see PERF.md round 4 for the
+                     measured policy ladder on the 0.7B proxy).
     """
     import jax
 
     from ..ndarray import NDArray
+
+    # validate the policy on EVERY call (eager included) so a typo can't
+    # hide until the first traced step
+    if policy in (None, "full"):
+        jpolicy = None
+    elif policy == "dots":
+        jpolicy = jax.checkpoint_policies.dots_with_no_batch_dims_saveable
+    elif callable(policy):
+        jpolicy = policy
+    else:
+        raise ValueError(f"unknown remat policy {policy!r}")
 
     if not args or not isinstance(args[0].data, jax.core.Tracer):
         return block(*args)
@@ -62,7 +84,7 @@ def remat_call(block, *args):
         _pure.tree = tree
         return tuple(o.data for o in flat)
 
-    out_vals = jax.checkpoint(_pure)(*[a.data for a in args])
+    out_vals = jax.checkpoint(_pure, policy=jpolicy)(*[a.data for a in args])
     out_nd = [NDArray(data=v, ctx=ctx) for v in out_vals]
     return nested_unflatten_nd(_pure.tree, out_nd)
 

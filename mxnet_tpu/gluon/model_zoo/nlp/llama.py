@@ -113,6 +113,20 @@ class LlamaBlock(HybridBlock):
         return x + self.mlp(self.mlp_norm(x))
 
 
+def _best_ce_chunk(vocab, target=8192):
+    """Largest divisor of ``vocab`` <= target (the fused-CE tile size that
+    keeps the bias-free path reachable — e.g. 8016 for Llama-3's 128256).
+    A vocab <= target is its own (single) chunk. Only when every divisor
+    is degenerate (< target/4, e.g. a large near-prime vocab) fall back to
+    ``target`` and accept the padded path."""
+    if vocab <= target:
+        return vocab
+    for c in range(target, 0, -1):
+        if vocab % c == 0:
+            return c if c >= target // 4 else target
+    return target
+
+
 class LlamaModel(HybridBlock):
     """Decoder-only causal LM; returns (B, L, vocab) logits."""
 
@@ -120,17 +134,26 @@ class LlamaModel(HybridBlock):
                  hidden_size=14336, num_heads=32, num_kv_heads=8,
                  rope_theta=500000.0, eps=1e-5, tie_weights=False,
                  ring_axis=None, remat=False, fused_ce=False,
-                 prefix=None, params=None):
+                 ce_chunk=None, prefix=None, params=None):
         super().__init__(prefix=prefix, params=params)
         self._units = units
         # per-block gradient rematerialization (jax.checkpoint) inside
-        # compiled train steps — pretrain-scale memory policy
-        self._remat = bool(remat)
+        # compiled train steps — pretrain-scale memory policy. ``remat``
+        # may be a bool (True = save-nothing "full" policy) or a policy
+        # name accepted by gluon.block.remat_call ("full" | "dots").
+        self._remat = remat if isinstance(remat, str) else bool(remat)
         # fused projection+CE head (ops/fused_loss.py): forward takes
         # (tokens, labels) and returns per-token loss; the (B, L, vocab)
         # logits never materialize — at pretrain vocab sizes they are
         # the largest intermediate of the step
         self._fused_ce = bool(fused_ce)
+        # chunk must DIVIDE vocab for the bias-free fast path of
+        # softmax_ce_head (a non-divisor falls back to padding + a
+        # synthetic zero bias whose vocab-sized cotangent the fast path
+        # exists to avoid — round-3 advisor finding). Default: largest
+        # divisor of vocab <= 8192, e.g. 8016 for the Llama-3 128256.
+        self._ce_chunk = int(ce_chunk) if ce_chunk else \
+            _best_ce_chunk(vocab_size)
         with self.name_scope():
             self.embed = nn.Embedding(vocab_size, units, prefix="embed_")
             self.blocks = []
@@ -158,7 +181,10 @@ class LlamaModel(HybridBlock):
 
         x = self.embed(tokens)
         for blk in self.blocks:
-            x = remat_call(blk, x) if self._remat else blk(x)
+            x = remat_call(
+                blk, x,
+                policy=self._remat if isinstance(self._remat, str)
+                else None) if self._remat else blk(x)
         h = self.norm(x)
         if self._fused_ce:
             if labels is None:
@@ -167,7 +193,7 @@ class LlamaModel(HybridBlock):
                     "returns the per-token loss")
             w = self.lm_head.weight.data(tokens.context)
             return F._contrib_softmax_ce_head(h, w, None, labels,
-                                              chunk=8192)
+                                              chunk=self._ce_chunk)
         return self.lm_head(h)
 
 
@@ -188,6 +214,14 @@ class LlamaModelPP(HybridBlock):
         super().__init__(prefix=prefix, params=params)
         from ....parallel.pipeline import Pipelined
 
+        if isinstance(remat, str):
+            # Pipelined's remat is jax.checkpoint over the stage scan with
+            # the default policy only; a policy string would be silently
+            # bool()-coerced to full remat — reject instead of lying
+            raise ValueError(
+                "LlamaModelPP supports remat=True/False only (the "
+                "pipelined trunk's checkpoint has no policy plumbing); "
+                f"got remat={remat!r}")
         self._units = units
         with self.name_scope():
             self.embed = nn.Embedding(vocab_size, units, prefix="embed_")

@@ -90,24 +90,90 @@ def convolution(data, weight, bias=None, *, kernel=(), stride=(), dilate=(),
     dilate = _tuplize(dilate or 1, nd)
     pad = _tuplize(pad or 0, nd)
     dnums = _conv_dnums(nd, layout)
-    out = jax.lax.conv_general_dilated(
-        data,
-        weight.astype(data.dtype),
-        window_strides=stride,
-        padding=[(p, p) for p in pad],
-        rhs_dilation=dilate,
-        dimension_numbers=dnums,
-        feature_group_count=num_group,
-        # NOTE: no preferred_element_type=f32 here — the TPU MXU accumulates
-        # bf16 convs in f32 natively, and an explicit f32 output breaks the
-        # conv transpose (VJP) rule's dtype agreement.
-    )
+    out = _conv_core(data, weight.astype(data.dtype), stride,
+                     [(p, p) for p in pad], dilate, dnums, num_group,
+                     layout, kernel)
     out = out.astype(data.dtype)
     if not no_bias and bias is not None:
         bshape = [1] * out.ndim
         bshape[_channel_axis(layout, out.ndim)] = bias.shape[0]
         out = out + bias.astype(out.dtype).reshape(bshape)
     return out
+
+
+def _conv_core(data, weight, stride, pads, dilate, dnums, groups, layout,
+               kernel):
+    """conv_general_dilated, with a custom dW backward on eligible shapes.
+
+    XLA:TPU derives dW as a conv whose 'kernel' is the (large) dy tensor —
+    measured at ~38% of roofline across ResNet-50's layers (PERF.md round
+    3; VERDICT r3 #3). MXNET_TPU_CONV_DW=patches switches eligible convs
+    (2-D, group-1, undilated, channels-last) to an explicit im2col dW:
+    gather input patches (conv_general_dilated_patches), contract
+    (N·Ho·Wo) x (C·kh·kw) against (N·Ho·Wo) x O in ONE MXU dot_general;
+    dX keeps XLA's transposed-conv rule.
+
+    Measured END-TO-END on ResNet-50 batch 256 (round 4): the patches
+    formulation is 4x SLOWER (615 vs 2,324 img/s) — the materialized
+    patch tensors (9x activation bytes for 3x3 convs) turn the step
+    HBM-bound, and XLA cannot fuse the gather into the contraction. An
+    isolated chained-scan microbench (tools/convbwd_bench.py) said the
+    opposite (vjp-dW 12-46x slower there), i.e. the scan context poisons
+    XLA's conv-bwd algorithm choice; trust only in-model traces. Kept
+    env-gated for experiments; default = XLA's own backward.
+    """
+    import os
+
+    def conv(x, w):
+        return jax.lax.conv_general_dilated(
+            x, w, window_strides=stride, padding=pads,
+            rhs_dilation=dilate, dimension_numbers=dnums,
+            feature_group_count=groups,
+            # NOTE: no preferred_element_type=f32 — the TPU MXU
+            # accumulates bf16 convs in f32 natively, and an explicit f32
+            # output breaks the conv transpose (VJP) rule's dtype
+            # agreement.
+        )
+
+    eligible = (len(kernel) == 2 and groups == 1
+                and all(d == 1 for d in dilate)
+                and bool(layout) and layout.endswith("C")
+                and os.environ.get("MXNET_TPU_CONV_DW", "vjp")
+                == "patches")
+    if not eligible:
+        return conv(data, weight)
+
+    kh, kw = kernel
+
+    @jax.custom_vjp
+    def f(x, w):
+        return conv(x, w)
+
+    def f_fwd(x, w):
+        return conv(x, w), (x, w)
+
+    def f_bwd(res, dy):
+        x, w = res
+        _, pull_x = jax.vjp(lambda x_: conv(x_, w), x)
+        (dx,) = pull_x(dy)
+        # dW via im2col: patches (N,Ho,Wo, C*kh*kw) — feature order is
+        # (C, kh, kw), per conv_general_dilated_patches — against
+        # dy (N,Ho,Wo,O), contracted over all positions at once
+        patches = jax.lax.conv_general_dilated_patches(
+            x, (kh, kw), stride, pads,
+            dimension_numbers=("NHWC", "HWIO", "NHWC"))
+        n, ho, wo, _ = patches.shape
+        cin = x.shape[-1]
+        dw = jax.lax.dot_general(
+            patches.reshape(n * ho * wo, cin * kh * kw),
+            dy.reshape(n * ho * wo, -1),
+            (((0,), (0,)), ((), ())))
+        # (C*kh*kw, O) -> (O, C, kh, kw) == the OIHW weight layout
+        dw = dw.reshape(cin, kh, kw, -1).transpose(3, 0, 1, 2)
+        return dx, dw.astype(w.dtype)
+
+    f.defvjp(f_fwd, f_bwd)
+    return f(data, weight)
 
 
 @register("Deconvolution", aliases=["deconvolution"])

@@ -170,3 +170,28 @@ def test_pooling_convention_same():
     with pytest.raises(Exception, match="same"):
         nd.Pooling(x, kernel=(3, 3), stride=(2, 2), pad=(1, 1),
                    pooling_convention="same")
+
+
+def test_conv_dw_patches_matches_vjp(monkeypatch):
+    """MXNET_TPU_CONV_DW=patches (the im2col dW experiment path) must
+    produce the same gradients as XLA's conv backward."""
+    from mxnet_tpu import autograd
+
+    rs = np.random.RandomState(0)
+    x_np = rs.randn(2, 9, 9, 5).astype("float32")
+    w_np = rs.randn(6, 5, 3, 3).astype("float32") * 0.1
+    grads = {}
+    for mode in ("vjp", "patches"):
+        monkeypatch.setenv("MXNET_TPU_CONV_DW", mode)
+        x, w = mx.nd.array(x_np), mx.nd.array(w_np)
+        x.attach_grad(); w.attach_grad()
+        with mx.autograd.record():
+            y = nd.Convolution(x, w, kernel=(3, 3), stride=(2, 2),
+                               pad=(1, 1), num_filter=6, no_bias=True,
+                               layout="NHWC")
+            ((y * y).sum()).backward()
+        grads[mode] = (x.grad.asnumpy(), w.grad.asnumpy())
+    np.testing.assert_allclose(grads["patches"][0], grads["vjp"][0],
+                               rtol=2e-4, atol=2e-4)
+    np.testing.assert_allclose(grads["patches"][1], grads["vjp"][1],
+                               rtol=2e-3, atol=2e-3)

@@ -199,3 +199,43 @@ class TestHybridize:
         ll.hybridize()
         jitted = ll(x).asnumpy()
         np.testing.assert_allclose(eager, jitted, rtol=1e-4, atol=1e-5)
+
+
+def test_remat_policy_grads_match():
+    """remat policies (full save-nothing vs dots-saveable vs none) must be
+    pure memory/FLOPs trades — identical losses and gradients."""
+    import numpy as onp
+
+    from mxnet_tpu import autograd, parallel as par
+    from mxnet_tpu.gluon import loss as gloss
+    from mxnet_tpu.gluon.model_zoo.nlp.llama import LlamaModel
+
+    import jax
+
+    results = {}
+    for remat in (False, True, "dots"):
+        onp.random.seed(7)
+        net = LlamaModel(vocab_size=64, num_layers=2, units=32,
+                         hidden_size=64, num_heads=4, num_kv_heads=2,
+                         remat=remat, fused_ce=True)
+        net.initialize()
+        mesh = par.make_mesh({"dp": 1}, devices=jax.devices()[:1])
+        step = par.TrainStep(net, lambda outs, *a: outs, "sgd", mesh=mesh,
+                             loss_only=True,
+                             optimizer_params={"learning_rate": 0.1})
+        rs = onp.random.RandomState(3)
+        toks = mx.nd.array(rs.randint(0, 64, (2, 16)).astype(onp.int32))
+        labs = mx.nd.array(rs.randint(0, 64, (2, 16)).astype(onp.int32))
+        loss, _ = step((toks, labs), ())
+        params = {k: v.data().asnumpy() for k, v in
+                  net._collect_params_with_prefix().items()}
+        results[str(remat)] = (float(loss.asnumpy()), params)
+
+    base_loss, base_params = results["False"]
+    for key in ("True", "dots"):
+        loss_v, params_v = results[key]
+        assert loss_v == pytest.approx(base_loss, rel=1e-5), key
+        for k in base_params:
+            onp.testing.assert_allclose(params_v[k], base_params[k],
+                                        rtol=1e-4, atol=1e-5,
+                                        err_msg=f"{key}:{k}")

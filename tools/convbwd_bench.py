@@ -52,10 +52,11 @@ SHAPES = [
 def conv_fwd(x, w, stride, pad):
     import jax
 
+    # bf16 in/out like the real bf16 TrainStep (MXU accumulates f32
+    # internally either way)
     return jax.lax.conv_general_dilated(
         x, w, window_strides=(stride, stride), padding=pad,
-        dimension_numbers=("NHWC", "HWIO", "NHWC"),
-        preferred_element_type=np.float32)
+        dimension_numbers=("NHWC", "HWIO", "NHWC"))
 
 
 def dw_patches(x, dy, kh, kw, stride, pad, cin):

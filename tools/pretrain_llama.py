@@ -76,7 +76,9 @@ def main(argv=None):
     ap.add_argument("--steps", type=int, default=10)
     ap.add_argument("--lr", type=float, default=3e-4)
     ap.add_argument("--weight-decay", type=float, default=0.1)
-    ap.add_argument("--remat", action="store_true", default=None)
+    ap.add_argument("--remat", nargs="?", const=True, default=None,
+                    help="enable remat; optional value picks the policy "
+                         "('full' save-nothing, 'dots' keep matmul outputs)")
     ap.add_argument("--no-remat", dest="remat", action="store_false")
     ap.add_argument("--data", default="synthetic")
     ap.add_argument("--save-dir", default=None)

@@ -74,6 +74,41 @@ def build_bert_step():
     return step, (tokens, labels)
 
 
+def build_llama_step():
+    """The 0.7B proxy exactly as bench_llama.py runs it (no-remat,
+    fused CE, AdamW, bf16) — VERDICT r4: trace the Llama path the way
+    BERT was traced."""
+    import jax
+    import mxnet_tpu as mx
+    from mxnet_tpu import parallel as par
+    from mxnet_tpu.gluon.model_zoo.nlp.llama import LlamaModel
+
+    sys.path.insert(0, os.path.dirname(os.path.abspath(__file__)))
+    from pretrain_llama import CONFIGS
+
+    batch, seq = int(os.environ.get("BENCH_LLAMA_BATCH", 8)), 2048
+    cfg = CONFIGS["proxy1b"]
+    raw = os.environ.get("LLAMA_REMAT", "")
+    remat = (True if raw.lower() in ("1", "true", "yes") else
+             False if raw.lower() in ("", "0", "false", "no") else raw)
+    net = LlamaModel(**cfg, remat=remat, fused_ce=True)
+    net.initialize()
+    net.cast("bfloat16")
+    rs = np.random.RandomState(0)
+    toks = mx.nd.array(rs.randint(0, cfg["vocab_size"],
+                                  (batch, seq)).astype(np.int32))
+    labs = mx.nd.array(rs.randint(0, cfg["vocab_size"],
+                                  (batch, seq)).astype(np.int32))
+    mesh = par.make_mesh({"dp": 1}, devices=jax.devices()[:1])
+    step = par.TrainStep(net, lambda outs, *a: outs, "adamw", mesh=mesh,
+                         loss_only=True,
+                         optimizer_params={"learning_rate": 3e-4,
+                                           "wd": 0.1, "beta1": 0.9,
+                                           "beta2": 0.95,
+                                           "multi_precision": True})
+    return step, ((toks, labs), ())
+
+
 def build_resnet_step():
     import jax
     import mxnet_tpu as mx
@@ -123,8 +158,8 @@ def main():
     topn = int(sys.argv[2]) if len(sys.argv) > 2 else 40
     import jax
 
-    step, batch = (build_bert_step if which == "bert"
-                   else build_resnet_step)()
+    step, batch = {"bert": build_bert_step, "resnet": build_resnet_step,
+                   "llama": build_llama_step}[which]()
     loss, _ = step(*batch)
     loss.asnumpy()
     step.stage_batch(*batch)
