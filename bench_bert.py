@@ -31,8 +31,6 @@ def main():
     from mxnet_tpu.gluon import loss as gloss
     from mxnet_tpu.gluon.model_zoo.nlp import bert
 
-    import os
-
     platform = jax.devices()[0].platform
     batch = int(os.environ.get("BENCH_BERT_BATCH",
                                32 if platform != "cpu" else 2))

@@ -29,11 +29,13 @@ def main():
         args = ["--config", "tiny", "--steps", "3"]
     else:
         # no-remat: the 0.7B proxy's full activations fit one v5e at
-        # batch 8, and dropping the blanket recompute measured +11%
-        # tokens/s (13,765 -> 15,265; PERF.md round 4). Remat is a MEMORY
-        # policy — the 8B stretch config keeps it (tools/pretrain_llama
-        # --config 8b), the proxy benchmarks the unconstrained step.
-        args = ["--config", "proxy1b", "--steps", "12", "--batch", "8",
+        # batch 8, and dropping the blanket recompute gained ~11%
+        # device-side. Remat is a MEMORY policy — the 8B stretch config
+        # keeps it (tools/pretrain_llama --config 8b), the proxy
+        # benchmarks the unconstrained step. 16 steps: sync at 8,
+        # synced-span over the last 8 (~5 s device; PERF.md round 4 on
+        # why the span MUST start from a synced fetch).
+        args = ["--config", "proxy1b", "--steps", "16", "--batch", "8",
                 "--seq", "2048", "--no-remat"]
     import contextlib
     import io

@@ -122,9 +122,8 @@ def _best_ce_chunk(vocab, target=8192):
     if vocab <= target:
         return vocab
     for c in range(target, 0, -1):
-        if vocab % c == 0:
+        if vocab % c == 0:  # c=1 always divides, so this always returns
             return c if c >= target // 4 else target
-    return target
 
 
 class LlamaModel(HybridBlock):
@@ -140,8 +139,10 @@ class LlamaModel(HybridBlock):
         # per-block gradient rematerialization (jax.checkpoint) inside
         # compiled train steps — pretrain-scale memory policy. ``remat``
         # may be a bool (True = save-nothing "full" policy) or a policy
-        # name accepted by gluon.block.remat_call ("full" | "dots").
-        self._remat = remat if isinstance(remat, str) else bool(remat)
+        # name accepted by gluon.block.remat_call ("full" | "dots");
+        # normalized here to policy-name-or-None
+        self._remat = remat if isinstance(remat, str) else \
+            ("full" if remat else None)
         # fused projection+CE head (ops/fused_loss.py): forward takes
         # (tokens, labels) and returns per-token loss; the (B, L, vocab)
         # logits never materialize — at pretrain vocab sizes they are
@@ -152,6 +153,12 @@ class LlamaModel(HybridBlock):
         # synthetic zero bias whose vocab-sized cotangent the fast path
         # exists to avoid — round-3 advisor finding). Default: largest
         # divisor of vocab <= 8192, e.g. 8016 for the Llama-3 128256.
+        if ce_chunk and vocab_size % int(ce_chunk):
+            raise ValueError(
+                f"ce_chunk={ce_chunk} does not divide vocab_size="
+                f"{vocab_size}; a non-divisor silently re-enables the "
+                "padded fallback path (default picks "
+                f"{_best_ce_chunk(vocab_size)})")
         self._ce_chunk = int(ce_chunk) if ce_chunk else \
             _best_ce_chunk(vocab_size)
         with self.name_scope():
@@ -181,10 +188,8 @@ class LlamaModel(HybridBlock):
 
         x = self.embed(tokens)
         for blk in self.blocks:
-            x = remat_call(
-                blk, x,
-                policy=self._remat if isinstance(self._remat, str)
-                else None) if self._remat else blk(x)
+            x = remat_call(blk, x, policy=self._remat) if self._remat \
+                else blk(x)
         h = self.norm(x)
         if self._fused_ce:
             if labels is None:

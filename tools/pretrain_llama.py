@@ -157,29 +157,51 @@ def main(argv=None):
         else:
             step.stage_batch(tokens, labels)
 
+    # Throughput methodology: async dispatch means per-step host timers
+    # measure DISPATCH, not device time, and the final fetch's wait
+    # carries EVERY queued step's device time — a trailing window that
+    # doesn't start from a synced point mis-attributes earlier steps'
+    # device work into its own denominator (round 4 found the round-3
+    # proxy number undercounted ~2x this way; the jax.profiler trace
+    # shows back-to-back 575 ms device steps). So: sync (fetch) at the
+    # steady-window boundary, wall-time the remaining steps as one span
+    # ending in a fetch — the same synced-span method bench.py uses.
     times = []
+    sync_at = max(2, args.steps // 2)
+    t_span = None
+    span_steps = 0
     for i in range(2, args.steps + 1):
         if args.data != "synthetic":
             tokens, labels = next(data_iter)
         t0 = time.time()
         loss, _ = run_step(tokens, labels)
-        if i == args.steps or i % 20 == 0:
+        if i == sync_at:
+            loss_val = float(loss.asnumpy())  # drain the dispatch queue
+            t_span = time.time()
+        elif i == args.steps or i % 20 == 0:
             loss_val = float(loss.asnumpy())
+        if i > sync_at:
+            span_steps += 1
+        if i == args.steps and t_span is not None:
+            # span ends HERE, at the final fetch — checkpoint saves below
+            # must not leak into the throughput denominator
+            span_dt = time.time() - t_span
         times.append(time.time() - t0)
         if args.save_dir and i % args.save_every == 0:
             _save(net, step, args.save_dir, i)
         if i == args.steps or i % 20 == 0:
             tok_s = batch * seq / (sum(times[-10:]) / len(times[-10:]))
-            print(f"step {i}: loss {loss_val:.4f} tokens/s {tok_s:.0f}",
+            print(f"step {i}: loss {loss_val:.4f} tokens/s {tok_s:.0f} "
+                  f"(rolling dispatch-window; final number is synced-span)",
                   flush=True)
     if args.save_dir and args.steps % args.save_every != 0:
         _save(net, step, args.save_dir, args.steps)
 
     peak = device_peak_flops()
-    steady = times[len(times) // 2:]
-    if not steady:  # --steps 1: only the compile step ran
-        steady = [time.time() - t0]
-    tok_s = batch * seq * len(steady) / sum(steady)
+    if t_span is not None and span_steps > 0:
+        tok_s = batch * seq * span_steps / span_dt
+    else:  # --steps 1: only the compile step ran
+        tok_s = batch * seq / (time.time() - t0)
     mfu = 6.0 * n_params * tok_s / peak if peak else None
     print(json.dumps({
         "config": args.config, "params": n_params, "tokens_per_sec":

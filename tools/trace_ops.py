@@ -88,9 +88,9 @@ def build_llama_step():
 
     batch, seq = int(os.environ.get("BENCH_LLAMA_BATCH", 8)), 2048
     cfg = CONFIGS["proxy1b"]
-    raw = os.environ.get("LLAMA_REMAT", "")
-    remat = (True if raw.lower() in ("1", "true", "yes") else
-             False if raw.lower() in ("", "0", "false", "no") else raw)
+    raw = os.environ.get("LLAMA_REMAT", "").lower()
+    remat = (True if raw in ("1", "true", "yes") else
+             False if raw in ("", "0", "false", "no") else raw)
     net = LlamaModel(**cfg, remat=remat, fused_ce=True)
     net.initialize()
     net.cast("bfloat16")
