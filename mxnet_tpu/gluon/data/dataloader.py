@@ -9,9 +9,19 @@ TPU-native design: workers produce **numpy** batches on the host (the
 TPU analogue of cpu_shared memory — host staging buffers); the final
 ``device_put`` happens when the consumer moves the batch to its context
 (`batch.as_in_context(mx.tpu())`), which XLA overlaps with compute.
-Worker transport uses multiprocessing with pickled numpy (zero-copy shm is
-an optimization slot; the API contract is identical). A prefetch queue of
-``2*num_workers`` batches keeps the device fed.
+
+Process-worker transport (``MXNET_TPU_FORK_WORKERS=1``) is ZERO-COPY
+over POSIX shared memory, mirroring the reference's
+``cpu_shared_storage_manager.h`` rebuild: the worker batchifies (stacks)
+sample trees into ``multiprocessing.shared_memory`` blocks and sends
+only (name, shape, dtype) descriptors through the pickle channel; the
+parent maps each block and wraps it without copying the payload through
+the pipe. Opt out with ``MXNET_TPU_SHM=0`` (falls back to pickled
+numpy); a custom ``batchify_fn`` also falls back, since worker-side
+stacking implements the DEFAULT batchify only — same constraint as the
+reference's ``default_mp_batchify_fn``. Thread-pool mode (the default)
+shares an address space and needs no transport at all. A prefetch queue
+of ``2*num_workers`` batches keeps the device fed.
 """
 from __future__ import annotations
 
@@ -65,10 +75,87 @@ def _worker_initializer(dataset):
     _worker_dataset = dataset
 
 
-def _worker_fn(samples, batchify_is_default):
-    """Runs in a worker process: fetch + transform samples, return numpy."""
+def _stack_tree(samples):
+    """default-batchify a list of numpy sample trees into batch arrays."""
+    first = samples[0]
+    if isinstance(first, tuple):
+        return tuple(_stack_tree([s[i] for s in samples])
+                     for i in range(len(first)))
+    return _np.stack([_np.asarray(s) for s in samples])
+
+
+def _to_shm(tree):
+    """Copy batch arrays into shm blocks; return descriptor tree."""
+    from multiprocessing import shared_memory
+
+    if isinstance(tree, tuple):
+        return tuple(_to_shm(t) for t in tree)
+    arr = _np.ascontiguousarray(tree)
+    shm = shared_memory.SharedMemory(create=True, size=max(arr.nbytes, 1))
+    dst = _np.ndarray(arr.shape, arr.dtype, buffer=shm.buf)
+    dst[...] = arr
+    name = shm.name
+    shm.close()  # drop the worker's mapping; the block outlives it
+    try:
+        # the parent owns the unlink; keep the worker-side resource
+        # tracker from double-unlinking at worker exit
+        from multiprocessing import resource_tracker
+
+        resource_tracker.unregister(shm._name if hasattr(shm, "_name")
+                                    else "/" + name, "shared_memory")
+    except Exception:
+        pass
+    return ("__shm__", name, tuple(arr.shape), str(arr.dtype))
+
+
+def _unlink_shm(tree):
+    """Best-effort unlink of every block in a descriptor tree — cleanup
+    path for batches that were prefetched but never consumed."""
+    from multiprocessing import shared_memory
+
+    if isinstance(tree, tuple) and len(tree) == 4 and tree[0] == "__shm__":
+        try:
+            shm = shared_memory.SharedMemory(name=tree[1])
+            shm.close()
+            shm.unlink()
+        except Exception:
+            pass
+        return
+    if isinstance(tree, tuple):
+        for t in tree:
+            _unlink_shm(t)
+
+
+def _from_shm(tree):
+    """Map descriptor tree back into NDArrays; unlink the blocks."""
+    from multiprocessing import shared_memory
+
+    if isinstance(tree, tuple) and len(tree) == 4 and tree[0] == "__shm__":
+        _, name, shape, dtype = tree
+        shm = shared_memory.SharedMemory(name=name)
+        view = _np.ndarray(shape, dtype, buffer=shm.buf)
+        # explicit memcpy out of the block BEFORE unmapping: the CPU
+        # backend may zero-copy-alias a numpy buffer, and unmapping under
+        # a live alias segfaults. The IPC hop itself stayed descriptor-
+        # only; this is the one host copy the reference's shm rebuild
+        # also pays (NDArray over shm -> consumer copy on first write).
+        nd = nd_array(view.copy())
+        shm.close()
+        shm.unlink()
+        return nd
+    if isinstance(tree, tuple):
+        return [_from_shm(t) for t in tree]
+    return tree
+
+
+def _worker_fn(samples, batchify_is_default, use_shm=False):
+    """Runs in a worker process: fetch + transform samples; either return
+    pickled numpy samples, or (shm mode) batchify here and ship only
+    shared-memory descriptors."""
     global _worker_dataset
     out = [_as_numpy_sample(_worker_dataset[i]) for i in samples]
+    if use_shm and batchify_is_default:
+        return _to_shm(_stack_tree(out))
     return out
 
 
@@ -103,6 +190,9 @@ class DataLoader:
         self._prefetch = max(0, prefetch if prefetch is not None
                              else 2 * self._num_workers)
         self._batchify_fn = batchify_fn or default_batchify_fn
+        self._batchify_is_default = batchify_fn is None
+        self._use_shm = (self._batchify_is_default
+                         and os.environ.get("MXNET_TPU_SHM", "1") != "0")
         self._pool = None
         if self._num_workers > 0:
             # Worker transport: thread pool by default. fork() after JAX
@@ -143,18 +233,42 @@ class DataLoader:
                     lambda idx: [_as_numpy_sample(self._dataset[i]) for i in idx],
                     (batch_idx,))
             else:
-                res = self._pool.apply_async(_worker_fn, (batch_idx, True))
+                res = self._pool.apply_async(
+                    _worker_fn, (batch_idx, self._batchify_is_default,
+                                 self._use_shm))
             pending.put(res)
             return True
 
+        shm_mode = (not self._thread_pool and self._use_shm
+                    and self._batchify_is_default)
         for _ in range(self._prefetch or 1):
             if not submit():
                 break
-        while not pending.empty():
-            res = pending.get()
-            samples = res.get(self._timeout)
-            submit()
-            yield self._batchify(samples)
+        try:
+            while not pending.empty():
+                res = pending.get()
+                samples = res.get(self._timeout)
+                submit()
+                if shm_mode:
+                    batch = _from_shm(samples)  # stacked in the worker
+                    if isinstance(batch, list) and len(batch) == 1:
+                        batch = batch[0]
+                    if self._pin_memory:
+                        batch = _pin(batch)
+                    yield batch
+                else:
+                    yield self._batchify(samples)
+        finally:
+            # early break / generator close / worker error: the workers
+            # unregistered their blocks from the resource tracker, so the
+            # parent must unlink every prefetched-but-unconsumed batch or
+            # /dev/shm fills across runs
+            if shm_mode:
+                while not pending.empty():
+                    try:
+                        _unlink_shm(pending.get().get(self._timeout))
+                    except Exception:
+                        pass
 
     def _batchify(self, samples):
         batch = self._batchify_fn(samples)

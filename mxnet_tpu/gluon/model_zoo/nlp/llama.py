@@ -154,11 +154,20 @@ class LlamaModel(HybridBlock):
         # exists to avoid — round-3 advisor finding). Default: largest
         # divisor of vocab <= 8192, e.g. 8016 for the Llama-3 128256.
         if ce_chunk and vocab_size % int(ce_chunk):
-            raise ValueError(
+            # warn, don't raise: the default itself may legitimately pick
+            # a non-divisor for near-prime vocabs (padded fallback is the
+            # only option there) — but an accidental non-divisor when good
+            # divisors exist deserves a loud signal
+            import warnings
+
+            best = _best_ce_chunk(vocab_size)
+            warnings.warn(
                 f"ce_chunk={ce_chunk} does not divide vocab_size="
-                f"{vocab_size}; a non-divisor silently re-enables the "
-                "padded fallback path (default picks "
-                f"{_best_ce_chunk(vocab_size)})")
+                f"{vocab_size}: the fused CE head takes the padded "
+                "fallback with a vocab-sized synthetic-bias cotangent"
+                + (f"; a dividing chunk exists ({best})"
+                   if vocab_size % best == 0 else ""),
+                stacklevel=3)
         self._ce_chunk = int(ce_chunk) if ce_chunk else \
             _best_ce_chunk(vocab_size)
         with self.name_scope():

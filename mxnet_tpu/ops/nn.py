@@ -498,6 +498,39 @@ def softmin(data, *, axis=-1, temperature=None, dtype=None):
     return softmax_op(-data, axis=axis, temperature=temperature, dtype=dtype)
 
 
+@register("masked_softmax")
+def masked_softmax(data, mask, *, axis=-1, temperature=1.0,
+                   normalize=True):
+    """Softmax over positions where ``mask`` is true; masked positions
+    get probability 0 (reference: src/operator/nn/masked_softmax.cc —
+    fully-masked rows produce zeros, not NaN)."""
+    m = mask.astype(bool)
+    x = data if temperature in (None, 1.0) else data / temperature
+    if not normalize:
+        # upstream normalize=False: plain exp on kept positions
+        return jnp.where(m, jnp.exp(x), 0.0).astype(data.dtype)
+    neg = jnp.finfo(jnp.float32).min
+    out = jax.nn.softmax(jnp.where(m, x.astype(jnp.float32), neg),
+                         axis=axis)
+    # a fully-masked row softmaxes the uniform min -> uniform probs;
+    # zero them like the reference kernel does
+    out = jnp.where(m, out, 0.0)
+    return out.astype(data.dtype)
+
+
+@register("masked_log_softmax")
+def masked_log_softmax(data, mask, *, axis=-1, temperature=1.0):
+    """log of masked_softmax; masked positions are -inf (reference:
+    masked_softmax.cc::MaskedSoftmaxGrad's paired log variant)."""
+    m = mask.astype(bool)
+    x = data if temperature in (None, 1.0) else data / temperature
+    neg = jnp.finfo(jnp.float32).min
+    out = jax.nn.log_softmax(jnp.where(m, x.astype(jnp.float32), neg),
+                             axis=axis)
+    out = jnp.where(m, out, -jnp.inf)
+    return out.astype(data.dtype)
+
+
 def _make_softmax_output(grad_scale, ignore_label, use_ignore, smooth_alpha,
                          normalization):
     """Fused softmax + cross-entropy-gradient head. The backward IGNORES the

@@ -512,6 +512,75 @@ def linalg_syrk(A, *, transpose=False, alpha=1.0):
     return alpha * jnp.matmul(a, jnp.swapaxes(a, -1, -2))
 
 
+@register("_linalg_gelqf", aliases=["linalg_gelqf"], num_outputs=2)
+def linalg_gelqf(A):
+    """LQ factorization A = L·Q with Q row-orthonormal (reference:
+    la_op.cc::gelqf — LAPACK *gelqf/*orglq). Computed as the transpose of
+    jnp's QR: A^T = Q'R'  =>  A = R'^T Q'^T = L Q."""
+    q, r = jnp.linalg.qr(jnp.swapaxes(A, -1, -2), mode="reduced")
+    return jnp.swapaxes(r, -1, -2), jnp.swapaxes(q, -1, -2)
+
+
+@register("_linalg_syevd", aliases=["linalg_syevd"], num_outputs=2)
+def linalg_syevd(A):
+    """Symmetric eigendecomposition A = U^T·diag(L)·U (reference:
+    la_op.cc::syevd — rows of the returned U are the eigenvectors, so
+    U @ A @ U^T = diag(L))."""
+    w, v = jnp.linalg.eigh(A)
+    return jnp.swapaxes(v, -1, -2), w
+
+
+@register("_linalg_potri", aliases=["linalg_potri"])
+def linalg_potri(A):
+    """Inverse from a Cholesky factor: (A·A^T)^-1 given lower-triangular A
+    (reference: la_op.cc::potri)."""
+    eye = jnp.broadcast_to(jnp.eye(A.shape[-1], dtype=A.dtype), A.shape)
+    inv_a = jax.scipy.linalg.solve_triangular(A, eye, lower=True)
+    return jnp.matmul(jnp.swapaxes(inv_a, -1, -2), inv_a)
+
+
+@register("_linalg_trmm", aliases=["linalg_trmm"])
+def linalg_trmm(A, B, *, transpose=False, rightside=False, lower=True,
+                alpha=1.0):
+    """Triangular matrix multiply (reference: la_op.cc::trmm)."""
+    tri = jnp.tril(A) if lower else jnp.triu(A)
+    a = jnp.swapaxes(tri, -1, -2) if transpose else tri
+    return alpha * (jnp.matmul(B, a) if rightside else jnp.matmul(a, B))
+
+
+@register("_linalg_sumlogdiag", aliases=["linalg_sumlogdiag"])
+def linalg_sumlogdiag(A):
+    """Sum of log of the diagonal (reference: la_op.cc::sumlogdiag)."""
+    return jnp.sum(jnp.log(jnp.diagonal(A, axis1=-2, axis2=-1)), axis=-1)
+
+
+@register("_linalg_extractdiag", aliases=["linalg_extractdiag"])
+def linalg_extractdiag(A, *, offset=0):
+    return jnp.diagonal(A, offset=offset, axis1=-2, axis2=-1)
+
+
+@register("_linalg_makediag", aliases=["linalg_makediag"])
+def linalg_makediag(A, *, offset=0):
+    return jnp.vectorize(lambda v: jnp.diag(v, k=offset),
+                         signature="(n)->(m,m)")(A)
+
+
+@register("_linalg_inverse", aliases=["linalg_inverse"])
+def linalg_inverse(A):
+    return jnp.linalg.inv(A)
+
+
+@register("_linalg_det", aliases=["linalg_det"])
+def linalg_det(A):
+    return jnp.linalg.det(A)
+
+
+@register("_linalg_slogdet", aliases=["linalg_slogdet"], num_outputs=2)
+def linalg_slogdet(A):
+    sign, logabsdet = jnp.linalg.slogdet(A)
+    return sign, logabsdet
+
+
 # ---------------------------------------------------------------------------
 # init ops (reference: src/operator/tensor/init_op.cc)
 # ---------------------------------------------------------------------------

@@ -275,3 +275,73 @@ def test_iter_len():
     assert len(x) == 3
     rows = [r.asnumpy() for r in x]
     assert len(rows) == 3 and np.allclose(rows[2], [5, 6])
+
+
+class TestLinalgTail:
+    """Round-4 linalg long tail (reference: la_op.cc gelqf/syevd/potri/
+    trmm/sumlogdiag/... kernels) vs the numpy oracle."""
+
+    def _spd(self, n=4, seed=0):
+        rs = np.random.RandomState(seed)
+        m = rs.randn(n, n).astype("float32")
+        return m @ m.T + n * np.eye(n, dtype="float32")
+
+    def test_gelqf_reconstructs(self):
+        rs = np.random.RandomState(1)
+        a = rs.randn(3, 5).astype("float32")
+        L, Q = mx.nd.linalg_gelqf(mx.nd.array(a))
+        l, q = L.asnumpy(), Q.asnumpy()
+        np.testing.assert_allclose(l @ q, a, rtol=1e-4, atol=1e-5)
+        np.testing.assert_allclose(q @ q.T, np.eye(3), rtol=1e-4,
+                                    atol=1e-5)
+        assert np.allclose(l, np.tril(l), atol=1e-5)
+
+    def test_syevd_reconstructs(self):
+        a = self._spd()
+        U, L = mx.nd.linalg_syevd(mx.nd.array(a))
+        u, lam = U.asnumpy(), L.asnumpy()
+        np.testing.assert_allclose(u.T @ np.diag(lam) @ u, a, rtol=1e-3,
+                                    atol=1e-3)
+        np.testing.assert_allclose(u @ a @ u.T, np.diag(lam), rtol=1e-3,
+                                    atol=1e-3)
+
+    def test_potri_matches_inverse(self):
+        a = self._spd(seed=2)
+        chol = np.linalg.cholesky(a).astype("float32")
+        got = mx.nd.linalg_potri(mx.nd.array(chol)).asnumpy()
+        np.testing.assert_allclose(got, np.linalg.inv(a), rtol=1e-2,
+                                    atol=1e-3)
+
+    def test_trmm_sumlogdiag_diag_ops(self):
+        rs = np.random.RandomState(3)
+        a = np.tril(rs.randn(4, 4)).astype("float32")
+        b = rs.randn(4, 4).astype("float32")
+        np.testing.assert_allclose(
+            mx.nd.linalg_trmm(mx.nd.array(a), mx.nd.array(b),
+                              alpha=2.0).asnumpy(),
+            2.0 * a @ b, rtol=1e-5)
+        spd = self._spd(seed=4)
+        chol = np.linalg.cholesky(spd).astype("float32")
+        np.testing.assert_allclose(
+            float(mx.nd.linalg_sumlogdiag(mx.nd.array(chol)).asnumpy()),
+            np.log(np.diag(chol)).sum(), rtol=1e-5)
+        np.testing.assert_allclose(
+            mx.nd.linalg_extractdiag(mx.nd.array(b)).asnumpy(),
+            np.diag(b), rtol=1e-6)
+        v = rs.randn(4).astype("float32")
+        np.testing.assert_allclose(
+            mx.nd.linalg_makediag(mx.nd.array(v)).asnumpy(), np.diag(v),
+            rtol=1e-6)
+
+    def test_det_inverse_slogdet(self):
+        a = self._spd(seed=5)
+        np.testing.assert_allclose(
+            float(mx.nd.linalg_det(mx.nd.array(a)).asnumpy()),
+            np.linalg.det(a), rtol=1e-3)
+        np.testing.assert_allclose(
+            mx.nd.linalg_inverse(mx.nd.array(a)).asnumpy(),
+            np.linalg.inv(a), rtol=1e-2, atol=1e-4)
+        sign, logdet = mx.nd.linalg_slogdet(mx.nd.array(a))
+        ws, wl = np.linalg.slogdet(a)
+        assert float(sign.asnumpy()) == ws
+        np.testing.assert_allclose(float(logdet.asnumpy()), wl, rtol=1e-4)

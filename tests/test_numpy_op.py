@@ -266,3 +266,148 @@ class TestReviewFindings:
         onp.testing.assert_allclose(elu, [onp.expm1(-1.0), 1.0], rtol=1e-5)
         with pytest.raises(mx.MXNetError, match="act_type"):
             npx.leaky_relu(x, act_type="bogus")
+
+
+class TestNpBreadth:
+    """Round-4 np_* long tail: spot-sweep the delegated/host/alias surface
+    against the NumPy oracle."""
+
+    def _a(self, shape=(3, 4), seed=0):
+        rs = onp.random.RandomState(seed)
+        return rs.randn(*shape).astype("float32")
+
+    def test_delegated_unary_sweep(self):
+        x = self._a()
+        for name in ["fabs", "fix", "positive", "signbit", "sinc",
+                     "nan_to_num", "deg2rad", "rad2deg", "exp2", "real",
+                     "conj", "fliplr", "flipud", "ravel", "ptp",
+                     "cumprod", "around"]:
+            got = getattr(np, name)(np.array(x))
+            want = getattr(onp, name)(x)
+            onp.testing.assert_allclose(got.asnumpy(), want, rtol=1e-5,
+                                        atol=1e-6, err_msg=name)
+
+    def test_delegated_binary_sweep(self):
+        a, b = self._a(seed=1), self._a(seed=2)
+        for name in ["fmax", "fmin", "logaddexp", "heaviside",
+                     "copysign", "float_power"]:
+            got = getattr(np, name)(np.array(a), np.array(b))
+            want = getattr(onp, name)(a, b)
+            onp.testing.assert_allclose(got.asnumpy(), want, rtol=1e-4,
+                                        atol=1e-5, err_msg=name)
+
+    def test_reductions_and_stats(self):
+        x = self._a((5, 6), seed=3)
+        x[0, 0] = onp.nan
+        for name in ["nanmax", "nanmin", "nansum", "nanmean", "nanstd"]:
+            got = getattr(np, name)(np.array(x))
+            want = getattr(onp, name)(x)
+            onp.testing.assert_allclose(float(got.asnumpy()), want,
+                                        rtol=1e-5, err_msg=name)
+        onp.testing.assert_allclose(
+            np.percentile(np.array(self._a()), 40).asnumpy(),
+            onp.percentile(self._a(), 40), rtol=1e-5)
+        onp.testing.assert_allclose(
+            np.average(np.array(self._a()), axis=0).asnumpy(),
+            onp.average(self._a(), axis=0), rtol=1e-5)
+
+    def test_shape_and_indexing(self):
+        x = self._a((4, 4), seed=4)
+        onp.testing.assert_allclose(np.tril(np.array(x)).asnumpy(),
+                                    onp.tril(x))
+        onp.testing.assert_allclose(np.rot90(np.array(x)).asnumpy(),
+                                    onp.rot90(x))
+        onp.testing.assert_allclose(np.trace(np.array(x)).asnumpy(),
+                                    onp.trace(x), rtol=1e-6)
+        onp.testing.assert_allclose(
+            np.diff(np.array(x), axis=1).asnumpy(), onp.diff(x, axis=1),
+            rtol=1e-6)
+        r, c = np.tril_indices(4)
+        wr, wc = onp.tril_indices(4)
+        onp.testing.assert_array_equal(r.asnumpy(), wr)
+        onp.testing.assert_array_equal(c.asnumpy(), wc)
+        parts = np.hsplit(np.array(x), 2)
+        assert len(parts) == 2 and parts[0].shape == (4, 2)
+
+    def test_host_fallbacks_dynamic_shapes(self):
+        x = onp.array([[0.0, 1.0], [2.0, 0.0]], "float32")
+        nz = np.nonzero(np.array(x))
+        wr = onp.nonzero(x)
+        for g, w in zip(nz, wr):
+            onp.testing.assert_array_equal(g.asnumpy(), w)
+        onp.testing.assert_array_equal(
+            np.union1d(np.array([1, 2]), np.array([2, 3])).asnumpy(),
+            [1, 2, 3])
+        onp.testing.assert_array_equal(
+            np.intersect1d(np.array([1, 2, 3]),
+                           np.array([2, 3, 4])).asnumpy(), [2, 3])
+
+    def test_aliases_and_meta(self):
+        x = np.array(self._a())
+        onp.testing.assert_allclose(np.acos(np.clip(x, -1, 1)).asnumpy(),
+                                    onp.arccos(onp.clip(self._a(), -1, 1)),
+                                    rtol=1e-5)
+        onp.testing.assert_allclose(np.concat([x, x]).asnumpy(),
+                                    onp.concatenate([self._a()] * 2),
+                                    rtol=1e-6)
+        assert np.finfo(np.float32).eps == onp.finfo(onp.float32).eps
+        assert np.result_type(np.float32, np.int32) == \
+            onp.result_type(onp.float32, onp.int32)
+        assert np.isscalar(3.0) and not np.isscalar([3.0])
+        assert np.size(x) == 12 and np.size(x, 1) == 4
+
+    def test_histogram_and_poly(self):
+        x = self._a((50,), seed=5)
+        gh, ge = np.histogram(np.array(x), bins=7)
+        wh, we = onp.histogram(x, bins=7)
+        onp.testing.assert_array_equal(gh.asnumpy(), wh)
+        onp.testing.assert_allclose(ge.asnumpy(), we, rtol=1e-5)
+        c = onp.array([1.0, -2.0, 1.0], "float32")
+        onp.testing.assert_allclose(
+            np.polyval(np.array(c), np.array([0.0, 1.0, 2.0])).asnumpy(),
+            onp.polyval(c, onp.array([0.0, 1.0, 2.0], "float32")),
+            rtol=1e-5)
+
+    def test_delegated_ops_are_tape_aware(self):
+        import mxnet_tpu as mx
+
+        x = np.array(self._a())
+        x.attach_grad()
+        with mx.autograd.record():
+            y = np.fliplr(x) * 2.0
+            s = y.sum()
+        s.backward()
+        onp.testing.assert_allclose(x.grad.asnumpy(),
+                                    onp.full((3, 4), 2.0), rtol=1e-6)
+
+
+class TestMaskedSoftmax:
+    def test_masked_softmax_matches_manual(self):
+        import mxnet_tpu as mx
+
+        rs = onp.random.RandomState(0)
+        x = rs.randn(2, 5).astype("float32")
+        m = onp.array([[1, 1, 0, 1, 0], [0, 0, 0, 0, 0]], bool)
+        got = mx.nd.masked_softmax(mx.nd.array(x),
+                                   mx.nd.array(m.astype("float32")))
+        g = got.asnumpy()
+        row = onp.exp(x[0][m[0]])
+        row = row / row.sum()
+        onp.testing.assert_allclose(g[0][m[0]], row, rtol=1e-5)
+        assert (g[0][~m[0]] == 0).all()
+        assert (g[1] == 0).all()  # fully-masked row -> zeros, not NaN
+
+    def test_masked_log_softmax(self):
+        import mxnet_tpu as mx
+
+        rs = onp.random.RandomState(1)
+        x = rs.randn(3, 4).astype("float32")
+        m = onp.ones((3, 4), bool)
+        m[1, 2:] = False
+        got = mx.nd.masked_log_softmax(mx.nd.array(x),
+                                       mx.nd.array(m.astype("float32")))
+        ref = mx.nd.masked_softmax(mx.nd.array(x),
+                                   mx.nd.array(m.astype("float32")))
+        g, r = got.asnumpy(), ref.asnumpy()
+        onp.testing.assert_allclose(onp.exp(g[m]), r[m], rtol=1e-5)
+        assert onp.isneginf(g[~m]).all()

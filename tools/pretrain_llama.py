@@ -167,9 +167,11 @@ def main(argv=None):
     # steady-window boundary, wall-time the remaining steps as one span
     # ending in a fetch — the same synced-span method bench.py uses.
     times = []
-    sync_at = max(2, args.steps // 2)
+    sync_at = min(max(2, args.steps // 2), max(args.steps - 1, 1))
     t_span = None
+    span_dt = None
     span_steps = 0
+    save_s = 0.0  # checkpoint-write time inside the span, excluded below
     for i in range(2, args.steps + 1):
         if args.data != "synthetic":
             tokens, labels = next(data_iter)
@@ -183,12 +185,15 @@ def main(argv=None):
         if i > sync_at:
             span_steps += 1
         if i == args.steps and t_span is not None:
-            # span ends HERE, at the final fetch — checkpoint saves below
-            # must not leak into the throughput denominator
-            span_dt = time.time() - t_span
+            # span ends HERE, at the final fetch — checkpoint saves must
+            # not leak into the throughput denominator
+            span_dt = time.time() - t_span - save_s
         times.append(time.time() - t0)
         if args.save_dir and i % args.save_every == 0:
+            t_save = time.time()
             _save(net, step, args.save_dir, i)
+            if t_span is not None and i < args.steps:
+                save_s += time.time() - t_save
         if i == args.steps or i % 20 == 0:
             tok_s = batch * seq / (sum(times[-10:]) / len(times[-10:]))
             print(f"step {i}: loss {loss_val:.4f} tokens/s {tok_s:.0f} "
@@ -198,10 +203,10 @@ def main(argv=None):
         _save(net, step, args.save_dir, args.steps)
 
     peak = device_peak_flops()
-    if t_span is not None and span_steps > 0:
+    if span_dt is not None and span_steps > 0:
         tok_s = batch * seq * span_steps / span_dt
-    else:  # --steps 1: only the compile step ran
-        tok_s = batch * seq / (time.time() - t0)
+    else:  # --steps 1: only the compile step ran; t0 is its dispatch
+        tok_s = batch * seq / max(time.time() - t0, 1e-9)
     mfu = 6.0 * n_params * tok_s / peak if peak else None
     print(json.dumps({
         "config": args.config, "params": n_params, "tokens_per_sec":
