@@ -244,29 +244,37 @@ class DataLoader:
         for _ in range(self._prefetch or 1):
             if not submit():
                 break
+        current = [None]  # the popped-but-unconsumed result, for cleanup
         try:
             while not pending.empty():
                 res = pending.get()
+                current[0] = res
                 samples = res.get(self._timeout)
+                current[0] = None
                 submit()
                 if shm_mode:
                     batch = _from_shm(samples)  # stacked in the worker
-                    if isinstance(batch, list) and len(batch) == 1:
-                        batch = batch[0]
+                    # structure matches default_batchify_fn exactly: a
+                    # tuple sample (ANY arity) -> list of arrays, a bare
+                    # array sample -> one array
                     if self._pin_memory:
                         batch = _pin(batch)
                     yield batch
                 else:
                     yield self._batchify(samples)
         finally:
-            # early break / generator close / worker error: the workers
-            # unregistered their blocks from the resource tracker, so the
-            # parent must unlink every prefetched-but-unconsumed batch or
+            # early break / generator close / worker error / timeout: the
+            # workers unregistered their blocks from the resource tracker,
+            # so the parent must unlink every prefetched-but-unconsumed
+            # batch (including the one whose get() just failed) or
             # /dev/shm fills across runs
             if shm_mode:
+                leftovers = [current[0]] if current[0] is not None else []
                 while not pending.empty():
+                    leftovers.append(pending.get())
+                for res in leftovers:
                     try:
-                        _unlink_shm(pending.get().get(self._timeout))
+                        _unlink_shm(res.get(self._timeout))
                     except Exception:
                         pass
 

@@ -854,20 +854,38 @@ def _np_delegate(jname):
     def fn(*args, out=None, **kwargs):
         jnp = _jnp()
         jf = getattr(jnp, jname)
-        # ANY NDArray operand — positional or keyword — must ride the
-        # tape-aware invoke path, or autograd through it silently drops
-        tpos = [i for i, a in enumerate(args) if isinstance(a, NDArray)]
-        tkeys = [k for k, v in kwargs.items() if isinstance(v, NDArray)]
-        tensors = [args[i] for i in tpos] + [kwargs[k] for k in tkeys]
+        # ANY NDArray operand — positional, keyword, or one level inside
+        # a positional list/tuple (select/column_stack/block/choose take
+        # sequences) — must ride the tape-aware invoke path, or autograd
+        # through it silently drops (or jnp rejects the NDArray outright)
+        tensors = []
+        slots = []  # ("arg", i) | ("kw", k) | ("seq", i, j)
+        for i, a in enumerate(args):
+            if isinstance(a, NDArray):
+                slots.append(("arg", i))
+                tensors.append(a)
+            elif isinstance(a, (list, tuple)):
+                for j, el in enumerate(a):
+                    if isinstance(el, NDArray):
+                        slots.append(("seq", i, j))
+                        tensors.append(el)
+        for k, v in kwargs.items():
+            if isinstance(v, NDArray):
+                slots.append(("kw", k))
+                tensors.append(v)
         static = list(args)
 
         def run(*ds):
-            call = list(static)
+            call = [list(a) if isinstance(a, (list, tuple)) else a
+                    for a in static]
             kw = dict(kwargs)
-            for i, d in zip(tpos, ds):
-                call[i] = d
-            for k, d in zip(tkeys, ds[len(tpos):]):
-                kw[k] = d
+            for slot, d in zip(slots, ds):
+                if slot[0] == "arg":
+                    call[slot[1]] = d
+                elif slot[0] == "seq":
+                    call[slot[1]][slot[2]] = d
+                else:
+                    kw[slot[1]] = d
             res = jf(*call, **kw)
             # imperative_invoke multi-output handling covers tuple AND
             # list results, so no conversion is needed here

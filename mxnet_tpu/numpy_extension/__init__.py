@@ -226,6 +226,95 @@ def arange_like(data, start=0.0, step=1.0, axis=None):
     return _invoke("npx_arange_like", body, [data])
 
 
+# op-backed npx functions (reference: mx.npx.* wrappers over the same
+# C-registered kernels the symbol/nd frontends use — here the shared op
+# registry). Round 4: the set gluon-numpy models and upstream scripts
+# actually call.
+def _op_call(opname, tensors, attrs):
+    from ..ndarray.ndarray import imperative_invoke
+    from ..ops.registry import get_op
+
+    return _np_wrap(imperative_invoke(
+        get_op(opname), list(tensors),
+        {k: v for k, v in attrs.items() if v is not None}))
+
+
+def activation(data, act_type="relu", **kwargs):
+    return _op_call("Activation", [data], {"act_type": act_type})
+
+
+def fully_connected(x, weight, bias=None, num_hidden=None, no_bias=False,
+                    flatten=True, **kwargs):
+    return _op_call("FullyConnected", [x, weight, bias],
+                    {"num_hidden": num_hidden or weight.shape[0],
+                     "no_bias": bias is None or no_bias,
+                     "flatten": flatten})
+
+
+def convolution(data=None, weight=None, bias=None, kernel=None, stride=None,
+                dilate=None, pad=None, num_filter=1, num_group=1,
+                no_bias=False, layout=None, **kwargs):
+    return _op_call("Convolution", [data, weight, bias],
+                    {"kernel": kernel, "stride": stride, "dilate": dilate,
+                     "pad": pad, "num_filter": num_filter,
+                     "num_group": num_group,
+                     "no_bias": bias is None or no_bias, "layout": layout})
+
+
+def pooling(data, kernel=None, stride=None, pad=None, pool_type="max",
+            global_pool=False, pooling_convention="valid", layout=None,
+            **kwargs):
+    return _op_call("Pooling", [data],
+                    {"kernel": kernel, "stride": stride, "pad": pad,
+                     "pool_type": pool_type, "global_pool": global_pool,
+                     "pooling_convention": pooling_convention,
+                     "layout": layout})
+
+
+def batch_norm(x, gamma, beta, running_mean, running_var, eps=1e-5,
+               momentum=0.9, axis=1, use_global_stats=False,
+               fix_gamma=False, **kwargs):
+    return _op_call("BatchNorm", [x, gamma, beta, running_mean,
+                                  running_var],
+                    {"eps": eps, "momentum": momentum, "axis": axis,
+                     "use_global_stats": use_global_stats,
+                     "fix_gamma": fix_gamma})
+
+
+def layer_norm(data, gamma, beta, axis=-1, eps=1e-5, **kwargs):
+    return _op_call("LayerNorm", [data, gamma, beta],
+                    {"axis": axis, "eps": eps})
+
+
+def dropout(data, p=0.5, axes=(), **kwargs):
+    return _op_call("Dropout", [data], {"p": p, "axes": tuple(axes)})
+
+
+def embedding(data, weight, input_dim=None, output_dim=None,
+              dtype="float32", sparse_grad=False, **kwargs):
+    return _op_call("Embedding", [data, weight],
+                    {"input_dim": input_dim or weight.shape[0],
+                     "output_dim": output_dim or weight.shape[1],
+                     "dtype": dtype})
+
+
+def smooth_l1(data, scalar=1.0, **kwargs):
+    return _op_call("smooth_l1", [data], {"scalar": scalar})
+
+
+def rnn(data=None, parameters=None, state=None, state_cell=None, mode=None,
+        state_size=None, num_layers=1, bidirectional=False, p=0.0,
+        state_outputs=False, **kwargs):
+    tensors = [data, parameters, state]
+    if state_cell is not None:
+        tensors.append(state_cell)
+    return _op_call("RNN", tensors,
+                    {"mode": mode, "state_size": state_size,
+                     "num_layers": num_layers,
+                     "bidirectional": bidirectional, "p": p,
+                     "state_outputs": state_outputs})
+
+
 # waitall/load/save mirrors (reference exposes them in npx too)
 def waitall():
     from ..ndarray import waitall as _w

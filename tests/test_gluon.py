@@ -354,3 +354,22 @@ def test_dataloader_shm_process_workers(monkeypatch):
     got2 = run()
     for (gd, _), (wd, _) in zip(got2, want):
         np.testing.assert_allclose(gd, wd)
+
+
+def test_dataloader_shm_structure_matches_inprocess(monkeypatch):
+    """Review r4: batch STRUCTURE must be identical across transports,
+    including 1-tuple samples."""
+    monkeypatch.setenv("MXNET_TPU_FORK_WORKERS", "1")
+
+    class OneTuple(gluon.data.Dataset):
+        def __len__(self):
+            return 8
+
+        def __getitem__(self, i):
+            return (np.full((3,), float(i), "float32"),)
+
+    ds = OneTuple()
+    ref = list(gluon.data.DataLoader(ds, batch_size=4))
+    got = list(gluon.data.DataLoader(ds, batch_size=4, num_workers=2))
+    assert type(ref[0]) is type(got[0]) and len(ref[0]) == len(got[0]) == 1
+    np.testing.assert_allclose(got[0][0].asnumpy(), ref[0][0].asnumpy())

@@ -411,3 +411,69 @@ class TestMaskedSoftmax:
         g, r = got.asnumpy(), ref.asnumpy()
         onp.testing.assert_allclose(onp.exp(g[m]), r[m], rtol=1e-5)
         assert onp.isneginf(g[~m]).all()
+
+
+class TestNpxOps:
+    """Round-4 npx op-backed surface (reference: mx.npx.* wrappers)."""
+
+    def test_fully_connected_and_activation(self):
+        rs = onp.random.RandomState(0)
+        x = np.array(rs.randn(4, 8).astype("float32"))
+        w = np.array(rs.randn(3, 8).astype("float32"))
+        b = np.array(rs.randn(3).astype("float32"))
+        out = npx.fully_connected(x, w, b, num_hidden=3)
+        want = x.asnumpy() @ w.asnumpy().T + b.asnumpy()
+        onp.testing.assert_allclose(out.asnumpy(), want, rtol=1e-5)
+        r = npx.activation(np.array([[-1.0, 2.0]]), act_type="relu")
+        onp.testing.assert_allclose(r.asnumpy(), [[0.0, 2.0]])
+
+    def test_convolution_pooling(self):
+        rs = onp.random.RandomState(1)
+        x = np.array(rs.randn(1, 2, 6, 6).astype("float32"))
+        w = np.array(rs.randn(3, 2, 3, 3).astype("float32"))
+        out = npx.convolution(data=x, weight=w, kernel=(3, 3), num_filter=3)
+        assert out.shape == (1, 3, 4, 4)
+        p = npx.pooling(out, kernel=(2, 2), stride=(2, 2))
+        assert p.shape == (1, 3, 2, 2)
+
+    def test_layer_norm_and_embedding(self):
+        rs = onp.random.RandomState(2)
+        x = np.array(rs.randn(2, 5).astype("float32"))
+        g = np.array(onp.ones(5, "float32"))
+        b = np.array(onp.zeros(5, "float32"))
+        ln = npx.layer_norm(x, g, b).asnumpy()
+        xm = x.asnumpy() - x.asnumpy().mean(-1, keepdims=True)
+        want = xm / onp.sqrt((xm ** 2).mean(-1, keepdims=True) + 1e-5)
+        onp.testing.assert_allclose(ln, want, rtol=1e-4, atol=1e-5)
+        wt = np.array(rs.randn(10, 4).astype("float32"))
+        idx = np.array(onp.array([1, 3], "int32"))
+        emb = npx.embedding(idx, wt)
+        onp.testing.assert_allclose(emb.asnumpy(),
+                                    wt.asnumpy()[[1, 3]], rtol=1e-6)
+
+    def test_smooth_l1_and_dropout_eval(self):
+        x = np.array(onp.array([-2.0, 0.25, 2.0], "float32"))
+        s = npx.smooth_l1(x, scalar=1.0).asnumpy()
+        onp.testing.assert_allclose(s, [1.5, 0.03125, 1.5], rtol=1e-5)
+        d = npx.dropout(x, p=0.5)  # not training: identity
+        onp.testing.assert_allclose(d.asnumpy(), x.asnumpy())
+
+
+def test_delegated_sequence_args_stay_on_tape():
+    """Review r4: NDArrays nested one level inside sequence args (select,
+    column_stack...) must be traced operands, not host-coerced constants."""
+    rs = onp.random.RandomState(0)
+    a = np.array(rs.randn(4).astype("float32"))
+    b = np.array(rs.randn(4).astype("float32"))
+    a.attach_grad()
+    with autograd.record():
+        y = np.column_stack((a, b))
+        s = (y * y).sum()
+    s.backward()
+    onp.testing.assert_allclose(a.grad.asnumpy(), 2 * a.asnumpy(),
+                                rtol=1e-5)
+    cond = np.array(onp.array([True, False, True, False]))
+    out = np.select([cond], [a], 0.0)
+    want = onp.where(onp.array([True, False, True, False]),
+                     a.asnumpy(), 0.0)
+    onp.testing.assert_allclose(out.asnumpy(), want, rtol=1e-6)
