@@ -273,8 +273,12 @@ class DataLoader:
                 while not pending.empty():
                     leftovers.append(pending.get())
                 for res in leftovers:
+                    # short re-wait only: a result whose get() already
+                    # timed out will not become ready now, and re-waiting
+                    # the full timeout per leftover would stall generator
+                    # teardown by minutes on a single stuck worker
                     try:
-                        _unlink_shm(res.get(self._timeout))
+                        _unlink_shm(res.get(1.0))
                     except Exception:
                         pass
 

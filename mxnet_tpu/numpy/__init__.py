@@ -854,12 +854,13 @@ def _np_delegate(jname):
     def fn(*args, out=None, **kwargs):
         jnp = _jnp()
         jf = getattr(jnp, jname)
-        # ANY NDArray operand — positional, keyword, or one level inside
-        # a positional list/tuple (select/column_stack/block/choose take
-        # sequences) — must ride the tape-aware invoke path, or autograd
-        # through it silently drops (or jnp rejects the NDArray outright)
+        # ANY NDArray operand — positional, keyword, or up to two levels
+        # inside a positional list/tuple (select/column_stack/choose take
+        # flat sequences; np.block takes nested [[A, B], [C, D]]) — must
+        # ride the tape-aware invoke path, or autograd through it silently
+        # drops (or jnp rejects the NDArray outright)
         tensors = []
-        slots = []  # ("arg", i) | ("kw", k) | ("seq", i, j)
+        slots = []  # ("arg", i) | ("kw", k) | ("seq", i, j) | ("seq2", i, j, k)
         for i, a in enumerate(args):
             if isinstance(a, NDArray):
                 slots.append(("arg", i))
@@ -869,6 +870,11 @@ def _np_delegate(jname):
                     if isinstance(el, NDArray):
                         slots.append(("seq", i, j))
                         tensors.append(el)
+                    elif isinstance(el, (list, tuple)):
+                        for k2, el2 in enumerate(el):
+                            if isinstance(el2, NDArray):
+                                slots.append(("seq2", i, j, k2))
+                                tensors.append(el2)
         for k, v in kwargs.items():
             if isinstance(v, NDArray):
                 slots.append(("kw", k))
@@ -876,7 +882,8 @@ def _np_delegate(jname):
         static = list(args)
 
         def run(*ds):
-            call = [list(a) if isinstance(a, (list, tuple)) else a
+            call = [[list(el) if isinstance(el, (list, tuple)) else el
+                     for el in a] if isinstance(a, (list, tuple)) else a
                     for a in static]
             kw = dict(kwargs)
             for slot, d in zip(slots, ds):
@@ -884,6 +891,8 @@ def _np_delegate(jname):
                     call[slot[1]] = d
                 elif slot[0] == "seq":
                     call[slot[1]][slot[2]] = d
+                elif slot[0] == "seq2":
+                    call[slot[1]][slot[2]][slot[3]] = d
                 else:
                     kw[slot[1]] = d
             res = jf(*call, **kw)

@@ -271,9 +271,12 @@ def pooling(data, kernel=None, stride=None, pad=None, pool_type="max",
                      "layout": layout})
 
 
-def batch_norm(x, gamma, beta, running_mean, running_var, eps=1e-5,
+def batch_norm(x, gamma, beta, running_mean, running_var, eps=1e-3,
                momentum=0.9, axis=1, use_global_stats=False,
-               fix_gamma=False, **kwargs):
+               fix_gamma=True, **kwargs):
+    # defaults mirror the BatchNorm op (reference batch_norm-inl.h
+    # DMLC_DECLARE_FIELD: eps 1e-3, fix_gamma true) so ported npx scripts
+    # see identical semantics
     return _op_call("BatchNorm", [x, gamma, beta, running_mean,
                                   running_var],
                     {"eps": eps, "momentum": momentum, "axis": axis,
@@ -305,6 +308,10 @@ def smooth_l1(data, scalar=1.0, **kwargs):
 def rnn(data=None, parameters=None, state=None, state_cell=None, mode=None,
         state_size=None, num_layers=1, bidirectional=False, p=0.0,
         state_outputs=False, **kwargs):
+    if mode is None:
+        raise ValueError(
+            "npx.rnn: 'mode' is required (one of 'rnn_relu', 'rnn_tanh', "
+            "'lstm', 'gru') — the RNN op has no default cell type")
     tensors = [data, parameters, state]
     if state_cell is not None:
         tensors.append(state_cell)

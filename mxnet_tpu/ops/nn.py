@@ -12,6 +12,7 @@ TPU mapping: Convolution/FullyConnected lower to ``lax.conv_general_dilated``
 """
 from __future__ import annotations
 
+from functools import partial as _partial
 from typing import Optional
 
 import jax
@@ -101,6 +102,45 @@ def convolution(data, weight, bias=None, *, kernel=(), stride=(), dilate=(),
     return out
 
 
+@jax.custom_vjp
+def _conv1x1_dot(x, w):
+    """Stride-1 1x1 NHWC conv as a dot_general, with dot-formulated VJPs.
+
+    x: (N, H, W, C), w: (O, C, 1, 1) [OIHW weight convention kept so
+    checkpoints stay layout-independent]. Forward contracts C; dX and dW
+    are the transposed contractions — all three run on the MXU as dots,
+    bypassing XLA:TPU's conv-backward algorithm selection (measured ~40%
+    of roofline on the same shapes inside ResNet-50; PERF.md round 4).
+    f32 accumulation, output cast back to the input dtype.
+    """
+    w2 = w.reshape(w.shape[0], w.shape[1]).astype(x.dtype)
+    out = jax.lax.dot_general(
+        x, w2, (((3,), (1,)), ((), ())),
+        preferred_element_type=jnp.float32)
+    return out.astype(x.dtype)
+
+
+def _conv1x1_dot_fwd(x, w):
+    return _conv1x1_dot(x, w), (x, w)
+
+
+def _conv1x1_dot_bwd(res, dy):
+    x, w = res
+    w2 = w.reshape(w.shape[0], w.shape[1]).astype(dy.dtype)
+    # dX[n,h,w,c] = sum_o dy[n,h,w,o] * W[o,c]
+    dx = jax.lax.dot_general(
+        dy, w2, (((3,), (0,)), ((), ())),
+        preferred_element_type=jnp.float32).astype(x.dtype)
+    # dW[o,c] = sum_{n,h,w} dy[n,h,w,o] * x[n,h,w,c]
+    dw = jax.lax.dot_general(
+        dy, x, (((0, 1, 2), (0, 1, 2)), ((), ())),
+        preferred_element_type=jnp.float32)
+    return dx, dw.reshape(w.shape).astype(w.dtype)
+
+
+_conv1x1_dot.defvjp(_conv1x1_dot_fwd, _conv1x1_dot_bwd)
+
+
 def _conv_core(data, weight, stride, pads, dilate, dnums, groups, layout,
                kernel):
     """conv_general_dilated, with a custom dW backward on eligible shapes.
@@ -134,6 +174,21 @@ def _conv_core(data, weight, stride, pads, dilate, dnums, groups, layout,
             # output breaks the conv transpose (VJP) rule's dtype
             # agreement.
         )
+
+    # Stride-1 1x1 channels-last convs ARE matmuls: formulate fwd/dW/dX as
+    # explicit dot_generals so XLA:TPU's matmul path (not its conv-backward
+    # algorithm selection) runs them. Round-4 trace: the 1x1 dX/dW conv
+    # formulations sat at ~40% of the matmul roofline inside the ResNet-50
+    # step (PERF.md round 4, conv-attribution table); a dot never enters
+    # conv algorithm selection at all.
+    if (tuple(kernel) == (1, 1) and tuple(stride) == (1, 1)
+            and groups == 1 and all(d == 1 for d in dilate)
+            and not isinstance(pads, str)
+            and all(tuple(p) == (0, 0) for p in pads)
+            and bool(layout) and layout.endswith("C")
+            and data.ndim == 4
+            and os.environ.get("MXNET_TPU_CONV1X1_DOT", "1") == "1"):
+        return _conv1x1_dot(data, weight)
 
     eligible = (len(kernel) == 2 and groups == 1
                 and all(d == 1 for d in dilate)
@@ -349,24 +404,102 @@ def batch_norm(data, gamma, beta, moving_mean, moving_var, *, eps=1e-3,
     (matching mx.nd.BatchNorm's single visible output).
     """
     axis = axis % data.ndim
-    reduce_axes = tuple(i for i in range(data.ndim) if i != axis)
     bshape = [1] * data.ndim
     bshape[axis] = data.shape[axis]
     g = jnp.ones_like(gamma) if fix_gamma else gamma
     use_batch_stats = _training and not use_global_stats
-    x32 = data.astype(jnp.float32)
     if use_batch_stats:
-        mean = jnp.mean(x32, axis=reduce_axes)
-        var = jnp.var(x32, axis=reduce_axes)
-    else:
-        mean, var = moving_mean.astype(jnp.float32), moving_var.astype(jnp.float32)
+        out, mean, var = _bn_train(axis, float(eps), data, g, beta)
+        return out, mean, var
+    reduce_axes = tuple(i for i in range(data.ndim) if i != axis)
+    x32 = data.astype(jnp.float32)
+    mean, var = moving_mean.astype(jnp.float32), moving_var.astype(jnp.float32)
     inv = jax.lax.rsqrt(var + eps)
-    out = (x32 - mean.reshape(bshape)) * inv.reshape(bshape)
-    out = out * g.astype(jnp.float32).reshape(bshape) + beta.astype(jnp.float32).reshape(bshape)
-    out = out.astype(data.dtype)
-    if use_batch_stats or output_mean_var:
+    scale = g.astype(jnp.float32) * inv
+    bias = beta.astype(jnp.float32) - mean * scale
+    out = (x32 * scale.reshape(bshape) + bias.reshape(bshape)).astype(data.dtype)
+    if output_mean_var:
         return out, mean, var
     return out
+
+
+def _bn_stats(x, axis, eps):
+    """Per-channel (mean, var, rsqrt(var+eps)).
+
+    Half-precision inputs use one-traversal moments (E[x^2]-E[x]^2, both
+    reduced in the same fused f32 loop): the f32 cancellation error,
+    ~1e-7*(mean/std)^2 relative, is subdominant to the input's own bf16
+    quantization until mean/std exceeds ~300. f32 inputs keep the exact
+    centered two-pass (jnp.var) — they carry no quantization floor to
+    hide behind, and the extra traversal only matters on the bf16 hot
+    path.
+    """
+    reduce_axes = tuple(i for i in range(x.ndim) if i != axis)
+    x32 = x.astype(jnp.float32)
+    mean = jnp.mean(x32, axis=reduce_axes)
+    if x.dtype == jnp.float32 or x.dtype == jnp.float64:
+        var = jnp.var(x32, axis=reduce_axes)
+    else:
+        sq = jnp.mean(x32 * x32, axis=reduce_axes)
+        var = jnp.maximum(sq - mean * mean, 0.0)
+    return mean, var, jax.lax.rsqrt(var + eps)
+
+
+@_partial(jax.custom_vjp, nondiff_argnums=(0, 1))
+def _bn_train(axis, eps, x, g, b):
+    """Training-mode batch norm with a hand-derived backward.
+
+    Autodiff through the statistics produces ~7 full-tensor reductions and
+    a dozen f32 elementwise chains per layer (round-4 ResNet trace: the
+    BN-backward arithmetic fused into the conv-dX fusions was the largest
+    single cost bucket). The classic two-reduction backward needs only
+    sum(dy) and sum(dy*xhat) — which are exactly dbeta and dgamma.
+
+    The (mean, var) outputs are statistics for the moving-average update
+    (MXNet aux states, reference: src/operator/nn/batch_norm.cc — aux
+    outputs carry no gradient); their cotangents are ignored.
+    """
+    out, mean, var, _ = _bn_train_math(axis, eps, x, g, b)
+    return out, mean, var
+
+
+def _bn_train_math(axis, eps, x, g, b):
+    bshape = [1] * x.ndim
+    bshape[axis] = x.shape[axis]
+    mean, var, inv = _bn_stats(x, axis, eps)
+    scale = g.astype(jnp.float32) * inv
+    bias = b.astype(jnp.float32) - mean * scale
+    out = (x.astype(jnp.float32) * scale.reshape(bshape)
+           + bias.reshape(bshape)).astype(x.dtype)
+    return out, mean, var, inv
+
+
+def _bn_train_fwd(axis, eps, x, g, b):
+    out, mean, var, inv = _bn_train_math(axis, eps, x, g, b)
+    return (out, mean, var), (x, g, b, mean, inv)
+
+
+def _bn_train_bwd(axis, eps, res, cots):
+    x, g, b, mean, inv = res
+    dy = cots[0]  # stats cotangents (aux moving-average path) are zero
+    reduce_axes = tuple(i for i in range(x.ndim) if i != axis)
+    bshape = [1] * x.ndim
+    bshape[axis] = x.shape[axis]
+    n = 1
+    for i in reduce_axes:
+        n *= x.shape[i]
+    dy32 = dy.astype(jnp.float32)
+    xhat = (x.astype(jnp.float32) - mean.reshape(bshape)) * inv.reshape(bshape)
+    dbeta = jnp.sum(dy32, axis=reduce_axes)
+    dgamma = jnp.sum(dy32 * xhat, axis=reduce_axes)
+    g32 = g.astype(jnp.float32)
+    dx = ((g32 * inv / n).reshape(bshape)
+          * (n * dy32 - dbeta.reshape(bshape) - xhat * dgamma.reshape(bshape))
+          ).astype(x.dtype)
+    return dx, dgamma.astype(g.dtype), dbeta.astype(b.dtype)
+
+
+_bn_train.defvjp(_bn_train_fwd, _bn_train_bwd)
 
 
 @register("LayerNorm", aliases=["layer_norm"])

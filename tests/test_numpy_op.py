@@ -477,3 +477,29 @@ def test_delegated_sequence_args_stay_on_tape():
     want = onp.where(onp.array([True, False, True, False]),
                      a.asnumpy(), 0.0)
     onp.testing.assert_allclose(out.asnumpy(), want, rtol=1e-6)
+
+
+def test_np_block_nested_grad_flows():
+    """np.block's canonical nested [[A, B], [C, D]] form keeps every
+    NDArray on the tape (two-level sequence lifting in _np_delegate)."""
+    import numpy as onp
+    from mxnet_tpu import autograd
+    a = mx.np.array([[1.0, 2.0]])
+    b = mx.np.array([[3.0, 4.0]])
+    c = mx.np.array([[5.0, 6.0]])
+    d = mx.np.array([[7.0, 8.0]])
+    for t in (a, b, c, d):
+        t.attach_grad()
+    with autograd.record():
+        y = mx.np.block([[a, b], [c, d]])
+        loss = (y * y).sum()
+    loss.backward()
+    for t in (a, b, c, d):
+        onp.testing.assert_allclose(t.grad.asnumpy(), 2 * t.asnumpy())
+
+
+def test_npx_rnn_mode_required():
+    import pytest
+    with pytest.raises(ValueError, match="mode"):
+        mx.npx.rnn(mx.np.ones((2, 1, 4)), mx.np.ones((100,)),
+                   mx.np.ones((1, 1, 8)), state_size=8)
