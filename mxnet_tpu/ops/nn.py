@@ -102,6 +102,85 @@ def convolution(data, weight, bias=None, *, kernel=(), stride=(), dilate=(),
     return out
 
 
+def _conv_s2d(x, w, kernel):
+    """Stride-2 large-kernel conv via space-to-depth re-indexing (exact).
+
+    out[ho] = sum_a x[2*ho + a - pad] * W[a] splits by input parity r:
+    a = 2*alpha + r + pad, so the same sum is a STRIDE-1 conv over the
+    s2d-packed input (phase r becomes a channel) with ceil-halved taps.
+    MXU win: contraction depth grows 4x (3->12 channels for the ResNet
+    stem, where C=3 left the systolic array ~85% idle; PERF.md round 4)
+    and the strided-dW backward formulation disappears — autodiff of this
+    composite IS the transformed backward.
+    """
+    n, h, w_, c = x.shape
+    o = w.shape[0]
+
+    def geom(k):
+        pad = (k - 1) // 2
+        alpha_lo = min(-((pad + r) // 2) for r in (0, 1))
+        alpha_hi = max((k - 1 - pad - r) // 2 for r in (0, 1))
+        taps = alpha_hi - alpha_lo + 1
+        lpad = -(2 * alpha_lo + pad)  # 0 or 1
+        return pad, alpha_lo, alpha_hi, taps, lpad
+
+    kh, kw = kernel
+    _, alo_h, ahi_h, th, lh = geom(kh)
+    _, alo_w, ahi_w, tw, lw = geom(kw)
+    wp = jnp.pad(w, ((0, 0), (0, 0), (lh, 2 * th - kh - lh),
+                     (lw, 2 * tw - kw - lw)))
+    w2 = wp.reshape(o, c, th, 2, tw, 2).transpose(0, 3, 5, 1, 2, 4)
+    w2 = w2.reshape(o, 4 * c, th, tw)
+    x2 = x.reshape(n, h // 2, 2, w_ // 2, 2, c).transpose(0, 1, 3, 2, 4, 5)
+    x2 = x2.reshape(n, h // 2, w_ // 2, 4 * c)
+    dn = jax.lax.conv_dimension_numbers(
+        x2.shape, w2.shape, ("NHWC", "OIHW", "NHWC"))
+    return jax.lax.conv_general_dilated(
+        x2, w2, (1, 1), [(-alo_h, ahi_h), (-alo_w, ahi_w)],
+        dimension_numbers=dn)
+
+
+@_partial(jax.custom_vjp, nondiff_argnums=(2,))
+def _conv1x1_strided_dot(x, w, stride):
+    """Stride-(sh,sw) 1x1 NHWC conv: strided slice + MXU dot.
+
+    dX zero-interleaves the small cotangent matmul back onto the input
+    grid by pad+reshape instead of XLA's lhs-dilated scatter-conv
+    (~2.5x its bandwidth floor on the ResNet downsample shapes).
+    """
+    sh, sw = stride
+    xs = x[:, ::sh, ::sw, :]
+    w2 = w.reshape(w.shape[0], w.shape[1]).astype(x.dtype)
+    out = jax.lax.dot_general(xs, w2, (((3,), (1,)), ((), ())),
+                              preferred_element_type=jnp.float32)
+    return out.astype(x.dtype)
+
+
+def _conv1x1_strided_fwd(x, w, stride):
+    return _conv1x1_strided_dot(x, w, stride), (x, w)
+
+
+def _conv1x1_strided_bwd(stride, res, dy):
+    x, w = res
+    sh, sw = stride
+    n, h, w_, c = x.shape
+    w2 = w.reshape(w.shape[0], w.shape[1]).astype(dy.dtype)
+    dxs = jax.lax.dot_general(dy, w2, (((3,), (0,)), ((), ())),
+                              preferred_element_type=jnp.float32
+                              ).astype(x.dtype)
+    # zero-interleave (N,Ho,Wo,C) -> (N,H,W,C): pad the phase dims
+    dx = jnp.pad(dxs[:, :, None, :, None, :],
+                 ((0, 0), (0, 0), (0, sh - 1), (0, 0), (0, sw - 1), (0, 0))
+                 ).reshape(n, h, w_, c)
+    xs = x[:, ::sh, ::sw, :]
+    dw = jax.lax.dot_general(dy, xs, (((0, 1, 2), (0, 1, 2)), ((), ())),
+                             preferred_element_type=jnp.float32)
+    return dx, dw.reshape(w.shape).astype(w.dtype)
+
+
+_conv1x1_strided_dot.defvjp(_conv1x1_strided_fwd, _conv1x1_strided_bwd)
+
+
 @jax.custom_vjp
 def _conv1x1_dot(x, w):
     """Stride-1 1x1 NHWC conv as a dot_general, with dot-formulated VJPs.
@@ -174,6 +253,46 @@ def _conv_core(data, weight, stride, pads, dilate, dnums, groups, layout,
             # output breaks the conv transpose (VJP) rule's dtype
             # agreement.
         )
+
+    # ResNet-stem-shaped convs (large kernel, stride 2, <=4 input channels)
+    # run the MXU at ~15% of roofline: contraction channels of 3 leave the
+    # systolic array idle, and the strided dW formulation is worse still.
+    # Space-to-depth is the exact re-indexing fix: s2d(2) the input
+    # (C -> 4C), zero-pad the kernel to even taps, and the same arithmetic
+    # becomes a stride-1 conv with 4x the contraction depth. Exact for
+    # fwd AND both backward passes (it is a pure re-indexing, so autodiff
+    # through the reshape/conv composite is the transformed backward).
+    if (len(kernel) == 2 and tuple(stride) == (2, 2)
+            and groups == 1 and all(d == 1 for d in dilate)
+            and not isinstance(pads, str)
+            and bool(layout) and layout.endswith("C")
+            and data.ndim == 4 and data.shape[-1] <= 4
+            and kernel[0] >= 5 and kernel[1] >= 5
+            and all(tuple(p) == ((k - 1) // 2,) * 2
+                    for p, k in zip(pads, kernel))
+            and data.shape[1] % 2 == 0 and data.shape[2] % 2 == 0
+            and os.environ.get("MXNET_TPU_CONV_S2D", "1") == "1"):
+        return _conv_s2d(data, weight, kernel)
+
+    # Strided 1x1 convs as strided SLICE + matmul, dX zero-interleaved by
+    # pad+reshape instead of XLA's lhs-dilated scatter-conv. Measured
+    # END-TO-END in ResNet-50 (round 4): a 4.5% REGRESSION (2,465 vs
+    # 2,585 img/s) — the materialized slice/pad intermediates cost more
+    # than the scatter-conv formulation they replace, mirroring the
+    # round-4 patches-dW lesson that isolated-op roofline math loses to
+    # XLA's fusion once the op sits inside a real step. Kept opt-in for
+    # experiments.
+    if (tuple(kernel) == (1, 1) and len(stride) == 2
+            and max(stride) > 1 and groups == 1
+            and all(d == 1 for d in dilate)
+            and not isinstance(pads, str)
+            and all(tuple(p) == (0, 0) for p in pads)
+            and bool(layout) and layout.endswith("C")
+            and data.ndim == 4
+            and data.shape[1] % stride[0] == 0
+            and data.shape[2] % stride[1] == 0
+            and os.environ.get("MXNET_TPU_CONV1X1_STRIDED_DOT", "0") == "1"):
+        return _conv1x1_strided_dot(data, weight, tuple(stride))
 
     # Stride-1 1x1 channels-last convs ARE matmuls: formulate fwd/dW/dX as
     # explicit dot_generals so XLA:TPU's matmul path (not its conv-backward

@@ -80,7 +80,10 @@ def trace_times(tdir):
     for e in data.get("traceEvents", []):
         if e.get("ph") == "X":
             n = e.get("name", "")
-            if n.startswith(("jit_", "Thread", "pjit")):
+            # host-side python/runtime frames leak into the event stream;
+            # XLA device ops never contain source locations or $-frames
+            if (n.startswith(("jit_", "Thread", "pjit", "$", "np.", "Pjit"))
+                    or ".py:" in n or " " in n):
                 continue
             per_op[n] += e.get("dur", 0) / 1e3
     return per_op
