@@ -651,6 +651,193 @@ def atleast_1d(*arys):
     return res[0] if len(res) == 1 else res
 
 
+def atleast_2d(*arys):
+    """NumPy-semantics atleast_2d (scalars/1-D get leading axes)."""
+    def one(a):
+        a = a if isinstance(a, ndarray) else array(a)
+        if a.ndim == 0:
+            return a.reshape(1, 1)
+        if a.ndim == 1:
+            return expand_dims(a, 0)
+        return a
+
+    res = [one(a) for a in arys]
+    return res[0] if len(res) == 1 else res
+
+
+def atleast_3d(*arys):
+    """NumPy-semantics atleast_3d (shapes promote to (1,N,1)-style)."""
+    def one(a):
+        a = a if isinstance(a, ndarray) else array(a)
+        if a.ndim == 0:
+            return a.reshape(1, 1, 1)
+        if a.ndim == 1:
+            return a.reshape(1, a.shape[0], 1)
+        if a.ndim == 2:
+            return expand_dims(a, -1)
+        return a
+
+    res = [one(a) for a in arys]
+    return res[0] if len(res) == 1 else res
+
+
+def asarray(obj, dtype=None):
+    """array() that is a no-op (no copy) for matching np ndarrays.
+
+    A legacy ``mx.nd`` NDArray is promoted to the np ndarray subclass
+    (NumPy semantics were requested), sharing its device buffer.
+    """
+    if isinstance(obj, ndarray) and (dtype is None
+                                     or obj.dtype == _onp.dtype(dtype)):
+        return obj
+    return array(obj, dtype=dtype)
+
+
+asanyarray = asarray
+
+
+def ascontiguousarray(obj, dtype=None):
+    # XLA owns physical layout; logical arrays are always C-contiguous
+    return asarray(obj, dtype=dtype)
+
+
+def copyto(dst, src):
+    """NumPy copyto: in-place overwrite of dst (tape-transparent write,
+    mirroring NDArray's [:] assignment semantics)."""
+    if not isinstance(dst, NDArray):
+        raise TypeError("np.copyto destination must be an ndarray")
+    dst[:] = src if isinstance(src, NDArray) else array(src)
+
+
+def put(a, ind, v, mode="raise"):
+    """NumPy put: flat-index in-place scatter into a (values cycled)."""
+    if not isinstance(a, NDArray):
+        raise TypeError("np.put target must be an ndarray")
+    jnp = _jnp()
+    flat = a.data.reshape(-1)
+    n = flat.shape[0]
+    ind_d = _data(ind) if isinstance(ind, NDArray) else jnp.asarray(
+        _onp.asarray(ind))
+    ind_d = jnp.asarray(ind_d).reshape(-1)
+    v_d = _data(v) if isinstance(v, NDArray) else jnp.asarray(
+        _onp.asarray(v))
+    v_d = jnp.asarray(v_d).reshape(-1)
+    if v_d.size == 0:
+        return
+    if v_d.size < ind_d.size:  # NumPy cycles shorter values
+        v_d = jnp.tile(v_d, -(-ind_d.size // v_d.size))
+    v_d = v_d[:ind_d.size].astype(flat.dtype)
+    if mode == "clip":
+        ind_d = jnp.clip(ind_d, 0, n - 1)
+    elif mode == "wrap":
+        ind_d = ind_d % n
+    else:  # "raise": jax scatter silently DROPS oob updates — check here
+        bad = ((ind_d < -n) | (ind_d >= n)).any()
+        if bool(bad):  # eager op: sync is part of the contract
+            raise IndexError(
+                f"np.put: index out of bounds for size-{n} array")
+    a[:] = ndarray(data=flat.at[ind_d].set(v_d).reshape(a.shape))
+
+
+def place(arr, mask, vals):
+    """NumPy place: set arr[mask] from vals cyclically (in-place)."""
+    if not isinstance(arr, NDArray):
+        raise TypeError("np.place target must be an ndarray")
+    host = _onp.array(arr.asnumpy())  # asnumpy may be a read-only view
+    _onp.place(host, _onp.asarray(
+        mask.asnumpy() if isinstance(mask, NDArray) else mask),
+        _onp.asarray(vals.asnumpy() if isinstance(vals, NDArray) else vals,
+                     dtype=host.dtype))
+    arr[:] = array(host, dtype=arr.dtype)
+
+
+def putmask(a, mask, values):
+    """NumPy putmask: a[mask] = values (broadcast/cycled), in-place."""
+    if not isinstance(a, NDArray):
+        raise TypeError("np.putmask target must be an ndarray")
+    jnp = _jnp()
+    m = _data(mask) if isinstance(mask, NDArray) else jnp.asarray(
+        _onp.asarray(mask))
+    v = _data(values) if isinstance(values, NDArray) else jnp.asarray(
+        _onp.asarray(values))
+    if v.size == a.size:
+        vb = v.reshape(a.shape)
+    else:
+        reps = -(-a.size // (v.size or 1))  # NB: max/min are np funcs here
+        vb = jnp.tile(v.reshape(-1), reps)[:a.size].reshape(a.shape)
+    a[:] = ndarray(data=jnp.where(m.astype(bool), vb.astype(a.data.dtype),
+                                  a.data))
+
+
+def put_along_axis(arr, indices, values, axis):
+    """NumPy put_along_axis (in-place scatter along an axis)."""
+    if not isinstance(arr, NDArray):
+        raise TypeError("np.put_along_axis target must be an ndarray")
+    jnp = _jnp()
+    idx = _data(indices) if isinstance(indices, NDArray) else jnp.asarray(
+        _onp.asarray(indices))
+    val = _data(values) if isinstance(values, NDArray) else jnp.asarray(
+        _onp.asarray(values))
+    if axis is None:
+        put(arr, idx.reshape(-1), val)
+        return
+    if hasattr(jnp, "put_along_axis"):
+        out = jnp.put_along_axis(arr.data, idx,
+                                 jnp.asarray(val).astype(arr.data.dtype),
+                                 axis, inplace=False)
+    else:  # manual scatter fallback: indices keep THEIR axis extent
+        # (NumPy broadcasts indices against values, not against arr)
+        bshape = list(arr.shape)
+        bshape[axis] = idx.shape[axis]
+        midx = jnp.moveaxis(jnp.broadcast_to(idx, bshape), axis, -1)
+        mval = jnp.moveaxis(
+            jnp.broadcast_to(jnp.asarray(val).astype(arr.data.dtype),
+                             bshape), axis, -1)
+        moved = jnp.moveaxis(arr.data, axis, -1)
+        flatten = moved.reshape(-1, moved.shape[-1])
+        fidx = midx.reshape(-1, midx.shape[-1])
+        fval = mval.reshape(-1, mval.shape[-1])
+        rows = jnp.arange(flatten.shape[0])[:, None]
+        out = jnp.moveaxis(
+            flatten.at[rows, fidx].set(fval).reshape(moved.shape), -1, axis)
+    arr[:] = ndarray(data=out)
+
+
+def real_if_close(a, tol=100):
+    a = a if isinstance(a, NDArray) else array(a)
+    return ndarray(data=_jnp().real_if_close(a.data, tol=tol)) \
+        if hasattr(_jnp(), "real_if_close") \
+        else ndarray(data=_onp.real_if_close(a.asnumpy(), tol=tol))
+
+
+def lexsort(keys, axis=-1):
+    ks = [(_data(k) if isinstance(k, NDArray) else k) for k in keys]
+    return ndarray(data=_jnp().lexsort(ks, axis=axis))
+
+
+def ndenumerate(a):
+    a = a if isinstance(a, ndarray) else array(a)
+    return _onp.ndenumerate(a.asnumpy())
+
+
+def ndindex(*shape):
+    return _onp.ndindex(*shape)
+
+
+def isdtype(dtype, kind):
+    jnp = _jnp()
+    if hasattr(jnp, "isdtype"):
+        return jnp.isdtype(dtype, kind)
+    return _onp.isdtype(_onp.dtype(dtype), kind)
+
+
+def from_dlpack(x):
+    """Zero-copy import via the DLPack protocol."""
+    import jax
+
+    return ndarray(data=jax.numpy.from_dlpack(x))
+
+
 def may_share_memory(a, b):
     return False
 

@@ -503,3 +503,79 @@ def test_npx_rnn_mode_required():
     with pytest.raises(ValueError, match="mode"):
         mx.npx.rnn(mx.np.ones((2, 1, 4)), mx.np.ones((100,)),
                    mx.np.ones((1, 1, 8)), state_size=8)
+
+
+class TestNpSurfaceAdditions:
+    """Round-4 tail: array-utility mirrors (asarray/atleast/put family)."""
+
+    def test_asarray_noop_and_atleast(self):
+        import numpy as onp
+        a = mx.np.array([1.0, 2.0, 3.0])
+        assert mx.np.asarray(a) is a
+        assert mx.np.asanyarray(a) is a
+        assert mx.np.ascontiguousarray(a) is not None
+        assert mx.np.atleast_2d(a).shape == (1, 3)
+        assert mx.np.atleast_3d(a).shape == (1, 3, 1)
+        assert mx.np.atleast_2d(mx.np.array(5.0)).shape == (1, 1)
+        a2, b2 = mx.np.atleast_2d(a, mx.np.array(1.0))
+        assert a2.shape == (1, 3) and b2.shape == (1, 1)
+
+    def test_put_family_matches_numpy(self):
+        import numpy as onp
+        e = mx.np.array([[10.0, 30.0], [40.0, 20.0]])
+        idx = mx.np.array([[1], [0]]).astype("int32")
+        mx.np.put_along_axis(e, idx, mx.np.array([[99.0], [88.0]]), 1)
+        h = onp.array([[10.0, 30.0], [40.0, 20.0]], onp.float32)
+        onp.put_along_axis(h, onp.array([[1], [0]]),
+                           onp.array([[99.0], [88.0]], onp.float32), 1)
+        onp.testing.assert_allclose(e.asnumpy(), h)
+
+        c = mx.np.zeros((5,))
+        mx.np.put(c, [0, 2], [9.0, 7.0])
+        onp.testing.assert_allclose(c.asnumpy(), [9, 0, 7, 0, 0])
+
+        d = mx.np.array([1.0, -2.0, 3.0])
+        mx.np.putmask(d, onp.array([False, True, False]), mx.np.array([0.0]))
+        onp.testing.assert_allclose(d.asnumpy(), [1.0, 0.0, 3.0])
+
+        f = mx.np.array([1.0, 2.0])
+        mx.np.place(f, onp.array([True, False]), [7.0])
+        onp.testing.assert_allclose(f.asnumpy(), [7.0, 2.0])
+
+        g = mx.np.zeros((2, 3))
+        mx.np.copyto(g, mx.np.array([1.0, 2.0, 3.0]))
+        onp.testing.assert_allclose(g.asnumpy(),
+                                    onp.tile([1.0, 2.0, 3.0], (2, 1)))
+
+    def test_lexsort_ndindex_isdtype_dlpack(self):
+        import numpy as onp
+        k = mx.np.lexsort([mx.np.array([2.0, 1.0, 3.0]),
+                           mx.np.array([0.0, 0.0, 0.0])])
+        onp.testing.assert_allclose(
+            k.asnumpy(), onp.lexsort([onp.array([2.0, 1.0, 3.0]),
+                                      onp.zeros(3)]))
+        assert list(mx.np.ndindex(2, 2)) == list(onp.ndindex(2, 2))
+        assert mx.np.isdtype(onp.float32, "real floating")
+        got = mx.np.from_dlpack(onp.ones((2, 2), onp.float32))
+        onp.testing.assert_allclose(got.asnumpy(), onp.ones((2, 2)))
+
+    def test_put_cycles_raises_and_asarray_promotes(self):
+        import numpy as onp
+        import pytest
+        c = mx.np.zeros((5,))
+        mx.np.put(c, [0, 1, 2, 3], [1.0, 2.0])  # NumPy cycles values
+        onp.testing.assert_allclose(c.asnumpy(), [1, 2, 1, 2, 0])
+        with pytest.raises(IndexError):
+            mx.np.put(mx.np.zeros((5,)), [10], [9.0])
+        out = mx.np.asarray(mx.nd.ones((2, 3)))  # legacy NDArray promotes
+        assert isinstance(out, mx.np.ndarray)
+
+    def test_put_along_axis_partial_axis_indices(self):
+        import numpy as onp
+        e = mx.np.array([[10.0, 30.0, 50.0], [40.0, 20.0, 60.0]])
+        mx.np.put_along_axis(e, mx.np.array([[0, 1], [1, 0]]).astype("int32"),
+                             mx.np.array([[1.0, 2.0], [3.0, 4.0]]), 1)
+        h = onp.array([[10.0, 30.0, 50.0], [40.0, 20.0, 60.0]], onp.float32)
+        onp.put_along_axis(h, onp.array([[0, 1], [1, 0]]),
+                           onp.array([[1.0, 2.0], [3.0, 4.0]], onp.float32), 1)
+        onp.testing.assert_allclose(e.asnumpy(), h)
