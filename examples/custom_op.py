@@ -1,0 +1,73 @@
+"""Define a custom operator in Python and train through it (reference:
+example/numpy-ops/custom_softmax.py — the CustomOp/CustomOpProp ABI).
+
+The op runs eagerly AND inside hybridized (jit-compiled) graphs: forward
+executes via pure_callback, the user-defined backward is wired in with
+custom_vjp.
+
+Usage:
+  python examples/custom_op.py
+"""
+import numpy as np
+
+import mxnet_tpu as mx
+from mxnet_tpu import autograd, gluon
+from mxnet_tpu.gluon import nn
+
+
+class Softmax(mx.operator.CustomOp):
+    def forward(self, is_train, req, in_data, out_data, aux):
+        x = in_data[0].asnumpy()
+        e = np.exp(x - x.max(axis=1, keepdims=True))
+        self.assign(out_data[0], req[0], mx.nd.array(e / e.sum(axis=1,
+                                                               keepdims=True)))
+
+    def backward(self, req, out_grad, in_data, out_data, in_grad, aux):
+        y = out_data[0].asnumpy()
+        dy = out_grad[0].asnumpy()
+        dx = y * (dy - (dy * y).sum(axis=1, keepdims=True))
+        self.assign(in_grad[0], req[0], mx.nd.array(dx))
+
+
+@mx.operator.register("my_softmax")
+class SoftmaxProp(mx.operator.CustomOpProp):
+    def __init__(self):
+        super().__init__(need_top_grad=True)
+
+    def list_arguments(self):
+        return ["data"]
+
+    def list_outputs(self):
+        return ["output"]
+
+    def infer_shape(self, in_shape):
+        return in_shape, [in_shape[0]], []
+
+    def create_operator(self, ctx, shapes, dtypes):
+        return Softmax()
+
+
+def main():
+    x = mx.nd.array(np.random.RandomState(0).randn(4, 10).astype(np.float32))
+    x.attach_grad()
+    with autograd.record():
+        y = mx.nd.Custom(x, op_type="my_softmax")
+        loss = -(y[:, 3].log()).mean()
+    loss.backward()
+    print("custom softmax row sums:", y.sum(axis=1).asnumpy())
+    print("grad norm:", float((x.grad ** 2).sum().asnumpy()) ** 0.5)
+
+    # the same op inside a hybridized block
+    class Head(gluon.HybridBlock):
+        def hybrid_forward(self, F, x):
+            return F.Custom(x, op_type="my_softmax")
+
+    net = Head()
+    net.hybridize()
+    out = net(x)
+    np.testing.assert_allclose(out.asnumpy(), y.asnumpy(), rtol=1e-5)
+    print("hybridized Custom op matches eager")
+
+
+if __name__ == "__main__":
+    main()

@@ -1,0 +1,103 @@
+"""Train LeNet on MNIST with the Gluon API.
+
+The canonical first-contact example (reference:
+example/gluon/mnist/mnist.py): dataset -> DataLoader -> HybridBlock ->
+Trainer -> evaluation loop. Runs on whatever accelerator jax exposes;
+synthesizes MNIST-shaped data when the real dataset is unreachable
+(zero-egress environments).
+
+Usage:
+  python examples/train_mnist_gluon.py --epochs 2 --batch-size 64
+"""
+import argparse
+import time
+
+import numpy as np
+
+import mxnet_tpu as mx
+from mxnet_tpu import autograd, gluon
+from mxnet_tpu.gluon import nn
+
+
+def build_net():
+    net = nn.HybridSequential()
+    net.add(
+        nn.Conv2D(20, kernel_size=5, activation="relu"),
+        nn.MaxPool2D(pool_size=2, strides=2),
+        nn.Conv2D(50, kernel_size=5, activation="relu"),
+        nn.MaxPool2D(pool_size=2, strides=2),
+        nn.Flatten(),
+        nn.Dense(500, activation="relu"),
+        nn.Dense(10),
+    )
+    return net
+
+
+def load_data(batch_size):
+    try:
+        train = gluon.data.vision.MNIST(train=True)
+        test = gluon.data.vision.MNIST(train=False)
+        tf = gluon.data.vision.transforms.ToTensor()
+        train = train.transform_first(tf)
+        test = test.transform_first(tf)
+    except Exception:
+        print("MNIST download unavailable; using synthetic digits")
+
+        class Synth(gluon.data.Dataset):
+            def __init__(self, n):
+                rs = np.random.RandomState(0)
+                self.y = rs.randint(0, 10, n).astype(np.int32)
+                self.x = rs.rand(n, 1, 28, 28).astype(np.float32) * 0.1
+                for i, lab in enumerate(self.y):  # class-dependent stripe
+                    self.x[i, 0, lab * 2:lab * 2 + 2, :] += 0.8
+
+            def __len__(self):
+                return len(self.y)
+
+            def __getitem__(self, i):
+                return self.x[i], self.y[i]
+
+        train, test = Synth(2048), Synth(512)
+    return (gluon.data.DataLoader(train, batch_size, shuffle=True),
+            gluon.data.DataLoader(test, batch_size))
+
+
+def evaluate(net, loader):
+    metric = mx.metric.Accuracy()
+    for x, y in loader:
+        metric.update([y], [net(x)])
+    return metric.get()[1]
+
+
+def main():
+    ap = argparse.ArgumentParser(description=__doc__)
+    ap.add_argument("--epochs", type=int, default=2)
+    ap.add_argument("--batch-size", type=int, default=64)
+    ap.add_argument("--lr", type=float, default=0.02)
+    args = ap.parse_args()
+
+    net = build_net()
+    net.initialize(mx.init.Xavier())
+    net.hybridize()
+    trainer = gluon.Trainer(net.collect_params(), "sgd",
+                            {"learning_rate": args.lr, "momentum": 0.9})
+    loss_fn = gluon.loss.SoftmaxCrossEntropyLoss()
+    train_loader, test_loader = load_data(args.batch_size)
+
+    for epoch in range(args.epochs):
+        t0 = time.time()
+        metric = mx.metric.Accuracy()
+        for x, y in train_loader:
+            with autograd.record():
+                out = net(x)
+                loss = loss_fn(out, y)
+            loss.backward()
+            trainer.step(x.shape[0])
+            metric.update([y], [out])
+        print(f"epoch {epoch}: train acc {metric.get()[1]:.4f} "
+              f"({time.time() - t0:.1f}s)")
+    print(f"test acc: {evaluate(net, test_loader):.4f}")
+
+
+if __name__ == "__main__":
+    main()

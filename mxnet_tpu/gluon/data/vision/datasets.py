@@ -28,11 +28,23 @@ __all__ = ["MNIST", "FashionMNIST", "CIFAR10", "CIFAR100",
            "ImageRecordDataset", "ImageFolderDataset"]
 
 
-def _synthetic_images(num, shape, num_classes, seed, flat_pixels=False):
+def _synthetic_images(num, shape, num_classes, seed, proto_seed=None):
     """Class-prototype + noise images: linearly separable enough to learn,
-    hard enough that an untrained net is at chance."""
-    rng = _np.random.RandomState(seed)
-    protos = rng.uniform(0, 255, size=(num_classes,) + shape).astype("float32")
+    hard enough that an untrained net is at chance.
+
+    ``proto_seed`` (default: ``seed``) draws the class prototypes and MUST
+    be shared across a dataset's train/test splits — with per-split
+    prototypes a model trained on one split is at chance on the other
+    (the bug this parameter fixes: train/test "MNIST" surrogates used to
+    describe different classes entirely).
+    """
+    protos = _np.random.RandomState(
+        seed if proto_seed is None else proto_seed).uniform(
+        0, 255, size=(num_classes,) + shape).astype("float32")
+    # disjoint stream for labels/noise: seeding with `seed` directly would
+    # replay the prototype RNG's draws when seed == proto_seed, making
+    # train-split noise a function of the prototype pixels
+    rng = _np.random.RandomState(seed + 100003)
     labels = rng.randint(0, num_classes, size=(num,)).astype("int32")
     noise = rng.normal(0, 64.0, size=(num,) + shape).astype("float32")
     imgs = _np.clip(protos[labels] * 0.6 + noise, 0, 255).astype("uint8")
@@ -97,7 +109,8 @@ class MNIST(_DownloadedDataset):
         n_synth = 8192 if self._train else 2048
         seed = self._SEED if self._train else self._SEED + 1
         self._data, self._label = _synthetic_images(
-            n_synth, self._SHAPE, self._NUM_CLASSES, seed)
+            n_synth, self._SHAPE, self._NUM_CLASSES, seed,
+            proto_seed=self._SEED)
 
 
 class FashionMNIST(MNIST):
@@ -140,7 +153,8 @@ class CIFAR10(_DownloadedDataset):
         n = 8192 if self._train else 2048
         seed = self._SEED if self._train else self._SEED + 1
         self._data, self._label = _synthetic_images(
-            n, self._SHAPE, self._NUM_CLASSES, seed)
+            n, self._SHAPE, self._NUM_CLASSES, seed,
+            proto_seed=self._SEED)
 
 
 class CIFAR100(CIFAR10):
@@ -164,7 +178,8 @@ class CIFAR100(CIFAR10):
         n = 8192 if self._train else 2048
         self._data, self._label = _synthetic_images(
             n, self._SHAPE, self._NUM_CLASSES,
-            self._SEED if self._train else self._SEED + 1)
+            self._SEED if self._train else self._SEED + 1,
+            proto_seed=self._SEED)
 
 
 class ImageRecordDataset(Dataset):

@@ -803,16 +803,11 @@ def put_along_axis(arr, indices, values, axis):
     arr[:] = ndarray(data=out)
 
 
-def real_if_close(a, tol=100):
-    a = a if isinstance(a, NDArray) else array(a)
-    return ndarray(data=_jnp().real_if_close(a.data, tol=tol)) \
-        if hasattr(_jnp(), "real_if_close") \
-        else ndarray(data=_onp.real_if_close(a.asnumpy(), tol=tol))
-
-
 def lexsort(keys, axis=-1):
-    ks = [(_data(k) if isinstance(k, NDArray) else k) for k in keys]
-    return ndarray(data=_jnp().lexsort(ks, axis=axis))
+    jnp = _jnp()
+    ks = [(_data(k) if isinstance(k, NDArray)
+           else jnp.asarray(_onp.asarray(k))) for k in keys]
+    return ndarray(data=jnp.lexsort(ks, axis=axis))
 
 
 def ndenumerate(a):
@@ -1330,6 +1325,9 @@ around = round
 trapz = trapezoid = _np_delegate("trapezoid") \
     if hasattr(__import__("jax.numpy", fromlist=["x"]), "trapezoid") \
     else _np_host("trapz")
+real_if_close = _np_delegate("real_if_close") \
+    if hasattr(__import__("jax.numpy", fromlist=["x"]), "real_if_close") \
+    else _np_host("real_if_close")
 matrix_transpose = _np_delegate("matrix_transpose")
 cumprod = _np_delegate("cumprod")
 ravel = _np_delegate("ravel")

@@ -71,3 +71,51 @@ def test_mlp_converges():
     pred = (net(xb).reshape(-1).asnumpy() > 0).astype(np.float32)
     acc = float((pred == y).mean())
     assert acc > 0.95, f"mlp failed to converge: acc={acc}"
+
+
+def test_synthetic_dataset_splits_share_class_structure():
+    """The zero-egress dataset surrogates must draw the SAME class
+    prototypes for train and test — per-split prototypes made a model
+    trained on the surrogate train split score at chance on its test
+    split (the silent-generalization-failure bug fixed in round 4)."""
+    from mxnet_tpu.gluon.data import vision
+
+    for cls in (vision.MNIST, vision.CIFAR10):
+        tr = cls(root="/nonexistent-forces-synthetic", train=True)
+        te = cls(root="/nonexistent-forces-synthetic", train=False)
+        assert tr.synthetic and te.synthetic
+
+        def class_means(ds):
+            import numpy as onp
+            xs = ds._data[:512].astype(onp.float32)
+            ys = onp.asarray(ds._label[:512])
+            return onp.stack([xs[ys == c].mean(axis=0).ravel()
+                              for c in range(10)])
+
+        import numpy as onp
+        a, b = class_means(tr), class_means(te)
+        # same-class means across splits must correlate far better than
+        # cross-class ones
+        same = onp.mean([onp.corrcoef(a[c], b[c])[0, 1] for c in range(10)])
+        cross = onp.mean([onp.corrcoef(a[c], b[(c + 1) % 10])[0, 1]
+                          for c in range(10)])
+        assert same > 0.5 and same > cross + 0.3, (same, cross)
+
+
+def test_synthetic_mnist_train_generalizes_to_test():
+    """End-to-end: a linear probe fit on the surrogate train split must
+    transfer to the surrogate test split."""
+    import numpy as onp
+    from mxnet_tpu.gluon.data import vision
+
+    tr = vision.MNIST(root="/nonexistent-forces-synthetic", train=True)
+    te = vision.MNIST(root="/nonexistent-forces-synthetic", train=False)
+    xtr = onp.asarray(tr._data[:2048], onp.float32).reshape(2048, -1) / 255.0
+    ytr = onp.asarray(tr._label[:2048])
+    xte = onp.asarray(te._data[:512], onp.float32).reshape(512, -1) / 255.0
+    yte = onp.asarray(te._label[:512])
+    # nearest-class-mean classifier
+    means = onp.stack([xtr[ytr == c].mean(axis=0) for c in range(10)])
+    pred = ((xte[:, None, :] - means[None]) ** 2).sum(-1).argmin(1)
+    acc = float((pred == yte).mean())
+    assert acc > 0.9, f"surrogate test split not learnable from train: {acc}"
