@@ -238,3 +238,10 @@ def greater(l, r):
 
 def lesser(l, r):
     return l < r
+
+
+def cast_storage(arr, stype="default"):
+    """reference: src/operator/tensor/cast_storage.cc — convert between
+    dense/'csr'/'row_sparse' storage. Sparse storage is a Python-level
+    facade here (SURVEY.md §7.3.5), so this delegates to ``tostype``."""
+    return arr.tostype(stype)

@@ -1110,3 +1110,130 @@ def grid_generator(data, *, transform_type="affine", target_shape=(0, 0)):
     theta = data.reshape(-1, 2, 3)
     out = jnp.einsum("nij,jk->nik", theta, base)  # (n, 2, h*w)
     return out.reshape(-1, 2, h, w)
+
+
+@register("mish")
+def mish(data):
+    # reference: src/operator/nn/activation.cc act_type mish (also reachable
+    # via Activation(act_type="mish"))
+    return data * jnp.tanh(jax.nn.softplus(data))
+
+
+@register("im2col", attrs=[
+    attr("kernel", tuple, "Sliding window size."),
+])
+def im2col(data, *, kernel=(), stride=(), dilate=(), pad=()):
+    """reference: src/operator/nn/im2col.h — unfold conv patches.
+
+    data (N, C, H, W) -> (N, C*prod(kernel), prod(out_spatial)); the
+    gather is conv_general_dilated_patches, which XLA lowers without
+    materializing per-tap copies until the consumer needs them.
+    """
+    nd = len(kernel)
+    stride = _tuplize(stride or 1, nd)
+    dilate = _tuplize(dilate or 1, nd)
+    pad = _tuplize(pad or 0, nd)
+    spatial = "DHW"[-nd:]
+    lhs = "NC" + spatial
+    patches = jax.lax.conv_general_dilated_patches(
+        data, tuple(kernel), stride, [(p, p) for p in pad],
+        rhs_dilation=dilate,
+        dimension_numbers=(lhs, "OI" + spatial, lhs))
+    n = patches.shape[0]
+    return patches.reshape(n, patches.shape[1], -1)
+
+
+@register("col2im", attrs=[
+    attr("kernel", tuple, "Sliding window size."),
+])
+def col2im(data, *, output_size=(), kernel=(), stride=(), dilate=(),
+           pad=()):
+    """reference: src/operator/nn/im2col.h col2im — scatter-add patches
+    back. Implemented as the exact VJP of im2col (the two are adjoint by
+    definition), so overlap accumulation is XLA's scatter fusion."""
+    nd = len(kernel)
+    n, ckk = data.shape[0], data.shape[1]
+    c = ckk
+    for k in tuple(kernel):
+        c //= k
+    x_shape = (n, c) + tuple(output_size)
+    zero = jnp.zeros(x_shape, dtype=data.dtype)
+    _, pull = jax.vjp(
+        lambda x: im2col(x, kernel=kernel, stride=stride, dilate=dilate,
+                         pad=pad), zero)
+    (out,) = pull(data)
+    return out
+
+
+@register("Convolution_v1", aliases=["convolution_v1"])
+def convolution_v1(data, weight, bias=None, **kwargs):
+    # reference: src/operator/convolution_v1.cc — legacy alias with the
+    # modern op's semantics
+    return convolution(data, weight, bias, **kwargs)
+
+
+@register("Pooling_v1", aliases=["pooling_v1"], attrs=[])
+def pooling_v1(data, **kwargs):
+    return pooling(data, **kwargs)
+
+
+@register("Crop", eager_only=False)
+def crop(*inputs, offset=(0, 0), h_w=(0, 0), center_crop=False,
+         num_args=1):
+    """reference: src/operator/crop.cc — crop data (NCHW) to h_w or to the
+    second input's spatial size."""
+    data = inputs[0]
+    if len(inputs) > 1:
+        th, tw = inputs[1].shape[2], inputs[1].shape[3]
+    else:
+        th, tw = h_w
+        if th <= 0 or tw <= 0:
+            raise ValueError(
+                "Crop: h_w must be given (positive) when no crop_like "
+                "input is passed (reference crop.cc parameter check)")
+    h, w = data.shape[2], data.shape[3]
+    if center_crop:
+        oy, ox = (h - th) // 2, (w - tw) // 2
+    else:
+        oy, ox = offset
+    return data[:, :, oy:oy + th, ox:ox + tw]
+
+
+@register("softmax_cross_entropy")
+def softmax_cross_entropy(data, label):
+    # reference: src/operator/loss_binary_op.cc — summed scalar CE over
+    # the batch, labels are class indices
+    lp = jax.nn.log_softmax(data.astype(jnp.float32), axis=-1)
+    picked = jnp.take_along_axis(
+        lp, label.astype(jnp.int32).reshape(-1, 1), axis=-1)
+    return -jnp.sum(picked)
+
+
+@_partial(jax.custom_vjp, nondiff_argnums=(1, 2))
+def _identity_kl_reg(data, sparseness_target, penalty):
+    return data
+
+
+def _identity_kl_fwd(data, sparseness_target, penalty):
+    return data, data
+
+
+def _identity_kl_bwd(sparseness_target, penalty, data, dy):
+    rho = sparseness_target
+    rho_hat = jnp.clip(jnp.mean(data.astype(jnp.float32), axis=0),
+                       1e-6, 1 - 1e-6)
+    kl_grad = penalty * (-rho / rho_hat + (1 - rho) / (1 - rho_hat))
+    return (dy + kl_grad.astype(dy.dtype),)
+
+
+_identity_kl_reg.defvjp(_identity_kl_fwd, _identity_kl_bwd)
+
+
+@register("IdentityAttachKLSparseReg")
+def identity_attach_kl_sparse_reg(data, *, sparseness_target=0.1,
+                                  penalty=0.001, momentum=0.9):
+    """reference: src/operator/identity_attach_KL_sparse_reg.cc —
+    identity forward; backward adds the KL sparsity penalty gradient
+    computed from the batch mean activation (the reference's moving
+    average collapses to the batch mean in a pure-function graph)."""
+    return _identity_kl_reg(data, float(sparseness_target), float(penalty))

@@ -351,3 +351,64 @@ def lamb_update_phase2(weight, g_update, r1, r2, *, lr, lower_bound=-1.0,
     ratio = jnp.where((r1v > 0) & (r2v > 0), r1v / r2v, 1.0)
     new_w = weight.astype(jnp.float32) - lr * ratio * g_update
     return new_w.astype(weight.dtype)
+
+
+@register("ftml_update")
+def ftml_update(weight, grad, d, v, z, *, lr, beta1=0.6, beta2=0.999,
+                epsilon=1e-8, t=1, wd=0.0, rescale_grad=1.0,
+                clip_grad=-1.0):
+    """FTML (Follow The Moving Leader; reference optimizer_op.cc
+    ftml_update, states d/v/z)."""
+    g = grad.astype(jnp.float32) * rescale_grad
+    if clip_grad is not None and clip_grad >= 0:
+        g = jnp.clip(g, -clip_grad, clip_grad)
+    g = g + wd * weight.astype(jnp.float32)
+    new_v = beta2 * v + (1 - beta2) * jnp.square(g)
+    d_t = (1 - beta1 ** t) / lr * (
+        jnp.sqrt(new_v / (1 - beta2 ** t)) + epsilon)
+    sigma = d_t - beta1 * d
+    new_z = beta1 * z + (1 - beta1) * g - sigma * weight.astype(jnp.float32)
+    new_w = -new_z / d_t
+    return (new_w.astype(weight.dtype), d_t.astype(d.dtype),
+            new_v.astype(v.dtype), new_z.astype(z.dtype))
+
+
+@register("mp_nag_mom_update")
+def mp_nag_mom_update(weight, grad, mom, weight32, *, lr, momentum=0.0,
+                      wd=0.0, rescale_grad=1.0, clip_gradient=-1.0):
+    g = _apply_wd(grad, weight32, wd, rescale_grad, clip_gradient)
+    new_mom = momentum * mom + g
+    new_w32 = weight32 - lr * (g + momentum * new_mom)
+    return new_w32.astype(weight.dtype), new_mom, new_w32
+
+
+@register("mp_lamb_update_phase1")
+def mp_lamb_update_phase1(weight, grad, mean, var, weight32, *, beta1=0.9,
+                          beta2=0.999, epsilon=1e-6, t=1,
+                          bias_correction=True, wd=0.0, rescale_grad=1.0,
+                          clip_gradient=-1.0):
+    g = grad.astype(jnp.float32) * rescale_grad
+    if clip_gradient is not None and clip_gradient >= 0:
+        g = jnp.clip(g, -clip_gradient, clip_gradient)
+    new_mean = beta1 * mean + (1 - beta1) * g
+    new_var = beta2 * var + (1 - beta2) * jnp.square(g)
+    m, v = new_mean, new_var
+    if bias_correction:
+        m = m / (1 - beta1 ** t)
+        v = v / (1 - beta2 ** t)
+    update = m / (jnp.sqrt(v) + epsilon) + wd * weight32
+    return update, new_mean, new_var
+
+
+@register("mp_lamb_update_phase2")
+def mp_lamb_update_phase2(weight, g_update, r1, r2, weight32, *, lr,
+                          lower_bound=-1.0, upper_bound=-1.0):
+    r1v = r1.reshape(())
+    r2v = r2.reshape(())
+    if lower_bound is not None and lower_bound >= 0:
+        r1v = jnp.maximum(r1v, lower_bound)
+    if upper_bound is not None and upper_bound >= 0:
+        r1v = jnp.minimum(r1v, upper_bound)
+    ratio = jnp.where((r1v > 0) & (r2v > 0), r1v / r2v, 1.0)
+    new_w32 = weight32 - lr * ratio * g_update
+    return new_w32.astype(weight.dtype), new_w32

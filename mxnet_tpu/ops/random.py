@@ -39,16 +39,33 @@ def random_exponential(rng, *, lam=1.0, shape=(), dtype="float32"):
     return jax.random.exponential(rng, tuple(shape), dtype=jnp.dtype(dtype)) / lam
 
 
+def _threefry(rng):
+    """A threefry2x32 key derived from ``rng``.
+
+    ``jax.random.poisson`` is only implemented for threefry, while this
+    library defaults the global PRNG to rbg (hardware generator, ~2x
+    cheaper for dropout — see mxnet_tpu/__init__.py). Deriving a
+    threefry key from the incoming key's data keeps poisson-backed draws
+    working under either default; traceable (pure bit reinterpretation).
+    """
+    data = jax.random.key_data(rng)
+    if data.shape[-1] > 2:
+        data = data[..., :2]
+    return jax.random.wrap_key_data(data, impl="threefry2x32")
+
+
 @register("_random_poisson", aliases=["random_poisson"], needs_rng=True)
 def random_poisson(rng, *, lam=1.0, shape=(), dtype="float32"):
-    return jax.random.poisson(rng, lam, tuple(shape)).astype(jnp.dtype(dtype))
+    return jax.random.poisson(_threefry(rng), lam,
+                              tuple(shape)).astype(jnp.dtype(dtype))
 
 
 @register("_random_negative_binomial", aliases=["random_negative_binomial"], needs_rng=True)
 def random_negative_binomial(rng, *, k=1, p=1.0, shape=(), dtype="float32"):
     k1, k2 = jax.random.split(rng)
     lam = jax.random.gamma(k1, k, tuple(shape)) * ((1 - p) / p)
-    return jax.random.poisson(k2, lam, tuple(shape)).astype(jnp.dtype(dtype))
+    return jax.random.poisson(_threefry(k2), lam,
+                              tuple(shape)).astype(jnp.dtype(dtype))
 
 
 @register("_random_randint", aliases=["random_randint"], needs_rng=True)
@@ -108,3 +125,125 @@ def sample_multinomial(rng, data, *, shape=(), get_prob=False, dtype="int32"):
 @register("_random_bernoulli", aliases=["sample_bernoulli"], needs_rng=True)
 def random_bernoulli(rng, *, p=0.5, shape=(), dtype="float32"):
     return jax.random.bernoulli(rng, p, tuple(shape)).astype(jnp.dtype(dtype))
+
+
+def _per_dist(rng, param, shape, draw):
+    """Broadcast helper for sample_*: one draw of ``shape`` per entry of
+    the (leading) parameter tensor (reference multisample_op.cc)."""
+    s = tuple(param.shape) + tuple(shape)
+    bshape = param.shape + (1,) * len(tuple(shape))
+    return draw(rng, s, bshape)
+
+
+@register("_sample_exponential", aliases=["sample_exponential"],
+          needs_rng=True)
+def sample_exponential(rng, lam, *, shape=(), dtype="float32"):
+    def draw(key, s, bshape):
+        e = jax.random.exponential(key, s)
+        return (e / lam.reshape(bshape)).astype(jnp.dtype(dtype))
+
+    return _per_dist(rng, lam, shape, draw)
+
+
+@register("_sample_poisson", aliases=["sample_poisson"], needs_rng=True)
+def sample_poisson(rng, lam, *, shape=(), dtype="float32"):
+    def draw(key, s, bshape):
+        return jax.random.poisson(
+            _threefry(key), jnp.broadcast_to(lam.reshape(bshape), s)).astype(
+            jnp.dtype(dtype))
+
+    return _per_dist(rng, lam, shape, draw)
+
+
+@register("_sample_negative_binomial", aliases=["sample_negative_binomial"],
+          needs_rng=True)
+def sample_negative_binomial(rng, k, p, *, shape=(), dtype="float32"):
+    def draw(key, s, bshape):
+        k1, k2 = jax.random.split(key)
+        rate = jax.random.gamma(
+            k1, jnp.broadcast_to(k.reshape(bshape).astype(jnp.float32), s)) \
+            * jnp.broadcast_to(((1 - p) / p).reshape(bshape), s)
+        return jax.random.poisson(_threefry(k2), rate).astype(
+            jnp.dtype(dtype))
+
+    return _per_dist(rng, k, shape, draw)
+
+
+@register("_sample_generalized_negative_binomial",
+          aliases=["sample_generalized_negative_binomial"], needs_rng=True)
+def sample_generalized_negative_binomial(rng, mu, alpha, *, shape=(),
+                                         dtype="float32"):
+    # reference sample_op.cc: Gamma(1/alpha, alpha*mu)-mixed Poisson
+    def draw(key, s, bshape):
+        k1, k2 = jax.random.split(key)
+        a = jnp.broadcast_to(alpha.reshape(bshape).astype(jnp.float32), s)
+        m = jnp.broadcast_to(mu.reshape(bshape).astype(jnp.float32), s)
+        rate = jax.random.gamma(k1, 1.0 / a) * a * m
+        return jax.random.poisson(_threefry(k2), rate).astype(
+            jnp.dtype(dtype))
+
+    return _per_dist(rng, mu, shape, draw)
+
+
+@register("_random_generalized_negative_binomial",
+          aliases=["random_generalized_negative_binomial"], needs_rng=True)
+def random_generalized_negative_binomial(rng, *, mu=1.0, alpha=1.0, shape=(),
+                                         dtype="float32"):
+    k1, k2 = jax.random.split(rng)
+    rate = jax.random.gamma(k1, 1.0 / alpha, tuple(shape)) * alpha * mu
+    return jax.random.poisson(_threefry(k2), rate).astype(jnp.dtype(dtype))
+
+
+# -- pdf ops (reference src/operator/random/pdf_op.cc): deterministic
+# densities of samples under (broadcast) distribution parameters; the
+# last axis of ``sample`` indexes draws per distribution --
+
+
+def _pdf_wrap(logpdf, is_log):
+    def fn(sample, *params):
+        ps = [p.reshape(p.shape + (1,)) for p in params]
+        lp = logpdf(sample.astype(jnp.float32),
+                    *[p.astype(jnp.float32) for p in ps])
+        return lp if is_log else jnp.exp(lp)
+
+    return fn
+
+
+def _register_pdf(name, logpdf):
+    @register(f"_random_pdf_{name}", aliases=[f"random_pdf_{name}"])
+    def pdf(sample, *params, is_log=False):
+        return _pdf_wrap(logpdf, is_log)(sample, *params)
+
+    return pdf
+
+
+_register_pdf("uniform", lambda x, lo, hi: jnp.where(
+    (x >= lo) & (x <= hi), -jnp.log(hi - lo), -jnp.inf))
+_register_pdf("normal", lambda x, mu, sigma:
+              -0.5 * jnp.square((x - mu) / sigma)
+              - jnp.log(sigma) - 0.5 * jnp.log(2 * jnp.pi))
+_register_pdf("exponential", lambda x, lam: jnp.log(lam) - lam * x)
+_register_pdf("poisson", lambda x, lam:
+              x * jnp.log(lam) - lam - jax.lax.lgamma(x + 1.0))
+_register_pdf("gamma", lambda x, alpha, beta:
+              alpha * jnp.log(beta) + (alpha - 1) * jnp.log(x) - beta * x
+              - jax.lax.lgamma(alpha))
+_register_pdf("negative_binomial", lambda x, k, p:
+              jax.lax.lgamma(x + k) - jax.lax.lgamma(x + 1.0)
+              - jax.lax.lgamma(k) + k * jnp.log(p) + x * jnp.log1p(-p))
+_register_pdf("generalized_negative_binomial", lambda x, mu, alpha:
+              jax.lax.lgamma(x + 1.0 / alpha) - jax.lax.lgamma(x + 1.0)
+              - jax.lax.lgamma(1.0 / alpha)
+              - (1.0 / alpha) * jnp.log1p(alpha * mu)
+              + x * (jnp.log(alpha) + jnp.log(mu) - jnp.log1p(alpha * mu)))
+
+
+@register("_random_pdf_dirichlet", aliases=["random_pdf_dirichlet"])
+def random_pdf_dirichlet(sample, alpha, *, is_log=False):
+    # sample: (..., draws, k); alpha: (..., k)
+    a = alpha.astype(jnp.float32)[..., None, :]
+    x = sample.astype(jnp.float32)
+    lp = (jnp.sum((a - 1) * jnp.log(x), axis=-1)
+          + jax.lax.lgamma(jnp.sum(a, axis=-1))
+          - jnp.sum(jax.lax.lgamma(a), axis=-1))
+    return lp if is_log else jnp.exp(lp)

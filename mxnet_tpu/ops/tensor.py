@@ -659,3 +659,65 @@ def size_array(data):
 @register("zeros_without_dtype", aliases=["_zeros_without_dtype"])
 def zeros_without_dtype(*, shape=(), dtype=-1):
     return jnp.zeros(tuple(shape), dtype=jnp.float32)
+
+
+@register("_scatter_set_nd", aliases=["scatter_set_nd"])
+def scatter_set_nd(lhs, rhs, indices, *, shape=()):
+    # reference: src/operator/tensor/indexing_op.cc::_scatter_set_nd —
+    # functional form: lhs with lhs[indices] = rhs (last writer wins)
+    idx = tuple(indices.astype(jnp.int32)[i] for i in range(indices.shape[0]))
+    return lhs.at[idx].set(rhs)
+
+
+@register("fill_element_0index")
+def fill_element_0index(lhs, mhs, rhs):
+    # reference: src/operator/tensor/matrix_op.cc fill_element_0index —
+    # lhs[i, rhs[i]] = mhs[i] along axis 1
+    rows = jnp.arange(lhs.shape[0])
+    return lhs.at[rows, rhs.astype(jnp.int32)].set(mhs.astype(lhs.dtype))
+
+
+@register("choose_element_0index")
+def choose_element_0index(lhs, rhs):
+    # reference: matrix_op.cc choose_element_0index — lhs[i, rhs[i]]
+    rows = jnp.arange(lhs.shape[0])
+    return lhs[rows, rhs.astype(jnp.int32)]
+
+
+@register("_linalg_maketrian", aliases=["linalg_maketrian"])
+def linalg_maketrian(data, *, offset=0, lower=True):
+    """reference: src/operator/tensor/la_op.cc maketrian — pack a
+    (..., n*(n+1)/2) vector into a (..., n, n) triangular matrix."""
+    import math
+
+    if offset != 0:
+        raise NotImplementedError(
+            "linalg_maketrian: offset != 0 is not implemented "
+            "(SURVEY.md operator inventory, la_op.cc tail)")
+    m = data.shape[-1]
+    n = int((math.isqrt(8 * m + 1) - 1) // 2)
+    if n * (n + 1) // 2 != m:
+        raise ValueError(
+            f"linalg_maketrian: last dim {m} is not a triangular number")
+    if lower:
+        r, c = jnp.tril_indices(n)
+    else:
+        r, c = jnp.triu_indices(n)
+    out = jnp.zeros(data.shape[:-1] + (n, n), dtype=data.dtype)
+    return out.at[..., r, c].set(data)
+
+
+@register("_linalg_extracttrian", aliases=["linalg_extracttrian"])
+def linalg_extracttrian(data, *, offset=0, lower=True):
+    """reference: la_op.cc extracttrian — unpack the triangle of a
+    (..., n, n) matrix into a (..., n*(n+1)/2) vector."""
+    if offset != 0:
+        raise NotImplementedError(
+            "linalg_extracttrian: offset != 0 is not implemented "
+            "(SURVEY.md operator inventory, la_op.cc tail)")
+    n = data.shape[-1]
+    if lower:
+        r, c = jnp.tril_indices(n)
+    else:
+        r, c = jnp.triu_indices(n)
+    return data[..., r, c]

@@ -1,0 +1,207 @@
+"""Round-4 op-name tail: sampling/pdf families, optimizer updates,
+im2col/col2im, legacy aliases, triangular linalg, indexing legacy ops.
+
+Oracles: scipy densities for pdf ops, distribution moments for samplers,
+adjointness for im2col/col2im, single-tensor update math for optimizers.
+"""
+import numpy as onp
+import pytest
+
+import mxnet_tpu as mx
+from mxnet_tpu import nd
+
+
+class TestSampleOps:
+    def test_sample_poisson_exponential_moments(self):
+        lam = nd.array([1.0, 4.0])
+        s = nd.sample_poisson(lam, shape=(4000,)).asnumpy()
+        onp.testing.assert_allclose(s.mean(axis=1), [1.0, 4.0], atol=0.2)
+        e = nd.sample_exponential(lam, shape=(4000,)).asnumpy()
+        onp.testing.assert_allclose(e.mean(axis=1), [1.0, 0.25], atol=0.1)
+
+    def test_sample_negative_binomial_moments(self):
+        s = nd.sample_negative_binomial(
+            nd.array([5.0]), nd.array([0.5]), shape=(4000,)).asnumpy()
+        onp.testing.assert_allclose(s.mean(), 5.0, atol=0.4)
+        g = nd.sample_generalized_negative_binomial(
+            nd.array([3.0]), nd.array([0.2]), shape=(4000,)).asnumpy()
+        onp.testing.assert_allclose(g.mean(), 3.0, atol=0.4)
+
+    def test_random_poisson_under_rbg_default(self):
+        """jax.random.poisson only supports threefry; the op derives a
+        threefry key from the (rbg-default) global key."""
+        s = nd.random_poisson(lam=2.0, shape=(4000,)).asnumpy()
+        onp.testing.assert_allclose(s.mean(), 2.0, atol=0.25)
+
+    def test_pdf_ops_match_scipy(self):
+        st = pytest.importorskip("scipy.stats")
+        x = nd.array([[1.0, 2.0]])
+        got = nd.random_pdf_normal(x, nd.array([0.0]), nd.array([1.0]))
+        onp.testing.assert_allclose(got.asnumpy()[0], st.norm.pdf([1, 2]),
+                                    atol=1e-5)
+        got = nd.random_pdf_poisson(x, nd.array([2.0]))
+        onp.testing.assert_allclose(got.asnumpy()[0],
+                                    st.poisson.pmf([1, 2], 2.0), atol=1e-5)
+        got = nd.random_pdf_gamma(x, nd.array([2.0]), nd.array([1.5]))
+        onp.testing.assert_allclose(
+            got.asnumpy()[0], st.gamma.pdf([1, 2], 2.0, scale=1 / 1.5),
+            atol=1e-5)
+        got = nd.random_pdf_negative_binomial(x, nd.array([5.0]),
+                                              nd.array([0.5]))
+        onp.testing.assert_allclose(got.asnumpy()[0],
+                                    st.nbinom.pmf([1, 2], 5, 0.5), atol=1e-5)
+        got = nd.random_pdf_exponential(x, nd.array([1.5]), is_log=True)
+        onp.testing.assert_allclose(got.asnumpy()[0],
+                                    st.expon.logpdf([1, 2], scale=1 / 1.5),
+                                    atol=1e-5)
+
+    def test_pdf_dirichlet(self):
+        st = pytest.importorskip("scipy.stats")
+        sample = nd.array([[[0.2, 0.3, 0.5]]])
+        alpha = nd.array([[2.0, 3.0, 4.0]])
+        got = nd.random_pdf_dirichlet(sample, alpha)
+        want = st.dirichlet.pdf([0.2, 0.3, 0.5], [2.0, 3.0, 4.0])
+        onp.testing.assert_allclose(got.asnumpy().ravel(), [want], rtol=1e-4)
+
+
+class TestOptimizerTail:
+    def test_ftml_update_moves_against_gradient(self):
+        w = nd.array([1.0, -2.0])
+        g = nd.array([0.5, -0.5])
+        d, v, z = nd.zeros(2), nd.zeros(2), nd.zeros(2)
+        new_w, d1, v1, z1 = nd.ftml_update(w, g, d, v, z, lr=0.1, t=1)
+        dw = new_w.asnumpy() - w.asnumpy()
+        assert dw[0] < 0 < dw[1]
+
+    def test_mp_nag_matches_fp32_nag(self):
+        w32 = nd.array([1.0, -2.0])
+        g = nd.array([0.1, 0.2])
+        m = nd.zeros(2)
+        ref_w, ref_m = nd.nag_mom_update(w32, g, m, lr=0.1, momentum=0.9)
+        got = nd.mp_nag_mom_update(w32.astype("float16"), g, nd.zeros(2),
+                                   nd.array([1.0, -2.0]), lr=0.1,
+                                   momentum=0.9)
+        onp.testing.assert_allclose(got[2].asnumpy(), ref_w.asnumpy(),
+                                    rtol=1e-6)
+        assert got[0].dtype == onp.float16
+
+    def test_mp_lamb_matches_lamb(self):
+        w = nd.array([1.0, -2.0])
+        g = nd.array([0.1, 0.2])
+        upd, m1, v1 = nd.lamb_update_phase1(w, g, nd.zeros(2), nd.zeros(2),
+                                            t=1)
+        upd_mp, _, _ = nd.mp_lamb_update_phase1(
+            w.astype("float16"), g, nd.zeros(2), nd.zeros(2), w, t=1)
+        onp.testing.assert_allclose(upd_mp.asnumpy(), upd.asnumpy(),
+                                    rtol=1e-5)
+        new_w = nd.lamb_update_phase2(w, upd, nd.array([1.0]),
+                                      nd.array([1.0]), lr=0.01)
+        got_w, got_w32 = nd.mp_lamb_update_phase2(
+            w.astype("float16"), upd, nd.array([1.0]), nd.array([1.0]), w,
+            lr=0.01)
+        onp.testing.assert_allclose(got_w32.asnumpy(), new_w.asnumpy(),
+                                    rtol=1e-5)
+
+
+class TestIm2Col:
+    def test_round_trip_shapes_and_adjoint(self):
+        rs = onp.random.RandomState(0)
+        x = nd.array(rs.randn(2, 3, 6, 6).astype("f"))
+        col = nd.im2col(x, kernel=(3, 3), stride=(1, 1), pad=(1, 1))
+        assert col.shape == (2, 27, 36)
+        c = nd.array(rs.randn(*col.shape).astype("f"))
+        back = nd.col2im(c, output_size=(6, 6), kernel=(3, 3), stride=(1, 1),
+                         pad=(1, 1))
+        assert back.shape == x.shape
+        # adjointness: <im2col(x), c> == <x, col2im(c)>
+        lhs = float((col * c).sum().asnumpy())
+        rhs = float((x * back).sum().asnumpy())
+        onp.testing.assert_allclose(lhs, rhs, rtol=1e-4)
+
+    def test_col2im_reconstructs_average(self):
+        # stride=kernel (no overlap): col2im(im2col(x)) == x exactly
+        x = nd.array(onp.arange(16, dtype="f").reshape(1, 1, 4, 4))
+        col = nd.im2col(x, kernel=(2, 2), stride=(2, 2))
+        back = nd.col2im(col, output_size=(4, 4), kernel=(2, 2),
+                         stride=(2, 2))
+        onp.testing.assert_allclose(back.asnumpy(), x.asnumpy())
+
+
+class TestLegacyAndMisc:
+    def test_v1_aliases(self):
+        x = nd.ones((1, 3, 8, 8))
+        w = nd.ones((4, 3, 1, 1))
+        y = nd.Convolution_v1(x, w, kernel=(1, 1), num_filter=4,
+                              no_bias=True)
+        assert y.shape == (1, 4, 8, 8)
+        p = nd.Pooling_v1(x, kernel=(2, 2), stride=(2, 2))
+        assert p.shape == (1, 3, 4, 4)
+
+    def test_crop(self):
+        x = nd.array(onp.arange(36, dtype="f").reshape(1, 1, 6, 6))
+        y = nd.Crop(x, offset=(1, 2), h_w=(3, 3))
+        onp.testing.assert_allclose(y.asnumpy()[0, 0, 0], [8, 9, 10])
+        ref = nd.zeros((1, 1, 2, 2))
+        y = nd.Crop(x, ref, center_crop=True)
+        assert y.shape == (1, 1, 2, 2)
+
+    def test_softmax_cross_entropy_matches_manual(self):
+        rs = onp.random.RandomState(0)
+        logits = rs.randn(4, 5).astype("f")
+        labels = onp.array([0, 2, 4, 1], "f")
+        got = float(nd.softmax_cross_entropy(
+            nd.array(logits), nd.array(labels)).asnumpy())
+        p = onp.exp(logits - logits.max(1, keepdims=True))
+        p /= p.sum(1, keepdims=True)
+        want = -onp.log(p[onp.arange(4), labels.astype(int)]).sum()
+        onp.testing.assert_allclose(got, want, rtol=1e-5)
+
+    def test_mish(self):
+        x = nd.array([0.0, 1.0, -1.0])
+        got = nd.mish(x).asnumpy()
+        want = x.asnumpy() * onp.tanh(onp.log1p(onp.exp(x.asnumpy())))
+        onp.testing.assert_allclose(got, want, rtol=1e-5)
+
+    def test_kl_sparse_reg_backward_adds_penalty(self):
+        from mxnet_tpu import autograd
+        x = nd.array(onp.full((4, 3), 0.5, "f"))
+        x.attach_grad()
+        with autograd.record():
+            y = nd.IdentityAttachKLSparseReg(x, sparseness_target=0.2,
+                                             penalty=0.01)
+            loss = y.sum()
+        loss.backward()
+        # identity grad (1) + penalty*(-rho/0.5 + (1-rho)/0.5)
+        want = 1.0 + 0.01 * (-0.2 / 0.5 + 0.8 / 0.5)
+        onp.testing.assert_allclose(x.grad.asnumpy(),
+                                    onp.full((4, 3), want), rtol=1e-5)
+
+    def test_triangular_pack_unpack(self):
+        v = nd.array([1.0, 2.0, 3.0, 4.0, 5.0, 6.0])
+        t = nd.linalg_maketrian(v)
+        onp.testing.assert_allclose(
+            t.asnumpy(), [[1, 0, 0], [2, 3, 0], [4, 5, 6]])
+        onp.testing.assert_allclose(nd.linalg_extracttrian(t).asnumpy(),
+                                    v.asnumpy())
+        u = nd.linalg_maketrian(v, lower=False)
+        onp.testing.assert_allclose(
+            nd.linalg_extracttrian(u, lower=False).asnumpy(), v.asnumpy())
+
+    def test_indexing_legacy_ops(self):
+        l = nd.array([[1.0, 2.0], [3.0, 4.0]])
+        r = nd.array([1.0, 0.0])
+        onp.testing.assert_allclose(
+            nd.choose_element_0index(l, r).asnumpy(), [2.0, 3.0])
+        filled = nd.fill_element_0index(l, nd.array([9.0, 8.0]), r)
+        onp.testing.assert_allclose(filled.asnumpy(), [[1, 9], [8, 4]])
+        idx = nd.array([[0], [1]]).astype("int32")
+        got = nd.scatter_set_nd(l, nd.array([5.0]), idx, shape=(2, 2))
+        onp.testing.assert_allclose(got.asnumpy(), [[1, 5], [3, 4]])
+
+    def test_cast_storage(self):
+        a = nd.array([[1.0, 0.0], [0.0, 2.0]])
+        c = nd.cast_storage(a, "csr")
+        assert c.stype == "csr"
+        assert nd.cast_storage(c, "default").stype == "default"
+        rs = nd.cast_storage(a, "row_sparse")
+        assert rs.stype == "row_sparse"
