@@ -353,3 +353,105 @@ def save(fname, data):
 __all__ = sorted(n for n in globals() if not n.startswith("_")
                  and n not in ("threading", "NDArray", "MXNetError",
                                "np_ndarray"))
+
+
+def masked_softmax(data, mask=None, axis=-1, temperature=1.0, **kwargs):
+    if mask is None:  # reference: mask=None means plain softmax
+        return softmax(data, axis=axis, temperature=temperature)
+    return _op_call("masked_softmax", [data, mask],
+                    {"axis": axis, "temperature": temperature})
+
+
+def masked_log_softmax(data, mask=None, axis=-1, temperature=1.0, **kwargs):
+    if mask is None:
+        return log_softmax(data, axis=axis)
+    return _op_call("masked_log_softmax", [data, mask],
+                    {"axis": axis, "temperature": temperature})
+
+
+def deconvolution(data, weight, bias=None, *, kernel=(), stride=(),
+                  dilate=(), pad=(), adj=(), num_filter=1, num_group=1,
+                  no_bias=False, target_shape=(), layout=None, **kwargs):
+    tensors = [data, weight] + ([bias] if bias is not None else [])
+    return _op_call("Deconvolution", tensors,
+                    {"kernel": kernel, "stride": stride, "dilate": dilate,
+                     "pad": pad, "adj": adj, "num_filter": num_filter,
+                     "num_group": num_group,
+                     "no_bias": bias is None or no_bias,
+                     "target_shape": target_shape, "layout": layout})
+
+
+def group_norm(data, gamma, beta, num_groups=1, eps=1e-5, **kwargs):
+    return _op_call("GroupNorm", [data, gamma, beta],
+                    {"num_groups": num_groups, "eps": eps})
+
+
+def instance_norm(data, gamma, beta, eps=1e-3, **kwargs):
+    return _op_call("InstanceNorm", [data, gamma, beta], {"eps": eps})
+
+
+def l2_normalization(data, eps=1e-10, mode="instance", **kwargs):
+    return _op_call("L2Normalization", [data], {"eps": eps, "mode": mode})
+
+
+def sequence_last(data, sequence_length=None, use_sequence_length=False,
+                  axis=0, **kwargs):
+    tensors = [data] + ([sequence_length]
+                        if sequence_length is not None else [])
+    return _op_call("SequenceLast", tensors,
+                    {"use_sequence_length": use_sequence_length
+                     or sequence_length is not None, "axis": axis})
+
+
+def sequence_reverse(data, sequence_length=None, use_sequence_length=False,
+                     axis=0, **kwargs):
+    tensors = [data] + ([sequence_length]
+                        if sequence_length is not None else [])
+    return _op_call("SequenceReverse", tensors,
+                    {"use_sequence_length": use_sequence_length
+                     or sequence_length is not None, "axis": axis})
+
+
+def ctc_loss(data, label, data_lengths=None, label_lengths=None, **kwargs):
+    # the op binds (data, label, data_lengths, label_lengths) POSITIONALLY:
+    # when only label_lengths is given, a full-length data_lengths tensor
+    # must occupy the third slot
+    tensors = [data, label]
+    attrs = {}
+    if label_lengths is not None and data_lengths is None:
+        from ..numpy import full as _np_full
+
+        data_lengths = _np_full((label.shape[0],), data.shape[0])
+    if data_lengths is not None:
+        tensors.append(data_lengths)
+        attrs["use_data_lengths"] = True
+    if label_lengths is not None:
+        tensors.append(label_lengths)
+        attrs["use_label_lengths"] = True
+    return _op_call("CTCLoss", tensors, attrs)
+
+
+def roi_pooling(data, rois, pooled_size=(1, 1), spatial_scale=1.0,
+                **kwargs):
+    return _op_call("ROIPooling", [data, rois],
+                    {"pooled_size": pooled_size,
+                     "spatial_scale": spatial_scale})
+
+
+def scatter_nd(data, indices, shape, **kwargs):
+    return _op_call("scatter_nd", [data, indices], {"shape": shape})
+
+
+def slice(data, begin, end, step=None, **kwargs):
+    return _op_call("slice", [data],
+                    {"begin": begin, "end": end, "step": step})
+
+
+def slice_axis(data, axis, begin, end, **kwargs):
+    return _op_call("slice_axis", [data],
+                    {"axis": axis, "begin": begin, "end": end})
+
+
+__all__ = sorted(n for n in globals() if not n.startswith("_")
+                 and n not in ("threading", "NDArray", "MXNetError",
+                               "np_ndarray", "annotations"))

@@ -491,8 +491,46 @@ def pooling(data, *, kernel=(), pool_type="max", stride=(), pad=(),
 
 @register("ROIPooling")
 def roi_pooling(data, rois, *, pooled_size=(), spatial_scale=1.0):
-    # reference: src/operator/roi_pooling.cc — simplified dense version
-    raise NotImplementedError("ROIPooling: use _contrib_ROIAlign")
+    """reference: src/operator/roi_pooling.cc — quantized-bin max pooling.
+
+    Bin i spans [floor(i*rh/ph), ceil((i+1)*rh/ph)) like the reference
+    (bins may overlap by one row/col). Dense masked-max formulation:
+    data-dependent bin edges become boolean masks over the feature map, a
+    per-axis reduction each — no dynamic shapes, XLA-friendly.
+    """
+    ph, pw = pooled_size
+    n, c, h_, w_ = data.shape
+
+    def one(roi):
+        b = roi[0].astype(jnp.int32)
+        # reference round() is half-AWAY-from-zero; coords are >= 0 so
+        # floor(x + 0.5) reproduces it (jnp.round is half-to-even)
+        x1 = jnp.floor(roi[1] * spatial_scale + 0.5).astype(jnp.int32)
+        y1 = jnp.floor(roi[2] * spatial_scale + 0.5).astype(jnp.int32)
+        x2 = jnp.floor(roi[3] * spatial_scale + 0.5).astype(jnp.int32)
+        y2 = jnp.floor(roi[4] * spatial_scale + 0.5).astype(jnp.int32)
+        rh = jnp.maximum(y2 - y1 + 1, 1).astype(jnp.float32)
+        rw = jnp.maximum(x2 - x1 + 1, 1).astype(jnp.float32)
+        img = jnp.take(data, b, axis=0)  # (C, H, W)
+        hs = jnp.arange(h_)[:, None]
+        ws = jnp.arange(w_)[:, None]
+        iy = jnp.arange(ph)[None]
+        ix = jnp.arange(pw)[None]
+        hstart = y1 + jnp.floor(iy * rh / ph).astype(jnp.int32)
+        hend = y1 + jnp.ceil((iy + 1) * rh / ph).astype(jnp.int32)
+        wstart = x1 + jnp.floor(ix * rw / pw).astype(jnp.int32)
+        wend = x1 + jnp.ceil((ix + 1) * rw / pw).astype(jnp.int32)
+        ymask = (hs >= hstart) & (hs < hend) & (hs >= 0) & (hs < h_)
+        xmask = (ws >= wstart) & (ws < wend) & (ws >= 0) & (ws < w_)
+        neg = jnp.array(-jnp.inf, dtype=jnp.float32)
+        # reduce W first: (C, H, pw), then H: (C, ph, pw)
+        tmp = jnp.max(jnp.where(xmask[None, None], img.astype(
+            jnp.float32)[..., None], neg), axis=2)
+        out = jnp.max(jnp.where(ymask[None, :, :, None],
+                                tmp[:, :, None, :], neg), axis=1)
+        return jnp.where(jnp.isfinite(out), out, 0.0).astype(data.dtype)
+
+    return jax.vmap(one)(rois.astype(jnp.float32))
 
 
 # ---------------------------------------------------------------------------

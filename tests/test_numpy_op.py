@@ -579,3 +579,93 @@ class TestNpSurfaceAdditions:
         onp.put_along_axis(h, onp.array([[0, 1], [1, 0]]),
                            onp.array([[1.0, 2.0], [3.0, 4.0]], onp.float32), 1)
         onp.testing.assert_allclose(e.asnumpy(), h)
+
+
+class TestNpxOpBackedAdditions:
+    """Round-4 npx tail: op-backed wrappers upstream gluon-numpy models
+    call (masked softmax, deconv, norms, sequence ops, ctc, roi, slices)."""
+
+    def test_masked_softmax(self):
+        import numpy as onp
+        x = mx.np.array([[1.0, 2.0, 3.0]])
+        m = mx.np.array([[1, 1, 0]]).astype("bool")
+        got = mx.npx.masked_softmax(x, m).asnumpy()
+        e = onp.exp([1.0, 2.0])
+        onp.testing.assert_allclose(got[0, :2], e / e.sum(), rtol=1e-5)
+        assert got[0, 2] == 0.0
+        lg = mx.npx.masked_log_softmax(x, m).asnumpy()
+        onp.testing.assert_allclose(lg[0, :2], onp.log(e / e.sum()),
+                                    rtol=1e-5)
+
+    def test_slices(self):
+        import numpy as onp
+        a = mx.np.arange(10)
+        onp.testing.assert_allclose(
+            mx.npx.slice(a, (2,), (8,), (2,)).asnumpy(), [2, 4, 6])
+        b = mx.np.arange(10).reshape(2, 5)
+        assert mx.npx.slice_axis(b, 1, 1, 3).shape == (2, 2)
+
+    def test_deconv_and_norms(self):
+        import numpy as onp
+        rs = onp.random.RandomState(0)
+        d = mx.np.array(rs.randn(1, 2, 4, 4).astype("f"))
+        w = mx.np.array(rs.randn(2, 3, 2, 2).astype("f"))
+        assert mx.npx.deconvolution(d, w, kernel=(2, 2), stride=(2, 2),
+                                    num_filter=3).shape == (1, 3, 8, 8)
+        g, b = mx.np.ones((2,)), mx.np.zeros((2,))
+        assert mx.npx.instance_norm(d, g, b).shape == (1, 2, 4, 4)
+        assert mx.npx.group_norm(d, g, b, num_groups=2).shape == (1, 2, 4, 4)
+        x = mx.np.array([[1.0, 2.0, 3.0]])
+        n = mx.npx.l2_normalization(x).asnumpy()
+        onp.testing.assert_allclose((n ** 2).sum(), 1.0, rtol=1e-5)
+
+    def test_sequence_ops_and_scatter(self):
+        import numpy as onp
+        rs = onp.random.RandomState(0)
+        s = mx.np.array(rs.randn(3, 2, 4).astype("f"))
+        sl = mx.np.array([2.0, 3.0])
+        last = mx.npx.sequence_last(s, sl)
+        onp.testing.assert_allclose(last.asnumpy()[0], s.asnumpy()[1, 0],
+                                    rtol=1e-6)
+        rev = mx.npx.sequence_reverse(s, sl)
+        onp.testing.assert_allclose(rev.asnumpy()[0, 0], s.asnumpy()[1, 0],
+                                    rtol=1e-6)
+        got = mx.npx.scatter_nd(mx.np.array([5.0]),
+                                mx.np.array([[1]]).astype("int32"), (3,))
+        onp.testing.assert_allclose(got.asnumpy(), [0, 5, 0])
+
+    def test_ctc_and_roi(self):
+        import numpy as onp
+        rs = onp.random.RandomState(0)
+        # CTC: (seq, batch, alphabet)
+        data = mx.np.array(rs.rand(6, 1, 5).astype("f"))
+        label = mx.np.array([[1.0, 2.0]])
+        loss = mx.npx.ctc_loss(data, label)
+        assert float(loss.asnumpy().ravel()[0]) > 0
+        feat = mx.np.array(rs.rand(1, 2, 8, 8).astype("f"))
+        rois = mx.np.array([[0.0, 0.0, 0.0, 4.0, 4.0]])
+        out = mx.npx.roi_pooling(feat, rois, pooled_size=(2, 2),
+                                 spatial_scale=1.0)
+        assert out.shape == (1, 2, 2, 2)
+
+    def test_npx_wrapper_review_regressions(self):
+        """masked_softmax without mask = plain softmax; deconvolution
+        honors a supplied bias; ctc_loss with only label_lengths binds
+        positionally correct; additions appear in __all__."""
+        import numpy as onp
+        x = mx.np.array([[1.0, 2.0, 3.0]])
+        onp.testing.assert_allclose(
+            mx.npx.masked_softmax(x).asnumpy().sum(), 1.0, rtol=1e-5)
+        d = mx.np.ones((1, 1, 2, 2))
+        w = mx.np.ones((1, 1, 1, 1))
+        b = mx.np.array([100.0])
+        out = mx.npx.deconvolution(d, w, b, kernel=(1, 1), num_filter=1)
+        assert float(out.asnumpy().ravel()[0]) == 101.0
+        data = mx.np.array(onp.random.RandomState(0).rand(6, 1, 5)
+                           .astype("f"))
+        loss = mx.npx.ctc_loss(data, mx.np.array([[1.0, 2.0]]),
+                               label_lengths=mx.np.array([2.0]))
+        assert float(loss.asnumpy().ravel()[0]) > 0
+        for name in ("masked_softmax", "ctc_loss", "deconvolution",
+                     "slice_axis"):
+            assert name in mx.npx.__all__

@@ -205,3 +205,41 @@ class TestLegacyAndMisc:
         assert nd.cast_storage(c, "default").stype == "default"
         rs = nd.cast_storage(a, "row_sparse")
         assert rs.stype == "row_sparse"
+
+
+class TestROIPooling:
+    """reference roi_pooling.cc bin rules (floor/ceil edges, overlap)."""
+
+    @staticmethod
+    def _ref(data, rois, ph, pw, scale):
+        R = rois.shape[0]
+        C, H, W = data.shape[1:]
+        out = onp.zeros((R, C, ph, pw), data.dtype)
+        for r, roi in enumerate(rois):
+            b = int(roi[0])
+            x1, y1, x2, y2 = [int(onp.floor(v * scale + 0.5))
+                              for v in roi[1:]]
+            rh = max(y2 - y1 + 1, 1)
+            rw = max(x2 - x1 + 1, 1)
+            for i in range(ph):
+                for j in range(pw):
+                    hs = max(y1 + int(onp.floor(i * rh / ph)), 0)
+                    he = min(y1 + int(onp.ceil((i + 1) * rh / ph)), H)
+                    ws = max(x1 + int(onp.floor(j * rw / pw)), 0)
+                    we = min(x1 + int(onp.ceil((j + 1) * rw / pw)), W)
+                    patch = data[b, :, hs:he, ws:we]
+                    out[r, :, i, j] = patch.max(axis=(1, 2)) \
+                        if patch.size else 0.0
+        return out
+
+    def test_matches_oracle(self):
+        rs = onp.random.RandomState(0)
+        data = rs.rand(2, 3, 12, 12).astype("f")
+        rois = onp.array([[0, 0, 0, 7, 7], [1, 2, 2, 11, 9],
+                          [0, 3, 1, 6, 6]], "f")
+        for (ph, pw), scale in (((3, 3), 1.0), ((2, 4), 0.5)):
+            got = nd.ROIPooling(nd.array(data), nd.array(rois),
+                                pooled_size=(ph, pw),
+                                spatial_scale=scale).asnumpy()
+            onp.testing.assert_allclose(
+                got, self._ref(data, rois, ph, pw, scale), rtol=1e-5)
