@@ -375,10 +375,30 @@ def deconvolution(data, weight, bias=None, *, kernel=(), stride=(), dilate=(),
         lo = k_eff - 1 - pad[i]
         hi = k_eff - 1 - pad[i] + adj[i]
         padding.append((lo, hi))
-    out = jax.lax.conv_transpose(
-        data, weight.astype(data.dtype), strides=stride, padding=padding,
-        rhs_dilation=dilate, dimension_numbers=dn, transpose_kernel=False,
-    )
+    if num_group > 1:
+        # lax.conv_transpose has no group support; the equivalent
+        # lhs-dilated conv does. Deconv weight (I, O/g, k, k) becomes a
+        # conv weight (O, I/g, k, k) by per-group channel transpose only.
+        g = num_group
+        i_ch = weight.shape[0]
+        og = weight.shape[1]
+        wt = weight.reshape((g, i_ch // g, og) + tuple(weight.shape[2:]))
+        wt = jnp.swapaxes(wt, 1, 2).reshape((g * og, i_ch // g)
+                                            + tuple(weight.shape[2:]))
+        # NO spatial flip: matches lax.conv_transpose(transpose_kernel=
+        # False), the convention the ungrouped path (and MXNet) uses
+        dn2 = jax.lax.conv_dimension_numbers(
+            data.shape, wt.shape, (lhs, "OI" + spatial, lhs))
+        out = jax.lax.conv_general_dilated(
+            data, wt.astype(data.dtype), window_strides=(1,) * nd,
+            padding=padding, lhs_dilation=stride, rhs_dilation=dilate,
+            dimension_numbers=dn2, feature_group_count=g)
+    else:
+        out = jax.lax.conv_transpose(
+            data, weight.astype(data.dtype), strides=stride,
+            padding=padding, rhs_dilation=dilate, dimension_numbers=dn,
+            transpose_kernel=False,
+        )
     out = out.astype(data.dtype)
     if not no_bias and bias is not None:
         bshape = [1] * out.ndim
@@ -1120,10 +1140,36 @@ def upsampling(*data, scale=1, sample_type="nearest", num_args=1,
                num_filter=0, multi_input_mode="concat", workspace=512):
     x = data[0]
     if sample_type == "nearest":
-        n, c, h, w = x.shape
-        out = jnp.repeat(jnp.repeat(x, scale, axis=2), scale, axis=3)
-        return out
-    raise NotImplementedError("UpSampling bilinear: use contrib.BilinearResize2D")
+        # reference upsampling.cc: EVERY input is upsampled to the common
+        # output size data[0].shape * scale — inputs may have different
+        # resolutions (FPN-style), each gets its own integer factor
+        out_h, out_w = x.shape[2] * scale, x.shape[3] * scale
+        ups = [jnp.repeat(jnp.repeat(d, out_h // d.shape[2], axis=2),
+                          out_w // d.shape[3], axis=3)
+               for d in data]
+        if len(ups) == 1:
+            return ups[0]
+        if multi_input_mode == "sum":
+            out = ups[0]
+            for u in ups[1:]:
+                out = out + u
+            return out
+        return jnp.concatenate(ups, axis=1)
+    if sample_type == "bilinear":
+        # reference upsampling.cc: bilinear mode IS a Deconvolution with a
+        # caller-supplied (usually bilinear-initialized, learnable) kernel:
+        # kernel=2*scale-scale%2, stride=scale, pad=ceil((scale-1)/2)
+        if len(data) < 2:
+            raise ValueError(
+                "UpSampling(sample_type='bilinear') needs a weight input "
+                "(reference: upsampling.cc bilinear = Deconvolution)")
+        w = data[1]  # (C, 1, k, k): depthwise bilinear kernel, learnable
+        k = 2 * scale - scale % 2
+        p = scale // 2
+        return deconvolution(
+            x, w, None, kernel=(k, k), stride=(scale, scale), pad=(p, p),
+            num_filter=x.shape[1], num_group=x.shape[1], no_bias=True)
+    raise ValueError(f"UpSampling: unknown sample_type {sample_type!r}")
 
 
 @register("_contrib_BilinearResize2D", aliases=["BilinearResize2D"])

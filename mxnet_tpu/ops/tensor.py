@@ -410,10 +410,10 @@ def argmax_channel(data):
 @register("topk")
 def topk(data, *, axis=-1, k=1, ret_typ="indices", is_ascend=False, dtype="float32"):
     d = -data if is_ascend else data
-    sel_vals, idx = jax.lax.top_k(jnp.moveaxis(d, axis, -1), k)
+    sel_vals, raw_idx = jax.lax.top_k(jnp.moveaxis(d, axis, -1), k)
     vals = -sel_vals if is_ascend else sel_vals
     vals = jnp.moveaxis(vals, -1, axis)
-    idx = jnp.moveaxis(idx, -1, axis).astype(jnp.dtype(dtype))
+    idx = jnp.moveaxis(raw_idx, -1, axis).astype(jnp.dtype(dtype))
     if ret_typ == "indices":
         return idx
     if ret_typ == "value":
@@ -421,7 +421,13 @@ def topk(data, *, axis=-1, k=1, ret_typ="indices", is_ascend=False, dtype="float
     if ret_typ == "both":
         return vals, idx
     if ret_typ == "mask":
-        raise NotImplementedError("topk ret_typ='mask'")
+        # 1 at each top-k position along axis, 0 elsewhere (reference
+        # ordering_op.cc ret_typ=mask). Built from the RAW integer
+        # indices — the dtype-cast idx (default float32) corrupts indices
+        # past 2^24.
+        n = data.shape[axis]
+        mask = jax.nn.one_hot(raw_idx, n, dtype=data.dtype).sum(axis=-2)
+        return jnp.moveaxis(mask, -1, axis)
     raise ValueError(ret_typ)
 
 

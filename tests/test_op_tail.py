@@ -243,3 +243,57 @@ class TestROIPooling:
                                 spatial_scale=scale).asnumpy()
             onp.testing.assert_allclose(
                 got, self._ref(data, rois, ph, pw, scale), rtol=1e-5)
+
+
+class TestUpsamplingAndGroupedDeconv:
+    def test_topk_mask(self):
+        x = nd.array([[3.0, 1.0, 2.0], [0.0, 5.0, 4.0]])
+        m = nd.topk(x, k=2, ret_typ="mask")
+        onp.testing.assert_allclose(m.asnumpy(), [[1, 0, 1], [0, 1, 1]])
+
+    def test_grouped_deconvolution_matches_per_group(self):
+        rs = onp.random.RandomState(0)
+        x = nd.array(rs.randn(2, 4, 5, 5).astype("f"))
+        w = nd.array(rs.randn(4, 2, 3, 3).astype("f"))
+        got = nd.Deconvolution(x, w, kernel=(3, 3), stride=(2, 2),
+                               pad=(1, 1), num_filter=4, num_group=2)
+        outs = []
+        for gi in range(2):
+            xg = nd.array(x.asnumpy()[:, gi * 2:(gi + 1) * 2])
+            wg = nd.array(w.asnumpy()[gi * 2:(gi + 1) * 2])
+            outs.append(nd.Deconvolution(
+                xg, wg, kernel=(3, 3), stride=(2, 2), pad=(1, 1),
+                num_filter=2).asnumpy())
+        onp.testing.assert_allclose(got.asnumpy(),
+                                    onp.concatenate(outs, axis=1),
+                                    rtol=1e-4, atol=1e-4)
+
+    def test_bilinear_upsampling_constant_preserving(self):
+        """UpSampling bilinear = depthwise deconv with the caller's
+        kernel (reference upsampling.cc); the standard bilinear-init
+        kernel must reproduce a constant image in the interior."""
+        scale, c_ch = 2, 3
+        k = 2 * scale - scale % 2
+        f = (k + 1) // 2
+        ctr = (2 * f - 1 - f % 2) / (2.0 * f)
+        og = onp.ogrid[:k, :k]
+        filt = (1 - abs(og[0] / f - ctr)) * (1 - abs(og[1] / f - ctr))
+        w = onp.zeros((c_ch, 1, k, k), "f")
+        w[:, 0] = filt
+        x = nd.ones((1, c_ch, 4, 4))
+        y = nd.UpSampling(x, nd.array(w), scale=scale,
+                          sample_type="bilinear", num_args=2)
+        assert y.shape == (1, c_ch, 8, 8)
+        assert onp.allclose(y.asnumpy()[0, :, 2:6, 2:6], 1.0, atol=1e-5)
+
+    def test_nearest_multi_input_concat(self):
+        a, b = nd.ones((1, 2, 3, 3)), nd.zeros((1, 1, 3, 3))
+        out = nd.UpSampling(a, b, scale=2, sample_type="nearest",
+                            num_args=2)
+        assert out.shape == (1, 3, 6, 6)
+        # different-resolution inputs upsample to the COMMON output size
+        # (reference: per-input factor toward data[0].shape * scale)
+        a, b = nd.ones((1, 2, 4, 4)), nd.zeros((1, 1, 2, 2))
+        out = nd.UpSampling(a, b, scale=2, sample_type="nearest",
+                            num_args=2)
+        assert out.shape == (1, 3, 8, 8)
