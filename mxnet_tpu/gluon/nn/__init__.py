@@ -2,3 +2,6 @@
 from .activations import *  # noqa: F401,F403
 from .basic_layers import *  # noqa: F401,F403
 from .conv_layers import *  # noqa: F401,F403
+
+# upstream exposes the Block bases through gluon.nn as well
+from ..block import Block, HybridBlock, SymbolBlock  # noqa: F401,E402

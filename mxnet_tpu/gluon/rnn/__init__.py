@@ -1,6 +1,7 @@
 """Gluon recurrent layers + cells (reference: python/mxnet/gluon/rnn/)."""
 from .rnn_cell import (BidirectionalCell, DropoutCell, GRUCell,  # noqa: F401
-                       HybridRecurrentCell, LSTMCell, ModifierCell,
+                       HybridRecurrentCell, HybridSequentialRNNCell,
+                       LSTMCell, ModifierCell,
                        RecurrentCell, ResidualCell, RNNCell,
                        SequentialRNNCell, ZoneoutCell)
 from .rnn_layer import GRU, LSTM, RNN  # noqa: F401

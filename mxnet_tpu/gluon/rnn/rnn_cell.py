@@ -13,7 +13,7 @@ from ..block import HybridBlock
 
 __all__ = ["RecurrentCell", "HybridRecurrentCell", "RNNCell", "LSTMCell",
            "GRUCell", "SequentialRNNCell", "DropoutCell", "ZoneoutCell",
-           "ResidualCell", "BidirectionalCell", "ModifierCell"]
+           "ResidualCell", "BidirectionalCell", "ModifierCell", "HybridSequentialRNNCell"]
 
 
 def _format_sequence(length, inputs, layout, merge):
@@ -422,3 +422,9 @@ class BidirectionalCell(RecurrentCell):
             t_axis = layout.find("T")
             outputs = F.stack(*outputs, axis=t_axis)
         return outputs, l_states + r_states
+
+
+class HybridSequentialRNNCell(SequentialRNNCell):
+    """Hybridizable sequential cell container (reference: rnn_cell.py ::
+    HybridSequentialRNNCell — identical semantics here, where every cell
+    container is already trace/jit-compatible)."""

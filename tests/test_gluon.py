@@ -373,3 +373,17 @@ def test_dataloader_shm_structure_matches_inprocess(monkeypatch):
     got = list(gluon.data.DataLoader(ds, batch_size=4, num_workers=2))
     assert type(ref[0]) is type(got[0]) and len(ref[0]) == len(got[0]) == 1
     np.testing.assert_allclose(got[0][0].asnumpy(), ref[0][0].asnumpy())
+
+
+def test_nn_exposes_block_bases_and_hybrid_sequential_cell():
+    """Upstream surface: gluon.nn.Block/HybridBlock/SymbolBlock aliases and
+    rnn.HybridSequentialRNNCell exist."""
+    from mxnet_tpu.gluon import nn as gnn, rnn as grnn
+    assert gnn.Block is mx.gluon.Block
+    assert gnn.HybridBlock is mx.gluon.HybridBlock
+    cell = grnn.HybridSequentialRNNCell()
+    cell.add(grnn.LSTMCell(8, input_size=4))
+    cell.initialize()
+    x = mx.nd.ones((2, 4))
+    out, _ = cell(x, cell.begin_state(batch_size=2, func=mx.nd.zeros))
+    assert out.shape == (2, 8)
