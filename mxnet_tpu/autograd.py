@@ -118,14 +118,26 @@ def predict_mode():
 class TapeNode:
     """One recorded op (reference: nnvm::Node on the autograd tape)."""
 
-    __slots__ = ("vjp_fn", "inputs", "outputs", "out_avals", "name")
+    __slots__ = ("vjp_fn", "inputs", "outputs", "out_avals", "name",
+                 "primal_fn", "primal_vals", "in_versions")
 
-    def __init__(self, vjp_fn, inputs, outputs, out_avals, name=""):
+    def __init__(self, vjp_fn, inputs, outputs, out_avals, name="",
+                 primal_fn=None, primal_vals=None):
         self.vjp_fn = vjp_fn  # cotangents(tuple matching outputs) -> input cotangents
         self.inputs = inputs  # list[NDArray] — all tensor inputs
         self.outputs = outputs  # list[NDArray] — produced arrays
         self.out_avals = out_avals  # list[(shape, dtype)]
         self.name = name
+        # create_graph support: the pure primal fn + its positional raw
+        # values (aligned with `inputs`), so the sweep can RE-linearize
+        # with the primals as live tape inputs (the stored pullback holds
+        # them as closure constants, invisible to a second differentiation)
+        self.primal_fn = primal_fn
+        self.primal_vals = primal_vals
+        # input version counters at record time: create_graph re-reads
+        # the inputs' LIVE data, so in-place mutation after the forward
+        # must be detected (the stored-closure first-order path is immune)
+        self.in_versions = [getattr(a, "_version", None) for a in inputs]
 
 
 def _mark_output(arr, node: TapeNode, index: int) -> None:
@@ -137,9 +149,11 @@ def is_on_tape(arr) -> bool:
     return getattr(arr, "_ag_node", None) is not None or getattr(arr, "_grad_req", "null") != "null"
 
 
-def record_node(vjp_fn, inputs, outputs, name="") -> None:
+def record_node(vjp_fn, inputs, outputs, name="", primal_fn=None,
+                primal_vals=None) -> None:
     avals = [(o.shape, o.dtype) for o in outputs]
-    node = TapeNode(vjp_fn, list(inputs), list(outputs), avals, name)
+    node = TapeNode(vjp_fn, list(inputs), list(outputs), avals, name,
+                    primal_fn=primal_fn, primal_vals=primal_vals)
     for i, o in enumerate(outputs):
         _mark_output(o, node, i)
 
@@ -188,9 +202,6 @@ def grad(heads, variables, head_grads=None, retain_graph=None,
     """Return gradients of heads w.r.t. variables (reference: autograd.grad)."""
     from .ndarray.ndarray import NDArray, _wrap_jax
 
-    if create_graph:
-        raise NotImplementedError(
-            "create_graph=True (higher-order autograd) is not supported yet")
     single = isinstance(variables, NDArray)
     if isinstance(heads, NDArray):
         heads = [heads]
@@ -198,7 +209,8 @@ def grad(heads, variables, head_grads=None, retain_graph=None,
             head_grads = [head_grads]
     if single:
         variables = [variables]
-    acc = _run_backward(heads, head_grads, collect=variables, write_attached=False)
+    acc = _run_backward(heads, head_grads, collect=variables,
+                        write_attached=False, create_graph=create_graph)
     out = []
     for v in variables:
         g = acc.get(id(v))
@@ -206,12 +218,115 @@ def grad(heads, variables, head_grads=None, retain_graph=None,
             raise MXNetError(
                 "cannot differentiate: one of the requested variables is not "
                 "part of the recorded graph")
-        out.append(_wrap_jax(g, v.context))
+        out.append(g if create_graph and isinstance(g, NDArray)
+                   else _wrap_jax(g, v.context))
     return out[0] if single else out
 
 
-def _run_backward(heads, head_grads, collect=None, write_attached=True):
+def _sweep_node_recorded(node, acc, add_grad):
+    """One reverse-sweep step with the vjp routed through the imperative
+    invoke path (create_graph=True).
+
+    The node's stored pullback closes over its primal inputs as CONSTANTS
+    — a second differentiation would see zero sensitivity to them. So the
+    grad op re-linearizes the node's stored pure primal function with the
+    float primal inputs as live tape inputs alongside the cotangents:
+    jax.vjp inside the recorded op gives second-order terms through both.
+    Nodes recorded without a primal (custom autograd.Function backwards)
+    fall back to the closure pullback: gradients flow through their
+    cotangent chain only, matching the reference's contract that a custom
+    Function is only twice-differentiable if written so.
+    """
     import jax.numpy as jnp
+
+    from .ndarray.ndarray import NDArray, _LambdaOp, imperative_invoke
+
+    tensor_cts = []
+    slots = []
+    const_ct = []
+    any_grad = False
+    for j, (o, (shape, dtype)) in enumerate(zip(node.outputs,
+                                                node.out_avals)):
+        g = acc.get(id(o))
+        if g is None:
+            const_ct.append(_zeros_cotangent(shape, dtype))
+        else:
+            any_grad = True
+            if isinstance(g, NDArray) and g.dtype != dtype:
+                g = g.astype(dtype)
+            slots.append(j)
+            tensor_cts.append(g)
+            const_ct.append(None)
+    if not any_grad:
+        return
+    float_in = [i for i, inp in enumerate(node.inputs)
+                if getattr(inp, "dtype", None) is not None
+                and jnp.issubdtype(jnp.dtype(inp.dtype), jnp.floating)]
+    if not float_in:
+        return
+    n_ct = len(tensor_cts)
+
+    if node.primal_fn is None:
+        raise MXNetError(
+            "create_graph=True through a node recorded without a stored "
+            f"primal ({node.name!r} — a custom autograd.Function backward): "
+            "higher-order gradients need the op's pure forward; write the "
+            "custom backward with differentiable ops instead")
+    # the grad op re-reads the inputs' LIVE data; an input mutated in
+    # place since the forward would silently change even the first-order
+    # result — refuse loudly (the stored-closure path is immune)
+    for i in float_in:
+        if getattr(node.inputs[i], "_version", None) != node.in_versions[i]:
+            raise MXNetError(
+                "create_graph=True: an input of recorded op "
+                f"{node.name!r} was mutated in place after the forward "
+                "pass; gradients would be computed at the mutated value")
+    primal_fn, primal_vals = node.primal_fn, node.primal_vals
+
+    def fn(*args):
+        import jax
+
+        cts, prims = args[:n_ct], args[n_ct:]
+        full_ct = list(const_ct)
+        for s, c in zip(slots, cts):
+            full_ct[s] = c
+        ct = tuple(full_ct) if len(full_ct) > 1 else full_ct[0]
+
+        def primal_of(*sel):
+            vals = list(primal_vals)
+            for i, v in zip(float_in, sel):
+                vals[i] = v
+            return primal_fn(*vals)
+
+        _, pull = jax.vjp(primal_of, *prims)
+        gs = pull(ct)
+        return gs if len(gs) > 1 else gs[0]
+
+    op_inputs = tensor_cts + [node.inputs[i] for i in float_in]
+
+    # force_record: the seed cotangent (a fresh ones-constant) is not on
+    # the tape, but the produced gradients must be
+    outs = imperative_invoke(_LambdaOp(fn, f"grad[{node.name}]"),
+                             op_inputs, {}, force_record=True)
+    outs = list(outs) if isinstance(outs, (list, tuple)) else [outs]
+    for i, g in zip(float_in, outs):
+        add_grad(node.inputs[i], g)
+
+
+def _run_backward(heads, head_grads, collect=None, write_attached=True,
+                  create_graph=False):
+    """Reverse accumulation over the tape.
+
+    ``create_graph=True`` (reference: autograd.grad(create_graph=True),
+    higher-order gradients): every vjp call of the sweep runs THROUGH the
+    imperative invoke path on live NDArrays, so if recording is active the
+    returned gradients are themselves on the tape and differentiable —
+    jax pullback closures are pure traced functions, so jax can transpose
+    them again.
+    """
+    import jax.numpy as jnp
+
+    from .ndarray.ndarray import NDArray, _wrap_jax
 
     # grad accumulator keyed by array object identity
     acc = {}
@@ -238,8 +353,10 @@ def _run_backward(heads, head_grads, collect=None, write_attached=True):
                 "call .attach_grad() and compute inside autograd.record()")
         if head_grads is None or head_grads[i] is None:
             hg = jnp.ones(h.shape, dtype=h.dtype)
+            if create_graph:
+                hg = _wrap_jax(hg, h.context)
         else:
-            hg = head_grads[i].data
+            hg = head_grads[i] if create_graph else head_grads[i].data
         add_grad(h, hg)
 
     # collect reachable nodes (reverse topological via iterative DFS
@@ -268,6 +385,9 @@ def _run_backward(heads, head_grads, collect=None, write_attached=True):
 
     # reverse sweep
     for node in reversed(order):
+        if create_graph:
+            _sweep_node_recorded(node, acc, add_grad)
+            continue
         cotangents = []
         any_grad = False
         for o, (shape, dtype) in zip(node.outputs, node.out_avals):

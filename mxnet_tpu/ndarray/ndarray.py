@@ -673,7 +673,8 @@ def _lambda_op(fn, name):
 # ---------------------------------------------------------------------------
 
 
-def imperative_invoke(opdef, tensor_args, attrs, out=None, ctx=None):
+def imperative_invoke(opdef, tensor_args, attrs, out=None, ctx=None,
+                      force_record=False):
     """Execute a registered op on NDArrays.
 
     This is the TPU equivalent of ``MXImperativeInvokeEx →
@@ -708,9 +709,9 @@ def imperative_invoke(opdef, tensor_args, attrs, out=None, ctx=None):
         attrs["_training"] = autograd.is_training()
     rng = random_state.next_key() if opdef.needs_rng else None
 
-    recording = autograd.is_recording() and any(
+    recording = autograd.is_recording() and (force_record or any(
         isinstance(a, NDArray) and autograd.is_on_tape(a) for a in tensor_args
-    )
+    ))
 
     if recording:
         fixed_attrs = dict(attrs)
@@ -749,7 +750,8 @@ def imperative_invoke(opdef, tensor_args, attrs, out=None, ctx=None):
         # tape inputs must align with vjp's positional grads
         autograd.record_node(_TapeVjp(vjp_fn, multi),
                              [a if isinstance(a, NDArray) else _DUMMY for a in nd_inputs],
-                             outputs, name=getattr(opdef, "name", "op"))
+                             outputs, name=getattr(opdef, "name", "op"),
+                             primal_fn=pure, primal_vals=list(vals))
 
     if engine.is_naive():
         for o in outputs:

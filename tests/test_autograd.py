@@ -205,3 +205,106 @@ def test_training_flag_drives_dropout():
     assert np.allclose(y2.asnumpy(), 1.0)  # identity in predict mode
     y3 = mx.nd.Dropout(x, p=0.5, mode="always")
     assert not np.allclose(y3.asnumpy(), 1.0)
+
+
+class TestCreateGraph:
+    """Higher-order autograd (reference: autograd.grad(create_graph=True)).
+
+    The reverse sweep re-linearizes each node's stored pure primal with
+    its float inputs live on the tape, so produced gradients are
+    differentiable again — including through the primal path (d/dx of
+    cos(x)*ct needs x as an input of the grad op, not a closure constant).
+    """
+
+    def test_second_derivative_sin(self):
+        x = mx.nd.array([0.3, 1.1, -0.7])
+        x.attach_grad()
+        with autograd.record():
+            y = mx.nd.sin(x)
+            dx = autograd.grad(y, [x], create_graph=True)[0]
+            loss = dx.sum()
+        loss.backward()
+        np.testing.assert_allclose(x.grad.asnumpy(), -np.sin(x.asnumpy()),
+                                   rtol=1e-5)
+
+    def test_gradient_penalty(self):
+        w = mx.nd.array([[2.0]])
+        w.attach_grad()
+        xv = mx.nd.array([[3.0]])
+        with autograd.record():
+            y = mx.nd.dot(xv, w) * mx.nd.dot(xv, w)
+            g = autograd.grad(y, [w], create_graph=True)[0]
+            pen = (g * g).sum()
+        pen.backward()
+        np.testing.assert_allclose(g.asnumpy(), [[36.0]], rtol=1e-5)
+        np.testing.assert_allclose(w.grad.asnumpy(), [[1296.0]], rtol=1e-5)
+
+    def test_third_order(self):
+        x = mx.nd.array([2.0])
+        x.attach_grad()
+        with autograd.record():
+            y = x * x * x * x
+            g1 = autograd.grad(y, [x], create_graph=True)[0]
+            g2 = autograd.grad(g1, [x], create_graph=True)[0]
+            s = g2.sum()
+        s.backward()
+        np.testing.assert_allclose(x.grad.asnumpy(), [48.0], rtol=1e-5)
+
+    def test_create_graph_false_unchanged(self):
+        x = mx.nd.array([1.0, 2.0])
+        x.attach_grad()
+        with autograd.record():
+            z = (x * x).sum()
+        gz = autograd.grad(z, [x])
+        np.testing.assert_allclose(gz[0].asnumpy(), [2.0, 4.0])
+
+    def test_gradient_penalty_through_dense(self):
+        """out = sum(x W^T + b) => d(out)/dx_i = W row; gp = sum_i |W|^2
+        over 4 rows = 4|W|^2, so d(gp)/dW = 8 W exactly."""
+        from mxnet_tpu.gluon import nn
+        net = nn.Dense(1, in_units=2)
+        net.initialize(mx.init.Xavier())
+        xi = mx.nd.array(np.random.RandomState(0).randn(4, 2).astype("f"))
+        xi.attach_grad()
+        with autograd.record():
+            out = net(xi).sum()
+            gi = autograd.grad(out, [xi], create_graph=True)[0]
+            gp = (gi * gi).sum()
+        gp.backward()
+        w = net.weight.data().asnumpy()
+        np.testing.assert_allclose(net.weight.grad().asnumpy(), 8 * w,
+                                   rtol=1e-4)
+
+    def test_custom_function_raises_under_create_graph(self):
+        class Sq(autograd.Function):
+            def forward(self, x):
+                self.save_for_backward(x)
+                return x * x
+
+            def backward(self, dy):
+                (x,) = self.saved_tensors
+                return 2 * x * dy
+
+        x = mx.nd.array([3.0])
+        x.attach_grad()
+        with autograd.record():
+            y = Sq()(x)
+            with pytest.raises(Exception, match="create_graph"):
+                autograd.grad(y, [x], create_graph=True)
+
+    def test_create_graph_rejects_inplace_mutation(self):
+        """In-place writes INSIDE record() are already refused at the
+        NDArray layer; a write after the scope closes is legal, but
+        create_graph would then re-linearize at the mutated value — the
+        version-counter guard refuses instead of silently diverging from
+        the stored-closure first-order result."""
+        x = mx.nd.array([3.0])
+        x.attach_grad()
+        with autograd.record():
+            y = x * x
+        x[:] = 100.0
+        # first-order path: immune (stored closure) — still 2*3
+        g = autograd.grad(y, [x])
+        np.testing.assert_allclose(g[0].asnumpy(), [6.0])
+        with pytest.raises(Exception, match="mutated"):
+            autograd.grad(y, [x], create_graph=True)
