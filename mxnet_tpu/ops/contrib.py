@@ -75,9 +75,12 @@ def interleaved_matmul_encdec_valatt(keys_values, attention, *, heads=1):
     return out.reshape(qseq, batch, -1)
 
 
-@register("_contrib_index_copy")
+@register("_contrib_index_copy", aliases=["index_copy"])
 def index_copy(old_tensor, index_vector, new_tensor):
-    return old_tensor.at[index_vector.astype(jnp.int32)].set(new_tensor)
+    # reference: src/operator/contrib/index_copy.cc — rows of old_tensor
+    # at index_vector replaced by rows of new_tensor
+    return old_tensor.at[index_vector.astype(jnp.int32)].set(
+        new_tensor.astype(old_tensor.dtype))
 
 
 @register("_contrib_index_array", aliases=["index_array"])
@@ -322,3 +325,122 @@ def quantized_conv(data, weight_q, w_scale, bias=None, *, kernel,
         xq, w, bias, kernel=kernel, num_filter=num_filter, stride=stride,
         pad=pad, dilate=dilate, num_group=num_group, layout=layout,
         no_bias=no_bias or bias is None)
+
+
+@register("_contrib_quadratic", aliases=["quadratic"])
+def quadratic(data, *, a=0.0, b=0.0, c=0.0):
+    # reference: src/operator/contrib/quadratic_op.cc (the tutorial op)
+    return a * data * data + b * data + c
+
+
+@register("_contrib_allclose", aliases=["allclose_op"])
+def allclose_op(a, b, *, rtol=1e-5, atol=1e-8, equal_nan=True):
+    # reference: src/operator/contrib/allclose_op.cc — 1 if all close
+    return jnp.all(jnp.isclose(a, b, rtol=rtol, atol=atol,
+                               equal_nan=equal_nan)).astype(jnp.float32)
+
+
+@register("_contrib_fft", aliases=["fft"])
+def fft(data, *, compute_size=128):
+    """reference: src/operator/contrib/fft.cc — FFT along the last axis,
+    real input, output interleaves (real, imag) doubling the last dim."""
+    f = jnp.fft.fft(data.astype(jnp.float32), axis=-1)
+    return jnp.stack([f.real, f.imag], axis=-1).reshape(
+        data.shape[:-1] + (2 * data.shape[-1],)).astype(jnp.float32)
+
+
+@register("_contrib_ifft", aliases=["ifft"])
+def ifft(data, *, compute_size=128):
+    # inverse of _contrib_fft's interleaved layout; output is the real part
+    n = data.shape[-1] // 2
+    ri = data.astype(jnp.float32).reshape(data.shape[:-1] + (n, 2))
+    comp = ri[..., 0] + 1j * ri[..., 1]
+    # reference scales by n on the inverse path (no 1/n normalization)
+    return jnp.fft.ifft(comp, axis=-1).real.astype(jnp.float32) * n
+
+
+@register("_contrib_count_sketch", aliases=["count_sketch"])
+def count_sketch(data, h, s, *, out_dim, processing_batch_size=32):
+    """reference: src/operator/contrib/count_sketch.cc — random feature
+    hashing: out[j] += s[i] * data[i] for h[i] == j (per row)."""
+    hi = h.reshape(-1).astype(jnp.int32)
+    si = s.reshape(-1).astype(data.dtype)
+    vals = data * si[None, :]
+    out = jnp.zeros(data.shape[:-1] + (int(out_dim),), dtype=data.dtype)
+    return out.at[..., hi].add(vals)
+
+
+@register("_contrib_AdaptiveAvgPooling2D", aliases=["AdaptiveAvgPooling2D"])
+def adaptive_avg_pooling2d(data, *, output_size=()):
+    """reference: src/operator/contrib/adaptive_avg_pooling.cc — NCHW
+    average pooling onto a fixed output grid with floor/ceil bin edges."""
+    if not output_size:
+        oh = ow = 1
+    elif isinstance(output_size, int):
+        oh = ow = int(output_size)
+    else:
+        out = tuple(output_size)
+        oh, ow = (out[0], out[0]) if len(out) == 1 else (out[0], out[1])
+    n, c, h, w = data.shape
+    x = data.astype(jnp.float32)
+
+    def pool_axis(arr, axis, n_in, n_out):
+        # bin edges are static python ints (shapes are static under jit)
+        starts = [(i * n_in) // n_out for i in range(n_out)]
+        ends = [-(-(i + 1) * n_in // n_out) for i in range(n_out)]
+        pieces = []
+        for st, en in zip(starts, ends):
+            sl = [slice(None)] * arr.ndim
+            sl[axis] = slice(st, en)
+            pieces.append(arr[tuple(sl)].mean(axis=axis, keepdims=True))
+        return jnp.concatenate(pieces, axis=axis)
+
+    x = pool_axis(x, 2, h, oh)
+    x = pool_axis(x, 3, w, ow)
+    return x.astype(data.dtype)
+
+
+@register("_contrib_bipartite_matching", aliases=["bipartite_matching"],
+          num_outputs=2)
+def bipartite_matching(data, *, is_ascend=False, threshold=0.0, topk=-1):
+    """reference: src/operator/contrib/bounding_box.cc ::
+    BipartiteMatching — greedy bipartite matching on a (..., N, M) score
+    matrix: repeatedly take the globally best remaining pair. Returns
+    (row_match, col_match): for each row the matched col (or -1), and for
+    each col the matched row (or -1). Static-shape lax.fori_loop over
+    min(N, M) rounds — compiler-friendly."""
+    import jax.lax as lax
+
+    scores = data.astype(jnp.float32)
+    lead = scores.shape[:-2]  # arbitrary batch dims, flattened for vmap
+    n, m = scores.shape[-2:]
+    scores = scores.reshape((-1, n, m))
+    b = scores.shape[0]
+    sgn = 1.0 if not is_ascend else -1.0
+    s0 = scores * sgn
+    thr = threshold * sgn
+    rounds = min(n, m) if topk < 0 else min(topk, n, m)
+
+    def one(sc):
+        def body(_, state):
+            s, rmatch, cmatch = state
+            flat = s.reshape(-1)
+            idx = jnp.argmax(flat)
+            val = flat[idx]
+            r, c_ = idx // m, idx % m
+            ok = val >= thr
+            rmatch = jnp.where(ok, rmatch.at[r].set(c_.astype(jnp.float32)),
+                               rmatch)
+            cmatch = jnp.where(ok, cmatch.at[c_].set(r.astype(jnp.float32)),
+                               cmatch)
+            neg = jnp.float32(-jnp.inf)
+            s = jnp.where(ok, s.at[r, :].set(neg).at[:, c_].set(neg), s)
+            return s, rmatch, cmatch
+
+        init = (sc, jnp.full((n,), -1.0, jnp.float32),
+                jnp.full((m,), -1.0, jnp.float32))
+        _, rmatch, cmatch = lax.fori_loop(0, rounds, body, init)
+        return rmatch, cmatch
+
+    rms, cms = jax.vmap(one)(s0)
+    return rms.reshape(lead + (n,)), cms.reshape(lead + (m,))

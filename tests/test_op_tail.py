@@ -297,3 +297,66 @@ class TestUpsamplingAndGroupedDeconv:
         out = nd.UpSampling(a, b, scale=2, sample_type="nearest",
                             num_args=2)
         assert out.shape == (1, 3, 8, 8)
+
+
+class TestContribTail:
+    """Round-4 contrib tail: fft/count_sketch/adaptive pool/matching."""
+
+    def test_quadratic_allclose_index_copy(self):
+        onp.testing.assert_allclose(
+            nd.quadratic(nd.array([1.0, 2.0]), a=1, b=2, c=3).asnumpy(),
+            [6.0, 11.0])
+        assert float(nd.contrib.allclose(
+            nd.array([1.0]), nd.array([1.0 + 1e-9])).asnumpy()) == 1.0
+        assert float(nd.contrib.allclose(
+            nd.array([1.0]), nd.array([2.0])).asnumpy()) == 0.0
+        old = nd.array(onp.zeros((4, 3), "f"))
+        new = nd.array(onp.ones((2, 3), "f"))
+        got = nd.index_copy(old, nd.array([1, 3]).astype("int32"), new)
+        onp.testing.assert_allclose(got.asnumpy()[:, 0], [0, 1, 0, 1])
+
+    def test_fft_ifft_roundtrip(self):
+        rs = onp.random.RandomState(0)
+        x = nd.array(rs.randn(2, 8).astype("f"))
+        f = nd.fft(x)
+        assert f.shape == (2, 16)  # interleaved (re, im)
+        bak = nd.ifft(f) / 8  # reference ifft scales by n
+        onp.testing.assert_allclose(bak.asnumpy(), x.asnumpy(), atol=1e-4)
+
+    def test_count_sketch_matches_oracle(self):
+        rs = onp.random.RandomState(1)
+        d = nd.array(rs.rand(3, 6).astype("f"))
+        hv = [0, 1, 0, 2, 1, 3]
+        sv = [1, -1, 1, 1, -1, 1]
+        cs = nd.count_sketch(d, nd.array(onp.array(hv, "f")),
+                             nd.array(onp.array(sv, "f")), out_dim=4)
+        want = onp.zeros((3, 4), "f")
+        for i, (hh, ss) in enumerate(zip(hv, sv)):
+            want[:, hh] += ss * d.asnumpy()[:, i]
+        onp.testing.assert_allclose(cs.asnumpy(), want, rtol=1e-5)
+
+    def test_adaptive_avg_pooling(self):
+        x = onp.arange(32, dtype="f").reshape(1, 2, 4, 4)
+        p = nd.AdaptiveAvgPooling2D(nd.array(x), output_size=(2, 2))
+        want = x.reshape(1, 2, 2, 2, 2, 2).mean(axis=(3, 5))
+        onp.testing.assert_allclose(p.asnumpy(), want)
+        # uneven bins + global (default) size
+        assert nd.AdaptiveAvgPooling2D(
+            nd.array(onp.random.rand(1, 1, 7, 5).astype("f")),
+            output_size=(3, 2)).shape == (1, 1, 3, 2)
+        assert nd.AdaptiveAvgPooling2D(
+            nd.array(x)).shape == (1, 2, 1, 1)
+
+    def test_bipartite_matching_greedy(self):
+        sc = nd.array(onp.array([[0.9, 0.1], [0.8, 0.7]], "f"))
+        rm, cm = nd.bipartite_matching(sc, threshold=0.05)
+        onp.testing.assert_allclose(rm.asnumpy(), [0, 1])
+        onp.testing.assert_allclose(cm.asnumpy(), [0, 1])
+        # threshold excludes weak pairs
+        rm, cm = nd.bipartite_matching(sc, threshold=0.85)
+        onp.testing.assert_allclose(rm.asnumpy(), [0, -1])
+        # ascending = smallest-first
+        rm, cm = nd.bipartite_matching(
+            nd.array(onp.array([[0.3, 0.2], [0.1, 0.25]], "f")),
+            is_ascend=True, threshold=0.5)
+        onp.testing.assert_allclose(rm.asnumpy(), [1, 0])
