@@ -273,23 +273,65 @@ class SaturationJitterAug(Augmenter):
         return nd_array(arr * alpha + gray * (1 - alpha))
 
 
-class ColorJitterAug(Augmenter):
-    def __init__(self, brightness=0.0, contrast=0.0, saturation=0.0):
-        super().__init__(brightness=brightness, contrast=contrast,
-                         saturation=saturation)
-        self._augs = []
-        if brightness:
-            self._augs.append(BrightnessJitterAug(brightness))
-        if contrast:
-            self._augs.append(ContrastJitterAug(contrast))
-        if saturation:
-            self._augs.append(SaturationJitterAug(saturation))
+
+class SequentialAug(Augmenter):
+    """Apply a list of augmenters in order (reference: image.py ::
+    SequentialAug)."""
+
+    def __init__(self, ts):
+        super().__init__()
+        self.ts = list(ts)
+
+    def dumps(self):
+        return ["SequentialAug", [t.dumps() for t in self.ts]]
 
     def __call__(self, src):
-        augs = list(self._augs)
-        _pyrandom.shuffle(augs)
-        for a in augs:
-            src = a(src)
+        for t in self.ts:
+            src = t(src)
+        return src
+
+
+class RandomOrderAug(Augmenter):
+    """Apply a list of augmenters in random order (reference: image.py ::
+    RandomOrderAug)."""
+
+    def __init__(self, ts):
+        super().__init__()
+        self.ts = list(ts)
+
+    def dumps(self):
+        return ["RandomOrderAug", [t.dumps() for t in self.ts]]
+
+    def __call__(self, src):
+        order = list(self.ts)
+        _pyrandom.shuffle(order)
+        for t in order:
+            src = t(src)
+        return src
+
+class ColorJitterAug(RandomOrderAug):
+    """Random-order brightness/contrast/saturation jitter (reference:
+    image.py::ColorJitterAug — a RandomOrderAug over the three jitters,
+    with hue available via HueJitterAug in the builder)."""
+
+    def __init__(self, brightness, contrast, saturation):
+        ts = []
+        if brightness:
+            ts.append(BrightnessJitterAug(brightness))
+        if contrast:
+            ts.append(ContrastJitterAug(contrast))
+        if saturation:
+            ts.append(SaturationJitterAug(saturation))
+        super().__init__(ts)
+        self.brightness = brightness
+        self.contrast = contrast
+        self.saturation = saturation
+
+    def dumps(self):
+        return ["ColorJitterAug", [t.dumps() for t in self.ts]]
+
+    def __call__(self, src):
+        src = super().__call__(src)
         return src if isinstance(src, NDArray) else nd_array(src)
 
 
@@ -325,7 +367,7 @@ class RandomGrayAug(Augmenter):
 
 def CreateAugmenter(data_shape, resize=0, rand_crop=False, rand_resize=False,
                     rand_mirror=False, mean=None, std=None, brightness=0,
-                    contrast=0, saturation=0, pca_noise=0, rand_gray=0,
+                    contrast=0, saturation=0, hue=0, pca_noise=0, rand_gray=0,
                     inter_method=2):
     """Standard augmenter list builder (reference: CreateAugmenter)."""
     auglist = []
@@ -345,6 +387,8 @@ def CreateAugmenter(data_shape, resize=0, rand_crop=False, rand_resize=False,
     auglist.append(CastAug())
     if brightness or contrast or saturation:
         auglist.append(ColorJitterAug(brightness, contrast, saturation))
+    if hue:
+        auglist.append(HueJitterAug(hue))
     if pca_noise > 0:
         eigval = np.array([55.46, 4.794, 1.148])
         eigvec = np.array([[-0.5675, 0.7192, 0.4009],
@@ -524,6 +568,46 @@ from .detection import (DetAugmenter, DetBorrowAug,  # noqa: E402
                         DetHorizontalFlipAug, DetRandomCropAug,
                         DetRandomPadAug, CreateDetAugmenter, ImageDetIter)
 
+__all__ += ["SequentialAug", "RandomOrderAug", "HueJitterAug",
+            "scale_down"]
 __all__ += ["DetAugmenter", "DetBorrowAug", "DetHorizontalFlipAug",
             "DetRandomCropAug", "DetRandomPadAug", "CreateDetAugmenter",
             "ImageDetIter"]
+
+
+class HueJitterAug(Augmenter):
+    """Random hue jitter (reference: image.py::HueJitterAug — the YIQ
+    rotation formulation)."""
+
+    def __init__(self, hue):
+        super().__init__(hue=hue)
+        self.hue = hue
+        self.tyiq = np.array([[0.299, 0.587, 0.114],
+                              [0.596, -0.274, -0.321],
+                              [0.211, -0.523, 0.311]])
+        self.ityiq = np.array([[1.0, 0.956, 0.621],
+                               [1.0, -0.272, -0.647],
+                               [1.0, -1.107, 1.705]])
+
+    def __call__(self, src):
+        alpha = _pyrandom.uniform(-self.hue, self.hue)
+        u = np.cos(alpha * np.pi)
+        w = np.sin(alpha * np.pi)
+        bt = np.array([[1.0, 0.0, 0.0],
+                       [0.0, u, -w],
+                       [0.0, w, u]])
+        t = np.dot(np.dot(self.ityiq, bt), self.tyiq).T
+        x = _to_np(src).astype(np.float32)
+        return nd_array(np.dot(x, t))
+
+
+def scale_down(src_size, size):
+    """Scale `size` down to fit in `src_size`, keeping aspect ratio
+    (reference: image.py::scale_down)."""
+    w, h = size
+    sw, sh = src_size
+    if sh < h:
+        w, h = float(w * sh) / h, sh
+    if sw < w:
+        w, h = sw, float(h * sw) / w
+    return int(w), int(h)

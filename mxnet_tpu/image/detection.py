@@ -163,7 +163,7 @@ class DetRandomPadAug(DetAugmenter):
 
 def CreateDetAugmenter(data_shape, resize=0, rand_crop=0, rand_pad=0,
                        rand_gray=0, rand_mirror=False, mean=None, std=None,
-                       brightness=0, contrast=0, saturation=0,
+                       brightness=0, contrast=0, saturation=0, hue=0,
                        pad_val=(127, 127, 127), min_object_covered=0.1,
                        aspect_ratio_range=(0.75, 1.33),
                        area_range=(0.05, 3.0), max_attempts=50):
@@ -189,6 +189,10 @@ def CreateDetAugmenter(data_shape, resize=0, rand_crop=0, rand_pad=0,
         auglist.append(DetBorrowAug(ContrastJitterAug(contrast)))
     if saturation:
         auglist.append(DetBorrowAug(SaturationJitterAug(saturation)))
+    if hue:
+        from . import HueJitterAug
+
+        auglist.append(DetBorrowAug(HueJitterAug(hue)))
     if rand_gray > 0:
         auglist.append(DetBorrowAug(RandomGrayAug(rand_gray)))
     auglist.append(DetBorrowAug(CastAug()))

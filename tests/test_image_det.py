@@ -115,3 +115,36 @@ class TestImageDetIter:
         with pytest.raises(MXNetError, match="object_width"):
             img_mod.ImageDetIter._parse_label(
                 onp.array([2, 3, 0, 0.1, 0.2], "float32"))
+
+
+class TestAugmenterTail:
+    """Round-4 augmenter surface tail: SequentialAug, RandomOrderAug,
+    HueJitterAug (YIQ rotation), scale_down."""
+
+    def _img(self):
+        return mx.nd.array(
+            onp.random.RandomState(0).rand(8, 8, 3).astype("f") * 255)
+
+    def test_sequential_and_random_order(self):
+        img = self._img()
+        seq = mx.image.SequentialAug([mx.image.BrightnessJitterAug(0.1),
+                                      mx.image.ContrastJitterAug(0.1)])
+        assert seq(img).shape == (8, 8, 3)
+        ro = mx.image.RandomOrderAug([mx.image.CastAug()])
+        assert ro(img).shape == (8, 8, 3)
+
+    def test_hue_jitter_identity_at_zero(self):
+        img = self._img()
+        h = mx.image.HueJitterAug(0.0)
+        # the rounded 3-decimal YIQ constants give ~0.25% residual — the
+        # same constants (and residual) as the reference implementation
+        onp.testing.assert_allclose(h(img).asnumpy(), img.asnumpy(),
+                                    atol=1.0)
+        h2 = mx.image.HueJitterAug(0.4)
+        out = h2(img).asnumpy()
+        assert out.shape == (8, 8, 3) and onp.isfinite(out).all()
+
+    def test_scale_down(self):
+        assert mx.image.scale_down((100, 100), (8, 6)) == (8, 6)
+        w, h = mx.image.scale_down((4, 4), (8, 6))
+        assert w <= 4 and h <= 4
