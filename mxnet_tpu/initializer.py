@@ -19,7 +19,7 @@ from .base import MXNetError
 
 __all__ = ["Initializer", "Uniform", "Normal", "Zero", "One", "Constant",
            "Xavier", "MSRAPrelu", "Orthogonal", "Bilinear", "LSTMBias",
-           "Mixed", "register", "create", "InitDesc"]
+           "Mixed", "Load", "register", "create", "InitDesc"]
 
 _REGISTRY = {}
 
@@ -264,3 +264,43 @@ class Mixed(Initializer):
                 init(name, arr)
                 return
         raise MXNetError(f"parameter {name} did not match any Mixed pattern")
+
+
+class Load:
+    """Initialize parameters from a saved dict (reference:
+    initializer.py::Load): names found in ``param`` take their stored
+    value; anything else falls through to ``default_init`` (or raises
+    when none is given)."""
+
+    def __init__(self, param, default_init=None, verbose=False):
+        if isinstance(param, str):
+            from .ndarray import serialization
+
+            param = serialization.load(param)
+        if not isinstance(param, dict):
+            raise TypeError(
+                "Load: expected a dict of name -> NDArray (a .params file "
+                "saved with names), got " + type(param).__name__)
+        self.param = {
+            (k[4:] if k.startswith(("arg:", "aux:")) else k): v
+            for k, v in param.items()}
+        self.default_init = default_init
+        self.verbose = verbose
+
+    def __call__(self, desc, arr):
+        name = str(desc)
+        if name in self.param:
+            src = self.param[name]
+            if tuple(src.shape) != tuple(arr.shape):
+                raise ValueError(
+                    f"Load: parameter {name!r} has shape {src.shape} in the "
+                    f"file but {arr.shape} is requested")
+            arr[:] = src
+            if self.verbose:
+                print(f"Initialized {name} by loading")
+        else:
+            if self.default_init is None:
+                raise ValueError(
+                    f"Load: cannot initialize {name!r} — not found in the "
+                    "loaded file and no default_init is given")
+            self.default_init(desc, arr)

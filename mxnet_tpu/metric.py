@@ -17,7 +17,7 @@ from .base import MXNetError
 __all__ = ["EvalMetric", "Accuracy", "TopKAccuracy", "F1", "MCC", "MAE",
            "MSE", "RMSE", "CrossEntropy", "NegativeLogLikelihood",
            "Perplexity", "PearsonCorrelation", "Loss", "CompositeEvalMetric",
-           "CustomMetric", "create", "np"]
+           "CustomMetric", "Torch", "Caffe", "PCC", "create", "np"]
 
 _REGISTRY = {}
 
@@ -415,3 +415,77 @@ def np(numpy_feval, name="custom", allow_extra_outputs=False):
 
     feval.__name__ = getattr(numpy_feval, "__name__", name)
     return CustomMetric(feval, name, allow_extra_outputs)
+
+
+@register
+class Torch(Loss):
+    """Deprecated alias kept for API parity (reference: metric.py::Torch —
+    mean of a torch-criterion output; identical to Loss here)."""
+
+    def __init__(self, name="torch", output_names=None, label_names=None):
+        super().__init__(name, output_names, label_names)
+
+
+@register
+class Caffe(Loss):
+    """Deprecated alias kept for API parity (reference: metric.py::Caffe)."""
+
+    def __init__(self, name="caffe", output_names=None, label_names=None):
+        super().__init__(name, output_names, label_names)
+
+
+@register
+class PCC(EvalMetric):
+    """Multiclass Pearson correlation of the confusion matrix (reference:
+    metric.py::PCC — the k-category generalization of MCC)."""
+
+    def __init__(self, name="pcc", output_names=None, label_names=None):
+        super().__init__(name, output_names, label_names)
+        self._conf = None
+        self._gconf = None
+
+    def reset(self):
+        super().reset()
+        self._conf = None
+        self._gconf = None
+
+    def reset_local(self):
+        super().reset_local()
+        self._conf = None
+
+    @staticmethod
+    def _pcc_of(c):
+        n = c.sum()
+        x = c.sum(axis=1)
+        y = c.sum(axis=0)
+        cov_xy = c.trace() * n - (x * y).sum()
+        denom = ((n * n - (x * x).sum()) * (n * n - (y * y).sum())) ** 0.5
+        return float(cov_xy / denom) if denom > 0 else 0.0
+
+    def update(self, labels, preds):
+        if not isinstance(labels, (list, tuple)):
+            labels, preds = [labels], [preds]
+
+        def grow(conf, k):
+            if conf is None or conf.shape[0] < k:
+                new = _np.zeros((k, k), _np.float64)
+                if conf is not None:
+                    new[:conf.shape[0], :conf.shape[1]] = conf
+                return new
+            return conf
+
+        for label, pred in zip(labels, preds):
+            lab = _as_numpy(label).astype(int).reshape(-1)
+            p = _as_numpy(pred)
+            cls = p.argmax(-1).reshape(-1) if p.ndim > 1 else \
+                (p.reshape(-1) > 0.5).astype(int)
+            k = int(max(lab.max(initial=0), cls.max(initial=0))) + 1
+            self._conf = grow(self._conf, k)
+            self._gconf = grow(self._gconf, k)
+            for li, ci in zip(lab, cls):
+                self._conf[ci, li] += 1
+                self._gconf[ci, li] += 1
+            self.num_inst = 1
+            self.global_num_inst = 1
+        self.sum_metric = self._pcc_of(self._conf)
+        self.global_sum_metric = self._pcc_of(self._gconf)

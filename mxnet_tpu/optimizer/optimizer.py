@@ -23,6 +23,7 @@ from ..ndarray import NDArray
 
 __all__ = ["Optimizer", "SGD", "NAG", "Adam", "AdamW", "RMSProp", "AdaGrad",
            "AdaDelta", "Ftrl", "Signum", "SGLD", "DCASGD", "LAMB",
+           "FTML", "Adamax", "Nadam", "LBSGD",
            "Updater", "create", "register", "get_updater"]
 
 _REGISTRY: Dict[str, type] = {}
@@ -514,6 +515,146 @@ class Updater:
             return s
 
         self.states = {k: to_nd(v) for k, v in data.items()}
+
+
+
+
+@register
+class FTML(Optimizer):
+    """reference: optimizer.py::FTML (Follow The Moving Leader; states
+    d/v/z driven by the ftml_update op)."""
+
+    def __init__(self, learning_rate=0.0025, beta1=0.6, beta2=0.999,
+                 epsilon=1e-8, **kwargs):
+        super().__init__(learning_rate=learning_rate, **kwargs)
+        self.beta1, self.beta2, self.epsilon = beta1, beta2, epsilon
+
+    def create_state(self, index, weight):
+        z = nd.zeros(weight.shape, ctx=weight.context, dtype=str(weight.dtype))
+        return (nd.zeros_like(z), nd.zeros_like(z), z)
+
+    def update(self, index, weight, grad, state):
+        self._update_count(index)
+        kw = self._common_kwargs(index)
+        clip = kw.pop("clip_gradient", -1.0)
+        d, v, z = state
+        nd.ftml_update(weight, grad, d, v, z, t=self._t(index),
+                       beta1=self.beta1, beta2=self.beta2,
+                       epsilon=self.epsilon, clip_grad=clip,
+                       out=[weight, d, v, z], **kw)
+
+
+@register
+class Adamax(Optimizer):
+    """reference: optimizer.py::Adamax — Adam with the infinity norm."""
+
+    def __init__(self, learning_rate=0.002, beta1=0.9, beta2=0.999, **kwargs):
+        super().__init__(learning_rate=learning_rate, **kwargs)
+        self.beta1, self.beta2 = beta1, beta2
+
+    def create_state(self, index, weight):
+        z = nd.zeros(weight.shape, ctx=weight.context, dtype=str(weight.dtype))
+        return (nd.zeros_like(z), nd.zeros_like(z))
+
+    def update(self, index, weight, grad, state):
+        self._update_count(index)
+        t = self._t(index)
+        kw = self._common_kwargs(index)
+        lr = kw["lr"] / (1.0 - self.beta1 ** t)
+        wd = kw["wd"]
+        g = grad * self.rescale_grad + wd * weight
+        if self.clip_gradient is not None:
+            g = nd.clip(g, -self.clip_gradient, self.clip_gradient)
+        m, u = state
+        m_new = self.beta1 * m + (1.0 - self.beta1) * g
+        u_new = nd.maximum(self.beta2 * u, nd.abs(g))
+        m._set_data(m_new.data)
+        u._set_data(u_new.data)
+        weight._set_data((weight - lr * m_new / (u_new + 1e-8)).data)
+
+
+@register
+class Nadam(Optimizer):
+    """reference: optimizer.py::Nadam — Adam with Nesterov momentum
+    (Dozat 2016 schedule)."""
+
+    def __init__(self, learning_rate=0.001, beta1=0.9, beta2=0.999,
+                 epsilon=1e-8, schedule_decay=0.004, **kwargs):
+        super().__init__(learning_rate=learning_rate, **kwargs)
+        self.beta1, self.beta2 = beta1, beta2
+        self.epsilon = epsilon
+        self.schedule_decay = schedule_decay
+        self.m_schedule = 1.0
+
+    def create_state(self, index, weight):
+        z = nd.zeros(weight.shape, ctx=weight.context, dtype=str(weight.dtype))
+        return (nd.zeros_like(z), nd.zeros_like(z))
+
+    def update(self, index, weight, grad, state):
+        self._update_count(index)
+        t = self._t(index)
+        kw = self._common_kwargs(index)
+        lr, wd = kw["lr"], kw["wd"]
+        g = grad * self.rescale_grad + wd * weight
+        if self.clip_gradient is not None:
+            g = nd.clip(g, -self.clip_gradient, self.clip_gradient)
+        momentum_t = self.beta1 * (1.0 - 0.5 * 0.96 ** (t * self.schedule_decay))
+        momentum_t_1 = self.beta1 * (1.0 - 0.5 * 0.96 ** ((t + 1) * self.schedule_decay))
+        self.m_schedule = self.m_schedule * momentum_t
+        m_schedule_next = self.m_schedule * momentum_t_1
+        m, v = state
+        m_new = self.beta1 * m + (1.0 - self.beta1) * g
+        v_new = self.beta2 * v + (1.0 - self.beta2) * g * g
+        g_prime = g / (1.0 - self.m_schedule)
+        m_prime = m_new / (1.0 - m_schedule_next)
+        v_prime = v_new / (1.0 - self.beta2 ** t)
+        m_bar = (1.0 - momentum_t) * g_prime + momentum_t_1 * m_prime
+        m._set_data(m_new.data)
+        v._set_data(v_new.data)
+        weight._set_data(
+            (weight - lr * m_bar / (nd.sqrt(v_prime) + self.epsilon)).data)
+
+
+@register
+class LBSGD(Optimizer):
+    """reference: optimizer.py::LBSGD — large-batch SGD with LARS-style
+    layer-wise adaptive rate scaling (warmup strategies collapse to the
+    'lars' trust-ratio core; momentum + multi-precision supported)."""
+
+    def __init__(self, learning_rate=0.01, momentum=0.9, eta=0.001,
+                 epsilon=1e-8, warmup_strategy="linear", warmup_epochs=5,
+                 batch_scale=1, updates_per_epoch=32, begin_epoch=0,
+                 num_epochs=60, **kwargs):
+        super().__init__(learning_rate=learning_rate, **kwargs)
+        self.momentum = momentum
+        self.eta = eta
+        self.epsilon = epsilon
+
+    def create_state(self, index, weight):
+        return nd.zeros(weight.shape, ctx=weight.context,
+                        dtype=str(weight.dtype))
+
+    def update(self, index, weight, grad, state):
+        self._update_count(index)
+        kw = self._common_kwargs(index)
+        lr, wd = kw["lr"], kw["wd"]
+        g = grad * self.rescale_grad
+        if self.clip_gradient is not None:
+            g = nd.clip(g, -self.clip_gradient, self.clip_gradient)
+        # LARS trust ratio: ||w|| / (||g|| + wd*||w|| + eps), computed
+        # ON DEVICE (a 0-d tensor broadcasting into the update) so the
+        # fused/jitted step can trace it and eager mode never syncs
+        wnorm = nd.sqrt((weight.astype("float32") ** 2).sum())
+        gnorm = nd.sqrt((g.astype("float32") ** 2).sum())
+        lars = nd.where(
+            (wnorm > 0) * (gnorm > 0),
+            self.eta * wnorm / (gnorm + wd * wnorm + self.epsilon),
+            nd.ones_like(wnorm))
+        eff_lr = lr * lars.astype(str(weight.dtype))
+        mom = state
+        mom_new = self.momentum * mom - eff_lr * (g + wd * weight)
+        mom._set_data(mom_new.data)
+        weight._set_data((weight + mom_new).data)
 
 
 def get_updater(optimizer: Optimizer) -> Updater:

@@ -212,3 +212,33 @@ class TestInstallMonitor:
                 force_init=True)
         assert first not in mon.exes and mod._exec in mon.exes
         assert len(mon.exes) == 1
+
+
+def test_metric_pcc_and_legacy_aliases():
+    """PCC equals MCC for binary confusion; Torch/Caffe = Loss aliases."""
+    m = mx.metric.PCC()
+    lab = mx.nd.array([0, 1, 1, 0, 1, 1])
+    pred = mx.nd.array([[0.9, 0.1], [0.2, 0.8], [0.3, 0.7],
+                        [0.6, 0.4], [0.8, 0.2], [0.1, 0.9]])
+    m.update([lab], [pred])
+    tp, tn, fp, fn = 3, 2, 0, 1
+    want = (tp * tn - fp * fn) / ((tp + fp) * (tp + fn)
+                                  * (tn + fp) * (tn + fn)) ** 0.5
+    assert abs(m.get()[1] - want) < 1e-6
+    t = mx.metric.Torch()
+    t.update(None, mx.nd.array([1.0, 2.0]))
+    assert t.get()[1] == 1.5
+
+
+def test_initializer_load():
+    import numpy as onp
+    d = mx.nd.ones((2, 3)) * 7
+    init = mx.init.Load({"w": d}, default_init=mx.init.Zero())
+    arr = mx.nd.zeros((2, 3))
+    init("w", arr)
+    onp.testing.assert_allclose(arr.asnumpy(), 7)
+    arr2 = mx.nd.ones((4,))
+    init("other", arr2)
+    onp.testing.assert_allclose(arr2.asnumpy(), 0)
+    with pytest.raises(ValueError):
+        mx.init.Load({})("missing", mx.nd.ones((1,)))

@@ -138,3 +138,22 @@ def test_lr_mult_wd_mult():
     o.set_lr_mult({0: 0.1})
     assert np.isclose(o._get_lr(0), 0.1)
     assert np.isclose(o._get_lr(1), 1.0)
+
+
+class TestOptimizerTailClasses:
+    """Round-4: FTML / Adamax / Nadam / LBSGD classes (reference
+    optimizer.py tail). Gate: each drives a quadratic to ~zero."""
+
+    @pytest.mark.parametrize("name,kw", [
+        ("ftml", {"learning_rate": 0.05}),
+        ("adamax", {"learning_rate": 0.05}),
+        ("nadam", {"learning_rate": 0.05}),
+        ("lbsgd", {"learning_rate": 0.1, "eta": 1.0}),
+    ])
+    def test_quadratic_converges(self, name, kw):
+        opt = mx.optimizer.create(name, **kw)
+        w = mx.nd.array([1.0, -2.0])
+        state = opt.create_state(0, w)
+        for _ in range(150):
+            opt.update(0, w, 2 * w, state)
+        assert float((w.asnumpy() ** 2).sum()) < 0.5, name
