@@ -18,7 +18,7 @@ from .ndarray import NDArray, _wrap_jax
 
 __all__ = ["BaseSparseNDArray", "CSRNDArray", "RowSparseNDArray",
            "csr_matrix", "row_sparse_array", "array", "zeros", "empty",
-           "dot", "retain"]
+           "dot", "retain", "add", "elemwise_add"]
 
 
 def _jnp():
@@ -389,3 +389,21 @@ def retain(data, indices):
     if not isinstance(data, RowSparseNDArray):
         raise MXNetError("retain expects a RowSparseNDArray")
     return data.retain(indices)
+
+
+def add(lhs, rhs):
+    """Sparse-aware add (reference: ndarray/sparse.py::add).
+
+    Operands with sparse stypes participate through their dense views;
+    the result keeps the OPERANDS' common sparse storage type (csr+csr ->
+    csr, row_sparse+row_sparse -> row_sparse) and is dense otherwise —
+    matching the reference's storage-type inference."""
+    out = lhs + rhs
+    ls = getattr(lhs, "stype", "default")
+    rs = getattr(rhs, "stype", "default")
+    if ls == rs and ls in ("csr", "row_sparse"):
+        return out.tostype(ls)
+    return out
+
+
+elemwise_add = add

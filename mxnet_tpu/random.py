@@ -9,7 +9,8 @@ from . import ndarray as nd
 from .random_state import seed  # re-export
 
 __all__ = ["seed", "uniform", "normal", "randn", "randint", "exponential",
-           "gamma", "poisson", "negative_binomial", "multinomial", "shuffle"]
+           "gamma", "poisson", "negative_binomial",
+           "generalized_negative_binomial", "multinomial", "shuffle"]
 
 uniform = nd.random.uniform
 normal = nd.random.normal
@@ -18,6 +19,7 @@ exponential = nd.random.exponential
 gamma = nd.random.gamma
 poisson = nd.random.poisson
 negative_binomial = nd.random.negative_binomial
+generalized_negative_binomial = nd.random.generalized_negative_binomial
 multinomial = nd.random.sample_multinomial
 nd.random.multinomial = nd.random.sample_multinomial
 

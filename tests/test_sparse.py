@@ -267,3 +267,20 @@ class TestReviewRegressions:
             sparse.array(onp.ones((2, 2)))
         out2 = sparse.array(onp.ones((2, 2)), stype="row_sparse")
         assert out2.stype == "row_sparse"
+
+
+def test_sparse_add_and_random_gnb():
+    import numpy as onp
+    a = mx.nd.array([[1.0, 0.0], [0.0, 2.0]]).tostype("csr")
+    b = mx.nd.ones((2, 2))
+    got = sparse.add(a, b)
+    assert got.stype == "default"  # csr + dense -> dense
+    onp.testing.assert_allclose(got.asnumpy(), [[2, 1], [1, 3]])
+    c = mx.nd.array([[0.0, 3.0], [0.0, 0.0]]).tostype("csr")
+    same = sparse.add(a, c)
+    assert same.stype == "csr"  # csr + csr keeps csr
+    onp.testing.assert_allclose(sparse.elemwise_add(a, b).asnumpy(),
+                                [[2, 1], [1, 3]])
+    g = mx.random.generalized_negative_binomial(mu=3.0, alpha=0.2,
+                                                shape=(2000,))
+    assert abs(float(g.asnumpy().mean()) - 3.0) < 0.5
