@@ -76,6 +76,10 @@ def _make_wrapper(opname: str):
             for k, v in kwargs.items():
                 if k in opdef.tensor_params:
                     tensors[opdef.tensor_params.index(k)] = v
+                elif k in attrs:
+                    raise TypeError(
+                        f"{opname}() got multiple values for argument "
+                        f"{k!r}")
                 else:
                     attrs[k] = v
             # trim trailing unset optional tensors

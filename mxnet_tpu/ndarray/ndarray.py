@@ -849,3 +849,55 @@ def empty(shape, ctx: Optional[Context] = None, dtype=None) -> NDArray:
 
 def waitall() -> None:
     engine.wait_for_all()
+
+
+# ---------------------------------------------------------------------------
+# fluent method surface (reference: ndarray.py — every registered unary /
+# attr-only op is callable as a METHOD, e.g. x.sin(), x.broadcast_to(...)).
+# Attached here so one list covers the tail instead of 40 hand-written
+# forwarders; two-tensor fluent ops get explicit wrappers below.
+# ---------------------------------------------------------------------------
+
+def _attach_fluent(name, opname=None):
+    op = opname or name
+
+    def method(self, *args, **kw):
+        # forward to the module-level wrapper: it owns the positional ->
+        # attr mapping (opdef.attr_params order) and the overflow errors,
+        # so the fluent surface can never drift from the op signature
+        import mxnet_tpu.ndarray as _pkg
+
+        return getattr(_pkg, op)(self, *args, **kw)
+
+    method.__name__ = name
+    method.__doc__ = f"Fluent form of ``mx.nd.{op}`` (reference ndarray.py)."
+    if not hasattr(NDArray, name):
+        setattr(NDArray, name, method)
+
+for _n in ["sort", "round", "rint", "floor", "ceil", "trunc", "fix",
+           "log2", "log10", "rsqrt", "cbrt", "sin", "cos", "tan",
+           "arcsin", "arccos", "arctan", "sinh", "cosh", "tanh",
+           "arcsinh", "arccosh", "arctanh", "degrees", "radians",
+           "sigmoid", "relu", "zeros_like", "ones_like", "shape_array",
+           "size_array", "diag", "pad", "broadcast_to", "split"]:
+    _attach_fluent(_n)
+
+
+def _nd_pick(self, index, axis=-1, mode="clip", keepdims=False):
+    return imperative_invoke(get_op("pick"), [self, index],
+                             {"axis": axis, "keepdims": keepdims,
+                              "mode": mode})
+
+
+def _nd_broadcast_like(self, rhs, **kw):
+    return imperative_invoke(get_op("broadcast_like"), [self, rhs], kw)
+
+
+def _nd_slice_like(self, shape_like, axes=()):
+    return imperative_invoke(get_op("slice_like"), [self, shape_like],
+                             {"axes": axes})
+
+
+NDArray.pick = _nd_pick
+NDArray.broadcast_like = _nd_broadcast_like
+NDArray.slice_like = _nd_slice_like

@@ -247,7 +247,12 @@ def batch_take(a, indices):
 
 @register("pick")
 def pick(data, index, *, axis=-1, keepdims=False, mode="clip"):
-    idx = jnp.clip(index.astype(jnp.int32), 0, data.shape[axis] - 1)
+    # reference: mode='clip' clamps out-of-range indices, 'wrap' takes
+    # them modulo the axis length
+    if mode == "wrap":
+        idx = index.astype(jnp.int32) % data.shape[axis]
+    else:
+        idx = jnp.clip(index.astype(jnp.int32), 0, data.shape[axis] - 1)
     picked = jnp.take_along_axis(data, jnp.expand_dims(idx, axis), axis=axis)
     if not keepdims:
         picked = jnp.squeeze(picked, axis=axis)

@@ -345,3 +345,43 @@ class TestLinalgTail:
         ws, wl = np.linalg.slogdet(a)
         assert float(sign.asnumpy()) == ws
         np.testing.assert_allclose(float(logdet.asnumpy()), wl, rtol=1e-4)
+
+
+class TestFluentMethodSurface:
+    """Round-4: the reference's fluent method forms (x.sin(), x.sort(),
+    x.broadcast_to(...)) — one forwarding layer over the op registry."""
+
+    def test_unary_fluent_match_free_functions(self):
+        a = mx.nd.array([[4.0, 1.0], [2.0, 3.0]])
+        np.testing.assert_allclose(a.sin().asnumpy(),
+                                    np.sin(a.asnumpy()), rtol=1e-6)
+        np.testing.assert_allclose(a.sort().asnumpy(),
+                                    np.sort(a.asnumpy()), rtol=1e-6)
+        np.testing.assert_allclose(a.floor().asnumpy(),
+                                    np.floor(a.asnumpy()))
+        np.testing.assert_allclose(a.rsqrt().asnumpy(),
+                                    1 / np.sqrt(a.asnumpy()), rtol=1e-6)
+        assert a.zeros_like().asnumpy().sum() == 0
+        assert a.relu().shape == a.sigmoid().shape == (2, 2)
+
+    def test_shape_fluent(self):
+        assert mx.nd.ones((1, 2)).broadcast_to((3, 2)).shape == (3, 2)
+        assert mx.nd.ones((1, 2)).broadcast_like(
+            mx.nd.zeros((3, 2))).shape == (3, 2)
+        assert mx.nd.ones((4, 4)).slice_like(
+            mx.nd.zeros((2, 3))).shape == (2, 3)
+        parts = mx.nd.ones((2, 4)).split(num_outputs=2, axis=1)
+        assert len(parts) == 2 and parts[0].shape == (2, 2)
+        a = mx.nd.array([[4.0, 1.0], [2.0, 3.0]])
+        np.testing.assert_allclose(
+            a.pick(mx.nd.array([0.0, 1.0])).asnumpy(), [4.0, 3.0])
+
+    def test_fluent_grads_flow(self):
+        from mxnet_tpu import autograd
+        a = mx.nd.array([0.3, 0.7])
+        a.attach_grad()
+        with autograd.record():
+            loss = a.sin().sum()
+        loss.backward()
+        np.testing.assert_allclose(a.grad.asnumpy(),
+                                    np.cos(a.asnumpy()), rtol=1e-6)
