@@ -267,11 +267,38 @@ def _sweep_node_recorded(node, acc, add_grad):
     n_ct = len(tensor_cts)
 
     if node.primal_fn is None:
-        raise MXNetError(
-            "create_graph=True through a node recorded without a stored "
-            f"primal ({node.name!r} — a custom autograd.Function backward): "
-            "higher-order gradients need the op's pure forward; write the "
-            "custom backward with differentiable ops instead")
+        # documented fallback (custom autograd.Function backward): no pure
+        # primal stored, so re-linearization through the primal inputs is
+        # impossible — route the stored closure pullback through the
+        # imperative invoke path instead. Gradients flow through the
+        # cotangent chain only, matching the reference's contract that a
+        # custom Function is twice-differentiable only if its backward is
+        # written with differentiable ops.
+        vjp_fn = node.vjp_fn
+        in_avals = [(node.inputs[i].shape, node.inputs[i].dtype)
+                    for i in float_in]
+
+        def closure_fn(*cts):
+            full_ct = list(const_ct)
+            for s, c in zip(slots, cts):
+                full_ct[s] = c
+            ct = tuple(full_ct) if len(full_ct) > 1 else full_ct[0]
+            gs = vjp_fn(ct)
+            out = []
+            for i, (shape, dtype) in zip(float_in, in_avals):
+                g = gs[i]
+                if g is None or (getattr(g, "dtype", None) is not None
+                                 and str(g.dtype) == "float0"):
+                    g = jnp.zeros(shape, dtype)
+                out.append(g)
+            return tuple(out) if len(out) > 1 else out[0]
+
+        outs = imperative_invoke(_LambdaOp(closure_fn, f"grad[{node.name}]"),
+                                 tensor_cts, {}, force_record=True)
+        outs = list(outs) if isinstance(outs, (list, tuple)) else [outs]
+        for i, g in zip(float_in, outs):
+            add_grad(node.inputs[i], g)
+        return
     # the grad op re-reads the inputs' LIVE data; an input mutated in
     # place since the forward would silently change even the first-order
     # result — refuse loudly (the stored-closure path is immune)

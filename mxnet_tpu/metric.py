@@ -482,9 +482,8 @@ class PCC(EvalMetric):
             k = int(max(lab.max(initial=0), cls.max(initial=0))) + 1
             self._conf = grow(self._conf, k)
             self._gconf = grow(self._gconf, k)
-            for li, ci in zip(lab, cls):
-                self._conf[ci, li] += 1
-                self._gconf[ci, li] += 1
+            _np.add.at(self._conf, (cls, lab), 1)
+            _np.add.at(self._gconf, (cls, lab), 1)
             self.num_inst = 1
             self.global_num_inst = 1
         self.sum_metric = self._pcc_of(self._conf)

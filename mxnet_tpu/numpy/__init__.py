@@ -723,6 +723,10 @@ def put(a, ind, v, mode="raise"):
         _onp.asarray(v))
     v_d = jnp.asarray(v_d).reshape(-1)
     if v_d.size == 0:
+        if ind_d.size > 0:  # NumPy: cannot cycle an empty values sequence
+            raise ValueError(
+                "np.put: cannot put from an empty values array into "
+                f"{ind_d.size} indices")
         return
     if v_d.size < ind_d.size:  # NumPy cycles shorter values
         v_d = jnp.tile(v_d, -(-ind_d.size // v_d.size))

@@ -567,6 +567,9 @@ class TestNpSurfaceAdditions:
         onp.testing.assert_allclose(c.asnumpy(), [1, 2, 1, 2, 0])
         with pytest.raises(IndexError):
             mx.np.put(mx.np.zeros((5,)), [10], [9.0])
+        with pytest.raises(ValueError):  # NumPy: cannot cycle empty values
+            mx.np.put(mx.np.zeros((5,)), [0, 1], [])
+        mx.np.put(mx.np.zeros((5,)), [], [])  # both empty: no-op, no raise
         out = mx.np.asarray(mx.nd.ones((2, 3)))  # legacy NDArray promotes
         assert isinstance(out, mx.np.ndarray)
 
