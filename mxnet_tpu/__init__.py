@@ -41,11 +41,57 @@ if not _os.environ.get("JAX_DEFAULT_PRNG_IMPL"):
 # autotune caches / CUDA kernel cache). Training-step executables for
 # transformer-sized models take minutes to build; caching them on disk makes
 # the second process start in seconds. MXNET_XLA_CACHE_DIR overrides the
-# location; MXNET_XLA_CACHE=0 disables.
+# base location; MXNET_XLA_CACHE=0 disables.
+#
+# The cache is namespaced per host-CPU feature set: jax's cache key does not
+# include host ISA features, so an XLA:CPU AOT executable compiled on an
+# AVX-512/AMX host replays on a host without them ("could lead to execution
+# errors such as SIGILL" — cpu_aot_loader). A host with a different
+# /proc/cpuinfo flag set gets its own subdirectory and recompiles.
+
+
+# ISA-extension prefixes (x86 `flags` / ARM `Features`) that codegen can
+# actually depend on; kernel-mitigation and power-management flags (md_clear,
+# ibrs, retbleed, ...) churn with microcode/kernel updates and must not key
+# the cache — they'd force full recompiles on identical hardware.
+_ISA_PREFIXES = (
+    "sse", "avx", "amx", "fma", "bmi", "aes", "sha", "mmx", "f16c",
+    "pclmul", "vpclmul", "gfni", "vaes", "adx", "lzcnt", "popcnt", "abm",
+    "movbe", "movdir", "xsave", "rtm", "rdrnd", "rdseed", "rdpid",
+    "fsgsbase", "invpcid", "clflush", "clwb", "cldemote", "wbnoinvd",
+    "serialize", "cmov", "cx8", "cx16", "fxsr", "crc32", "tsxldtrk",
+    "lahf", "kl", "widekl", "waitpkg", "enqcmd", "uintr", "hreset", "lm",
+    "neon", "asimd", "sve", "fp", "fphp", "crypto", "atomics", "lse",
+)
+
+
+def _host_cpu_tag() -> str:
+    import hashlib
+    import platform
+
+    feats = ""
+    try:
+        with open("/proc/cpuinfo") as f:
+            for line in f:
+                if line.startswith(("flags", "Features")):
+                    toks = line.split(":", 1)[1].split()
+                    feats = " ".join(
+                        sorted(t for t in toks if t.startswith(_ISA_PREFIXES)))
+                    break
+    except OSError:
+        pass
+    if not feats:
+        feats = platform.processor() or platform.machine() or "unknown"
+    return hashlib.sha1(feats.encode()).hexdigest()[:12]
+
+
 if _os.environ.get("MXNET_XLA_CACHE", "1") != "0":
-    _cache_dir = _os.environ.get(
-        "MXNET_XLA_CACHE_DIR",
-        _os.path.join(_os.path.expanduser("~"), ".cache", "mxnet_tpu_xla"))
+    _cache_dir = _os.path.join(
+        _os.environ.get(
+            "MXNET_XLA_CACHE_DIR",
+            _os.path.join(_os.path.expanduser("~"), ".cache",
+                          "mxnet_tpu_xla")),
+        "host-" + _host_cpu_tag())
     try:
         _os.makedirs(_cache_dir, exist_ok=True)
         _jax.config.update("jax_compilation_cache_dir", _cache_dir)
