@@ -59,10 +59,13 @@ _ISA_PREFIXES = (
     "pclmul", "vpclmul", "gfni", "vaes", "adx", "lzcnt", "popcnt", "abm",
     "movbe", "movdir", "xsave", "rtm", "rdrnd", "rdseed", "rdpid",
     "fsgsbase", "invpcid", "clflush", "clwb", "cldemote", "wbnoinvd",
-    "serialize", "cmov", "cx8", "cx16", "fxsr", "crc32", "tsxldtrk",
+    "serialize", "cmov", "cx8", "cx16", "fxsr", "crc32",
     "lahf", "kl", "widekl", "waitpkg", "enqcmd", "uintr", "hreset", "lm",
     "neon", "asimd", "sve", "fp", "fphp", "crypto", "atomics", "lse",
 )
+# deliberately absent: rtm/hle/tsxldtrk — TSX is routinely disabled by
+# microcode mitigations (flag churn on identical hardware) and XLA codegen
+# never emits it.
 
 
 def _host_cpu_tag() -> str:
@@ -81,7 +84,13 @@ def _host_cpu_tag() -> str:
     except OSError:
         pass
     if not feats:
-        feats = platform.processor() or platform.machine() or "unknown"
+        # degraded path (no readable /proc/cpuinfo — non-Linux or /proc
+        # unmounted): only the coarse arch is known, so hosts of the same
+        # arch but different ISA extensions share a namespace and the
+        # cross-host AOT protection is WEAK here; the distinct prefix
+        # keeps these entries out of any verified-feature namespace.
+        feats = "weak:" + (platform.processor() or platform.machine()
+                           or "unknown")
     return hashlib.sha1(feats.encode()).hexdigest()[:12]
 
 
@@ -94,6 +103,18 @@ if _os.environ.get("MXNET_XLA_CACHE", "1") != "0":
         "host-" + _host_cpu_tag())
     try:
         _os.makedirs(_cache_dir, exist_ok=True)
+        # one-time cleanup: flat entries written by versions before the
+        # host namespacing have unknown host provenance (they're the
+        # SIGILL-risk entries this scheme exists to quarantine) — delete
+        # rather than migrate; they recompile once into the new subdir.
+        _base = _os.path.dirname(_cache_dir)
+        for _f in _os.listdir(_base):
+            if _f.endswith("-cache") and _os.path.isfile(
+                    _os.path.join(_base, _f)):
+                try:
+                    _os.unlink(_os.path.join(_base, _f))
+                except OSError:
+                    pass
         _jax.config.update("jax_compilation_cache_dir", _cache_dir)
         _jax.config.update("jax_persistent_cache_min_compile_time_secs", 1.0)
         _jax.config.update("jax_persistent_cache_min_entry_size_bytes", 0)
