@@ -9,7 +9,8 @@ from ..base import MXNetError
 from ..ndarray import NDArray
 from ..ndarray import array as nd_array
 
-__all__ = ["KVStore", "KVStoreLocal", "KVStoreTPUSync", "create"]
+__all__ = ["KVStore", "KVStoreDistAsyncEmu", "KVStoreLocal",
+           "KVStoreTPUSync", "create"]
 
 
 def create(name="local") -> "KVStore":
@@ -21,10 +22,17 @@ def create(name="local") -> "KVStore":
     if name in ("tpu_sync", "nccl", "dist_device_sync", "dist_sync"):
         return KVStoreTPUSync(name)
     if name in ("dist_async",):
+        import os
+
+        if os.environ.get("MXNET_KVSTORE_DIST_ASYNC_EMU") == "1":
+            return KVStoreDistAsyncEmu(name)
         raise MXNetError(
             "kvstore 'dist_async' (parameter-server async mode) has no "
             "TPU-native equivalent; use 'tpu_sync' (synchronous in-graph "
-            "allreduce over the mesh) — SURVEY.md §5.8")
+            "allreduce over the mesh), or opt into the bounded-staleness "
+            "emulation with MXNET_KVSTORE_DIST_ASYNC_EMU=1 "
+            "(MXNET_KVSTORE_ASYNC_STALENESS bounds the drift) — "
+            "SURVEY.md §5.8, ADR-002")
     if name in ("horovod", "byteps"):
         raise MXNetError(
             f"kvstore '{name}' plugin is replaced by 'tpu_sync' on TPU")
@@ -368,6 +376,88 @@ class KVStoreTPUSync(KVStoreLocal):
             else:
                 o._set_data(src.as_in_context(o.context).data
                             if o.context != src.context else data)
+
+
+class KVStoreDistAsyncEmu(KVStoreTPUSync):
+    """Bounded-staleness emulation of the reference's ``dist_async`` mode
+    (reference: kvstore_dist.h server mode over ps-lite — workers push
+    gradients, servers apply the optimizer immediately, no cross-worker
+    barrier, unbounded staleness).
+
+    TPU pods have no parameter server, and XLA collectives are
+    synchronous by construction — true unbounded-async cannot exist
+    in this execution model. The emulation keeps the convergence-relevant
+    property (each worker trains on locally-stale weights, applying its
+    own updates without waiting for peers) with a BOUND instead: the
+    server-side optimizer runs on the process-local replica at every
+    push, and every ``MXNET_KVSTORE_ASYNC_STALENESS`` pushes per key
+    (default 4) the replicas are averaged with one psum across processes.
+    ``staleness=1`` degenerates to per-step synchronous weight averaging.
+
+    Opt-in via ``MXNET_KVSTORE_DIST_ASYNC_EMU=1`` because the semantics
+    are an approximation of the reference's, not a match — ADR-002
+    records the decision (SURVEY.md §5.8 "deprecated with emulation
+    shim").
+    """
+
+    def __init__(self, type_name="dist_async"):
+        import os
+
+        super().__init__(type_name)
+        _maybe_init_distributed()
+        self._staleness = max(1, int(os.environ.get(
+            "MXNET_KVSTORE_ASYNC_STALENESS", "4")))
+        self._push_count: Dict = {}
+
+    @property
+    def staleness(self) -> int:
+        return self._staleness
+
+    def push(self, key, value, priority=0):
+        if isinstance(key, (list, tuple)):
+            for k, v in zip(key, value):
+                self.push(k, v, priority)
+            return
+        key = self._canon(key)
+        self._check_init(key)
+        if self._updater is None:
+            raise MXNetError(
+                "dist_async requires the server-side optimizer "
+                "(set_optimizer / Trainer with update_on_kvstore=True), "
+                "matching the reference's async server mode")
+        vals = list(value) if isinstance(value, (list, tuple)) else [value]
+        if self._compression is not None:
+            vals = [self._compression.compress(key, i, v)
+                    for i, v in enumerate(vals)]
+        # LOCAL aggregation only — the async property: no cross-process
+        # barrier on the push path
+        agg = KVStoreLocal._aggregate(self, vals)
+        self._updater(key, agg, self._store[key])
+        n = self._push_count[key] = self._push_count.get(key, 0) + 1
+        if n % self._staleness == 0:
+            self._sync_replicas(key)
+
+    def _sync_replicas(self, key):
+        """Average the process-local replicas: one psum over all
+        processes' devices (each local device contributes replica /
+        n_local, so every process has unit weight regardless of its
+        device count), then divide by the process count."""
+        import jax
+
+        if jax.process_count() == 1:
+            return
+        src = self._store[key]
+        local = jax.local_devices()
+        scaled = src.data / float(len(local))
+        copies = [NDArray(data=jax.device_put(scaled, d), ctx=src.context)
+                  for d in local]
+        total = self._collective_sum(copies)
+        # materialize the mean as a process-LOCAL array on the replica's
+        # own device: async pulls are local by contract, and the next
+        # push's updater keeps applying to a single-device replica
+        mean = total.addressable_data(0) / float(jax.process_count())
+        dev = next(iter(src.data.devices()))
+        src._set_data(jax.device_put(mean, dev))
 
 
 def _maybe_init_distributed():

@@ -49,9 +49,29 @@ class TestLocal:
         store.pull(0, out)
         np.testing.assert_allclose(out.asnumpy(), np.full((3,), 0.5))
 
-    def test_dist_async_rejected(self):
-        with pytest.raises(MXNetError, match="tpu_sync"):
+    def test_dist_async_rejected_without_flag(self):
+        with pytest.raises(MXNetError, match="MXNET_KVSTORE_DIST_ASYNC_EMU"):
             kv.create("dist_async")
+
+    def test_dist_async_emulation_local_semantics(self, monkeypatch):
+        """Single-process slice of the ADR-002 shim: pushes apply the
+        server-side optimizer immediately to the local replica, no
+        optimizer is a loud error, staleness knob is honored."""
+        monkeypatch.setenv("MXNET_KVSTORE_DIST_ASYNC_EMU", "1")
+        monkeypatch.setenv("MXNET_KVSTORE_ASYNC_STALENESS", "3")
+        store = kv.create("dist_async")
+        assert isinstance(store, kv.KVStoreDistAsyncEmu)
+        assert store.staleness == 3
+        store.init(0, mx.nd.zeros((3,)))
+        with pytest.raises(MXNetError, match="server-side optimizer"):
+            store.push(0, mx.nd.ones((3,)))
+        store.set_optimizer(mx.optimizer.create("sgd", learning_rate=1.0,
+                                                wd=0.0))
+        for i in range(4):  # crosses the staleness boundary (no-op at P=1)
+            store.push(0, mx.nd.ones((3,)))
+        out = mx.nd.zeros((3,))
+        store.pull(0, out)
+        np.testing.assert_allclose(out.asnumpy(), np.full((3,), -4.0))
 
 
 class TestTPUSync:
@@ -174,7 +194,82 @@ sys.stdout.write(f"DIST_OK {store.rank}\n"); sys.stdout.flush()
 """
 
 
+_DIST_ASYNC_WORKER = r"""
+import os, sys
+import numpy as np
+os.environ.setdefault("JAX_PLATFORMS", "cpu")
+os.environ["XLA_FLAGS"] = (os.environ.get("XLA_FLAGS", "")
+                           + " --xla_force_host_platform_device_count=1")
+os.environ["MXNET_KVSTORE_DIST_ASYNC_EMU"] = "1"
+os.environ["MXNET_KVSTORE_ASYNC_STALENESS"] = "2"
+import mxnet_tpu as mx
+from mxnet_tpu import kvstore as kv
+store = kv.create("dist_async")
+rank = store.rank
+store.set_optimizer(mx.optimizer.create("sgd", learning_rate=1.0, wd=0.0))
+store.init(0, mx.nd.zeros((2, 2)))
+g = float(rank * 2 + 1)                      # rank0: 1, rank1: 3
+# push 1: applied LOCALLY, no cross-process barrier -> replicas diverge
+store.push(0, mx.nd.full((2, 2), g))
+out = mx.nd.zeros((2, 2)); store.pull(0, out)
+assert np.allclose(out.asnumpy(), -g), (rank, out.asnumpy()[0, 0])
+# push 2 hits the staleness bound -> replicas averaged: mean(-2,-6) = -4
+store.push(0, mx.nd.full((2, 2), g))
+store.pull(0, out)
+assert np.allclose(out.asnumpy(), -4.0), (rank, out.asnumpy()[0, 0])
+# training continues locally on the synced value
+store.push(0, mx.nd.full((2, 2), g))
+store.pull(0, out)
+assert np.allclose(out.asnumpy(), -4.0 - g), (rank, out.asnumpy()[0, 0])
+sys.stdout.write(f"ASYNC_OK {rank}\n"); sys.stdout.flush()
+"""
+
+
 class TestDistSync:
+    def _run_two_workers(self, tmp_path, source, ok_token):
+        script = tmp_path / "worker.py"
+        script.write_text(source)
+        env_base = {k: v for k, v in os.environ.items()
+                    if not k.startswith(("DMLC_", "XLA_FLAGS"))}
+        import socket
+
+        with socket.socket() as s:
+            s.bind(("", 0))
+            port = s.getsockname()[1]
+        procs = []
+        for rank in range(2):
+            repo_root = os.path.dirname(os.path.dirname(
+                os.path.abspath(__file__)))
+            env = dict(env_base,
+                       JAX_PLATFORMS="cpu",
+                       PYTHONPATH=repo_root + os.pathsep
+                       + env_base.get("PYTHONPATH", ""),
+                       DMLC_PS_ROOT_URI="127.0.0.1",
+                       DMLC_PS_ROOT_PORT=str(port),
+                       DMLC_NUM_WORKER="2",
+                       DMLC_WORKER_ID=str(rank))
+            procs.append(subprocess.Popen(
+                [sys.executable, str(script)], env=env,
+                stdout=subprocess.PIPE, stderr=subprocess.STDOUT,
+                text=True))
+        outs = []
+        for p in procs:
+            try:
+                out, _ = p.communicate(timeout=180)
+            except subprocess.TimeoutExpired:
+                p.kill()
+                out, _ = p.communicate()
+            outs.append(out)
+        for rank, (p, out) in enumerate(zip(procs, outs)):
+            assert p.returncode == 0 and f"{ok_token} {rank}" in out, \
+                f"rank {rank} failed:\n{out[-2000:]}"
+
+    def test_dist_async_emulation_bounded_staleness(self, tmp_path):
+        """ADR-002 shim across 2 processes: pushes apply locally with no
+        barrier (replicas diverge), the staleness-th push averages the
+        replicas, training continues on the synced value."""
+        self._run_two_workers(tmp_path, _DIST_ASYNC_WORKER, "ASYNC_OK")
+
     def test_two_process_bootstrap(self, tmp_path):
         """create('dist_sync') bootstraps jax.distributed from the DMLC_*
         env contract (SURVEY.md §5.6.4) — 2 local processes."""
