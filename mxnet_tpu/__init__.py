@@ -94,7 +94,25 @@ def _host_cpu_tag() -> str:
     return hashlib.sha1(feats.encode()).hexdigest()[:12]
 
 
-if _os.environ.get("MXNET_XLA_CACHE", "1") != "0":
+def _cache_default() -> str:
+    # Pure-CPU processes (tests, the driver's virtual-mesh dryrun) default
+    # to NO persistent cache: their compiles are cheap, and XLA:CPU AOT
+    # entries are what trigger the cpu_aot_loader feature-probe warning on
+    # every later load (the probe doesn't know the +prefer-no-scatter/
+    # +prefer-no-gather tuning pseudo-features this XLA version compiles
+    # with — benign same-host noise, but it pollutes driver artifacts and
+    # reads like SIGILL risk). TPU-capable processes keep the cache (the
+    # minutes-long transformer TrainStep compiles are the whole point);
+    # their host-side CPU jits stay under the 1 s min-compile-time bar, so
+    # no CPU AOT entries get written and the warning cannot fire.
+    plats = _os.environ.get("JAX_PLATFORMS", "")
+    toks = [t.strip() for t in plats.split(",") if t.strip()]
+    if toks and all(t == "cpu" for t in toks):
+        return "0"
+    return "1"
+
+
+if _os.environ.get("MXNET_XLA_CACHE", _cache_default()) != "0":
     _cache_dir = _os.path.join(
         _os.environ.get(
             "MXNET_XLA_CACHE_DIR",

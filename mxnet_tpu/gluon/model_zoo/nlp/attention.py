@@ -25,8 +25,8 @@ class MultiHeadAttention(HybridBlock):
     """
 
     def __init__(self, units, num_heads, dropout=0.0, use_bias=True,
-                 causal=False, cross=False, ring_axis=None, prefix=None,
-                 params=None):
+                 causal=False, cross=False, ring_axis=None,
+                 attn_dropout=0.0, prefix=None, params=None):
         super().__init__(prefix=prefix, params=params)
         if units % num_heads:
             raise ValueError(f"units {units} not divisible by heads {num_heads}")
@@ -34,6 +34,11 @@ class MultiHeadAttention(HybridBlock):
         self._num_heads = num_heads
         self._causal = causal
         self._cross = cross
+        # attention-probability dropout (reference: GluonNLP
+        # MultiHeadAttentionCell's dropout on the attention weights) —
+        # applied INSIDE sdp_attention / the flash kernels; ``dropout``
+        # stays the output-projection dropout as before
+        self._attn_dropout = float(attn_dropout)
         # sequence-parallel ring attention over this mesh axis (long
         # contexts; requires mask-free attention)
         self._ring_axis = ring_axis
@@ -78,10 +83,12 @@ class MultiHeadAttention(HybridBlock):
         k = self._split_heads(F, k)
         v = self._split_heads(F, v)
         if mask is not None:
-            out = F._contrib_sdp_attention(q, k, v, mask, causal=self._causal)
+            out = F._contrib_sdp_attention(q, k, v, mask, causal=self._causal,
+                                           dropout=self._attn_dropout)
         else:
             out = F._contrib_sdp_attention(q, k, v, causal=self._causal,
-                                           ring_axis=self._ring_axis)
+                                           ring_axis=self._ring_axis,
+                                           dropout=self._attn_dropout)
         out = self._merge_heads(F, out)
         out = self.out_proj(out)
         if self.dropout is not None:

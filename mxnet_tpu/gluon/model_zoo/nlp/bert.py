@@ -23,15 +23,20 @@ class BERTEncoder(HybridBlock):
     """Stack of post-LN transformer cells with GELU FFN."""
 
     def __init__(self, num_layers=12, units=768, hidden_size=3072,
-                 num_heads=12, dropout=0.1, prefix=None, params=None):
+                 num_heads=12, dropout=0.1, attn_dropout=0.0,
+                 prefix=None, params=None):
         super().__init__(prefix=prefix, params=params)
         with self.name_scope():
             self.cells = nn.HybridSequential(prefix="")
             for i in range(num_layers):
-                # BERT FFN uses GELU (reference: gluonnlp BERTEncoder)
+                # BERT FFN uses GELU (reference: gluonnlp BERTEncoder);
+                # attn_dropout = dropout ON the attention probabilities
+                # (gluonnlp BERTEncoder attention_dropout), generated
+                # inside the flash kernels
                 self.cells.add(TransformerEncoderCell(
                     units, hidden_size, num_heads, dropout=dropout,
-                    activation="gelu", prefix=f"layer{i}_"))
+                    activation="gelu", attn_dropout=attn_dropout,
+                    prefix=f"layer{i}_"))
 
     def hybrid_forward(self, F, x, mask=None):
         for cell in self.cells._children.values():
@@ -49,7 +54,8 @@ class BERTModel(HybridBlock):
 
     def __init__(self, vocab_size=30522, token_type_vocab_size=2,
                  max_length=512, num_layers=12, units=768, hidden_size=3072,
-                 num_heads=12, dropout=0.1, use_pooler=True,
+                 num_heads=12, dropout=0.1, attn_dropout=0.0,
+                 use_pooler=True,
                  use_classifier=True, use_decoder=True,
                  prefix=None, params=None):
         super().__init__(prefix=prefix, params=params)
@@ -67,7 +73,9 @@ class BERTModel(HybridBlock):
             self.embed_ln = nn.LayerNorm(prefix="embed_ln_")
             self.embed_dropout = nn.Dropout(dropout) if dropout else None
             self.encoder = BERTEncoder(num_layers, units, hidden_size,
-                                       num_heads, dropout, prefix="enc_")
+                                       num_heads, dropout,
+                                       attn_dropout=attn_dropout,
+                                       prefix="enc_")
             if use_pooler:
                 self.pooler = nn.Dense(units, flatten=False, activation="tanh",
                                        prefix="pooler_")
@@ -158,7 +166,7 @@ class BERTForPretrainFused(HybridBlock):
 
     def __init__(self, vocab_size=30522, token_type_vocab_size=2,
                  max_length=512, num_layers=12, units=768, hidden_size=3072,
-                 num_heads=12, dropout=0.1, chunk=5120,
+                 num_heads=12, dropout=0.1, attn_dropout=0.0, chunk=5120,
                  prefix=None, params=None):
         super().__init__(prefix=prefix, params=params)
         self._units = units
@@ -169,7 +177,8 @@ class BERTForPretrainFused(HybridBlock):
                 token_type_vocab_size=token_type_vocab_size,
                 max_length=max_length, num_layers=num_layers, units=units,
                 hidden_size=hidden_size, num_heads=num_heads,
-                dropout=dropout, use_pooler=False, use_classifier=False,
+                dropout=dropout, attn_dropout=attn_dropout,
+                use_pooler=False, use_classifier=False,
                 use_decoder=False, prefix="bert_")
             self.decoder_transform = nn.Dense(
                 units, flatten=False, activation="gelu",

@@ -44,12 +44,14 @@ class PositionwiseFFN(HybridBlock):
 
 class TransformerEncoderCell(HybridBlock):
     def __init__(self, units, hidden_size, num_heads, dropout=0.0,
-                 pre_norm=False, activation="relu", prefix=None, params=None):
+                 pre_norm=False, activation="relu", attn_dropout=0.0,
+                 prefix=None, params=None):
         super().__init__(prefix=prefix, params=params)
         self._pre_norm = pre_norm
         with self.name_scope():
             self.attention = MultiHeadAttention(units, num_heads,
                                                 dropout=dropout,
+                                                attn_dropout=attn_dropout,
                                                 prefix="attn_")
             self.ffn = PositionwiseFFN(units, hidden_size, dropout=dropout,
                                        activation=activation, prefix="ffn_")

@@ -112,7 +112,9 @@ class Initializer:
 
     def _rand(self):
         # initializer randomness flows from the global mx.random seed
-        return _np.random
+        from .random_state import host_rng
+
+        return host_rng()
 
 
 @register
@@ -122,7 +124,7 @@ class Uniform(Initializer):
         self.scale = scale
 
     def _init_weight(self, name, arr):
-        arr[:] = _np.random.uniform(-self.scale, self.scale, arr.shape).astype("float32")
+        arr[:] = self._rand().uniform(-self.scale, self.scale, arr.shape).astype("float32")
 
 
 @register
@@ -132,7 +134,7 @@ class Normal(Initializer):
         self.sigma = sigma
 
     def _init_weight(self, name, arr):
-        arr[:] = _np.random.normal(0, self.sigma, arr.shape).astype("float32")
+        arr[:] = self._rand().normal(0, self.sigma, arr.shape).astype("float32")
 
 
 @register
@@ -182,9 +184,9 @@ class Xavier(Initializer):
         factor = {"avg": (fan_in + fan_out) / 2.0, "in": fan_in, "out": fan_out}[self.factor_type]
         scale = math.sqrt(self.magnitude / factor)
         if self.rnd_type == "uniform":
-            arr[:] = _np.random.uniform(-scale, scale, shape).astype("float32")
+            arr[:] = self._rand().uniform(-scale, scale, shape).astype("float32")
         elif self.rnd_type == "gaussian":
-            arr[:] = _np.random.normal(0, scale, shape).astype("float32")
+            arr[:] = self._rand().normal(0, scale, shape).astype("float32")
         else:
             raise MXNetError(f"unknown rnd_type {self.rnd_type}")
 
@@ -208,9 +210,9 @@ class Orthogonal(Initializer):
         nout = arr.shape[0]
         nin = int(_np.prod(arr.shape[1:]))
         if self.rand_type == "uniform":
-            tmp = _np.random.uniform(-1.0, 1.0, (nout, nin))
+            tmp = self._rand().uniform(-1.0, 1.0, (nout, nin))
         else:
-            tmp = _np.random.normal(0.0, 1.0, (nout, nin))
+            tmp = self._rand().normal(0.0, 1.0, (nout, nin))
         u, _, v = _np.linalg.svd(tmp, full_matrices=False)
         q = u if u.shape == tmp.shape else v
         arr[:] = (self.scale * q).reshape(arr.shape).astype("float32")

@@ -277,8 +277,28 @@ class KVStoreTPUSync(KVStoreLocal):
                      if d.process_index == jax.process_index()]
             return mesh, local
         if jax.process_count() > 1:
-            devs = jax.devices()          # same order on every process
-            return Mesh(np.array(devs), ("kv",)), jax.local_devices()
+            # one mesh slot per PUSHED COPY per process, not per device:
+            # a single-context worker (one model replica per process, the
+            # common deployment) pushes one copy even when the process
+            # exposes several devices. The mesh depends ONLY on the copy
+            # COUNT (slot i -> every process's i-th local device in id
+            # order), never on which local devices this rank's copies
+            # happen to sit on — per-rank placement must not produce
+            # per-rank meshes (a disagreeing device set deadlocks the
+            # collective). SPMD contract: every process pushes the same
+            # number of copies per key; _collective_sum's device check
+            # surfaces placement mismatches loudly.
+            k = len(vals)
+            by_proc = {}
+            for d in jax.devices():       # same order on every process
+                by_proc.setdefault(d.process_index, []).append(d)
+            chosen = []
+            for p in sorted(by_proc):
+                proc_devs = sorted(by_proc[p], key=lambda d: d.id)
+                chosen.extend(proc_devs[:k])
+            local = [d for d in chosen
+                     if d.process_index == jax.process_index()]
+            return Mesh(np.array(chosen), ("kv",)), local
         devs = [next(iter(v.data.devices())) for v in vals]
         return Mesh(np.array(devs), ("kv",)), devs
 
@@ -317,6 +337,21 @@ class KVStoreTPUSync(KVStoreLocal):
         shape = tuple(vals[0].shape)
         by_dev = {next(iter(v.data.devices())): v for v in vals}
         if set(by_dev) != set(local_devs):
+            if jax.process_count() > 1 and len(by_dev) == len(local_devs):
+                # multi-process slot mesh (see _reduce_mesh): the mesh
+                # slots are position-derived, so a copy pinned to a
+                # different local device is relocated onto its slot
+                # (deterministic: copies ordered by source device id)
+                ordered = [by_dev[d] for d in
+                           sorted(by_dev, key=lambda d: d.id)]
+                by_dev = {ld: jax.device_put(v.data, ld)
+                          for ld, v in zip(local_devs, ordered)}
+                shards = [by_dev[d].reshape((1,) + shape)
+                          for d in local_devs]
+                stacked = jax.make_array_from_single_device_arrays(
+                    (ndev,) + shape, NamedSharding(mesh, P("kv")), shards)
+                return self._reducer(mesh, ndev, shape,
+                                     vals[0].dtype)(stacked)
             raise MXNetError(
                 f"tpu_sync push expects one gradient copy per local mesh "
                 f"device ({len(local_devs)}); got copies on "

@@ -18,9 +18,14 @@ import jax.numpy as jnp
 from .registry import register
 
 
-def _sdpa_reference(q, k, v, mask, scale, causal, layout="bhld"):
+def _sdpa_reference(q, k, v, mask, scale, causal, layout="bhld",
+                    dropout=0.0, seed=None):
     """f32-softmax attention. layout "bhld": (B, H, L, D); "blhd":
-    (B, L, H, D) — head transposes fold into the einsum contractions."""
+    (B, L, H, D) — head transposes fold into the einsum contractions.
+
+    ``dropout``: attention-probability dropout using the SAME stateless
+    position-hash mask as the Pallas flash kernels (bitwise identical
+    given the same seed) — this path is the kernels' dense oracle."""
     dtype = q.dtype
     if layout == "blhd":
         scores = jnp.einsum("bqhd,bkhd->bhqk", q, k)
@@ -35,15 +40,33 @@ def _sdpa_reference(q, k, v, mask, scale, causal, layout="bhld"):
         # mask: 1 = attend, 0 = ignore; broadcastable to (B, H, Lq, Lk)
         m = jnp.broadcast_to(mask.astype(bool), scores.shape)
         scores = jnp.where(m, scores, jnp.float32(-1e9))
-    probs = jax.nn.softmax(scores, axis=-1).astype(dtype)
+    probs = jax.nn.softmax(scores, axis=-1)
+    if dropout > 0.0:
+        from ..pallas_kernels.flash_attention import (_drop_mask,
+                                                      dropout_thresh)
+
+        b, h, lq, lk = probs.shape
+        shp = probs.shape
+        head = (jax.lax.broadcasted_iota(jnp.int32, shp, 0) * h
+                + jax.lax.broadcasted_iota(jnp.int32, shp, 1))
+        qp = jax.lax.broadcasted_iota(jnp.int32, shp, 2)
+        kp = jax.lax.broadcasted_iota(jnp.int32, shp, 3)
+        keep = _drop_mask(head, qp, kp, lq, lk,
+                          jnp.asarray(seed, jnp.uint32).reshape(-1)[0],
+                          dropout_thresh(float(dropout)))
+        probs = jnp.where(keep,
+                          probs * jnp.float32(1.0 / (1.0 - dropout)), 0.0)
+    probs = probs.astype(dtype)
     if layout == "blhd":
         return jnp.einsum("bhqk,bkhd->bqhd", probs, v)
     return jnp.einsum("bhqk,bhkd->bhqd", probs, v)
 
 
-@register("_contrib_sdp_attention", aliases=["sdp_attention"])
-def sdp_attention(query, key, value, mask=None, *, scale=None, causal=False,
-                  flash=True, layout="bhld", ring_axis=None):
+@register("_contrib_sdp_attention", aliases=["sdp_attention"],
+          needs_rng=True, pass_training_flag=True)
+def sdp_attention(rng, query, key, value, mask=None, *, scale=None,
+                  causal=False, flash=True, layout="bhld", ring_axis=None,
+                  dropout=0.0, _training=False):
     """Scaled dot-product attention.
 
     ``layout``: "bhld" (batch, heads, seq, head_dim) or "blhd" (batch, seq,
@@ -55,9 +78,23 @@ def sdp_attention(query, key, value, mask=None, *, scale=None, causal=False,
     ``flash=True`` routes to the Pallas flash kernel on TPU when the shape
     qualifies (seq multiple of block size); otherwise the XLA reference path
     runs (which XLA fuses well on its own for short sequences).
+
+    ``dropout``: attention-probability dropout (reference capability:
+    GluonNLP MultiHeadAttentionCell applies dropout to the attention
+    weights). Training-mode only. Generated INSIDE the flash kernels from
+    a stateless position hash (pallas_kernels.flash_attention._drop_mask)
+    seeded from this op's PRNG key; the reference/scan paths use the
+    bitwise-identical mask, so every dispatch route drops the same
+    elements for a given key.
     """
     if scale is None:
         scale = 1.0 / math.sqrt(query.shape[-1])
+    p_drop = float(dropout) if _training else 0.0
+    seed = None
+    if p_drop > 0.0:
+        from ..pallas_kernels.flash_attention import fold_key_seed
+
+        seed = fold_key_seed(rng)
     from ..parallel.ring_attention import ring_active
 
     if ring_axis is not None and mask is None and ring_active(ring_axis):
@@ -66,6 +103,11 @@ def sdp_attention(query, key, value, mask=None, *, scale=None, causal=False,
         # reference dispatch below instead of pinning the dense path
         from ..parallel.ring_attention import ring_attention
 
+        if p_drop > 0.0:
+            raise ValueError(
+                "sdp_attention: attention dropout is not supported with "
+                "ring (sequence-parallel) attention — the per-pair mask "
+                "would need globally-consistent positions across shards")
         if layout == "blhd":
             out = ring_attention(query.transpose(0, 2, 1, 3),
                                  key.transpose(0, 2, 1, 3),
@@ -80,19 +122,22 @@ def sdp_attention(query, key, value, mask=None, *, scale=None, causal=False,
 
         if flash_supported(query, key, value, causal=causal, layout=layout):
             return flash_attention(query, key, value, scale=scale,
-                                   causal=causal, layout=layout)
+                                   causal=causal, layout=layout,
+                                   dropout=p_drop, seed=seed)
         seq_ax = 1 if layout == "blhd" else -2
         if key.shape[seq_ax] >= 2048:
             # long sequence off-TPU: O(L) memory blockwise path
             if layout == "blhd":
                 out = flash_attention_scan(
                     query.transpose(0, 2, 1, 3), key.transpose(0, 2, 1, 3),
-                    value.transpose(0, 2, 1, 3), scale=scale, causal=causal)
+                    value.transpose(0, 2, 1, 3), scale=scale, causal=causal,
+                    dropout=p_drop, seed=seed)
                 return out.transpose(0, 2, 1, 3)
             return flash_attention_scan(query, key, value, scale=scale,
-                                        causal=causal)
+                                        causal=causal, dropout=p_drop,
+                                        seed=seed)
     return _sdpa_reference(query, key, value, mask, scale, causal,
-                           layout=layout)
+                           layout=layout, dropout=p_drop, seed=seed)
 
 
 @register("_contrib_rms_norm", aliases=["rms_norm"])

@@ -69,6 +69,66 @@ _ZERO32 = _np.float32(0.0)
 # because only the representation of p's computation moves, not p.
 _LOG2E = _np.float32(1.4426950408889634)
 
+# --- stateless dropout hash (shared by kernels, fallbacks, and oracles) ---
+# splitmix/murmur3-finalizer on the element's absolute (head, q, k) id:
+# pure elementwise integer code, so the SAME mask is reproducible in any
+# kernel orientation/grouping (fwd (BQ, BK) vs transposed bwd (BK, BQ))
+# and in the pure-jnp reference path — no PRNG state to thread, no
+# fwd-to-bwd mask tensor in HBM. 16 low hash bits vs a u16 threshold =
+# the dropout op's keep-rate granularity (ops/nn.py::dropout_op).
+_GOLD = _np.uint32(0x9E3779B9)
+_MUR1 = _np.uint32(0x85EBCA6B)
+_MUR2 = _np.uint32(0xC2B2AE35)
+_U16 = _np.uint32(0xFFFF)
+
+
+def _hash_u32(idx, seed):
+    """Murmur3-finalize uint32 ``idx`` (+seed); full 32-bit result."""
+    z = idx * _GOLD + seed
+    z = z ^ (z >> 16)
+    z = z * _MUR1
+    z = z ^ (z >> 13)
+    z = z * _MUR2
+    z = z ^ (z >> 16)
+    return z
+
+
+def _hash_u16(idx, seed):
+    """Low 16 bits of the murmur3 finalizer (dropout threshold compare)."""
+    return _hash_u32(idx, seed) & _U16
+
+
+def dropout_thresh(p):
+    """u16 keep threshold for drop probability ``p``."""
+    return _np.uint32(min(0xFFFF, int(round((1.0 - p) * 65536.0))))
+
+
+def fold_key_seed(rng):
+    """Fold a jax PRNG key's words into one u32 dropout seed — shared by
+    every stateless-hash dropout site so all dispatch paths derive the
+    identical stream from the same op key."""
+    kd = jax.random.key_data(rng).astype(jnp.uint32).reshape(-1)
+    seed = kd[0]
+    for i in range(1, kd.shape[0]):
+        seed = seed ^ (kd[i] * _np.uint32(0x9E3779B9 + i))
+    return seed
+
+
+def _drop_mask(head_idx, q_pos, k_pos, lq, lk, seed, thresh):
+    """Keep-mask for absolute (head, q, k) positions (any orientation).
+
+    Two-level hash: the (batch*head) index folds into a per-head seed
+    first, then the in-head (q*lk + k) id is hashed under it — a single
+    flat (head*lq + q)*lk + k id would wrap uint32 at b*h*lq*lk > 2^32
+    (e.g. 32x16 heads at seq 4096) and silently give distinct elements
+    identical masks. Per-head ids wrap only at lq*lk > 2^32, i.e. seq
+    ~65k even before the head split.
+    """
+    head_seed = _hash_u32(head_idx.astype(jnp.uint32), seed)
+    idx = (q_pos.astype(jnp.uint32) * _np.uint32(lk)
+           + k_pos.astype(jnp.uint32))
+    return _hash_u16(idx, head_seed) < thresh
+
 
 def _x32_mode():
     # Mosaic cannot legalize the i64/f64 constants that jax_enable_x64
@@ -126,11 +186,18 @@ def flash_supported(q, k, v, causal=False, layout="bhld") -> bool:
 
 
 def flash_attention_scan(q, k, v, scale=None, causal=False,
-                         block_k=BLOCK_K):
+                         block_k=BLOCK_K, dropout=0.0, seed=None):
     """Online-softmax attention via lax.scan over K blocks. O(Lk/block)
-    scan steps, never materialises the (Lq, Lk) score matrix."""
+    scan steps, never materialises the (Lq, Lk) score matrix.
+
+    ``dropout``/``seed``: same stateless position-hash mask as the Pallas
+    kernels (bitwise identical given the same seed) — this path doubles
+    as the kernels' CPU oracle."""
     if scale is None:
         scale = 1.0 / math.sqrt(q.shape[-1])
+    dropout = float(dropout)
+    if dropout > 0.0 and seed is None:
+        raise ValueError("flash_attention_scan: dropout > 0 requires seed")
     dtype = q.dtype
     b, h, lq, d = q.shape
     lk = k.shape[2]
@@ -163,7 +230,22 @@ def flash_attention_scan(q, k, v, scale=None, causal=False,
         p = jnp.where(valid[None, None], jnp.exp(s - m_new), 0.0)
         alpha = jnp.exp(m - m_new)
         l_new = l * alpha + jnp.sum(p, axis=-1, keepdims=True)
-        acc_new = acc * alpha + jnp.einsum("bhqk,bhkd->bhqd", p, vb)
+        if dropout > 0.0:
+            shp = (b, h, lq, block_k)
+            head = (jax.lax.broadcasted_iota(jnp.int32, shp, 0) * h
+                    + jax.lax.broadcasted_iota(jnp.int32, shp, 1))
+            qp = jax.lax.broadcasted_iota(jnp.int32, shp, 2)
+            kp = kidx * block_k + jax.lax.broadcasted_iota(
+                jnp.int32, shp, 3)
+            # true lk (not the padded extent): padded columns have p == 0
+            # regardless, and the kernel oracle hashes with true lk
+            keep = _drop_mask(head, qp, kp, lq, lk,
+                              jnp.asarray(seed, jnp.uint32).reshape(-1)[0],
+                              dropout_thresh(dropout))
+            p_acc = jnp.where(keep, p, 0.0)
+        else:
+            p_acc = p
+        acc_new = acc * alpha + jnp.einsum("bhqk,bhkd->bhqd", p_acc, vb)
         return (acc_new, m_new, l_new), None
 
     acc0 = jnp.zeros((b, h, lq, d), jnp.float32)
@@ -174,6 +256,8 @@ def flash_attention_scan(q, k, v, scale=None, causal=False,
         (kf.transpose(2, 0, 1, 3, 4), vf.transpose(2, 0, 1, 3, 4),
          jnp.arange(nk)))
     # fully-masked rows (l == 0) emit zeros rather than 0/0 NaN
+    if dropout > 0.0:
+        acc = acc * _np.float32(1.0 / (1.0 - dropout))
     return (acc / jnp.where(l == 0.0, 1.0, l)).astype(dtype)
 
 
@@ -182,8 +266,9 @@ def flash_attention_scan(q, k, v, scale=None, causal=False,
 # ---------------------------------------------------------------------------
 
 
-def _fwd_kernel(q_ref, k_ref, v_ref, o_ref, lse_ref, acc_ref, m_ref, l_ref,
-                *, scale2, causal, nk, causal_offset, prec, bq, bk):
+def _fwd_kernel(q_ref, k_ref, v_ref, seed_ref, o_ref, lse_ref, acc_ref,
+                m_ref, l_ref, *, scale2, causal, nk, causal_offset, prec,
+                bq, bk, dropout, lq, lk):
     from jax.experimental import pallas as pl
 
     ki = pl.program_id(2)
@@ -221,8 +306,15 @@ def _fwd_kernel(q_ref, k_ref, v_ref, o_ref, lse_ref, acc_ref, m_ref, l_ref,
         alpha = jnp.exp2(m_prev - m_new)
         l_ref[:] = l_ref[:] * alpha + jnp.broadcast_to(
             jnp.sum(p, axis=-1, keepdims=True), l_ref.shape)
+        if dropout > 0.0:
+            # drop in the PV accumulation only: the online (m, l) stats
+            # stay pre-dropout; inv_keep folds into the final normalize
+            keep = _drop_mask_2d(seed_ref, bq, bk, qi, ki, lq, lk, dropout)
+            pd = jnp.where(keep, p, _ZERO32)
+        else:
+            pd = p
         acc_ref[:] = acc_ref[:] * alpha + jnp.dot(
-            p.astype(v.dtype), v, preferred_element_type=jnp.float32,
+            pd.astype(v.dtype), v, preferred_element_type=jnp.float32,
             precision=prec)
         m_ref[:] = jnp.broadcast_to(m_new, m_ref.shape)
 
@@ -238,8 +330,10 @@ def _fwd_kernel(q_ref, k_ref, v_ref, o_ref, lse_ref, acc_ref, m_ref, l_ref,
     def _final():
         # fully-masked rows (every K block skipped: l == 0) emit zeros
         l = l_ref[:, 0:1]
-        o_ref[...] = (acc_ref[:] / jnp.where(l == _ZERO32, _ONE32, l)).astype(
-            o_ref.dtype)
+        div = jnp.where(l == _ZERO32, _ONE32, l)
+        if dropout > 0.0:
+            div = div * _np.float32(1.0 - dropout)
+        o_ref[...] = (acc_ref[:] / div).astype(o_ref.dtype)
         # per-row base-2 logsumexp residual for the backward kernels,
         # stored as a lane vector broadcast over 8 sublanes — (8, BQ) is
         # the smallest f32 tile, so the (BQ,) column transposes in legally
@@ -251,8 +345,40 @@ def _fwd_kernel(q_ref, k_ref, v_ref, o_ref, lse_ref, acc_ref, m_ref, l_ref,
             lse_col.reshape(1, bq), (8, bq))
 
 
-def _fwd_kernel_single_g(q_ref, k_ref, v_ref, o_ref, lse_ref, *,
-                         scale2, causal, causal_offset, prec, bq, bk):
+def _drop_mask_g(seed_ref, g, bq, bk, qi, ki, lq, lk, dropout):
+    """(G, bq, bk) keep-mask for the g-heads-per-step kernels; head ids
+    are absolute (program_id(0) * g + local)."""
+    from jax.experimental import pallas as pl
+
+    head = (pl.program_id(0) * g + jax.lax.broadcasted_iota(
+        jnp.int32, (g, bq, bk), 0))
+    q_pos = qi * bq + jax.lax.broadcasted_iota(jnp.int32, (g, bq, bk), 1)
+    k_pos = ki * bk + jax.lax.broadcasted_iota(jnp.int32, (g, bq, bk), 2)
+    return _drop_mask(head, q_pos, k_pos, lq, lk, seed_ref[0],
+                      dropout_thresh(dropout))
+
+
+def _drop_mask_2d(seed_ref, bq, bk, qi, ki, lq, lk, dropout,
+                  transposed=False):
+    """(bq, bk) keep-mask — or its exact (bk, bq) transpose for the
+    score-transposed backward kernels (same absolute ids, so the bits
+    match the forward elementwise)."""
+    from jax.experimental import pallas as pl
+
+    head = pl.program_id(0)
+    if transposed:
+        q_pos = qi * bq + jax.lax.broadcasted_iota(jnp.int32, (bk, bq), 1)
+        k_pos = ki * bk + jax.lax.broadcasted_iota(jnp.int32, (bk, bq), 0)
+    else:
+        q_pos = qi * bq + jax.lax.broadcasted_iota(jnp.int32, (bq, bk), 0)
+        k_pos = ki * bk + jax.lax.broadcasted_iota(jnp.int32, (bq, bk), 1)
+    return _drop_mask(head, q_pos, k_pos, lq, lk, seed_ref[0],
+                      dropout_thresh(dropout))
+
+
+def _fwd_kernel_single_g(q_ref, k_ref, v_ref, seed_ref, o_ref, lse_ref, *,
+                         scale2, causal, causal_offset, prec, bq, bk,
+                         dropout, lq, lk):
     """g heads per grid step (refs (G, BQ/BK, D)): amortizes the
     per-grid-step overhead that dominates once the softmax runs in
     base-2 — the dots batch over the leading head dim on the MXU."""
@@ -272,18 +398,30 @@ def _fwd_kernel_single_g(q_ref, k_ref, v_ref, o_ref, lse_ref, *,
     p = jnp.exp2(s - m)
     l = jnp.sum(p, axis=-1, keepdims=True)
     l_safe = jnp.where(l == _ZERO32, _ONE32, l)
+    if dropout > 0.0:
+        # mask applied to the PV accumulation only: l (and the lse
+        # residual) stay pre-dropout softmax statistics; inv_keep folds
+        # into the final normalize
+        g = q.shape[0]
+        keep = _drop_mask_g(seed_ref, g, bq, bk, 0, 0, lq, lk, dropout)
+        pd = jnp.where(keep, p, _ZERO32)
+        l_safe = l_safe * _np.float32(1.0 - dropout)
+    else:
+        pd = p
     o = jax.lax.dot_general(
-        p.astype(v.dtype), v, (((2,), (1,)), ((0,), (0,))),
+        pd.astype(v.dtype), v, (((2,), (1,)), ((0,), (0,))),
         preferred_element_type=jnp.float32, precision=prec)
     o_ref[...] = (o / l_safe).astype(o_ref.dtype)
     g = q.shape[0]
-    lse_col = jnp.where(l == _ZERO32, _NEG_INF32, m + jnp.log2(l_safe))
+    l_norm = jnp.where(l == _ZERO32, _ONE32, l)
+    lse_col = jnp.where(l == _ZERO32, _NEG_INF32, m + jnp.log2(l_norm))
     lse_ref[...] = jnp.broadcast_to(
         lse_col.reshape(g, 1, bq), (g, 8, bq))
 
 
-def _fwd_kernel_single(q_ref, k_ref, v_ref, o_ref, lse_ref, *,
-                       scale2, causal, causal_offset, prec, bq, bk):
+def _fwd_kernel_single(q_ref, k_ref, v_ref, seed_ref, o_ref, lse_ref, *,
+                       scale2, causal, causal_offset, prec, bq, bk,
+                       dropout, lq, lk):
     """Whole-head-in-one-block forward (nq == nk == 1, e.g. BERT seq 512).
 
     No streaming means no running statistics: the scratch carries and the
@@ -306,9 +444,16 @@ def _fwd_kernel_single(q_ref, k_ref, v_ref, o_ref, lse_ref, *,
     p = jnp.exp2(s - m)
     l = jnp.sum(p, axis=-1, keepdims=True)
     l_safe = jnp.where(l == _ZERO32, _ONE32, l)
-    o_ref[...] = (jnp.dot(p.astype(v.dtype), v,
+    if dropout > 0.0:
+        keep = _drop_mask_2d(seed_ref, bq, bk, 0, 0, lq, lk, dropout)
+        pd = jnp.where(keep, p, _ZERO32)
+        div = l_safe * _np.float32(1.0 - dropout)
+    else:
+        pd = p
+        div = l_safe
+    o_ref[...] = (jnp.dot(pd.astype(v.dtype), v,
                           preferred_element_type=jnp.float32,
-                          precision=prec) / l_safe).astype(o_ref.dtype)
+                          precision=prec) / div).astype(o_ref.dtype)
     lse_col = jnp.where(l == _ZERO32, _NEG_INF32, m + jnp.log2(l_safe))
     lse_ref[...] = jnp.broadcast_to(lse_col.reshape(1, bq), (8, bq))
 
@@ -341,8 +486,17 @@ def _tile_spec(layout, h, blk, d, seq_index):
         lambda bh_, qi, ki, _s=seq_index: (bh_, (qi, ki)[_s], 0))
 
 
+def _seed_arr(seed):
+    """Normalize the dropout seed to the (1,) u32 SMEM operand the
+    kernels read (zeros when dropout is off — the mask code isn't
+    traced then, the operand just keeps signatures uniform)."""
+    if seed is None:
+        return jnp.zeros((1,), jnp.uint32)
+    return jnp.asarray(seed, jnp.uint32).reshape((1,))
+
+
 def _flash_fwd_pallas(q, k, v, scale, causal, interpret=False,
-                      layout="bhld"):
+                      layout="bhld", dropout=0.0, seed=None):
     from jax.experimental import pallas as pl
     from jax.experimental.pallas import tpu as pltpu
 
@@ -360,10 +514,12 @@ def _flash_fwd_pallas(q, k, v, scale, causal, interpret=False,
     nq, nk = lq // bq, lk // bk
     prec = _prec_for(q.dtype)
     scale2 = _np.float32(scale) * _LOG2E
+    smem_spec = pl.BlockSpec(memory_space=pltpu.SMEM)
     in_specs = [
         _tile_spec(layout, h, bq, d, 0),
         _tile_spec(layout, h, bk, d, 1),
         _tile_spec(layout, h, bk, d, 1),
+        smem_spec,
     ]
     out_specs = [
         _tile_spec(layout, h, bq, d, 0),
@@ -384,7 +540,8 @@ def _flash_fwd_pallas(q, k, v, scale, causal, interpret=False,
                  if bh % gg == 0 and gg * bq * bk * 4 <= 4 << 20)
         kernel = functools.partial(
             _fwd_kernel_single_g, scale2=scale2, causal=causal,
-            causal_offset=lk - lq, prec=prec, bq=bq, bk=bk)
+            causal_offset=lk - lq, prec=prec, bq=bq, bk=bk,
+            dropout=dropout, lq=lq, lk=lk)
         with _x32_mode():
             out, lse = pl.pallas_call(
                 kernel,
@@ -393,6 +550,7 @@ def _flash_fwd_pallas(q, k, v, scale, causal, interpret=False,
                     pl.BlockSpec((g, bq, d), lambda b, qi, ki: (b, qi, 0)),
                     pl.BlockSpec((g, bk, d), lambda b, qi, ki: (b, ki, 0)),
                     pl.BlockSpec((g, bk, d), lambda b, qi, ki: (b, ki, 0)),
+                    smem_spec,
                 ],
                 out_specs=[
                     pl.BlockSpec((g, bq, d), lambda b, qi, ki: (b, qi, 0)),
@@ -404,17 +562,19 @@ def _flash_fwd_pallas(q, k, v, scale, causal, interpret=False,
                     jax.ShapeDtypeStruct((bh, nq, 8, bq), jnp.float32),
                 ],
                 interpret=interpret,
-            )(q, k, v)
+            )(q, k, v, _seed_arr(seed))
         return out.reshape(b, h, lq, d), lse
     if nq == 1 and nk == 1:
         kernel = functools.partial(
             _fwd_kernel_single, scale2=scale2, causal=causal,
-            causal_offset=lk - lq, prec=prec, bq=bq, bk=bk)
+            causal_offset=lk - lq, prec=prec, bq=bq, bk=bk,
+            dropout=dropout, lq=lq, lk=lk)
         scratch = []
     else:
         kernel = functools.partial(
             _fwd_kernel, scale2=scale2, causal=causal, nk=nk,
-            causal_offset=lk - lq, prec=prec, bq=bq, bk=bk)
+            causal_offset=lk - lq, prec=prec, bq=bq, bk=bk,
+            dropout=dropout, lq=lq, lk=lk)
         scratch = [
             pltpu.VMEM((bq, d), jnp.float32),
             pltpu.VMEM((bq, 128), jnp.float32),
@@ -429,15 +589,16 @@ def _flash_fwd_pallas(q, k, v, scale, causal, interpret=False,
             out_shape=out_shape,
             scratch_shapes=scratch,
             interpret=interpret,
-        )(q, k, v)
+        )(q, k, v, _seed_arr(seed))
     if layout == "bhld":
         out = out.reshape(b, h, lq, d)
     return out, lse
 
 
 def _bwd_dkdv_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref,
-                     dk_ref, dv_ref, dk_acc, dv_acc, *,
-                     scale, scale2, causal, nq, causal_offset, prec, bq, bk):
+                     seed_ref, dk_ref, dv_ref, dk_acc, dv_acc, *,
+                     scale, scale2, causal, nq, causal_offset, prec, bq, bk,
+                     dropout, lq, lk):
     """dK/dV for one K block; Q blocks stream on the innermost grid dim.
 
     All score math is done TRANSPOSED — s_T = (BK, BQ) — so the per-row
@@ -473,12 +634,25 @@ def _bwd_dkdv_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref,
                 jnp.int32, (bk, bq), 0)
             s_t = jnp.where(k_pos <= q_pos, s_t, _NEG_INF32)
         p_t = jnp.exp2(s_t - lse)                            # (BK, BQ)
-        dv_acc[:] += jnp.dot(p_t.astype(do.dtype), do,
+        if dropout > 0.0:
+            # regenerate the forward's exact mask (same absolute ids,
+            # transposed orientation); dV sees P_drop, dP gets the mask
+            # before the softmax backward (dS = P ⊙ (dP - delta) — the
+            # delta trick survives dropout unchanged, PERF.md round 5)
+            keep_t = _drop_mask_2d(seed_ref, bq, bk, qi, ki, lq, lk,
+                                   dropout, transposed=True)
+            inv_keep = _np.float32(1.0 / (1.0 - dropout))
+            pd_t = jnp.where(keep_t, p_t * inv_keep, _ZERO32)
+        else:
+            pd_t = p_t
+        dv_acc[:] += jnp.dot(pd_t.astype(do.dtype), do,
                              preferred_element_type=jnp.float32,
                              precision=prec)
         dp_t = jax.lax.dot_general(
             v, do, (((1,), (1,)), ((), ())),
             preferred_element_type=jnp.float32, precision=prec)  # (BK, BQ)
+        if dropout > 0.0:
+            dp_t = jnp.where(keep_t, dp_t * inv_keep, _ZERO32)
         ds_t = p_t * (dp_t - delta) * scale
         dk_acc[:] += jnp.dot(ds_t.astype(q.dtype), q,
                              preferred_element_type=jnp.float32,
@@ -497,9 +671,23 @@ def _bwd_dkdv_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref,
         dv_ref[...] = dv_acc[:].astype(dv_ref.dtype)
 
 
+def _drop_mask_g_t(seed_ref, g, bq, bk, lq, lk, dropout):
+    """(G, bk, bq) transposed keep-mask for the g-heads fused backward —
+    bitwise identical to _drop_mask_g's forward mask."""
+    from jax.experimental import pallas as pl
+
+    head = (pl.program_id(0) * g + jax.lax.broadcasted_iota(
+        jnp.int32, (g, bk, bq), 0))
+    q_pos = jax.lax.broadcasted_iota(jnp.int32, (g, bk, bq), 2)
+    k_pos = jax.lax.broadcasted_iota(jnp.int32, (g, bk, bq), 1)
+    return _drop_mask(head, q_pos, k_pos, lq, lk, seed_ref[0],
+                      dropout_thresh(dropout))
+
+
 def _bwd_fused_kernel_g(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref,
-                        dq_ref, dk_ref, dv_ref, *, scale, scale2, causal,
-                        causal_offset, prec, bq, bk):
+                        seed_ref, dq_ref, dk_ref, dv_ref, *, scale, scale2,
+                        causal, causal_offset, prec, bq, bk, dropout,
+                        lq, lk):
     """g-heads-per-step fused backward (refs (G, ., .)); see
     _bwd_fused_kernel for the math, _fwd_kernel_single_g for why."""
     q = q_ref[...]                                     # (G, BQ, D)
@@ -518,7 +706,14 @@ def _bwd_fused_kernel_g(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref,
         k_pos = jax.lax.broadcasted_iota(jnp.int32, (g, bk, bq), 1)
         s_t = jnp.where(k_pos <= q_pos, s_t, _NEG_INF32)
     p_t = jnp.exp2(s_t - lse)                          # (G, BK, BQ)
-    p_cast = p_t.astype(do.dtype)
+    if dropout > 0.0:
+        keep_t = _drop_mask_g_t(seed_ref, q.shape[0], bq, bk, lq, lk,
+                                dropout)
+        inv_keep = _np.float32(1.0 / (1.0 - dropout))
+        pd_t = jnp.where(keep_t, p_t * inv_keep, _ZERO32)
+    else:
+        pd_t = p_t
+    p_cast = pd_t.astype(do.dtype)
     dv_ref[...] = jax.lax.dot_general(
         p_cast, do, (((2,), (1,)), ((0,), (0,))),
         preferred_element_type=jnp.float32,
@@ -526,6 +721,8 @@ def _bwd_fused_kernel_g(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref,
     dp_t = jax.lax.dot_general(
         v, do, (((2,), (2,)), ((0,), (0,))),
         preferred_element_type=jnp.float32, precision=prec)
+    if dropout > 0.0:
+        dp_t = jnp.where(keep_t, dp_t * inv_keep, _ZERO32)
     ds_t = (p_t * (dp_t - delta) * scale).astype(q.dtype)
     dk_ref[...] = jax.lax.dot_general(
         ds_t, q, (((2,), (1,)), ((0,), (0,))),
@@ -538,8 +735,8 @@ def _bwd_fused_kernel_g(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref,
 
 
 def _bwd_fused_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref,
-                      dq_ref, dk_ref, dv_ref, *, scale, scale2, causal,
-                      causal_offset, prec, bq, bk):
+                      seed_ref, dq_ref, dk_ref, dv_ref, *, scale, scale2,
+                      causal, causal_offset, prec, bq, bk, dropout, lq, lk):
     """Fused dQ/dK/dV for the single-block case (nq == nk == 1).
 
     The split dK/dV + dQ kernels each recompute the probability matrix —
@@ -567,13 +764,22 @@ def _bwd_fused_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref,
         k_pos = jax.lax.broadcasted_iota(jnp.int32, (bk, bq), 0)
         s_t = jnp.where(k_pos <= q_pos, s_t, _NEG_INF32)
     p_t = jnp.exp2(s_t - lse)                           # (BK, BQ) f32
-    p_cast = p_t.astype(do.dtype)
+    if dropout > 0.0:
+        keep_t = _drop_mask_2d(seed_ref, bq, bk, 0, 0, lq, lk, dropout,
+                               transposed=True)
+        inv_keep = _np.float32(1.0 / (1.0 - dropout))
+        pd_t = jnp.where(keep_t, p_t * inv_keep, _ZERO32)
+    else:
+        pd_t = p_t
+    p_cast = pd_t.astype(do.dtype)
     dv_ref[...] = jnp.dot(p_cast, do,
                           preferred_element_type=jnp.float32,
                           precision=prec).astype(dv_ref.dtype)
     dp_t = jax.lax.dot_general(
         v, do, (((1,), (1,)), ((), ())),
         preferred_element_type=jnp.float32, precision=prec)  # (BK, BQ)
+    if dropout > 0.0:
+        dp_t = jnp.where(keep_t, dp_t * inv_keep, _ZERO32)
     ds_t = (p_t * (dp_t - delta) * scale).astype(q.dtype)
     dk_ref[...] = jnp.dot(ds_t, q,
                           preferred_element_type=jnp.float32,
@@ -586,8 +792,8 @@ def _bwd_fused_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref,
 
 
 def _bwd_dq_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref,
-                   dq_ref, dq_acc, *, scale, scale2, causal, nk,
-                   causal_offset, prec, bq, bk):
+                   seed_ref, dq_ref, dq_acc, *, scale, scale2, causal, nk,
+                   causal_offset, prec, bq, bk, dropout, lq, lk):
     """dQ for one Q block; K blocks stream on the innermost grid dim."""
     from jax.experimental import pallas as pl
 
@@ -618,6 +824,12 @@ def _bwd_dq_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref,
         dp_t = jax.lax.dot_general(
             v, do, (((1,), (1,)), ((), ())),
             preferred_element_type=jnp.float32, precision=prec)
+        if dropout > 0.0:
+            keep_t = _drop_mask_2d(seed_ref, bq, bk, qi, ki, lq, lk,
+                                   dropout, transposed=True)
+            dp_t = jnp.where(keep_t,
+                             dp_t * _np.float32(1.0 / (1.0 - dropout)),
+                             _ZERO32)
         ds_t = (p_t * (dp_t - delta) * scale)               # (BK, BQ)
         # dq = ds @ k = ds_t^T @ k : contract the BK dim of both
         dq_acc[:] += jax.lax.dot_general(
@@ -637,7 +849,7 @@ def _bwd_dq_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref,
 
 
 def _flash_bwd_pallas(q, k, v, o, lse, g, scale, causal, interpret=False,
-                      layout="bhld", delta=None):
+                      layout="bhld", delta=None, dropout=0.0, seed=None):
     """``delta``: optional precomputed rowsum(dO*O) of shape (B*H, Lq)
     f32 — ring attention passes the GLOBAL delta so per-pair calls don't
     recompute it; ``o`` may then be None."""
@@ -679,6 +891,7 @@ def _flash_bwd_pallas(q, k, v, o, lse, g, scale, causal, interpret=False,
                              (bh, nq, 8, bq))
     offset = lk - lq
     prec = _prec_for(q.dtype)
+    smem_spec = pl.BlockSpec(memory_space=pltpu.SMEM)
 
     if nq == 1 and nk == 1 and layout == "bhld":
         # fused dq/dk/dv kernel, g heads per grid step (f32 score tiles
@@ -696,14 +909,15 @@ def _flash_bwd_pallas(q, k, v, o, lse, g, scale, causal, interpret=False,
                 functools.partial(_bwd_fused_kernel_g, scale=scale,
                                   scale2=_np.float32(scale) * _LOG2E,
                                   causal=causal, causal_offset=offset,
-                                  prec=prec, bq=bq, bk=bk),
+                                  prec=prec, bq=bq, bk=bk,
+                                  dropout=dropout, lq=lq, lk=lk),
                 grid=(bh // grp, 1, 1),
                 in_specs=[gq_spec, gk_spec, gk_spec, gq_spec,
-                          grow_spec, grow_spec],
+                          grow_spec, grow_spec, smem_spec],
                 out_specs=[gq_spec, gk_spec, gk_spec],
                 out_shape=[dq_shape, dk_shape, dv_shape],
                 interpret=interpret,
-            )(q, k, v, do, lse, delta)
+            )(q, k, v, do, lse, delta, _seed_arr(seed))
         return (dq.reshape(b, h, lq, d), dk3.reshape(b, h, lk, d),
                 dv3.reshape(b, h, lk, d))
     if nq == 1 and nk == 1:
@@ -718,14 +932,15 @@ def _flash_bwd_pallas(q, k, v, o, lse, g, scale, causal, interpret=False,
                 functools.partial(_bwd_fused_kernel, scale=scale,
                                   scale2=_np.float32(scale) * _LOG2E,
                                   causal=causal, causal_offset=offset,
-                                  prec=prec, bq=bq, bk=bk),
+                                  prec=prec, bq=bq, bk=bk,
+                                  dropout=dropout, lq=lq, lk=lk),
                 grid=(bh, 1, 1),
                 in_specs=[q_spec, k_spec, k_spec, q_spec,
-                          row_spec, row_spec],
+                          row_spec, row_spec, smem_spec],
                 out_specs=[q_spec, k_spec, k_spec],
                 out_shape=[dq_shape, dk_shape, dv_shape],
                 interpret=interpret,
-            )(q, k, v, do, lse, delta)
+            )(q, k, v, do, lse, delta, _seed_arr(seed))
         return dq, dk3, dv3
 
     # grid (bh, nk, nq): q/do/lse/delta stream on the inner (j) dim, so
@@ -739,10 +954,11 @@ def _flash_bwd_pallas(q, k, v, o, lse, g, scale, causal, interpret=False,
             functools.partial(_bwd_dkdv_kernel, scale=scale,
                               scale2=_np.float32(scale) * _LOG2E,
                               causal=causal, nq=nq, causal_offset=offset,
-                              prec=prec, bq=bq, bk=bk),
+                              prec=prec, bq=bq, bk=bk,
+                              dropout=dropout, lq=lq, lk=lk),
             grid=(bh, nk, nq),
             in_specs=[q_spec_j, k_spec_i, k_spec_i, q_spec_j,
-                      row_spec_j, row_spec_j],
+                      row_spec_j, row_spec_j, smem_spec],
             out_specs=[k_spec_i, k_spec_i],
             out_shape=[dk_shape, dv_shape],
             scratch_shapes=[
@@ -750,13 +966,14 @@ def _flash_bwd_pallas(q, k, v, o, lse, g, scale, causal, interpret=False,
                 pltpu.VMEM((bk, d), jnp.float32),
             ],
             interpret=interpret,
-        )(q, k, v, do, lse, delta)
+        )(q, k, v, do, lse, delta, _seed_arr(seed))
 
         dq = pl.pallas_call(
             functools.partial(_bwd_dq_kernel, scale=scale,
                               scale2=_np.float32(scale) * _LOG2E,
                               causal=causal, nk=nk, causal_offset=offset,
-                              prec=prec, bq=bq, bk=bk),
+                              prec=prec, bq=bq, bk=bk,
+                              dropout=dropout, lq=lq, lk=lk),
             grid=(bh, nq, nk),
             in_specs=[
                 _tile_spec(layout, h, bq, d, 0),
@@ -767,42 +984,49 @@ def _flash_bwd_pallas(q, k, v, o, lse, g, scale, causal, interpret=False,
                              lambda bh_, i, j: (bh_, i, 0, 0)),
                 pl.BlockSpec((None, None, 8, bq),
                              lambda bh_, i, j: (bh_, i, 0, 0)),
+                smem_spec,
             ],
             out_specs=_tile_spec(layout, h, bq, d, 0),
             out_shape=dq_shape,
             scratch_shapes=[pltpu.VMEM((bq, d), jnp.float32)],
             interpret=interpret,
-        )(q, k, v, do, lse, delta)
+        )(q, k, v, do, lse, delta, _seed_arr(seed))
     if layout == "bhld":
         return (dq.reshape(b, h, lq, d), dk3.reshape(b, h, lk, d),
                 dv3.reshape(b, h, lk, d))
     return dq, dk3, dv3
 
 
-@functools.partial(jax.custom_vjp, nondiff_argnums=(3, 4, 5, 6))
-def _flash(q, k, v, scale, causal, interpret, layout):
-    return _flash_fwd_pallas(q, k, v, scale, causal, interpret, layout)[0]
+@functools.partial(jax.custom_vjp, nondiff_argnums=(4, 5, 6, 7, 8))
+def _flash(q, k, v, seed, scale, causal, interpret, layout, dropout):
+    return _flash_fwd_pallas(q, k, v, scale, causal, interpret, layout,
+                             dropout, seed)[0]
 
 
-def _flash_fwd(q, k, v, scale, causal, interpret, layout):
-    o, lse = _flash_fwd_pallas(q, k, v, scale, causal, interpret, layout)
-    return o, (q, k, v, o, lse)
+def _flash_fwd(q, k, v, seed, scale, causal, interpret, layout, dropout):
+    o, lse = _flash_fwd_pallas(q, k, v, scale, causal, interpret, layout,
+                               dropout, seed)
+    return o, (q, k, v, o, lse, seed)
 
 
-def _flash_bwd(scale, causal, interpret, layout, res, g):
+def _flash_bwd(scale, causal, interpret, layout, dropout, res, g):
     # Pallas dq/dk/dv kernels recomputing p from the saved logsumexp —
     # training-mode attention runs on the MXU in BOTH directions (round-1
-    # weakness #5: the old bwd re-differentiated the XLA scan).
-    q, k, v, o, lse = res
-    return _flash_bwd_pallas(q, k, v, o, lse, g, scale, causal, interpret,
-                             layout)
+    # weakness #5: the old bwd re-differentiated the XLA scan). The
+    # dropout mask is REGENERATED from (seed, positions) — nothing beyond
+    # the (1,) seed crosses fwd->bwd.
+    q, k, v, o, lse, seed = res
+    dq, dk, dv = _flash_bwd_pallas(q, k, v, o, lse, g, scale, causal,
+                                   interpret, layout, dropout=dropout,
+                                   seed=seed)
+    return dq, dk, dv, _np.zeros((1,), jax.dtypes.float0)
 
 
 _flash.defvjp(_flash_fwd, _flash_bwd)
 
 
 def flash_attention(q, k, v, scale=None, causal=False, interpret=False,
-                    layout="bhld"):
+                    layout="bhld", dropout=0.0, seed=None):
     """Pallas flash attention (differentiable).
 
     ``layout``: "bhld" (B, H, L, D) — the classic attention layout — or
@@ -811,8 +1035,18 @@ def flash_attention(q, k, v, scale=None, causal=False, interpret=False,
     squeezed-H sublane tile — groundwork for a (B, L, H*D) 128-aligned
     view once a head_dim % 128 model needs it. On-hardware callers go
     through ``sdp_attention``, which gates on ``flash_supported``.
+
+    ``dropout``: attention-probability drop rate (reference capability:
+    GluonNLP MultiHeadAttentionCell's dropout on the attention weights).
+    The keep-mask is a stateless position hash (see _drop_mask) applied
+    to the post-softmax P inside the kernels, pre-PV-matmul; ``seed``
+    (uint32 scalar/(1,) array, may be traced) selects the stream and
+    MUST be supplied when dropout > 0.
     """
     if scale is None:
         scale = 1.0 / math.sqrt(q.shape[-1])
-    return _flash(q, k, v, float(scale), bool(causal), bool(interpret),
-                  str(layout))
+    dropout = float(dropout)
+    if dropout > 0.0 and seed is None:
+        raise ValueError("flash_attention: dropout > 0 requires a seed")
+    return _flash(q, k, v, _seed_arr(seed), float(scale), bool(causal),
+                  bool(interpret), str(layout), dropout)

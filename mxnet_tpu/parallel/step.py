@@ -115,13 +115,17 @@ class TrainStep:
             net(*nds)
             return 0
 
-        with random_state.preserved_stream():
-            try:
+        try:
+            with random_state.preserved_stream():
                 jax.eval_shape(_shape_probe, *shape_vals)
-            except Exception:
-                if fallback is None:
-                    raise
-                fallback()
+        except Exception:
+            if fallback is None:
+                raise
+            # fallback runs AFTER the stream restore: an aborted probe
+            # leaves traced keys in the stateful stream, and an eager
+            # fallback splitting one of those is an escaped-tracer error
+            # (found live, round 5)
+            fallback()
 
     def _bind_params(self):
         """Record the settled parameter list, trainable ordinals,

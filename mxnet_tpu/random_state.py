@@ -25,7 +25,24 @@ def _global():
     if not hasattr(_state, "keys"):
         _state.keys = {}            # (dev_type, dev_id) -> PRNGKey
         _state.base_seed = _DEFAULT_SEED
+        _state.host_rng = None      # numpy RandomState for host-side init
     return _state
+
+
+def host_rng():
+    """Host-side numpy RandomState for initializers (reference: the CPU
+    sampling behind Initializer). Derived from the mx.random seed so
+    ``mx.random.seed(n)`` makes parameter initialization reproducible —
+    including ACROSS PROCESSES of a dist job, where each process's
+    ``numpy.random`` global state would otherwise start from independent
+    OS entropy and data-parallel replicas would silently begin from
+    different weights (found live via the 2-process dryrun, round 5)."""
+    import numpy as np
+
+    st = _global()
+    if getattr(st, "host_rng", None) is None:
+        st.host_rng = np.random.RandomState(st.base_seed & 0x7FFFFFFF)
+    return st.host_rng
 
 
 def _ctx_sig(ctx=None):
@@ -61,8 +78,12 @@ def seed(seed_state, ctx="all") -> None:
 
     st = _global()
     if isinstance(ctx, str) and ctx == "all":
+        import numpy as np
+
         st.base_seed = int(seed_state)
         st.keys = {}
+        # host-side initializer stream reseeds with the devices
+        st.host_rng = np.random.RandomState(st.base_seed & 0x7FFFFFFF)
     else:
         st.keys[_ctx_sig(ctx)] = jax.random.PRNGKey(int(seed_state))
 

@@ -159,3 +159,100 @@ class TestPallasKernel:
             np.testing.assert_allclose(np.asarray(t(a)), np.asarray(b),
                                        rtol=1e-5, atol=1e-5,
                                        err_msg=f"d{name} causal={causal}")
+
+
+class TestAttentionDropout:
+    """In-kernel attention-probability dropout (round 5, VERDICT r4 #2).
+
+    The mask is a stateless position hash, so every dispatch route
+    (Pallas kernels in any block/grouping geometry, the scan fallback,
+    the dense reference) must produce BITWISE-identical drop decisions
+    for the same seed — which makes exact oracle comparison possible.
+    """
+
+    def test_kernel_matches_dense_oracle(self):
+        q, k, v = _qkv()
+        seed = jnp.asarray(7, jnp.uint32)
+        ref = _sdpa_reference(q, k, v, None, SCALE, False,
+                              dropout=0.25, seed=seed)
+        out = flash_attention(q, k, v, causal=False, interpret=True,
+                              dropout=0.25, seed=seed)
+        np.testing.assert_allclose(np.asarray(out), np.asarray(ref),
+                                   rtol=1e-5, atol=1e-5)
+
+    def test_scan_matches_dense_oracle(self):
+        q, k, v = _qkv(lq=128, lk=384)
+        seed = jnp.asarray(11, jnp.uint32)
+        ref = _sdpa_reference(q, k, v, None, SCALE, True,
+                              dropout=0.1, seed=seed)
+        out = flash_attention_scan(q, k, v, causal=True,
+                                   dropout=0.1, seed=seed)
+        np.testing.assert_allclose(np.asarray(out), np.asarray(ref),
+                                   rtol=1e-5, atol=1e-5)
+
+    def test_streaming_kernel_matches_dense_oracle(self):
+        # lq=512, lk=1024 -> nk=2: exercises the streaming fwd kernel's
+        # per-(qi, ki) mask tiles against the whole-matrix oracle
+        q, k, v = _qkv(lq=512, lk=1024)
+        seed = jnp.asarray(3, jnp.uint32)
+        ref = _sdpa_reference(q, k, v, None, SCALE, False,
+                              dropout=0.2, seed=seed)
+        out = flash_attention(q, k, v, causal=False, interpret=True,
+                              dropout=0.2, seed=seed)
+        np.testing.assert_allclose(np.asarray(out), np.asarray(ref),
+                                   rtol=1e-5, atol=1e-5)
+
+    def test_gradients_match_dense_oracle(self):
+        q, k, v = _qkv(lq=256, lk=256)
+        seed = jnp.asarray(5, jnp.uint32)
+
+        def loss_flash(a, b, c):
+            return jnp.sum(flash_attention(a, b, c, interpret=True,
+                                           dropout=0.25, seed=seed) ** 2)
+
+        def loss_ref(a, b, c):
+            return jnp.sum(_sdpa_reference(a, b, c, None, SCALE, False,
+                                           dropout=0.25, seed=seed) ** 2)
+
+        gf = jax.grad(loss_flash, argnums=(0, 1, 2))(q, k, v)
+        gr = jax.grad(loss_ref, argnums=(0, 1, 2))(q, k, v)
+        for a, b in zip(gf, gr):
+            np.testing.assert_allclose(np.asarray(a), np.asarray(b),
+                                       rtol=2e-4, atol=2e-4)
+
+    def test_streaming_bwd_gradients_match(self):
+        # nq=nk=2 -> split dkdv/dq backward kernels regenerate the mask
+        # per streamed tile
+        q, k, v = _qkv(lq=1024, lk=1024)
+        seed = jnp.asarray(13, jnp.uint32)
+
+        def loss_flash(a, b, c):
+            return jnp.sum(flash_attention(a, b, c, interpret=True,
+                                           dropout=0.1, seed=seed) ** 2)
+
+        def loss_ref(a, b, c):
+            return jnp.sum(_sdpa_reference(a, b, c, None, SCALE, False,
+                                           dropout=0.1, seed=seed) ** 2)
+
+        gf = jax.grad(loss_flash, argnums=(0, 1, 2))(q, k, v)
+        gr = jax.grad(loss_ref, argnums=(0, 1, 2))(q, k, v)
+        for a, b in zip(gf, gr):
+            np.testing.assert_allclose(np.asarray(a), np.asarray(b),
+                                       rtol=2e-4, atol=2e-4)
+
+    def test_keep_rate_and_seed_sensitivity(self):
+        q, k, v = _qkv()
+        o1 = flash_attention(q, k, v, interpret=True, dropout=0.5,
+                             seed=jnp.asarray(1, jnp.uint32))
+        o2 = flash_attention(q, k, v, interpret=True, dropout=0.5,
+                             seed=jnp.asarray(2, jnp.uint32))
+        assert not np.allclose(np.asarray(o1), np.asarray(o2))
+        # expectation preserved: mean over many elements ~ no-dropout mean
+        o0 = flash_attention(q, k, v, interpret=True)
+        np.testing.assert_allclose(np.asarray(o1).mean(),
+                                   np.asarray(o0).mean(), atol=0.02)
+
+    def test_dropout_requires_seed(self):
+        q, k, v = _qkv()
+        with pytest.raises(ValueError, match="seed"):
+            flash_attention(q, k, v, dropout=0.1)

@@ -128,7 +128,7 @@ class TestPipelinedBlock:
         labels = rs.randint(0, 256, (8, 8)).astype("int32")
 
         def build():
-            onp.random.seed(42)  # initializers draw from numpy global RNG
+            mx.random.seed(42)  # initializer reproducibility contract (r5)
             net = nlp.llama_tiny_pp(n_stages=4, n_microbatches=4)
             net.initialize()
             return net
