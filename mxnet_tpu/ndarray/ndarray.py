@@ -707,7 +707,9 @@ def imperative_invoke(opdef, tensor_args, attrs, out=None, ctx=None,
     attrs = {k: _canon_attr(v) for k, v in attrs.items() if v is not None or k in ("axis",)}
     if opdef.pass_training_flag:
         attrs["_training"] = autograd.is_training()
-    rng = random_state.next_key() if opdef.needs_rng else None
+    wants_rng = opdef.needs_rng and (
+        opdef.rng_gate is None or opdef.rng_gate(attrs))
+    rng = random_state.next_key() if wants_rng else None
 
     recording = autograd.is_recording() and (force_record or any(
         isinstance(a, NDArray) and autograd.is_on_tape(a) for a in tensor_args
@@ -719,6 +721,9 @@ def imperative_invoke(opdef, tensor_args, attrs, out=None, ctx=None,
         if rng is not None:
             def pure(*tensors):
                 return fn(rng, *tensors, **fixed_attrs)
+        elif opdef.needs_rng:  # rng draw gated off: fn still has the slot
+            def pure(*tensors):
+                return fn(None, *tensors, **fixed_attrs)
         else:
             def pure(*tensors):
                 return fn(*tensors, **fixed_attrs)

@@ -112,6 +112,44 @@ def save(fname: str, data) -> None:
         f.write(bytes(buf))
 
 
+def save_indexed(fname: str, data: Dict) -> Dict:
+    """``save`` for a dict, additionally returning a byte index:
+    ``{name: [data_offset, nbytes, shape, dtype_str]}`` so a reader can
+    fetch one array's raw payload with a seek instead of parsing the
+    whole container (the sharded-checkpoint restore path)."""
+    names = list(data.keys())
+    arrays = [data[k] for k in names]
+    buf = bytearray()
+    buf += struct.pack("<QQ", _LIST_MAGIC, 0)
+    buf += struct.pack("<Q", len(arrays))
+    index: Dict = {}
+    for name, a in zip(names, arrays):
+        arr_np = a.asnumpy() if hasattr(a, "asnumpy") else _np.asarray(a)
+        before = len(buf)
+        _save_one(buf, arr_np)
+        nbytes = arr_np.dtype.itemsize * arr_np.size
+        index[name] = [len(buf) - nbytes, nbytes,
+                       list(arr_np.shape), str(arr_np.dtype)]
+        assert len(buf) - before >= nbytes
+    buf += struct.pack("<Q", len(names))
+    for n in names:
+        nb = n.encode("utf-8")
+        buf += struct.pack("<Q", len(nb))
+        buf += nb
+    with open(fname, "wb") as f:
+        f.write(bytes(buf))
+    return index
+
+
+def read_indexed(fname: str, entry) -> _np.ndarray:
+    """Fetch one array's payload via its ``save_indexed`` index entry."""
+    off, nbytes, shape, dtype = entry
+    with open(fname, "rb") as f:
+        f.seek(off)
+        raw = f.read(nbytes)
+    return _np.frombuffer(raw, dtype=_np.dtype(dtype)).reshape(shape).copy()
+
+
 def load(fname: str, ctx: Context = None):
     """Load NDArray(s) (reference: mx.nd.load / MXNDArrayLoad)."""
     from .ndarray import array

@@ -63,7 +63,9 @@ def _sdpa_reference(q, k, v, mask, scale, causal, layout="bhld",
 
 
 @register("_contrib_sdp_attention", aliases=["sdp_attention"],
-          needs_rng=True, pass_training_flag=True)
+          needs_rng=True, pass_training_flag=True,
+          rng_gate=lambda attrs: bool(attrs.get("dropout"))
+          and bool(attrs.get("_training")))
 def sdp_attention(rng, query, key, value, mask=None, *, scale=None,
                   causal=False, flash=True, layout="bhld", ring_axis=None,
                   dropout=0.0, _training=False):

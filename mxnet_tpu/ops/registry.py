@@ -163,6 +163,12 @@ class OpDef(NamedTuple):
     # fn has **kwargs: forward ALL attrs, not just declared attr_params
     # (the `Custom` op's user-defined attribute surface)
     var_attrs: bool = False
+    # optional attrs -> bool predicate: draw/consume a PRNG key only when
+    # it returns True (ops like sdp_attention that are random only when a
+    # dropout attr is set — an unconditional draw would advance the
+    # global stream on every eval-mode call, a reproducibility trap).
+    # When gated off the fn still receives rng=None positionally.
+    rng_gate: Optional[Callable] = None
 
 
 _REGISTRY: Dict[str, OpDef] = {}
@@ -177,6 +183,7 @@ def register(
     variadic: bool = False,
     eager_only: bool = False,
     attrs: Sequence[AttrSpec] = (),
+    rng_gate: Optional[Callable] = None,
 ):
     """Decorator registering a pure-JAX op implementation.
 
@@ -223,6 +230,7 @@ def register(
             attr_specs={s.name: s for s in attrs} if attrs else None,
             var_attrs=any(p.kind == p.VAR_KEYWORD
                           for p in sig.parameters.values()),
+            rng_gate=rng_gate,
         )
         _REGISTRY[opname] = opdef
         for a in aliases:
@@ -285,6 +293,10 @@ def _cached_call(opname: str, attr_items: tuple, n_tensors: int,
     if has_rng:
         def pure(rng, *tensors):
             return opdef.fn(rng, *tensors, **attrs)
+    elif opdef.needs_rng:
+        # rng draw gated off (rng_gate): the fn still expects the slot
+        def pure(*tensors):
+            return opdef.fn(None, *tensors, **attrs)
     else:
         def pure(*tensors):
             return opdef.fn(*tensors, **attrs)
@@ -363,6 +375,8 @@ def eager_call(opdef: OpDef, tensors, attrs, rng=None):
         if uncached:
             if rng is not None:
                 return opdef.fn(rng, *tensors, **attrs)
+            if opdef.needs_rng:
+                return opdef.fn(None, *tensors, **attrs)
             return opdef.fn(*tensors, **attrs)
         fn = _cached_call(opdef.name, attr_items, len(tensors),
                           rng is not None, platform)

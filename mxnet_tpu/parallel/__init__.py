@@ -15,11 +15,13 @@ from .sharding import (PartitionSpec, ShardingRules, named_sharding,
                        replicated, shard_array, shard_parameters,
                        spec_for_param)
 from .step import TrainStep
+from .checkpoint import save_sharded, restore_sharded
 from .ring_attention import ring_attention, ring_attention_sharded
 from .pipeline import (Pipelined, pipeline_apply, pipeline_active,
                        pipeline_sharding_rules, pipeline_train_1f1b)
 
-__all__ = ["ring_attention", "ring_attention_sharded",
+__all__ = ["save_sharded", "restore_sharded",
+           "ring_attention", "ring_attention_sharded",
            "Pipelined", "pipeline_apply", "pipeline_active",
            "pipeline_sharding_rules", "pipeline_train_1f1b",
            "AXES", "make_mesh", "current_mesh", "use_mesh", "local_devices",

@@ -1,0 +1,266 @@
+"""Sharded (per-process) checkpointing for TrainStep.
+
+Reference capability: ``Module.save_checkpoint`` / Gluon
+``save_parameters`` + ``Trainer.save_states`` cover the single-host case
+by gathering everything to host 0 — fine for ResNet, impossible for a
+model that only exists sharded over a pod (SURVEY.md §5.4 "stretch:
+sharded save behind the same call"; VERDICT r4 #6: an 8B model living on
+a 32-device mesh via ``abstract_init`` had no tested save/resume path).
+
+Design (ocp-style, but on the ``.params`` container so the format stays
+the framework's own):
+
+* ``save_sharded(step, directory)`` — every process writes ONE
+  ``shard-{pid:05d}-of-{n:05d}.params`` file holding, for each parameter
+  and optimizer-state leaf, the process's ADDRESSABLE shards only
+  (deduplicated: a replicated value stores one copy per process, a
+  tp-sharded weight stores each distinct slice once). Keys are
+  ``{name}@{slice}`` where ``{slice}`` is the shard's global index
+  (e.g. ``0:128,64:128``) — self-describing, mesh-topology-free.
+  Process 0 additionally writes ``meta.json`` (names, global shapes,
+  dtypes, optimizer counters, process count); every process writes
+  ``index-{pid}.json`` listing its keys so restore can locate any slice
+  without opening every file.
+* ``restore_sharded(step, directory)`` — each process materializes ONLY
+  the slices its local devices need (per the step's own shardings),
+  device_puts them shard-by-shard, and assembles global arrays with
+  ``jax.make_array_from_single_device_arrays``. No host ever holds a
+  full copy of any tensor, so the path works for models larger than any
+  single host/device memory. Optimizer counters are restored so LR
+  schedules and bias-correction terms continue bit-identically.
+
+Restore requires slice-compatible shardings (the natural case: same mesh
+shape and rules). A mismatched slice raises with the missing key named.
+"""
+from __future__ import annotations
+
+import json
+import os
+from typing import Dict
+
+import numpy as _np
+
+from ..base import MXNetError
+
+__all__ = ["save_sharded", "restore_sharded"]
+
+
+def _slice_key(index, shape) -> str:
+    parts = []
+    for sl, dim in zip(index, shape):
+        start = 0 if sl.start is None else int(sl.start)
+        stop = dim if sl.stop is None else int(sl.stop)
+        parts.append(f"{start}:{stop}")
+    return ",".join(parts) if parts else "scalar"
+
+
+def _param_names(step):
+    """Structure-relative parameter names, aligned with step._params.
+
+    Uses the block-attribute path (``_collect_params_with_prefix`` — the
+    same names Block.save_parameters writes), NOT Parameter.name: Gluon's
+    per-class name counters are process-global, so two instances of the
+    same architecture disagree on raw names (dense0_ vs dense2_) while
+    their attribute paths are identical."""
+    by_id = {}
+    collect = getattr(step.net, "_collect_params_with_prefix", None)
+    if collect is not None:
+        for k, p in collect().items():
+            by_id[id(p)] = k
+    prefix = getattr(step.net, "prefix", "") or ""
+    names = []
+    for p in step._params:
+        n = by_id.get(id(p))
+        if n is None:  # fallback: prefix-relative raw name
+            n = p.name[len(prefix):] \
+                if prefix and p.name.startswith(prefix) else p.name
+        names.append(n)
+    return names
+
+
+def _named_arrays(step):
+    """(name, jax.Array holder) pairs for every persistent tensor of the
+    step: parameters by structure-relative name, state leaves
+    positionally."""
+    pairs = []
+    for n, p in zip(_param_names(step), step._params):
+        pairs.append((n, p.data()))
+    for j, leaf in enumerate(step._state_leaf_nds):
+        pairs.append((f"__state{j}", leaf))
+    return pairs
+
+
+def save_sharded(step, directory: str) -> None:
+    """Write this process's shard file (+ index, + meta on process 0)."""
+    import jax
+
+    from ..ndarray import serialization
+
+    if step._params is None or step._state_leaf_nds is None:
+        raise MXNetError(
+            "save_sharded: TrainStep has no settled parameters/state — "
+            "run at least one step (or restore into it) first")
+    os.makedirs(directory, exist_ok=True)
+    pid, nproc = jax.process_index(), jax.process_count()
+    fname = f"shard-{pid:05d}-of-{nproc:05d}.params"
+
+    entries: Dict[str, _np.ndarray] = {}
+    meta_arrays = {}
+    for name, nd in _named_arrays(step):
+        arr = nd.data
+        meta_arrays[name] = {"shape": list(arr.shape),
+                             "dtype": str(arr.dtype)}
+        seen = set()
+        for sh in arr.addressable_shards:
+            ikey = _slice_key(sh.index, arr.shape)
+            if ikey in seen:
+                continue
+            seen.add(ikey)
+            entries[f"{name}@{ikey}"] = _np.asarray(sh.data)
+
+    index = serialization.save_indexed(
+        os.path.join(directory, fname), entries)
+    with open(os.path.join(directory, f"index-{pid:05d}.json"), "w") as f:
+        json.dump({"file": fname, "entries": index}, f)
+    # cross-process barrier BEFORE the commit marker: meta.json is written
+    # LAST by process 0, so a checkpoint with meta.json present has every
+    # shard fully on disk — a crash mid-save can never masquerade as a
+    # complete checkpoint
+    if nproc > 1:
+        from jax.experimental import multihost_utils
+
+        multihost_utils.sync_global_devices("mxnet_tpu_sharded_ckpt_save")
+    if pid == 0:
+        opt = step.optimizer
+        meta = {
+            "nproc": nproc,
+            "arrays": meta_arrays,
+            "param_names": _param_names(step),
+            "n_state_leaves": len(step._state_leaf_nds),
+            "optimizer": {
+                "num_update": int(opt.num_update),
+                "index_update_count": {
+                    str(k): int(v)
+                    for k, v in opt._index_update_count.items()},
+            },
+        }
+        with open(os.path.join(directory, "meta.json"), "w") as f:
+            json.dump(meta, f)
+
+
+class _ShardReader:
+    """Per-key lazy shard lookup: key -> host numpy array.
+
+    Reads use the byte index (seek + read of exactly one slice), never a
+    whole-file parse; keys present in several processes' files resolve to
+    THIS process's own file first, so a same-topology restore touches
+    only local data."""
+
+    def __init__(self, directory):
+        import jax
+
+        self._dir = directory
+        own = f"index-{jax.process_index():05d}.json"
+        self._key_to_loc: Dict[str, tuple] = {}
+        idx_files = sorted(
+            f for f in os.listdir(directory)
+            if f.startswith("index-") and f.endswith(".json"))
+        # own index LAST so its entries override other processes'
+        for idx in [f for f in idx_files if f != own] + \
+                ([own] if own in idx_files else []):
+            with open(os.path.join(directory, idx)) as f:
+                rec = json.load(f)
+            for k, entry in rec["entries"].items():
+                self._key_to_loc[k] = (rec["file"], entry)
+
+    def get(self, key: str) -> _np.ndarray:
+        loc = self._key_to_loc.get(key)
+        if loc is None:
+            raise MXNetError(
+                f"restore_sharded: slice {key!r} not found in checkpoint "
+                "— the saving and restoring shardings must be "
+                "slice-compatible (same mesh shape and rules)")
+        from ..ndarray import serialization
+
+        fname, entry = loc
+        return serialization.read_indexed(
+            os.path.join(self._dir, fname), entry)
+
+
+def _materialize(name, shape, dtype, sharding, reader):
+    """Assemble one global array from per-device slices — local devices
+    only, no full-array host copy."""
+    import jax
+
+    index_map = sharding.addressable_devices_indices_map(tuple(shape))
+    shards = []
+    devs = []
+    for dev, index in index_map.items():
+        ikey = _slice_key(index, shape)
+        host = reader.get(f"{name}@{ikey}").astype(dtype, copy=False)
+        shards.append(jax.device_put(host, dev))
+        devs.append(dev)
+    return jax.make_array_from_single_device_arrays(
+        tuple(shape), sharding, shards)
+
+
+def restore_sharded(step, directory: str, example_data=None) -> None:
+    """Restore parameters, optimizer state, and counters in place.
+
+    Works on a live step (buffers overwritten) and on a freshly built
+    step (pass ``example_data`` — the training batch, or same-shaped
+    arrays — so deferred shapes settle before the restore); each process
+    reads only the slices its devices own.
+    """
+    import jax
+
+    with open(os.path.join(directory, "meta.json")) as f:
+        meta = json.load(f)
+    if step._params is None:
+        if example_data is None:
+            raise MXNetError(
+                "restore_sharded: settle the step's parameters first "
+                "(run one step, or pass example_data=) — restore "
+                "replaces buffer contents, not the model structure")
+        from .step import _as_tuple
+
+        step._settle_params(_as_tuple(example_data))
+    if step._state_leaf_nds is None or (
+            not step._state_leaf_nds
+            and meta["n_state_leaves"]):
+        step._init_states()
+    names = _param_names(step)
+    if names != meta["param_names"]:
+        raise MXNetError(
+            "restore_sharded: parameter set mismatch — checkpoint has "
+            f"{len(meta['param_names'])} params, step has {len(names)} "
+            "(or ordering/naming differs)")
+    if len(step._state_leaf_nds) != meta["n_state_leaves"]:
+        raise MXNetError(
+            f"restore_sharded: optimizer state layout mismatch "
+            f"({len(step._state_leaf_nds)} leaves vs checkpoint "
+            f"{meta['n_state_leaves']}) — same optimizer required")
+
+    reader = _ShardReader(directory)
+    for name, nd in _named_arrays(step):
+        rec = meta["arrays"].get(name)
+        arr = nd.data
+        if rec is None:
+            raise MXNetError(
+                f"restore_sharded: {name!r} absent from checkpoint meta")
+        if tuple(rec["shape"]) != tuple(arr.shape) \
+                or rec["dtype"] != str(arr.dtype):
+            raise MXNetError(
+                f"restore_sharded: {name!r} is {rec['dtype']}"
+                f"{tuple(rec['shape'])} in the checkpoint but "
+                f"{arr.dtype}{tuple(arr.shape)} in the step — same "
+                "architecture/dtype config required")
+        new = _materialize(name, rec["shape"], rec["dtype"],
+                           arr.sharding, reader)
+        nd._set_data(new)
+
+    opt = step.optimizer
+    opt.num_update = meta["optimizer"]["num_update"]
+    opt._index_update_count = {
+        int(k): v
+        for k, v in meta["optimizer"]["index_update_count"].items()}

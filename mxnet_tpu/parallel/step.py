@@ -553,6 +553,20 @@ class TrainStep:
                 param_sharded, tuple(state_structs), t, lr, rng, *batch_in)
             return lowered.compile()
 
+    def save_sharded(self, directory):
+        """Per-process sharded checkpoint (SURVEY §5.4 stretch; see
+        parallel/checkpoint.py)."""
+        from .checkpoint import save_sharded
+
+        save_sharded(self, directory)
+
+    def restore_sharded(self, directory, example_data=None):
+        """Restore a sharded checkpoint in place (params + optimizer
+        state + counters); see parallel/checkpoint.py."""
+        from .checkpoint import restore_sharded
+
+        restore_sharded(self, directory, example_data=example_data)
+
     def stage_batch(self, data, label=()):
         """Place host batches on the mesh with this step's input sharding.
 

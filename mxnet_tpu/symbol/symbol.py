@@ -412,6 +412,8 @@ def _apply_opdef(opdef, tensors, attrs, rng, training):
     if opdef.pass_training_flag:
         kw["_training"] = training
     if opdef.needs_rng:
+        if opdef.rng_gate is not None and not opdef.rng_gate(kw):
+            return opdef.fn(None, *tensors, **kw)
         import jax
 
         key = rng if rng is not None else jax.random.PRNGKey(0)
