@@ -288,20 +288,58 @@ def quantized_dense(data, weight_q, w_scale, bias=None, *, num_hidden,
         no_bias=no_bias or bias is None, flatten=flatten)
 
 
+def _calib_t(min_calib, max_calib, who):
+    """Symmetric int8 threshold from python-float calib bounds; both
+    bounds required, loud error naming the op otherwise."""
+    if min_calib is None or max_calib is None:
+        raise ValueError(
+            f"{who}: min and max calibration bounds are both required "
+            "for the int8 grid")
+    return max(abs(float(min_calib)), abs(float(max_calib))) + 1e-12
+
+
+def _requant_out(out_f32, out_min_calib, out_max_calib):
+    """Fused requantize of a layer's f32-scaled result onto the int8 grid
+    of its calibrated OUTPUT range — elementwise, so XLA folds it into
+    the conv/dense epilogue and the inter-layer tensor in HBM is int8.
+    Returns (codes, -t, t)."""
+    t = jnp.float32(_calib_t(out_min_calib, out_max_calib,
+                             "quantized out_type='int8'"))
+    codes = jnp.clip(jnp.round(out_f32 * (127.0 / t)),
+                     -127, 127).astype(jnp.int8)
+    return codes, jnp.float32(-t), jnp.float32(t)
+
+
 @register("_contrib_quantized_conv")
 def quantized_conv(data, weight_q, w_scale, bias=None, *, kernel,
                    num_filter, stride=None, pad=None, dilate=None,
                    num_group=1, no_bias=False, layout=None,
-                   min_calib_range=None, max_calib_range=None):
+                   min_calib_range=None, max_calib_range=None,
+                   out_type="float32", out_min_calib=None,
+                   out_max_calib=None):
     """Int8-weight convolution; on TPU the conv itself runs s8 x s8 ->
-    s32 (see quantized_dense), elsewhere fake-quant f32."""
+    s32 (see quantized_dense), elsewhere fake-quant f32.
+
+    ``out_type='int8'`` (requires ``out_min_calib``/``out_max_calib``)
+    fuses the requantize: returns (int8 codes, min, max) so the next
+    quantized op consumes codes directly — the int8-end-to-end trunk
+    path (reference: quantized conv + requantize fusion). ``data`` may
+    then itself be int8 codes with ``min/max_calib_range`` as their
+    range."""
     from .registry import get_op
 
     if _int8_mxu_enabled():
         from .nn import _conv_dnums, _channel_axis, _tuplize
 
         nd = len(kernel)
-        xq, s_x = _quantize_act_s8(data, min_calib_range, max_calib_range)
+        if data.dtype == jnp.int8:
+            # already codes (previous layer's int8 output)
+            xq = data
+            s_x = 127.0 / jnp.float32(_calib_t(
+                min_calib_range, max_calib_range, "quantized_conv"))
+        else:
+            xq, s_x = _quantize_act_s8(data, min_calib_range,
+                                       max_calib_range)
         acc = jax.lax.conv_general_dilated(
             xq, weight_q,
             window_strides=_tuplize(stride or 1, nd),
@@ -316,15 +354,117 @@ def quantized_conv(data, weight_q, w_scale, bias=None, *, kernel,
         out = acc.astype(jnp.float32) * (w_scale.reshape(sshape) / s_x)
         if not (no_bias or bias is None):
             out = out + bias.astype(jnp.float32).reshape(sshape)
+        if out_type == "int8":
+            return _requant_out(out, out_min_calib, out_max_calib)
         return out  # f32, matching the oracle path's output dtype
 
-    xq = _fake_quant_act(data, min_calib_range, max_calib_range)
+    if data.dtype == jnp.int8:
+        t_in = jnp.float32(_calib_t(min_calib_range, max_calib_range,
+                                    "quantized_conv"))
+        xq = data.astype(jnp.float32) * (t_in / 127.0)
+    else:
+        xq = _fake_quant_act(data, min_calib_range, max_calib_range)
     scale = w_scale.reshape((-1,) + (1,) * (weight_q.ndim - 1))
     w = weight_q.astype(jnp.float32) * scale
-    return get_op("Convolution").fn(
+    out = get_op("Convolution").fn(
         xq, w, bias, kernel=kernel, num_filter=num_filter, stride=stride,
         pad=pad, dilate=dilate, num_group=num_group, layout=layout,
         no_bias=no_bias or bias is None)
+    if out_type == "int8":
+        return _requant_out(out.astype(jnp.float32), out_min_calib,
+                            out_max_calib)
+    return out
+
+
+@register("_contrib_requantize", num_outputs=3)
+def requantize(data, min_range, max_range, *, out_type="int8",
+               min_calib_range=None, max_calib_range=None):
+    """int32 accumulator -> int8 codes (reference:
+    src/operator/quantization/requantize-inl.h). ``min_range``/
+    ``max_range`` describe the real-valued span of the s32 input; the
+    output grid uses the calibrated range when given, else the input's.
+    Pure elementwise rescale — XLA fuses it into the producing matmul's
+    epilogue, so no f32 tensor ever materializes in HBM."""
+    if out_type != "int8":
+        raise ValueError("requantize: only int8 output is supported")
+    in_t = _q8_range(min_range, max_range)
+    if min_calib_range is not None or max_calib_range is not None:
+        t = jnp.float32(_calib_t(min_calib_range, max_calib_range,
+                                 "requantize"))
+    else:
+        t = in_t
+    # s32 codes represent x = codes * in_t / (2^31 - 1)
+    scale = (in_t / jnp.float32(2147483647.0)) * (127.0 / t)
+    codes = jnp.clip(jnp.round(data.astype(jnp.float32) * scale),
+                     -127, 127).astype(jnp.int8)
+    return codes, -t, t
+
+
+def _q8_range(min_r, max_r):
+    t = jnp.maximum(jnp.abs(jnp.asarray(min_r, jnp.float32)),
+                    jnp.abs(jnp.asarray(max_r, jnp.float32)))
+    return t + 1e-12
+
+
+@register("_contrib_quantized_pooling", num_outputs=3)
+def quantized_pooling(data, min_data, max_data, *, kernel=None, pool_type="max",
+                      global_pool=False, stride=None, pad=None,
+                      pooling_convention="valid", layout=None, count_include_pad=True):
+    """Pooling on int8 codes (reference: src/operator/quantization/
+    quantized_pooling.cc). Max pooling is exact on codes (monotonic);
+    avg pooling accumulates in s32 and rounds back onto the SAME grid, so
+    the (min, max) range passes through unchanged and the trunk stays
+    int8 — no dequantize between a quantized conv and its pool."""
+    from .registry import get_op
+
+    pool = get_op("Pooling").fn
+    if pool_type == "max":
+        out = pool(data.astype(jnp.int32), kernel=kernel, pool_type="max",
+                   global_pool=global_pool, stride=stride, pad=pad,
+                   pooling_convention=pooling_convention, layout=layout,
+                   count_include_pad=count_include_pad).astype(jnp.int8)
+    elif pool_type == "avg":
+        # f32 mean of codes, rounded back to the code grid (the codes are
+        # small ints, so f32 holds them exactly; XLA fuses the chain)
+        out = jnp.clip(jnp.round(pool(
+            data.astype(jnp.float32), kernel=kernel, pool_type="avg",
+            global_pool=global_pool, stride=stride, pad=pad,
+            pooling_convention=pooling_convention, layout=layout,
+            count_include_pad=count_include_pad)), -127, 127).astype(jnp.int8)
+    else:
+        raise ValueError(
+            f"quantized_pooling: pool_type {pool_type!r} not supported "
+            "(reference supports max/avg)")
+    return out, min_data, max_data
+
+
+@register("_contrib_quantized_concat", variadic=True, num_outputs=3)
+def quantized_concat(*args, dim=1, num_args=None):
+    """Concat int8 tensors (reference: src/operator/quantization/
+    quantized_concat.cc). Inputs arrive as ``x0..xn-1, min0, max0, ...``;
+    inputs whose ranges differ are REQUANTIZED onto the widest range
+    (codes scale by t_i / t_out) so one grid covers the result."""
+    n = num_args if num_args is not None else len(args) // 3
+    data = args[:n]
+    mins = args[n::2][:n]
+    maxs = args[n + 1::2][:n]
+    ts = [_q8_range(mn, mx) for mn, mx in zip(mins, maxs)]
+    t_out = ts[0]
+    for t in ts[1:]:
+        t_out = jnp.maximum(t_out, t)
+    parts = []
+    for x, t in zip(data, ts):
+        scale = t / t_out
+        parts.append(jnp.clip(jnp.round(x.astype(jnp.float32) * scale),
+                              -127, 127).astype(jnp.int8))
+    return jnp.concatenate(parts, axis=dim), -t_out, t_out
+
+
+@register("_contrib_quantized_flatten", num_outputs=3)
+def quantized_flatten(data, min_data, max_data):
+    """Flatten int8 codes; range passes through (reference:
+    src/operator/quantization/quantized_flatten.cc)."""
+    return data.reshape(data.shape[0], -1), min_data, max_data
 
 
 @register("_contrib_quadratic", aliases=["quadratic"])

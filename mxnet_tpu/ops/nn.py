@@ -17,6 +17,7 @@ from typing import Optional
 
 import jax
 import jax.numpy as jnp
+import numpy as _np
 
 from .registry import attr, register
 
@@ -488,7 +489,10 @@ def pooling(data, *, kernel=(), pool_type="max", stride=(), pad=(),
 
     padding = pads_for(pooling_convention)
     if pool_type == "max":
-        init = -jnp.inf if jnp.issubdtype(data.dtype, jnp.floating) else jnp.iinfo(data.dtype).min
+        # fixed-width init scalar: a bare Python int promotes to i64
+        # under jax_enable_x64 and reduce_window rejects the mismatch
+        init = -jnp.inf if jnp.issubdtype(data.dtype, jnp.floating) \
+            else _np.dtype(data.dtype).type(jnp.iinfo(data.dtype).min)
         return jax.lax.reduce_window(data, init, jax.lax.max, window, strides, padding)
     if pool_type in ("avg", "sum"):
         summed = jax.lax.reduce_window(data, 0.0, jax.lax.add, window, strides, padding)

@@ -70,6 +70,7 @@ class TestShardingRules:
 class TestTrainStep:
     def test_dp_converges(self):
         np.random.seed(0)
+        mx.random.seed(0)
         net = _mlp()
         mesh = par.make_mesh({"dp": 8})
         step = par.TrainStep(net, gloss.SoftmaxCrossEntropyLoss(), "adam",

@@ -123,6 +123,7 @@ class TestPipelinedBlock:
     def test_trainstep_pp_matches_offmesh_loss(self):
         """Same init → first-step loss identical on-mesh and off-mesh."""
         onp.random.seed(7)
+        mx.random.seed(7)
         rs = onp.random.RandomState(11)
         tokens = rs.randint(0, 256, (8, 8)).astype("int32")
         labels = rs.randint(0, 256, (8, 8)).astype("int32")
@@ -158,6 +159,7 @@ class TestPipelinedBlock:
 
     def test_trainstep_pp_tp_dp_converges(self):
         onp.random.seed(13)
+        mx.random.seed(13)
         net = nlp.llama_tiny_pp(n_stages=2, layers_per_stage=2,
                                 n_microbatches=4)
         net.initialize()

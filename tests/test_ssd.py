@@ -96,6 +96,7 @@ class TestSSDModel:
 
     def test_training_learns_fixed_scene(self):
         onp.random.seed(3)
+        mx.random.seed(3)
         net = vision.ssd_toy(num_classes=2)
         net.initialize()
         loss_fn = vision.SSDMultiBoxLoss()
