@@ -193,10 +193,14 @@ def _conv1x1_dot(x, w):
     of roofline on the same shapes inside ResNet-50; PERF.md round 4).
     f32 accumulation, output cast back to the input dtype.
     """
+    # NO preferred_element_type=f32: the TPU MXU accumulates bf16 dots in
+    # f32 natively and rounds on output, but an explicit f32 preferred
+    # type SURVIVES XLA's dot->conv canonicalization — the round-5 HLO
+    # byte audit found ~14 GB/step of f32[256,56,56,256]-class conv
+    # outputs materialized in HBM (2x the bytes of the bf16 tensors the
+    # 3x3 convs emit), with the .astype living in the consumer fusion
     w2 = w.reshape(w.shape[0], w.shape[1]).astype(x.dtype)
-    out = jax.lax.dot_general(
-        x, w2, (((3,), (1,)), ((), ())),
-        preferred_element_type=jnp.float32)
+    out = jax.lax.dot_general(x, w2, (((3,), (1,)), ((), ())))
     return out.astype(x.dtype)
 
 
@@ -207,10 +211,11 @@ def _conv1x1_dot_fwd(x, w):
 def _conv1x1_dot_bwd(res, dy):
     x, w = res
     w2 = w.reshape(w.shape[0], w.shape[1]).astype(dy.dtype)
-    # dX[n,h,w,c] = sum_o dy[n,h,w,o] * W[o,c]
+    # dX[n,h,w,c] = sum_o dy[n,h,w,o] * W[o,c] — no preferred f32 (see
+    # forward note: it would materialize f32 dX tensors after dot->conv
+    # canonicalization)
     dx = jax.lax.dot_general(
-        dy, w2, (((3,), (0,)), ((), ())),
-        preferred_element_type=jnp.float32).astype(x.dtype)
+        dy, w2, (((3,), (0,)), ((), ()))).astype(x.dtype)
     # dW[o,c] = sum_{n,h,w} dy[n,h,w,o] * x[n,h,w,c]
     dw = jax.lax.dot_general(
         dy, x, (((0, 1, 2), (0, 1, 2)), ((), ())),
