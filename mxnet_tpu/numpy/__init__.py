@@ -1014,6 +1014,10 @@ class _Random:
 
 random = _Random()
 
+
+
+
+
 __all__ = sorted(
     [n for n in globals()
      if not n.startswith("_") and n not in ("builtins", "NDArray",
@@ -1354,6 +1358,83 @@ inexact, signedinteger = _onp.inexact, _onp.signedinteger
 unsignedinteger, character = _onp.unsignedinteger, _onp.character
 generic, flexible = _onp.generic, _onp.flexible
 bool = _onp.bool_
+
+
+
+# ---------------------------------------------------------------------------
+# index-expression helpers (reference: numpy.lib.index_tricks — mx.np
+# mirrors the numpy surface, SURVEY.md §2.3 numpy API row)
+# ---------------------------------------------------------------------------
+
+
+def _slice_to_axis(sl):
+    """slice -> 1-D coordinate array, numpy index-trick conventions:
+    an IMAGINARY step means linspace point count (``1:2:5j``)."""
+    start = 0 if sl.start is None else sl.start
+    if isinstance(sl.step, complex):
+        return linspace(start, sl.stop, int(abs(sl.step)))
+    return arange(start, sl.stop, 1 if sl.step is None else sl.step)
+
+
+class _MGridClass:
+    """``mgrid[...]``: dense coordinate grids (``ogrid`` = sparse)."""
+
+    def __init__(self, sparse):
+        self._sparse = sparse
+
+    def __getitem__(self, key):
+        slices = key if isinstance(key, tuple) else (key,)
+        axes = [_slice_to_axis(sl) for sl in slices]
+        if len(axes) == 1:
+            return axes[0]
+        if self._sparse:
+            out = []
+            for i, ax in enumerate(axes):
+                shp = [1] * len(axes)
+                shp[i] = ax.shape[0]
+                out.append(ax.reshape(tuple(shp)))
+            return out
+        grids = meshgrid(*axes, indexing="ij")
+        return stack(grids, axis=0)
+
+
+mgrid = _MGridClass(sparse=False)
+ogrid = _MGridClass(sparse=True)
+
+
+class _RClass:
+    """``r_[...]``: concatenate slices/arrays/scalars along axis 0."""
+
+    _axis = 0
+
+    def __getitem__(self, key):
+        items = key if isinstance(key, tuple) else (key,)
+        if items and isinstance(items[0], str):
+            raise NotImplementedError(
+                "np.r_/np.c_ string directives ('2,0', 'r') are not "
+                "supported; pass arrays/slices")
+        parts = []
+        for it in items:
+            if isinstance(it, slice):
+                parts.append(_slice_to_axis(it))
+            else:
+                parts.append(atleast_1d(asarray(it)))
+        if self._axis != 0:
+            parts = [p.reshape((-1, 1)) if p.ndim == 1 else p
+                     for p in parts]
+        return concatenate(parts, axis=self._axis)
+
+
+class _CClass(_RClass):
+    """``c_[...]``: column-wise concatenation (1-D inputs become
+    columns)."""
+
+    _axis = -1
+
+
+r_ = _RClass()
+c_ = _CClass()
+
 
 __all__ = sorted(
     [n for n in globals()

@@ -672,3 +672,32 @@ class TestNpxOpBackedAdditions:
         for name in ("masked_softmax", "ctc_loss", "deconvolution",
                      "slice_axis"):
             assert name in mx.npx.__all__
+
+
+class TestIndexTricks:
+    """numpy.lib.index_tricks mirrors (round 5: mgrid/ogrid/r_/c_)."""
+
+    def test_mgrid_ogrid(self):
+        import numpy as onp
+        onp.testing.assert_allclose(mx.np.mgrid[0:3, 0:2].asnumpy(),
+                                    onp.mgrid[0:3, 0:2])
+        onp.testing.assert_allclose(mx.np.mgrid[1:2:5j].asnumpy(),
+                                    onp.mgrid[1:2:5j])
+        got = mx.np.ogrid[0:3, 0:2]
+        want = onp.ogrid[0:3, 0:2]
+        for a, b in zip(got, want):
+            onp.testing.assert_allclose(a.asnumpy(), b)
+
+    def test_r_and_c(self):
+        import numpy as onp
+        onp.testing.assert_allclose(
+            mx.np.r_[0:4, mx.np.array([9.0, 8.0]), 7].asnumpy(),
+            onp.r_[0:4, [9.0, 8.0], 7])
+        onp.testing.assert_allclose(mx.np.r_[1:2:5j].asnumpy(),
+                                    onp.r_[1:2:5j])
+        onp.testing.assert_allclose(
+            mx.np.c_[mx.np.array([1, 2, 3]), mx.np.array([4, 5, 6])]
+            .asnumpy(), onp.c_[[1, 2, 3], [4, 5, 6]])
+        import pytest
+        with pytest.raises(NotImplementedError):
+            mx.np.r_["2,0", mx.np.array([1.0])]
