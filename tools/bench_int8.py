@@ -14,6 +14,25 @@ import time
 import numpy as np
 
 
+def _bench_chain(fn, x, iters):
+    """Chained data-dependent timing loop (the PERF.md relay protocol):
+    jit a fori_loop of fn, fetch a scalar that depends on everything,
+    best of 2 timed runs."""
+    import jax
+
+    f = jax.jit(lambda x: jax.lax.fori_loop(
+        0, iters, lambda i, x: fn(x), x))
+    r = f(x)
+    _ = np.asarray(jax.device_get(r)).ravel()[0]
+    best = float("inf")
+    for _i in range(2):
+        t0 = time.perf_counter()
+        r = f(r)
+        _ = np.asarray(jax.device_get(r)).ravel()[0]
+        best = min(best, time.perf_counter() - t0)
+    return best / iters * 1e3
+
+
 def main():
     import jax
     import jax.numpy as jnp
@@ -27,17 +46,7 @@ def main():
     bbf = jnp.asarray(rs.randn(K, N), jnp.bfloat16)
 
     def bench(fn, x):
-        f = jax.jit(lambda x: jax.lax.fori_loop(
-            0, iters, lambda i, x: fn(x), x))
-        r = f(x)
-        _ = np.asarray(jax.device_get(r)).ravel()[0]
-        best = float("inf")
-        for _i in range(2):
-            t0 = time.perf_counter()
-            r = f(r)
-            _ = np.asarray(jax.device_get(r)).ravel()[0]
-            best = min(best, time.perf_counter() - t0)
-        return best / iters * 1e3
+        return _bench_chain(fn, x, iters)
 
     def mm_s8(x):
         acc = jax.lax.dot_general(x, b8, (((1,), (0,)), ((), ())),
@@ -87,17 +96,7 @@ def main_layers():
     iters = 60
 
     def bench(fn, x):
-        f = jax.jit(lambda x: jax.lax.fori_loop(
-            0, iters, lambda i, x: fn(x), x))
-        r = f(x)
-        _ = np.asarray(jax.device_get(r)).ravel()[0]
-        best = float("inf")
-        for _i in range(2):
-            t0 = time.perf_counter()
-            r = f(r)
-            _ = np.asarray(jax.device_get(r)).ravel()[0]
-            best = min(best, time.perf_counter() - t0)
-        return best / iters * 1e3
+        return _bench_chain(fn, x, iters)
 
     LAYERS = [
         ("stage1_3x3", (64, 56, 56, 64), 64, (3, 3), (1, 1)),
