@@ -460,6 +460,29 @@ def quantized_concat(*args, dim=1, num_args=None):
     return jnp.concatenate(parts, axis=dim), -t_out, t_out
 
 
+@register("_contrib_quantized_elemwise_add", num_outputs=3)
+def quantized_elemwise_add(lhs, rhs, min_lhs, max_lhs, min_rhs, max_rhs, *,
+                           min_calib_range=None, max_calib_range=None):
+    """Residual add on int8 codes (reference: src/operator/quantization/
+    quantized_elemwise_add.cc — the op that keeps ResNet skip
+    connections int8). Each side rescales onto the OUTPUT grid (the
+    calibrated range when given, else the sum of the input ranges so the
+    result cannot clip), accumulating in f32 inside the fused epilogue;
+    only int8 codes cross HBM."""
+    t_l = _q8_range(min_lhs, max_lhs)
+    t_r = _q8_range(min_rhs, max_rhs)
+    if min_calib_range is not None or max_calib_range is not None:
+        t = jnp.float32(_calib_t(min_calib_range, max_calib_range,
+                                 "quantized_elemwise_add"))
+    else:
+        t = t_l + t_r
+    acc = (lhs.astype(jnp.float32) * (t_l / 127.0)
+           + rhs.astype(jnp.float32) * (t_r / 127.0))
+    codes = jnp.clip(jnp.round(acc * (127.0 / t)),
+                     -127, 127).astype(jnp.int8)
+    return codes, -t, t
+
+
 @register("_contrib_quantized_flatten", num_outputs=3)
 def quantized_flatten(data, min_data, max_data):
     """Flatten int8 codes; range passes through (reference:

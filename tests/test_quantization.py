@@ -439,3 +439,32 @@ class TestInt8Trunk:
                         int8_trunk=True)
         got = net(x).asnumpy()
         assert _rel_err(got, want) < 0.1, _rel_err(got, want)
+
+
+class TestQuantizedElemwiseAdd:
+    def test_matches_dequantized_sum(self):
+        rs = onp.random.RandomState(0)
+        a = rs.randn(4, 8).astype("float32")
+        b = (rs.randn(4, 8) * 3).astype("float32")
+        ta, tb = float(onp.abs(a).max()), float(onp.abs(b).max())
+        ca = onp.clip(onp.round(a * 127 / ta), -127, 127).astype("int8")
+        cb = onp.clip(onp.round(b * 127 / tb), -127, 127).astype("int8")
+        out, mn, mxr = mx.nd._contrib_quantized_elemwise_add(
+            mx.nd.array(ca, dtype="int8"), mx.nd.array(cb, dtype="int8"),
+            mx.nd.array([-ta]), mx.nd.array([ta]),
+            mx.nd.array([-tb]), mx.nd.array([tb]))
+        assert out.dtype == onp.int8
+        t = float(mxr.asnumpy())
+        got = out.asnumpy().astype("float32") * t / 127.0
+        onp.testing.assert_allclose(got, a + b, atol=3 * t / 127.0)
+
+    def test_calibrated_output_grid(self):
+        ca = onp.array([[127, -127]], dtype="int8")
+        cb = onp.array([[127, 127]], dtype="int8")
+        out, mn, mxr = mx.nd._contrib_quantized_elemwise_add(
+            mx.nd.array(ca, dtype="int8"), mx.nd.array(cb, dtype="int8"),
+            mx.nd.array([-1.0]), mx.nd.array([1.0]),
+            mx.nd.array([-1.0]), mx.nd.array([1.0]),
+            min_calib_range=-2.0, max_calib_range=2.0)
+        got = out.asnumpy().astype("float32") * 2.0 / 127.0
+        onp.testing.assert_allclose(got, [[2.0, 0.0]], atol=2 / 127.0)
