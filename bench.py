@@ -68,7 +68,27 @@ def _emit(record: dict) -> None:
     print(json.dumps(record), flush=True)
 
 
+def _write_telemetry(path: "str | None") -> None:
+    if not path:
+        return
+    from mxnet_tpu import telemetry
+
+    telemetry.write_snapshot(path)
+
+
 def main():
+    # --telemetry-out PATH: enable mx.telemetry for the run and write a
+    # JSON snapshot after every stage, so BENCH_r*.json rounds carry
+    # op-mix and cache-hit data
+    from mxnet_tpu.telemetry import pop_telemetry_out_flag
+
+    sys.argv[1:], telemetry_out = pop_telemetry_out_flag(sys.argv[1:])
+    if telemetry_out:
+        from mxnet_tpu import telemetry
+
+        telemetry.enable()
+        global _TELEMETRY_OUT
+        _TELEMETRY_OUT = telemetry_out
     import jax
     import mxnet_tpu as mx
     from mxnet_tpu import parallel as par
@@ -104,6 +124,12 @@ def main():
         "vs_baseline": round(img_s / BASELINE_IMG_S, 4),
     }
     _emit(record)  # stage 1 complete — contract keys are now on stdout
+    # snapshot after every stage, matching the incremental-emit contract:
+    # a mid-chain kill still leaves the latest telemetry on disk. This
+    # file covers THIS process (resnet + real-data stages); the BERT/
+    # Llama subprocess stages write their own <PATH>.<script>.json via
+    # MXNET_TELEMETRY_OUT (see _run_sub)
+    _write_telemetry(telemetry_out)
 
     # release this process's step/model buffers before the BERT/Llama
     # subprocesses run — the chip's HBM is shared with children, and the
@@ -120,6 +146,7 @@ def main():
         else:
             record[name + "_skipped"] = "budget"
         _emit(record)
+        _write_telemetry(telemetry_out)
 
     if _remaining_s() > 60:
         try:
@@ -129,6 +156,7 @@ def main():
     else:
         record["real_data_skipped"] = "budget"
     _emit(record)
+    _write_telemetry(telemetry_out)
     return 0
 
 
@@ -265,14 +293,26 @@ def _real_data_extra(batch, steps=10, img_size=224, n_images=2048):
     }
 
 
+_TELEMETRY_OUT = None  # set by main() when --telemetry-out is given
+
+
 def _run_sub(script, timeout_s):
-    """Run a bench subprocess, return its last-stdout-line JSON record."""
+    """Run a bench subprocess, return its last-stdout-line JSON record.
+
+    With --telemetry-out, the child gets MXNET_TELEMETRY_OUT so its own
+    telemetry lands in a per-stage sibling file (the parent's snapshot
+    cannot see a subprocess's registry)."""
     import subprocess
 
+    env = None
+    if _TELEMETRY_OUT:
+        stem = os.path.splitext(script)[0]
+        env = dict(os.environ, MXNET_TELEMETRY="1",
+                   MXNET_TELEMETRY_OUT=f"{_TELEMETRY_OUT}.{stem}.json")
     out = subprocess.run(
         [sys.executable,
          os.path.join(os.path.dirname(os.path.abspath(__file__)), script)],
-        capture_output=True, text=True, timeout=timeout_s)
+        capture_output=True, text=True, timeout=timeout_s, env=env)
     line = out.stdout.strip().splitlines()[-1]
     return json.loads(line)
 

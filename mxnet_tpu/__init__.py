@@ -175,6 +175,7 @@ from . import parallel
 from . import symbol
 from . import symbol as sym
 from . import tracing
+from . import telemetry
 from . import profiler
 from . import callback
 from . import monitor

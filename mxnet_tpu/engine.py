@@ -25,6 +25,10 @@ from __future__ import annotations
 import contextlib
 import os
 import threading
+import time
+
+from . import telemetry
+from .telemetry import _state as _telemetry_state
 
 __all__ = ["set_engine_type", "engine_type", "is_naive", "wait_for_all", "bulk"]
 
@@ -67,13 +71,25 @@ def track(jax_array) -> None:
         ref = weakref.ref(jax_array)
     except TypeError:  # non-weakrefable (plain scalar) — nothing async
         return
+    n_evict = 0
     with _live_lock:
         _live_arrays.append(ref)
         if len(_live_arrays) > _MAX_LIVE:
-            # compact collected entries first; halve only if still over
+            # compact collected (dead) entries first; halve only if still
+            # over — those evictions drop STILL-LIVE refs out of
+            # wait_for_all coverage, so they are counted (telemetry:
+            # mxnet_engine_live_evictions_total) instead of silent
             _live_arrays[:] = [r for r in _live_arrays if r() is not None]
             if len(_live_arrays) > _MAX_LIVE:
-                del _live_arrays[: len(_live_arrays) // 2]
+                n_evict = len(_live_arrays) // 2
+                del _live_arrays[:n_evict]
+        n_live = len(_live_arrays)
+    # record outside _live_lock: track() runs on every array creation and
+    # telemetry takes its own lock — never nest the two
+    if n_evict:
+        telemetry.record_live_evictions(n_evict)
+    if _telemetry_state.enabled:
+        telemetry.set_live_arrays(n_live)
 
 
 def wait_for_all() -> None:
@@ -82,12 +98,24 @@ def wait_for_all() -> None:
     ThreadedEngine::WaitForAll + exception rethrow)."""
     import jax
 
+    # capture the flag ONCE: enable() from another thread mid-wait must
+    # not pair an unset t0 with a recording exit (uptime-scale sample)
+    rec = _telemetry_state.enabled
+    t0 = time.perf_counter() if rec else 0.0
     with _live_lock:
         pending = [r() for r in _live_arrays]
         _live_arrays.clear()
-    for arr in pending:
-        if arr is not None:
-            jax.block_until_ready(arr)
+    try:
+        for arr in pending:
+            if arr is not None:
+                jax.block_until_ready(arr)
+    finally:
+        if rec:
+            telemetry.record_engine_wait(time.perf_counter() - t0)
+            # arrays may have been tracked concurrently while we blocked
+            with _live_lock:
+                n_live = len(_live_arrays)
+            telemetry.set_live_arrays(n_live)
 
 
 @contextlib.contextmanager

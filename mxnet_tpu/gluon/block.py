@@ -26,8 +26,9 @@ import threading
 from collections import OrderedDict
 from typing import List, Optional
 
-from .. import autograd, engine, random_state, tracing
+from .. import autograd, engine, random_state, telemetry, tracing
 from ..base import MXNetError, name_manager
+from ..telemetry import _state as _telemetry_state
 from ..context import Context, cpu, current_context
 from ..ndarray import NDArray
 from ..ndarray.ndarray import _wrap_jax, imperative_invoke, _LambdaOp
@@ -440,6 +441,8 @@ class _CachedGraph:
             training,
         )
         entry = self._cache.get(key)
+        if _telemetry_state.enabled:
+            telemetry.record_cache("cached_op", hit=entry is not None)
         if entry is None:
             entry = self._build(param_arrays, args, ctx, training)
             self._cache[key] = entry

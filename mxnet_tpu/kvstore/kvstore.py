@@ -2,12 +2,31 @@
 from __future__ import annotations
 
 import pickle
+import time
 from typing import Dict, List, Optional
 
+import numpy as _np
+
 from .. import optimizer as opt
+from .. import telemetry
 from ..base import MXNetError
 from ..ndarray import NDArray
 from ..ndarray import array as nd_array
+from ..telemetry import _state as _telemetry_state
+
+
+def _nd_bytes(v) -> int:
+    """Payload size of one NDArray (shape x dtype itemsize)."""
+    try:
+        d = v.dtype
+        itemsize = getattr(d, "itemsize", None) or _np.dtype(d).itemsize
+        return int(v.size) * int(itemsize)
+    except Exception:
+        return 0
+
+
+def _payload_bytes(vals) -> int:
+    return sum(_nd_bytes(v) for v in vals)
 
 __all__ = ["KVStore", "KVStoreDistAsyncEmu", "KVStoreLocal",
            "KVStoreTPUSync", "create"]
@@ -176,6 +195,8 @@ class KVStoreLocal(KVStore):
             for k, v in zip(key, value):
                 self.push(k, v, priority)
             return
+        _tel = _telemetry_state.enabled
+        t0 = time.perf_counter() if _tel else 0.0
         key = self._canon(key)
         self._check_init(key)
         vals = list(value) if isinstance(value, (list, tuple)) else [value]
@@ -192,6 +213,9 @@ class KVStoreLocal(KVStore):
             self._updater(key, agg, self._store[key])
         else:
             self._store_reduced(key, agg)
+        if _tel:
+            telemetry.record_kv("push", _payload_bytes(vals),
+                                time.perf_counter() - t0)
 
     def _aggregate(self, vals: List[NDArray]) -> NDArray:
         """Reduce per-device copies to one value (subclass hook)."""
@@ -215,6 +239,8 @@ class KVStoreLocal(KVStore):
             for k, o in zip(key, out):
                 self.pull(k, o, priority)
             return
+        _tel = _telemetry_state.enabled
+        t0 = time.perf_counter() if _tel else 0.0
         key = self._canon(key)
         self._check_init(key)
         outs = out if isinstance(out, (list, tuple)) else [out]
@@ -222,6 +248,9 @@ class KVStoreLocal(KVStore):
         for o in outs:
             o._set_data(src.as_in_context(o.context).data
                         if o.context != src.context else src.data)
+        if _tel:
+            telemetry.record_kv("pull", _nd_bytes(src) * len(outs),
+                                time.perf_counter() - t0)
 
 
 class KVStoreTPUSync(KVStoreLocal):
@@ -329,6 +358,19 @@ class KVStoreTPUSync(KVStoreLocal):
 
     def _collective_sum(self, vals: List[NDArray]):
         """All-reduce per-device copies: one XLA psum over the mesh."""
+        if not _telemetry_state.enabled:
+            return self._collective_sum_impl(vals)
+        t0 = time.perf_counter()
+        reduced = self._collective_sum_impl(vals)
+        # payload entering the psum: one copy per mesh slot — the reduced
+        # array is replicated over the mesh (out_specs=P()), so its device
+        # set IS the mesh; a failed collective records nothing
+        telemetry.record_kv(
+            "allreduce", _nd_bytes(vals[0]) * len(reduced.sharding.device_set),
+            time.perf_counter() - t0)
+        return reduced
+
+    def _collective_sum_impl(self, vals: List[NDArray]):
         import jax
         from jax.sharding import NamedSharding, PartitionSpec as P
 
@@ -392,6 +434,8 @@ class KVStoreTPUSync(KVStoreLocal):
             for k, o in zip(key, out):
                 self.pull(k, o, priority)
             return
+        _tel = _telemetry_state.enabled
+        t0 = time.perf_counter() if _tel else 0.0
         key = self._canon(key)
         self._check_init(key)
         outs = out if isinstance(out, (list, tuple)) else [out]
@@ -411,6 +455,9 @@ class KVStoreTPUSync(KVStoreLocal):
             else:
                 o._set_data(src.as_in_context(o.context).data
                             if o.context != src.context else data)
+        if _tel:
+            telemetry.record_kv("pull", _nd_bytes(src) * len(outs),
+                                time.perf_counter() - t0)
 
 
 class KVStoreDistAsyncEmu(KVStoreTPUSync):
@@ -453,6 +500,8 @@ class KVStoreDistAsyncEmu(KVStoreTPUSync):
             for k, v in zip(key, value):
                 self.push(k, v, priority)
             return
+        _tel = _telemetry_state.enabled
+        t0 = time.perf_counter() if _tel else 0.0
         key = self._canon(key)
         self._check_init(key)
         if self._updater is None:
@@ -471,6 +520,9 @@ class KVStoreDistAsyncEmu(KVStoreTPUSync):
         n = self._push_count[key] = self._push_count.get(key, 0) + 1
         if n % self._staleness == 0:
             self._sync_replicas(key)
+        if _tel:
+            telemetry.record_kv("push", _payload_bytes(vals),
+                                time.perf_counter() - t0)
 
     def _sync_replicas(self, key):
         """Average the process-local replicas: one psum over all

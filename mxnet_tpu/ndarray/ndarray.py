@@ -20,14 +20,16 @@ TPU-native design (SURVEY.md §7.3.1 — the riskiest seam):
 """
 from __future__ import annotations
 
+import time
 from typing import Optional
 
 import numpy as _np
 
-from .. import autograd, engine, random_state
+from .. import autograd, engine, random_state, telemetry
 from ..base import MXNetError, numeric_types, integer_types
 from ..context import Context, current_context
 from ..ops.registry import OpDef, eager_call, get_op
+from ..telemetry import _state as _telemetry_state
 
 __all__ = ["NDArray", "array", "empty", "_wrap_jax", "imperative_invoke", "waitall"]
 
@@ -729,12 +731,29 @@ def imperative_invoke(opdef, tensor_args, attrs, out=None, ctx=None,
                 return fn(*tensors, **fixed_attrs)
         from ..base import current_execution_platform, execution_platform
 
+        # telemetry: the recording path bypasses eager_call (jax.vjp over
+        # the raw fn), so per-op dispatch is counted here; the eager OpDef
+        # branch below counts inside eager_call — no double count. The
+        # flag is captured once so a mid-call enable() can't pair an
+        # unset t0 with a recording exit
+        _tel = _telemetry_state.enabled
+        _tel_t0 = time.perf_counter() if _tel else 0.0
         sample = next((v for v in vals if hasattr(v, "devices")), None)
         with execution_platform(current_execution_platform(sample)):
             result, vjp_fn = jax.vjp(pure, *vals)
+        if _tel:
+            telemetry.record_op_dispatch(
+                getattr(opdef, "name", "op"), time.perf_counter() - _tel_t0)
+    elif isinstance(opdef, OpDef):
+        result = eager_call(opdef, vals, attrs, rng=rng)
+        vjp_fn = None
     else:
-        result = eager_call(opdef, vals, attrs, rng=rng) if isinstance(opdef, OpDef) \
-            else opdef.fn(*vals, **{k: v for k, v in attrs.items()})
+        _tel = _telemetry_state.enabled
+        _tel_t0 = time.perf_counter() if _tel else 0.0
+        result = opdef.fn(*vals, **{k: v for k, v in attrs.items()})
+        if _tel:
+            telemetry.record_op_dispatch(
+                getattr(opdef, "name", "op"), time.perf_counter() - _tel_t0)
         vjp_fn = None
 
     multi = isinstance(result, (tuple, list))

@@ -26,7 +26,12 @@ from __future__ import annotations
 
 import functools
 import inspect
+import threading
+import time
 from typing import Callable, Dict, List, NamedTuple, Optional, Sequence
+
+from .. import telemetry
+from ..telemetry import _state as _telemetry_state
 
 __all__ = ["OpDef", "AttrSpec", "attr", "register", "get_op", "list_ops",
            "alias", "validate_attrs"]
@@ -277,9 +282,16 @@ def list_ops() -> List[str]:
 # ---------------------------------------------------------------------------
 
 
+# hit/miss telemetry: the lru-cached body below only runs on a miss, and
+# only in the calling thread, so a thread-local flag is race-free where a
+# cache_info().misses delta would misattribute a concurrent thread's miss
+_cache_probe = threading.local()
+
+
 @functools.lru_cache(maxsize=4096)
 def _cached_call(opname: str, attr_items: tuple, n_tensors: int,
                  has_rng: bool, platform: str):
+    _cache_probe.miss = True
     # `platform` keys the cache even though the traced fn only reads it
     # ambiently: op impls dispatch on current_execution_platform() at
     # TRACE time (Pallas kernels, int8 MXU paths), so one executable per
@@ -348,7 +360,22 @@ def _harmonize_devices(tensors):
 
 
 def eager_call(opdef: OpDef, tensors, attrs, rng=None):
-    """Execute an op eagerly through the per-op executable cache."""
+    """Execute an op eagerly through the per-op executable cache.
+
+    Telemetry (MXNET_TELEMETRY=1): per-op invocation count + host dispatch
+    latency; disabled mode costs exactly this one branch.
+    """
+    if _telemetry_state.enabled:
+        t0 = time.perf_counter()
+        try:
+            return _eager_call(opdef, tensors, attrs, rng)
+        finally:
+            telemetry.record_op_dispatch(
+                opdef.name, time.perf_counter() - t0)
+    return _eager_call(opdef, tensors, attrs, rng)
+
+
+def _eager_call(opdef: OpDef, tensors, attrs, rng=None):
     from ..base import current_execution_platform, execution_platform
 
     if opdef.attr_specs:
@@ -378,8 +405,14 @@ def eager_call(opdef: OpDef, tensors, attrs, rng=None):
             if opdef.needs_rng:
                 return opdef.fn(None, *tensors, **attrs)
             return opdef.fn(*tensors, **attrs)
-        fn = _cached_call(opdef.name, attr_items, len(tensors),
-                          rng is not None, platform)
+        if _telemetry_state.enabled:
+            _cache_probe.miss = False
+            fn = _cached_call(opdef.name, attr_items, len(tensors),
+                              rng is not None, platform)
+            telemetry.record_cache("eager_op", hit=not _cache_probe.miss)
+        else:
+            fn = _cached_call(opdef.name, attr_items, len(tensors),
+                              rng is not None, platform)
         if rng is not None:
             return fn(rng, *tensors)
         return fn(*tensors)

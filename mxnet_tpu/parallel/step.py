@@ -25,8 +25,9 @@ from __future__ import annotations
 from typing import Dict, List, Optional, Sequence
 
 from .. import optimizer as opt_mod
-from .. import random_state, tracing
+from .. import random_state, telemetry, tracing
 from ..base import MXNetError
+from ..telemetry import _state as _telemetry_state
 from ..context import current_context
 from ..ndarray import NDArray
 from ..gluon.block import make_pure_fn, nested_flatten_nd, nested_unflatten_nd
@@ -599,6 +600,8 @@ class TrainStep:
                tuple((tuple(v.shape), str(v.dtype))
                      for v in data_tuple + label_tuple), training)
         entry = self._cache.get(key)
+        if _telemetry_state.enabled:
+            telemetry.record_cache("train_step", hit=entry is not None)
         if entry is None:
             entry = self._build(data_tuple, label_tuple, training)
             self._cache[key] = entry

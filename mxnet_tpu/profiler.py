@@ -45,6 +45,9 @@ _jax_trace_active = False
 _spans: Dict[str, List[float]] = defaultdict(lambda: [0, 0.0, float("inf"), 0.0])
 _counters: Dict[str, float] = {}
 _markers: List[tuple] = []
+# pause/resume bookkeeping: cumulative excluded wall time + open pause start
+_paused_total = 0.0
+_pause_started: Optional[float] = None
 
 
 def set_config(**kwargs):
@@ -68,10 +71,17 @@ def state():
 
 def set_state(new_state="stop"):
     """'run' starts the device trace; 'stop' ends it (reference semantics)."""
-    global _state, _jax_trace_active, _trace_dir
+    global _state, _jax_trace_active, _trace_dir, _paused_total, _pause_started
     if new_state not in ("run", "stop", "pause"):
         raise ValueError(f"bad profiler state {new_state!r}")
     with _lock:
+        now = time.perf_counter()
+        if new_state == "pause" and _state == "run":
+            _pause_started = now
+        elif _pause_started is not None and new_state in ("run", "stop"):
+            # leaving pause: accumulate the excluded window
+            _paused_total += now - _pause_started
+            _pause_started = None
         if new_state == "run" and _state != "run":
             import jax
 
@@ -109,15 +119,33 @@ def resume(profile_process="worker"):
 
 
 def dumps(reset=False, format="table"):
-    """Aggregate-stats table of host-recorded spans + counters.
+    """Aggregate stats of host-recorded spans, counters and markers.
 
-    Mirrors ``profiler.dumps()`` (aggregate mode). The device-side XProf
-    trace lives in ``<filename stem>_xprof/`` for TensorBoard.
+    ``format="table"`` (default) mirrors ``profiler.dumps()``'s aggregate
+    mode: timed spans, ``Counter`` values, ``Marker`` entries (count + last
+    timestamp), with pause/resume-excluded time in the header. The
+    device-side XProf trace lives in ``<filename stem>_xprof/``.
+
+    ``format="chrome_trace"`` returns a chrome://tracing JSON string:
+    aggregate span events, profiler counters as ``ph:"C"`` counter events,
+    markers as instant events — with ``mx.telemetry``'s counters merged
+    onto the same timeline when telemetry has data.
     """
+    global _paused_total, _pause_started
+    if format == "chrome_trace":
+        return _dumps_chrome_trace(reset)
+    if format != "table":
+        raise ValueError(f"unknown dumps format {format!r}")
     with _lock:
-        lines = ["Profile Statistics:",
-                 f"{'Name':<40}{'Calls':>8}{'Total(ms)':>12}"
-                 f"{'Min(ms)':>10}{'Max(ms)':>10}{'Avg(ms)':>10}"]
+        now = time.perf_counter()
+        paused = _paused_total
+        if _pause_started is not None:  # still paused at dump time
+            paused += now - _pause_started
+        lines = ["Profile Statistics:"]
+        if paused > 0:
+            lines.append(f"(excluded paused time: {paused * 1e3:.3f} ms)")
+        lines.append(f"{'Name':<40}{'Calls':>8}{'Total(ms)':>12}"
+                     f"{'Min(ms)':>10}{'Max(ms)':>10}{'Avg(ms)':>10}")
         for name in sorted(_spans):
             cnt, tot, mn, mx = _spans[name]
             lines.append(
@@ -125,14 +153,69 @@ def dumps(reset=False, format="table"):
                 f"{mx * 1e3:>10.3f}{tot / max(cnt, 1) * 1e3:>10.3f}")
         for name in sorted(_counters):
             lines.append(f"{name:<40}{'':>8}{_counters[name]:>12.3f}")
+        by_marker: Dict[str, int] = {}
+        for name, scope, ts in _markers:
+            key = f"Marker::{name} ({scope})"
+            by_marker[key] = by_marker.get(key, 0) + 1
+        for name in sorted(by_marker):
+            lines.append(f"{name:<40}{by_marker[name]:>8}")
         if reset:
             _spans.clear()
             _counters.clear()
             _markers.clear()
+            _paused_total = 0.0
+            if _pause_started is not None:
+                # an open pause window was just reported — rebase it so
+                # resume() doesn't re-account the reset portion
+                _pause_started = now
         out = "\n".join(lines)
     if _trace_dir:
         out += f"\n(XProf device trace: {_trace_dir})"
     return out
+
+
+def _dumps_chrome_trace(reset=False):
+    import json
+
+    from . import telemetry
+
+    global _paused_total, _pause_started
+    events = []
+    with _lock:
+        now = time.perf_counter()
+        for name in sorted(_spans):
+            cnt, tot, mn, mx = _spans[name]
+            events.append({
+                "name": name, "ph": "X", "pid": 0, "tid": 0, "ts": 0,
+                "dur": tot * 1e6,
+                "args": {"calls": cnt, "min_ms": mn * 1e3,
+                         "max_ms": mx * 1e3,
+                         "avg_ms": tot / max(cnt, 1) * 1e3}})
+        for name in sorted(_counters):
+            events.append({"name": name, "ph": "C", "pid": 0, "tid": 0,
+                           "ts": now * 1e6,
+                           "args": {"value": _counters[name]}})
+        for name, scope, ts in _markers:
+            events.append({"name": name, "ph": "i", "pid": 0, "tid": 0,
+                           "ts": ts * 1e6, "s": "p",
+                           "args": {"scope": scope}})
+        paused = _paused_total
+        if _pause_started is not None:  # still paused at dump time
+            paused += now - _pause_started
+        if reset:
+            _spans.clear()
+            _counters.clear()
+            _markers.clear()
+            _paused_total = 0.0
+            if _pause_started is not None:
+                _pause_started = now
+    # merge telemetry's counter series onto the same timeline
+    events.extend(telemetry.chrome_counter_events())
+    doc = {"traceEvents": events, "displayTimeUnit": "ms",
+           "otherData": {"excluded_paused_ms": paused * 1e3}}
+    if _trace_dir:
+        doc["otherData"]["xprof_trace_dir"] = _trace_dir
+    return json.dumps(doc)
 
 
 def dump(finished=True, profile_process="worker"):

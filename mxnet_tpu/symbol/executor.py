@@ -11,10 +11,12 @@ from __future__ import annotations
 
 from typing import Dict, List, Optional
 
+from .. import telemetry
 from ..base import MXNetError
 from ..context import Context, cpu, current_context
 from ..ndarray import NDArray, zeros as nd_zeros
 from ..ndarray.ndarray import _wrap_jax
+from ..telemetry import _state as _telemetry_state
 from .symbol import Symbol, _apply_opdef
 from ..ops.registry import get_op
 
@@ -113,6 +115,8 @@ class Executor:
 
         key = training
         fn = self._fwd_cache.get(key)
+        if _telemetry_state.enabled:
+            telemetry.record_cache("executor", hit=fn is not None)
         if fn is None:
             sym = self._symbol
             arg_names = sym.list_arguments()

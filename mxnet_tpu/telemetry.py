@@ -1,0 +1,683 @@
+"""``mx.telemetry`` — always-available runtime metrics.
+
+The reference pairs its dependency engine with a first-class profiler
+(``src/profiler/profiler.cc``); profiling answers "where did this one run
+spend its time", but a serving-scale system also needs cheap *structured
+counters* that are always on in production: op mix, comms volume, compile
+-cache behaviour, step throughput. This module is that spine: a thread-safe
+registry of counters, gauges and fixed-bucket histograms (no unbounded
+state) with three exporters:
+
+* ``dumps()``       — structured JSON snapshot;
+* ``prom_text()``   — Prometheus text exposition format (no dependency);
+* ``chrome_counter_events()`` — chrome-trace ``ph:"C"`` counter events,
+  merged into ``profiler.dumps(format="chrome_trace")``'s timeline.
+
+Recording is **default-off**: every instrumented hot path guards on one
+module-level flag (``_state.enabled`` — a single attribute load + branch)
+so the disabled fast path costs one branch and allocates nothing. Enable
+with ``MXNET_TELEMETRY=1`` in the environment or ``telemetry.enable()``.
+
+Instrumented layers (each records through the ``record_*`` helpers below,
+which also no-op when disabled, so call sites may skip the outer guard off
+the hot path):
+
+* op dispatch    — ``ops/registry.py::eager_call`` +
+  ``ndarray.imperative_invoke`` (per-op counts, host dispatch latency);
+* engine         — live-array gauge, ``wait_for_all`` block time,
+  live-ref eviction counter (``engine.track`` overflow);
+* kvstore        — push/pull/allreduce call counts, bytes moved, latency;
+* jit caches     — hit/miss per cache (eager per-op executables, CachedOp,
+  TrainStep, symbol Executor);
+* training loop  — ``TrainingTelemetry`` step hook: step time,
+  examples/sec, MFU (FLOP accounting shared with ``tools/cost_check.py``
+  via :func:`xla_cost_analysis`).
+"""
+from __future__ import annotations
+
+import json
+import math
+import os
+import threading
+import time
+from typing import Dict, List, Optional, Sequence, Tuple
+
+__all__ = [
+    "enable", "disable", "enabled", "reset",
+    "counter", "gauge", "histogram",
+    "dumps", "prom_text", "chrome_counter_events", "snapshot",
+    "record_op_dispatch", "record_cache", "record_kv",
+    "record_engine_wait", "set_live_arrays", "record_live_evictions",
+    "record_training_step",
+    "TrainingTelemetry", "xla_cost_analysis",
+    "pop_telemetry_out_flag", "write_snapshot",
+    "LATENCY_BUCKETS", "STEP_BUCKETS",
+]
+
+
+class _State:
+    __slots__ = ("enabled",)
+
+    def __init__(self, enabled: bool):
+        self.enabled = enabled
+
+
+# THE fast-path guard: instrumented modules read `_state.enabled` directly
+# (one attribute load + branch; never swap the _State instance, callers
+# cache a reference to it).
+_state = _State(os.environ.get("MXNET_TELEMETRY", "0") == "1")
+
+
+def enabled() -> bool:
+    return _state.enabled
+
+
+def enable() -> None:
+    _state.enabled = True
+
+
+def disable() -> None:
+    _state.enabled = False
+
+
+# ---------------------------------------------------------------------------
+# Metric registry
+# ---------------------------------------------------------------------------
+
+_lock = threading.Lock()
+_registry: Dict[str, "_Family"] = {}
+
+# Per-family label-child cap: label values come from bounded sets (op names,
+# cache names) but a bug upstream must degrade to a catch-all child, never
+# to unbounded registry growth.
+_MAX_CHILDREN = 4096
+_OVERFLOW_LABEL = "_overflow"
+
+# host-side dispatch/comms latencies: 10 µs .. 30 s, ~x3 geometric
+LATENCY_BUCKETS: Tuple[float, ...] = (
+    10e-6, 30e-6, 100e-6, 300e-6, 1e-3, 3e-3, 10e-3, 30e-3,
+    100e-3, 300e-3, 1.0, 3.0, 10.0, 30.0)
+# training steps: 1 ms .. 100 s
+STEP_BUCKETS: Tuple[float, ...] = (
+    1e-3, 3e-3, 10e-3, 30e-3, 100e-3, 300e-3, 1.0, 3.0, 10.0, 30.0, 100.0)
+
+
+class _Counter:
+    __slots__ = ("value",)
+
+    def __init__(self):
+        self.value = 0.0
+
+    def inc(self, amount: float = 1.0) -> None:
+        if amount < 0:
+            raise ValueError("counters only go up; use a gauge")
+        with _lock:
+            self.value += amount
+
+
+class _Gauge:
+    __slots__ = ("value",)
+
+    def __init__(self):
+        self.value = 0.0
+
+    def set(self, value: float) -> None:
+        with _lock:
+            self.value = float(value)
+
+    def inc(self, amount: float = 1.0) -> None:
+        with _lock:
+            self.value += amount
+
+    def dec(self, amount: float = 1.0) -> None:
+        self.inc(-amount)
+
+
+class _Histogram:
+    __slots__ = ("edges", "counts", "sum", "count")
+
+    def __init__(self, edges: Tuple[float, ...]):
+        self.edges = edges
+        self.counts = [0] * (len(edges) + 1)   # last slot = +Inf
+        self.sum = 0.0
+        self.count = 0
+
+    def observe(self, value: float) -> None:
+        i = 0
+        edges = self.edges
+        n = len(edges)
+        # linear scan: bucket lists are ~a dozen entries, and bisect on a
+        # tuple of floats is not faster at this size
+        while i < n and value > edges[i]:
+            i += 1
+        with _lock:
+            self.counts[i] += 1
+            self.sum += value
+            self.count += 1
+
+
+_KINDS = {"counter": _Counter, "gauge": _Gauge, "histogram": _Histogram}
+
+
+class _Family:
+    """One named metric with a fixed label schema and per-labelset children."""
+
+    __slots__ = ("name", "kind", "help", "labelnames", "buckets", "children")
+
+    def __init__(self, name, kind, help="", labelnames=(), buckets=None):
+        self.name = name
+        self.kind = kind
+        self.help = help
+        self.labelnames = tuple(labelnames)
+        self.buckets = tuple(buckets) if buckets is not None else None
+        self.children: Dict[Tuple[str, ...], object] = {}
+
+    def labels(self, *values) -> object:
+        if len(values) != len(self.labelnames):
+            raise ValueError(
+                f"{self.name}: expected labels {self.labelnames}, "
+                f"got {values!r}")
+        key = tuple(str(v) for v in values)
+        child = self.children.get(key)
+        if child is None:
+            with _lock:
+                child = self.children.get(key)
+                if child is None:
+                    if len(self.children) >= _MAX_CHILDREN:
+                        key = (_OVERFLOW_LABEL,) * len(self.labelnames)
+                        child = self.children.get(key)
+                        if child is not None:
+                            return child
+                    child = (_Histogram(self.buckets)
+                             if self.kind == "histogram"
+                             else _KINDS[self.kind]())
+                    self.children[key] = child
+        return child
+
+    # label-less convenience: family with no labelnames acts as its child
+    def _solo(self):
+        return self.labels()
+
+    def inc(self, amount: float = 1.0):
+        self._solo().inc(amount)
+
+    def set(self, value: float):
+        self._solo().set(value)
+
+    def dec(self, amount: float = 1.0):
+        self._solo().dec(amount)
+
+    def observe(self, value: float):
+        self._solo().observe(value)
+
+
+def _get_or_create(name, kind, help, labelnames, buckets=None) -> _Family:
+    fam = _registry.get(name)
+    if fam is not None:
+        if (fam.kind != kind or fam.labelnames != tuple(labelnames)
+                or (buckets is not None and fam.buckets != tuple(buckets))):
+            raise ValueError(
+                f"metric {name!r} already registered as {fam.kind} with "
+                f"labels {fam.labelnames} and buckets {fam.buckets}")
+        return fam
+    with _lock:
+        fam = _registry.get(name)
+        if fam is None:
+            fam = _Family(name, kind, help, labelnames, buckets)
+            _registry[name] = fam
+    return fam
+
+
+def counter(name: str, help: str = "",
+            labelnames: Sequence[str] = ()) -> _Family:
+    """Get or create a monotonically-increasing counter family."""
+    return _get_or_create(name, "counter", help, labelnames)
+
+
+def gauge(name: str, help: str = "",
+          labelnames: Sequence[str] = ()) -> _Family:
+    """Get or create a gauge (set/inc/dec) family."""
+    return _get_or_create(name, "gauge", help, labelnames)
+
+
+def histogram(name: str, help: str = "", labelnames: Sequence[str] = (),
+              buckets: Sequence[float] = LATENCY_BUCKETS) -> _Family:
+    """Get or create a fixed-bucket histogram family."""
+    edges = tuple(sorted(float(b) for b in buckets))
+    if not edges:
+        raise ValueError("histogram needs at least one bucket edge")
+    return _get_or_create(name, "histogram", help, labelnames, edges)
+
+
+def reset() -> None:
+    """Drop all registered metrics (values AND families).
+
+    Instrumentation re-creates families lazily through the ``record_*``
+    helpers, so a full clear is safe; tests use this for isolation.
+    """
+    with _lock:
+        _registry.clear()
+
+
+# ---------------------------------------------------------------------------
+# Exporters
+# ---------------------------------------------------------------------------
+
+def snapshot() -> Dict:
+    """Point-in-time dict of every metric (the JSON exporter's payload)."""
+    out: Dict = {"enabled": _state.enabled, "metrics": {}}
+    with _lock:
+        families = list(_registry.values())
+    for fam in families:
+        samples: List[Dict] = []
+        with _lock:
+            children = list(fam.children.items())
+        for key, child in children:
+            labels = dict(zip(fam.labelnames, key))
+            if fam.kind == "histogram":
+                with _lock:
+                    counts = list(child.counts)
+                    hsum, hcount = child.sum, child.count
+                cum = 0
+                buckets = {}
+                for edge, c in zip(fam.buckets, counts):
+                    cum += c
+                    buckets[_fmt_float(edge)] = cum
+                buckets["+Inf"] = hcount
+                samples.append({"labels": labels, "sum": hsum,
+                                "count": hcount, "buckets": buckets})
+            else:
+                samples.append({"labels": labels, "value": child.value})
+        out["metrics"][fam.name] = {
+            "type": fam.kind, "help": fam.help, "samples": samples}
+    return out
+
+
+def dumps(indent: Optional[int] = None) -> str:
+    """Structured JSON snapshot of all metrics."""
+    return json.dumps(snapshot(), indent=indent, sort_keys=True)
+
+
+def _fmt_float(v: float) -> str:
+    if v == math.inf:
+        return "+Inf"
+    s = repr(float(v))
+    return s[:-2] if s.endswith(".0") else s
+
+
+def _esc_label(v: str) -> str:
+    return v.replace("\\", "\\\\").replace('"', '\\"').replace("\n", "\\n")
+
+
+def _prom_labels(labels: Dict[str, str], extra: Tuple[str, str] = None) -> str:
+    items = list(labels.items())
+    if extra is not None:
+        items.append(extra)
+    if not items:
+        return ""
+    body = ",".join(f'{k}="{_esc_label(str(v))}"' for k, v in items)
+    return "{" + body + "}"
+
+
+def prom_text() -> str:
+    """Prometheus text exposition format (version 0.0.4) of all metrics."""
+    snap = snapshot()
+    lines: List[str] = []
+    for name in sorted(snap["metrics"]):
+        fam = snap["metrics"][name]
+        if fam["help"]:
+            lines.append(f"# HELP {name} {fam['help']}")
+        lines.append(f"# TYPE {name} {fam['type']}")
+        for s in fam["samples"]:
+            if fam["type"] == "histogram":
+                for le, cum in s["buckets"].items():
+                    lines.append(
+                        f"{name}_bucket"
+                        f"{_prom_labels(s['labels'], ('le', le))} {cum}")
+                lines.append(
+                    f"{name}_sum{_prom_labels(s['labels'])} "
+                    f"{_fmt_float(s['sum'])}")
+                lines.append(
+                    f"{name}_count{_prom_labels(s['labels'])} {s['count']}")
+            else:
+                lines.append(
+                    f"{name}{_prom_labels(s['labels'])} "
+                    f"{_fmt_float(s['value'])}")
+    return "\n".join(lines) + "\n"
+
+
+def chrome_counter_events(ts_us: Optional[float] = None) -> List[Dict]:
+    """Current counter/gauge values as chrome-trace ``ph:"C"`` events.
+
+    ``profiler.dumps(format="chrome_trace")`` merges these onto its
+    timeline so about:tracing shows telemetry counters next to the spans.
+    Histograms contribute their ``_count`` and ``_sum`` series.
+    """
+    if ts_us is None:
+        ts_us = time.perf_counter() * 1e6
+    snap = snapshot()
+    events: List[Dict] = []
+    for name, fam in sorted(snap["metrics"].items()):
+        for s in fam["samples"]:
+            series = "/".join(v for v in s["labels"].values()) or "value"
+            if fam["type"] == "histogram":
+                args = {series + "_count": s["count"],
+                        series + "_sum": s["sum"]}
+            else:
+                args = {series: s["value"]}
+            events.append({"name": name, "ph": "C", "pid": 0, "tid": 0,
+                           "ts": ts_us, "args": args})
+    return events
+
+
+# ---------------------------------------------------------------------------
+# Tool plumbing: the shared `--telemetry-out PATH` contract (bench.py,
+# tools/trace_ops.py) lives here so the flag cannot drift between tools.
+# ---------------------------------------------------------------------------
+
+def pop_telemetry_out_flag(argv: Sequence[str]
+                           ) -> Tuple[List[str], Optional[str]]:
+    """Strip ``--telemetry-out PATH`` / ``--telemetry-out=PATH`` from argv.
+
+    Returns ``(argv_without_flag, path_or_None)`` — positionals keep their
+    slots. A flag with no PATH is a hard error (SystemExit) rather than a
+    silent no-snapshot run discovered only after an expensive trace.
+    """
+    out: List[str] = []
+    path: Optional[str] = None
+    it = iter(argv)
+    for a in it:
+        if a == "--telemetry-out":
+            path = next(it, None)
+        elif a.startswith("--telemetry-out="):
+            path = a.split("=", 1)[1]
+        else:
+            out.append(a)
+            continue
+        if not path or path.startswith("-"):
+            # a following option is NOT a path — erroring beats silently
+            # consuming the flag and snapshotting into "--some-flag"
+            raise SystemExit("--telemetry-out requires a PATH argument")
+    return out, path
+
+
+def write_snapshot(path: str) -> None:
+    """Write an indented JSON snapshot to ``path`` (tool exit hook)."""
+    with open(path, "w") as f:
+        f.write(dumps(indent=2))
+
+
+# MXNET_TELEMETRY_OUT=PATH: enable recording and write a snapshot at
+# interpreter exit — how driver-spawned subprocesses (bench.py's BERT/
+# Llama stages) report telemetry without any CLI plumbing of their own.
+_env_out = os.environ.get("MXNET_TELEMETRY_OUT")
+if _env_out:
+    import atexit
+
+    _state.enabled = True
+    atexit.register(write_snapshot, _env_out)
+
+
+# ---------------------------------------------------------------------------
+# Recording helpers — the one place metric names/schemas are defined.
+# All no-op when telemetry is disabled.
+# ---------------------------------------------------------------------------
+
+def record_op_dispatch(op: str, seconds: float) -> None:
+    """One imperative op dispatch: per-op count + host latency."""
+    if not _state.enabled:
+        return
+    counter("mxnet_op_dispatch_total",
+            "Imperative op dispatches by op name.",
+            ("op",)).labels(op).inc()
+    histogram("mxnet_op_dispatch_seconds",
+              "Host-side dispatch latency per op (async: excludes device "
+              "execution).", ("op",)).labels(op).observe(seconds)
+
+
+def record_cache(cache: str, hit: bool) -> None:
+    """One lookup in a jit/CachedOp compile cache."""
+    if not _state.enabled:
+        return
+    counter("mxnet_jit_cache_total",
+            "Compile-cache lookups by cache and result.",
+            ("cache", "result")).labels(
+                cache, "hit" if hit else "miss").inc()
+
+
+def record_kv(op: str, nbytes: float, seconds: float) -> None:
+    """One kvstore operation (push/pull/allreduce/row_sparse_pull)."""
+    if not _state.enabled:
+        return
+    counter("mxnet_kvstore_calls_total",
+            "KVStore operations by kind.", ("op",)).labels(op).inc()
+    counter("mxnet_kvstore_bytes_total",
+            "Payload bytes moved through the kvstore by kind.",
+            ("op",)).labels(op).inc(float(nbytes))
+    histogram("mxnet_kvstore_seconds",
+              "Host-side kvstore call latency by kind.",
+              ("op",)).labels(op).observe(seconds)
+
+
+def record_engine_wait(seconds: float) -> None:
+    if not _state.enabled:
+        return
+    histogram("mxnet_engine_wait_all_seconds",
+              "Time blocked in engine.wait_for_all.").observe(seconds)
+
+
+def set_live_arrays(n: int) -> None:
+    if not _state.enabled:
+        return
+    gauge("mxnet_engine_live_arrays",
+          "Arrays tracked by the engine whose async work may be in "
+          "flight.").set(n)
+
+
+def record_live_evictions(n: int) -> None:
+    """Still-live refs evicted by engine.track overflow compaction —
+    a nonzero rate means wait_for_all coverage is leaking."""
+    if not _state.enabled or n <= 0:
+        return
+    counter("mxnet_engine_live_evictions_total",
+            "Still-live refs evicted from the engine registry by "
+            "overflow compaction.").inc(n)
+
+
+def record_training_step(seconds: float, examples: float,
+                         mfu_pct: Optional[float] = None) -> None:
+    if not _state.enabled:
+        return
+    counter("mxnet_training_steps_total", "Completed training steps.").inc()
+    counter("mxnet_training_examples_total",
+            "Examples consumed by training steps.").inc(examples)
+    histogram("mxnet_training_step_seconds", "Training step wall time.",
+              buckets=STEP_BUCKETS).observe(seconds)
+    if seconds > 0:
+        gauge("mxnet_training_examples_per_sec",
+              "Throughput of the most recent training step.").set(
+                  examples / seconds)
+    if mfu_pct is not None:
+        gauge("mxnet_training_mfu_pct",
+              "Model-FLOP utilization of the most recent step (percent)."
+              ).set(mfu_pct)
+
+
+# ---------------------------------------------------------------------------
+# Training-step observability
+# ---------------------------------------------------------------------------
+
+def xla_cost_analysis(step, batch) -> Dict[str, float]:
+    """Static cost analysis of a TrainStep's compiled executable.
+
+    The FLOP accounting behind ``tools/cost_check.py`` (which imports this):
+    mirror ``TrainStep.__call__``'s argument assembly, lower the cached
+    executable, and return XLA's ``compiled.cost_analysis()`` dict —
+    ``'flops'`` is the compiler's own static per-step FLOP count.
+
+    .. warning:: This EXECUTES one real training step on ``batch`` to
+       populate the step's executable cache: parameters, optimizer state,
+       ``optimizer.num_update`` and the RNG stream all advance by one
+       update. Call it before training starts (a warmup batch), not
+       mid-run.
+    """
+    import numpy as np
+
+    import jax
+    from . import random_state
+    from .base import execution_platform
+    from .parallel.mesh import use_mesh
+    from .parallel.step import _as_tuple
+
+    loss, _ = step(*batch)
+    loss.asnumpy()
+    data_tuple = _as_tuple(batch[0])
+    label_tuple = _as_tuple(batch[1]) if len(batch) > 1 else ()
+    entry = next(iter(step._cache.values()))
+    jitted = entry["jitted"]
+    optimizer = step.optimizer
+    t = np.int32(optimizer.num_update)
+    lr = np.float32(optimizer.learning_rate)
+    rng = random_state.get_state_key()
+    param_vals = tuple(p.data().data for p in step._params)
+    state_vals = tuple(s.data for s in step._state_leaf_nds)
+    batch_vals = [jax.device_put(v.data, sh)
+                  for v, sh in zip(tuple(data_tuple) + tuple(label_tuple),
+                                   entry["batch_sh"])]
+    with execution_platform(step.mesh.devices.flat[0].platform), \
+            use_mesh(step.mesh):
+        lowered = jitted.lower(param_vals, state_vals, t, lr, rng,
+                               *batch_vals)
+        compiled = lowered.compile()
+    ca = compiled.cost_analysis()
+    if isinstance(ca, (list, tuple)):
+        ca = ca[0]
+    return ca
+
+
+class TrainingTelemetry:
+    """Per-step observability hook for Gluon/Module training loops.
+
+    Records step wall time, examples/sec and an MFU estimate into the
+    telemetry registry (when enabled) and keeps the latest values as
+    attributes (always), so it is usable standalone::
+
+        tt = telemetry.TrainingTelemetry(batch_size=256,
+                                         flops_per_step=fl, peak_flops=pk)
+        for x, y in loader:
+            with tt.step():
+                loss, _ = train_step(x, y)
+        print(tt.last_examples_per_sec, tt.last_mfu_pct)
+
+    ``Module.fit``-style loops attach it as a batch-end callback
+    (``batch_end_callback=tt.batch_end`` — step time is measured between
+    consecutive calls, reference ``BatchEndParam`` contract).
+
+    FLOP accounting: pass ``flops_per_step`` (e.g. from
+    :func:`xla_cost_analysis`'s ``'flops'`` — the same number
+    ``tools/cost_check.py`` reports) or ``flops_per_sample`` (6ND-style);
+    :meth:`for_step` derives it from a TrainStep via the compiler. The MFU
+    denominator is ``peak_flops`` or ``callback.device_peak_flops() x
+    num_devices`` (None on hosts with no known peak — MFU is skipped then).
+    """
+
+    def __init__(self, batch_size: int, flops_per_step: Optional[float] = None,
+                 flops_per_sample: Optional[float] = None,
+                 num_devices: Optional[int] = None,
+                 peak_flops: Optional[float] = None):
+        self.batch_size = batch_size
+        self.flops_per_step = flops_per_step
+        if flops_per_step is None and flops_per_sample is not None:
+            self.flops_per_step = flops_per_sample * batch_size
+        self._num_devices = num_devices
+        self._peak = peak_flops
+        self._peak_resolved = peak_flops is not None
+        self._t0: Optional[float] = None
+        self._last_batch_end: Optional[float] = None
+        self.steps = 0
+        self.last_step_seconds: Optional[float] = None
+        self.last_examples_per_sec: Optional[float] = None
+        self.last_mfu_pct: Optional[float] = None
+
+    @classmethod
+    def for_step(cls, step, batch, batch_size: int, **kwargs
+                 ) -> "TrainingTelemetry":
+        """Build with ``flops_per_step`` read from XLA's cost analysis of
+        ``step``'s compiled executable. Note this runs one REAL optimizer
+        update on ``batch`` (see :func:`xla_cost_analysis`) — use it
+        during setup, counting ``batch`` as a consumed warmup step."""
+        ca = xla_cost_analysis(step, batch)
+        flops = float(ca.get("flops", 0.0)) or None
+        return cls(batch_size, flops_per_step=flops, **kwargs)
+
+    # -- explicit step timing -----------------------------------------
+    def step_begin(self) -> None:
+        self._t0 = time.perf_counter()
+
+    def step_end(self) -> None:
+        if self._t0 is None:
+            return
+        self._observe(time.perf_counter() - self._t0)
+        self._t0 = None
+
+    class _StepScope:
+        __slots__ = ("tt",)
+
+        def __init__(self, tt):
+            self.tt = tt
+
+        def __enter__(self):
+            self.tt.step_begin()
+            return self.tt
+
+        def __exit__(self, *exc):
+            self.tt.step_end()
+            return False
+
+    def step(self) -> "_StepScope":
+        """Context manager timing one training step."""
+        return self._StepScope(self)
+
+    # -- Module.fit / BatchEndParam adapter ---------------------------
+    def batch_end(self, param=None) -> None:
+        """Batch-end callback: step time = time since the previous call
+        (the first call only arms the clock)."""
+        now = time.perf_counter()
+        if getattr(param, "nbatch", None) == 0:
+            # first batch of an epoch (reference BatchEndParam: nbatch
+            # resets per epoch): the gap since the previous call spans
+            # validation/checkpointing, not a training step — re-arm
+            self._last_batch_end = now
+            return
+        if self._last_batch_end is not None:
+            self._observe(now - self._last_batch_end)
+        self._last_batch_end = now
+
+    __call__ = batch_end
+
+    # -- internals ----------------------------------------------------
+    def _resolve_peak(self) -> Optional[float]:
+        if not self._peak_resolved:
+            from .callback import device_peak_flops
+
+            per_chip = device_peak_flops()
+            if per_chip:
+                if self._num_devices is None:
+                    import jax
+
+                    self._num_devices = jax.device_count()
+                self._peak = per_chip * self._num_devices
+            self._peak_resolved = True
+        return self._peak
+
+    def _observe(self, dt: float) -> None:
+        self.steps += 1
+        self.last_step_seconds = dt
+        self.last_examples_per_sec = self.batch_size / dt if dt > 0 else None
+        mfu = None
+        if self.flops_per_step and dt > 0:
+            peak = self._resolve_peak()
+            if peak:
+                mfu = 100.0 * self.flops_per_step / (dt * peak)
+        self.last_mfu_pct = mfu
+        record_training_step(dt, self.batch_size, mfu)

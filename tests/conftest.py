@@ -25,3 +25,22 @@ def seeded(request):
 
     mx.random.seed(seed)
     yield seed
+
+
+@pytest.fixture(autouse=True)
+def telemetry_leak_guard():
+    """State-leak guard (mirrors the engine-type restore discipline): a
+    test that enables mx.telemetry globally and forgets to disable it
+    would silently tax every later test's dispatch path — fail loudly
+    instead. Tests that WANT telemetry enable it and disable in teardown
+    (or monkeypatch mxnet_tpu.telemetry._state.enabled)."""
+    from mxnet_tpu import telemetry
+
+    was_enabled = telemetry.enabled()
+    yield
+    leaked = telemetry.enabled() and not was_enabled
+    if leaked:
+        telemetry.disable()
+        pytest.fail(
+            "test left mx.telemetry globally enabled; call "
+            "telemetry.disable() in teardown")

@@ -24,41 +24,15 @@ sys.path.insert(0, os.path.dirname(os.path.abspath(__file__)))
 
 
 def cost_of_step(step, batch):
-    """Mirror TrainStep.__call__'s argument assembly, lower the cached
-    executable, and return XLA's static cost analysis."""
-    import numpy as np
+    """XLA's static cost analysis of the step's compiled executable.
 
-    import jax
-    from mxnet_tpu import random_state
-    from mxnet_tpu.parallel.step import _as_tuple
+    The accounting itself lives in ``mxnet_tpu.telemetry.xla_cost_analysis``
+    so ``TrainingTelemetry`` reports the same per-step FLOP number this
+    tool prints.
+    """
+    from mxnet_tpu.telemetry import xla_cost_analysis
 
-    loss, _ = step(*batch)
-    loss.asnumpy()
-    data_tuple = _as_tuple(batch[0])
-    label_tuple = _as_tuple(batch[1]) if len(batch) > 1 else ()
-    entry = next(iter(step._cache.values()))
-    jitted = entry["jitted"]
-    optimizer = step.optimizer
-    t = np.int32(optimizer.num_update)
-    lr = np.float32(optimizer.learning_rate)
-    rng = random_state.get_state_key()
-    param_vals = tuple(p.data().data for p in step._params)
-    state_vals = tuple(s.data for s in step._state_leaf_nds)
-    batch_vals = [jax.device_put(v.data, sh)
-                  for v, sh in zip(tuple(data_tuple) + tuple(label_tuple),
-                                   entry["batch_sh"])]
-    from mxnet_tpu.base import execution_platform
-    from mxnet_tpu.parallel.mesh import use_mesh
-
-    with execution_platform(step.mesh.devices.flat[0].platform), \
-            use_mesh(step.mesh):
-        lowered = jitted.lower(param_vals, state_vals, t, lr, rng,
-                               *batch_vals)
-        compiled = lowered.compile()
-    ca = compiled.cost_analysis()
-    if isinstance(ca, (list, tuple)):
-        ca = ca[0]
-    return ca
+    return xla_cost_analysis(step, batch)
 
 
 def main():

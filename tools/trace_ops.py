@@ -3,6 +3,9 @@
 Usage:
   python tools/trace_ops.py bert   # trace bench_bert's TrainStep
   python tools/trace_ops.py resnet # trace bench.py's TrainStep
+  python tools/trace_ops.py bert 40 --telemetry-out /tmp/telemetry.json
+                                   # also dump an mx.telemetry snapshot
+                                   # (op mix, jit-cache hit/miss)
 
 Captures a few steps under jax.profiler.trace, parses the perfetto
 trace.json.gz, and prints device ops aggregated by fusion-name prefix,
@@ -154,9 +157,17 @@ def classify(name):
 
 
 def main():
-    which = sys.argv[1] if len(sys.argv) > 1 else "bert"
-    topn = int(sys.argv[2]) if len(sys.argv) > 2 else 40
+    from mxnet_tpu.telemetry import pop_telemetry_out_flag
+
+    argv, telemetry_out = pop_telemetry_out_flag(sys.argv[1:])
+    which = argv[0] if argv else "bert"
+    topn = int(argv[1]) if len(argv) > 1 else 40
     import jax
+
+    if telemetry_out:
+        from mxnet_tpu import telemetry
+
+        telemetry.enable()
 
     step, batch = {"bert": build_bert_step, "resnet": build_resnet_step,
                    "llama": build_llama_step}[which]()
@@ -217,6 +228,11 @@ def main():
     for name, t in per_op.most_common(topn):
         print(f"  {t / nsteps:8.3f}  {name[:110]}")
     print("trace dir:", tdir)
+    if telemetry_out:
+        from mxnet_tpu import telemetry
+
+        telemetry.write_snapshot(telemetry_out)
+        print("telemetry snapshot:", telemetry_out)
     return 0
 
 
