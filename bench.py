@@ -4,9 +4,11 @@ Prints a JSON line {"metric", "value", "unit", "vs_baseline", ...extras}
 after EVERY completed stage (flushed), monotonically enriched:
 
     stage 1  ResNet-50 synthetic   -> line 1 (the required contract keys)
-    stage 2  BERT-base subprocess  -> line 2 (adds bert_*)
-    stage 3  Llama proxy subprocess-> line 3 (adds llama_proxy_*)
-    stage 4  ResNet-50 real-data   -> line 4 (adds real_data_*)
+    stage 2  eager-vs-bulk chain   -> line 2 (adds bulk_* — dispatch
+             microbench of engine.bulk fused segments; cheap, runs first)
+    stage 3  BERT-base subprocess  -> line 3 (adds bert_*)
+    stage 4  Llama proxy subprocess-> line 4 (adds llama_proxy_*)
+    stage 5  ResNet-50 real-data   -> line 5 (adds real_data_*)
 
     Stages are ordered by information value (BASELINE.json tracks resnet,
     bert, llama MFU; real-data measures the host pipeline on a 1-core
@@ -38,8 +40,8 @@ bandwidth (~50 MB/s) would otherwise dominate and measure the tunnel, not
 the framework.
 
 Env knobs: BENCH_BUDGET_S (float, default 1800), BENCH_SKIP_REALDATA,
-BENCH_SKIP_BERT, BENCH_SKIP_LLAMA, BENCH_BERT_TIMEOUT_S,
-BENCH_LLAMA_TIMEOUT_S.
+BENCH_SKIP_BERT, BENCH_SKIP_LLAMA, BENCH_SKIP_BULK,
+BENCH_BERT_TIMEOUT_S, BENCH_LLAMA_TIMEOUT_S.
 """
 from __future__ import annotations
 
@@ -131,6 +133,16 @@ def main():
     # MXNET_TELEMETRY_OUT (see _run_sub)
     _write_telemetry(telemetry_out)
 
+    if _remaining_s() > 30:
+        try:
+            record.update(_bulk_extra())
+        except Exception as e:
+            record["bulk_error"] = repr(e)[:200]
+    else:
+        record["bulk_skipped"] = "budget"
+    _emit(record)
+    _write_telemetry(telemetry_out)
+
     # release this process's step/model buffers before the BERT/Llama
     # subprocesses run — the chip's HBM is shared with children, and the
     # resident ResNet state otherwise costs them batch-size headroom
@@ -191,6 +203,97 @@ def _make_resnet_batch(batch):
         .astype("bfloat16")
     y = mx.nd.array(rs.randint(0, 1000, (batch,)).astype(np.float32))
     return x, y
+
+
+def _bulk_extra(chain_len=64, reps=10):
+    """Eager-vs-bulk op-chain microbench (engine.bulk fused segments).
+
+    The number the bulking work exists to move: per-op host dispatch time
+    of an imperative elementwise chain, eager (one single-op jit dispatch
+    per op) vs inside ``engine.bulk`` (whole chain = ONE fused XLA
+    dispatch). Also reports the XLA-dispatch reduction and the
+    fused-segment cache hit rate over the timed reps — steady state
+    should be all hits (CachedOp-style signature reuse). Opt out with
+    BENCH_SKIP_BULK=1.
+    """
+    if os.environ.get("BENCH_SKIP_BULK"):
+        return {}
+    import mxnet_tpu as mx
+    from mxnet_tpu import engine, telemetry
+
+    n = chain_len
+    x = mx.nd.array(
+        np.random.RandomState(0).rand(256, 256).astype(np.float32))
+
+    def chain(v):
+        for _ in range(n // 2):
+            v = v * 1.01 + 0.01  # n//2 muls + n//2 adds = n ops
+        return v
+
+    def dispatches():
+        fam = telemetry.snapshot()["metrics"].get(
+            "mxnet_xla_dispatch_total")
+        return sum(s["value"] for s in fam["samples"]) if fam else 0.0
+
+    def fused_cache():
+        fam = telemetry.snapshot()["metrics"].get("mxnet_jit_cache_total")
+        hits = misses = 0.0
+        for s in (fam["samples"] if fam else ()):
+            if s["labels"].get("cache") == "fused_segment":
+                if s["labels"].get("result") == "hit":
+                    hits = s["value"]
+                else:
+                    misses = s["value"]
+        return hits, misses
+
+    # counters are read as before/after deltas so a --telemetry-out run's
+    # accumulated registry is never reset mid-chain
+    was_enabled = telemetry.enabled()
+    telemetry.enable()
+    try:
+        # warm both paths (per-op jit cache / fused-segment compile)
+        chain(x).wait_to_read()
+        with engine.bulk(n):
+            out_w = chain(x)
+        out_w.wait_to_read()
+
+        d0 = dispatches()
+        t0 = time.perf_counter()
+        for _ in range(reps):
+            out_e = chain(x)
+        out_e.wait_to_read()
+        eager_s = time.perf_counter() - t0
+        eager_disp = dispatches() - d0
+
+        h0, m0 = fused_cache()
+        d0 = dispatches()
+        t0 = time.perf_counter()
+        for _ in range(reps):
+            with engine.bulk(n):
+                out_b = chain(x)
+            out_b.wait_to_read()
+        bulk_s = time.perf_counter() - t0
+        bulk_disp = dispatches() - d0
+        h1, m1 = fused_cache()
+    finally:
+        if not was_enabled:
+            telemetry.disable()
+
+    total_ops = n * reps
+    hit, mis = h1 - h0, m1 - m0
+    return {
+        "bulk_chain_ops": n,
+        "bulk_eager_dispatch_us_per_op": round(eager_s / total_ops * 1e6, 2),
+        "bulk_fused_dispatch_us_per_op": round(bulk_s / total_ops * 1e6, 2),
+        "bulk_speedup_vs_eager": round(eager_s / bulk_s, 3),
+        "bulk_xla_dispatch_reduction": round(eager_disp / max(bulk_disp, 1.0), 1),
+        "bulk_fused_cache_hit_rate": round(hit / max(hit + mis, 1.0), 4),
+        # rtol 1e-5: XLA contracts mul+add to FMA inside the fused module
+        # (one rounding instead of two) — same class of difference as any
+        # jit-vs-op-by-op comparison
+        "bulk_allclose_eager": bool(np.allclose(out_b.asnumpy(),
+                                                out_e.asnumpy(), rtol=1e-5)),
+    }
 
 
 def _real_data_extra(batch, steps=10, img_size=224, n_images=2048):

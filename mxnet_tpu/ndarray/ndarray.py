@@ -83,14 +83,37 @@ class NDArray:
     # ------------------------------------------------------------------
     @property
     def data(self):
-        """The underlying jax.Array (recomputed for stale views)."""
+        """The underlying jax.Array (recomputed for stale views).
+
+        A sync point for bulked execution: when the payload is a pending
+        bulk-segment output, reading it flushes the owning segment
+        (reference: ThreadedVar WaitToRead), so ``asnumpy``/
+        ``wait_to_read``/``item``/printing all materialize for free.
+        """
         if self._base is not None:
             if self._cached_version != self._base._version or self._data is None:
                 self._data = self._view_read(self._base.data)
                 self._cached_version = self._base._version
-        if self._data is None:
+        d = self._data
+        if type(d) is engine.PendingValue:
+            d = engine.concretize(d)
+            self._data = d
+        if d is None:
             raise MXNetError("NDArray payload not yet materialized")
-        return self._data
+        return d
+
+    def _payload(self):
+        """Payload for op dispatch: the raw ``engine.PendingValue`` while
+        this array is an unflushed bulk-segment output — keeping chains
+        deferred — else the concrete jax.Array (``.data``)."""
+        d = self._data
+        if self._base is None and type(d) is engine.PendingValue:
+            c = d._concrete
+            if c is None:
+                return d
+            self._data = c
+            return c
+        return self.data
 
     def _set_data(self, new_jax) -> None:
         """Functionally replace the payload (an in-place write in API terms)."""
@@ -572,7 +595,12 @@ class NDArray:
             out = self._binop(other, opname, scalar_opname)
         if out is NotImplemented:
             return out
-        self._set_data(out.data.astype(self.data.dtype))
+        if self._base is None and self.dtype == out.dtype:
+            # same-dtype in-place update on a non-view: adopt the (possibly
+            # still pending) payload so `x += y` loops stay bulked
+            self._set_data(out._payload())
+        else:
+            self._set_data(out.data.astype(self.data.dtype))
         return self
 
     def _check_inplace_during_record(self):
@@ -695,12 +723,27 @@ def imperative_invoke(opdef, tensor_args, attrs, out=None, ctx=None,
     if ctx is None:
         ctx = current_context()
 
+    recording = autograd.is_recording() and (force_record or any(
+        isinstance(a, NDArray) and autograd.is_on_tape(a) for a in tensor_args
+    ))
+    if recording:
+        # autograd recording is non-recordable for bulking (flush trigger
+        # c): the vjp trace below must see concrete arrays, and tape
+        # ordering must match execution order
+        scope = engine.current_bulk_scope()
+        if scope is not None:
+            scope.flush("unrecordable")
+    # the eager OpDef path forwards raw pending payloads so op chains stay
+    # deferred inside a bulk scope; the vjp/lambda paths call opdef.fn
+    # directly and need concrete jax.Arrays
+    defer_ok = not recording and isinstance(opdef, OpDef)
+
     vals = []
     for a in tensor_args:
         if a is None:
             vals.append(None)
         elif isinstance(a, NDArray):
-            vals.append(a.data)
+            vals.append(a._payload() if defer_ok else a.data)
         elif isinstance(a, numeric_types):
             vals.append(a)
         else:
@@ -712,10 +755,6 @@ def imperative_invoke(opdef, tensor_args, attrs, out=None, ctx=None,
     wants_rng = opdef.needs_rng and (
         opdef.rng_gate is None or opdef.rng_gate(attrs))
     rng = random_state.next_key() if wants_rng else None
-
-    recording = autograd.is_recording() and (force_record or any(
-        isinstance(a, NDArray) and autograd.is_on_tape(a) for a in tensor_args
-    ))
 
     if recording:
         fixed_attrs = dict(attrs)
@@ -784,6 +823,12 @@ def imperative_invoke(opdef, tensor_args, attrs, out=None, ctx=None,
     if out is not None:
         outs = out if isinstance(out, (list, tuple)) else [out]
         for o_dst, o_src in zip(outs, outputs):
+            if o_dst._base is None and o_dst.dtype == o_src.dtype:
+                # same-dtype write into a non-view: hand over the payload
+                # as-is (possibly still pending) so `out=` chains — the
+                # optimizer-update pattern — stay bulked
+                o_dst._set_data(o_src._payload())
+                continue
             o_dst._set_data(o_src.data.astype(o_dst.data.dtype)
                             if o_dst.data.dtype != o_src.data.dtype else o_src.data)
         return out

@@ -24,17 +24,19 @@ keyword(-only) parameters with defaults. The wrapper generators use
 """
 from __future__ import annotations
 
+import collections
 import functools
 import inspect
 import threading
 import time
 from typing import Callable, Dict, List, NamedTuple, Optional, Sequence
 
-from .. import telemetry
+from .. import engine, telemetry
 from ..telemetry import _state as _telemetry_state
 
 __all__ = ["OpDef", "AttrSpec", "attr", "register", "get_op", "list_ops",
-           "alias", "validate_attrs"]
+           "alias", "validate_attrs", "execute_segment",
+           "fused_segment_cache_clear"]
 
 
 class AttrSpec(NamedTuple):
@@ -380,6 +382,27 @@ def _eager_call(opdef: OpDef, tensors, attrs, rng=None):
 
     if opdef.attr_specs:
         validate_attrs(opdef, attrs)
+    scope = engine.current_bulk_scope()
+    if scope is not None and not engine.is_naive():
+        res = _bulk_record(scope, opdef, tensors, attrs, rng)
+        if res is _FLUSH_AND_RUN:
+            # non-recordable op (eager-only / unhashable attrs / sparse-
+            # grad / tracer input): flush trigger (c), then run eagerly
+            scope.flush("unrecordable")
+            tensors = [engine.concretize(t) for t in tensors]
+        elif res is not _RUN_EAGER:
+            return res
+    else:
+        # no recorder on THIS thread, but an input may be the pending
+        # output of another thread's open segment (or of a scope running
+        # under NaiveEngine) — materialize before eager dispatch. Scan
+        # first: the common no-bulk case must not pay a list rebuild
+        for t in tensors:
+            if type(t) is engine.PendingValue:
+                tensors = [engine.concretize(v)
+                           if type(v) is engine.PendingValue else v
+                           for v in tensors]
+                break
     tensors = _harmonize_devices(tensors)
     attr_items = tuple(sorted(attrs.items(), key=lambda kv: kv[0]))
     try:
@@ -400,6 +423,8 @@ def _eager_call(opdef: OpDef, tensors, attrs, rng=None):
     platform = current_execution_platform(sample)
     with execution_platform(platform):
         if uncached:
+            if _telemetry_state.enabled:
+                telemetry.record_xla_dispatch("eager_uncached")
             if rng is not None:
                 return opdef.fn(rng, *tensors, **attrs)
             if opdef.needs_rng:
@@ -410,9 +435,298 @@ def _eager_call(opdef: OpDef, tensors, attrs, rng=None):
             fn = _cached_call(opdef.name, attr_items, len(tensors),
                               rng is not None, platform)
             telemetry.record_cache("eager_op", hit=not _cache_probe.miss)
+            telemetry.record_xla_dispatch("eager_op")
         else:
             fn = _cached_call(opdef.name, attr_items, len(tensors),
                               rng is not None, platform)
         if rng is not None:
             return fn(rng, *tensors)
         return fn(*tensors)
+
+
+# ---------------------------------------------------------------------------
+# Bulked execution: record-vs-execute fork + fused-segment cache.
+#
+# Reference analogue: CachedOp — MXNet wins its imperative perf back by
+# bulking op sequences into single engine pushes keyed by a graph signature.
+# Here an ``engine.bulk`` scope records ops into an ``engine.Segment``; the
+# segment lowers to ONE jitted function compiled through ``_FUSED_CACHE``,
+# keyed by the full (op, attrs, input shape/dtype, wiring, live-output)
+# sequence, so a repeated loop body replays a compiled executable with zero
+# retracing. See engine.py for the scope/flush machinery.
+# ---------------------------------------------------------------------------
+
+_RUN_EAGER = object()       # don't record; no flush needed (independent op)
+_FLUSH_AND_RUN = object()   # non-recordable: flush segment, then run eagerly
+
+_jax_cached = None
+
+
+def _jax_mod():
+    """Cached jax module for the per-recorded-op path (this module keeps
+    jax imports lazy, but a sys.modules lookup per recorded op is the same
+    per-call overhead class the engine hot-path hoists removed)."""
+    global _jax_cached
+    if _jax_cached is None:
+        import jax
+
+        _jax_cached = jax
+    return _jax_cached
+
+
+def _bulk_record(scope, opdef: OpDef, tensors, attrs, rng):
+    """Try to append this op to the thread's open bulk segment.
+
+    Returns the op's result (PendingValue(s)) when recorded, or one of the
+    ``_RUN_EAGER`` / ``_FLUSH_AND_RUN`` sentinels when the op must execute
+    eagerly.
+    """
+    _jax = _jax_mod()
+
+    if opdef.eager_only:
+        return _FLUSH_AND_RUN
+    attr_items = tuple(sorted(attrs.items(), key=lambda kv: kv[0]))
+    try:
+        hash(attr_items)
+    except TypeError:  # unhashable attr (e.g. nested list) — not keyable
+        return _FLUSH_AND_RUN
+    if attrs.get("_sparse_uid") is not None:
+        # row-sparse-grad side channel logs backward tracers that must not
+        # cross a fused-segment jit boundary (same rule as the per-op cache)
+        from ..parallel.sparse_grad import sparse_grad_active
+
+        if sparse_grad_active():
+            return _FLUSH_AND_RUN
+
+    # classify inputs; rng (a concrete PRNG key) is a leading runtime arg
+    # but NOT an array input for the creation-op test below — a zero-tensor
+    # random sampler is a creation op and must take the _RUN_EAGER path
+    raw_inputs = list(tensors)
+    n_prefix = 0
+    if rng is not None:
+        raw_inputs.insert(0, rng)
+        n_prefix = 1
+    elif opdef.needs_rng:  # gated-off rng: fn still expects the slot
+        raw_inputs.insert(0, None)
+        n_prefix = 1
+    staged = []        # ("r", pv) | ("a", value) | ("s", literal)
+    aval_key = []      # hashable per-input descriptors for shape inference
+    seg = scope.segment
+    has_array_input = False
+    for i, t in enumerate(raw_inputs):
+        if type(t) is engine.PendingValue:
+            c = t._concrete
+            if c is not None:
+                t = c  # already flushed: plain runtime arg
+            elif seg is not None and t.segment is seg:
+                has_array_input = True
+                staged.append(("r", t))
+                aval_key.append(("v", t.aval.shape, t.aval.dtype))
+                continue
+            else:
+                # pending output of ANOTHER segment (cross-thread handoff
+                # or pre-nesting leftovers): materialize it
+                t = t.force()
+        if isinstance(t, _jax.core.Tracer):
+            # already inside someone else's trace — recording would leak
+            # the tracer into the fused jit's scope
+            return _FLUSH_AND_RUN
+        if t is None or isinstance(t, (bool, int, float, complex, str)):
+            staged.append(("s", t))
+            aval_key.append(("s", t))
+            continue
+        if not hasattr(t, "shape"):
+            return _FLUSH_AND_RUN
+        sh = getattr(t, "sharding", None)
+        if sh is not None and getattr(sh, "num_devices", 1) > 1:
+            # multi-device operands keep the eager path (its device
+            # harmonization logic); bulking targets single-device chains
+            return _FLUSH_AND_RUN
+        if i >= n_prefix:
+            has_array_input = True
+        staged.append(("a", t))
+        aval_key.append(("v", tuple(t.shape), t.dtype))
+    if not has_array_input:
+        # creation-style op (zeros/arange/...): no dataflow into the
+        # segment, so nothing to defer — run eagerly WITHOUT flushing
+        return _RUN_EAGER
+
+    if seg is not None and not seg.flushed:
+        platform = seg.platform
+    else:
+        from ..base import current_execution_platform
+
+        sample = next((t for k, t in staged
+                       if k == "a" and hasattr(t, "devices")), None)
+        platform = current_execution_platform(sample)
+
+    try:
+        out_avals, out_is_seq = _segment_avals(
+            opdef.name, attr_items, tuple(aval_key), platform)
+    except Exception:
+        # abstract eval failed (value-dependent op, bad shapes, ...): the
+        # eager path reproduces the exact per-op error at the right line
+        return _FLUSH_AND_RUN
+
+    seg = scope.open_segment(platform)
+    with seg._lock:
+        if seg.flushed:  # another thread forced a flush mid-record
+            seg = scope.open_segment(platform)
+        node_index = len(seg.nodes)
+        input_specs = []
+        sig_inputs = []
+        for kind, v in staged:
+            if kind == "r" and (v.segment is not seg
+                                or v._concrete is not None):
+                # the segment was flushed (and reopened) between staging
+                # and commit — the dependency is concrete now
+                kind, v = "a", (v._concrete if v._concrete is not None
+                                else v.force())
+            if kind == "r":
+                spec = ("r", v.node_index, v.out_index)
+                input_specs.append(spec)
+                sig_inputs.append(spec)
+            elif kind == "a":
+                idx = seg.add_const(v)
+                input_specs.append(("a", idx))
+                sig_inputs.append(("a", idx, tuple(v.shape), str(v.dtype)))
+            else:
+                input_specs.append(("s", v))
+                sig_inputs.append(("s", v))
+        sig = (opdef.name, attr_items, tuple(sig_inputs))
+        node = engine._SegmentNode(
+            opdef.name, opdef.fn, attr_items, tuple(input_specs),
+            len(out_avals), out_is_seq, sig)
+        seg.nodes.append(node)
+        pvs = [engine.PendingValue(seg, node_index, oi,
+                                   _jax.ShapeDtypeStruct(shape, dtype))
+               for oi, (shape, dtype) in enumerate(out_avals)]
+        seg.out_refs.append([engine.weakref.ref(pv) for pv in pvs])
+        full = len(seg.nodes) >= scope.max_size
+    if full:
+        seg.flush("size")  # trigger (b): segment reached bulk(size)
+    if out_is_seq:
+        return tuple(pvs)
+    return pvs[0]
+
+
+@functools.lru_cache(maxsize=8192)
+def _segment_avals(opname: str, attr_items: tuple, aval_key: tuple,
+                   platform: str):
+    """Output (shape, dtype) sequence of one op via ``jax.eval_shape`` —
+    cached so steady-state recording never re-traces. ``aval_key`` entries:
+    ``("v", shape, dtype)`` for runtime args, ``("s", literal)`` for
+    static scalars/None."""
+    import jax
+
+    from ..base import execution_platform
+
+    opdef = _REGISTRY[opname]
+    attrs = dict(attr_items)
+    avals = [jax.ShapeDtypeStruct(k[1], k[2]) for k in aval_key
+             if k[0] == "v"]
+
+    def pure(*arrs):
+        it = iter(arrs)
+        args = [next(it) if k[0] == "v" else k[1] for k in aval_key]
+        return opdef.fn(*args, **attrs)
+
+    with execution_platform(platform):
+        out = jax.eval_shape(pure, *avals)
+    out_is_seq = isinstance(out, (tuple, list))
+    outs = tuple(out) if out_is_seq else (out,)
+    return tuple((tuple(o.shape), o.dtype) for o in outs), out_is_seq
+
+
+# signature -> jitted fused function; LRU-bounded. The signature encodes
+# the complete segment semantics (per-node op/attrs/static-literals/wiring,
+# runtime-arg shapes+dtypes, live-output mask, platform), so a hit replays
+# a compiled executable for a structurally identical segment.
+_FUSED_CACHE: "collections.OrderedDict" = collections.OrderedDict()
+_FUSED_CACHE_MAX = 1024
+_fused_lock = threading.Lock()
+
+
+def fused_segment_cache_clear() -> None:
+    with _fused_lock:
+        _FUSED_CACHE.clear()
+
+
+def _build_fused(nodes, live_mask):
+    """Lower a recorded segment into one pure function and jit it. The
+    closure captures node structure only — everything it captures is part
+    of the cache signature, so reuse across segments is sound."""
+    import jax
+
+    from ..base import MXNetError
+
+    def fused_segment(*consts):
+        env = {}
+        for ni, node in enumerate(nodes):
+            args = []
+            for spec in node.input_specs:
+                kind = spec[0]
+                if kind == "r":
+                    args.append(env[(spec[1], spec[2])])
+                elif kind == "a":
+                    args.append(consts[spec[1]])
+                else:
+                    args.append(spec[1])
+            try:
+                out = node.fn(*args, **dict(node.attr_items))
+            except Exception as e:
+                # flush-time errors must name the originating op — the
+                # user's call site is long gone by now
+                raise MXNetError(
+                    f"error while executing bulked segment at op #{ni} "
+                    f"({node.name!r}): {e}") from e
+            outs = out if isinstance(out, (tuple, list)) else (out,)
+            for oi, o in enumerate(outs):
+                env[(ni, oi)] = o
+        return tuple(env[k] for k in live_mask)
+
+    fused_segment.__name__ = "fused_segment"
+    return jax.jit(fused_segment)
+
+
+def execute_segment(seg, reason: str) -> None:
+    """Flush one segment: one fused XLA dispatch through the signature-
+    keyed cache; resolve live PendingValues. Called (exactly once per
+    segment) by ``engine.Segment.flush`` with the segment lock held."""
+    from ..base import execution_platform
+
+    t0 = time.perf_counter()
+    live = []
+    for refs in seg.out_refs:
+        for ref in refs:
+            pv = ref()
+            if pv is not None:
+                live.append(pv)
+    live_mask = tuple((pv.node_index, pv.out_index) for pv in live)
+    sig = (tuple(n.sig for n in seg.nodes), live_mask, seg.platform)
+    with _fused_lock:
+        jitted = _FUSED_CACHE.get(sig)
+        hit = jitted is not None
+        if hit:
+            _FUSED_CACHE.move_to_end(sig)
+    if not hit:
+        jitted = _build_fused(tuple(seg.nodes), live_mask)
+        with _fused_lock:
+            _FUSED_CACHE[sig] = jitted
+            while len(_FUSED_CACHE) > _FUSED_CACHE_MAX:
+                _FUSED_CACHE.popitem(last=False)
+    if _telemetry_state.enabled:
+        telemetry.record_cache("fused_segment", hit=hit)
+    with execution_platform(seg.platform):
+        outs = jitted(*seg.consts)
+    if _telemetry_state.enabled:
+        telemetry.record_xla_dispatch("fused_segment")
+        telemetry.record_bulk_flush(reason, len(seg.nodes),
+                                    time.perf_counter() - t0)
+    for pv, val in zip(live, outs):
+        pv._concrete = val
+        engine.track(val)
+    from .. import profiler
+
+    if profiler.state() == "run":
+        profiler.record_span("Bulk::flush", time.perf_counter() - t0)

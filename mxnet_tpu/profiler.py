@@ -24,7 +24,8 @@ from typing import Dict, List, Optional
 
 __all__ = [
     "set_config", "set_state", "start", "stop", "pause", "resume", "dumps",
-    "dump", "state", "Task", "Frame", "Event", "Counter", "Marker",
+    "dump", "state", "record_span", "Task", "Frame", "Event", "Counter",
+    "Marker",
 ]
 
 _lock = threading.Lock()
@@ -216,6 +217,23 @@ def _dumps_chrome_trace(reset=False):
     if _trace_dir:
         doc["otherData"]["xprof_trace_dir"] = _trace_dir
     return json.dumps(doc)
+
+
+def record_span(name: str, seconds: float) -> None:
+    """Record one already-measured span into the aggregate table.
+
+    For runtime-internal spans whose start/stop straddle internal locks
+    (e.g. ``Bulk::flush`` — the engine measures a flush while holding the
+    segment lock, so a scoped ``Event`` would be misleading to users who
+    ``Event(...)`` around their own code). Shows in ``dumps()`` exactly
+    like a ``_Scope``-recorded span.
+    """
+    with _lock:
+        rec = _spans[name]
+        rec[0] += 1
+        rec[1] += seconds
+        rec[2] = min(rec[2], seconds)
+        rec[3] = max(rec[3], seconds)
 
 
 def dump(finished=True, profile_process="worker"):

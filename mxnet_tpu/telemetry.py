@@ -48,10 +48,10 @@ __all__ = [
     "dumps", "prom_text", "chrome_counter_events", "snapshot",
     "record_op_dispatch", "record_cache", "record_kv",
     "record_engine_wait", "set_live_arrays", "record_live_evictions",
-    "record_training_step",
+    "record_training_step", "record_xla_dispatch", "record_bulk_flush",
     "TrainingTelemetry", "xla_cost_analysis",
     "pop_telemetry_out_flag", "write_snapshot",
-    "LATENCY_BUCKETS", "STEP_BUCKETS",
+    "LATENCY_BUCKETS", "STEP_BUCKETS", "SEGMENT_BUCKETS",
 ]
 
 
@@ -100,6 +100,9 @@ LATENCY_BUCKETS: Tuple[float, ...] = (
 # training steps: 1 ms .. 100 s
 STEP_BUCKETS: Tuple[float, ...] = (
     1e-3, 3e-3, 10e-3, 30e-3, 100e-3, 300e-3, 1.0, 3.0, 10.0, 30.0, 100.0)
+# bulk-segment lengths (op counts): powers of two up to the practical cap
+SEGMENT_BUCKETS: Tuple[float, ...] = (
+    1, 2, 4, 8, 16, 32, 64, 128, 256, 512, 1024)
 
 
 class _Counter:
@@ -482,6 +485,37 @@ def record_live_evictions(n: int) -> None:
     counter("mxnet_engine_live_evictions_total",
             "Still-live refs evicted from the engine registry by "
             "overflow compaction.").inc(n)
+
+
+def record_xla_dispatch(kind: str) -> None:
+    """One host→XLA dispatch (a compiled-callable invocation). ``kind``:
+    ``eager_op`` (cached per-op executable), ``eager_uncached`` (tracer/
+    fallback path), ``fused_segment`` (one bulked segment). The eager-vs-
+    bulk dispatch-reduction ratio in BENCH rounds is computed from this."""
+    if not _state.enabled:
+        return
+    counter("mxnet_xla_dispatch_total",
+            "Host-side XLA dispatches by kind (a fused bulk segment "
+            "counts once however many ops it contains).",
+            ("kind",)).labels(kind).inc()
+
+
+def record_bulk_flush(reason: str, n_ops: int, seconds: float) -> None:
+    """One bulk-segment flush: why it flushed, how many ops it fused,
+    and host-side flush latency (cache lookup + dispatch)."""
+    if not _state.enabled:
+        return
+    counter("mxnet_bulk_flush_total",
+            "Bulk segment flushes by trigger (sync/size/unrecordable/"
+            "scope_exit/nested_scope).", ("reason",)).labels(reason).inc()
+    counter("mxnet_bulk_ops_total",
+            "Imperative ops executed via fused bulk segments.").inc(n_ops)
+    histogram("mxnet_bulk_segment_ops",
+              "Ops fused per flushed bulk segment.",
+              buckets=SEGMENT_BUCKETS).observe(n_ops)
+    histogram("mxnet_bulk_flush_seconds",
+              "Host-side bulk flush latency (fused-cache lookup + "
+              "dispatch).").observe(seconds)
 
 
 def record_training_step(seconds: float, examples: float,

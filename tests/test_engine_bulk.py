@@ -1,0 +1,408 @@
+"""Bulked (lazy) imperative execution: ``mx.engine.bulk`` (reference:
+``python/mxnet/engine.py :: bulk`` + ThreadedEngine op bulking).
+
+Covers: eager-equivalence over mixed op chains, every flush trigger
+(sync point, size cap, non-recordable op, scope exit, nested scope),
+fused-segment cache behaviour, NaiveEngine interplay, flush-time
+exception attribution, and thread isolation of the recorder.
+"""
+import threading
+
+import numpy as onp
+import pytest
+
+import mxnet_tpu as mx
+from mxnet_tpu import engine, telemetry
+from mxnet_tpu.ops import registry
+
+
+@pytest.fixture
+def tel():
+    """Telemetry enabled for the test, cleanly reset around it."""
+    telemetry.enable()
+    telemetry.reset()
+    yield telemetry
+    telemetry.disable()
+    telemetry.reset()
+
+
+def _counter(name, **labels):
+    """Sum of a counter family's samples matching the given labels."""
+    fam = telemetry.snapshot()["metrics"].get(name)
+    if fam is None:
+        return 0.0
+    total = 0.0
+    for s in fam["samples"]:
+        if all(s["labels"].get(k) == v for k, v in labels.items()):
+            total += s["value"]
+    return total
+
+
+def _chain(x, y):
+    """A mixed op chain: elementwise, scalar, reduction-with-keepdims,
+    matmul, transpose — enough variety to exercise wiring and avals."""
+    z = (x + y) * 2.0 - y / 3.0
+    z = z.exp().log() + z.square().sqrt()
+    m = z.mean(axis=1, keepdims=True)
+    z = z - m
+    w = z.dot(z, transpose_b=True)
+    return (w + w.T).sum(axis=0)
+
+
+class TestEagerEquivalence:
+    def test_mixed_chain_matches_eager(self):
+        x = mx.nd.array(onp.random.rand(5, 7).astype(onp.float32) + 0.5)
+        y = mx.nd.array(onp.random.rand(5, 7).astype(onp.float32) + 0.5)
+        ref = _chain(x, y).asnumpy()
+        with engine.bulk(64):
+            out = _chain(x, y)
+            assert engine.is_pending(out._data)
+            got = out.asnumpy()
+        onp.testing.assert_allclose(got, ref, rtol=1e-6)
+
+    def test_elementwise_chain_bit_identical(self):
+        x = mx.nd.array(onp.random.rand(4, 4).astype(onp.float32))
+        ref = x
+        for _ in range(16):
+            ref = ref * 1.5 + 0.25
+        ref = ref.asnumpy()
+        with engine.bulk(64):
+            z = x
+            for _ in range(16):
+                z = z * 1.5 + 0.25
+            got = z.asnumpy()
+        onp.testing.assert_array_equal(got, ref)
+
+    def test_creation_ops_run_eagerly_without_flushing(self, tel):
+        x = mx.nd.ones((3, 3))
+        with engine.bulk(64):
+            z = x + 1.0
+            w = mx.nd.zeros((3, 3))  # no dataflow into the segment
+            assert not engine.is_pending(w._data)
+            assert engine.is_pending(z._data)  # ...and no flush either
+            z = z + w
+            got = z.asnumpy()
+        onp.testing.assert_array_equal(got, onp.full((3, 3), 2.0))
+
+    def test_random_samplers_run_eagerly_in_bulk(self, tel):
+        # zero-tensor rng ops are creation ops: the leading PRNG-key arg
+        # must not make them recordable (they'd crash imperative_invoke's
+        # device_put creation branch with a PendingValue)
+        with engine.bulk(64):
+            r = mx.nd.random.uniform(shape=(4,))
+            assert not engine.is_pending(r._data)
+            z = r * 2.0  # ...but chains ON the sample do record
+            assert engine.is_pending(z._data)
+            got = z.asnumpy()
+        onp.testing.assert_array_equal(got, r.asnumpy() * 2.0)
+
+    def test_inplace_loop_stays_bulked(self, tel):
+        a = mx.nd.zeros((2, 2))
+        with engine.bulk(64):
+            for _ in range(10):
+                a += 1.0
+            got = a.asnumpy()
+        onp.testing.assert_array_equal(got, onp.full((2, 2), 10.0))
+        assert _counter("mxnet_xla_dispatch_total", kind="fused_segment") == 1
+
+    def test_out_kwarg_stays_bulked(self, tel):
+        x = mx.nd.ones((3,))
+        dst = mx.nd.zeros((3,))
+        with engine.bulk(64):
+            mx.nd.broadcast_add(x, x, out=dst)
+            mx.nd.broadcast_mul(dst, dst, out=dst)
+            got = dst.asnumpy()
+        onp.testing.assert_array_equal(got, onp.full((3,), 4.0))
+        assert _counter("mxnet_xla_dispatch_total", kind="fused_segment") == 1
+
+
+class TestFlushTriggers:
+    def test_sync_point_flushes(self, tel):
+        x = mx.nd.ones((2, 2))
+        with engine.bulk(64):
+            z = x * 3.0
+            z.asnumpy()  # sync point mid-scope
+            assert _counter("mxnet_bulk_flush_total", reason="sync") == 1
+            assert not engine.is_pending(z._data)
+
+    def test_wait_to_read_and_waitall_flush(self, tel):
+        x = mx.nd.ones((2, 2))
+        with engine.bulk(64):
+            z = x + 1.0
+            z.wait_to_read()
+            assert not engine.is_pending(z._data)
+            w = x + 2.0
+            mx.nd.waitall()
+            assert not engine.is_pending(w._data)
+        assert _counter("mxnet_bulk_flush_total", reason="sync") == 2
+
+    def test_repr_is_a_sync_point(self):
+        x = mx.nd.ones((2,))
+        with engine.bulk(64):
+            z = x + 1.0
+            assert "2x" not in repr(z)  # shape 2, just materialize
+            assert not engine.is_pending(z._data)
+
+    def test_size_cap_flushes(self, tel):
+        x = mx.nd.ones((2, 2))
+        with engine.bulk(4):
+            z = x
+            for _ in range(8):
+                z = z + 1.0
+            got = z.asnumpy()
+        onp.testing.assert_array_equal(got, onp.full((2, 2), 9.0))
+        assert _counter("mxnet_bulk_flush_total", reason="size") == 2
+
+    def test_eager_only_op_flushes_then_runs(self, tel):
+        data = mx.nd.array(onp.arange(6, dtype=onp.float32).reshape(3, 2))
+        mask = mx.nd.array(onp.array([1.0, 0.0, 1.0], dtype=onp.float32))
+        with engine.bulk(64):
+            z = data * 2.0
+            # boolean_mask is eager_only (dynamic output shape)
+            kept = mx.nd.contrib.boolean_mask(z, mask)
+            assert _counter("mxnet_bulk_flush_total",
+                            reason="unrecordable") == 1
+            got = kept.asnumpy()
+        onp.testing.assert_array_equal(
+            got, onp.array([[0.0, 2.0], [8.0, 10.0]], dtype=onp.float32))
+
+    def test_scope_exit_flushes(self, tel):
+        x = mx.nd.ones((2, 2))
+        with engine.bulk(64):
+            z = x * 5.0
+            assert engine.is_pending(z._data)
+        assert _counter("mxnet_bulk_flush_total", reason="scope_exit") == 1
+        onp.testing.assert_array_equal(z.asnumpy(), onp.full((2, 2), 5.0))
+
+    def test_autograd_recording_is_unrecordable(self, tel):
+        from mxnet_tpu import autograd
+
+        x = mx.nd.ones((2, 2))
+        x.attach_grad()
+        with engine.bulk(64):
+            pre = x * 2.0  # recorded into the segment
+            with autograd.record():
+                y = (x * x).sum()
+            y.backward()
+            assert _counter("mxnet_bulk_flush_total",
+                            reason="unrecordable") >= 1
+        onp.testing.assert_array_equal(x.grad.asnumpy(),
+                                       onp.full((2, 2), 2.0))
+        onp.testing.assert_array_equal(pre.asnumpy(), onp.full((2, 2), 2.0))
+
+
+class TestNestedScopes:
+    def test_nested_scope_flushes_outer_and_restores(self, tel):
+        x = mx.nd.ones((2, 2))
+        with engine.bulk(64):
+            a = x + 1.0
+            with engine.bulk(8):
+                assert _counter("mxnet_bulk_flush_total",
+                                reason="nested_scope") == 1
+                assert not engine.is_pending(a._data)
+                b = a * 2.0
+                assert engine.is_pending(b._data)
+            # inner exit flushed; outer scope active again
+            assert _counter("mxnet_bulk_flush_total",
+                            reason="scope_exit") == 1
+            assert not engine.is_pending(b._data)
+            c = b + 0.5
+            assert engine.is_pending(c._data)
+        onp.testing.assert_array_equal(c.asnumpy(), onp.full((2, 2), 4.5))
+
+    def test_size_validation(self):
+        for bad in (0, -3):
+            with pytest.raises(ValueError, match=">= 1"):
+                with engine.bulk(bad):
+                    pass
+        for bad in ("8", 2.0, True, None):
+            with pytest.raises(ValueError, match="int"):
+                with engine.bulk(bad):
+                    pass
+
+
+class TestFusedCache:
+    def test_structurally_identical_segments_hit(self, tel):
+        registry.fused_segment_cache_clear()
+        x = mx.nd.array(onp.random.rand(6, 6).astype(onp.float32))
+
+        def run():
+            with engine.bulk(64):
+                z = x
+                for _ in range(5):
+                    z = z * 1.1 + 0.1
+                return z.asnumpy()
+
+        r1, r2 = run(), run()
+        onp.testing.assert_array_equal(r1, r2)
+        assert _counter("mxnet_jit_cache_total",
+                        cache="fused_segment", result="miss") == 1
+        assert _counter("mxnet_jit_cache_total",
+                        cache="fused_segment", result="hit") == 1
+
+    def test_dispatch_reduction_on_long_chain(self, tel):
+        """Acceptance: a >=32-op chain bulked into >=4x fewer dispatches,
+        allclose to eager."""
+        x = mx.nd.array(onp.random.rand(8, 8).astype(onp.float32))
+
+        def chain(v):
+            for i in range(32):
+                v = v * 1.01 + 0.01
+            return v
+
+        ref = chain(x).asnumpy()
+        telemetry.reset()
+        eager_out = chain(x).asnumpy()
+        eager_n = (_counter("mxnet_xla_dispatch_total", kind="eager_op")
+                   + _counter("mxnet_xla_dispatch_total",
+                              kind="eager_uncached"))
+        telemetry.reset()
+        with engine.bulk(64):
+            bulk_out = chain(x).asnumpy()
+        bulk_n = (_counter("mxnet_xla_dispatch_total", kind="fused_segment")
+                  + _counter("mxnet_xla_dispatch_total", kind="eager_op")
+                  + _counter("mxnet_xla_dispatch_total",
+                             kind="eager_uncached"))
+        assert eager_n == 64  # 32 muls + 32 adds
+        assert bulk_n >= 1
+        assert eager_n / bulk_n >= 4.0
+        # rtol 1e-5: XLA may contract mul+add to FMA inside the fused
+        # module — one rounding instead of two per chain link
+        onp.testing.assert_allclose(bulk_out, ref, rtol=1e-5)
+        onp.testing.assert_allclose(bulk_out, eager_out, rtol=1e-5)
+
+
+class TestNaiveEngine:
+    def test_naive_engine_executes_immediately(self, tel):
+        engine.set_engine_type("NaiveEngine")
+        try:
+            x = mx.nd.ones((2, 2))
+            with engine.bulk(64):
+                z = x + 1.0
+                # NaiveEngine is fully synchronous: nothing is deferred
+                assert not engine.is_pending(z._data)
+            onp.testing.assert_array_equal(z.asnumpy(),
+                                           onp.full((2, 2), 2.0))
+            assert _counter("mxnet_xla_dispatch_total",
+                            kind="fused_segment") == 0
+        finally:
+            engine.set_engine_type("ThreadedEnginePerDevice")
+
+
+class TestExceptionPropagation:
+    def test_flush_error_names_originating_op(self):
+        from mxnet_tpu.base import MXNetError
+
+        registry.fused_segment_cache_clear()
+        x = mx.nd.array(onp.random.rand(3, 5).astype(onp.float32))
+        with engine.bulk(64):
+            z = x + 1.0
+            seg = engine.current_bulk_scope().segment
+            # simulate an op whose lowering fails only at flush time (e.g.
+            # a platform-gated kernel): poison the recorded node's fn
+            def boom(*a, **kw):
+                raise RuntimeError("lowering exploded")
+
+            seg.nodes[0] = engine._SegmentNode(
+                seg.nodes[0].name, boom, seg.nodes[0].attr_items,
+                seg.nodes[0].input_specs, seg.nodes[0].n_out,
+                seg.nodes[0].out_is_seq, seg.nodes[0].sig)
+            with pytest.raises(MXNetError, match=r"op #0.*_plus_scalar"):
+                z.asnumpy()
+
+    def test_failed_segment_rethrows_for_every_pending(self):
+        from mxnet_tpu.base import MXNetError
+
+        registry.fused_segment_cache_clear()
+        x = mx.nd.array(onp.random.rand(4, 9).astype(onp.float32))
+        with engine.bulk(64):
+            z1 = x + 1.0
+            z2 = z1 * 2.0
+            seg = engine.current_bulk_scope().segment
+
+            def boom(*a, **kw):
+                raise RuntimeError("lowering exploded")
+
+            seg.nodes[0] = engine._SegmentNode(
+                seg.nodes[0].name, boom, seg.nodes[0].attr_items,
+                seg.nodes[0].input_specs, seg.nodes[0].n_out,
+                seg.nodes[0].out_is_seq, seg.nodes[0].sig)
+            with pytest.raises(MXNetError, match="op #0"):
+                z1.asnumpy()
+            # the sibling pending re-raises the stored failure, not a
+            # generic engine-bug error (ThreadedVar ExceptionRef contract)
+            with pytest.raises(MXNetError, match="failed"):
+                z2.asnumpy()
+
+    def test_shape_errors_surface_eagerly_at_call_site(self):
+        # abstract eval fails at record time -> the op runs (and raises)
+        # eagerly, naming the real failure, not at some later flush
+        x = mx.nd.ones((2, 3))
+        y = mx.nd.ones((4, 5))
+        with engine.bulk(64):
+            with pytest.raises(Exception):
+                (x + 1.0).dot(y)
+
+
+class TestThreadIsolation:
+    def test_other_threads_execute_eagerly(self):
+        x = mx.nd.ones((2, 2))
+        results = {}
+
+        def worker():
+            w = x * 7.0
+            results["pending"] = engine.is_pending(w._data)
+            results["val"] = w.asnumpy()
+
+        with engine.bulk(64):
+            z = x + 1.0  # main thread records...
+            t = threading.Thread(target=worker)
+            t.start()
+            t.join()
+            assert engine.is_pending(z._data)  # ...and stays recorded
+        assert results["pending"] is False
+        onp.testing.assert_array_equal(results["val"],
+                                       onp.full((2, 2), 7.0))
+
+    def test_cross_thread_force_of_pending_value(self):
+        x = mx.nd.ones((2, 2))
+        results = {}
+        with engine.bulk(64):
+            z = x + 41.0
+            assert engine.is_pending(z._data)
+
+            def reader():
+                # a pending array handed across threads: reading it must
+                # flush the owning (other-thread) segment safely
+                results["val"] = z.asnumpy()
+
+            t = threading.Thread(target=reader)
+            t.start()
+            t.join()
+        onp.testing.assert_array_equal(results["val"],
+                                       onp.full((2, 2), 42.0))
+
+    def test_concurrent_scopes_are_independent(self, tel):
+        errs = []
+
+        def worker(seed):
+            try:
+                a = mx.nd.array(onp.full((2, 2), float(seed),
+                                         dtype=onp.float32))
+                with engine.bulk(16):
+                    z = a
+                    for _ in range(6):
+                        z = z + 1.0
+                    got = z.asnumpy()
+                onp.testing.assert_array_equal(
+                    got, onp.full((2, 2), float(seed) + 6.0))
+            except Exception as e:  # pragma: no cover
+                errs.append(e)
+
+        threads = [threading.Thread(target=worker, args=(i,))
+                   for i in range(4)]
+        for t in threads:
+            t.start()
+        for t in threads:
+            t.join()
+        assert not errs

@@ -138,6 +138,10 @@ def build_resnet_step():
 
 
 GROUPS = [
+    # first so ops dispatched via an engine.bulk fused segment (the jitted
+    # module is named "fused_segment", see ops/registry.py::_build_fused)
+    # are attributed to bulking rather than the generic fusion bucket
+    ("bulk_fused", r"fused_segment"),
     ("flash_fwd", r"flash|_fwd_kernel"),
     ("flash_bwd", r"dkdv|_bwd_"),
     ("fusion", r"^fusion"),
@@ -149,11 +153,25 @@ GROUPS = [
 ]
 
 
-def classify(name):
+def classify(name, ctx=""):
+    # only the bulk group consults the HLO metadata ctx: the module name
+    # lives there, whereas matching every group's pattern against ctx
+    # would misbin ops whose OPERAND names mention e.g. "transpose"
+    if ctx and re.search(r"fused_segment", ctx):
+        return "bulk_fused"
     for g, pat in GROUPS:
         if re.search(pat, name):
             return g
     return "other"
+
+
+def _event_ctx(e):
+    """Trace-event metadata that carries the owning jit module / HLO
+    provenance (XLA puts the module name in args, not the event name)."""
+    args = e.get("args") or {}
+    return " ".join(str(args[k]) for k in ("long_name", "tf_op", "source",
+                                           "group_by", "hlo_module")
+                    if k in args)
 
 
 def main():
@@ -215,8 +233,14 @@ def main():
         # skip obvious host-side module-level events
         if name.startswith(("jit_", "Thread", "pjit")):
             continue
+        ctx = _event_ctx(e)
+        if "fused_segment" in name or "fused_segment" in ctx:
+            # executed via an engine.bulk fused segment — mark it so the
+            # per-op table shows which device time came from bulked
+            # imperative chains vs ordinary per-op dispatch
+            name = "[bulk] " + name
         per_op[name] += dur
-        per_group[classify(name)] += dur
+        per_group[classify(name, ctx)] += dur
         total += dur
 
     print(f"== {which}: {nsteps} steps, device op time total "
