@@ -176,6 +176,8 @@ from . import symbol
 from . import symbol as sym
 from . import tracing
 from . import telemetry
+from . import fault
+from . import checkpoint
 from . import profiler
 from . import callback
 from . import monitor

@@ -205,6 +205,14 @@ def _patch_trainer_step(trainer):
                     "AMP: gradient overflow, skipping step "
                     "(loss scale %.1f -> %.1f)", scaler.loss_scale,
                     scaler.loss_scale / scaler._scale_factor)
+                # the scaler owns overflow handling (skip + scale
+                # backoff); the Trainer's nonfinite guard defers to it,
+                # so account the skip here under the shared counter
+                from .. import telemetry
+
+                trainer.steps_skipped = getattr(
+                    trainer, "steps_skipped", 0) + 1
+                telemetry.record_step_skipped("amp_overflow")
             else:
                 orig_step(batch_size, ignore_stale_grad)
             scaler.update_scale(overflow)

@@ -292,9 +292,17 @@ class Block:
         if not allow_missing:
             for name in params:
                 if name not in loaded:
+                    # name the keys the file DOES hold: a prefix mismatch
+                    # ('features.0.weight' vs '0.weight') is then obvious
+                    # from the error alone instead of a debugger session
+                    avail = sorted(loaded)
+                    shown = ", ".join(avail[:12]) + \
+                        (f", ... ({len(avail) - 12} more)"
+                         if len(avail) > 12 else "")
                     raise MXNetError(
                         f"Parameter {name} missing in {filename} "
-                        "(allow_missing=False)")
+                        f"(allow_missing=False). The file contains "
+                        f"{len(avail)} parameter(s): [{shown}]")
         for name, v in loaded.items():
             if name not in params:
                 if not ignore_extra:

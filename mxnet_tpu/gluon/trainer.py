@@ -13,9 +13,12 @@ semantics for API parity.
 """
 from __future__ import annotations
 
+import logging
+import os
 from typing import Dict, List, Optional
 
 from .. import optimizer as opt
+from .. import telemetry
 from ..base import MXNetError
 from .parameter import Parameter, ParameterDict
 
@@ -23,9 +26,19 @@ __all__ = ["Trainer"]
 
 
 class Trainer:
+    """``check_nonfinite`` (or env ``MXNET_CHECK_NONFINITE=1``): opt-in
+    step anomaly guard — a step whose gradients contain NaN/Inf is
+    SKIPPED (no optimizer update, no kvstore traffic) and counted
+    (``trainer.steps_skipped``, telemetry
+    ``mxnet_steps_skipped_total{reason="nonfinite_grad"}``) instead of
+    poisoning the weights. When an ``amp.DynamicLossScaler`` is attached
+    (``amp.init_trainer``) the scaler owns overflow handling — it skips
+    the step AND backs the loss scale off — so the guard defers to it
+    rather than double-scanning the gradients."""
+
     def __init__(self, params, optimizer, optimizer_params=None,
                  kvstore="device", compression_params=None,
-                 update_on_kvstore=None):
+                 update_on_kvstore=None, check_nonfinite=None):
         if isinstance(params, (dict, ParameterDict)):
             params = list(params.values())
         if not isinstance(params, (list, tuple)):
@@ -42,6 +55,11 @@ class Trainer:
             p._trainer = self
         self._compression_params = compression_params
         self._scale = 1.0
+        if check_nonfinite is None:
+            check_nonfinite = os.environ.get(
+                "MXNET_CHECK_NONFINITE", "0") == "1"
+        self._check_nonfinite = bool(check_nonfinite)
+        self.steps_skipped = 0
         optimizer_params = dict(optimizer_params or {})
         self._init_optimizer(optimizer, optimizer_params)
         self._kvstore_type = kvstore
@@ -127,12 +145,55 @@ class Trainer:
     # ------------------------------------------------------------------
     def step(self, batch_size, ignore_stale_grad=False):
         """Apply one optimizer step scaled by 1/batch_size
-        (reference: Trainer.step)."""
+        (reference: Trainer.step). With ``check_nonfinite``, a step with
+        NaN/Inf gradients is skipped and counted instead (see class
+        docstring)."""
         if not self._kv_initialized:
             self._init_kvstore()
         self._optimizer.rescale_grad = self._scale / batch_size
+        if self._check_nonfinite and \
+                getattr(self, "_amp_loss_scaler", None) is None and \
+                self._grads_nonfinite():
+            # skip BEFORE the allreduce: a NaN local gradient would
+            # poison every replica through the psum. (Single-process
+            # semantics; a multi-process job must skip symmetrically or
+            # replicas diverge — the AMP scaler path has the same
+            # contract in the reference.)
+            self.steps_skipped += 1
+            telemetry.record_step_skipped("nonfinite_grad")
+            logging.warning(
+                "Trainer.step: non-finite gradient detected, skipping "
+                "update (%d skipped so far)", self.steps_skipped)
+            return
         self._allreduce_grads()
         self._update(ignore_stale_grad)
+
+    def _grads_nonfinite(self) -> bool:
+        """True if any live gradient contains NaN/Inf (the anomaly-guard
+        scan; same contract as amp.DynamicLossScaler.has_overflow).
+        One device->host sync for the whole parameter set: per-gradient
+        isfinite reductions are AND-folded per device and fetched with a
+        single batched ``device_get`` — N separate ``bool(...)`` pulls
+        would serialize N round-trips into every guarded step."""
+        import jax
+        import jax.numpy as jnp
+
+        by_dev = {}
+        for p in self._params:
+            if p.grad_req == "null":
+                continue
+            for g in p.list_grad():
+                data = g.data
+                dev = next(iter(data.devices())) \
+                    if hasattr(data, "devices") else None
+                flag = jnp.isfinite(data).all()
+                prev = by_dev.get(dev)
+                by_dev[dev] = flag if prev is None \
+                    else jnp.logical_and(prev, flag)
+        if not by_dev:
+            return False
+        return not all(bool(v) for v in
+                       jax.device_get(list(by_dev.values())))
 
     def allreduce_grads(self):
         """Reduce gradients only — for gradient clipping between reduce and
@@ -173,17 +234,38 @@ class Trainer:
 
     # ------------------------------------------------------------------
     def save_states(self, fname):
-        """reference: Trainer.save_states (Updater.get_states pickle)."""
+        """reference: Trainer.save_states (Updater.get_states pickle).
+        Committed atomically (temp + fsync + rename) — a crash mid-save
+        leaves the previous state file intact."""
         if not self._kv_initialized:
             self._init_kvstore()
-        with open(fname, "wb") as f:
-            f.write(self._updaters[0].get_states(dump_optimizer=False))
+        from ..checkpoint import atomic_write
+
+        atomic_write(fname, self._updaters[0].get_states(
+            dump_optimizer=False))
 
     def load_states(self, fname):
+        """Inverse of save_states. Missing or corrupt state files raise
+        :class:`MXNetError` naming the file — never a raw OSError or
+        pickle traceback from deep inside the updater."""
         if not self._kv_initialized:
             self._init_kvstore()
-        with open(fname, "rb") as f:
-            states = f.read()
-        for upd in self._updaters:
-            upd.set_states(states)
-            upd.optimizer = self._optimizer
+        from ..checkpoint import apply_state_bytes, read_state_bytes
+
+        states = read_state_bytes(fname, "Trainer.load_states")
+
+        def _apply(blob):
+            for upd in self._updaters:
+                upd.set_states(blob)
+                if upd.optimizer is not self._optimizer:
+                    # a dump_optimizer=True payload installed its own
+                    # Optimizer on the updater; carry its restored update
+                    # counters onto the Trainer's live optimizer before
+                    # re-pointing, or the Adam bias-correction clock the
+                    # v2 state format preserves would be silently lost
+                    self._optimizer.num_update = upd.optimizer.num_update
+                    self._optimizer._index_update_count = dict(
+                        upd.optimizer._index_update_count)
+                upd.optimizer = self._optimizer
+
+        apply_state_bytes(states, _apply, fname, "Trainer.load_states")

@@ -7,9 +7,11 @@ from typing import Dict, List, Optional
 
 import numpy as _np
 
+from .. import fault
 from .. import optimizer as opt
 from .. import telemetry
 from ..base import MXNetError
+from ..fault import _state as _fault_state
 from ..ndarray import NDArray
 from ..ndarray import array as nd_array
 from ..telemetry import _state as _telemetry_state
@@ -147,14 +149,18 @@ class KVStore:
     def save_optimizer_states(self, fname, dump_optimizer=False):
         if self._updater is None:
             raise MXNetError("no optimizer set on this kvstore")
-        with open(fname, "wb") as f:
-            f.write(self._updater.get_states(dump_optimizer))
+        from ..checkpoint import atomic_write
+
+        atomic_write(fname, self._updater.get_states(dump_optimizer))
 
     def load_optimizer_states(self, fname):
         if self._updater is None:
             raise MXNetError("no optimizer set on this kvstore")
-        with open(fname, "rb") as f:
-            self._updater.set_states(f.read())
+        from ..checkpoint import apply_state_bytes, read_state_bytes
+
+        states = read_state_bytes(fname, "load_optimizer_states")
+        apply_state_bytes(states, self._updater.set_states, fname,
+                          "load_optimizer_states")
 
     def barrier(self):
         from ..ndarray import waitall
@@ -202,10 +208,22 @@ class KVStoreLocal(KVStore):
         vals = list(value) if isinstance(value, (list, tuple)) else [value]
         if self._compression is not None:
             # quantize each worker-slot's gradient before the reduce —
-            # the same point the reference compresses before the wire
+            # the same point the reference compresses before the wire.
+            # NOT inside the retry: compression carries error-feedback
+            # state, so re-compressing on retry would double-apply it.
             vals = [self._compression.compress(key, i, v)
                     for i, v in enumerate(vals)]
-        agg = self._aggregate(vals)
+
+        def _reduce():
+            if _fault_state.enabled:
+                fault.check("kvstore.push", f"key {key!r}")
+            return self._aggregate(vals)
+
+        # bounded exponential-backoff retry around the device work only
+        # (the reduce); the updater/store application below runs once —
+        # retrying a half-applied optimizer update is not idempotent
+        agg = fault.retry_call("kvstore.push", _reduce,
+                               detail=f"key {key!r}")
         if self._updater is not None:
             # server-side optimizer path (update_on_kvstore=True). The key
             # itself indexes updater state: ints and strings are both
@@ -245,9 +263,16 @@ class KVStoreLocal(KVStore):
         self._check_init(key)
         outs = out if isinstance(out, (list, tuple)) else [out]
         src = self._store[key]
-        for o in outs:
-            o._set_data(src.as_in_context(o.context).data
-                        if o.context != src.context else src.data)
+
+        def _copy_out():
+            if _fault_state.enabled:
+                fault.check("kvstore.pull", f"key {key!r}")
+            for o in outs:
+                o._set_data(src.as_in_context(o.context).data
+                            if o.context != src.context else src.data)
+
+        # idempotent (plain overwrite of the outs) — safe to retry whole
+        fault.retry_call("kvstore.pull", _copy_out, detail=f"key {key!r}")
         if _tel:
             telemetry.record_kv("pull", _nd_bytes(src) * len(outs),
                                 time.perf_counter() - t0)
@@ -357,11 +382,25 @@ class KVStoreTPUSync(KVStoreLocal):
         return fn
 
     def _collective_sum(self, vals: List[NDArray]):
-        """All-reduce per-device copies: one XLA psum over the mesh."""
-        if not _telemetry_state.enabled:
+        """All-reduce per-device copies: one XLA psum over the mesh.
+
+        The collective is wrapped in the bounded retry
+        (``fault.retry_call``, site ``kvstore.allreduce``): a psum is
+        stateless, so re-dispatching after a transient collective
+        failure is safe. Exhaustion raises MXNetError naming the site
+        and attempt count."""
+
+        def _reduce():
+            if _fault_state.enabled:
+                fault.check(
+                    "kvstore.allreduce",
+                    f"{tuple(vals[0].shape)} x {len(vals)} copies")
             return self._collective_sum_impl(vals)
+
+        if not _telemetry_state.enabled:
+            return fault.retry_call("kvstore.allreduce", _reduce)
         t0 = time.perf_counter()
-        reduced = self._collective_sum_impl(vals)
+        reduced = fault.retry_call("kvstore.allreduce", _reduce)
         # payload entering the psum: one copy per mesh slot — the reduced
         # array is replicated over the mesh (out_specs=P()), so its device
         # set IS the mesh; a failed collective records nothing
@@ -448,13 +487,19 @@ class KVStoreTPUSync(KVStoreLocal):
                         for s in getattr(data, "addressable_shards", [])} \
             if hasattr(data, "sharding") \
             and len(data.sharding.device_set) > 1 else {}
-        for o in outs:
-            dev = o.context.jax_device()
-            if dev in shard_by_dev:
-                o._set_data(shard_by_dev[dev])
-            else:
-                o._set_data(src.as_in_context(o.context).data
-                            if o.context != src.context else data)
+
+        def _copy_out():
+            if _fault_state.enabled:
+                fault.check("kvstore.pull", f"key {key!r}")
+            for o in outs:
+                dev = o.context.jax_device()
+                if dev in shard_by_dev:
+                    o._set_data(shard_by_dev[dev])
+                else:
+                    o._set_data(src.as_in_context(o.context).data
+                                if o.context != src.context else data)
+
+        fault.retry_call("kvstore.pull", _copy_out, detail=f"key {key!r}")
         if _tel:
             telemetry.record_kv("pull", _nd_bytes(src) * len(outs),
                                 time.perf_counter() - t0)
@@ -515,7 +560,14 @@ class KVStoreDistAsyncEmu(KVStoreTPUSync):
                     for i, v in enumerate(vals)]
         # LOCAL aggregation only — the async property: no cross-process
         # barrier on the push path
-        agg = KVStoreLocal._aggregate(self, vals)
+
+        def _reduce():
+            if _fault_state.enabled:
+                fault.check("kvstore.push", f"key {key!r}")
+            return KVStoreLocal._aggregate(self, vals)
+
+        agg = fault.retry_call("kvstore.push", _reduce,
+                               detail=f"key {key!r}")
         self._updater(key, agg, self._store[key])
         n = self._push_count[key] = self._push_count.get(key, 0) + 1
         if n % self._staleness == 0:

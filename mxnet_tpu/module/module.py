@@ -353,8 +353,12 @@ class Module(BaseModule):
         if self._preloaded_states is not None:
             # Module.load(..., load_optimizer_states=True): apply the
             # checkpointed updater states now that the updater exists.
-            with open(self._preloaded_states, "rb") as f:
-                self._updater.set_states(f.read())
+            from ..checkpoint import apply_state_bytes, read_state_bytes
+
+            fname = self._preloaded_states
+            states = read_state_bytes(fname, "Module.load")
+            apply_state_bytes(states, self._updater.set_states, fname,
+                              "Module.load")
             self._preloaded_states = None
         self.optimizer_initialized = True
 
@@ -406,11 +410,17 @@ class Module(BaseModule):
         self._monitored_exec = self._exec
 
     def save_checkpoint(self, prefix, epoch, save_optimizer_states=False):
+        # every artifact commits through the atomic writer (temp + fsync
+        # + rename): symbol json and .params via their own savers, the
+        # optimizer states here — a killed process never leaves a
+        # truncated checkpoint file behind
         arg, aux = self.get_params()
         save_checkpoint(prefix, epoch, self._symbol, arg, aux)
         if save_optimizer_states:
-            with open(f"{prefix}-{epoch:04d}.states", "wb") as f:
-                f.write(self._updater.get_states())
+            from ..checkpoint import atomic_write
+
+            atomic_write(f"{prefix}-{epoch:04d}.states",
+                         self._updater.get_states())
 
     @staticmethod
     def load(prefix, epoch, load_optimizer_states=False, **kwargs):

@@ -22,8 +22,10 @@ from typing import Dict, List, Sequence, Tuple, Union
 
 import numpy as _np
 
+from .. import fault as _fault
 from ..base import MXNetError, dtype_id_to_np, dtype_np_to_id
 from ..context import Context, cpu, current_context
+from ..fault import _state as _fault_state
 
 _LIST_MAGIC = 0x112
 _V1_MAGIC = 0xF993FAC8
@@ -108,8 +110,11 @@ def save(fname: str, data) -> None:
         nb = n.encode("utf-8")
         buf += struct.pack("<Q", len(nb))
         buf += nb
-    with open(fname, "wb") as f:
-        f.write(bytes(buf))
+    # crash-safe commit (temp + fsync + rename): a .params file either
+    # has its old content or its new content, never a torn write
+    from ..checkpoint import atomic_write
+
+    atomic_write(fname, bytes(buf))
 
 
 def save_indexed(fname: str, data: Dict) -> Dict:
@@ -136,8 +141,9 @@ def save_indexed(fname: str, data: Dict) -> Dict:
         nb = n.encode("utf-8")
         buf += struct.pack("<Q", len(nb))
         buf += nb
-    with open(fname, "wb") as f:
-        f.write(bytes(buf))
+    from ..checkpoint import atomic_write
+
+    atomic_write(fname, bytes(buf))
     return index
 
 
@@ -154,38 +160,56 @@ def load(fname: str, ctx: Context = None):
     """Load NDArray(s) (reference: mx.nd.load / MXNDArrayLoad)."""
     from .ndarray import array
 
+    if _fault_state.enabled:
+        _fault.check("checkpoint.read", fname)
     ctx = ctx or cpu(0)
-    with open(fname, "rb") as f:
-        data = f.read()
+    try:
+        with open(fname, "rb") as f:
+            data = f.read()
+    except OSError as e:
+        raise MXNetError(
+            f"cannot read NDArray file {fname!r}: {e}") from e
     if data[:6] == b"PK\x03\x04" + b"\x14\x00" or data[:2] == b"PK":
         # NumPy .npz escape hatch for externally produced fixtures
         npz = _np.load(fname)
         return {k: array(npz[k], ctx=ctx) for k in npz.files}
-    return loads(data, ctx=ctx)
+    try:
+        return loads(data, ctx=ctx)
+    except MXNetError as e:
+        # re-raise with the filename: "invalid magic" without a path is
+        # undebuggable from a training-loop traceback
+        raise MXNetError(f"{fname!r}: {e}") from e
 
 
 def loads(data: bytes, ctx: Context = None):
     from .ndarray import array
 
     ctx = ctx or cpu(0)
-    magic, _reserved = struct.unpack_from("<QQ", data, 0)
-    if magic != _LIST_MAGIC:
-        raise MXNetError("invalid NDArray list file magic")
-    off = 16
-    (n,) = struct.unpack_from("<Q", data, off)
-    off += 8
-    arrays: List = []
-    for _ in range(n):
-        arr, off = _load_one(data, off)
-        arrays.append(array(arr, ctx=ctx, dtype=arr.dtype))
-    (m,) = struct.unpack_from("<Q", data, off)
-    off += 8
-    names: List[str] = []
-    for _ in range(m):
-        (ln,) = struct.unpack_from("<Q", data, off)
+    try:
+        magic, _reserved = struct.unpack_from("<QQ", data, 0)
+        if magic != _LIST_MAGIC:
+            raise MXNetError("invalid NDArray list file magic")
+        off = 16
+        (n,) = struct.unpack_from("<Q", data, off)
         off += 8
-        names.append(data[off : off + ln].decode("utf-8"))
-        off += ln
+        arrays: List = []
+        for _ in range(n):
+            arr, off = _load_one(data, off)
+            arrays.append(array(arr, ctx=ctx, dtype=arr.dtype))
+        (m,) = struct.unpack_from("<Q", data, off)
+        off += 8
+        names: List[str] = []
+        for _ in range(m):
+            (ln,) = struct.unpack_from("<Q", data, off)
+            off += 8
+            names.append(data[off : off + ln].decode("utf-8"))
+            off += ln
+    except (struct.error, ValueError, UnicodeDecodeError, KeyError) as e:
+        # truncated payload / garbage bytes must surface as a framework
+        # error, not a struct traceback from the middle of the parser
+        # (KeyError: a corrupted dtype-id field failing the id->np map)
+        raise MXNetError(
+            f"corrupt or truncated NDArray payload: {e!r}") from e
     if m == 0:
         return arrays
     return dict(zip(names, arrays))

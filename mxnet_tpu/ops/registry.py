@@ -31,7 +31,8 @@ import threading
 import time
 from typing import Callable, Dict, List, NamedTuple, Optional, Sequence
 
-from .. import engine, telemetry
+from .. import engine, fault, telemetry
+from ..fault import _state as _fault_state
 from ..telemetry import _state as _telemetry_state
 
 __all__ = ["OpDef", "AttrSpec", "attr", "register", "get_op", "list_ops",
@@ -365,8 +366,13 @@ def eager_call(opdef: OpDef, tensors, attrs, rng=None):
     """Execute an op eagerly through the per-op executable cache.
 
     Telemetry (MXNET_TELEMETRY=1): per-op invocation count + host dispatch
-    latency; disabled mode costs exactly this one branch.
+    latency; disabled mode costs exactly this one branch. Fault site
+    ``engine.dispatch`` (MXNET_FAULT_SPEC): one injection opportunity per
+    dispatch — errors here propagate like a failed device op (the
+    ThreadedVar ExceptionRef analogue); likewise one branch when off.
     """
+    if _fault_state.enabled:
+        fault.check("engine.dispatch", opdef.name)
     if _telemetry_state.enabled:
         t0 = time.perf_counter()
         try:

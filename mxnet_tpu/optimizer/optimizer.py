@@ -483,6 +483,13 @@ class Updater:
             self.states[index] = self.optimizer.create_state_multi_precision(index, weight)
         self.optimizer.update_multi_precision(index, weight, grad, self.states[index])
 
+    # envelope marker for the versioned state pickle: v2 adds the
+    # optimizer's update counters (num_update / per-index counts), which
+    # Adam-family bias correction depends on — without them a resumed
+    # run restarts t at 1 and silently diverges from the uninterrupted
+    # run. Legacy payloads (bare dict / (dict, Optimizer)) still load.
+    _STATES_V2 = "mxnet_tpu_updater_states_v2"
+
     def get_states(self, dump_optimizer=False):
         def to_np(s):
             if s is None:
@@ -494,13 +501,24 @@ class Updater:
             return s
 
         payload = {k: to_np(v) for k, v in self.states.items()}
-        if dump_optimizer:
-            return pickle.dumps((payload, self.optimizer))
-        return pickle.dumps(payload)
+        counters = {
+            "num_update": self.optimizer.num_update,
+            "index_update_count": dict(self.optimizer._index_update_count),
+        }
+        return pickle.dumps(
+            (self._STATES_V2, payload, counters,
+             self.optimizer if dump_optimizer else None))
 
     def set_states(self, states):
         data = pickle.loads(states)
-        if isinstance(data, tuple) and len(data) == 2 and isinstance(data[1], Optimizer):
+        counters = None
+        if isinstance(data, tuple) and len(data) == 4 and \
+                data[0] == self._STATES_V2:
+            _, data, counters, opt_obj = data
+            if opt_obj is not None:
+                self.optimizer = opt_obj
+        elif isinstance(data, tuple) and len(data) == 2 and \
+                isinstance(data[1], Optimizer):
             data, self.optimizer = data
 
         def to_nd(s):
@@ -515,6 +533,10 @@ class Updater:
             return s
 
         self.states = {k: to_nd(v) for k, v in data.items()}
+        if counters is not None:
+            self.optimizer.num_update = counters["num_update"]
+            self.optimizer._index_update_count = dict(
+                counters["index_update_count"])
 
 
 

@@ -15,7 +15,8 @@ from __future__ import annotations
 import contextlib
 import threading
 
-__all__ = ["seed", "next_key", "scoped_key", "get_state_key"]
+__all__ = ["seed", "next_key", "scoped_key", "get_state_key",
+           "checkpoint_state", "restore_checkpoint_state"]
 
 _state = threading.local()
 _DEFAULT_SEED = 0
@@ -109,6 +110,61 @@ def get_state_key():
     """Fresh key drawn from the stateful global generator (for feeding a
     compiled executable's rng input)."""
     return next_key()
+
+
+def checkpoint_state() -> dict:
+    """Serializable (picklable) snapshot of the global PRNG: base seed,
+    every materialized per-device key stream, and the host-side
+    initializer RandomState. The crash-safe checkpoint contract
+    (``mxnet_tpu/checkpoint.py``) stores this so a resumed run draws the
+    SAME random sequence the uninterrupted run would have — bit-exact
+    resume requires the RNG, not just params and optimizer state.
+
+    Thread-scoped like the state itself: snapshots the calling thread's
+    streams (the training loop's, in practice).
+    """
+    import numpy as np
+
+    st = _global()
+    keys = {}
+    for sig, k in st.keys.items():
+        try:
+            raw = np.asarray(k)          # old-style uint32 key array
+            typed = False
+        except TypeError:
+            import jax
+
+            raw = np.asarray(jax.random.key_data(k))   # new-style typed
+            typed = True
+        keys[sig] = (raw, typed)
+    host = None
+    if getattr(st, "host_rng", None) is not None:
+        host = st.host_rng.get_state()
+    return {"version": 1, "base_seed": st.base_seed, "keys": keys,
+            "host_rng": host}
+
+
+def restore_checkpoint_state(state: dict) -> None:
+    """Restore a :func:`checkpoint_state` snapshot into the calling
+    thread's global PRNG (inverse of the snapshot; see there)."""
+    import numpy as np
+
+    import jax
+    import jax.numpy as jnp
+
+    st = _global()
+    st.base_seed = int(state["base_seed"])
+    keys = {}
+    for sig, (raw, typed) in state["keys"].items():
+        arr = jnp.asarray(np.asarray(raw))
+        keys[sig] = jax.random.wrap_key_data(arr) if typed else arr
+    st.keys = keys
+    if state.get("host_rng") is not None:
+        rng = np.random.RandomState()
+        rng.set_state(state["host_rng"])
+        st.host_rng = rng
+    else:
+        st.host_rng = None
 
 
 @contextlib.contextmanager

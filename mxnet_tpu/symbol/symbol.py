@@ -250,8 +250,9 @@ class Symbol:
         }, indent=2)
 
     def save(self, fname: str) -> None:
-        with open(fname, "w") as f:
-            f.write(self.tojson())
+        from ..checkpoint import atomic_write
+
+        atomic_write(fname, self.tojson().encode("utf-8"))
 
     # -- composition sugar ---------------------------------------------
     def __add__(self, other):

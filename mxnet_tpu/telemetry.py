@@ -49,6 +49,8 @@ __all__ = [
     "record_op_dispatch", "record_cache", "record_kv",
     "record_engine_wait", "set_live_arrays", "record_live_evictions",
     "record_training_step", "record_xla_dispatch", "record_bulk_flush",
+    "record_fault_injected", "record_retry", "record_checkpoint_write",
+    "record_step_skipped",
     "TrainingTelemetry", "xla_cost_analysis",
     "pop_telemetry_out_flag", "write_snapshot",
     "LATENCY_BUCKETS", "STEP_BUCKETS", "SEGMENT_BUCKETS",
@@ -516,6 +518,46 @@ def record_bulk_flush(reason: str, n_ops: int, seconds: float) -> None:
     histogram("mxnet_bulk_flush_seconds",
               "Host-side bulk flush latency (fused-cache lookup + "
               "dispatch).").observe(seconds)
+
+
+def record_fault_injected(site: str) -> None:
+    """One fault fired by the injector (mxnet_tpu/fault.py)."""
+    if not _state.enabled:
+        return
+    counter("mxnet_fault_injected_total",
+            "Faults fired by the fault injector by site.",
+            ("site",)).labels(site).inc()
+
+
+def record_retry(site: str, outcome: str) -> None:
+    """One retry event at a comms/IO site. ``outcome``: ``retry`` (one
+    failed attempt), ``recovered`` (call succeeded after >=1 retry),
+    ``exhausted`` (attempts used up, error surfaced)."""
+    if not _state.enabled:
+        return
+    counter("mxnet_retry_total",
+            "Retry events by site and outcome (retry/recovered/"
+            "exhausted).", ("site", "outcome")).labels(site, outcome).inc()
+
+
+def record_checkpoint_write(seconds: float) -> None:
+    """One committed checkpoint bundle write (manifest valid on disk)."""
+    if not _state.enabled:
+        return
+    histogram("mxnet_checkpoint_write_seconds",
+              "Wall time to write + commit one checkpoint bundle.",
+              buckets=STEP_BUCKETS).observe(seconds)
+
+
+def record_step_skipped(reason: str) -> None:
+    """One training step skipped by an anomaly guard. ``reason``:
+    ``nonfinite_grad`` (Trainer guard) or ``amp_overflow`` (loss-scaler
+    backoff)."""
+    if not _state.enabled:
+        return
+    counter("mxnet_steps_skipped_total",
+            "Training steps skipped by anomaly guards, by reason.",
+            ("reason",)).labels(reason).inc()
 
 
 def record_training_step(seconds: float, examples: float,

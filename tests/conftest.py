@@ -44,3 +44,22 @@ def telemetry_leak_guard():
         pytest.fail(
             "test left mx.telemetry globally enabled; call "
             "telemetry.disable() in teardown")
+
+
+@pytest.fixture(autouse=True)
+def fault_leak_guard():
+    """Mirror of the telemetry guard for the fault injector: a test that
+    leaves fault injection globally enabled would make every later test
+    randomly fail at instrumented sites — fail the leaking test loudly.
+    Tests use ``fault.inject(...)`` (scoped) or clear() in teardown."""
+    from mxnet_tpu import fault
+
+    was_active = fault.active()
+    yield
+    leaked = fault.active() and not was_active
+    if leaked:
+        fault.clear()
+        pytest.fail(
+            "test left mx.fault injection globally enabled; use "
+            "fault.inject() as a context manager or call fault.clear() "
+            "in teardown")

@@ -1,0 +1,658 @@
+"""Fault-tolerance subsystem tests (mxnet_tpu/fault.py, checkpoint.py,
+kvstore retry, Trainer anomaly guard).
+
+The acceptance contract this file proves:
+
+* injection points are zero-cost when disabled (no behavior change with
+  MXNET_FAULT_SPEC unset);
+* a training run with injected fail-once collective faults completes
+  with results identical to a fault-free run (retry absorbs the fault);
+* exhausted retries raise MXNetError naming the site and attempt count;
+* a kill during checkpoint write leaves the previous checkpoint the
+  newest valid one, and resume from a bundle is bit-exact for params +
+  optimizer state + RNG;
+* a NaN step is skipped and counted, composing with the AMP loss
+  scaler instead of fighting it.
+"""
+import os
+import pickle
+
+import numpy as np
+import pytest
+
+import mxnet_tpu as mx
+from mxnet_tpu import autograd, checkpoint, fault, gluon, telemetry
+from mxnet_tpu.gluon import nn
+
+pytestmark = pytest.mark.fault
+
+
+# ---------------------------------------------------------------------------
+# helpers
+# ---------------------------------------------------------------------------
+
+def make_net(seed=42):
+    mx.random.seed(seed)
+    net = nn.Dense(4, in_units=8)
+    net.initialize(mx.init.Xavier())
+    return net
+
+
+def make_batch():
+    x = mx.nd.array(np.random.RandomState(0).randn(8, 8).astype(np.float32))
+    y = mx.nd.array(np.random.RandomState(1).randn(8, 4).astype(np.float32))
+    return x, y
+
+
+def train_step(net, trainer, x, y, batch_size=8):
+    with autograd.record():
+        loss = ((net(x) - y) ** 2).mean()
+    loss.backward()
+    trainer.step(batch_size)
+    return float(loss.asnumpy())
+
+
+def run_training(steps=4, seed=42, optimizer="adam", kvstore="tpu_sync"):
+    net = make_net(seed)
+    trainer = gluon.Trainer(net.collect_params(), optimizer,
+                            {"learning_rate": 0.01}, kvstore=kvstore)
+    x, y = make_batch()
+    losses = [train_step(net, trainer, x, y) for _ in range(steps)]
+    return net, trainer, losses
+
+
+# ---------------------------------------------------------------------------
+# spec grammar / framework
+# ---------------------------------------------------------------------------
+
+class TestSpecGrammar:
+    def test_policies_parse(self):
+        spec = fault.parse_spec(
+            "engine.dispatch=latency:0.001;kvstore.push=once;"
+            "kvstore.allreduce=every:3;checkpoint.write=nth:2;*=p:0.25")
+        assert set(spec) == {"engine.dispatch", "kvstore.push",
+                             "kvstore.allreduce", "checkpoint.write", "*"}
+        assert spec["kvstore.push"].kind == "once"
+        assert spec["kvstore.allreduce"].arg == 3
+        assert spec["checkpoint.write"].arg == 2
+        assert spec["*"].arg == 0.25
+
+    def test_unknown_site_rejected(self):
+        with pytest.raises(mx.MXNetError, match="unknown fault site"):
+            fault.parse_spec("kvstore.push2=once")
+
+    def test_bad_policy_rejected(self):
+        with pytest.raises(mx.MXNetError, match="bad fault policy"):
+            fault.parse_spec("kvstore.push=sometimes")
+        with pytest.raises(mx.MXNetError, match="bad fault policy"):
+            fault.parse_spec("kvstore.push=p:1.5")
+        with pytest.raises(mx.MXNetError, match="bad fault policy"):
+            fault.parse_spec("kvstore.push=every:0")
+
+    def test_missing_equals_rejected(self):
+        with pytest.raises(mx.MXNetError, match="site=policy"):
+            fault.parse_spec("kvstore.push")
+
+    def test_inject_scope_restores_state(self):
+        assert not fault.active()
+        with fault.inject("engine.dispatch=once"):
+            assert fault.active()
+        assert not fault.active()
+        assert fault.stats() == {}
+
+    def test_policy_semantics(self):
+        # once: fires exactly on hit 1
+        with fault.inject("engine.dispatch=once"):
+            with pytest.raises(fault.FaultInjected):
+                fault.check("engine.dispatch")
+            for _ in range(5):
+                fault.check("engine.dispatch")
+        # nth:3 fires exactly on hit 3
+        with fault.inject("engine.dispatch=nth:3"):
+            fault.check("engine.dispatch")
+            fault.check("engine.dispatch")
+            with pytest.raises(fault.FaultInjected):
+                fault.check("engine.dispatch")
+            fault.check("engine.dispatch")
+        # every:2 fires on hits 2, 4, ...
+        with fault.inject("engine.dispatch=every:2") as stats:
+            fired = 0
+            for _ in range(6):
+                try:
+                    fault.check("engine.dispatch")
+                except fault.FaultInjected:
+                    fired += 1
+            assert fired == 3
+            assert stats()["engine.dispatch"]["injected"] == 3
+
+    def test_probabilistic_is_seeded(self):
+        def run(seed):
+            fired = []
+            with fault.inject("engine.dispatch=p:0.5", seed=seed):
+                for i in range(64):
+                    try:
+                        fault.check("engine.dispatch")
+                        fired.append(0)
+                    except fault.FaultInjected:
+                        fired.append(1)
+            return fired
+        a, b, c = run(7), run(7), run(8)
+        assert a == b          # deterministic per seed
+        assert a != c          # and the seed matters
+        assert 0 < sum(a) < 64
+
+    def test_wildcard_site(self):
+        with fault.inject("*=once"):
+            with pytest.raises(fault.FaultInjected):
+                fault.check("kvstore.pull")
+
+    def test_latency_injects_no_error(self):
+        import time
+
+        telemetry.reset()
+        telemetry.enable()
+        try:
+            with fault.inject("engine.dispatch=latency:0.01") as stats:
+                t0 = time.perf_counter()
+                (mx.nd.ones((2,)) + 1).asnumpy()
+                dt = time.perf_counter() - t0
+                assert stats()["engine.dispatch"]["injected"] >= 1
+                assert dt >= 0.01
+            # latency injections count in the telemetry too, not only
+            # in fault.stats()
+            samples = telemetry.snapshot()["metrics"][
+                "mxnet_fault_injected_total"]["samples"]
+            assert samples[0]["labels"] == {"site": "engine.dispatch"}
+            assert samples[0]["value"] >= 1
+        finally:
+            telemetry.disable()
+            telemetry.reset()
+
+
+class TestZeroCostWhenDisabled:
+    def test_disabled_flag_is_single_branch_state(self):
+        # the call-site contract: one attribute load on one stable object
+        assert fault._state.enabled is False
+        assert fault.active() is False
+
+    def test_no_behavior_change_with_spec_unset(self):
+        """MXNET_FAULT_SPEC unset: training twice (same seed) with the
+        whole fault-tolerance stack in place is bit-identical — the
+        instrumented hot paths change nothing when injection is off."""
+        assert "MXNET_FAULT_SPEC" not in os.environ
+        net1, _, losses1 = run_training(steps=3)
+        net2, _, losses2 = run_training(steps=3)
+        assert losses1 == losses2
+        assert np.array_equal(net1.weight.data().asnumpy(),
+                              net2.weight.data().asnumpy())
+
+    def test_check_noop_when_disabled(self):
+        fault.check("engine.dispatch")  # no spec, disabled: must no-op
+
+
+# ---------------------------------------------------------------------------
+# comms retry / backoff
+# ---------------------------------------------------------------------------
+
+class TestCommsRetry:
+    def test_fail_once_allreduce_recovers(self):
+        """A transient collective failure is absorbed by the retry: the
+        reduced value is identical to the fault-free one."""
+        import jax
+
+        if len(jax.devices()) < 2:
+            pytest.skip("needs >= 2 devices")
+        grads_np = [np.full((4,), float(i + 1), np.float32)
+                    for i in range(2)]
+
+        def push_pull(spec):
+            store = mx.kv.create("tpu_sync")
+            store.init(0, mx.nd.zeros((4,)))
+            grads = [mx.nd.array(g).as_in_context(mx.Context("cpu", i))
+                     for i, g in enumerate(grads_np)]
+            if spec:
+                with fault.inject(spec) as stats:
+                    store.push(0, grads)
+                    st = stats()
+            else:
+                store.push(0, grads)
+                st = None
+            out = mx.nd.zeros((4,))
+            store.pull(0, out)
+            return out.asnumpy(), st
+
+        clean, _ = push_pull(None)
+        faulty, st = push_pull("kvstore.allreduce=once")
+        assert st["kvstore.allreduce"]["injected"] == 1
+        assert st["kvstore.allreduce"]["hits"] >= 2   # the retry
+        np.testing.assert_array_equal(clean, faulty)
+
+    def test_exhausted_retries_raise_with_attempt_count(self):
+        store = mx.kv.create("tpu_sync")
+        store.init(7, mx.nd.zeros((4,)))
+        grads = [mx.nd.ones((4,)).as_in_context(mx.Context("cpu", i))
+                 for i in range(2)]
+        with fault.inject("kvstore.allreduce=every:1"):
+            with pytest.raises(mx.MXNetError,
+                               match=r"kvstore\.allreduce.*failed after "
+                                     r"3 attempt"):
+                store.push(7, grads)
+
+    def test_retry_attempt_knobs(self, monkeypatch):
+        monkeypatch.setenv("MXNET_COMM_RETRY_ATTEMPTS", "5")
+        monkeypatch.setenv("MXNET_COMM_RETRY_DELAY", "0")
+        calls = []
+
+        def flaky():
+            calls.append(1)
+            raise fault.FaultInjected("kvstore.push", len(calls))
+
+        with pytest.raises(mx.MXNetError, match="after 5 attempt"):
+            fault.retry_call("kvstore.push", flaky, detail="key 0")
+        assert len(calls) == 5
+
+    def test_retry_recovers_and_reports_detail(self):
+        calls = []
+
+        def flaky():
+            calls.append(1)
+            if len(calls) < 3:
+                raise fault.FaultInjected("kvstore.pull", len(calls))
+            return "ok"
+
+        assert fault.retry_call("kvstore.pull", flaky, attempts=3,
+                                base_delay=0) == "ok"
+
+    def test_nontransient_error_not_retried(self):
+        calls = []
+
+        def broken():
+            calls.append(1)
+            raise ValueError("deterministic bug")
+
+        with pytest.raises(ValueError):
+            fault.retry_call("kvstore.push", broken, base_delay=0)
+        assert len(calls) == 1   # no retry: would only mask the bug
+
+    def test_push_fault_during_training_is_transparent(self):
+        """Tentpole acceptance: training with an injected fail-once comms
+        fault finishes IDENTICAL to the fault-free run."""
+        clean_net, _, clean_losses = run_training(steps=3)
+        with fault.inject("kvstore.push=once") as stats:
+            faulty_net, _, faulty_losses = run_training(steps=3)
+            assert stats()["kvstore.push"]["injected"] == 1
+        assert clean_losses == faulty_losses
+        assert np.array_equal(clean_net.weight.data().asnumpy(),
+                              faulty_net.weight.data().asnumpy())
+
+    def test_retry_telemetry(self):
+        telemetry.reset()
+        telemetry.enable()
+        try:
+            with fault.inject("kvstore.allreduce=once"):
+                store = mx.kv.create("tpu_sync")
+                store.init(0, mx.nd.zeros((4,)))
+                store.push(0, [mx.nd.ones((4,)).as_in_context(
+                    mx.Context("cpu", i)) for i in range(2)])
+            snap = telemetry.snapshot()["metrics"]
+            retries = {tuple(s["labels"].items()): s["value"]
+                       for s in snap["mxnet_retry_total"]["samples"]}
+            assert retries[(("site", "kvstore.allreduce"),
+                            ("outcome", "retry"))] == 1
+            assert retries[(("site", "kvstore.allreduce"),
+                            ("outcome", "recovered"))] == 1
+            faults = snap["mxnet_fault_injected_total"]["samples"]
+            assert faults[0]["labels"] == {"site": "kvstore.allreduce"}
+            assert faults[0]["value"] == 1
+        finally:
+            telemetry.disable()
+            telemetry.reset()
+
+
+# ---------------------------------------------------------------------------
+# engine dispatch site
+# ---------------------------------------------------------------------------
+
+class TestEngineDispatchSite:
+    def test_dispatch_fault_propagates_deterministically(self):
+        a = mx.nd.ones((4,))
+        with fault.inject("engine.dispatch=nth:2"):
+            b = a + 1                          # hit 1: passes
+            with pytest.raises(fault.FaultInjected, match="engine.dispatch"):
+                _ = a * 2                      # hit 2: fires
+            c = a - 1                          # hit 3: passes again
+        np.testing.assert_array_equal(b.asnumpy(), np.full((4,), 2.0))
+        np.testing.assert_array_equal(c.asnumpy(), np.zeros((4,)))
+
+
+# ---------------------------------------------------------------------------
+# crash-safe checkpointing
+# ---------------------------------------------------------------------------
+
+class TestCheckpointManager:
+    def test_atomic_write_never_tears(self, tmp_path):
+        p = tmp_path / "f.bin"
+        checkpoint.atomic_write(str(p), b"old-content")
+        with fault.inject("checkpoint.write=once"):
+            with pytest.raises(fault.FaultInjected):
+                checkpoint.atomic_write(str(p), b"new-content")
+        assert p.read_bytes() == b"old-content"
+        assert [f for f in os.listdir(tmp_path) if ".tmp" in f] == []
+
+    def test_save_load_roundtrip_bit_exact(self, tmp_path):
+        net, trainer, _ = run_training(steps=3)
+        mgr = checkpoint.CheckpointManager(str(tmp_path), keep_last=3)
+        path = mgr.save(3, params=net, trainer=trainer, epoch=1,
+                        extra={"lr": 0.01})
+        assert mgr.latest_step() == 3 and mgr.is_valid(3)
+
+        # reference: continue the ORIGINAL run
+        x, y = make_batch()
+        ref_losses = [train_step(net, trainer, x, y) for _ in range(3)]
+        ref_w = net.weight.data().asnumpy().copy()
+        ref_draw = mx.nd.random.uniform(shape=(4,)).asnumpy()
+
+        # crash-sim: fresh process state, restore, replay
+        mx.random.seed(999)   # pollute the RNG: restore must undo this
+        net2 = make_net(seed=7)   # different init: restore must undo this
+        tr2 = gluon.Trainer(net2.collect_params(), "adam",
+                            {"learning_rate": 0.01}, kvstore="tpu_sync")
+        meta = mgr.restore(block=net2, trainer=tr2)
+        assert meta["step"] == 3 and meta["epoch"] == 1
+        assert meta["extra"] == {"lr": 0.01}
+        res_losses = [train_step(net2, tr2, x, y) for _ in range(3)]
+        res_draw = mx.nd.random.uniform(shape=(4,)).asnumpy()
+
+        assert ref_losses == res_losses        # bit-exact, not allclose
+        assert np.array_equal(ref_w, net2.weight.data().asnumpy())
+        assert np.array_equal(ref_draw, res_draw)
+        assert os.path.isdir(path)
+
+    def test_kill_during_write_keeps_previous_checkpoint(self, tmp_path):
+        """Acceptance: a crash at ANY file of the in-flight bundle leaves
+        the previous checkpoint manifest-valid, loadable, and newest."""
+        net, trainer, _ = run_training(steps=2)
+        mgr = checkpoint.CheckpointManager(str(tmp_path), keep_last=3)
+        mgr.save(2, params=net, trainer=trainer)
+        before = mgr.load(2)["params"]["weight"].asnumpy()
+
+        # the bundle writes params, states, rng, meta, manifest in order;
+        # kill at each of the first 5 commits in turn
+        for nth in range(1, 6):
+            with fault.inject(f"checkpoint.write=nth:{nth}"):
+                with pytest.raises(fault.FaultInjected):
+                    mgr.save(5, params=net, trainer=trainer)
+            assert mgr.latest_step() == 2, f"kill at write #{nth}"
+            assert mgr.is_valid(2)
+        # staging debris never pollutes discovery, and is swept by the
+        # next successful save
+        mgr.save(6, params=net, trainer=trainer)
+        assert mgr.latest_step() == 6
+        assert [e for e in os.listdir(tmp_path) if ".staging-" in e] == []
+        np.testing.assert_array_equal(
+            before, mgr.load(6)["params"]["weight"].asnumpy())
+
+    def test_corrupt_newest_falls_back_to_older_valid(self, tmp_path):
+        net, trainer, _ = run_training(steps=2)
+        mgr = checkpoint.CheckpointManager(str(tmp_path), keep_last=3)
+        mgr.save(1, params=net, trainer=trainer)
+        mgr.save(2, params=net, trainer=trainer)
+        # flip bytes in the newest bundle's params payload
+        with open(os.path.join(mgr.path(2), "params.params"),
+                  "r+b") as f:
+            f.seek(40)
+            f.write(b"\xde\xad\xbe\xef")
+        assert not mgr.is_valid(2)
+        assert mgr.latest_step() == 1          # discovery skips corrupt
+        with pytest.raises(mx.MXNetError, match="checksum"):
+            mgr.load(2)
+
+    def test_no_checkpoint_raises_clear_error(self, tmp_path):
+        mgr = checkpoint.CheckpointManager(str(tmp_path))
+        assert mgr.latest_step() is None
+        with pytest.raises(mx.MXNetError, match="no checksum-valid"):
+            mgr.load()
+
+    def test_staging_sweep_is_age_gated(self, tmp_path):
+        """A fresh staging dir may be another live writer's in-flight
+        bundle — only crash leftovers (old mtime) are swept."""
+        import time
+
+        net, trainer, _ = run_training(steps=1)
+        mgr = checkpoint.CheckpointManager(str(tmp_path))
+        fresh = tmp_path / ".ckpt-00000009.staging-live"
+        fresh.mkdir()
+        old = tmp_path / ".ckpt-00000008.staging-dead"
+        old.mkdir()
+        past = time.time() - 2 * mgr._STAGING_SWEEP_AGE_S
+        os.utime(old, (past, past))
+        mgr.save(1, params=net, trainer=trainer)
+        assert fresh.is_dir()          # live writer left alone
+        assert not old.exists()        # crash leftover swept
+        assert mgr.latest_step() == 1  # staging never pollutes discovery
+
+    def test_retention_keeps_last_k(self, tmp_path):
+        net, trainer, _ = run_training(steps=1)
+        mgr = checkpoint.CheckpointManager(str(tmp_path), keep_last=2)
+        for s in (1, 2, 3, 4):
+            mgr.save(s, params=net, trainer=trainer)
+        assert mgr.steps() == [4, 3]
+        assert sorted(os.listdir(tmp_path)) == ["ckpt-00000003",
+                                                "ckpt-00000004"]
+
+    def test_checkpoint_write_telemetry(self, tmp_path):
+        net, trainer, _ = run_training(steps=1)
+        telemetry.reset()
+        telemetry.enable()
+        try:
+            mgr = checkpoint.CheckpointManager(str(tmp_path))
+            mgr.save(1, params=net, trainer=trainer)
+            snap = telemetry.snapshot()["metrics"]
+            assert snap["mxnet_checkpoint_write_seconds"][
+                "samples"][0]["count"] == 1
+        finally:
+            telemetry.disable()
+            telemetry.reset()
+
+
+# ---------------------------------------------------------------------------
+# step anomaly guard
+# ---------------------------------------------------------------------------
+
+class TestStepAnomalyGuard:
+    def _poisoned_trainer(self, check_nonfinite=True):
+        net = make_net()
+        trainer = gluon.Trainer(net.collect_params(), "sgd",
+                                {"learning_rate": 0.1},
+                                check_nonfinite=check_nonfinite)
+        x, y = make_batch()
+        with autograd.record():
+            loss = ((net(x) - y) ** 2).mean()
+        loss.backward()
+        # poison one gradient
+        g = net.weight.grad()
+        g_np = g.asnumpy().copy()
+        g_np[0, 0] = np.nan
+        g._set_data(mx.nd.array(g_np).data)
+        return net, trainer
+
+    def test_nan_step_skipped_and_counted(self):
+        net, trainer = self._poisoned_trainer()
+        w_before = net.weight.data().asnumpy().copy()
+        telemetry.reset()
+        telemetry.enable()
+        try:
+            trainer.step(8)
+            snap = telemetry.snapshot()["metrics"]
+            skipped = snap["mxnet_steps_skipped_total"]["samples"]
+            assert skipped[0]["labels"] == {"reason": "nonfinite_grad"}
+            assert skipped[0]["value"] == 1
+        finally:
+            telemetry.disable()
+            telemetry.reset()
+        assert trainer.steps_skipped == 1
+        # the poisoned update was NOT applied
+        assert np.array_equal(w_before, net.weight.data().asnumpy())
+
+    def test_guard_off_by_default(self):
+        net, trainer = self._poisoned_trainer(check_nonfinite=False)
+        w_before = net.weight.data().asnumpy().copy()
+        trainer.step(8)   # reference behavior: NaN propagates
+        assert trainer.steps_skipped == 0
+        assert np.isnan(net.weight.data().asnumpy()).any()
+        assert not np.array_equal(w_before, net.weight.data().asnumpy())
+
+    def test_guard_env_knob(self, monkeypatch):
+        monkeypatch.setenv("MXNET_CHECK_NONFINITE", "1")
+        net = make_net()
+        trainer = gluon.Trainer(net.collect_params(), "sgd",
+                                {"learning_rate": 0.1})
+        assert trainer._check_nonfinite
+
+    def test_composes_with_amp_loss_scaler(self):
+        """With a DynamicLossScaler attached the scaler owns overflow:
+        step skipped ONCE, scale backed off, shared skip counter bumped —
+        the guard defers instead of double-handling."""
+        from mxnet_tpu import amp
+
+        net, trainer = self._poisoned_trainer(check_nonfinite=True)
+        scaler = amp.DynamicLossScaler(init_scale=64.0, scale_factor=2.0)
+        trainer._amp_loss_scaler = scaler
+        amp._patch_trainer_step(trainer)
+        w_before = net.weight.data().asnumpy().copy()
+        trainer.step(8)
+        assert np.array_equal(w_before, net.weight.data().asnumpy())
+        assert scaler.loss_scale == 32.0       # backoff happened
+        assert trainer.steps_skipped == 1      # counted exactly once
+
+
+# ---------------------------------------------------------------------------
+# state-file error paths (satellites)
+# ---------------------------------------------------------------------------
+
+class TestStateFileErrors:
+    def test_trainer_load_states_missing_file(self, tmp_path):
+        _, trainer, _ = run_training(steps=1)
+        missing = str(tmp_path / "nope.states")
+        with pytest.raises(mx.MXNetError, match="nope.states"):
+            trainer.load_states(missing)
+
+    def test_trainer_load_states_corrupt_file(self, tmp_path):
+        _, trainer, _ = run_training(steps=1)
+        bad = tmp_path / "bad.states"
+        bad.write_bytes(b"this is not a pickle")
+        with pytest.raises(mx.MXNetError,
+                           match=r"bad.states.*corrupt or wrong format"):
+            trainer.load_states(str(bad))
+
+    def test_kvstore_load_optimizer_states_errors(self, tmp_path):
+        store = mx.kv.create("local")
+        store.set_optimizer(mx.optimizer.create("sgd", learning_rate=0.1))
+        with pytest.raises(mx.MXNetError, match="gone.states"):
+            store.load_optimizer_states(str(tmp_path / "gone.states"))
+        bad = tmp_path / "junk.states"
+        bad.write_bytes(b"\x00\x01junk")
+        with pytest.raises(mx.MXNetError, match="junk.states"):
+            store.load_optimizer_states(str(bad))
+
+    def test_kvstore_states_roundtrip_atomic(self, tmp_path):
+        store = mx.kv.create("local")
+        store.set_optimizer(mx.optimizer.create("adam",
+                                                learning_rate=0.1))
+        store.init(0, mx.nd.zeros((4,)))
+        store.push(0, mx.nd.ones((4,)))
+        f = str(tmp_path / "kv.states")
+        store.save_optimizer_states(f)
+        store2 = mx.kv.create("local")
+        store2.set_optimizer(mx.optimizer.create("adam",
+                                                 learning_rate=0.1))
+        store2.load_optimizer_states(f)
+        assert 0 in store2._updater.states
+
+    def test_updater_states_carry_optimizer_counters(self):
+        """v2 state pickle restores num_update / per-index counts — the
+        Adam bias-correction clock a bit-exact resume depends on."""
+        from mxnet_tpu import optimizer as opt
+
+        o = opt.create("adam", learning_rate=0.01)
+        upd = opt.get_updater(o)
+        w, g = mx.nd.ones((4,)), mx.nd.ones((4,))
+        for _ in range(5):
+            upd(0, g, w)
+        assert o.num_update == 5
+        blob = upd.get_states()
+        o2 = opt.create("adam", learning_rate=0.01)
+        upd2 = opt.get_updater(o2)
+        upd2.set_states(blob)
+        assert o2.num_update == 5
+        assert o2._index_update_count == {0: 5}
+
+    def test_load_states_dump_optimizer_keeps_counters(self, tmp_path):
+        """A dump_optimizer=True payload embeds its own Optimizer; the
+        Trainer must carry the restored update counters onto its LIVE
+        optimizer when re-pointing the updaters at it."""
+        _, trainer, _ = run_training(steps=3)
+        f = str(tmp_path / "dump.states")
+        checkpoint.atomic_write(
+            f, trainer._updaters[0].get_states(dump_optimizer=True))
+        _, tr2, _ = run_training(steps=1, seed=5)
+        assert tr2._optimizer.num_update == 1
+        tr2.load_states(f)
+        assert tr2._optimizer.num_update == 3
+        for upd in tr2._updaters:
+            assert upd.optimizer is tr2._optimizer
+
+    def test_updater_legacy_payload_still_loads(self):
+        from mxnet_tpu import optimizer as opt
+
+        legacy = pickle.dumps({0: np.ones((4,), np.float32)})
+        upd = opt.get_updater(opt.create("sgd", learning_rate=0.1))
+        upd.set_states(legacy)
+        assert 0 in upd.states
+
+    def test_nd_load_errors_name_the_file(self, tmp_path):
+        """Missing / truncated / garbage .params files raise MXNetError
+        with the filename — never a raw OSError or struct.error."""
+        missing = str(tmp_path / "gone.params")
+        with pytest.raises(mx.MXNetError, match="gone.params"):
+            mx.nd.load(missing)
+        junk = tmp_path / "junk.params"
+        junk.write_bytes(b"garbage")
+        with pytest.raises(mx.MXNetError, match="junk.params"):
+            mx.nd.load(str(junk))
+        # truncate a real file mid-payload
+        net = make_net()
+        good = str(tmp_path / "net.params")
+        net.save_parameters(good)
+        data = open(good, "rb").read()
+        trunc = tmp_path / "trunc.params"
+        trunc.write_bytes(data[:len(data) // 2])
+        with pytest.raises(mx.MXNetError, match="trunc.params"):
+            mx.nd.load(str(trunc))
+
+    def test_load_parameters_error_names_available_keys(self, tmp_path):
+        net = make_net()
+        f = str(tmp_path / "net.params")
+        net.save_parameters(f)
+        # a Sequential wrapper prefixes its child's params ('0.weight'),
+        # so loading the bare Dense checkpoint is the classic mismatch
+        seq = nn.HybridSequential()
+        seq.add(nn.Dense(4, in_units=8))
+        seq.initialize(mx.init.Xavier())
+        with pytest.raises(mx.MXNetError) as ei:
+            seq.load_parameters(f)
+        msg = str(ei.value)
+        assert "missing in" in msg
+        assert "weight" in msg and "bias" in msg   # the available keys
+        assert "contains 2 parameter" in msg
+
+
+# ---------------------------------------------------------------------------
+# leak guard self-check
+# ---------------------------------------------------------------------------
+
+class TestLeakGuard:
+    def test_inject_cleans_up_for_next_test(self):
+        with fault.inject("engine.dispatch=once"):
+            pass
+        assert not fault.active()

@@ -1,0 +1,217 @@
+"""Chaos check: training under seeded fault injection must match a
+fault-free run bit-for-bit.
+
+The fault-tolerance acceptance gate (ISSUE 3): when every injected fault
+is *retryable* (comms faults absorbed by the kvstore retry, latency
+injection at op dispatch), a training run under a seeded random
+injection spec must (a) complete and (b) land on exactly the final loss
+and weights of the clean run. Additionally a crash-safe checkpoint
+cycle runs mid-loop: the first save attempt is killed by an injected
+``checkpoint.write`` fault (previous checkpoint must stay valid), the
+save is repeated, the run "crashes", and a fresh model resumes from the
+bundle — the resumed tail must match the uninterrupted run bit-for-bit
+(params + optimizer counters + RNG stream).
+
+  python tools/chaos_check.py                 # default spec/steps
+  python tools/chaos_check.py --steps 40 --seed 11 \
+      --spec 'kvstore.push=every:7;kvstore.allreduce=p:0.1' \
+      --json /tmp/chaos.json
+
+Exit code 0 = all gates pass. Runs on the CPU oracle mesh
+(JAX_PLATFORMS=cpu; the fake cluster flag is set below if absent).
+"""
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import shutil
+import sys
+import tempfile
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+# CPU oracle env (mirrors the test conftest): must be set before jax init
+os.environ.setdefault("JAX_PLATFORMS", "cpu")
+if "xla_force_host_platform_device_count" not in os.environ.get(
+        "XLA_FLAGS", ""):
+    os.environ["XLA_FLAGS"] = (os.environ.get("XLA_FLAGS", "") +
+                               " --xla_force_host_platform_device_count=8"
+                               ).strip()
+
+DEFAULT_SPEC = ("kvstore.push=every:5;kvstore.pull=p:0.05;"
+                "kvstore.allreduce=p:0.1;engine.dispatch=latency:0.0001")
+
+
+def make_data(seed):
+    """Synthetic classification data from a PRIVATE numpy RNG — must not
+    touch mx.random: the resume gate restores the checkpointed stream
+    and a reseed here would silently clobber it (making the RNG half of
+    the bit-exactness gate vacuous)."""
+    import numpy as np
+
+    rs = np.random.RandomState(seed)
+    x = rs.randn(128, 64).astype(np.float32)
+    y = rs.randint(0, 10, size=(128,)).astype(np.int32)
+    return x, y
+
+
+def build(seed):
+    """Fresh model + trainer + data, deterministically from ``seed``."""
+    import mxnet_tpu as mx
+    from mxnet_tpu import gluon
+    from mxnet_tpu.gluon import nn
+
+    mx.random.seed(seed)
+    net = nn.HybridSequential()
+    net.add(nn.Dense(32, in_units=64, activation="relu"))
+    net.add(nn.Dense(10, in_units=32))
+    net.initialize(mx.init.Xavier())
+    trainer = gluon.Trainer(net.collect_params(), "adam",
+                            {"learning_rate": 0.01}, kvstore="tpu_sync")
+    x, y = make_data(seed)
+    return net, trainer, x, y
+
+
+def run(seed, steps, batch_size=32, net=None, trainer=None,
+        start_step=0, ckpt_mgr=None, ckpt_at=None, kill_first_save=False):
+    """Train ``steps`` minibatch steps; returns (losses, net, trainer).
+
+    ``ckpt_at``: step index at which to save a checkpoint through
+    ``ckpt_mgr`` (with ``kill_first_save`` the first attempt runs under
+    an injected ``checkpoint.write`` fault and must fail cleanly).
+    """
+    import mxnet_tpu as mx
+    from mxnet_tpu import autograd, fault
+    from mxnet_tpu.gluon import loss as gloss
+
+    if net is None:
+        net, trainer, x, y = build(seed)
+    else:
+        x, y = make_data(seed)   # data only; model + RNG state passed in
+    loss_fn = gloss.SoftmaxCrossEntropyLoss()
+    n = x.shape[0]
+    losses = []
+    for step in range(start_step, steps):
+        lo = (step * batch_size) % n
+        xb = mx.nd.array(x[lo:lo + batch_size])
+        yb = mx.nd.array(y[lo:lo + batch_size])
+        with autograd.record():
+            loss = loss_fn(net(xb), yb).mean()
+        loss.backward()
+        trainer.step(batch_size)
+        losses.append(float(loss.asnumpy()))
+        if ckpt_mgr is not None and step == ckpt_at:
+            if kill_first_save:
+                prev = ckpt_mgr.latest_step()
+                try:
+                    with fault.inject("checkpoint.write=once"):
+                        ckpt_mgr.save(step, params=net, trainer=trainer)
+                    raise AssertionError(
+                        "injected checkpoint.write fault did not fire")
+                except fault.FaultInjected:
+                    pass
+                assert ckpt_mgr.latest_step() == prev, \
+                    "killed save corrupted checkpoint discovery"
+            ckpt_mgr.save(step, params=net, trainer=trainer)
+    return losses, net, trainer
+
+
+def weights_of(net):
+    return {name: p.data().asnumpy()
+            for name, p in net._collect_params_with_prefix().items()}
+
+
+def main():
+    ap = argparse.ArgumentParser(description=__doc__.split("\n")[0])
+    ap.add_argument("--steps", type=int, default=24)
+    ap.add_argument("--seed", type=int, default=7)
+    ap.add_argument("--spec", default=DEFAULT_SPEC,
+                    help="fault spec for the chaos run (all-retryable)")
+    ap.add_argument("--json", default=None,
+                    help="write the result summary to this path")
+    args = ap.parse_args()
+
+    import numpy as np
+
+    from mxnet_tpu import checkpoint, fault, telemetry
+
+    telemetry.enable()
+    summary = {"steps": args.steps, "seed": args.seed, "spec": args.spec,
+               "gates": {}}
+    ok = True
+
+    # -- gate 1: clean baseline ----------------------------------------
+    clean_losses, clean_net, _ = run(args.seed, args.steps)
+    print(f"[chaos] clean run: {args.steps} steps, "
+          f"final loss {clean_losses[-1]:.6f}")
+
+    # -- gate 2: chaos run matches bit-for-bit -------------------------
+    with fault.inject(args.spec, seed=args.seed) as stats:
+        chaos_losses, chaos_net, _ = run(args.seed, args.steps)
+        injected = {site: dict(v) for site, v in stats().items()}
+    total_injected = sum(v["injected"] for v in injected.values())
+    losses_equal = chaos_losses == clean_losses
+    clean_w, chaos_w = weights_of(clean_net), weights_of(chaos_net)
+    weights_equal = all(np.array_equal(a, chaos_w[k])
+                        for k, a in clean_w.items())
+    summary["gates"]["chaos_matches_clean"] = {
+        "pass": bool(losses_equal and weights_equal),
+        "faults_injected": injected,
+        "final_loss_clean": clean_losses[-1],
+        "final_loss_chaos": chaos_losses[-1]}
+    per_site = ", ".join(
+        "{}:{}".format(s, v["injected"]) for s, v in injected.items())
+    print(f"[chaos] chaos run: {total_injected} faults injected "
+          f"({per_site})")
+    print(f"[chaos] losses identical: {losses_equal}; "
+          f"weights bit-exact: {weights_equal}")
+    if total_injected == 0:
+        print("[chaos] WARNING: spec injected nothing — gate is vacuous")
+        ok = False
+    ok = ok and losses_equal and weights_equal
+
+    # -- gate 3: kill-during-write + bit-exact resume ------------------
+    ckpt_dir = tempfile.mkdtemp(prefix="chaos_ckpt_")
+    try:
+        mgr = checkpoint.CheckpointManager(ckpt_dir, keep_last=2)
+        half = args.steps // 2
+        full_losses, full_net, _ = run(
+            args.seed, args.steps, ckpt_mgr=mgr, ckpt_at=half,
+            kill_first_save=True)
+        # "crash": rebuild from nothing, restore, replay the tail
+        net2, tr2, _, _ = build(args.seed + 1)   # wrong init on purpose
+        meta = mgr.restore(block=net2, trainer=tr2)
+        resumed_losses, resumed_net, _ = run(
+            args.seed, args.steps, net=net2, trainer=tr2,
+            start_step=meta["step"] + 1)
+        tail_equal = resumed_losses == full_losses[half + 1:]
+        full_w, resumed_w = weights_of(full_net), weights_of(resumed_net)
+        resumed_weights_equal = all(np.array_equal(a, resumed_w[k])
+                                    for k, a in full_w.items())
+        summary["gates"]["crash_resume_bit_exact"] = {
+            "pass": bool(tail_equal and resumed_weights_equal),
+            "resumed_from_step": meta["step"]}
+        print(f"[chaos] resume from step {meta['step']}: tail losses "
+              f"identical: {tail_equal}; weights bit-exact: "
+              f"{resumed_weights_equal}")
+        ok = ok and tail_equal and resumed_weights_equal
+    finally:
+        shutil.rmtree(ckpt_dir, ignore_errors=True)
+
+    retry_counters = {}
+    for s in telemetry.snapshot()["metrics"].get(
+            "mxnet_retry_total", {}).get("samples", []):
+        retry_counters["/".join(s["labels"].values())] = s["value"]
+    summary["retry_counters"] = retry_counters
+    summary["ok"] = ok
+    if args.json:
+        with open(args.json, "w") as f:
+            json.dump(summary, f, indent=2)
+    print(f"[chaos] retries: {retry_counters or 'none'}")
+    print(f"[chaos] {'PASS' if ok else 'FAIL'}")
+    return 0 if ok else 1
+
+
+if __name__ == "__main__":
+    sys.exit(main())
