@@ -34,10 +34,11 @@ Methodology (PERF.md has the full story): synthetic data is staged on the
 device once before the timed loop, mirroring the reference's synthetic-data
 benchmark mode (`example/image-classification/benchmark_score.py` uses
 `mx.io.NDArrayIter` on pre-generated arrays). Input H2D transfer overlap is
-the data pipeline's job (io.PrefetchingIter), not the step's; in this
-environment the single TPU chip sits behind a network relay whose H2D
-bandwidth (~50 MB/s) would otherwise dominate and measure the tunnel, not
-the framework.
+the data pipeline's job (io.DeviceFeedIter — stage 5 runs the full async
+path: process decode workers -> shm -> async sharded device_put of uint8
+-> on-device normalize), not the step's; in this environment the single
+TPU chip sits behind a network relay whose H2D bandwidth (~50 MB/s) would
+otherwise dominate and measure the tunnel, not the framework.
 
 Env knobs: BENCH_BUDGET_S (float, default 1800), BENCH_SKIP_REALDATA,
 BENCH_SKIP_BERT, BENCH_SKIP_LLAMA, BENCH_SKIP_BULK,
@@ -298,25 +299,30 @@ def _bulk_extra(chain_len=64, reps=10):
 
 def _real_data_extra(batch, steps=10, img_size=224, n_images=2048):
     """Real-data mode (VERDICT round-2 #5, round-4 #3): the same fused
-    TrainStep fed by the full input pipeline — JPEG recordio on disk ->
-    ImageRecordIter (decode + random-crop + mirror + normalize on host
-    workers) -> PrefetchingIter overlap -> per-step device_put.
+    TrainStep fed by the full async input pipeline (PERF.md round 7) —
+    JPEG recordio on disk -> ImageIter with PROCESS decode workers
+    (decode + crop + mirror on uint8, shm transport) ->
+    io.DeviceFeedIter (async sharded device_put of quarter-size uint8
+    batches, normalize+bf16 cast ON DEVICE) -> pre-sharded no-op step
+    entry.
 
-    Round-5 methodology (the r4 single-window number spread 2.3x across
-    same-day runs): THREE timed windows, median reported with the spread;
-    plus the two reference rates that make the number interpretable on a
-    1-core host — the host-only pipeline rate (no device work) and the
-    device-only step rate (staged batch), from which device-busy%% is
-    derived (busy = device step time / real-data step time). Opt out
-    with BENCH_SKIP_REALDATA=1.
+    Methodology unchanged from round 5: THREE timed windows, median with
+    spread, plus the host-only producer rate and the device-only step
+    rate (busy%% = median / device-only). New: the bit-identity key —
+    one serial-decoded batch must equal the process-decoded batch under
+    the same seed (the acceptance contract for moving decode off-process).
+    Opt out with BENCH_SKIP_REALDATA=1; MXNET_DATA_WORKERS overrides the
+    decode worker count (default: all cores).
     """
     import tempfile
 
     if os.environ.get("BENCH_SKIP_REALDATA"):
         return {}
-    n_threads = int(os.environ.get("BENCH_REALDATA_THREADS", "4"))
-    step = _make_resnet_step(batch)
-    from mxnet_tpu import io as mxio, recordio
+    from mxnet_tpu import image as mximg, io as mxio, recordio
+
+    n_workers = int(os.environ.get(
+        "MXNET_DATA_WORKERS",
+        os.environ.get("BENCH_REALDATA_THREADS", str(os.cpu_count() or 2))))
 
     rec_path = os.path.join(tempfile.gettempdir(),
                             f"bench_imgs_{img_size}_{n_images}.rec")
@@ -330,58 +336,80 @@ def _real_data_extra(batch, steps=10, img_size=224, n_images=2048):
             writer.write(recordio.pack_img(header, img, quality=90))
         writer.close()
 
-    it = mxio.ImageRecordIter(
-        path_imgrec=rec_path, data_shape=(3, img_size, img_size),
-        batch_size=batch, rand_crop=False, rand_mirror=True,
-        preprocess_threads=n_threads,
-        mean_r=123.68, mean_g=116.78, mean_b=103.94,
-        std_r=58.4, std_g=57.1, std_b=57.4)
-    pf = mxio.PrefetchingIter(it)
+    # host augmenters stay on uint8 (crop + mirror); normalization moved
+    # onto the device so the wire carries 1/4 the bytes of the old f32
+    # host-normalized batch
+    def make_iter(mode, workers):
+        return mximg.ImageIter(
+            batch_size=batch, data_shape=(3, img_size, img_size),
+            path_imgrec=rec_path, seed=0, dtype="uint8",
+            worker_mode=mode, preprocess_threads=workers,
+            aug_list=[mximg.CenterCropAug((img_size, img_size)),
+                      mximg.HorizontalFlipAug(0.5)])
+
+    # bit-identity gate: same seed, serial vs process workers
+    it_a, it_b = make_iter("serial", 1), make_iter("process", n_workers)
+    ba, bb = it_a.next(), it_b.next()
+    identical = bool(
+        np.array_equal(ba.data[0].asnumpy(), bb.data[0].asnumpy())
+        and np.array_equal(ba.label[0].asnumpy(), bb.label[0].asnumpy()))
+    it_a.close()
+    it_b.close()
+
+    step = _make_resnet_step(batch)
+    it = make_iter("process", n_workers)
+    feed = mxio.DeviceFeedIter(
+        it, step=step, depth=2,
+        device_transform=mxio.make_normalize_transform(
+            [123.68, 116.78, 103.94], [58.4, 57.1, 57.4], "bfloat16"),
+        name="bench_real_data")
 
     def next_batch():
         try:
-            b = next(pf)
+            b = next(feed)
         except StopIteration:
-            pf.reset()
-            b = next(pf)
-        return (b.data[0].astype("bfloat16"),
-                b.label[0].reshape((-1,)).astype("float32"))
+            feed.reset()
+            b = next(feed)
+        return b.data[0], b.label[0]
 
-    # warm (decoders + any reshape recompile)
-    x, y = next_batch()
-    loss, _ = step(x, y)
-    loss.asnumpy()
-
-    # reference 1: device-only step rate on a staged batch
-    step.stage_batch(x, y)
-    loss, _ = step(x, y)
-    loss.asnumpy()
-    t0 = time.perf_counter()
-    for _ in range(steps):
+    try:
+        # warm (decoders + step compile on the fed shapes)
+        x, y = next_batch()
         loss, _ = step(x, y)
-    loss.asnumpy()
-    dev_img_s = batch * steps / (time.perf_counter() - t0)
+        loss.asnumpy()
 
-    # reference 2: host-only pipeline rate (no device work). Drain the
-    # prefetch queue first — it filled while the device-only loop ran
-    # with nobody consuming, and free pre-buffered batches would inflate
-    # the producer-bound rate this number exists to measure
-    for _ in range(3):
-        next_batch()
-    t0 = time.perf_counter()
-    for _ in range(steps):
-        next_batch()
-    host_img_s = batch * steps / (time.perf_counter() - t0)
-
-    # three measured windows of the full pipeline+train loop
-    rates = []
-    for _ in range(3):
+        # reference 1: device-only step rate on a staged batch
+        step.stage_batch(x, y)
+        loss, _ = step(x, y)
+        loss.asnumpy()
         t0 = time.perf_counter()
         for _ in range(steps):
-            xb, yb = next_batch()
-            loss, _ = step(xb, yb)
+            loss, _ = step(x, y)
         loss.asnumpy()
-        rates.append(batch * steps / (time.perf_counter() - t0))
+        dev_img_s = batch * steps / (time.perf_counter() - t0)
+
+        # reference 2: host-side producer rate (decode + async device
+        # dispatch, no step). Drain the prefetch queue first — it filled
+        # while the device-only loop ran with nobody consuming, and
+        # pre-buffered batches would inflate the producer-bound rate
+        for _ in range(3):
+            next_batch()
+        t0 = time.perf_counter()
+        for _ in range(steps):
+            next_batch()
+        host_img_s = batch * steps / (time.perf_counter() - t0)
+
+        # three measured windows of the full pipeline+train loop
+        rates = []
+        for _ in range(3):
+            t0 = time.perf_counter()
+            for _ in range(steps):
+                xb, yb = next_batch()
+                loss, _ = step(xb, yb)
+            loss.asnumpy()
+            rates.append(batch * steps / (time.perf_counter() - t0))
+    finally:
+        feed.close()  # closes the ImageIter decode pool through it
     rates.sort()
     med = rates[1]
     return {
@@ -392,7 +420,9 @@ def _real_data_extra(batch, steps=10, img_size=224, n_images=2048):
         "real_data_device_only_images_per_sec": round(dev_img_s, 2),
         # fraction of each real-data step the device is actually busy
         "real_data_device_busy_pct": round(100.0 * med / dev_img_s, 1),
-        "real_data_preprocess_threads": n_threads,
+        "real_data_preprocess_threads": n_workers,
+        "real_data_pipeline": "process-workers+uint8-shm+device-feed",
+        "real_data_worker_batches_bit_identical": identical,
     }
 
 

@@ -52,12 +52,21 @@ def main():
                         .astype(np.float32))
         batches = ((x, y) for _ in range(args.steps))
     else:
+        # the async input pipeline end to end: process decode workers on
+        # uint8 (MXNET_DATA_WORKERS to size the pool), batches staged
+        # onto the mesh ahead of the step with the step's own input
+        # sharding, normalize/bf16-cast on device (README "Input
+        # pipeline"). shuffle=True would need a .idx file
+        # (path_imgidx=...; build one with tools/im2rec.py).
         it = mx.io.ImageRecordIter(
             path_imgrec=args.rec, data_shape=(3, 224, 224),
-            batch_size=args.batch_size, shuffle=True, rand_mirror=True,
-            preprocess_threads=4)
-        it = mx.io.PrefetchingIter(it)
-        batches = ((b.data[0].astype("bfloat16"), b.label[0]) for b in it)
+            batch_size=args.batch_size, rand_mirror=True,
+            preprocess_threads=4, dtype="uint8")
+        it = mx.io.DeviceFeedIter(
+            it, step=step, depth=2,
+            device_transform=mx.io.make_normalize_transform(
+                [123.68, 116.78, 103.94], [58.4, 57.1, 57.4], "bfloat16"))
+        batches = ((b.data[0], b.label[0]) for b in it)
 
     t0, seen = time.time(), 0
     for i, (x, y) in enumerate(batches):

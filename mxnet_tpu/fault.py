@@ -11,7 +11,9 @@ module makes the failure paths *testable*:
   :func:`check` at a named point — ``engine.dispatch`` (every imperative
   op dispatch), ``kvstore.push`` / ``kvstore.pull`` /
   ``kvstore.allreduce`` (comms), ``checkpoint.write`` /
-  ``checkpoint.read`` (every atomic file commit / checkpoint load).
+  ``checkpoint.read`` (every atomic file commit / checkpoint load),
+  ``datafeed.put`` (each batch staged by the async input pipeline —
+  ``io.DeviceFeedIter``).
   Like telemetry, every call site guards on one module-level flag
   (``_state.enabled`` — a single attribute load + branch), so the
   disabled fast path costs one branch and allocates nothing.
@@ -69,6 +71,7 @@ SITES = (
     "kvstore.allreduce",
     "checkpoint.write",
     "checkpoint.read",
+    "datafeed.put",
 )
 
 

@@ -22,6 +22,11 @@ stacking implements the DEFAULT batchify only — same constraint as the
 reference's ``default_mp_batchify_fn``. Thread-pool mode (the default)
 shares an address space and needs no transport at all. A prefetch queue
 of ``2*num_workers`` batches keeps the device fed.
+
+``pin_memory=True`` stages each yielded batch onto
+``jax.devices()[pin_device_id]`` with an async ``device_put`` (see
+``_pin``); for mesh-sharded async prefetch onto a TrainStep's input
+layout, wrap the loader in ``io.DeviceFeedIter``.
 """
 from __future__ import annotations
 
@@ -35,7 +40,6 @@ from typing import Callable, Optional
 import numpy as _np
 
 from ...base import MXNetError
-from ...context import cpu_pinned
 from ...ndarray import NDArray, array as nd_array
 from .dataset import Dataset
 from .sampler import BatchSampler, RandomSampler, Sampler, SequentialSampler
@@ -84,28 +88,51 @@ def _stack_tree(samples):
     return _np.stack([_np.asarray(s) for s in samples])
 
 
-def _to_shm(tree):
-    """Copy batch arrays into shm blocks; return descriptor tree."""
+def _alloc_shm(shape, dtype, name=None):
+    """Create one worker-side shm block to fill in place.
+
+    Returns ``(descriptor, view, done)``: write the payload into ``view``
+    then call ``done()`` — it drops the worker's mapping and unregisters
+    the block from the worker-side resource tracker (the PARENT owns the
+    unlink; double-unlink at worker exit would race the consumer).
+    Decode workers fill samples straight into the block, skipping the
+    stack-then-copy intermediate ``_to_shm`` pays. ``name`` lets the
+    parent pre-assign the block name, so blocks whose descriptor never
+    arrives (worker timeout, pool terminate) remain sweepable by prefix
+    (``ImageIter.close``)."""
     from multiprocessing import shared_memory
 
+    dt = _np.dtype(dtype)
+    nbytes = int(_np.prod(shape)) * dt.itemsize
+    shm = shared_memory.SharedMemory(name=name, create=True,
+                                     size=max(nbytes, 1))
+    view = _np.ndarray(shape, dt, buffer=shm.buf)
+    name = shm.name
+
+    def done():
+        shm.close()
+        try:
+            from multiprocessing import resource_tracker
+
+            resource_tracker.unregister(
+                shm._name if hasattr(shm, "_name") else "/" + name,
+                "shared_memory")
+        except Exception:
+            pass
+
+    return (("__shm__", name, tuple(int(s) for s in shape), str(dt)),
+            view, done)
+
+
+def _to_shm(tree):
+    """Copy batch arrays into shm blocks; return descriptor tree."""
     if isinstance(tree, tuple):
         return tuple(_to_shm(t) for t in tree)
     arr = _np.ascontiguousarray(tree)
-    shm = shared_memory.SharedMemory(create=True, size=max(arr.nbytes, 1))
-    dst = _np.ndarray(arr.shape, arr.dtype, buffer=shm.buf)
-    dst[...] = arr
-    name = shm.name
-    shm.close()  # drop the worker's mapping; the block outlives it
-    try:
-        # the parent owns the unlink; keep the worker-side resource
-        # tracker from double-unlinking at worker exit
-        from multiprocessing import resource_tracker
-
-        resource_tracker.unregister(shm._name if hasattr(shm, "_name")
-                                    else "/" + name, "shared_memory")
-    except Exception:
-        pass
-    return ("__shm__", name, tuple(arr.shape), str(arr.dtype))
+    desc, view, done = _alloc_shm(arr.shape, arr.dtype)
+    view[...] = arr
+    done()
+    return desc
 
 
 def _unlink_shm(tree):
@@ -126,8 +153,11 @@ def _unlink_shm(tree):
             _unlink_shm(t)
 
 
-def _from_shm(tree):
-    """Map descriptor tree back into NDArrays; unlink the blocks."""
+def _from_shm_numpy(tree):
+    """Map a descriptor tree back into HOST numpy arrays; unlink the
+    blocks. The numpy-only rebuild exists for consumers that must stay
+    off the device (``image.ImageIter``'s decode workers assemble numpy
+    batches; wrapping into NDArrays here would device_put every chunk)."""
     from multiprocessing import shared_memory
 
     if isinstance(tree, tuple) and len(tree) == 4 and tree[0] == "__shm__":
@@ -139,13 +169,39 @@ def _from_shm(tree):
         # a live alias segfaults. The IPC hop itself stayed descriptor-
         # only; this is the one host copy the reference's shm rebuild
         # also pays (NDArray over shm -> consumer copy on first write).
-        nd = nd_array(view.copy())
+        arr = view.copy()
         shm.close()
         shm.unlink()
-        return nd
+        return arr
+    if isinstance(tree, tuple):
+        return [_from_shm_numpy(t) for t in tree]
+    return tree
+
+
+def _from_shm(tree):
+    """Map descriptor tree back into NDArrays; unlink the blocks."""
+    if isinstance(tree, tuple) and len(tree) == 4 and tree[0] == "__shm__":
+        return nd_array(_from_shm_numpy(tree))
     if isinstance(tree, tuple):
         return [_from_shm(t) for t in tree]
     return tree
+
+
+def _from_shm_into(desc, out, ofs=0):
+    """Copy one block's payload straight into ``out[ofs:ofs+n]`` (one
+    memcpy, no intermediate array) and unlink it; returns n. The batch-
+    assembly fast path for consumers that own a preallocated buffer
+    (``image.ImageIter``'s decode chunks)."""
+    from multiprocessing import shared_memory
+
+    _, name, shape, dtype = desc
+    shm = shared_memory.SharedMemory(name=name)
+    view = _np.ndarray(shape, dtype, buffer=shm.buf)
+    n = shape[0]
+    out[ofs:ofs + n] = view
+    shm.close()
+    shm.unlink()
+    return n
 
 
 def _worker_fn(samples, batchify_is_default, use_shm=False):
@@ -168,6 +224,7 @@ class DataLoader:
                  prefetch=None, thread_pool=False, timeout=120):
         self._dataset = dataset
         self._pin_memory = pin_memory
+        self._pin_device_id = pin_device_id
         self._thread_pool = thread_pool
         self._timeout = timeout
         if batch_sampler is None:
@@ -258,7 +315,7 @@ class DataLoader:
                     # tuple sample (ANY arity) -> list of arrays, a bare
                     # array sample -> one array
                     if self._pin_memory:
-                        batch = _pin(batch)
+                        batch = _pin(batch, self._pin_device_id)
                     yield batch
                 else:
                     yield self._batchify(samples)
@@ -285,7 +342,7 @@ class DataLoader:
     def _batchify(self, samples):
         batch = self._batchify_fn(samples)
         if self._pin_memory:
-            batch = _pin(batch)
+            batch = _pin(batch, self._pin_device_id)
         return batch
 
     def __del__(self):
@@ -297,7 +354,13 @@ class DataLoader:
                 pass  # interpreter shutdown: pool internals may be gone
 
 
-def _pin(batch):
-    if isinstance(batch, (list, tuple)):
-        return [_pin(b) for b in batch]
-    return batch.as_in_context(cpu_pinned())
+def _pin(batch, device_id=0):
+    """``pin_memory`` routed through the device-feed staging path: the
+    batch payloads are ``device_put`` onto ``jax.devices()[device_id]``
+    (async — the H2D copy overlaps the consumer's compute), the TPU
+    analogue of the reference's pinned-host staging buffers. For sharded
+    multi-device placement wrap the loader in ``io.DeviceFeedIter``
+    instead, which also prefetches ahead."""
+    from ...io.device_feed import stage_on_device
+
+    return stage_on_device(batch, device_id)

@@ -223,6 +223,9 @@ class ImageDetIter(ImageIter):
                  label_shape=None, **kwargs):
         if aug_list is None:
             aug_list = CreateDetAugmenter(data_shape)
+        # ImageDetIter.next() decodes inline (no pool); don't let env
+        # MXNET_DATA_WORKERS fork a process pool it would never use
+        kwargs.setdefault("worker_mode", "serial")
         super().__init__(batch_size, data_shape, path_imgrec=path_imgrec,
                          path_imgidx=path_imgidx, shuffle=shuffle,
                          aug_list=[], label_width=1, **kwargs)

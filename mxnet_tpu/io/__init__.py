@@ -9,7 +9,10 @@ bind/step time (per-host sharded `device_put` on pods).
 from .io import (DataDesc, DataBatch, DataIter, NDArrayIter, ResizeIter,
                  PrefetchingIter, CSVIter, LibSVMIter, MNISTIter,
                  ImageRecordIter)
+from .device_feed import (DeviceFeedIter, make_normalize_transform,
+                          stage_on_device)
 
 __all__ = ["DataDesc", "DataBatch", "DataIter", "NDArrayIter", "ResizeIter",
            "PrefetchingIter", "CSVIter", "LibSVMIter", "MNISTIter",
-           "ImageRecordIter"]
+           "ImageRecordIter", "DeviceFeedIter", "stage_on_device",
+           "make_normalize_transform"]

@@ -2,15 +2,18 @@
 from __future__ import annotations
 
 import threading
+import time
 import queue as _queue
 from collections import namedtuple
 from typing import Dict, List, Optional
 
 import numpy as _np
 
+from .. import telemetry
 from ..base import MXNetError
 from ..context import cpu
 from ..ndarray import NDArray, array as nd_array
+from ..telemetry import _state as _telemetry_state
 
 __all__ = ["ImageRecordIter",
            "DataDesc", "DataBatch", "DataIter", "NDArrayIter", "ResizeIter",
@@ -231,11 +234,193 @@ class ResizeIter(DataIter):
         return self.current_batch.pad or 0
 
 
-class PrefetchingIter(DataIter):
+class _WorkerFailure:
+    """Queue sentinel: the producer thread died on ``exc``."""
+
+    __slots__ = ("exc",)
+
+    def __init__(self, exc):
+        self.exc = exc
+
+
+class _AsyncStage(DataIter):
+    """Producer-thread machinery shared by the async pipeline stages
+    (``PrefetchingIter``, ``io.DeviceFeedIter``): a daemon thread fills
+    a bounded queue from :meth:`_produce`; the consumer pops.
+
+    The lifecycle contract, implemented once here:
+
+    * post-exhaustion ``next()`` raises ``StopIteration`` immediately
+      (the worker is gone — blocking on its queue would hang forever);
+    * a producer crash surfaces at ``next()`` as ``MXNetError``, never a
+      hang, and stays sticky;
+    * ``reset()`` restarts; ``close()`` is idempotent, joins the worker,
+      closes the wrapped source, and makes further ``next()`` an error;
+    * every worker generation binds its own ``(queue, stop)`` pair,
+      and ``_shutdown_worker`` replaces BOTH unconditionally — an
+      in-flight put that slipped past the drain, or a join-timeout
+      zombie, writes into the orphaned queue, never the successor's.
+
+    Subclasses implement ``_produce()`` (one item or StopIteration),
+    ``_source_obj()`` (the wrapped iterator, for reset/close chaining),
+    optionally ``_on_start()`` (rebind the source iterator) and set
+    ``_stage_name`` (telemetry label).
+    """
+
+    _stage_name = "async_stage"
+
+    def __init__(self, batch_size=0, depth=2, thread_name="mxnet-stage"):
+        super().__init__(batch_size)
+        self._depth = max(1, int(depth))
+        self._thread_name = thread_name
+        self._queue: _queue.Queue = _queue.Queue(maxsize=self._depth)
+        self._stop = threading.Event()
+        self._thread = None
+        self._current = None
+        self._exhausted = False
+        self._failure = None
+        self._closed = False
+
+    # -- subclass surface ----------------------------------------------
+    def _produce(self):
+        """Produce one staged item; raise StopIteration when drained."""
+        raise NotImplementedError
+
+    def _source_obj(self):
+        """The wrapped iterator (reset()/close() chain to it)."""
+        raise NotImplementedError
+
+    def _on_start(self):
+        """Hook run before each worker generation starts."""
+
+    def _raise_failure(self):
+        raise MXNetError(
+            f"{type(self).__name__} worker thread died: "
+            f"{self._failure!r}") from self._failure
+
+    # -- producer ------------------------------------------------------
+    @staticmethod
+    def _stop_aware_put(q, stop, item) -> bool:
+        """Bounded put that never blocks forever on a full queue whose
+        consumer has gone away (close/reset drains concurrently)."""
+        while not stop.is_set():
+            try:
+                q.put(item, timeout=0.05)
+                return True
+            except _queue.Full:
+                continue
+        return False
+
+    def _worker(self, q, stop):
+        try:
+            while not stop.is_set():
+                try:
+                    item = self._produce()
+                except StopIteration:
+                    self._stop_aware_put(q, stop, None)
+                    return
+                if not self._stop_aware_put(q, stop, item):
+                    return
+                if _telemetry_state.enabled:
+                    telemetry.set_data_queue_depth(self._stage_name,
+                                                   q.qsize())
+        except BaseException as e:  # noqa: BLE001 - delivered to consumer
+            # a dead producer must surface as an error at the consumer,
+            # not as a next() that blocks on an empty queue forever
+            self._stop_aware_put(q, stop, _WorkerFailure(e))
+
+    def _start(self):
+        self._on_start()
+        self._thread = threading.Thread(
+            target=self._worker, args=(self._queue, self._stop),
+            daemon=True, name=self._thread_name)
+        self._thread.start()
+
+    def _shutdown_worker(self):
+        self._stop.set()
+        try:
+            while True:
+                self._queue.get_nowait()
+        except _queue.Empty:
+            pass
+        if self._thread is not None:
+            self._thread.join(timeout=5)
+            self._thread = None
+        # fresh generation objects UNCONDITIONALLY: a put in flight
+        # during the drain (or a zombie that outlived the join timeout)
+        # lands in the orphaned queue, so no stale batch or None
+        # sentinel can leak into the successor epoch
+        self._queue = _queue.Queue(maxsize=self._depth)
+        self._stop = threading.Event()
+
+    # -- consumer / lifecycle ------------------------------------------
+    def reset(self):
+        if self._closed:
+            raise MXNetError(f"{type(self).__name__} is closed")
+        self._shutdown_worker()
+        inner_reset = getattr(self._source_obj(), "reset", None)
+        if inner_reset is not None:
+            inner_reset()
+        self._exhausted = False
+        self._failure = None
+        self._start()
+
+    def close(self):
+        """Stop + join the worker and close the wrapped source
+        (idempotent; also runs on GC)."""
+        if self._closed:
+            return
+        self._closed = True
+        self._shutdown_worker()
+        inner_close = getattr(self._source_obj(), "close", None)
+        if inner_close is not None:
+            inner_close()
+
+    def __del__(self):  # pragma: no cover - GC timing
+        try:
+            self.close()
+        except Exception:
+            pass
+
+    def iter_next(self):
+        if self._closed:
+            raise MXNetError(
+                f"{type(self).__name__} is closed; next() after close() "
+                "would block on the dead worker's queue")
+        if self._failure is not None:
+            self._raise_failure()
+        if self._exhausted:
+            return False
+        t0 = time.perf_counter()
+        item = self._queue.get()
+        if _telemetry_state.enabled:
+            telemetry.record_data_wait(time.perf_counter() - t0,
+                                       self._stage_name)
+            telemetry.set_data_queue_depth(self._stage_name,
+                                           self._queue.qsize())
+        if item is None:
+            self._exhausted = True
+            return False
+        if isinstance(item, _WorkerFailure):
+            self._failure = item.exc
+            self._raise_failure()
+        self._current = item
+        return True
+
+    def next(self):
+        if self.iter_next():
+            return self._current
+        raise StopIteration
+
+
+class PrefetchingIter(_AsyncStage):
     """Threaded prefetch over one or more iters
     (reference: io.py::PrefetchingIter; the C++ analogue is
     src/io/iter_prefetcher.h). Host-side pipelining: the next batch is
-    prepared while the device crunches the current one."""
+    prepared while the device crunches the current one. Lifecycle per
+    :class:`_AsyncStage` (shared with ``io.DeviceFeedIter``)."""
+
+    _stage_name = "prefetch"
 
     def __init__(self, iters, rename_data=None, rename_label=None,
                  prefetch_depth=2):
@@ -245,11 +430,8 @@ class PrefetchingIter(DataIter):
             raise MXNetError("PrefetchingIter: composite mode not supported; "
                              "pass one iterator")
         self.iter = iters[0]
-        super().__init__(self.iter.batch_size)
-        self._queue: _queue.Queue = _queue.Queue(maxsize=prefetch_depth)
-        self._stop = threading.Event()
-        self._thread = None
-        self._current = None
+        super().__init__(self.iter.batch_size, depth=prefetch_depth,
+                         thread_name="mxnet-prefetch")
         self._start()
 
     @property
@@ -260,40 +442,11 @@ class PrefetchingIter(DataIter):
     def provide_label(self):
         return self.iter.provide_label
 
-    def _worker(self):
-        while not self._stop.is_set():
-            try:
-                batch = self.iter.next()
-            except StopIteration:
-                self._queue.put(None)
-                return
-            self._queue.put(batch)
+    def _source_obj(self):
+        return self.iter
 
-    def _start(self):
-        self._thread = threading.Thread(target=self._worker, daemon=True)
-        self._thread.start()
-
-    def reset(self):
-        self._stop.set()
-        try:
-            while True:
-                self._queue.get_nowait()
-        except _queue.Empty:
-            pass
-        if self._thread is not None:
-            self._thread.join(timeout=5)
-        self._stop.clear()
-        self.iter.reset()
-        self._start()
-
-    def iter_next(self):
-        self._current = self._queue.get()
-        return self._current is not None
-
-    def next(self):
-        if self.iter_next():
-            return self._current
-        raise StopIteration
+    def _produce(self):
+        return self.iter.next()
 
     def getdata(self):
         return self._current.data
@@ -482,8 +635,17 @@ def ImageRecordIter(path_imgrec=None, path_imgidx=None, data_shape=None,
         # std-only normalization still needs the ColorNormalizeAug (a
         # zero mean), matching the C++ iterator's independent std divide
         mean = _np.array([mean_r, mean_g, mean_b], _np.float32)
+    dtype = kwargs.get("dtype", "float32")
+    if mean is not None and _np.issubdtype(_np.dtype(dtype), _np.integer):
+        raise MXNetError(
+            f"ImageRecordIter: mean/std normalization produces floats — "
+            f"incompatible with dtype={dtype!r} (an integer cast would "
+            "wrap). Ship integer pixels and normalize on device via "
+            "io.DeviceFeedIter(device_transform=io.make_normalize_"
+            "transform(mean, std)), or use a float dtype")
     aug = CreateAugmenter(data_shape, resize=resize, rand_crop=rand_crop,
-                          rand_mirror=rand_mirror, mean=mean, std=std)
+                          rand_mirror=rand_mirror, mean=mean, std=std,
+                          dtype=dtype)
     return ImageIter(batch_size=batch_size, data_shape=data_shape,
                      path_imgrec=path_imgrec, path_imgidx=path_imgidx,
                      shuffle=shuffle, aug_list=aug, label_width=label_width,

@@ -62,12 +62,18 @@ class TrainStep:
         (default ``('dp',)``; pass e.g. ``('dp','fsdp')`` for combined axes).
     seq_axis : optional mesh axis for sequence sharding of rank>=2 inputs
         (dimension 1) — context parallelism for long sequences.
+    donate_inputs : donate the batch buffers to the executable (XLA may
+        reuse their HBM for activations). Only for single-use batches —
+        an async input pipeline (``io.DeviceFeedIter``) stages a fresh
+        buffer per step; a benchmark replaying one staged batch must NOT
+        set this (the donated buffer is dead after the call).
     """
 
     def __init__(self, net, loss, optimizer, mesh=None,
                  rules: Optional[ShardingRules] = None,
                  batch_axis: Sequence[str] = ("dp",), seq_axis=None,
-                 optimizer_params=None, loss_only=False):
+                 optimizer_params=None, loss_only=False,
+                 donate_inputs=False):
         self.net = net
         self.loss = loss
         # loss_only: don't return model outputs from the step — for nets
@@ -85,6 +91,7 @@ class TrainStep:
         self.batch_axis = tuple(a for a in _as_tuple(batch_axis)
                                 if a in mesh.axis_names)
         self.seq_axis = seq_axis if (seq_axis in mesh.axis_names) else None
+        self.donate_inputs = bool(donate_inputs)
         self._cache: Dict = {}
         self._params = None          # List[Parameter]
         self._param_specs = None     # per-param PartitionSpec
@@ -448,13 +455,17 @@ class TrainStep:
         batch_sh = tuple(ns(self._batch_spec(v))
                          for v in list(data_tuple) + list(label_tuple))
         in_sh = (param_sh, state_sh, rep, rep, rep) + batch_sh
+        donate = (0, 1)
+        if self.donate_inputs:
+            # batch args start after (params, states, t, lr, rng)
+            donate = donate + tuple(range(5, 5 + len(batch_sh)))
         # outputs: params/states keep their layout (no per-step reshard);
         # loss replicated; model outputs/aux left to XLA (None = inferred)
         jitted = jax.jit(
             step_fn,
             in_shardings=in_sh,
             out_shardings=(param_sh, state_sh, rep, None, None),
-            donate_argnums=(0, 1),
+            donate_argnums=donate,
         )
         return {"jitted": jitted, "cell": cell, "batch_sh": batch_sh,
                 "loss_only": loss_only}
@@ -568,6 +579,19 @@ class TrainStep:
 
         restore_sharded(self, directory, example_data=example_data)
 
+    def input_shardings(self, data, label=()):
+        """The NamedShardings this step will place its batch inputs with,
+        one per array in ``(data..., label...)`` order.
+
+        The async input pipeline's contract (``io.DeviceFeedIter`` passes
+        itself as the consumer): a batch ``device_put`` with exactly
+        these shardings enters ``__call__`` as a true no-op. Works before
+        the first step — only the mesh and batch/seq axes are consulted,
+        arrays just need ``shape``/``ndim`` (NDArray, numpy, jax, or
+        ShapeDtypeStruct)."""
+        return tuple(named_sharding(self.mesh, self._batch_spec(v))
+                     for v in _as_tuple(data) + _as_tuple(label))
+
     def stage_batch(self, data, label=()):
         """Place host batches on the mesh with this step's input sharding.
 
@@ -623,10 +647,17 @@ class TrainStep:
         param_vals = tuple(p.data().data for p in self._params)
         state_vals = tuple(s.data for s in self._state_leaf_nds)
         # explicit device_put: host batches become sharded global arrays
-        # (each host feeds its slice on pods — SURVEY.md §7.1 "Data")
-        batch_vals = [jax.device_put(v.data, sh)
-                      for v, sh in zip(data_tuple + label_tuple,
-                                       entry["batch_sh"])]
+        # (each host feeds its slice on pods — SURVEY.md §7.1 "Data").
+        # A batch already carrying the exact target sharding (staged by
+        # io.DeviceFeedIter / stage_batch) skips the put entirely — the
+        # async-pipeline contract that makes entry a true no-op.
+        batch_vals = []
+        for v, sh in zip(data_tuple + label_tuple, entry["batch_sh"]):
+            d = v.data
+            if getattr(d, "sharding", None) == sh:
+                batch_vals.append(d)
+            else:
+                batch_vals.append(jax.device_put(d, sh))
         from ..base import execution_platform
         from .mesh import use_mesh
 

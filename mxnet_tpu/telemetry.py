@@ -51,6 +51,7 @@ __all__ = [
     "record_training_step", "record_xla_dispatch", "record_bulk_flush",
     "record_fault_injected", "record_retry", "record_checkpoint_write",
     "record_step_skipped",
+    "record_data_wait", "set_data_queue_depth", "record_images_decoded",
     "TrainingTelemetry", "xla_cost_analysis",
     "pop_telemetry_out_flag", "write_snapshot",
     "LATENCY_BUCKETS", "STEP_BUCKETS", "SEGMENT_BUCKETS",
@@ -558,6 +559,37 @@ def record_step_skipped(reason: str) -> None:
     counter("mxnet_steps_skipped_total",
             "Training steps skipped by anomaly guards, by reason.",
             ("reason",)).labels(reason).inc()
+
+
+def record_data_wait(seconds: float, stage: str = "device_feed") -> None:
+    """Time the consumer blocked waiting on an input-pipeline stage.
+
+    The host-vs-device starvation discriminator: a real-data step whose
+    ``mxnet_data_wait_seconds`` sum approaches wall time is host-starved
+    (feed the device more); one near zero is device-bound (the pipeline
+    keeps up)."""
+    if not _state.enabled:
+        return
+    histogram("mxnet_data_wait_seconds",
+              "Time the training loop blocked waiting for the input "
+              "pipeline, by stage.", ("stage",)).labels(stage).observe(seconds)
+
+
+def set_data_queue_depth(stage: str, depth: int) -> None:
+    """Prefetched batches currently ready in a pipeline stage's queue."""
+    if not _state.enabled:
+        return
+    gauge("mxnet_data_queue_depth",
+          "Prefetched batches ready per input-pipeline stage.",
+          ("stage",)).labels(stage).set(depth)
+
+
+def record_images_decoded(n: int) -> None:
+    """Images decoded+augmented by the host input pipeline."""
+    if not _state.enabled or n <= 0:
+        return
+    counter("mxnet_data_decoded_images_total",
+            "Images decoded and augmented by the input pipeline.").inc(n)
 
 
 def record_training_step(seconds: float, examples: float,

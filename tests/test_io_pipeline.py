@@ -1,0 +1,556 @@
+"""Async input pipeline tests (PR: device-feed input pipeline).
+
+Covers the three pipeline stages end to end on the 8-device CPU mesh:
+
+* ``image.ImageIter`` process decode workers — bit-identical to the
+  serial path under fixed seeds, shm hygiene, close() protocol;
+* ``io.PrefetchingIter`` — post-exhaustion StopIteration (regression:
+  next() after the final None used to block forever on the dead
+  worker's queue), worker-error surfacing, close/join;
+* ``io.DeviceFeedIter`` — sharded staging matching
+  ``TrainStep.input_shardings``, on-device transform, reset/exhaustion/
+  close semantics, ``mxnet_data_wait_seconds`` emission, and the
+  ``datafeed.put`` fault site surfacing as MXNetError instead of a hang.
+"""
+import glob
+import threading
+import time
+
+import numpy as np
+import pytest
+
+import mxnet_tpu as mx
+from mxnet_tpu import fault, image as mimg, io as mxio, recordio, telemetry
+from mxnet_tpu.base import MXNetError
+
+pytestmark = pytest.mark.io
+
+
+# ---------------------------------------------------------------------------
+# helpers
+# ---------------------------------------------------------------------------
+
+def _write_rec(path, n=24, size=40, indexed=False):
+    rs = np.random.RandomState(0)
+    writer = recordio.MXIndexedRecordIO(str(path) + ".idx", str(path), "w") \
+        if indexed else recordio.MXRecordIO(str(path), "w")
+    for i in range(n):
+        img = rs.randint(0, 256, (size, size, 3), np.uint8)
+        rec = recordio.pack_img(recordio.IRHeader(0, float(i), i, 0), img,
+                                quality=90)
+        if indexed:
+            writer.write_idx(i, rec)
+        else:
+            writer.write(rec)
+    writer.close()
+    return str(path)
+
+
+def _aug():
+    return [mimg.RandomCropAug((32, 32)), mimg.HorizontalFlipAug(0.5)]
+
+
+class _SlowAug:
+    """Module-level (fork-inheritable) augmenter that outruns a short
+    worker_timeout."""
+
+    def __call__(self, src):
+        time.sleep(3.0)
+        return src
+
+
+def _image_iter(rec, mode, workers=2, seed=7, dtype="uint8", **kw):
+    return mimg.ImageIter(batch_size=8, data_shape=(3, 32, 32),
+                          path_imgrec=rec, aug_list=_aug(), seed=seed,
+                          dtype=dtype, worker_mode=mode,
+                          preprocess_threads=workers, **kw)
+
+
+def _drain(it):
+    out = []
+    try:
+        while True:
+            b = it.next()
+            out.append((b.data[0].asnumpy(), b.label[0].asnumpy()))
+    except StopIteration:
+        pass
+    return out
+
+
+def _pipeline_threads():
+    return [t for t in threading.enumerate()
+            if t.name.startswith(("mxnet-prefetch", "mxnet-"))
+            and t.is_alive()]
+
+
+# ---------------------------------------------------------------------------
+# ImageIter decode workers
+# ---------------------------------------------------------------------------
+
+class TestImageIterWorkers:
+    def test_process_bit_identical_to_serial(self, tmp_path):
+        """The acceptance contract: seeded augmenters make process-worker
+        batches EQUAL the single-thread path's, across epochs."""
+        rec = _write_rec(tmp_path / "a.rec")
+        pre = set(glob.glob("/dev/shm/psm_*"))
+        it_s = _image_iter(rec, "serial", 1)
+        it_p = _image_iter(rec, "process", 2)
+        for epoch in range(2):
+            a, b = _drain(it_s), _drain(it_p)
+            assert len(a) == len(b) == 3
+            for (da, la), (db, lb) in zip(a, b):
+                np.testing.assert_array_equal(da, db)
+                np.testing.assert_array_equal(la, lb)
+            it_s.reset()
+            it_p.reset()
+        it_s.close()
+        it_p.close()
+        # the parent unlinked every chunk block it consumed
+        assert not set(glob.glob("/dev/shm/psm_*")) - pre
+
+    def test_float32_default_augmenters_identical(self, tmp_path):
+        """Same contract through the full float pipeline (cast +
+        normalize + jitter augmenters from CreateAugmenter)."""
+        rec = _write_rec(tmp_path / "b.rec", n=8)
+        aug = lambda: mimg.CreateAugmenter(  # noqa: E731
+            (3, 32, 32), rand_crop=True, rand_mirror=True, brightness=0.2,
+            mean=np.array([1.0, 2.0, 3.0]), std=np.array([4.0, 5.0, 6.0]))
+        outs = []
+        for mode, w in (("serial", 1), ("process", 2)):
+            it = mimg.ImageIter(batch_size=8, data_shape=(3, 32, 32),
+                                path_imgrec=rec, aug_list=aug(), seed=3,
+                                worker_mode=mode, preprocess_threads=w)
+            outs.append(it.next().data[0].asnumpy())
+            it.close()
+        assert outs[0].dtype == np.float32
+        np.testing.assert_array_equal(outs[0], outs[1])
+
+    def test_seeded_shuffle_is_deterministic(self, tmp_path):
+        rec = _write_rec(tmp_path / "c.rec", indexed=True)
+        labels = []
+        for _ in range(2):
+            it = mimg.ImageIter(batch_size=8, data_shape=(3, 32, 32),
+                                path_imgrec=rec, path_imgidx=rec + ".idx",
+                                shuffle=True, aug_list=_aug(), seed=11,
+                                worker_mode="serial")
+            labels.append(np.concatenate(
+                [lab for _, lab in _drain(it)]))
+            it.close()
+        np.testing.assert_array_equal(labels[0], labels[1])
+        assert not np.array_equal(labels[0], np.sort(labels[0]))
+
+    def test_close_idempotent_and_pool_gone(self, tmp_path):
+        rec = _write_rec(tmp_path / "d.rec", n=8)
+        it = _image_iter(rec, "process", 2)
+        it.next()
+        assert it._pool is not None
+        it.close()
+        assert it._pool is None
+        it.close()  # idempotent
+
+    def test_worker_failure_raises_mxnet_error(self, tmp_path):
+        """A crashing decode worker surfaces as MXNetError, not a hang,
+        and leaks no shm blocks."""
+        path = str(tmp_path / "bad.rec")
+        writer = recordio.MXRecordIO(path, "w")
+        for i in range(8):
+            writer.write(recordio.pack(recordio.IRHeader(0, 0.0, i, 0),
+                                       b"not a jpeg"))
+        writer.close()
+        pre = set(glob.glob("/dev/shm/psm_*"))
+        it = mimg.ImageIter(batch_size=8, data_shape=(3, 32, 32),
+                            path_imgrec=path, aug_list=_aug(),
+                            worker_mode="process", preprocess_threads=2)
+        with pytest.raises(MXNetError, match="decode worker"):
+            it.next()
+        it.close()
+        assert not set(glob.glob("/dev/shm/psm_*")) - pre
+
+    def test_env_knob_selects_process_mode(self, tmp_path, monkeypatch):
+        monkeypatch.setenv("MXNET_DATA_WORKERS", "2")
+        rec = _write_rec(tmp_path / "e.rec", n=8)
+        it = mimg.ImageIter(batch_size=8, data_shape=(3, 32, 32),
+                            path_imgrec=rec, aug_list=_aug())
+        assert it._worker_mode == "process" and it._n_workers == 2
+        it.next()
+        it.close()
+
+    def test_bad_worker_mode_rejected(self, tmp_path):
+        rec = _write_rec(tmp_path / "f.rec", n=8)
+        with pytest.raises(MXNetError, match="worker_mode"):
+            mimg.ImageIter(batch_size=8, data_shape=(3, 32, 32),
+                           path_imgrec=rec, worker_mode="gpu")
+
+    def test_uint8_with_host_normalization_rejected(self, tmp_path):
+        """Review regression: normalized floats cast to uint8 WRAP into
+        garbage — both the factory and the decode path must refuse."""
+        rec = _write_rec(tmp_path / "n.rec", n=8)
+        with pytest.raises(MXNetError, match="incompatible with dtype"):
+            mxio.ImageRecordIter(path_imgrec=rec, data_shape=(3, 32, 32),
+                                 batch_size=4, mean_r=123.0, dtype="uint8")
+        it = mimg.ImageIter(
+            batch_size=4, data_shape=(3, 32, 32), path_imgrec=rec,
+            aug_list=[mimg.CenterCropAug((32, 32)),
+                      mimg.ColorNormalizeAug([1, 2, 3], [4, 5, 6])],
+            dtype="uint8", worker_mode="serial")
+        with pytest.raises(MXNetError, match="dtype"):
+            it.next()
+        it.close()
+
+    def test_factory_uint8_pipeline_stays_uint8(self, tmp_path):
+        """ImageRecordIter(dtype='uint8') without normalization emits a
+        cast-free uint8 batch (CreateAugmenter is dtype-aware)."""
+        rec = _write_rec(tmp_path / "u8.rec", n=8, size=32)
+        it = mxio.ImageRecordIter(path_imgrec=rec, data_shape=(3, 32, 32),
+                                  batch_size=4, rand_mirror=True,
+                                  dtype="uint8", worker_mode="serial")
+        b = it.next()
+        assert b.data[0].asnumpy().dtype == np.uint8
+        it.close()
+
+    def test_worker_timeout_blocks_swept_on_close(self, tmp_path):
+        """A chunk that exceeds worker_timeout errors out cleanly and its
+        orphaned shm block (descriptor never arrived) is swept by
+        close() via the parent-assigned name prefix."""
+        rec = _write_rec(tmp_path / "slow.rec", n=4)
+        it = mimg.ImageIter(batch_size=4, data_shape=(3, 32, 32),
+                            path_imgrec=rec, aug_list=[_SlowAug()],
+                            worker_mode="process", preprocess_threads=2,
+                            worker_timeout=0.5)
+        with pytest.raises(MXNetError, match="decode worker"):
+            it.next()
+        time.sleep(0.3)  # let a worker reach its _alloc_shm
+        it.close()
+        assert not glob.glob(f"/dev/shm/{it._shm_prefix}*")
+
+
+# ---------------------------------------------------------------------------
+# PrefetchingIter lifecycle (regression: post-exhaustion deadlock)
+# ---------------------------------------------------------------------------
+
+class _CloseRecordingIter(mxio.NDArrayIter):
+    closed = False
+
+    def close(self):
+        self.closed = True
+
+
+class TestPrefetchingIter:
+    def _iter(self, n=32, batch=8, cls=mxio.NDArrayIter):
+        data = np.arange(n * 4, dtype="float32").reshape(n, 4)
+        label = np.arange(n, dtype="float32")
+        return cls(data, label, batch_size=batch)
+
+    def test_post_exhaustion_raises_immediately(self):
+        pf = mxio.PrefetchingIter(self._iter())
+        assert len(_drain(pf)) == 4
+        # regression: this next() used to block forever on the dead
+        # worker's empty queue
+        t0 = time.perf_counter()
+        with pytest.raises(StopIteration):
+            pf.next()
+        with pytest.raises(StopIteration):
+            next(pf)
+        assert time.perf_counter() - t0 < 2.0
+        pf.close()
+
+    def test_reset_after_exhaustion_restarts(self):
+        pf = mxio.PrefetchingIter(self._iter())
+        _drain(pf)
+        pf.reset()
+        assert len(_drain(pf)) == 4
+        pf.close()
+
+    def test_close_joins_worker_and_inner(self):
+        inner = self._iter(cls=_CloseRecordingIter)
+        pf = mxio.PrefetchingIter(inner)
+        pf.next()
+        thread = pf._thread
+        pf.close()
+        assert thread is None or not thread.is_alive()
+        assert pf._thread is None
+        assert inner.closed
+        pf.close()  # idempotent
+
+    def test_no_worker_thread_leak_per_epoch(self):
+        """Daemon prefetch threads must not accumulate across epochs."""
+        pf = mxio.PrefetchingIter(self._iter())
+        for _ in range(5):
+            _drain(pf)
+            pf.reset()
+        alive = [t for t in threading.enumerate()
+                 if t.name == "mxnet-prefetch" and t.is_alive()]
+        assert len(alive) == 1  # exactly the current epoch's worker
+        pf.close()
+        time.sleep(0.1)
+        alive = [t for t in threading.enumerate()
+                 if t.name == "mxnet-prefetch" and t.is_alive()]
+        assert not alive
+
+    def test_inner_error_surfaces_not_hangs(self):
+        class Boom(mxio.NDArrayIter):
+            def next(self):
+                raise ValueError("decode exploded")
+
+        pf = mxio.PrefetchingIter(
+            Boom(np.zeros((8, 2), "float32"), batch_size=4))
+        with pytest.raises(MXNetError, match="worker thread died"):
+            _drain(pf)
+        pf.close()
+
+    def test_next_after_close_raises_not_hangs(self):
+        """Regression (review): next() on a closed iterator must error,
+        not block forever on the joined worker's empty queue."""
+        pf = mxio.PrefetchingIter(self._iter())
+        pf.next()
+        pf.close()
+        t0 = time.perf_counter()
+        with pytest.raises(MXNetError, match="closed"):
+            pf.next()
+        with pytest.raises(MXNetError, match="closed"):
+            pf.reset()
+        assert time.perf_counter() - t0 < 2.0
+
+    def test_reset_midstream_yields_fresh_epoch(self):
+        """Regression (review): an in-flight producer put must not leak
+        a stale batch (or None sentinel) into the post-reset queue."""
+        data = np.arange(64, dtype="float32").reshape(16, 4)
+        it = mxio.NDArrayIter(data, np.arange(16, dtype="float32"),
+                              batch_size=4)
+        pf = mxio.PrefetchingIter(it, prefetch_depth=1)
+        for _ in range(20):
+            first = pf.next()  # consume one, queue refills behind it
+            pf.reset()
+            fresh = pf.next()
+            # epoch always restarts at batch 0
+            np.testing.assert_array_equal(fresh.data[0].asnumpy(),
+                                          data[:4])
+            del first
+        pf.close()
+
+
+# ---------------------------------------------------------------------------
+# DeviceFeedIter
+# ---------------------------------------------------------------------------
+
+def _mlp_step(donate_inputs=False):
+    from mxnet_tpu import parallel as par
+    from mxnet_tpu.gluon import nn, loss as gloss
+
+    net = nn.HybridSequential()
+    with net.name_scope():
+        net.add(nn.Dense(8, activation="relu"), nn.Dense(4))
+    net.initialize()
+    mesh = par.make_mesh({"dp": 8})
+    return par.TrainStep(net, gloss.SoftmaxCrossEntropyLoss(), "sgd",
+                         mesh=mesh, donate_inputs=donate_inputs)
+
+
+def _nd_iter(n=64, batch=16, dim=6):
+    data = np.random.rand(n, dim).astype("float32")
+    label = np.random.randint(0, 4, (n,)).astype("float32")
+    return mxio.NDArrayIter(data, label, batch_size=batch)
+
+
+class TestDeviceFeedIter:
+    def test_requires_exactly_one_placement_source(self):
+        with pytest.raises(MXNetError, match="exactly one"):
+            mxio.DeviceFeedIter(_nd_iter())
+        step = _mlp_step()
+        with pytest.raises(MXNetError, match="exactly one"):
+            mxio.DeviceFeedIter(_nd_iter(), step=step, shardings=[None])
+
+    def test_batches_staged_with_step_sharding(self):
+        """Tentpole contract: fed batches carry the step's exact input
+        sharding (dp-sharded dim 0 on the 8-device mesh) so the step's
+        device_put is a no-op, and training runs end to end."""
+        step = _mlp_step()
+        feed = mxio.DeviceFeedIter(_nd_iter(), step=step, depth=2)
+        shs = step.input_shardings(
+            mx.nd.array(np.zeros((16, 6), "float32")),
+            mx.nd.array(np.zeros((16,), "float32")))
+        from jax.sharding import PartitionSpec as P
+
+        assert shs[0].spec == P("dp", None) and shs[1].spec == P("dp")
+        n = 0
+        for b in feed:
+            assert b.data[0].data.sharding == shs[0]
+            assert b.label[0].data.sharding == shs[1]
+            loss, _ = step(b.data[0], b.label[0])
+            n += 1
+        assert n == 4
+        assert np.isfinite(loss.asnumpy()).all()
+        feed.close()
+
+    def test_donated_inputs_with_fresh_batches(self):
+        """donate_inputs=True composes with the feed: every step gets a
+        fresh staged buffer, so donation never reuses a dead one."""
+        step = _mlp_step(donate_inputs=True)
+        feed = mxio.DeviceFeedIter(_nd_iter(), step=step)
+        losses = [float(step(b.data[0], b.label[0])[0].asnumpy())
+                  for b in feed]
+        assert len(losses) == 4 and all(np.isfinite(losses))
+        feed.close()
+
+    def test_plain_iterable_and_explicit_shardings(self):
+        """DataLoader-shaped sources (lists of arrays) keep their form;
+        explicit shardings accept anything device_put does."""
+        import jax
+
+        from mxnet_tpu import gluon
+
+        ds = gluon.data.ArrayDataset(
+            np.arange(32, dtype="float32").reshape(16, 2),
+            np.arange(16, dtype="float32"))
+        loader = gluon.data.DataLoader(ds, batch_size=4)
+        dev = jax.devices()[0]
+        feed = mxio.DeviceFeedIter(loader, shardings=[dev, dev])
+        batches = list(feed)
+        assert len(batches) == 4
+        assert isinstance(batches[0], list) and len(batches[0]) == 2
+        assert batches[0][0].data.devices() == {dev}
+        feed.close()
+
+    def test_device_transform_runs_on_device(self):
+        """uint8 wire format + on-device normalize: values match the
+        host-side float math."""
+        import jax.numpy as jnp
+
+        raw = np.random.randint(0, 256, (32, 3, 4, 4), np.uint8)
+        labels = np.arange(32, dtype="float32")
+        it = mxio.NDArrayIter(raw, labels, batch_size=8)
+        step = _mlp_step()
+
+        def tf(x, y):
+            return (x.astype(jnp.float32) - 127.5) / 3.0, y
+
+        feed = mxio.DeviceFeedIter(it, step=step, device_transform=tf)
+        b = next(feed)
+        got = b.data[0].asnumpy()
+        np.testing.assert_allclose(
+            got, (raw[:8].astype(np.float32) - 127.5) / 3.0, rtol=1e-6)
+        feed.close()
+
+    def test_transform_arity_mismatch_surfaces(self):
+        step = _mlp_step()
+        feed = mxio.DeviceFeedIter(_nd_iter(), step=step,
+                                   device_transform=lambda x, y: x,
+                                   name="badtf")
+        with pytest.raises(MXNetError, match="badtf"):
+            next(feed)
+        feed.close()
+
+    def test_reset_exhaustion_close_semantics(self):
+        step = _mlp_step()
+        feed = mxio.DeviceFeedIter(_nd_iter(), step=step, name="life")
+        assert len(list(feed)) == 4
+        t0 = time.perf_counter()
+        with pytest.raises(StopIteration):
+            next(feed)  # immediate, not a queue hang
+        assert time.perf_counter() - t0 < 2.0
+        feed.reset()
+        assert len(list(feed)) == 4
+        feed.close()
+        assert feed._thread is None
+        feed.close()  # idempotent
+        with pytest.raises(MXNetError, match="closed"):
+            next(feed)
+        alive = [t for t in threading.enumerate()
+                 if t.name == "mxnet-life" and t.is_alive()]
+        assert not alive
+
+    def test_close_chains_to_source(self, tmp_path):
+        import jax
+
+        rec = _write_rec(tmp_path / "g.rec", n=8)
+        it = _image_iter(rec, "process", 2)
+        dev = jax.devices()[0]
+        feed = mxio.DeviceFeedIter(
+            it, shardings=lambda vals: [dev] * len(vals))
+        next(feed)
+        feed.close()
+        assert it._pool is None  # ImageIter.close ran
+
+    def test_fault_injection_surfaces_as_error(self):
+        """fault site datafeed.put: a producer crash is an MXNetError
+        naming the stage — never a hang on the empty queue."""
+        step = _mlp_step()
+        with fault.inject("datafeed.put=once"):
+            feed = mxio.DeviceFeedIter(_nd_iter(), step=step,
+                                       name="chaos_stage")
+            t0 = time.perf_counter()
+            with pytest.raises(MXNetError) as ei:
+                for _ in feed:
+                    pass
+            assert time.perf_counter() - t0 < 5.0
+            msg = str(ei.value)
+            assert "chaos_stage" in msg and "datafeed.put" in msg
+            # the error is sticky: the consumer can't silently continue
+            with pytest.raises(MXNetError):
+                next(feed)
+            feed.close()
+
+    def test_data_wait_telemetry_emitted(self, tmp_path, monkeypatch):
+        """mxnet_data_wait_seconds{stage} + queue depth + decode counter
+        land in the registry and in prom_text()."""
+        monkeypatch.setattr(telemetry._state, "enabled", True)
+        rec = _write_rec(tmp_path / "h.rec", n=16)
+        it = _image_iter(rec, "serial", 1, seed=None)
+        step = _mlp_step()
+
+        # ImageIter batches are (3,32,32) images; feed them through
+        # explicit shardings (the MLP step's shapes don't matter here)
+        import jax
+
+        dev = jax.devices()[0]
+        feed = mxio.DeviceFeedIter(it, shardings=lambda vals:
+                                   [dev] * len(vals), name="telemetry_t")
+        _drain(feed)
+        feed.close()
+        snap = telemetry.snapshot()["metrics"]
+        waits = snap["mxnet_data_wait_seconds"]["samples"]
+        assert any(s["labels"]["stage"] == "telemetry_t" and s["count"] > 0
+                   for s in waits)
+        assert "mxnet_data_queue_depth" in snap
+        decoded = snap["mxnet_data_decoded_images_total"]["samples"]
+        assert decoded and decoded[0]["value"] >= 16
+        text = telemetry.prom_text()
+        assert 'mxnet_data_wait_seconds_count{stage="telemetry_t"}' in text
+        telemetry.reset()
+
+
+# ---------------------------------------------------------------------------
+# DataLoader pin_memory routing
+# ---------------------------------------------------------------------------
+
+class TestPinMemory:
+    def test_pin_memory_stages_on_device(self):
+        import jax
+
+        from mxnet_tpu import gluon
+        from mxnet_tpu.context import cpu_pinned
+
+        ds = gluon.data.ArrayDataset(
+            np.arange(24, dtype="float32").reshape(12, 2),
+            np.arange(12, dtype="float32"))
+        loader = gluon.data.DataLoader(ds, batch_size=4, pin_memory=True)
+        batch = next(iter(loader))
+        assert batch[0].context == cpu_pinned()
+        assert batch[0].data.devices() == {jax.devices()[0]}
+        np.testing.assert_allclose(batch[0].asnumpy(),
+                                   np.arange(8, dtype="float32")
+                                   .reshape(4, 2))
+
+    def test_pin_memory_with_workers(self):
+        from mxnet_tpu import gluon
+
+        ds = gluon.data.ArrayDataset(
+            np.arange(24, dtype="float32").reshape(12, 2),
+            np.arange(12, dtype="float32"))
+        want = [b[0].asnumpy()
+                for b in gluon.data.DataLoader(ds, batch_size=4)]
+        loader = gluon.data.DataLoader(ds, batch_size=4, pin_memory=True,
+                                       num_workers=2)
+        got = [b[0].asnumpy() for b in loader]
+        for g, w in zip(got, want):
+            np.testing.assert_allclose(g, w)
