@@ -6,9 +6,12 @@ after EVERY completed stage (flushed), monotonically enriched:
     stage 1  ResNet-50 synthetic   -> line 1 (the required contract keys)
     stage 2  eager-vs-bulk chain   -> line 2 (adds bulk_* — dispatch
              microbench of engine.bulk fused segments; cheap, runs first)
-    stage 3  BERT-base subprocess  -> line 3 (adds bert_*)
-    stage 4  Llama proxy subprocess-> line 4 (adds llama_proxy_*)
-    stage 5  ResNet-50 real-data   -> line 5 (adds real_data_*)
+    stage 2.5 comms exchange       -> line 3 (adds comms_* — per-key vs
+             bucketed vs bucketed+2bit gradient exchange on the
+             ResNet-50-scale param set; dispatch counts + loss gate)
+    stage 3  BERT-base subprocess  -> line 4 (adds bert_*)
+    stage 4  Llama proxy subprocess-> line 5 (adds llama_proxy_*)
+    stage 5  ResNet-50 real-data   -> line 6 (adds real_data_*)
 
     Stages are ordered by information value (BASELINE.json tracks resnet,
     bert, llama MFU; real-data measures the host pipeline on a 1-core
@@ -41,8 +44,8 @@ TPU chip sits behind a network relay whose H2D bandwidth (~50 MB/s) would
 otherwise dominate and measure the tunnel, not the framework.
 
 Env knobs: BENCH_BUDGET_S (float, default 1800), BENCH_SKIP_REALDATA,
-BENCH_SKIP_BERT, BENCH_SKIP_LLAMA, BENCH_SKIP_BULK,
-BENCH_BERT_TIMEOUT_S, BENCH_LLAMA_TIMEOUT_S.
+BENCH_SKIP_BERT, BENCH_SKIP_LLAMA, BENCH_SKIP_BULK, BENCH_SKIP_COMMS,
+BENCH_BERT_TIMEOUT_S, BENCH_LLAMA_TIMEOUT_S, MXNET_KV_BUCKET_MB.
 """
 from __future__ import annotations
 
@@ -141,6 +144,16 @@ def main():
             record["bulk_error"] = repr(e)[:200]
     else:
         record["bulk_skipped"] = "budget"
+    _emit(record)
+    _write_telemetry(telemetry_out)
+
+    if _remaining_s() > 30:
+        try:
+            record.update(_comms_extra())
+        except Exception as e:
+            record["comms_error"] = repr(e)[:200]
+    else:
+        record["comms_skipped"] = "budget"
     _emit(record)
     _write_telemetry(telemetry_out)
 
@@ -295,6 +308,149 @@ def _bulk_extra(chain_len=64, reps=10):
         "bulk_allclose_eager": bool(np.allclose(out_b.asnumpy(),
                                                 out_e.asnumpy(), rtol=1e-5)),
     }
+
+
+def _comms_extra(copies=2, reps=3):
+    """Gradient-exchange microbench (stage 2.5): per-key vs bucketed vs
+    bucketed+2bit on the ResNet-50-scale parameter set (ISSUE 5).
+
+    The per-key path reduces each of the 161 parameters with its own
+    dispatch (the reference KVStore shape); the bucketed fused
+    ``pushpull`` coalesces them into ~25 MB flat buckets — one reduce
+    per bucket. Reports the collective-dispatch reduction (from the
+    telemetry counters), wall time per exchange for the three variants,
+    and the trainer-level loss bit-identity gate (bucketed uncompressed
+    must match per-key BIT-exactly). Single-chip note: with one device
+    the 'collective' is the store's fused aggregation — the dispatch
+    counts and the tax they model are the same, only the wire is
+    missing. ``tools/comms_bench.py`` runs the identical measurement
+    over a real multi-device psum mesh on the CPU oracle. Opt out with
+    BENCH_SKIP_COMMS=1.
+    """
+    if os.environ.get("BENCH_SKIP_COMMS"):
+        return {}
+    import importlib.util as ilu
+
+    import mxnet_tpu as mx
+    from mxnet_tpu import kvstore as kvmod, telemetry
+    from mxnet_tpu.kvstore import bucket_cap_bytes
+
+    spec = ilu.spec_from_file_location(
+        "comms_bench", os.path.join(
+            os.path.dirname(os.path.abspath(__file__)), "tools",
+            "comms_bench.py"))
+    cb = ilu.module_from_spec(spec)
+    spec.loader.exec_module(cb)   # import is side-effect free
+    shapes = cb.resnet50_param_shapes()
+    cap = bucket_cap_bytes()
+
+    def collectives():
+        fam = telemetry.snapshot()["metrics"].get(
+            "mxnet_kvstore_collective_dispatch_total")
+        return sum(s["value"] for s in (fam["samples"] if fam else ()))
+
+    def run_variant(bucket_bytes, compression=None):
+        store = kvmod.create("device")
+        store._bucket_bytes = bucket_bytes
+        if compression is not None:
+            store.set_gradient_compression(compression)
+        rs = np.random.RandomState(0)
+        keys = list(range(len(shapes)))
+        vals, outs = [], []
+        for sh in shapes:
+            g = mx.nd.array(rs.randn(*sh).astype(np.float32))
+            vals.append([g, g * 1.5])          # two copies, one device
+            outs.append([mx.nd.zeros(sh), mx.nd.zeros(sh)])
+        for k, sh in zip(keys, shapes):
+            store.init(k, mx.nd.zeros(sh))
+        pr = [-k for k in keys]
+
+        def exchange():
+            store.pushpull(keys, vals, out=outs, priority=pr)
+            mx.nd.waitall()
+
+        exchange()                              # warm compiles
+        c0 = collectives()
+        times = []
+        for _ in range(reps):
+            t0 = time.perf_counter()
+            exchange()
+            times.append(time.perf_counter() - t0)
+        per_step = (collectives() - c0) / reps
+        times.sort()
+        return per_step, times[len(times) // 2] * 1e3
+
+    was_enabled = telemetry.enabled()
+    telemetry.enable()
+    try:
+        perkey_n, perkey_ms = run_variant(0)
+        bucket_n, bucket_ms = run_variant(cap)
+        _, bucket2bit_ms = run_variant(
+            cap, compression={"type": "2bit", "threshold": 0.5})
+    finally:
+        if not was_enabled:
+            telemetry.disable()
+    identical = _comms_loss_bit_identity()
+    return {
+        "comms_params": len(shapes),
+        "comms_bucket_mb": round(cap / (1 << 20), 3),
+        "comms_perkey_collectives_per_step": round(perkey_n, 1),
+        "comms_bucketed_collectives_per_step": round(bucket_n, 1),
+        "comms_dispatch_reduction": round(
+            perkey_n / max(bucket_n, 1.0), 1),
+        "comms_perkey_ms_per_step": round(perkey_ms, 2),
+        "comms_bucketed_ms_per_step": round(bucket_ms, 2),
+        "comms_bucketed_2bit_ms_per_step": round(bucket2bit_ms, 2),
+        "comms_bucketed_loss_bit_identical": bool(identical),
+    }
+
+
+def _comms_loss_bit_identity(steps=4):
+    """Trainer-level gate on THIS device: a small net trained through
+    kvstore='tpu_sync' with the per-key path (MXNET_KV_BUCKET_MB=0) and
+    the bucketed path must produce bit-identical losses and weights."""
+    import mxnet_tpu as mx
+    from mxnet_tpu import autograd, gluon
+    from mxnet_tpu.gluon import nn
+    from mxnet_tpu.gluon.loss import L2Loss
+
+    def run(bucket_mb):
+        prev = os.environ.get("MXNET_KV_BUCKET_MB")
+        os.environ["MXNET_KV_BUCKET_MB"] = str(bucket_mb)
+        try:
+            mx.random.seed(0)
+            net = nn.Dense(16, in_units=32)
+            net.initialize()
+            rs = np.random.RandomState(7)
+            net.weight.set_data(mx.nd.array(
+                rs.randn(16, 32).astype(np.float32)))
+            net.bias.set_data(mx.nd.zeros(16))
+            tr = gluon.Trainer(net.collect_params(), "sgd",
+                               {"learning_rate": 0.05},
+                               kvstore="tpu_sync")
+            loss_fn = L2Loss()
+            rs2 = np.random.RandomState(11)
+            x = mx.nd.array(rs2.randn(8, 32).astype(np.float32))
+            y = mx.nd.array(rs2.randn(8, 16).astype(np.float32))
+            losses = []
+            for _ in range(steps):
+                with autograd.record():
+                    loss = loss_fn(net(x), y)
+                loss.backward()
+                tr.step(8)
+                losses.append(float(loss.asnumpy().sum()))
+            return losses, net.weight.data().asnumpy()
+        finally:
+            # restore, don't erase: MXNET_KV_BUCKET_MB is a documented
+            # bench knob and later stages/subprocesses must see it
+            if prev is None:
+                os.environ.pop("MXNET_KV_BUCKET_MB", None)
+            else:
+                os.environ["MXNET_KV_BUCKET_MB"] = prev
+
+    losses_pk, w_pk = run(0)
+    losses_bk, w_bk = run(25)
+    return losses_pk == losses_bk and bool(np.array_equal(w_pk, w_bk))
 
 
 def _real_data_extra(batch, steps=10, img_size=224, n_images=2048):

@@ -120,9 +120,15 @@ def apply_state_bytes(states: bytes, apply, fname: str,
                       context: str) -> None:
     """Run ``apply(states)`` (an ``Updater.set_states``-like consumer),
     wrapping corrupt-payload failures in :class:`MXNetError` naming the
-    file instead of leaking a pickle traceback."""
+    file instead of leaking a pickle traceback. An ``MXNetError`` raised
+    by the consumer is already a first-class, contextualized diagnosis
+    (e.g. a compression-config mismatch on a well-formed file) and
+    passes through unwrapped — re-labelling it 'corrupt' would bury the
+    real cause."""
     try:
         apply(states)
+    except MXNetError:
+        raise
     except Exception as e:
         raise MXNetError(
             f"{context}: {fname!r} is not a valid optimizer state file "

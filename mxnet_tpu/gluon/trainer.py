@@ -203,15 +203,38 @@ class Trainer:
         self._allreduce_grads()
 
     def _allreduce_grads(self):
+        """Exchange gradients through the kvstore.
+
+        Worker-side-optimizer mode (the tpu_sync/local default) goes
+        through ONE batched ``pushpull``: the store coalesces the keys
+        into flat ~``MXNET_KV_BUCKET_MB`` buckets and runs one
+        collective per bucket instead of one per parameter, dispatching
+        buckets in the ``priority=-i`` order (the hint the reference
+        engine used for comms/compute overlap — honored here: bucket
+        *i+1*'s allreduce is issued before bucket *i*'s scatter, so via
+        JAX async dispatch it overlaps the scatter + optimizer update).
+        Server-side-optimizer mode keeps per-key pushes — the updater
+        applies per key on the store."""
         if self._kvstore is None:
             return
+        if self._update_on_kvstore:
+            for i, p in enumerate(self._params):
+                if p.grad_req == "null":
+                    continue
+                self._kvstore.push(i, p.list_grad(), priority=-i)
+            return
+        keys: List[int] = []
+        grads: List[list] = []
+        priorities: List[int] = []
         for i, p in enumerate(self._params):
             if p.grad_req == "null":
                 continue
-            self._kvstore.push(i, p.list_grad(), priority=-i)
-            if not self._update_on_kvstore:
-                self._kvstore.pull(i, p.list_grad(), priority=-i,
-                                   ignore_sparse=True)
+            keys.append(i)
+            grads.append(p.list_grad())
+            priorities.append(-i)
+        if keys:
+            self._kvstore.pushpull(keys, grads, out=grads,
+                                   priority=priorities)
 
     def update(self, batch_size, ignore_stale_grad=False):
         if not self._kv_initialized:
@@ -233,16 +256,31 @@ class Trainer:
                 upd(i, grad, arr)
 
     # ------------------------------------------------------------------
+    # envelope marker for trainer-state payloads that carry gradient-
+    # compression error-feedback residuals next to the updater pickle;
+    # plain payloads (no compression) keep the legacy bare-updater bytes
+    _STATES_ENVELOPE = "__mxnet_tpu_trainer_states__"
+
     def save_states(self, fname):
         """reference: Trainer.save_states (Updater.get_states pickle).
         Committed atomically (temp + fsync + rename) — a crash mid-save
-        leaves the previous state file intact."""
+        leaves the previous state file intact. With gradient compression
+        active, the error-feedback residuals ride along so a resumed
+        run's transmitted-gradient stream continues bit-exactly."""
         if not self._kv_initialized:
             self._init_kvstore()
         from ..checkpoint import atomic_write
 
-        atomic_write(fname, self._updaters[0].get_states(
-            dump_optimizer=False))
+        blob = self._updaters[0].get_states(dump_optimizer=False)
+        comp = getattr(self._kvstore, "_compression", None) \
+            if self._kvstore is not None else None
+        if comp is not None:
+            import pickle
+
+            blob = pickle.dumps({self._STATES_ENVELOPE: 1,
+                                 "updater": blob,
+                                 "compression": comp.get_state()})
+        atomic_write(fname, blob)
 
     def load_states(self, fname):
         """Inverse of save_states. Missing or corrupt state files raise
@@ -255,6 +293,30 @@ class Trainer:
         states = read_state_bytes(fname, "Trainer.load_states")
 
         def _apply(blob):
+            comp_state = None
+            try:
+                import pickle
+
+                obj = pickle.loads(blob)
+            except Exception:
+                obj = None
+            if isinstance(obj, dict) and obj.get(self._STATES_ENVELOPE):
+                comp_state = obj.get("compression")
+                blob = obj["updater"]
+            comp = getattr(self._kvstore, "_compression", None) \
+                if self._kvstore is not None else None
+            if comp_state is not None:
+                if comp is None:
+                    raise MXNetError(
+                        f"{fname!r} carries gradient-compression "
+                        "residual state but this Trainer has no "
+                        "compression_params configured")
+                comp.set_state(comp_state)
+            elif comp is not None:
+                # legacy/residual-less payload into a compressing
+                # trainer: clear any live residuals so the restored
+                # stream matches a fresh process loading the same file
+                comp.set_state({})
             for upd in self._updaters:
                 upd.set_states(blob)
                 if upd.optimizer is not self._optimizer:

@@ -13,5 +13,6 @@ scripts run unchanged. Parameter-server 'dist_async' has no TPU analogue
 and raises with guidance. Multi-host rendezvous uses jax.distributed
 (see mxnet_tpu.parallel) instead of dmlc_tracker env bootstrap.
 """
+from .bucketing import Bucket, bucket_cap_bytes, plan_buckets  # noqa: F401
 from .kvstore import (KVStore, KVStoreDistAsyncEmu, KVStoreLocal,  # noqa: F401
                       KVStoreTPUSync, create)

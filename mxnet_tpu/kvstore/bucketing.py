@@ -1,0 +1,146 @@
+"""Gradient bucketing for the kvstore's fused ``pushpull``.
+
+The reference KVStore (``dist_device_sync`` / ``nccl``) reduces every
+gradient key as its own collective; a ResNet-50 step pays ~160 separate
+dispatches and a transformer one per weight tensor. The proven fix
+(PyTorch DDP's 25 MB gradient buckets, Li et al. VLDB'20; Horovod tensor
+fusion) is to coalesce gradients into large flat buffers and run ONE
+collective per bucket. This module holds the mechanics shared by every
+store type:
+
+* :func:`plan_buckets` — greedy, order-preserving partition of keys into
+  dtype-segregated buckets capped at ``MXNET_KV_BUCKET_MB`` (default 25)
+  payload bytes. Keys arrive already sorted by priority (descending), so
+  bucket *dispatch order* is the priority order. A single tensor larger
+  than the cap gets a bucket of its own — it is never split (the
+  collective is one dispatch either way) and never silently dropped.
+* :func:`pack` / :func:`unpacker` — jitted flatten-and-concatenate of a
+  bucket's member gradients into one flat buffer and the inverse
+  scatter. One XLA dispatch each; the unpacker executable is cached per
+  bucket signature (member shapes), and ``jax.jit``'s own
+  signature-keyed cache makes repeated steps replay compiled code.
+
+Bit-identity contract: packing is pure reshape/concatenate and the
+reduction over a flat bucket applies the same elementwise sum (same
+operand order, same reduction arity) each member would see in its own
+per-key collective — so the bucketed *uncompressed* exchange is
+bit-identical to the per-key path, which the tests and
+``tools/comms_bench.py`` assert.
+"""
+from __future__ import annotations
+
+import os
+from typing import Dict, List, Sequence, Tuple
+
+__all__ = ["Bucket", "bucket_cap_bytes", "pack", "plan_buckets",
+           "unpacker"]
+
+DEFAULT_BUCKET_MB = 25.0  # PyTorch DDP's default gradient-bucket size
+
+
+def bucket_cap_bytes() -> int:
+    """Resolve ``MXNET_KV_BUCKET_MB`` (float MB; 0 disables bucketing)."""
+    mb = float(os.environ.get("MXNET_KV_BUCKET_MB", str(DEFAULT_BUCKET_MB)))
+    return int(mb * (1 << 20))
+
+
+class Bucket:
+    """One planned bucket: member positions (indices into the caller's
+    key list), their shapes, and the flat-buffer layout."""
+
+    __slots__ = ("indices", "shapes", "dtype", "nbytes", "group")
+
+    def __init__(self, dtype, group):
+        self.indices: List[int] = []
+        self.shapes: List[Tuple[int, ...]] = []
+        self.dtype = dtype
+        self.group = group          # (dtype_str, nslots, slot device sig)
+        self.nbytes = 0
+
+    def add(self, index: int, shape: Tuple[int, ...],
+            nbytes: int) -> None:
+        self.indices.append(index)
+        self.shapes.append(tuple(shape))
+        self.nbytes += int(nbytes)
+
+    def __len__(self):
+        return len(self.indices)
+
+    def __repr__(self):
+        return (f"Bucket(keys={len(self.indices)}, dtype={self.dtype}, "
+                f"bytes={self.nbytes})")
+
+
+def plan_buckets(entries: Sequence[Tuple[int, Tuple[int, ...], object,
+                                         object, int]],
+                 cap_bytes: int) -> List[Bucket]:
+    """Partition ``entries`` into buckets, preserving the given order.
+
+    ``entries``: ``(index, shape, dtype, group, nbytes)`` tuples in
+    dispatch (priority) order. ``group`` segregates members that cannot
+    share a flat buffer — different dtypes, different device-copy counts
+    or placements. Greedy: an entry joins the open bucket of its group
+    unless that would exceed ``cap_bytes``; an entry alone larger than
+    the cap still gets (and fills) its own bucket.
+    """
+    buckets: List[Bucket] = []
+    open_by_group: Dict[object, Bucket] = {}
+    for index, shape, dtype, group, nbytes in entries:
+        b = open_by_group.get(group)
+        if b is None or (len(b) > 0 and b.nbytes + nbytes > cap_bytes):
+            b = Bucket(dtype, group)
+            buckets.append(b)
+            open_by_group[group] = b
+        b.add(index, shape, nbytes)
+    return buckets
+
+
+# --------------------------------------------------------------------------
+# jitted pack / unpack
+# --------------------------------------------------------------------------
+
+_PACK = None                       # lazily-built jitted variadic packer
+_UNPACKERS: Dict[Tuple, object] = {}
+
+
+def pack(arrs):
+    """Flatten + concatenate a bucket's member arrays (one dispatch).
+
+    ``jax.jit`` caches per (arity, shapes, dtype) signature, so every
+    step after the first replays a compiled executable. All members must
+    be committed to the same device (the planner's ``group`` guarantees
+    it); the flat buffer lands on that device.
+    """
+    global _PACK
+    if _PACK is None:
+        import jax
+        import jax.numpy as jnp
+
+        _PACK = jax.jit(
+            lambda *xs: jnp.concatenate([x.reshape(-1) for x in xs]))
+    return _PACK(*arrs)
+
+
+def unpacker(shapes: Sequence[Tuple[int, ...]]):
+    """Jitted inverse of :func:`pack` for a bucket signature: flat buffer
+    -> tuple of member arrays (one dispatch). Cached per shapes tuple."""
+    sig = tuple(tuple(s) for s in shapes)
+    fn = _UNPACKERS.get(sig)
+    if fn is None:
+        import jax
+
+        offsets = []
+        off = 0
+        for s in sig:
+            n = 1
+            for d in s:
+                n *= int(d)
+            offsets.append((off, n, s))
+            off += n
+
+        def unpack(flat):
+            return tuple(flat[o:o + n].reshape(s) for o, n, s in offsets)
+
+        fn = jax.jit(unpack)
+        _UNPACKERS[sig] = fn
+    return fn

@@ -9,17 +9,41 @@ quantization error in a per-key residual that is added to the next
 gradient (error feedback), so the sum of transmitted values converges to
 the true gradient sum. TPU-native: the compress step is a tiny jitted
 elementwise kernel; the collective then runs on the compressed values.
-Residuals live per (key, worker-slot), matching the reference's
-per-worker residual buffers.
+
+Two granularities share the one kernel:
+
+* per key (:meth:`GradientCompression.compress`) — the scalar
+  ``push()`` path, residual per ``(key, worker-slot)`` matching the
+  reference's per-worker residual buffers;
+* per BUCKET (:meth:`GradientCompression.compress_flat`) — the fused
+  ``pushpull`` path quantizes a whole packed bucket in one jitted call
+  with one residual per ``(bucket members, slot)``, so compression cost
+  scales with bucket count, not parameter count.
+
+The two residual namespaces are independent (scalar keys vs member-key
+tuples): a store driven through BOTH paths for the same keys keeps two
+error-feedback streams — pick one path per key per training run (the
+trainer does).
+
+Only floating-point gradients are quantizable; an integer-dtype payload
+raises :class:`MXNetError` instead of silently casting the ±threshold
+grid into garbage. Residual state is checkpointable
+(:meth:`get_state` / :meth:`set_state`) and rides in
+``Trainer.save_states``, so a resumed run's error feedback continues
+bit-exactly.
 """
 from __future__ import annotations
 
 from typing import Dict
 
+from .. import telemetry
 from ..base import MXNetError
 from ..ndarray import NDArray
+from ..telemetry import _state as _telemetry_state
 
 __all__ = ["GradientCompression", "create_compression"]
+
+_SUPPORTED_DTYPES = ("float32", "float16", "bfloat16")
 
 
 class GradientCompression:
@@ -38,7 +62,8 @@ class GradientCompression:
         t = threshold
 
         # ONE jitted kernel per instance: jax caches per (shape, dtype),
-        # so steady-state pushes hit the compile cache
+        # so steady-state pushes hit the compile cache — and the bucketed
+        # path compiles per BUCKET shape, not per parameter
         @jax.jit
         def _q(g, r):
             g2 = g.astype(jnp.float32) + r
@@ -49,17 +74,67 @@ class GradientCompression:
 
         self._q = _q
 
-    def compress(self, key, slot, grad: NDArray) -> NDArray:
-        """Quantize ``grad + residual`` to {-t, 0, +t}; update residual."""
+    def _check_dtype(self, dtype, what):
+        if str(dtype) not in _SUPPORTED_DTYPES:
+            raise MXNetError(
+                f"2-bit gradient compression supports float gradients "
+                f"only ({', '.join(_SUPPORTED_DTYPES)}); {what} has "
+                f"dtype {dtype} — refusing to silently cast")
+
+    def _quantize(self, rkey, data):
         import jax.numpy as jnp
 
-        rkey = (key, slot)
         res = self._residual.get(rkey)
         if res is None:
-            res = jnp.zeros(grad.shape, jnp.float32)
-        out, new_res = self._q(grad.data, res)
+            res = jnp.zeros(data.shape, jnp.float32)
+        out, new_res = self._q(data, res)
         self._residual[rkey] = new_res
-        return NDArray(data=out, ctx=grad.context)
+        if _telemetry_state.enabled:
+            bits = getattr(data.dtype, "itemsize", 4) * 8
+            telemetry.record_kv_compression(bits / 2.0, int(data.size))
+        return out
+
+    def compress(self, key, slot, grad: NDArray) -> NDArray:
+        """Quantize ``grad + residual`` to {-t, 0, +t}; update residual."""
+        self._check_dtype(grad.dtype, f"gradient for key {key!r}")
+        return NDArray(data=self._quantize((key, slot), grad.data),
+                       ctx=grad.context)
+
+    def compress_flat(self, bucket_key, slot, flat):
+        """Quantize a packed gradient bucket (a flat jax array) in one
+        jitted kernel call; the error-feedback residual is keyed by the
+        bucket's member keys + slot. Bucket composition is stable across
+        steps for a fixed model, so the residual stream is continuous.
+        """
+        self._check_dtype(flat.dtype,
+                          f"gradient bucket {tuple(bucket_key)!r}")
+        return self._quantize((tuple(bucket_key), slot), flat)
+
+    # -- checkpointing -------------------------------------------------
+    def get_state(self) -> Dict:
+        """Pickleable snapshot of the error-feedback residuals (numpy) —
+        what ``Trainer.save_states`` embeds so a resumed run's
+        transmitted-gradient stream continues bit-exactly."""
+        import numpy as np
+
+        return {"threshold": self.threshold,
+                "residual": {k: np.asarray(v)
+                             for k, v in self._residual.items()}}
+
+    def set_state(self, state: Dict) -> None:
+        """Inverse of :meth:`get_state`. A threshold mismatch raises —
+        residuals accumulated under a different quantization grid would
+        silently corrupt error feedback."""
+        import jax.numpy as jnp
+
+        thr = state.get("threshold")
+        if thr is not None and float(thr) != self.threshold:
+            raise MXNetError(
+                f"gradient-compression state was saved with threshold "
+                f"{thr} but this store is configured with "
+                f"{self.threshold}")
+        self._residual = {k: jnp.asarray(v, jnp.float32)
+                          for k, v in state.get("residual", {}).items()}
 
 
 def create_compression(params) -> GradientCompression:

@@ -15,6 +15,21 @@ from ..fault import _state as _fault_state
 from ..ndarray import NDArray
 from ..ndarray import array as nd_array
 from ..telemetry import _state as _telemetry_state
+from .bucketing import bucket_cap_bytes, pack, plan_buckets, unpacker
+
+_FUSED_SUM = None
+
+
+def _fused_sum(arrs):
+    """One jitted stack-and-sum over N same-shape arrays (one XLA
+    dispatch; jit caches per (N, shape, dtype) signature)."""
+    global _FUSED_SUM
+    if _FUSED_SUM is None:
+        import jax
+        import jax.numpy as jnp
+
+        _FUSED_SUM = jax.jit(lambda *xs: jnp.sum(jnp.stack(xs), axis=0))
+    return _FUSED_SUM(*arrs)
 
 
 def _nd_bytes(v) -> int:
@@ -91,8 +106,61 @@ class KVStore:
         raise NotImplementedError
 
     def pushpull(self, key, value, out=None, priority=0):
-        self.push(key, value, priority)
-        self.pull(key, out if out is not None else value, priority)
+        """Fused push+pull (reference: kvstore.py::pushpull).
+
+        The batched form — ``pushpull(keys, values, outs, priorities)``
+        with parallel lists — is the REAL fused entry: stores that
+        support it coalesce the keys into flat dtype-segregated buckets
+        of ``MXNET_KV_BUCKET_MB`` (default 25) MB and run ONE collective
+        per bucket instead of one per key. The scalar form is a thin
+        wrapper over a one-key batch.
+
+        Priority contract (previously accepted and ignored, now
+        honored): keys are exchanged in DESCENDING priority order,
+        stable for ties. The Gluon trainer passes ``priority=-i``, so
+        parameter 0's bucket is dispatched first and its reduced
+        gradient reaches the optimizer soonest; bucket *i+1*'s
+        collective is dispatched before bucket *i*'s scatter, so via
+        JAX async dispatch the collective overlaps the previous
+        bucket's scatter + optimizer update.
+        """
+        if isinstance(key, (list, tuple)):
+            keys = list(key)
+            values = list(value)
+            if len(values) != len(keys):
+                raise MXNetError(
+                    f"batched pushpull: {len(keys)} keys but "
+                    f"{len(values)} values")
+            if out is None:
+                outs = values
+            else:
+                outs = list(out) if isinstance(out, (list, tuple)) \
+                    else [out]
+                if len(outs) != len(keys):
+                    raise MXNetError(
+                        f"batched pushpull: {len(keys)} keys but "
+                        f"{len(outs)} outs")
+            if isinstance(priority, (list, tuple)):
+                if len(priority) != len(keys):
+                    raise MXNetError(
+                        f"batched pushpull: {len(keys)} keys but "
+                        f"{len(priority)} priorities")
+                priorities = [int(p) for p in priority]
+            else:
+                priorities = [int(priority)] * len(keys)
+            return self._pushpull_batched(keys, values, outs, priorities)
+        return self._pushpull_batched(
+            [key], [value], [out if out is not None else value],
+            [int(priority)])
+
+    def _pushpull_batched(self, keys, values, outs, priorities):
+        """Per-key decomposition — the fallback for stores without a
+        fused bucketed path and for the server-side-optimizer mode
+        (the updater applies per key). Still honors the priority order
+        (descending, stable)."""
+        for i in sorted(range(len(keys)), key=lambda j: -priorities[j]):
+            self.push(keys[i], values[i], priorities[i])
+            self.pull(keys[i], outs[i], priorities[i])
 
     def row_sparse_pull(self, key, out, priority=0, row_ids=None):
         """Pull ONLY the requested rows (reference: kvstore.py::
@@ -182,6 +250,10 @@ class KVStoreLocal(KVStore):
     def __init__(self, type_name="local"):
         super().__init__(type_name)
         self._store: Dict = {}
+        # fused-pushpull bucket cap (bytes); 0 disables bucketing.
+        # Mutable attribute so benches/dryruns can force the per-key
+        # path on one store without touching the environment.
+        self._bucket_bytes = bucket_cap_bytes()
 
     def init(self, key, value):
         key = self._canon(key)
@@ -234,16 +306,24 @@ class KVStoreLocal(KVStore):
         if _tel:
             telemetry.record_kv("push", _payload_bytes(vals),
                                 time.perf_counter() - t0)
+            telemetry.record_kv_collective("per_key")
 
     def _aggregate(self, vals: List[NDArray]) -> NDArray:
-        """Reduce per-device copies to one value (subclass hook)."""
-        agg = vals[0]
-        if len(vals) > 1:
-            acc = vals[0].copyto(vals[0].context)
-            for v in vals[1:]:
-                acc += v.as_in_context(acc.context)
-            agg = acc
-        return agg
+        """Reduce per-device copies to one value (subclass hook).
+
+        ONE fused stack-and-sum dispatch instead of N-1 sequential
+        in-place adds (each of which was its own XLA dispatch); copies
+        living on other devices are staged onto the first copy's device
+        first. The reduction order over the N copies is fixed by the
+        stack, so results are deterministic across calls."""
+        if len(vals) == 1:
+            return vals[0]
+        import jax
+
+        dev = next(iter(vals[0].data.devices()))
+        arrs = [v.data if next(iter(v.data.devices())) == dev
+                else jax.device_put(v.data, dev) for v in vals]
+        return NDArray(data=_fused_sum(arrs), ctx=vals[0].context)
 
     def _store_reduced(self, key, agg: NDArray):
         # snapshot the (immutable) payload — never alias the caller's
@@ -276,6 +356,186 @@ class KVStoreLocal(KVStore):
         if _tel:
             telemetry.record_kv("pull", _nd_bytes(src) * len(outs),
                                 time.perf_counter() - t0)
+
+    # -- bucketed fused pushpull ---------------------------------------
+    def _pushpull_batched(self, keys, values, outs, priorities):
+        """The fused entry: keys are coalesced into dtype-segregated flat
+        buckets (``MXNET_KV_BUCKET_MB``) and each bucket is reduced by
+        ONE dispatch (`_bucket_reduce` — a fused stack-and-sum here, one
+        compiled psum in ``tpu_sync``), then scattered back into the
+        per-param store entries and out views.
+
+        Pipelining: buckets are processed in descending-priority order
+        and bucket *i+1*'s reduce is dispatched BEFORE bucket *i*'s
+        scatter, so the collective runs while the host enqueues the
+        previous bucket's unpack (JAX async dispatch — nothing here
+        blocks on device work).
+
+        Falls back to the per-key decomposition when the fused path
+        cannot apply: server-side optimizer installed (the updater
+        applies per key), bucketing disabled (``MXNET_KV_BUCKET_MB=0``
+        or ``store._bucket_bytes = 0``), or — per key — a payload that
+        is not a dense NDArray (row-sparse gradients keep their
+        specialized path).
+        """
+        if self._updater is not None or self._bucket_bytes <= 0:
+            return KVStore._pushpull_batched(
+                self, keys, values, outs, priorities)
+        _tel = _telemetry_state.enabled
+        t0 = time.perf_counter() if _tel else 0.0
+        order = sorted(range(len(keys)), key=lambda j: -priorities[j])
+        entries = []          # planner input, in dispatch order
+        fallback = set()      # positions exchanged per-key
+        vals_by_pos: Dict = {}
+        outs_by_pos: Dict = {}
+        total_bytes = 0
+        for pos in order:
+            key = self._canon(keys[pos])
+            self._check_init(key)
+            vals = list(values[pos]) if isinstance(
+                values[pos], (list, tuple)) else [values[pos]]
+            outs_i = list(outs[pos]) if isinstance(
+                outs[pos], (list, tuple)) else [outs[pos]]
+            vals_by_pos[pos] = (key, vals)
+            outs_by_pos[pos] = outs_i
+            if not all(getattr(a, "stype", "default") == "default"
+                       for a in vals + outs_i):
+                fallback.add(pos)
+                continue
+            v0 = vals[0]
+            nbytes = _nd_bytes(v0)
+            # group: members of one bucket must share dtype, copy count
+            # and per-slot device placement so each slot packs into one
+            # same-device flat buffer
+            devsig = tuple(str(next(iter(v.data.devices())))
+                           for v in vals)
+            entries.append((pos, tuple(v0.shape), v0.dtype,
+                            (str(v0.dtype), len(vals), devsig), nbytes))
+            # pushed copies in + pulled outs back, matching what the
+            # per-key path records under push+pull — the two paths'
+            # byte counters must stay comparable
+            total_bytes += nbytes * (len(vals) + len(outs_i))
+        buckets = plan_buckets(entries, self._bucket_bytes)
+        # one dispatch plan in global priority order: a bucket is issued
+        # at its FIRST member's slot, per-key fallbacks (sparse payloads)
+        # at their own slot — not banished behind every bucket
+        bucket_at = {b.indices[0]: b for b in buckets}
+        pending = None
+        for pos in order:
+            b = bucket_at.get(pos)
+            if b is not None:
+                reduced = self._bucket_exchange_reduce(b, vals_by_pos)
+                if _tel:
+                    telemetry.record_kv_bucket(b.nbytes, len(b))
+                    telemetry.record_kv_collective("bucketed")
+                if pending is not None:
+                    self._bucket_scatter(pending[0], pending[1],
+                                         vals_by_pos, outs_by_pos)
+                pending = (b, reduced)
+            elif pos in fallback:
+                if pending is not None:
+                    self._bucket_scatter(pending[0], pending[1],
+                                         vals_by_pos, outs_by_pos)
+                    pending = None
+                key, vals = vals_by_pos[pos]
+                self.push(key, vals, priorities[pos])
+                self.pull(key, outs_by_pos[pos], priorities[pos])
+        if pending is not None:
+            self._bucket_scatter(pending[0], pending[1],
+                                 vals_by_pos, outs_by_pos)
+        if _tel:
+            telemetry.record_kv("pushpull", total_bytes,
+                                time.perf_counter() - t0)
+
+    def _bucket_exchange_reduce(self, bucket, vals_by_pos):
+        """Pack each device slot's member gradients into one flat buffer
+        (one jitted dispatch per slot), compress per bucket when a
+        compressor is set, and reduce the slots. Returns the reduced
+        flat jax array."""
+        nslots = bucket.group[1]
+        flats = []
+        for s in range(nslots):
+            flat = pack([vals_by_pos[pos][1][s].data
+                         for pos in bucket.indices])
+            if self._compression is not None:
+                # per-BUCKET quantize: one jitted kernel over the flat
+                # buffer, residual keyed by the bucket's member keys —
+                # compression cost stops scaling with parameter count.
+                # NOT inside the retry below: error-feedback state, so a
+                # retry must not re-apply it (same rule as push()).
+                bkey = tuple(vals_by_pos[pos][0]
+                             for pos in bucket.indices)
+                flat = self._compression.compress_flat(bkey, s, flat)
+            flats.append(flat)
+
+        def _reduce():
+            if _fault_state.enabled:
+                fault.check("kvstore.push",
+                            f"bucket[{len(bucket)} keys]")
+            return self._bucket_reduce(flats)
+
+        return fault.retry_call("kvstore.push", _reduce,
+                                detail=f"bucket[{len(bucket)} keys]")
+
+    def _bucket_reduce(self, flats):
+        """Reduce per-slot flat buffers to one (subclass hook): fused
+        stack-and-sum on the first slot's device — the flat-buffer twin
+        of `_aggregate`, elementwise-identical to reducing each member
+        in its own per-key call."""
+        if len(flats) == 1:
+            return flats[0]
+        import jax
+
+        dev = next(iter(flats[0].devices()))
+        arrs = [f if next(iter(f.devices())) == dev
+                else jax.device_put(f, dev) for f in flats]
+        return _fused_sum(arrs)
+
+    def _bucket_scatter(self, bucket, reduced, vals_by_pos, outs_by_pos):
+        """Unpack the reduced flat buffer back into the store entries and
+        every out view — ONE jitted unpack dispatch per target device
+        (replicated tpu_sync results scatter from each device's local
+        shard; other devices get one whole-flat transfer, not one per
+        key)."""
+        import jax
+
+        unpack = unpacker(bucket.shapes)
+        shard_by_dev = {s.device: s.data
+                        for s in getattr(reduced, "addressable_shards", [])} \
+            if hasattr(reduced, "sharding") \
+            and len(reduced.sharding.device_set) > 1 else {}
+        pieces_by_dev: Dict = {}
+
+        def pieces_for(dev):
+            p = pieces_by_dev.get(dev)
+            if p is None:
+                f = shard_by_dev.get(dev)
+                if f is None:
+                    if shard_by_dev:
+                        f = jax.device_put(
+                            next(iter(shard_by_dev.values())), dev)
+                    else:
+                        f = reduced \
+                            if next(iter(reduced.devices())) == dev \
+                            else jax.device_put(reduced, dev)
+                p = unpack(f)
+                pieces_by_dev[dev] = p
+            return p
+
+        def _copy_out():
+            if _fault_state.enabled:
+                fault.check("kvstore.pull",
+                            f"bucket[{len(bucket)} keys]")
+            for j, pos in enumerate(bucket.indices):
+                key = vals_by_pos[pos][0]
+                dst = self._store[key]
+                dst._set_data(pieces_for(dst.context.jax_device())[j])
+                for o in outs_by_pos[pos]:
+                    o._set_data(pieces_for(o.context.jax_device())[j])
+
+        # idempotent overwrite — safe to retry whole, like pull()
+        fault.retry_call("kvstore.pull", _copy_out,
+                         detail=f"bucket[{len(bucket)} keys]")
 
 
 class KVStoreTPUSync(KVStoreLocal):
@@ -445,17 +705,35 @@ class KVStoreTPUSync(KVStoreLocal):
             (ndev,) + shape, NamedSharding(mesh, P("kv")), shards)
         return self._reducer(mesh, ndev, shape, vals[0].dtype)(stacked)
 
-    def _aggregate(self, vals: List[NDArray]) -> NDArray:
+    def _needs_collective(self, arrs) -> bool:
+        """Whether these per-copy jax arrays must reduce via the mesh
+        collective. ONE gate shared by the per-key (`_aggregate`) and
+        bucketed (`_bucket_reduce`) paths — if they disagreed, the two
+        paths could pick different reduction mechanisms in the same
+        configuration and the bucketed-equals-per-key bit-identity
+        guarantee would silently break."""
         import jax
 
-        multi = (jax.process_count() > 1 or self._mesh is not None
-                 or (len(vals) > 1 and len(
-                     {next(iter(v.data.devices())) for v in vals})
-                     == len(vals)))
-        if multi:
+        return (jax.process_count() > 1 or self._mesh is not None
+                or (len(arrs) > 1
+                    and len({next(iter(a.devices())) for a in arrs})
+                    == len(arrs)))
+
+    def _aggregate(self, vals: List[NDArray]) -> NDArray:
+        if self._needs_collective([v.data for v in vals]):
             return NDArray(data=self._collective_sum(vals),
                            ctx=vals[0].context)
         return super()._aggregate(vals)
+
+    def _bucket_reduce(self, flats):
+        """ONE compiled psum over the mesh per bucket. The reducer cache
+        keys by the flat shape, so every same-layout step replays one
+        executable per bucket — O(params·bytes / bucket_cap) collectives
+        per step instead of O(params)."""
+        if not self._needs_collective(flats):
+            return super()._bucket_reduce(flats)
+        wrapped = [NDArray(data=f) for f in flats]
+        return self._collective_sum(wrapped)
 
     def _store_reduced(self, key, agg: NDArray):
         data = agg.data
@@ -575,6 +853,15 @@ class KVStoreDistAsyncEmu(KVStoreTPUSync):
         if _tel:
             telemetry.record_kv("push", _payload_bytes(vals),
                                 time.perf_counter() - t0)
+            telemetry.record_kv_collective("per_key")
+
+    def _pushpull_batched(self, keys, values, outs, priorities):
+        # Server-side optimizer semantics: the updater (and the
+        # bounded-staleness replica sync) applies per KEY, so the
+        # batched form decomposes here; the per-push local slot
+        # aggregation is already one fused stack-and-sum dispatch.
+        return KVStore._pushpull_batched(self, keys, values, outs,
+                                         priorities)
 
     def _sync_replicas(self, key):
         """Average the process-local replicas: one psum over all

@@ -47,6 +47,7 @@ __all__ = [
     "counter", "gauge", "histogram",
     "dumps", "prom_text", "chrome_counter_events", "snapshot",
     "record_op_dispatch", "record_cache", "record_kv",
+    "record_kv_collective", "record_kv_bucket", "record_kv_compression",
     "record_engine_wait", "set_live_arrays", "record_live_evictions",
     "record_training_step", "record_xla_dispatch", "record_bulk_flush",
     "record_fault_injected", "record_retry", "record_checkpoint_write",
@@ -55,6 +56,7 @@ __all__ = [
     "TrainingTelemetry", "xla_cost_analysis",
     "pop_telemetry_out_flag", "write_snapshot",
     "LATENCY_BUCKETS", "STEP_BUCKETS", "SEGMENT_BUCKETS",
+    "BYTES_BUCKETS",
 ]
 
 
@@ -106,6 +108,10 @@ STEP_BUCKETS: Tuple[float, ...] = (
 # bulk-segment lengths (op counts): powers of two up to the practical cap
 SEGMENT_BUCKETS: Tuple[float, ...] = (
     1, 2, 4, 8, 16, 32, 64, 128, 256, 512, 1024)
+# payload sizes (gradient buckets): 4 KB .. 1 GB, x4 geometric
+BYTES_BUCKETS: Tuple[float, ...] = (
+    4096, 16384, 65536, 262144, 1 << 20, 4 << 20, 16 << 20, 64 << 20,
+    256 << 20, 1 << 30)
 
 
 class _Counter:
@@ -463,6 +469,43 @@ def record_kv(op: str, nbytes: float, seconds: float) -> None:
     histogram("mxnet_kvstore_seconds",
               "Host-side kvstore call latency by kind.",
               ("op",)).labels(op).observe(seconds)
+
+
+def record_kv_collective(path: str, n: int = 1) -> None:
+    """One gradient-reduction dispatch on the comms path. ``path``:
+    ``per_key`` (one reduce/psum per parameter — the reference shape) or
+    ``bucketed`` (one collective per fused gradient bucket). The
+    per-step dispatch-reduction ratio in BENCH/PERF rounds is computed
+    from this."""
+    if not _state.enabled:
+        return
+    counter("mxnet_kvstore_collective_dispatch_total",
+            "Gradient-reduction collective dispatches by path "
+            "(per_key/bucketed).", ("path",)).labels(path).inc(n)
+
+
+def record_kv_bucket(nbytes: float, nkeys: int) -> None:
+    """One fused gradient bucket exchanged by batched pushpull."""
+    if not _state.enabled:
+        return
+    histogram("mxnet_kvstore_bucket_bytes",
+              "Payload bytes per fused gradient bucket.",
+              buckets=BYTES_BUCKETS).observe(float(nbytes))
+    counter("mxnet_kvstore_bucketed_keys_total",
+            "Parameter keys coalesced through bucketed pushpull."
+            ).inc(nkeys)
+
+
+def record_kv_compression(ratio: float, elements: int) -> None:
+    """One compressed bucket. ``ratio``: logical wire compression
+    (uncompressed payload bits / 2-bit payload, e.g. 16x for fp32)."""
+    if not _state.enabled:
+        return
+    gauge("mxnet_kvstore_compression_ratio",
+          "Logical wire compression of the most recent compressed "
+          "bucket (uncompressed bits / 2-bit quantized bits).").set(ratio)
+    counter("mxnet_kvstore_compressed_elements_total",
+            "Gradient elements through the 2-bit quantizer.").inc(elements)
 
 
 def record_engine_wait(seconds: float) -> None:

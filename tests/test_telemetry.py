@@ -500,10 +500,15 @@ class TestGluonRunAcceptance:
         # per-op dispatch counts
         assert sum(s["value"]
                    for s in snap["mxnet_op_dispatch_total"]["samples"]) > 0
-        # kvstore byte counters
+        # kvstore byte counters — the trainer's gradient exchange now
+        # goes through the fused bucketed pushpull
         kv_bytes = {s["labels"]["op"]: s["value"]
                     for s in snap["mxnet_kvstore_bytes_total"]["samples"]}
-        assert kv_bytes.get("push", 0) > 0
+        assert kv_bytes.get("pushpull", 0) > 0
+        # one bucketed collective dispatch per step, not one per param
+        coll = {s["labels"]["path"]: s["value"] for s in
+                snap["mxnet_kvstore_collective_dispatch_total"]["samples"]}
+        assert coll.get("bucketed", 0) > 0
         # jit-cache hit/miss
         cache = {(s["labels"]["cache"], s["labels"]["result"])
                  for s in snap["mxnet_jit_cache_total"]["samples"]}

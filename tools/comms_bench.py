@@ -1,0 +1,278 @@
+#!/usr/bin/env python
+"""Comms-path benchmark — no accelerator required.
+
+Measures the gradient-exchange path in isolation on the virtual CPU
+mesh, so a comms regression (or the bucketing win) is visible without a
+TPU (or a 30-minute bench.py run):
+
+1. **collective dispatches per step** — the ResNet-50-scale parameter
+   set (161 tensors, ~25.5M params) exchanged through kvstore
+   ``tpu_sync``: per-key push/pull (one compiled psum per parameter,
+   the reference KVStore shape) vs the fused bucketed ``pushpull``
+   (one psum per ~``MXNET_KV_BUCKET_MB`` bucket). The headline metric
+   is the dispatch reduction — O(params) -> O(params·bytes / cap).
+2. **exchange wall time** — median over reps of the full exchange
+   (pack + reduce + scatter, synced), per-key vs bucketed vs
+   bucketed + 2-bit compression.
+3. **training-loss bit-identity** — a small data-parallel Trainer run
+   twice (per-key vs bucketed store): losses and final weights must be
+   BIT-identical, the acceptance gate for switching the trainer to the
+   fused path.
+
+Emits bench.py's JSON contract — one flushed line per completed stage,
+monotonically enriched, ``{"metric", "value", "unit", "vs_baseline"}``
+first — so the same last-line-of-stdout drivers parse it.
+``vs_baseline`` is the measured dispatch reduction against the 10x
+acceptance bar (ISSUE 5): >= 1.0 passes. Knobs: COMMS_BENCH_COPIES
+(gradient copies per key, default 2), COMMS_BENCH_REPS (timed reps,
+default 3), COMMS_BENCH_SCALE (``resnet50`` | ``tiny``),
+MXNET_KV_BUCKET_MB (bucket cap, default 25).
+
+Forces JAX_PLATFORMS=cpu + an 8-device virtual host mesh when run as a
+script (measuring exchange mechanics, not a tunnel), like the tier-1
+test environment. Importing the module has no side effects (bench.py
+borrows :func:`resnet50_param_shapes`).
+"""
+from __future__ import annotations
+
+import json
+import os
+import sys
+import time
+
+if __name__ == "__main__":
+    os.environ["JAX_PLATFORMS"] = "cpu"
+    _flags = os.environ.get("XLA_FLAGS", "")
+    if "xla_force_host_platform_device_count" not in _flags:
+        os.environ["XLA_FLAGS"] = (
+            _flags + " --xla_force_host_platform_device_count=8").strip()
+    sys.path.insert(0, os.path.dirname(
+        os.path.dirname(os.path.abspath(__file__))))
+
+import numpy as np
+
+DISPATCH_REDUCTION_BAR = 10.0   # ISSUE 5 acceptance: >= 10x fewer
+
+
+def resnet50_param_shapes():
+    """The 161 trainable-parameter shapes of ResNet-50 v1 (conv weights,
+    BN gamma/beta, fc) — ~25.5M params, the ISSUE's 'ResNet-50-scale
+    param set'. Generated, not read from the model zoo: this tool must
+    not pay a model build + shape inference to know the layout."""
+    shapes = [(64, 3, 7, 7), (64,), (64,)]
+    in_c = 64
+    for n_blocks, width in zip((3, 4, 6, 3), (64, 128, 256, 512)):
+        for b in range(n_blocks):
+            shapes += [(width, in_c, 1, 1), (width,), (width,),
+                       (width, width, 3, 3), (width,), (width,),
+                       (width * 4, width, 1, 1), (width * 4,),
+                       (width * 4,)]
+            if b == 0:
+                shapes += [(width * 4, in_c, 1, 1), (width * 4,),
+                           (width * 4,)]
+            in_c = width * 4
+    shapes += [(1000, 2048), (1000,)]
+    return shapes
+
+
+def tiny_param_shapes():
+    """Small stand-in set for smoke tests (same code path, <1 MB)."""
+    return [(64, 32), (64,), (32, 16, 3, 3), (32,), (128, 64), (128,),
+            (8, 8), (2000,)]
+
+
+def _emit(record: dict) -> None:
+    print(json.dumps(record), flush=True)
+
+
+def _make_store(copies, bucket_bytes, compression=None):
+    import mxnet_tpu as mx
+    from mxnet_tpu import kvstore as kv
+
+    store = kv.create("tpu_sync")
+    store._bucket_bytes = bucket_bytes
+    if compression is not None:
+        store.set_gradient_compression(compression)
+    return store
+
+
+def _make_grads(shapes, copies):
+    import mxnet_tpu as mx
+
+    rs = np.random.RandomState(0)
+    vals, outs = [], []
+    for sh in shapes:
+        g = rs.randn(*sh).astype(np.float32)
+        vals.append([mx.nd.array(g).as_in_context(mx.Context("cpu", c))
+                     for c in range(copies)])
+        outs.append([mx.nd.zeros(sh, ctx=mx.Context("cpu", c))
+                     for c in range(copies)])
+    return vals, outs
+
+
+def _collective_counts():
+    from mxnet_tpu import telemetry
+
+    fam = telemetry.snapshot()["metrics"].get(
+        "mxnet_kvstore_collective_dispatch_total")
+    out = {"per_key": 0.0, "bucketed": 0.0}
+    for s in (fam["samples"] if fam else ()):
+        out[s["labels"]["path"]] = s["value"]
+    return out
+
+
+def _exchange(store, keys, vals, outs, priorities):
+    import mxnet_tpu as mx
+
+    store.pushpull(keys, vals, out=outs, priority=priorities)
+    mx.nd.waitall()
+
+
+def _run_variant(shapes, copies, bucket_bytes, reps, compression=None):
+    """Returns (collectives_per_step, median_ms) for one exchange
+    configuration."""
+    import mxnet_tpu as mx
+    from mxnet_tpu import telemetry
+
+    store = _make_store(copies, bucket_bytes, compression)
+    vals, outs = _make_grads(shapes, copies)
+    keys = list(range(len(shapes)))
+    priorities = [-k for k in keys]
+    for k, sh in zip(keys, shapes):
+        store.init(k, mx.nd.zeros(sh))
+    was = telemetry.enabled()
+    telemetry.enable()
+    try:
+        _exchange(store, keys, vals, outs, priorities)   # warm compiles
+        c0 = _collective_counts()
+        t_all = []
+        for _ in range(reps):
+            t0 = time.perf_counter()
+            _exchange(store, keys, vals, outs, priorities)
+            t_all.append(time.perf_counter() - t0)
+        c1 = _collective_counts()
+    finally:
+        if not was:
+            telemetry.disable()
+    per_step = sum(c1.values()) - sum(c0.values())
+    t_all.sort()
+    return per_step / reps, t_all[len(t_all) // 2] * 1e3
+
+
+def _loss_bit_identity(steps=4):
+    """Small 2-context data-parallel Trainer, per-key vs bucketed store:
+    per-step losses and the final weight must be bit-identical."""
+    import mxnet_tpu as mx
+    from mxnet_tpu import autograd, gluon
+    from mxnet_tpu.gluon import nn
+    from mxnet_tpu.gluon.loss import L2Loss
+
+    def run(bucket_mb):
+        prev = os.environ.get("MXNET_KV_BUCKET_MB")
+        os.environ["MXNET_KV_BUCKET_MB"] = str(bucket_mb)
+        try:
+            mx.random.seed(0)
+            net = nn.Dense(16, in_units=32)
+            net.initialize()
+            rs = np.random.RandomState(7)
+            net.weight.set_data(mx.nd.array(
+                rs.randn(16, 32).astype(np.float32)))
+            net.bias.set_data(mx.nd.zeros(16))
+            ctxs = [mx.Context("cpu", 0), mx.Context("cpu", 1)]
+            net.collect_params().reset_ctx(ctxs)
+            tr = gluon.Trainer(net.collect_params(), "sgd",
+                               {"learning_rate": 0.05}, kvstore="tpu_sync")
+            loss_fn = L2Loss()
+            rs2 = np.random.RandomState(11)
+            x = rs2.randn(8, 32).astype(np.float32)
+            y = rs2.randn(8, 16).astype(np.float32)
+            losses = []
+            for _ in range(steps):
+                with autograd.record():
+                    ls = [loss_fn(net(mx.nd.array(x[i * 4:(i + 1) * 4],
+                                                  ctx=c)),
+                                  mx.nd.array(y[i * 4:(i + 1) * 4],
+                                              ctx=c))
+                          for i, c in enumerate(ctxs)]
+                autograd.backward(ls)
+                tr.step(8)
+                losses.append(float(sum(l.asnumpy().sum() for l in ls)))
+            return losses, net.weight.data(ctxs[0]).asnumpy()
+        finally:
+            if prev is None:
+                os.environ.pop("MXNET_KV_BUCKET_MB", None)
+            else:
+                os.environ["MXNET_KV_BUCKET_MB"] = prev
+
+    losses_pk, w_pk = run(0)
+    losses_bk, w_bk = run(25)
+    return (losses_pk == losses_bk and np.array_equal(w_pk, w_bk),
+            losses_bk[-1])
+
+
+def main():
+    from mxnet_tpu.telemetry import pop_telemetry_out_flag
+
+    sys.argv[1:], telemetry_out = pop_telemetry_out_flag(sys.argv[1:])
+    if telemetry_out:
+        from mxnet_tpu import telemetry
+
+        telemetry.enable()
+
+    scale = os.environ.get("COMMS_BENCH_SCALE", "resnet50")
+    shapes = tiny_param_shapes() if scale == "tiny" \
+        else resnet50_param_shapes()
+    copies = int(os.environ.get("COMMS_BENCH_COPIES", "2"))
+    reps = int(os.environ.get("COMMS_BENCH_REPS", "3"))
+    from mxnet_tpu.kvstore import bucket_cap_bytes
+
+    cap = bucket_cap_bytes()
+    total_bytes = sum(4 * int(np.prod(s)) for s in shapes)
+
+    # stage 1+2 share the variant runs (the dispatch counters come from
+    # the same timed exchanges)
+    perkey_n, perkey_ms = _run_variant(shapes, copies, 0, reps)
+    bucket_n, bucket_ms = _run_variant(shapes, copies, cap, reps)
+    reduction = perkey_n / max(bucket_n, 1.0)
+    record = {
+        "metric": "comms_collective_dispatch_reduction",
+        "value": round(reduction, 1),
+        "unit": "x",
+        "vs_baseline": round(reduction / DISPATCH_REDUCTION_BAR, 4),
+        "comms_params": len(shapes),
+        "comms_param_mb": round(total_bytes / (1 << 20), 1),
+        "comms_copies": copies,
+        "comms_bucket_mb": round(cap / (1 << 20), 3),
+        "comms_perkey_collectives_per_step": round(perkey_n, 1),
+        "comms_bucketed_collectives_per_step": round(bucket_n, 1),
+    }
+    _emit(record)
+
+    _, bucket2bit_ms = _run_variant(
+        shapes, copies, cap, reps,
+        compression={"type": "2bit", "threshold": 0.5})
+    record.update({
+        "comms_perkey_ms_per_step": round(perkey_ms, 2),
+        "comms_bucketed_ms_per_step": round(bucket_ms, 2),
+        "comms_bucketed_2bit_ms_per_step": round(bucket2bit_ms, 2),
+        "comms_bucketed_speedup_vs_perkey": round(
+            perkey_ms / max(bucket_ms, 1e-9), 2),
+    })
+    _emit(record)
+
+    identical, last_loss = _loss_bit_identity()
+    record.update({
+        "comms_bucketed_loss_bit_identical": bool(identical),
+        "comms_trainer_last_loss": round(last_loss, 6),
+    })
+    _emit(record)
+
+    if telemetry_out:
+        from mxnet_tpu import telemetry
+
+        telemetry.write_snapshot(telemetry_out)
+    return 0 if identical else 1
+
+
+if __name__ == "__main__":
+    sys.exit(main())
