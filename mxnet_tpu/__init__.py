@@ -178,6 +178,7 @@ from . import tracing
 from . import telemetry
 from . import fault
 from . import checkpoint
+from . import serving
 from . import profiler
 from . import callback
 from . import monitor

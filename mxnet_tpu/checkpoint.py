@@ -178,6 +178,8 @@ class CheckpointManager:
         self.prefix = prefix
         self.keep_last = int(keep_last)
         self._pat = re.compile(re.escape(prefix) + r"-(\d{8})$")
+        # poll_newest change-detection state, keyed by caller tag
+        self._poll_state: Dict[str, Dict] = {}
         os.makedirs(self.directory, exist_ok=True)
 
     # -- naming --------------------------------------------------------
@@ -242,6 +244,51 @@ class CheckpointManager:
             if self.is_valid(s):
                 return s
         return None
+
+    def _manifest_sig(self, step: int) -> Optional[tuple]:
+        """Cheap identity of a bundle's commit record: one stat() of its
+        manifest. The manifest is always written last and atomically, so
+        (step, mtime_ns, size) changing is necessary AND sufficient for
+        the bundle's content having changed."""
+        try:
+            st = os.stat(os.path.join(self.path(step), MANIFEST_NAME))
+        except OSError:
+            return None
+        return (step, st.st_mtime_ns, st.st_size)
+
+    def poll_newest(self, tag: str = "default") -> Optional[int]:
+        """Return the newest valid step IFF it changed since the last
+        poll with this ``tag``; None when nothing new (including "still
+        no checkpoint"). The hot-reload watcher's tick primitive: the
+        no-change path is one ``listdir`` + one ``stat`` — full manifest
+        re-hashing (:meth:`is_valid` over every payload file) only runs
+        when a bundle's commit record actually moved. Each ``tag`` keeps
+        independent state, so several watchers can share one manager.
+        The first poll with a tag reports an existing checkpoint as a
+        change; prime the tag with one discarded poll to watch for
+        *subsequent* checkpoints only."""
+        committed = [s for s in self._scan() if self._has_manifest(s)]
+        commit_sig = self._manifest_sig(committed[0]) if committed else None
+        prev = self._poll_state.get(tag)
+        if prev is not None and prev["commit_sig"] == commit_sig:
+            return None
+        # the newest committed bundle moved (or first poll): pay one full
+        # validation pass to find the newest VALID step
+        step = self.latest_step()
+        valid_sig = self._manifest_sig(step) if step is not None else None
+        changed = (prev is None or step != prev["valid_step"]
+                   or valid_sig != prev["valid_sig"])
+        self._poll_state[tag] = {"commit_sig": commit_sig,
+                                 "valid_step": step,
+                                 "valid_sig": valid_sig}
+        return step if (changed and step is not None) else None
+
+    def poll_reset(self, tag: str = "default") -> None:
+        """Forget ``tag``'s poll state: the next :meth:`poll_newest`
+        reports the newest valid bundle again. A consumer that FAILED to
+        act on a reported change calls this so the change is re-offered
+        next tick instead of being lost until a newer bundle lands."""
+        self._poll_state.pop(tag, None)
 
     # -- write ---------------------------------------------------------
     def _param_payload(self, params) -> Dict:

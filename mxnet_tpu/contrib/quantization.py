@@ -358,6 +358,12 @@ def quantize_net(network, calib_data=None, calib_mode="naive",
     for blk in _walk(network):
         if getattr(blk, "_cached_graph", None) is not None:
             blk._cached_graph = None
+    # a hybridized net must STAY hybridized: the swapped-in Quantized*/
+    # Int8Run children are fresh blocks constructed inactive, so without
+    # re-propagation a child served standalone (or a later warmup() over
+    # the serving bucket grid) would silently run eager
+    if getattr(network, "_active", False):
+        network.hybridize(True, **getattr(network, "_flags", {}))
     return network
 
 

@@ -13,7 +13,9 @@ module makes the failure paths *testable*:
   ``kvstore.allreduce`` (comms), ``checkpoint.write`` /
   ``checkpoint.read`` (every atomic file commit / checkpoint load),
   ``datafeed.put`` (each batch staged by the async input pipeline —
-  ``io.DeviceFeedIter``).
+  ``io.DeviceFeedIter``), ``serving.dispatch`` (every inference batch
+  the model server dispatches) and ``serving.reload`` (every model
+  hot-reload — ``serving.Server``).
   Like telemetry, every call site guards on one module-level flag
   (``_state.enabled`` — a single attribute load + branch), so the
   disabled fast path costs one branch and allocates nothing.
@@ -72,6 +74,8 @@ SITES = (
     "checkpoint.write",
     "checkpoint.read",
     "datafeed.put",
+    "serving.dispatch",
+    "serving.reload",
 )
 
 

@@ -483,6 +483,47 @@ class _CachedGraph:
         pure, cell = make_pure_fn(self.block, param_arrays, ctx, training)
         return {"jitted": jax.jit(pure), "cell": cell}
 
+    def warmup(self, arg_specs, dtype="float32", ctx=None):
+        """AOT-compile one cache entry per input signature, ahead of any
+        real request (first bite of ROADMAP item 5 — a serving replica
+        must start hot, not pay first-request trace+compile latencies).
+
+        ``arg_specs``: iterable of input signatures. Each spec is either
+        one shape tuple (single-input block) or a sequence of shape
+        tuples (multi-input); ``dtype`` applies to every input, or pass
+        ``(shape, dtype)`` pairs inside a multi-input spec-style list to
+        mix — shapes whose first element is an ``int`` are treated as a
+        single input.
+
+        Drives a real zero-filled call through ``__call__`` per spec
+        (inference mode, gradient tape paused), so both the trace cache
+        here AND jax's executable cache are warm — a later request with
+        that signature is a pure cache hit. Returns the number of
+        entries newly compiled (0 = everything was already warm).
+        """
+        from .. import autograd as _ag
+        from ..ndarray import zeros as _nd_zeros
+
+        before = len(self._cache)
+        for spec in arg_specs:
+            spec = list(spec) if not (spec and isinstance(spec[0], int)) \
+                else [tuple(spec)]
+            args = []
+            for item in spec:
+                if (len(item) == 2 and isinstance(item[0], (tuple, list))
+                        and isinstance(item[1], str)):
+                    shape, dt = tuple(item[0]), item[1]
+                else:
+                    shape, dt = tuple(item), dtype
+                args.append(_nd_zeros(shape, ctx=ctx, dtype=dt))
+            with _ag.pause():
+                try:
+                    self(args)
+                except DeferredInitializationError:
+                    self.block._deferred_infer_shape(*args)
+                    self(args)
+        return len(self._cache) - before
+
 
 class HybridBlock(Block):
     """Block that can be compiled to one XLA executable
@@ -511,6 +552,20 @@ class HybridBlock(Block):
 
     def _clear_cached_op(self):
         self._cached_graph = None
+
+    def warmup(self, input_shapes, dtype="float32", ctx=None):
+        """Pre-trace + compile the hybridized graph for every signature
+        in ``input_shapes`` (see :meth:`_CachedGraph.warmup`) so no
+        real request pays a first-call compile — the serving bucket
+        grid's load-time hook. Requires :meth:`hybridize` first; returns
+        the number of entries newly compiled."""
+        if not self._active:
+            raise MXNetError(
+                f"{self.name}: warmup() requires hybridize() — only a "
+                "compiled block has a graph cache to warm")
+        if self._cached_graph is None:
+            self._cached_graph = _CachedGraph(self, self._flags)
+        return self._cached_graph.warmup(input_shapes, dtype=dtype, ctx=ctx)
 
     def cast(self, dtype):
         self._clear_cached_op()

@@ -1,0 +1,16 @@
+"""``mx.serving`` — the inference serving stack (ROADMAP item 1).
+
+A model server over the ``_CachedGraph`` compiled path: concurrent
+requests enter through ``Server.submit`` (thread-safe, Future out), a
+scheduler drains them into dynamic batches padded onto a
+``BucketGrid`` — the ``BucketingModule`` idea (PAPER.md §2.3) re-keyed
+to compiled-graph cache entries — and dispatches each batch as one warm
+XLA executable under a per-request latency SLO. Hot reload, fault
+injection/retry and Prometheus telemetry ride the PR-1/PR-3
+infrastructure; see :mod:`.server`, :mod:`.buckets`, :mod:`.reload`.
+"""
+from .buckets import BucketGrid
+from .reload import ReloadWatcher
+from .server import Server, live_servers
+
+__all__ = ["Server", "BucketGrid", "ReloadWatcher", "live_servers"]

@@ -1,0 +1,448 @@
+"""``mx.serving.Server`` — continuous-batching model server.
+
+The repo trains fast; this is the piece that *serves* (ROADMAP item 1).
+One server wraps one hybridized (optionally int8-quantized) Gluon block
+and turns concurrent single-sample requests into bucket-padded batches:
+
+* :meth:`Server.submit` is the thread-safe ingress — any thread hands in
+  one sample and gets a ``concurrent.futures.Future`` back;
+* a scheduler thread drains the queue into dynamic batches under a
+  per-request latency SLO: it keeps filling while the oldest queued
+  request is comfortably inside its deadline and dispatches early the
+  moment it is not (deadline-aware batch close);
+* each batch is padded up to the nearest :class:`~.buckets.BucketGrid`
+  entry, so every dispatch lands on one warm ``_CachedGraph`` executable
+  (``HybridBlock.warmup`` pre-compiles the whole grid at load time);
+* per-request outputs are sliced from the real rows and resolved into
+  the futures; padded rows never reach a caller.
+
+Resilience reuses the PR-3 runtime: every dispatch runs under
+``fault.retry_call`` at site ``serving.dispatch`` (transient failures
+retry with backoff; deterministic ones fail the batch's futures, not the
+server), and hot reload (``serving.reload``) swaps a freshly-built,
+freshly-WARMED model in behind a lock — the old graph serves every
+request that arrives while the new one compiles (see
+:mod:`mxnet_tpu.serving.reload`).
+
+Telemetry (``MXNET_TELEMETRY=1`` / ``telemetry.enable()``):
+``mxnet_serving_queue_depth``, ``mxnet_serving_batch_occupancy``,
+``mxnet_serving_time_in_queue_seconds``, ``mxnet_serving_request_seconds``
+(p50/p99 from the fine ``SERVING_BUCKETS``), ``mxnet_serving_requests_total``,
+``mxnet_serving_batches_total{reason}``, ``mxnet_serving_reloads_total`` —
+all exported via ``telemetry.prom_text()``.
+"""
+from __future__ import annotations
+
+import threading
+import time
+import weakref
+from concurrent.futures import Future
+from typing import Optional, Sequence, Tuple
+
+import numpy as np
+
+from .. import autograd, fault, telemetry
+from ..base import MXNetError
+from ..fault import _state as _fault_state
+from ..telemetry import _state as _telemetry_state
+from .buckets import BucketGrid
+
+__all__ = ["Server", "live_servers"]
+
+# every running server, for the test-suite leak guard: a test that leaves
+# a scheduler (or watcher) thread running would tax every later test
+_live_servers = weakref.WeakSet()
+
+
+def live_servers():
+    """Servers whose scheduler thread is currently running."""
+    return [s for s in list(_live_servers) if s.is_running]
+
+
+class _Request:
+    __slots__ = ("sample", "shape_key", "future", "t_enqueue", "deadline")
+
+    def __init__(self, sample, shape_key, deadline_s):
+        self.sample = sample
+        self.shape_key = shape_key
+        self.future = Future()
+        self.t_enqueue = time.perf_counter()
+        self.deadline = self.t_enqueue + deadline_s
+
+
+class Server:
+    """Serve a Gluon block under a latency SLO with bucketed batching.
+
+    ::
+
+        net.hybridize()
+        srv = mx.serving.Server(net, batch_buckets=(1, 4, 16, 32),
+                                shape_buckets=[(3, 224, 224)], slo_ms=50)
+        srv.start()                       # warms every grid bucket
+        fut = srv.submit(image)           # any thread; one sample, no
+        probs = fut.result()              # batch dim; numpy out
+        srv.stop()                        # drains in-flight requests
+
+    ``block``: the model. A ``HybridBlock`` is hybridized (if it is not
+    already) and every grid bucket is AOT-warmed at :meth:`start`; a
+    plain ``Block`` serves eagerly (no warmup — useful for tests).
+
+    ``slo_ms`` is the per-request latency objective: a request's batch
+    closes no later than ``slo_ms - close_margin_ms`` after its submit,
+    however empty the batch is; under load batches close early on
+    ``full``. ``deadline_ms=`` at submit overrides per request.
+
+    ``dtype``: samples are cast to it on submit. Futures resolve with
+    numpy arrays (or the model's output structure with numpy leaves).
+    """
+
+    def __init__(self, block, batch_buckets=(1, 2, 4, 8, 16, 32),
+                 shape_buckets=None, slo_ms: float = 100.0,
+                 close_margin_ms: float = 5.0, max_queue: int = 4096,
+                 dtype: str = "float32", ctx=None, warmup: bool = True,
+                 name: Optional[str] = None):
+        if slo_ms <= 0:
+            raise MXNetError(f"slo_ms must be > 0, got {slo_ms}")
+        if close_margin_ms < 0 or close_margin_ms >= slo_ms:
+            raise MXNetError(
+                f"close_margin_ms must be in [0, slo_ms), got "
+                f"{close_margin_ms} (slo_ms={slo_ms})")
+        if max_queue < 1:
+            raise MXNetError(f"max_queue must be >= 1, got {max_queue}")
+        self.grid = BucketGrid(batch_buckets, shape_buckets)
+        self.slo_s = slo_ms / 1e3
+        self.margin_s = close_margin_ms / 1e3
+        self.max_queue = int(max_queue)
+        self.dtype = dtype
+        self.ctx = ctx
+        self.name = name or f"server_{id(self):x}"
+        self._warmup = bool(warmup)
+        self._model = block
+        self._model_lock = threading.Lock()
+        self._cond = threading.Condition()
+        self._queue: list = []
+        self._running = False
+        self._thread: Optional[threading.Thread] = None
+        self._watcher = None        # reload.ReloadWatcher, when enabled
+        self.loaded_step: Optional[int] = None
+        # signatures actually compiled/used — the reload warmup manifest
+        self._warm_sigs = set()
+        # always-on light counters (telemetry covers the full story)
+        self.n_requests = 0
+        self.n_batches = 0
+        self.n_errors = 0
+        self.n_reloads = 0
+
+    # -- lifecycle -----------------------------------------------------
+    @property
+    def is_running(self) -> bool:
+        return self._running or (self._thread is not None
+                                 and self._thread.is_alive())
+
+    def start(self) -> "Server":
+        """Warm the bucket grid and start the scheduler thread."""
+        if self.is_running:
+            raise MXNetError(f"{self.name}: already running")
+        self._warm_block(self._model, prime=True)
+        self._running = True
+        self._thread = threading.Thread(
+            target=self._scheduler_loop, name=self.name, daemon=True)
+        self._thread.start()
+        _live_servers.add(self)
+        return self
+
+    def stop(self, drain: bool = True, timeout: Optional[float] = None
+             ) -> None:
+        """Stop the server. ``drain=True`` (default) serves every queued
+        request first (dispatching immediately, SLO waits skipped);
+        ``drain=False`` fails pending futures with :class:`MXNetError`."""
+        with self._cond:
+            self._running = False
+            if not drain:
+                pending, self._queue = self._queue, []
+                for r in pending:
+                    if not r.future.set_running_or_notify_cancel():
+                        continue        # caller already cancelled it
+                    r.future.set_exception(
+                        MXNetError(f"{self.name}: server stopped before "
+                                   "this request was dispatched"))
+                    self._count_request(outcome="rejected")
+            self._cond.notify_all()
+        if self._watcher is not None:
+            self._watcher.stop(timeout)
+            self._watcher = None
+        if self._thread is not None:
+            self._thread.join(timeout)
+            if self._thread.is_alive():
+                raise MXNetError(
+                    f"{self.name}: scheduler thread did not exit within "
+                    f"{timeout}s")
+            self._thread = None
+        _live_servers.discard(self)
+
+    def __enter__(self) -> "Server":
+        return self.start()
+
+    def __exit__(self, *exc) -> None:
+        self.stop(drain=not any(exc))
+
+    # -- ingress -------------------------------------------------------
+    def submit(self, sample, deadline_ms: Optional[float] = None) -> Future:
+        """Enqueue one sample (NO batch dimension); returns a Future that
+        resolves to the model output for that sample (numpy leaves).
+        Thread-safe. Raises :class:`MXNetError` immediately when the
+        server is not running, the queue is full, or no shape bucket
+        fits the sample — rejection is synchronous, never a hung future.
+        """
+        arr = sample.asnumpy() if hasattr(sample, "asnumpy") \
+            else np.asarray(sample)
+        arr = np.ascontiguousarray(arr, dtype=self.dtype)
+        bucket = self.grid.bucket_shape(arr.shape)   # raises if none fits
+        arr = self.grid.pad_sample(arr, bucket)
+        deadline_s = (deadline_ms / 1e3 if deadline_ms is not None
+                      else self.slo_s)
+        req = _Request(arr, bucket, deadline_s)
+        with self._cond:
+            if not self._running:
+                self._count_request(outcome="rejected")
+                raise MXNetError(f"{self.name}: server is not running")
+            if len(self._queue) >= self.max_queue:
+                self._count_request(outcome="rejected")
+                raise MXNetError(
+                    f"{self.name}: submission queue full "
+                    f"({self.max_queue} requests)")
+            self._queue.append(req)
+            depth = len(self._queue)
+            self._cond.notify_all()
+        if _telemetry_state.enabled:
+            telemetry.set_serving_queue_depth(depth)
+        return req.future
+
+    # -- scheduler -----------------------------------------------------
+    def _scheduler_loop(self) -> None:
+        try:
+            while True:
+                batch, reason = self._next_batch()
+                if batch is None:
+                    return
+                self._dispatch(batch, reason)
+        except BaseException:
+            # a scheduler death must be LOUD, not a server that accepts
+            # requests into a queue nobody drains: stop accepting and
+            # fail everything queued
+            with self._cond:
+                self._running = False
+                pending, self._queue = self._queue, []
+            for r in pending:
+                if r.future.set_running_or_notify_cancel():
+                    r.future.set_exception(MXNetError(
+                        f"{self.name}: scheduler thread crashed"))
+            raise
+
+    def _next_batch(self):
+        """Block until a batch should close; returns (requests, reason)
+        or (None, None) on shutdown with an empty queue."""
+        with self._cond:
+            while True:
+                if not self._queue:
+                    if not self._running:
+                        return None, None
+                    self._cond.wait(0.1)
+                    continue
+                head = self._queue[0]
+                key = head.shape_key
+                cap = self.grid.max_batch
+                matching = sum(1 for r in self._queue
+                               if r.shape_key == key)
+                now = time.perf_counter()
+                # close on the TIGHTEST deadline in the queue, not just
+                # the head's: a short-deadline request behind a lazy head
+                # (same key: it rides this batch; different key: it is
+                # served right after) must not wait out the head's SLO
+                close_at = min(r.deadline for r in self._queue) \
+                    - self.margin_s
+                if matching >= cap:
+                    reason = "full"
+                elif not self._running:
+                    reason = "drain"
+                elif now >= close_at:
+                    reason = "deadline"
+                else:
+                    # fill otherwise: sleep until the head's close time
+                    # or the next submit, whichever is first
+                    self._cond.wait(min(close_at - now, 0.1))
+                    continue
+                taken, rest = [], []
+                for r in self._queue:
+                    if len(taken) < cap and r.shape_key == key:
+                        taken.append(r)
+                    else:
+                        rest.append(r)
+                self._queue = rest
+                if _telemetry_state.enabled:
+                    telemetry.set_serving_queue_depth(len(rest))
+                return taken, reason
+
+    def _dispatch(self, batch, reason: str) -> None:
+        """Pad, run, slice, resolve — one bucketed inference dispatch."""
+        from ..ndarray import array as nd_array
+
+        t_start = time.perf_counter()
+        # a caller may have cancelled a still-queued future; drop those
+        # rows now — set_result on a cancelled future would raise and
+        # kill the scheduler thread
+        batch = [r for r in batch
+                 if r.future.set_running_or_notify_cancel()]
+        if not batch:
+            return
+        n = len(batch)
+        key = batch[0].shape_key
+        cap = self.grid.batch_bucket(n)
+        payload = np.zeros((cap,) + key, dtype=self.dtype)
+        for i, r in enumerate(batch):
+            payload[i] = r.sample
+        model = self._model          # reload swaps the attribute, not us
+        sig = (cap,) + key
+
+        def run():
+            if _fault_state.enabled:
+                fault.check("serving.dispatch", f"{self.name} batch={sig}")
+            x = nd_array(payload, ctx=self.ctx)
+            with autograd.pause():
+                out = model(x)
+            return self._materialize(out)
+
+        try:
+            leaves, tree = fault.retry_call(
+                "serving.dispatch", run, detail=self.name)
+        except Exception as e:  # noqa: BLE001 - forwarded to the futures
+            self.n_errors += 1
+            for r in batch:
+                r.future.set_exception(e)
+                self._count_request(outcome="error", t_enqueue=r.t_enqueue)
+            return
+        self.n_batches += 1
+        if _telemetry_state.enabled:
+            telemetry.record_serving_batch(n, cap, reason)
+            for r in batch:
+                telemetry.record_serving_queue_time(t_start - r.t_enqueue)
+        with self._model_lock:      # the reload warmup copies this set
+            self._warm_sigs.add(sig)
+        from ..gluon.block import nested_unflatten_nd
+
+        try:
+            for i, r in enumerate(batch):
+                # copy: a row VIEW would pin the whole padded batch
+                # array for as long as the caller holds the result
+                r.future.set_result(nested_unflatten_nd(
+                    tree, [leaf[i].copy() for leaf in leaves]))
+                self._count_request(outcome="ok", t_enqueue=r.t_enqueue)
+        except Exception as e:  # noqa: BLE001 - e.g. non-batch-major leaf
+            self.n_errors += 1
+            for r in batch:
+                if not r.future.done():
+                    r.future.set_exception(e)
+                    self._count_request(outcome="error",
+                                        t_enqueue=r.t_enqueue)
+
+    @staticmethod
+    def _materialize(out):
+        """Flatten the model output and pull each leaf to host numpy once
+        per batch (futures hand out row slices of these)."""
+        from ..gluon.block import nested_flatten_nd
+
+        flat, tree = nested_flatten_nd(out)
+        return [leaf.asnumpy() for leaf in flat], tree
+
+    def _count_request(self, outcome: str, t_enqueue: Optional[float] = None
+                       ) -> None:
+        self.n_requests += 1
+        if _telemetry_state.enabled:
+            lat = (time.perf_counter() - t_enqueue
+                   if t_enqueue is not None else 0.0)
+            telemetry.record_serving_request(lat, outcome)
+
+    # -- model management ----------------------------------------------
+    def _warm_block(self, block, prime: bool = False) -> int:
+        """AOT-compile ``block`` for every known signature: the full
+        grid when it is enumerable (``prime=True`` + shape buckets), and
+        always every signature this server has actually served — so a
+        hot-reloaded model is warm for live traffic before the swap."""
+        if not self._warmup or not hasattr(block, "warmup"):
+            return 0
+        with self._model_lock:      # the scheduler adds sigs concurrently
+            sigs = set(self._warm_sigs)
+        if prime and self.grid.shape_buckets is not None:
+            sigs.update(self.grid.input_signatures())
+        if not sigs:
+            return 0
+        if getattr(block, "_active", None) is False:
+            block.hybridize()
+        return block.warmup(sorted(sigs), dtype=self.dtype, ctx=self.ctx)
+
+    def swap_model(self, block) -> None:
+        """Atomically replace the served model with ``block``, warming it
+        for every signature in live use first — requests dispatched
+        during the warmup keep hitting the old graph."""
+        self._warm_block(block, prime=True)
+        with self._model_lock:
+            self._model = block
+        self.n_reloads += 1
+
+    def reload(self, manager, model_factory, step: Optional[int] = None
+               ) -> int:
+        """Zero-downtime reload from a :class:`CheckpointManager` bundle:
+        build a fresh block via ``model_factory(bundle_path)``, warm it,
+        swap it in. The old graph serves until the swap. Fault site
+        ``serving.reload``; transient failures retry, persistent ones
+        raise (the old model keeps serving). Returns the loaded step."""
+        t0 = time.perf_counter()
+        if step is None:
+            step = manager.latest_step()
+            if step is None:
+                raise MXNetError(
+                    f"{self.name}: no checksum-valid checkpoint under "
+                    f"{manager.directory!r} to reload from")
+        path = manager.path(step)
+
+        def build():
+            if _fault_state.enabled:
+                fault.check("serving.reload", path)
+            return model_factory(path)
+
+        try:
+            block = fault.retry_call("serving.reload", build, detail=path)
+            self.swap_model(block)
+        except Exception:
+            if _telemetry_state.enabled:
+                telemetry.record_serving_reload(0.0, outcome="error")
+            raise
+        self.loaded_step = step
+        if _telemetry_state.enabled:
+            telemetry.record_serving_reload(time.perf_counter() - t0)
+        return step
+
+    def enable_hot_reload(self, manager, model_factory,
+                          interval_s: float = 0.5,
+                          tag: Optional[str] = None):
+        """Start a watcher thread that polls ``manager`` (via
+        :meth:`CheckpointManager.poll_newest`) and hot-reloads on every
+        new valid bundle. See :class:`~.reload.ReloadWatcher`."""
+        from .reload import ReloadWatcher
+
+        if self._watcher is not None:
+            raise MXNetError(f"{self.name}: hot reload already enabled")
+        self._watcher = ReloadWatcher(
+            self, manager, model_factory, interval_s=interval_s,
+            tag=tag or self.name)
+        self._watcher.start()
+        return self._watcher
+
+    def stats(self) -> dict:
+        """Light always-on counters (telemetry has the full story)."""
+        with self._cond:
+            depth = len(self._queue)
+        return {"requests": self.n_requests, "batches": self.n_batches,
+                "errors": self.n_errors, "reloads": self.n_reloads,
+                "queue_depth": depth, "loaded_step": self.loaded_step,
+                "running": self.is_running}

@@ -53,10 +53,13 @@ __all__ = [
     "record_fault_injected", "record_retry", "record_checkpoint_write",
     "record_step_skipped",
     "record_data_wait", "set_data_queue_depth", "record_images_decoded",
+    "record_serving_request", "record_serving_batch",
+    "record_serving_queue_time", "set_serving_queue_depth",
+    "record_serving_reload",
     "TrainingTelemetry", "xla_cost_analysis",
     "pop_telemetry_out_flag", "write_snapshot",
     "LATENCY_BUCKETS", "STEP_BUCKETS", "SEGMENT_BUCKETS",
-    "BYTES_BUCKETS",
+    "BYTES_BUCKETS", "SERVING_BUCKETS", "OCCUPANCY_BUCKETS",
 ]
 
 
@@ -112,6 +115,15 @@ SEGMENT_BUCKETS: Tuple[float, ...] = (
 BYTES_BUCKETS: Tuple[float, ...] = (
     4096, 16384, 65536, 262144, 1 << 20, 4 << 20, 16 << 20, 64 << 20,
     256 << 20, 1 << 30)
+# inference request latencies: LATENCY_BUCKETS bottoms out too coarse for
+# serving p50s (a batched CPU dense dispatch answers in tens of µs) —
+# 20 µs .. 10 s, ~x2–2.5 geometric, dense through the sub-millisecond range
+SERVING_BUCKETS: Tuple[float, ...] = (
+    20e-6, 50e-6, 100e-6, 200e-6, 500e-6, 1e-3, 2e-3, 5e-3, 10e-3,
+    20e-3, 50e-3, 100e-3, 200e-3, 500e-3, 1.0, 2.0, 5.0, 10.0)
+# batch occupancy (real rows / padded bucket capacity): eighths of a batch
+OCCUPANCY_BUCKETS: Tuple[float, ...] = (
+    0.125, 0.25, 0.375, 0.5, 0.625, 0.75, 0.875, 1.0)
 
 
 class _Counter:
@@ -633,6 +645,73 @@ def record_images_decoded(n: int) -> None:
         return
     counter("mxnet_data_decoded_images_total",
             "Images decoded and augmented by the input pipeline.").inc(n)
+
+
+def record_serving_request(seconds: float, outcome: str = "ok") -> None:
+    """One served request, end-to-end (submit -> future resolved).
+    ``outcome``: ``ok``, ``error`` (dispatch failed after retries) or
+    ``rejected`` (queue full / server stopped — no latency recorded).
+    p50/p99 come from the histogram quantiles."""
+    if not _state.enabled:
+        return
+    counter("mxnet_serving_requests_total",
+            "Serving requests by outcome (ok/error/rejected).",
+            ("outcome",)).labels(outcome).inc()
+    if outcome != "rejected":
+        histogram("mxnet_serving_request_seconds",
+                  "End-to-end request latency (submit to future "
+                  "resolution).", buckets=SERVING_BUCKETS).observe(seconds)
+
+
+def record_serving_batch(n_real: int, capacity: int, reason: str) -> None:
+    """One dispatched inference batch. ``reason``: what closed it —
+    ``full`` (bucket capacity reached), ``deadline`` (oldest request
+    neared its SLO), ``drain`` (server stopping)."""
+    if not _state.enabled:
+        return
+    counter("mxnet_serving_batches_total",
+            "Inference batches dispatched, by close reason "
+            "(full/deadline/drain).", ("reason",)).labels(reason).inc()
+    if capacity > 0:
+        histogram("mxnet_serving_batch_occupancy",
+                  "Real requests / padded bucket capacity per dispatched "
+                  "batch.", buckets=OCCUPANCY_BUCKETS).observe(
+                      n_real / capacity)
+    pad = capacity - n_real
+    if pad > 0:
+        counter("mxnet_serving_padded_slots_total",
+                "Padding rows dispatched to round batches up to their "
+                "bucket.").inc(pad)
+
+
+def record_serving_queue_time(seconds: float) -> None:
+    """Time one request spent queued before its batch dispatched."""
+    if not _state.enabled:
+        return
+    histogram("mxnet_serving_time_in_queue_seconds",
+              "Time a request waited in the submission queue before "
+              "batch dispatch.", buckets=SERVING_BUCKETS).observe(seconds)
+
+
+def set_serving_queue_depth(depth: int) -> None:
+    """Requests currently waiting in the server's submission queue."""
+    if not _state.enabled:
+        return
+    gauge("mxnet_serving_queue_depth",
+          "Requests waiting in the serving submission queue.").set(depth)
+
+
+def record_serving_reload(seconds: float, outcome: str = "ok") -> None:
+    """One hot-reload attempt (build + restore + warmup + swap)."""
+    if not _state.enabled:
+        return
+    counter("mxnet_serving_reloads_total",
+            "Model hot-reload attempts by outcome (ok/error).",
+            ("outcome",)).labels(outcome).inc()
+    if outcome == "ok":
+        histogram("mxnet_serving_reload_seconds",
+                  "Wall time to build, warm and swap in a reloaded "
+                  "model.", buckets=STEP_BUCKETS).observe(seconds)
 
 
 def record_training_step(seconds: float, examples: float,

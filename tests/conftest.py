@@ -47,6 +47,29 @@ def telemetry_leak_guard():
 
 
 @pytest.fixture(autouse=True)
+def serving_leak_guard():
+    """Guard for the serving stack: a test that leaves a Server's
+    scheduler (or reload-watcher) thread running would keep dispatching
+    — and keep model state alive — under every later test. Fail the
+    leaking test loudly; tests stop servers in teardown (or use the
+    Server context manager)."""
+    yield
+    import sys
+
+    mod = sys.modules.get("mxnet_tpu.serving.server")
+    if mod is None:        # serving never imported: nothing to leak
+        return
+    leaked = mod.live_servers()
+    if leaked:
+        names = [s.name for s in leaked]
+        for s in leaked:
+            s.stop(drain=False)
+        pytest.fail(
+            f"test left serving Server(s) running: {names}; call "
+            "stop() in teardown or use the Server context manager")
+
+
+@pytest.fixture(autouse=True)
 def fault_leak_guard():
     """Mirror of the telemetry guard for the fault injector: a test that
     leaves fault injection globally enabled would make every later test
