@@ -38,6 +38,8 @@ __all__ = [
     "grad",
     "get_symbol",
     "Function",
+    "watch_grad_ready",
+    "unwatch_grad_ready",
 ]
 
 _state = threading.local()
@@ -166,6 +168,79 @@ def mark_variables(variables, gradients, grad_reqs="write") -> None:
     for v, g, req in zip(variables, gradients, grad_reqs):
         v._grad = g
         v._grad_req = req
+
+
+# ---------------------------------------------------------------------------
+# grad-ready watch (backward-overlapped comms)
+# ---------------------------------------------------------------------------
+
+# id(array) -> (weakref(array), weak-callable(callback)). When a watched
+# array's attached .grad is FINALIZED during a backward sweep (its last
+# contributing tape node has been processed — no later node can add to
+# it), the grad buffer is written immediately and the callback fires,
+# with the rest of the reverse sweep still to run. This is the seam the
+# overlapped-comms Trainer uses to issue a gradient bucket's allreduce
+# *inside* the backward (the reference engine's DependencyEngine push
+# scheduling, re-created on the tape): via JAX async dispatch the
+# collective's device work overlaps the remaining backward.
+# The array reference is weak, and a bound-method callback holds only a
+# weak reference to its owner (a plain-function callback is kept
+# strongly — it IS the registration); dead entries are pruned at the
+# start of every watched sweep. An id() can be reused by a new object —
+# the identity check on fire protects against aliasing.
+_GRAD_READY_WATCH = {}
+
+# Monotone id of the currently-running (or last) watched backward sweep.
+# Consumers with per-sweep state (the overlapped-comms Trainer) compare
+# it inside their ready callback: a backward that raised mid-sweep (so
+# the consumer's end-of-step reset never ran) is detected as a NEW
+# sweep id and the stale state self-heals.
+_BACKWARD_SEQ = 0
+
+
+def backward_sweep_seq() -> int:
+    """The current watched-backward sweep id (see _BACKWARD_SEQ)."""
+    return _BACKWARD_SEQ
+
+
+def watch_grad_ready(arrays, callback) -> None:
+    """Register ``callback(array)`` to fire when ``array``'s attached
+    gradient is finalized during ``backward()`` — while the reverse
+    sweep is still running. A bound-method callback keeps only a weak
+    reference to its owner (a plain function is referenced strongly);
+    dead registrations are pruned at the next watched sweep. No effect
+    on ``grad(..., create_graph=True)`` sweeps (grads are tape nodes
+    there, not buffer writes)."""
+    import weakref
+
+    try:
+        cb_ref = weakref.WeakMethod(callback)
+    except TypeError:
+        cb_ref = lambda _cb=callback: _cb
+    for a in arrays:
+        _GRAD_READY_WATCH[id(a)] = (weakref.ref(a), cb_ref)
+
+
+def unwatch_grad_ready(arrays) -> None:
+    for a in arrays:
+        _GRAD_READY_WATCH.pop(id(a), None)
+
+
+def _finalize_attached(arr, acc) -> bool:
+    """Write ``arr``'s accumulated cotangent into its attached grad
+    buffer per grad_req; True if a write happened."""
+    req = getattr(arr, "_grad_req", "null")
+    if req == "null" or getattr(arr, "_grad", None) is None:
+        return False
+    g = acc.get(id(arr))
+    if g is None:
+        return False
+    gbuf = arr._grad
+    if req == "add":
+        gbuf._set_data(gbuf.data + g.astype(gbuf.dtype))
+    else:
+        gbuf._set_data(g.astype(gbuf.dtype))
+    return True
 
 
 # ---------------------------------------------------------------------------
@@ -410,8 +485,35 @@ def _run_backward(heads, head_grads, collect=None, write_attached=True,
             if child is not None and id(child) not in visited:
                 stack.append((child, False))
 
+    # watched-array early finalization: a watched array's grad is FINAL
+    # once the last tape node listing it as an input has been swept — no
+    # later node can add_grad into it. Precompute that last-use index so
+    # the sweep can write the grad buffer and fire the ready callback
+    # in-flight (backward-overlapped comms; see watch_grad_ready).
+    sweep = list(reversed(order))
+    ready_at = {}
+    if write_attached and not create_graph and _GRAD_READY_WATCH:
+        # prune dead entries first — a process churning watchers must
+        # not pay the last-use scan for registrations that can never
+        # fire (and their ids may alias new objects)
+        for k, (aref, cref) in list(_GRAD_READY_WATCH.items()):
+            if aref() is None or cref() is None:
+                _GRAD_READY_WATCH.pop(k, None)
+    if write_attached and not create_graph and _GRAD_READY_WATCH:
+        global _BACKWARD_SEQ
+        _BACKWARD_SEQ += 1
+        last_use = {}
+        for idx, node in enumerate(sweep):
+            for inp in node.inputs:
+                k = id(inp)
+                if k in _GRAD_READY_WATCH:
+                    last_use[k] = idx
+        for k, idx in last_use.items():
+            ready_at.setdefault(idx, []).append(k)
+    finalized = set()
+
     # reverse sweep
-    for node in reversed(order):
+    for idx, node in enumerate(sweep):
         if create_graph:
             _sweep_node_recorded(node, acc, add_grad)
             continue
@@ -424,30 +526,36 @@ def _run_backward(heads, head_grads, collect=None, write_attached=True,
             else:
                 any_grad = True
                 cotangents.append(g.astype(dtype) if hasattr(g, "astype") and g.dtype != dtype else g)
-        if not any_grad:
-            continue
-        ct = tuple(cotangents) if len(cotangents) > 1 else cotangents[0]
-        in_grads = node.vjp_fn(ct)
-        for inp, g in zip(node.inputs, in_grads):
-            if g is None:
+        if any_grad:
+            ct = tuple(cotangents) if len(cotangents) > 1 else cotangents[0]
+            in_grads = node.vjp_fn(ct)
+            for inp, g in zip(node.inputs, in_grads):
+                if g is None:
+                    continue
+                dt = getattr(g, "dtype", None)
+                if dt is not None and str(dt) == "float0":
+                    continue
+                add_grad(inp, g)
+        for k in ready_at.get(idx, ()):
+            entry = _GRAD_READY_WATCH.get(k)
+            if entry is None:
                 continue
-            dt = getattr(g, "dtype", None)
-            if dt is not None and str(dt) == "float0":
+            arr = entry[0]()
+            cb = entry[1]()
+            if arr is None or cb is None:
+                # array or callback owner died — prune the stale entry
+                # (its id may alias a new object)
+                _GRAD_READY_WATCH.pop(k, None)
                 continue
-            add_grad(inp, g)
+            if _finalize_attached(arr, acc):
+                finalized.add(k)
+                cb(arr)
 
     # write attached grads (reference: grads written per grad_req write/add)
     if write_attached:
         for k, arr in keep.items():
-            req = getattr(arr, "_grad_req", "null")
-            if req == "null" or getattr(arr, "_grad", None) is None:
-                continue
-            g = acc[k]
-            gbuf = arr._grad
-            if req == "add":
-                gbuf._set_data(gbuf.data + g.astype(gbuf.dtype))
-            else:
-                gbuf._set_data(g.astype(gbuf.dtype))
+            if k not in finalized:
+                _finalize_attached(arr, acc)
     return acc
 
 

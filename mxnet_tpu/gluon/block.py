@@ -35,7 +35,31 @@ from ..ndarray.ndarray import _wrap_jax, imperative_invoke, _LambdaOp
 from .parameter import DeferredInitializationError, Parameter, ParameterDict
 
 __all__ = ["Block", "HybridBlock", "SymbolBlock", "nested_flatten_nd",
-           "remat_call"]
+           "remat_call", "resolve_remat_policy"]
+
+
+def resolve_remat_policy(policy):
+    """Normalize a remat policy name to a ``jax.checkpoint`` policy.
+
+    The single validator behind every remat surface (``remat_call``, the
+    model zoo's ``remat=`` kwargs, ``TrainStep(remat=...)``), so a typo
+    raises the same ValueError everywhere — eagerly, never from inside a
+    trace. Returns the jax policy callable (or None for save-nothing):
+
+      None / "full"  save nothing — recompute the whole span;
+      "dots"         ``dots_with_no_batch_dims_saveable`` — matmul
+                     outputs SAVED, elementwise/norm/rotary recompute;
+      callable       passed through (a raw jax checkpoint policy).
+    """
+    import jax
+
+    if policy in (None, "full"):
+        return None
+    if policy == "dots":
+        return jax.checkpoint_policies.dots_with_no_batch_dims_saveable
+    if callable(policy):
+        return policy
+    raise ValueError(f"unknown remat policy {policy!r}")
 
 
 def remat_call(block, *args, policy=None):
@@ -66,14 +90,7 @@ def remat_call(block, *args, policy=None):
 
     # validate the policy on EVERY call (eager included) so a typo can't
     # hide until the first traced step
-    if policy in (None, "full"):
-        jpolicy = None
-    elif policy == "dots":
-        jpolicy = jax.checkpoint_policies.dots_with_no_batch_dims_saveable
-    elif callable(policy):
-        jpolicy = policy
-    else:
-        raise ValueError(f"unknown remat policy {policy!r}")
+    jpolicy = resolve_remat_policy(policy)
 
     if not args or not isinstance(args[0].data, jax.core.Tracer):
         return block(*args)
@@ -443,10 +460,15 @@ class _CachedGraph:
             raise DeferredInitializationError  # caller runs one eager pass
         param_arrays = [p.data(ctx) for p in params]
         training = autograd.is_training()
+        from ..ops.registry import _routing_knobs
+
         key = (
             tuple((a.shape, str(a.dtype)) for a in args),
             tuple((a.shape, str(a.dtype)) for a in param_arrays),
             training,
+            # trace-time routing knobs (Pallas fused kernels, hash
+            # dropout) select different op bodies — key them like shapes
+            _routing_knobs(),
         )
         entry = self._cache.get(key)
         if _telemetry_state.enabled:

@@ -24,6 +24,11 @@ __all__ = ["RMSNorm", "LlamaAttention", "LlamaMLP", "LlamaBlock",
 
 
 class RMSNorm(HybridBlock):
+    """f32-statistics RMSNorm. Under ``MXNET_PALLAS_FUSED=1`` the
+    ``_contrib_rms_norm`` op routes to the fused Pallas kernel
+    (pallas_kernels/fused_layers.py, RMS mode) on TPU — every Llama
+    block adopts the fused layer path through this seam."""
+
     def __init__(self, units, eps=1e-6, prefix=None, params=None):
         super().__init__(prefix=prefix, params=params)
         self._eps = eps

@@ -48,6 +48,7 @@ class TransformerEncoderCell(HybridBlock):
                  prefix=None, params=None):
         super().__init__(prefix=prefix, params=params)
         self._pre_norm = pre_norm
+        self._drop_rate = float(dropout)
         with self.name_scope():
             self.attention = MultiHeadAttention(units, num_heads,
                                                 dropout=dropout,
@@ -59,7 +60,22 @@ class TransformerEncoderCell(HybridBlock):
             self.ln2 = nn.LayerNorm(prefix="ln2_")
             self.dropout = nn.Dropout(dropout) if dropout else None
 
+    def _fused_add_norm(self, F, h, residual, ln, dropout=0.0):
+        """``LN(dropout(h) + residual)`` through the fused op (one
+        Pallas VMEM pass when gated; eager composition otherwise). The
+        LayerNorm child keeps owning gamma/beta — parameter names and
+        checkpoints are unchanged — but its forward is bypassed, so a
+        deferred shape is settled here first."""
+        if ln.gamma._data is None:
+            ln._infer_param_shapes(h)
+        ctx = h.context
+        return F._contrib_fused_layer_norm(
+            h, ln.gamma.data(ctx), ln.beta.data(ctx), residual,
+            eps=ln._epsilon, dropout=dropout)
+
     def hybrid_forward(self, F, x, mask=None):
+        from ....pallas_kernels.fused_layers import fused_layers_enabled
+
         if self._pre_norm:
             h = self.attention(self.ln1(x), None, mask) if mask is not None \
                 else self.attention(self.ln1(x))
@@ -68,6 +84,14 @@ class TransformerEncoderCell(HybridBlock):
             return x + h
         h = self.attention(x, None, mask) if mask is not None \
             else self.attention(x)
+        if fused_layers_enabled():
+            # post-LN add+norm pairs collapse into the fused op — the
+            # PERF.md residue buckets this PR targets (epilogue re-reads,
+            # dropout mask traffic, the LN sweep) in one kernel
+            x = self._fused_add_norm(F, h, x, self.ln1,
+                                     dropout=self._drop_rate)
+            h = self.ffn(x)
+            return self._fused_add_norm(F, h, x, self.ln2)
         x = self.ln1(x + (self.dropout(h) if self.dropout else h))
         h = self.ffn(x)
         return self.ln2(x + h)

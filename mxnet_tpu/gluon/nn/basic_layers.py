@@ -86,6 +86,7 @@ class Dense(HybridBlock):
         self._units = units
         self._in_units = in_units
         self._flatten = flatten
+        self._activation = activation
         with self.name_scope():
             self.weight = self.params.get(
                 "weight", shape=(units, in_units), init=weight_initializer,
@@ -107,6 +108,18 @@ class Dense(HybridBlock):
         self.weight._finish_deferred_init((self._units, in_units))
 
     def hybrid_forward(self, F, x, weight, bias=None):
+        if bias is not None and self._activation == "gelu":
+            from ...pallas_kernels.fused_layers import fused_layers_enabled
+
+            if fused_layers_enabled():
+                # bias+GELU epilogue fused into one Pallas VMEM pass when
+                # the op's shape/platform gates hold (eager-identical
+                # composition otherwise) — the matmul keeps its own
+                # dispatch, only the epilogue moves
+                out = F.FullyConnected(x, weight, None,
+                                       num_hidden=self._units,
+                                       no_bias=True, flatten=self._flatten)
+                return F._contrib_fused_bias_gelu(out, bias)
         out = F.FullyConnected(x, weight, bias, num_hidden=self._units,
                                no_bias=bias is None, flatten=self._flatten)
         if self.act is not None:

@@ -38,7 +38,8 @@ class Trainer:
 
     def __init__(self, params, optimizer, optimizer_params=None,
                  kvstore="device", compression_params=None,
-                 update_on_kvstore=None, check_nonfinite=None):
+                 update_on_kvstore=None, check_nonfinite=None,
+                 overlap_comms=None):
         if isinstance(params, (dict, ParameterDict)):
             params = list(params.values())
         if not isinstance(params, (list, tuple)):
@@ -67,6 +68,13 @@ class Trainer:
         self._kv_initialized = False
         self._update_on_kvstore = update_on_kvstore
         self._contexts = None
+        # backward-overlapped comms: dispatch each gradient bucket's
+        # pushpull from the autograd grad-ready hook, INSIDE backward()
+        if overlap_comms is None:
+            overlap_comms = os.environ.get("MXNET_KV_OVERLAP", "0") == "1"
+        self._overlap_comms = bool(overlap_comms)
+        self._overlap = None
+        self.last_overlap_stats = None
 
     def _init_optimizer(self, optimizer, optimizer_params):
         param_dict = {i: p for i, p in enumerate(self._params)}
@@ -129,6 +137,154 @@ class Trainer:
         self._updaters = [opt.get_updater(self._optimizer)
                           for _ in self._contexts]
         self._kv_initialized = True
+        if self._overlap_comms:
+            self._setup_overlap()
+
+    # -- backward-overlapped comms -------------------------------------
+    def _setup_overlap(self):
+        """Arm the grad-ready hooks (``autograd.watch_grad_ready``) that
+        let ``backward()`` dispatch each gradient bucket's ``pushpull``
+        the moment its members' grads finalize — the reference engine's
+        priority-scheduled push, re-created on the tape. The collective's
+        device work then runs under the REST of the backward via JAX
+        async dispatch instead of starting after it.
+
+        Engages only when the fused bucketed path would run (worker-side
+        optimizer, bucketing on, a store with ``plan_pushpull``) and
+        every trainable param has grad_req='write' — 'add' accumulation
+        across multiple backwards would reduce a partial gradient.
+        Contract: one backward per step (the standard loop); the
+        nonfinite guard / AMP scaler must see gradients BEFORE any
+        reduce, so those trainers keep the at-step exchange. Note also
+        that grad buffers are REDUCED IN PLACE as backward runs: code
+        inspecting ``p.grad()`` between ``backward()`` and ``step()``
+        (e.g. manual global-norm clipping) would see a mix of reduced
+        and still-raw buckets — use ``allreduce_grads()`` +
+        ``update()`` with ``overlap_comms=False`` for that pattern."""
+        store = self._kvstore
+        if (store is None or self._update_on_kvstore
+                or not hasattr(store, "plan_pushpull")
+                or getattr(store, "_bucket_bytes", 0) <= 0
+                or self._check_nonfinite
+                or getattr(self, "_amp_loss_scaler", None) is not None):
+            return
+        if any(p.grad_req == "add" for p in self._params):
+            return
+        from .. import autograd as ag
+
+        idxs = [i for i, p in enumerate(self._params)
+                if p.grad_req != "null"]
+        if not idxs:
+            return
+        watch = {}
+        arrays = []
+        for i in idxs:
+            for a in self._params[i].list_data():
+                watch[id(a)] = i
+                arrays.append(a)
+        if self._overlap is not None:
+            ag.unwatch_grad_ready(self._overlap["arrays"])
+        self._overlap = {
+            "idxs": idxs, "watch": watch, "arrays": arrays,
+            "pending": {i: len(self._params[i].list_ctx())
+                        for i in idxs},
+            "exchange": None, "groups": None, "group_of": {},
+            "dispatched": set(), "in_backward": 0, "seq": -1,
+        }
+        ag.watch_grad_ready(arrays, self._on_grad_ready)
+
+    def _grad_exchange_args(self):
+        keys, grads, priorities = [], [], []
+        for i, p in enumerate(self._params):
+            if p.grad_req == "null":
+                continue
+            keys.append(i)
+            grads.append(p.list_grad())
+            priorities.append(-i)
+        return keys, grads, priorities
+
+    def _ensure_overlap_plan(self):
+        st = self._overlap
+        if st["groups"] is not None:
+            return
+        keys, grads, priorities = self._grad_exchange_args()
+        st["exchange"] = (keys, grads, priorities)
+        st["groups"] = self._kvstore.plan_pushpull(keys, grads, priorities)
+        for gi, grp in enumerate(st["groups"]):
+            for pos in grp:
+                st["group_of"][keys[pos]] = gi
+
+    def _on_grad_ready(self, arr):
+        """autograd grad-ready hook: fires inside backward() when a
+        watched param-copy's gradient buffer is finalized."""
+        st = self._overlap
+        if st is None:
+            return
+        if getattr(self, "_amp_loss_scaler", None) is not None:
+            return  # scaler owns overflow handling pre-reduce
+        from .. import autograd as ag
+
+        seq = ag.backward_sweep_seq()
+        if seq != st["seq"]:
+            # new backward sweep: if the previous one raised mid-sweep
+            # (so step()'s flush/reset never ran), the stale pending/
+            # dispatched tracking would silently skip fresh buckets —
+            # self-heal by resetting the per-step state here
+            if st["seq"] != -1 and (st["dispatched"] or st["in_backward"]):
+                self._reset_overlap_step()
+            st["seq"] = seq
+        i = st["watch"].get(id(arr))
+        if i is None:
+            return
+        rem = st["pending"].get(i, 0) - 1
+        st["pending"][i] = rem
+        if rem > 0:
+            return
+        self._ensure_overlap_plan()
+        gi = st["group_of"].get(i)
+        if gi is None or gi in st["dispatched"]:
+            return
+        keys = st["exchange"][0]
+        if any(st["pending"].get(keys[pos], 1) > 0
+               for pos in st["groups"][gi]):
+            return
+        self._dispatch_overlap_group(gi, during_backward=True)
+
+    def _dispatch_overlap_group(self, gi, during_backward):
+        st = self._overlap
+        keys, grads, priorities = st["exchange"]
+        grp = st["groups"][gi]
+        self._kvstore.pushpull([keys[pos] for pos in grp],
+                               [grads[pos] for pos in grp],
+                               out=[grads[pos] for pos in grp],
+                               priority=[priorities[pos] for pos in grp])
+        st["dispatched"].add(gi)
+        if during_backward:
+            st["in_backward"] += 1
+        telemetry.record_kv_overlap(
+            "backward" if during_backward else "step")
+
+    def _overlap_flush(self):
+        """Dispatch every not-yet-exchanged group (params whose grads
+        never finalized through the hook this step), record stats, and
+        reset the per-step tracking."""
+        st = self._overlap
+        self._ensure_overlap_plan()
+        for gi in range(len(st["groups"])):
+            if gi not in st["dispatched"]:
+                self._dispatch_overlap_group(gi, during_backward=False)
+        self.last_overlap_stats = {
+            "groups": len(st["groups"]),
+            "dispatched_in_backward": st["in_backward"],
+        }
+        self._reset_overlap_step()
+
+    def _reset_overlap_step(self):
+        st = self._overlap
+        st["dispatched"].clear()
+        st["in_backward"] = 0
+        for i in st["idxs"]:
+            st["pending"][i] = len(self._params[i].list_ctx())
 
     # ------------------------------------------------------------------
     @property
@@ -223,15 +379,13 @@ class Trainer:
                     continue
                 self._kvstore.push(i, p.list_grad(), priority=-i)
             return
-        keys: List[int] = []
-        grads: List[list] = []
-        priorities: List[int] = []
-        for i, p in enumerate(self._params):
-            if p.grad_req == "null":
-                continue
-            keys.append(i)
-            grads.append(p.list_grad())
-            priorities.append(-i)
+        if self._overlap is not None:
+            # overlapped mode: buckets whose members finalized during
+            # backward() were already exchanged from the grad-ready hook;
+            # flush the stragglers and reset for the next step
+            self._overlap_flush()
+            return
+        keys, grads, priorities = self._grad_exchange_args()
         if keys:
             self._kvstore.pushpull(keys, grads, out=grads,
                                    priority=priorities)

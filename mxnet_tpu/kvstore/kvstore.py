@@ -398,23 +398,15 @@ class KVStoreLocal(KVStore):
                 outs[pos], (list, tuple)) else [outs[pos]]
             vals_by_pos[pos] = (key, vals)
             outs_by_pos[pos] = outs_i
-            if not all(getattr(a, "stype", "default") == "default"
-                       for a in vals + outs_i):
+            entry = self._bucket_entry(pos, vals, outs_i)
+            if entry is None:
                 fallback.add(pos)
                 continue
-            v0 = vals[0]
-            nbytes = _nd_bytes(v0)
-            # group: members of one bucket must share dtype, copy count
-            # and per-slot device placement so each slot packs into one
-            # same-device flat buffer
-            devsig = tuple(str(next(iter(v.data.devices())))
-                           for v in vals)
-            entries.append((pos, tuple(v0.shape), v0.dtype,
-                            (str(v0.dtype), len(vals), devsig), nbytes))
+            entries.append(entry)
             # pushed copies in + pulled outs back, matching what the
             # per-key path records under push+pull — the two paths'
             # byte counters must stay comparable
-            total_bytes += nbytes * (len(vals) + len(outs_i))
+            total_bytes += entry[4] * (len(vals) + len(outs_i))
         buckets = plan_buckets(entries, self._bucket_bytes)
         # one dispatch plan in global priority order: a bucket is issued
         # at its FIRST member's slot, per-key fallbacks (sparse payloads)
@@ -446,6 +438,70 @@ class KVStoreLocal(KVStore):
         if _tel:
             telemetry.record_kv("pushpull", total_bytes,
                                 time.perf_counter() - t0)
+
+    @staticmethod
+    def _bucket_entry(pos, vals, outs_i):
+        """Planner entry for one key's payload, or None for the per-key
+        fallback (any non-dense val OR out). The single eligibility/
+        grouping rule shared by ``_pushpull_batched`` and
+        ``plan_pushpull`` — the dry-run must never predict a bucket the
+        batched path would not form. Group: members of one bucket must
+        share dtype, copy count and per-slot device placement so each
+        slot packs into one same-device flat buffer."""
+        if not all(getattr(a, "stype", "default") == "default"
+                   for a in vals + outs_i):
+            return None
+        v0 = vals[0]
+        devsig = tuple(str(next(iter(v.data.devices()))) for v in vals)
+        return (pos, tuple(v0.shape), v0.dtype,
+                (str(v0.dtype), len(vals), devsig), _nd_bytes(v0))
+
+    def plan_pushpull(self, keys, values, priorities=None, outs=None):
+        """Dry-run of ``_pushpull_batched``'s bucket plan: the key GROUPS
+        a batched call with these arguments would coalesce, as lists of
+        positions into ``keys``, in dispatch (descending-priority) order.
+
+        The overlapped-comms Trainer uses this to dispatch each group as
+        its own ``pushpull`` the moment its members' gradients finalize
+        during backward: a group re-planned alone reproduces exactly the
+        batched call's bucket (same members, same flat-buffer layout,
+        same reduce arity), so the overlapped exchange stays bit-identical
+        to the one-shot batched path. Per-key fallbacks (sparse payloads,
+        bucketing disabled, server-side optimizer) come back as singleton
+        groups. ``outs`` defaults to ``values`` (the Trainer's in-place
+        exchange); pass the real outs when they differ — eligibility
+        depends on both.
+        """
+        n = len(keys)
+        priorities = [0] * n if priorities is None else \
+            [int(p) for p in priorities]
+        order = sorted(range(n), key=lambda j: -priorities[j])
+        if self._updater is not None or self._bucket_bytes <= 0:
+            return [[pos] for pos in order]
+        if outs is None:
+            outs = values
+        entries = []
+        fallback = set()
+        for pos in order:
+            vals = list(values[pos]) if isinstance(
+                values[pos], (list, tuple)) else [values[pos]]
+            outs_i = list(outs[pos]) if isinstance(
+                outs[pos], (list, tuple)) else [outs[pos]]
+            entry = self._bucket_entry(pos, vals, outs_i)
+            if entry is None:
+                fallback.add(pos)
+                continue
+            entries.append(entry)
+        buckets = plan_buckets(entries, self._bucket_bytes)
+        bucket_at = {b.indices[0]: b for b in buckets}
+        groups = []
+        for pos in order:
+            b = bucket_at.get(pos)
+            if b is not None:
+                groups.append(list(b.indices))
+            elif pos in fallback:
+                groups.append([pos])
+        return groups
 
     def _bucket_exchange_reduce(self, bucket, vals_by_pos):
         """Pack each device slot's member gradients into one flat buffer

@@ -145,7 +145,20 @@ def sdp_attention(rng, query, key, value, mask=None, *, scale=None,
 @register("_contrib_rms_norm", aliases=["rms_norm"])
 def rms_norm(data, weight, *, eps=1e-6):
     """RMSNorm (no reference counterpart — Llama-era op, SURVEY.md §5.7).
-    Statistics in f32, output in compute dtype."""
+    Statistics in f32, output in compute dtype. Under
+    ``MXNET_PALLAS_FUSED=1`` + shape/platform gates the Pallas one-pass
+    kernel takes it (pallas_kernels/fused_layers.py, RMS mode): the
+    Llama blocks adopt the fused-layer path through this seam without
+    any model change."""
+    from ..pallas_kernels.fused_layers import (fused_layers_enabled,
+                                               fused_ln_supported)
+
+    if fused_layers_enabled() and fused_ln_supported(data):
+        from .. import telemetry
+        from ..pallas_kernels.fused_layers import fused_rms_norm
+
+        telemetry.record_pallas_dispatch("fused_rms_norm")
+        return fused_rms_norm(data, weight, eps=eps)
     x32 = data.astype(jnp.float32)
     inv = jax.lax.rsqrt(jnp.mean(x32 * x32, axis=-1, keepdims=True) + eps)
     return (x32 * inv).astype(data.dtype) * weight

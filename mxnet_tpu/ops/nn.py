@@ -688,9 +688,35 @@ def _bn_train_bwd(axis, eps, res, cots):
 _bn_train.defvjp(_bn_train_fwd, _bn_train_bwd)
 
 
+def _fused_ln_routable(data, axis):
+    """True when the Pallas fused-LN kernel may take this call:
+    MXNET_PALLAS_FUSED=1, last-axis norm, TPU execution platform and the
+    row/lane shape gate (``fused_ln_supported``, the flash_supported
+    twin). Checked per call — the env knob is a live switch."""
+    from ..pallas_kernels.fused_layers import (fused_layers_enabled,
+                                               fused_ln_supported)
+
+    if not fused_layers_enabled():
+        return False
+    if axis not in (-1, data.ndim - 1):
+        return False
+    return fused_ln_supported(data)
+
+
 @register("LayerNorm", aliases=["layer_norm"])
 def layer_norm(data, gamma, beta, *, axis=-1, eps=1e-5, output_mean_var=False):
     # reference: src/operator/nn/layer_norm.cc
+    if not output_mean_var and _fused_ln_routable(data, axis):
+        # Pallas one-pass kernel (pallas_kernels/fused_layers.py): same
+        # f32 statistics, custom_vjp backward recomputing xhat from the
+        # saved (mean, rstd) rows instead of autodiff through the
+        # reductions — the bandwidth-bound LN sweep from the PERF.md
+        # batch-32 trace
+        from .. import telemetry
+        from ..pallas_kernels.fused_layers import fused_layer_norm
+
+        telemetry.record_pallas_dispatch("fused_layer_norm")
+        return fused_layer_norm(data, gamma, beta, eps=eps)
     x32 = data.astype(jnp.float32)
     mean = jnp.mean(x32, axis=axis, keepdims=True)
     var = jnp.var(x32, axis=axis, keepdims=True)
@@ -703,6 +729,65 @@ def layer_norm(data, gamma, beta, *, axis=-1, eps=1e-5, output_mean_var=False):
     if output_mean_var:
         return out, jnp.squeeze(mean, axis), jnp.squeeze(var, axis)
     return out
+
+
+@register("_contrib_fused_layer_norm", aliases=["fused_layer_norm"],
+          needs_rng=True, pass_training_flag=True,
+          rng_gate=lambda attrs: bool(attrs.get("dropout"))
+          and bool(attrs.get("_training")), attrs=[
+    attr("eps", float, "Normalization epsilon.", low=0.0),
+    attr("dropout", float, "Drop rate applied to ``data`` (not the "
+         "residual) before the add+norm.", low=0.0, high=1.0),
+])
+def fused_layer_norm_op(rng, data, gamma, beta, residual=None, *,
+                        eps=1e-5, dropout=0.0, _training=False):
+    """Fused ``LayerNorm(dropout(data) + residual)`` over the last axis
+    — the post-LN transformer cell's add+norm collapsed into one op
+    (reference capability: transformer.cc's fused residual epilogues).
+
+    Routed to the Pallas one-pass kernel under ``MXNET_PALLAS_FUSED=1``
+    + shape/platform gates; otherwise the eager jnp composition runs
+    with the SAME stateless position-hash dropout mask, so both routes
+    drop identical elements for a given op key (the flash-attention
+    dropout contract). Training-mode only dropout; the PRNG key is
+    drawn only when it applies (rng_gate).
+    """
+    from ..pallas_kernels.fused_layers import (fused_layer_norm,
+                                               fused_layer_norm_reference)
+
+    p = float(dropout) if _training else 0.0
+    seed = None
+    if p > 0.0:
+        from ..pallas_kernels.flash_attention import fold_key_seed
+
+        seed = fold_key_seed(rng)
+    if _fused_ln_routable(data, -1):
+        from .. import telemetry
+
+        telemetry.record_pallas_dispatch("fused_layer_norm")
+        return fused_layer_norm(data, gamma, beta, residual, eps=eps,
+                                dropout=p, seed=seed)
+    return fused_layer_norm_reference(data, gamma, beta, residual,
+                                      eps=eps, dropout=p, seed=seed)
+
+
+@register("_contrib_fused_bias_gelu", aliases=["fused_bias_gelu"])
+def fused_bias_gelu_op(data, bias):
+    """Fused ``gelu(data + bias)`` (exact erf form) — the Dense matmul
+    epilogue. Bit-identical to the eager pair (bias add in the matmul
+    dtype, then ``Activation(act_type='gelu')``); under
+    ``MXNET_PALLAS_FUSED=1`` + gates it runs as one Pallas VMEM pass
+    whose backward recomputes the activation derivative instead of
+    saving erf/cdf intermediates."""
+    from ..pallas_kernels.fused_layers import (fused_bias_gelu,
+                                               fused_bias_gelu_reference)
+
+    if _fused_ln_routable(data, -1):
+        from .. import telemetry
+
+        telemetry.record_pallas_dispatch("fused_bias_gelu")
+        return fused_bias_gelu(data, bias)
+    return fused_bias_gelu_reference(data, bias)
 
 
 @register("InstanceNorm")
@@ -1005,7 +1090,14 @@ def dropout_op(rng, data, *, p=0.5, mode="training", axes=(), cudnn_off=False,
     import os as _os
 
     thresh32 = _np.uint32(min(0xFFFF, int(round(keep * 65536.0))))
-    if _os.environ.get("MXNET_TPU_HASH_DROPOUT", "0") == "1":
+    if _os.environ.get("MXNET_TPU_HASH_DROPOUT", "0") == "1" or \
+            _os.environ.get("MXNET_PALLAS_FUSED", "0") == "1":
+        # MXNET_PALLAS_FUSED also selects the hash path: the fused layer
+        # kernels generate THEIR dropout from this same position hash, so
+        # one knob keeps every dropout site in the model on one stream
+        # family (and the mask fuses into adjacent chains instead of
+        # spilling RngBitGenerator bool traffic — the PERF.md batch-32
+        # residue bucket the fused kernels target).
         # Stateless position-hash mask (round 5, VERDICT r4 #2 attempt):
         # pure elementwise integer code that XLA fuses into the adjacent
         # chains — zero extra HBM traffic, no RngBitGenerator custom

@@ -291,9 +291,22 @@ def list_ops() -> List[str]:
 _cache_probe = threading.local()
 
 
+def _routing_knobs() -> tuple:
+    """Trace-time routing env knobs that select a DIFFERENT op body for
+    the same (op, attrs, shapes) signature — like ``platform`` below,
+    they must key every executable cache or a knob toggle would keep
+    replaying the previously-traced body (round-9 review finding:
+    MXNET_PALLAS_FUSED flipped after a warm cache never engaged the
+    fused kernels)."""
+    import os
+
+    return (os.environ.get("MXNET_PALLAS_FUSED", "0") == "1",
+            os.environ.get("MXNET_TPU_HASH_DROPOUT", "0") == "1")
+
+
 @functools.lru_cache(maxsize=4096)
 def _cached_call(opname: str, attr_items: tuple, n_tensors: int,
-                 has_rng: bool, platform: str):
+                 has_rng: bool, platform: str, routing: tuple = ()):
     _cache_probe.miss = True
     # `platform` keys the cache even though the traced fn only reads it
     # ambiently: op impls dispatch on current_execution_platform() at
@@ -436,15 +449,16 @@ def _eager_call(opdef: OpDef, tensors, attrs, rng=None):
             if opdef.needs_rng:
                 return opdef.fn(None, *tensors, **attrs)
             return opdef.fn(*tensors, **attrs)
+        routing = _routing_knobs()
         if _telemetry_state.enabled:
             _cache_probe.miss = False
             fn = _cached_call(opdef.name, attr_items, len(tensors),
-                              rng is not None, platform)
+                              rng is not None, platform, routing)
             telemetry.record_cache("eager_op", hit=not _cache_probe.miss)
             telemetry.record_xla_dispatch("eager_op")
         else:
             fn = _cached_call(opdef.name, attr_items, len(tensors),
-                              rng is not None, platform)
+                              rng is not None, platform, routing)
         if rng is not None:
             return fn(rng, *tensors)
         return fn(*tensors)
@@ -709,7 +723,8 @@ def execute_segment(seg, reason: str) -> None:
             if pv is not None:
                 live.append(pv)
     live_mask = tuple((pv.node_index, pv.out_index) for pv in live)
-    sig = (tuple(n.sig for n in seg.nodes), live_mask, seg.platform)
+    sig = (tuple(n.sig for n in seg.nodes), live_mask, seg.platform,
+           _routing_knobs())
     with _fused_lock:
         jitted = _FUSED_CACHE.get(sig)
         hit = jitted is not None

@@ -10,6 +10,11 @@ see mxnet_tpu.pallas_api).
 """
 from .flash_attention import (flash_attention, flash_attention_scan,
                               flash_supported, flash_shape_supported)
+from .fused_layers import (fused_bias_gelu, fused_layer_norm,
+                           fused_layers_enabled, fused_ln_shape_supported,
+                           fused_ln_supported, fused_rms_norm)
 
 __all__ = ["flash_attention", "flash_attention_scan", "flash_supported",
-           "flash_shape_supported"]
+           "flash_shape_supported", "fused_layer_norm", "fused_rms_norm",
+           "fused_bias_gelu", "fused_layers_enabled",
+           "fused_ln_shape_supported", "fused_ln_supported"]

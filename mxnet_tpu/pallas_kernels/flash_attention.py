@@ -133,8 +133,14 @@ def _drop_mask(head_idx, q_pos, k_pos, lq, lk, seed, thresh):
 def _x32_mode():
     # Mosaic cannot legalize the i64/f64 constants that jax_enable_x64
     # (on globally for MXNet dtype parity) injects into kernel traces and
-    # BlockSpec index maps; trace kernels in 32-bit mode.
-    return jax.enable_x64(False)
+    # BlockSpec index maps; trace kernels in 32-bit mode. The context
+    # manager moved from jax.experimental to the jax root namespace
+    # across versions — accept either home.
+    if hasattr(jax, "enable_x64"):
+        return jax.enable_x64(False)
+    from jax.experimental import enable_x64
+
+    return enable_x64(False)
 
 
 def _prec_for(dtype):

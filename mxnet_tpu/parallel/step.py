@@ -30,7 +30,8 @@ from ..base import MXNetError
 from ..telemetry import _state as _telemetry_state
 from ..context import current_context
 from ..ndarray import NDArray
-from ..gluon.block import make_pure_fn, nested_flatten_nd, nested_unflatten_nd
+from ..gluon.block import (make_pure_fn, nested_flatten_nd,
+                           nested_unflatten_nd, resolve_remat_policy)
 from .mesh import current_mesh, make_mesh
 from .sharding import ShardingRules, named_sharding, spec_for_param
 
@@ -67,13 +68,25 @@ class TrainStep:
         an async input pipeline (``io.DeviceFeedIter``) stages a fresh
         buffer per step; a benchmark replaying one staged batch must NOT
         set this (the donated buffer is dead after the call).
+    remat : gradient-rematerialization policy for the whole net inside
+        the compiled step — ``None`` (save activations, the default),
+        ``"full"`` (save nothing: recompute the forward in the backward,
+        max memory headroom for ~one extra forward of FLOPs) or
+        ``"dots"`` (matmul outputs saved, elementwise/norm recompute —
+        no MXU work re-runs). The same policy names as
+        ``gluon.block.remat_call`` / the Llama zoo's ``remat=`` kwarg,
+        resolved by the one shared validator — but threaded here ANY
+        compiled step can trade recompute for the batch-size headroom
+        the MFU targets need, not just nets that opted in at
+        construction. Composes with model-level remat_call (inner
+        checkpoints nest).
     """
 
     def __init__(self, net, loss, optimizer, mesh=None,
                  rules: Optional[ShardingRules] = None,
                  batch_axis: Sequence[str] = ("dp",), seq_axis=None,
                  optimizer_params=None, loss_only=False,
-                 donate_inputs=False):
+                 donate_inputs=False, remat=None):
         self.net = net
         self.loss = loss
         # loss_only: don't return model outputs from the step — for nets
@@ -92,6 +105,10 @@ class TrainStep:
                                 if a in mesh.axis_names)
         self.seq_axis = seq_axis if (seq_axis in mesh.axis_names) else None
         self.donate_inputs = bool(donate_inputs)
+        # validate eagerly — a typo must raise at construction, not from
+        # inside the first traced step
+        resolve_remat_policy(remat)
+        self.remat = remat
         self._cache: Dict = {}
         self._params = None          # List[Parameter]
         self._param_specs = None     # per-param PartitionSpec
@@ -330,6 +347,18 @@ class TrainStep:
         else:
             param_arrays = [p.data() for p in self._params]
             pure, cell = make_pure_fn(self.net, param_arrays, ctx, training)
+            if self.remat is not None:
+                # net forward under jax.checkpoint: activations inside the
+                # span are recomputed during the backward per the policy.
+                # Parameters/batch enter as checkpoint arguments (always
+                # saved); the loss head stays outside the span.
+                pure = jax.checkpoint(
+                    pure, policy=resolve_remat_policy(self.remat))
+        if pipe is not None and self.remat is not None:
+            raise MXNetError(
+                "TrainStep(remat=...) does not apply to a 1F1B Pipelined "
+                "net — the pipelined trunk owns its own remat "
+                "(Pipelined(remat=True))")
         loss_only = self.loss_only or pipe is not None
         trainable = list(self._trainable)
         if pipe is not None:
@@ -620,13 +649,32 @@ class TrainStep:
             self._settle_params(data_tuple)
             self._init_states()
         training = True
+        # routing knobs key the cache like shapes do: the traced body
+        # dispatches on them (Pallas fused kernels, hash dropout), so a
+        # knob toggled between steps must re-trace, not replay
+        from ..ops.registry import _routing_knobs
+
         key = (len(data_tuple),
                tuple((tuple(v.shape), str(v.dtype))
-                     for v in data_tuple + label_tuple), training)
+                     for v in data_tuple + label_tuple), training,
+               _routing_knobs())
         entry = self._cache.get(key)
         if _telemetry_state.enabled:
             telemetry.record_cache("train_step", hit=entry is not None)
         if entry is None:
+            if self.donate_inputs and self._cache:
+                # shape change with input donation: invalidate the stale
+                # lowerings. Their input buffers were donated — a later
+                # cache hit replaying a batch staged for the OLD shape
+                # would dispatch against donated-dead buffers (an opaque
+                # XLA RuntimeError at best, garbage reads at worst);
+                # re-lowering on return to a shape forces fresh staging.
+                # Deliberate trade: a donating step fed ALTERNATING
+                # shapes re-lowers on every switch. Donation is for
+                # single-use streamed batches (one bucket shape per
+                # step instance); alternating-bucket replay wants
+                # donate_inputs=False, which keeps every lowering.
+                self._cache.clear()
             entry = self._build(data_tuple, label_tuple, training)
             self._cache[key] = entry
         jitted, cell = entry["jitted"], entry["cell"]
@@ -654,6 +702,16 @@ class TrainStep:
         batch_vals = []
         for v, sh in zip(data_tuple + label_tuple, entry["batch_sh"]):
             d = v.data
+            if self.donate_inputs and getattr(d, "is_deleted", None) \
+                    and d.is_deleted():
+                raise MXNetError(
+                    "TrainStep(donate_inputs=True): a batch buffer passed "
+                    "to this step was already donated to a previous "
+                    "dispatch (its device memory was reused for "
+                    "activations). Donation is for single-use batches — "
+                    "stage a FRESH buffer per step (io.DeviceFeedIter "
+                    "does), or build the step with donate_inputs=False "
+                    "to replay one staged batch")
             if getattr(d, "sharding", None) == sh:
                 batch_vals.append(d)
             else:

@@ -520,6 +520,34 @@ def record_kv_compression(ratio: float, elements: int) -> None:
             "Gradient elements through the 2-bit quantizer.").inc(elements)
 
 
+def record_pallas_dispatch(kernel: str, n: int = 1) -> None:
+    """A Pallas kernel routed into a trace. ``kernel``: flash_attention /
+    fused_layer_norm / fused_rms_norm / fused_bias_gelu / ... Counts
+    ROUTING decisions (the Python dispatch site runs once per trace, not
+    per executed step), so this is the kernel ADOPTION observable: zero
+    while MXNET_PALLAS_FUSED / shape gates keep a model on the eager
+    path, one per kernel site per compiled executable otherwise."""
+    if not _state.enabled:
+        return
+    counter("mxnet_pallas_dispatch_total",
+            "Pallas-kernel routings into compiled traces by kernel "
+            "(adoption counter: one per kernel site per trace).",
+            ("kernel",)).labels(kernel).inc(n)
+
+
+def record_kv_overlap(when: str, n: int = 1) -> None:
+    """One gradient-bucket pushpull dispatched by the overlapped-comms
+    trainer. ``when``: ``backward`` (issued from the grad-ready hook
+    while autograd's reverse sweep was still running — the overlap win)
+    or ``step`` (flushed by Trainer.step for buckets whose members never
+    became ready in the backward)."""
+    if not _state.enabled:
+        return
+    counter("mxnet_kvstore_overlap_dispatch_total",
+            "Overlapped-comms bucket dispatches by phase "
+            "(backward/step).", ("when",)).labels(when).inc(n)
+
+
 def record_engine_wait(seconds: float) -> None:
     if not _state.enabled:
         return

@@ -581,7 +581,7 @@ def test_comms_bench_tool_contract(tmp_path):
         env=env, capture_output=True, text=True, timeout=600)
     assert out.returncode == 0, out.stdout + out.stderr
     lines = [ln for ln in out.stdout.splitlines() if ln.startswith("{")]
-    assert len(lines) == 3               # one per completed stage
+    assert len(lines) == 4               # one per completed stage
     first = json.loads(lines[0])
     for key in ("metric", "value", "unit", "vs_baseline"):
         assert key in first              # the shared driver contract
@@ -589,3 +589,227 @@ def test_comms_bench_tool_contract(tmp_path):
     assert last["comms_bucketed_loss_bit_identical"] is True
     assert last["comms_perkey_collectives_per_step"] > \
         last["comms_bucketed_collectives_per_step"]
+    # stage 4 (ISSUE 7): allreduce-under-backward overlap, bit-identical
+    assert last["comms_overlap_loss_bit_identical"] is True
+    assert last["comms_overlap_dispatch_pct"] > 0.0
+
+
+class TestBackwardOverlap:
+    """Backward-overlapped collectives (ISSUE 7): grad-ready hooks
+    dispatch each bucket's pushpull INSIDE autograd.backward, results
+    bit-identical to the at-step exchange."""
+
+    def test_plan_pushpull_matches_bucket_plan(self):
+        store = kv.create("local")
+        store._bucket_bytes = 60  # tiny cap -> several buckets
+        vals = _grads()
+        nds = [[mx.nd.array(v) for v in vs] for vs in vals]
+        for k, sh in enumerate(SHAPES):
+            store.init(k, mx.nd.zeros(sh))
+        keys = list(range(len(SHAPES)))
+        groups = store.plan_pushpull(keys, nds, [-k for k in keys])
+        # every key exactly once, in descending-priority dispatch order
+        flat = [p for g in groups for p in g]
+        assert sorted(flat) == keys
+        assert flat == keys  # priority -k => ascending key order
+        # each group fits the cap (or is a singleton oversize)
+        for g in groups:
+            nbytes = sum(4 * int(np.prod(SHAPES[p])) for p in g)
+            assert len(g) == 1 or nbytes <= 60
+
+    def test_plan_pushpull_perkey_when_disabled(self):
+        store = kv.create("local")
+        store._bucket_bytes = 0
+        nds = [[mx.nd.array(v) for v in vs] for vs in _grads()]
+        groups = store.plan_pushpull(list(range(len(SHAPES))), nds)
+        assert groups == [[p] for p in range(len(SHAPES))]
+
+    @staticmethod
+    def _trainer_losses(bucket_mb, overlap, steps=4):
+        from mxnet_tpu import autograd, gluon
+        from mxnet_tpu.gluon import nn
+        from mxnet_tpu.gluon.loss import L2Loss
+
+        prev = os.environ.get("MXNET_KV_BUCKET_MB")
+        os.environ["MXNET_KV_BUCKET_MB"] = str(bucket_mb)
+        try:
+            mx.random.seed(0)
+            net = nn.HybridSequential()
+            with net.name_scope():
+                net.add(nn.Dense(32, in_units=16), nn.Dense(32),
+                        nn.Dense(8))
+            net.initialize()
+            net(mx.nd.zeros((1, 16)))
+            rs = np.random.RandomState(7)
+            for p in net.collect_params().values():
+                p.set_data(mx.nd.array(
+                    rs.randn(*p.shape).astype(np.float32) * 0.1))
+            ctxs = [mx.Context("cpu", 0), mx.Context("cpu", 1)]
+            net.collect_params().reset_ctx(ctxs)
+            tr = gluon.Trainer(net.collect_params(), "sgd",
+                               {"learning_rate": 0.05},
+                               kvstore="tpu_sync",
+                               overlap_comms=overlap)
+            loss_fn = L2Loss()
+            rs2 = np.random.RandomState(11)
+            x = rs2.randn(8, 16).astype(np.float32)
+            y = rs2.randn(8, 8).astype(np.float32)
+            losses, stats = [], []
+            for _ in range(steps):
+                with autograd.record():
+                    ls = [loss_fn(net(mx.nd.array(x[i * 4:(i + 1) * 4],
+                                                  ctx=c)),
+                                  mx.nd.array(y[i * 4:(i + 1) * 4],
+                                              ctx=c))
+                          for i, c in enumerate(ctxs)]
+                autograd.backward(ls)
+                tr.step(8)
+                if tr.last_overlap_stats is not None:
+                    stats.append(dict(tr.last_overlap_stats))
+                losses.append(float(sum(l.asnumpy().sum()
+                                        for l in ls)))
+            weights = [p.data(ctxs[0]).asnumpy()
+                       for p in net.collect_params().values()]
+            return losses, weights, stats
+        finally:
+            if prev is None:
+                os.environ.pop("MXNET_KV_BUCKET_MB", None)
+            else:
+                os.environ["MXNET_KV_BUCKET_MB"] = prev
+
+    def test_overlapped_trainer_bit_identical_to_perkey(self):
+        l_pk, w_pk, _ = self._trainer_losses(0, False)
+        l_ov, w_ov, stats = self._trainer_losses(0.005, True)
+        assert l_pk == l_ov
+        for a, b in zip(w_pk, w_ov):
+            np.testing.assert_array_equal(a, b)
+        # steady state (hooks arm during step 1's kvstore init): every
+        # bucket dispatched inside backward
+        assert stats, "overlap stats not recorded"
+        steady = stats[1:]
+        assert steady and all(
+            s["dispatched_in_backward"] == s["groups"] > 0
+            for s in steady)
+
+    def test_overlap_disabled_under_nonfinite_guard(self):
+        from mxnet_tpu import gluon
+        from mxnet_tpu.gluon import nn
+
+        net = nn.Dense(4, in_units=4)
+        net.initialize()
+        ctxs = [mx.Context("cpu", 0), mx.Context("cpu", 1)]
+        net.collect_params().reset_ctx(ctxs)
+        tr = gluon.Trainer(net.collect_params(), "sgd",
+                           {"learning_rate": 0.1}, kvstore="tpu_sync",
+                           overlap_comms=True, check_nonfinite=True)
+        tr._init_kvstore()
+        # the guard must see gradients BEFORE any reduce -> no overlap
+        assert tr._overlap is None
+
+    def test_watch_grad_ready_fires_inside_backward(self):
+        from mxnet_tpu import autograd
+
+        x = mx.nd.array(np.ones((2, 2), np.float32))
+        x.attach_grad()
+        seen = []
+
+        class Owner:
+            def cb(self, arr):
+                # the grad buffer is already finalized when we fire
+                seen.append(np.asarray(arr.grad.asnumpy()).copy())
+
+        owner = Owner()
+        autograd.watch_grad_ready([x], owner.cb)
+        try:
+            with autograd.record():
+                y = (x * 3.0).sum()
+            y.backward()
+            assert len(seen) == 1
+            np.testing.assert_allclose(seen[0], 3.0 * np.ones((2, 2)))
+            # grad also visible after backward as usual
+            np.testing.assert_allclose(x.grad.asnumpy(),
+                                       3.0 * np.ones((2, 2)))
+        finally:
+            autograd.unwatch_grad_ready([x])
+
+    def test_unwatch_and_dead_owner_are_safe(self):
+        from mxnet_tpu import autograd
+
+        x = mx.nd.array(np.ones((2,), np.float32))
+        x.attach_grad()
+
+        class Owner:
+            hits = 0
+
+            def cb(self, arr):
+                Owner.hits += 1
+
+        owner = Owner()
+        autograd.watch_grad_ready([x], owner.cb)
+        del owner  # weak callback: dead owner must not fire or leak
+        with autograd.record():
+            y = (x * 2.0).sum()
+        y.backward()
+        assert Owner.hits == 0
+        np.testing.assert_allclose(x.grad.asnumpy(), 2.0 * np.ones(2))
+        autograd.unwatch_grad_ready([x])
+
+    def test_overlap_self_heals_after_abandoned_backward(self):
+        """A backward not followed by step() (aborted iteration) must
+        not leave stale dispatched-state that makes the NEXT step skip
+        its exchange — the sweep-seq check resets it."""
+        from mxnet_tpu import autograd, gluon
+        from mxnet_tpu.gluon import nn
+        from mxnet_tpu.gluon.loss import L2Loss
+
+        prev = os.environ.get("MXNET_KV_BUCKET_MB")
+        os.environ["MXNET_KV_BUCKET_MB"] = "0.005"
+        try:
+            def run(overlap):
+                mx.random.seed(0)
+                net = nn.HybridSequential()
+                with net.name_scope():
+                    net.add(nn.Dense(32, in_units=16), nn.Dense(8))
+                net.initialize()
+                net(mx.nd.zeros((1, 16)))
+                rs = np.random.RandomState(7)
+                for p in net.collect_params().values():
+                    p.set_data(mx.nd.array(
+                        rs.randn(*p.shape).astype(np.float32) * 0.1))
+                ctxs = [mx.Context("cpu", 0), mx.Context("cpu", 1)]
+                net.collect_params().reset_ctx(ctxs)
+                tr = gluon.Trainer(net.collect_params(), "sgd",
+                                   {"learning_rate": 0.05},
+                                   kvstore="tpu_sync",
+                                   overlap_comms=overlap)
+                lf = L2Loss()
+                rs2 = np.random.RandomState(11)
+                x = rs2.randn(8, 16).astype(np.float32)
+                y = rs2.randn(8, 8).astype(np.float32)
+
+                def bwd():
+                    with autograd.record():
+                        ls = [lf(net(mx.nd.array(x[i * 4:(i + 1) * 4],
+                                                 ctx=c)),
+                                 mx.nd.array(y[i * 4:(i + 1) * 4],
+                                             ctx=c))
+                              for i, c in enumerate(ctxs)]
+                    autograd.backward(ls)
+
+                for step_i in range(3):
+                    bwd()
+                    if step_i == 1:
+                        bwd()   # abandoned first backward: no step()
+                    tr.step(8)
+                return [p.data(ctxs[0]).asnumpy()
+                        for p in net.collect_params().values()]
+
+            w_pk = run(False)
+            w_ov = run(True)
+            for a, b in zip(w_pk, w_ov):
+                np.testing.assert_array_equal(a, b)
+        finally:
+            if prev is None:
+                os.environ.pop("MXNET_KV_BUCKET_MB", None)
+            else:
+                os.environ["MXNET_KV_BUCKET_MB"] = prev

@@ -13,6 +13,8 @@ import jax.numpy as jnp
 from mxnet_tpu.pallas_kernels import flash_attention, flash_attention_scan
 from mxnet_tpu.ops.attention import _sdpa_reference
 
+pytestmark = pytest.mark.pallas
+
 SCALE = 1.0 / np.sqrt(64)
 
 

@@ -337,3 +337,98 @@ class TestTrainStep1F1B:
             onp.testing.assert_allclose(
                 p1.data().asnumpy(), p2.data().asnumpy(),
                 rtol=2e-4, atol=2e-5, err_msg=f"{k1} vs {k2}")
+
+
+class TestTrainStepRemat:
+    """TrainStep(remat=...) — the policy knob threaded through
+    parallel/step.py (ISSUE 7): any compiled step can trade recompute
+    for memory, with a bit-identical loss trajectory."""
+
+    def _run(self, remat, steps=3, donate=False):
+        mx.random.seed(0)
+        net = nn.HybridSequential()
+        with net.name_scope():
+            net.add(nn.Dense(32, in_units=16, flatten=False,
+                             activation="gelu"))
+            net.add(nn.Dense(8, flatten=False))
+        net.initialize()
+        net(mx.nd.zeros((1, 16)))
+        rs = onp.random.RandomState(5)
+        # definition order, NOT sorted-by-name: auto-prefix counters
+        # advance across tests, and "dense10_" sorts before "dense9_"
+        for p in net.collect_params().values():
+            p.set_data(mx.nd.array(
+                rs.randn(*p.shape).astype(onp.float32) * 0.1))
+        step = par.TrainStep(net, gloss.L2Loss(), "sgd",
+                             optimizer_params={"learning_rate": 0.05},
+                             remat=remat, donate_inputs=donate)
+        rs2 = onp.random.RandomState(1)
+        losses = []
+        for _ in range(steps):
+            x = mx.nd.array(rs2.randn(4, 16).astype(onp.float32))
+            y = mx.nd.array(rs2.randn(4, 8).astype(onp.float32))
+            losses.append(float(step(x, y)[0].asnumpy()))
+        return losses
+
+    def test_policies_match_no_remat(self):
+        base = self._run(None)
+        assert self._run("full") == base
+        assert self._run("dots") == base
+
+    def test_invalid_policy_raises_at_construction(self):
+        net = nn.Dense(4, in_units=4)
+        net.initialize()
+        with pytest.raises(ValueError, match="remat policy"):
+            par.TrainStep(net, gloss.L2Loss(), "sgd", remat="bogus")
+
+    def test_remat_composes_with_donation(self):
+        # fresh buffers per step: remat + donate_inputs train together
+        base = self._run(None)
+        assert self._run("full", donate=True) == base
+
+
+class TestDonateInputsShapeChange:
+    """Regression (ISSUE 7 satellite): a donating TrainStep reused after
+    a shape change must invalidate its cached lowering and refuse a
+    donated-dead buffer with a clear error — never dispatch against it."""
+
+    def _make(self):
+        net = nn.Dense(8, in_units=16, flatten=False)
+        net.initialize()
+        return par.TrainStep(net, gloss.L2Loss(), "sgd",
+                             optimizer_params={"learning_rate": 0.1},
+                             donate_inputs=True)
+
+    @staticmethod
+    def _batch(rs, b):
+        return (mx.nd.array(rs.randn(b, 16).astype(onp.float32)),
+                mx.nd.array(rs.randn(b, 8).astype(onp.float32)))
+
+    def test_fresh_buffers_across_shape_changes(self):
+        step = self._make()
+        rs = onp.random.RandomState(0)
+        for b in (4, 6, 4, 6):
+            x, y = self._batch(rs, b)
+            loss, _ = step(x, y)
+            assert onp.isfinite(loss.asnumpy()).all()
+
+    def test_donated_reuse_raises_mxnet_error(self):
+        from mxnet_tpu.base import MXNetError
+
+        step = self._make()
+        rs = onp.random.RandomState(0)
+        xa, ya = self._batch(rs, 4)
+        step(xa, ya)[0].asnumpy()          # donates xa/ya buffers
+        xb, yb = self._batch(rs, 6)
+        step(xb, yb)[0].asnumpy()          # shape change
+        with pytest.raises(MXNetError, match="donated"):
+            step(xa, ya)                   # dead buffers, clear error
+
+    def test_shape_change_invalidates_cached_lowering(self):
+        step = self._make()
+        rs = onp.random.RandomState(0)
+        step(*self._batch(rs, 4))[0].asnumpy()
+        assert len(step._cache) == 1
+        step(*self._batch(rs, 6))[0].asnumpy()
+        # the shape-A lowering (donated-dead inputs) must be gone
+        assert len(step._cache) == 1

@@ -18,6 +18,13 @@ TPU (or a 30-minute bench.py run):
    twice (per-key vs bucketed store): losses and final weights must be
    BIT-identical, the acceptance gate for switching the trainer to the
    fused path.
+4. **allreduce-under-backward overlap** — the same trainer with
+   ``overlap_comms=True`` (grad-ready hooks dispatch each bucket's
+   pushpull INSIDE ``autograd.backward``): reports the % of bucket
+   collectives issued before backward() returned (the overlap win —
+   their device work runs under the remaining reverse sweep via JAX
+   async dispatch) and gates the overlapped run's losses/weights
+   bit-identical to the per-key exchange.
 
 Emits bench.py's JSON contract — one flushed line per completed stage,
 monotonically enriched, ``{"metric", "value", "unit", "vs_baseline"}``
@@ -159,55 +166,98 @@ def _run_variant(shapes, copies, bucket_bytes, reps, compression=None):
     return per_step / reps, t_all[len(t_all) // 2] * 1e3
 
 
-def _loss_bit_identity(steps=4):
-    """Small 2-context data-parallel Trainer, per-key vs bucketed store:
-    per-step losses and the final weight must be bit-identical."""
+def _trainer_run(bucket_mb, steps=4, overlap=False, n_dense=1):
+    """Small 2-context data-parallel Trainer run; returns (per-step
+    losses, final weights sorted by param name, per-step overlap stats).
+    ``bucket_mb`` configures the store's fused-pushpull cap for the run
+    (0 = per-key); ``n_dense`` > 1 stacks layers so a tiny cap yields
+    several buckets (the overlap stage needs a multi-bucket plan)."""
     import mxnet_tpu as mx
     from mxnet_tpu import autograd, gluon
     from mxnet_tpu.gluon import nn
     from mxnet_tpu.gluon.loss import L2Loss
 
-    def run(bucket_mb):
-        prev = os.environ.get("MXNET_KV_BUCKET_MB")
-        os.environ["MXNET_KV_BUCKET_MB"] = str(bucket_mb)
-        try:
-            mx.random.seed(0)
+    prev = os.environ.get("MXNET_KV_BUCKET_MB")
+    os.environ["MXNET_KV_BUCKET_MB"] = str(bucket_mb)
+    try:
+        mx.random.seed(0)
+        if n_dense == 1:
             net = nn.Dense(16, in_units=32)
-            net.initialize()
-            rs = np.random.RandomState(7)
-            net.weight.set_data(mx.nd.array(
-                rs.randn(16, 32).astype(np.float32)))
-            net.bias.set_data(mx.nd.zeros(16))
-            ctxs = [mx.Context("cpu", 0), mx.Context("cpu", 1)]
-            net.collect_params().reset_ctx(ctxs)
-            tr = gluon.Trainer(net.collect_params(), "sgd",
-                               {"learning_rate": 0.05}, kvstore="tpu_sync")
-            loss_fn = L2Loss()
-            rs2 = np.random.RandomState(11)
-            x = rs2.randn(8, 32).astype(np.float32)
-            y = rs2.randn(8, 16).astype(np.float32)
-            losses = []
-            for _ in range(steps):
-                with autograd.record():
-                    ls = [loss_fn(net(mx.nd.array(x[i * 4:(i + 1) * 4],
-                                                  ctx=c)),
-                                  mx.nd.array(y[i * 4:(i + 1) * 4],
-                                              ctx=c))
-                          for i, c in enumerate(ctxs)]
-                autograd.backward(ls)
-                tr.step(8)
-                losses.append(float(sum(l.asnumpy().sum() for l in ls)))
-            return losses, net.weight.data(ctxs[0]).asnumpy()
-        finally:
-            if prev is None:
-                os.environ.pop("MXNET_KV_BUCKET_MB", None)
-            else:
-                os.environ["MXNET_KV_BUCKET_MB"] = prev
+        else:
+            net = nn.HybridSequential()
+            with net.name_scope():
+                for _ in range(n_dense - 1):
+                    net.add(nn.Dense(64, in_units=32 if len(net) == 0
+                                     else 64))
+                net.add(nn.Dense(16))
+        net.initialize()
+        net(mx.nd.zeros((1, 32)))
+        rs = np.random.RandomState(7)
+        # definition order, NOT sorted-by-name: the auto-prefix counters
+        # advance across runs in one process, and "dense10_" would sort
+        # before "dense9_" — the seeded init must land identically
+        for p in net.collect_params().values():
+            p.set_data(mx.nd.array(
+                rs.randn(*p.shape).astype(np.float32) * 0.1))
+        ctxs = [mx.Context("cpu", 0), mx.Context("cpu", 1)]
+        net.collect_params().reset_ctx(ctxs)
+        tr = gluon.Trainer(net.collect_params(), "sgd",
+                           {"learning_rate": 0.05}, kvstore="tpu_sync",
+                           overlap_comms=overlap)
+        loss_fn = L2Loss()
+        rs2 = np.random.RandomState(11)
+        x = rs2.randn(8, 32).astype(np.float32)
+        y = rs2.randn(8, 16).astype(np.float32)
+        losses, stats = [], []
+        for _ in range(steps):
+            with autograd.record():
+                ls = [loss_fn(net(mx.nd.array(x[i * 4:(i + 1) * 4],
+                                              ctx=c)),
+                              mx.nd.array(y[i * 4:(i + 1) * 4],
+                                          ctx=c))
+                      for i, c in enumerate(ctxs)]
+            autograd.backward(ls)
+            tr.step(8)
+            if tr.last_overlap_stats is not None:
+                stats.append(dict(tr.last_overlap_stats))
+            losses.append(float(sum(l.asnumpy().sum() for l in ls)))
+        weights = [p.data(ctxs[0]).asnumpy()
+                   for p in net.collect_params().values()]
+        return losses, weights, stats
+    finally:
+        if prev is None:
+            os.environ.pop("MXNET_KV_BUCKET_MB", None)
+        else:
+            os.environ["MXNET_KV_BUCKET_MB"] = prev
 
-    losses_pk, w_pk = run(0)
-    losses_bk, w_bk = run(25)
-    return (losses_pk == losses_bk and np.array_equal(w_pk, w_bk),
-            losses_bk[-1])
+
+def _loss_bit_identity(steps=4):
+    """Per-key vs bucketed store: per-step losses and the final weight
+    must be bit-identical."""
+    losses_pk, w_pk, _ = _trainer_run(0, steps)
+    losses_bk, w_bk, _ = _trainer_run(25, steps)
+    identical = losses_pk == losses_bk and all(
+        np.array_equal(a, b) for a, b in zip(w_pk, w_bk))
+    return identical, losses_bk[-1]
+
+
+def _overlap_metrics(steps=5):
+    """Backward-overlapped comms: % of bucket collectives dispatched
+    inside backward() (steady state — step 1 arms the hooks during
+    kvstore init, so it is excluded) plus bit-identity of the overlapped
+    run against the per-key exchange."""
+    losses_pk, w_pk, _ = _trainer_run(0, steps, n_dense=3)
+    # ~0.01 MB cap over the 3-layer param set -> a multi-bucket plan
+    losses_ov, w_ov, stats = _trainer_run(0.01, steps, overlap=True,
+                                          n_dense=3)
+    identical = losses_pk == losses_ov and all(
+        np.array_equal(a, b) for a, b in zip(w_pk, w_ov))
+    steady = stats[1:] if len(stats) > 1 else stats
+    total = sum(s["groups"] for s in steady)
+    in_bwd = sum(s["dispatched_in_backward"] for s in steady)
+    pct = 100.0 * in_bwd / total if total else 0.0
+    groups = steady[-1]["groups"] if steady else 0
+    return pct, groups, identical
 
 
 def main():
@@ -267,11 +317,20 @@ def main():
     })
     _emit(record)
 
+    overlap_pct, overlap_groups, overlap_identical = _overlap_metrics()
+    record.update({
+        "comms_overlap_dispatch_pct": round(overlap_pct, 1),
+        "comms_overlap_groups_per_step": overlap_groups,
+        "comms_overlap_loss_bit_identical": bool(overlap_identical),
+    })
+    _emit(record)
+
     if telemetry_out:
         from mxnet_tpu import telemetry
 
         telemetry.write_snapshot(telemetry_out)
-    return 0 if identical else 1
+    return 0 if (identical and overlap_identical
+                 and overlap_pct > 0.0) else 1
 
 
 if __name__ == "__main__":

@@ -28,6 +28,9 @@ sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
 
 
 def build_bert_step():
+    # trace the published bench configuration: fused layer kernels ON
+    # (bench_bert.py sets the same default)
+    os.environ.setdefault("MXNET_PALLAS_FUSED", "1")
     import jax
     import mxnet_tpu as mx
     from mxnet_tpu import parallel as par
@@ -81,6 +84,7 @@ def build_llama_step():
     """The 0.7B proxy exactly as bench_llama.py runs it (no-remat,
     fused CE, AdamW, bf16) — VERDICT r4: trace the Llama path the way
     BERT was traced."""
+    os.environ.setdefault("MXNET_PALLAS_FUSED", "1")
     import jax
     import mxnet_tpu as mx
     from mxnet_tpu import parallel as par
@@ -142,6 +146,9 @@ GROUPS = [
     # module is named "fused_segment", see ops/registry.py::_build_fused)
     # are attributed to bulking rather than the generic fusion bucket
     ("bulk_fused", r"fused_segment"),
+    # fused layer kernels (pallas_kernels/fused_layers.py) before the
+    # flash groups: their kernel names also contain _fwd/_bwd
+    ("pallas_layer", r"_norm_fwd_kernel|_norm_bwd_kernel|_bias_gelu"),
     ("flash_fwd", r"flash|_fwd_kernel"),
     ("flash_bwd", r"dkdv|_bwd_"),
     ("fusion", r"^fusion"),
@@ -151,6 +158,14 @@ GROUPS = [
     ("transpose", r"transpose"),
     ("rng", r"rng"),
 ]
+
+# device ops executed by ANY Pallas kernel of ours — tagged "[pallas] "
+# in the per-op table (like "[bulk] " for fused segments) so kernel
+# adoption is visible straight in the trace, next to the
+# mxnet_pallas_dispatch_total{kernel} telemetry counter
+PALLAS_PAT = re.compile(
+    r"_norm_fwd_kernel|_norm_bwd_kernel|_bias_gelu|_fwd_kernel"
+    r"|_bwd_dkdv|_bwd_dq|_bwd_fused|flash")
 
 
 def classify(name, ctx=""):
@@ -239,6 +254,10 @@ def main():
             # per-op table shows which device time came from bulked
             # imperative chains vs ordinary per-op dispatch
             name = "[bulk] " + name
+        elif PALLAS_PAT.search(name) or PALLAS_PAT.search(ctx):
+            # executed by one of our Pallas kernels (flash attention or
+            # the fused layer kernels) — adoption visible per-op
+            name = "[pallas] " + name
         per_op[name] += dur
         per_group[classify(name, ctx)] += dur
         total += dur
