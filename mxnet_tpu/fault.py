@@ -12,10 +12,13 @@ module makes the failure paths *testable*:
   op dispatch), ``kvstore.push`` / ``kvstore.pull`` /
   ``kvstore.allreduce`` (comms), ``checkpoint.write`` /
   ``checkpoint.read`` (every atomic file commit / checkpoint load),
+  ``kvstore.barrier`` (every bounded cross-process rendezvous),
   ``datafeed.put`` (each batch staged by the async input pipeline —
   ``io.DeviceFeedIter``), ``serving.dispatch`` (every inference batch
-  the model server dispatches) and ``serving.reload`` (every model
-  hot-reload — ``serving.Server``).
+  the model server dispatches), ``serving.reload`` (every model
+  hot-reload — ``serving.Server``), ``elastic.heartbeat`` (every
+  liveness touch of the elastic runtime) and ``elastic.rejoin`` (every
+  epoch-transition restore — ``parallel.elastic.ElasticRunner``).
   Like telemetry, every call site guards on one module-level flag
   (``_state.enabled`` — a single attribute load + branch), so the
   disabled fast path costs one branch and allocates nothing.
@@ -71,11 +74,14 @@ SITES = (
     "kvstore.push",
     "kvstore.pull",
     "kvstore.allreduce",
+    "kvstore.barrier",
     "checkpoint.write",
     "checkpoint.read",
     "datafeed.put",
     "serving.dispatch",
     "serving.reload",
+    "elastic.heartbeat",
+    "elastic.rejoin",
 )
 
 

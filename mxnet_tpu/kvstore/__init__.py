@@ -12,7 +12,19 @@ collectives over the device mesh — push/pull become a compiled psum; the
 scripts run unchanged. Parameter-server 'dist_async' has no TPU analogue
 and raises with guidance. Multi-host rendezvous uses jax.distributed
 (see mxnet_tpu.parallel) instead of dmlc_tracker env bootstrap.
+
+Dist modes are SUPERVISED (replacing what ps-lite's tracker gave the
+reference): ``tools/launch.py`` polls every worker and fail-fasts or
+restarts dead ranks (``--max-restarts``); ``barrier()`` and the
+``jax.distributed`` bootstrap are bounded by ``MXNET_KV_BARRIER_TIMEOUT``
+and raise a typed :class:`~mxnet_tpu.kvstore.kvstore.BarrierTimeoutError`
+naming the site and the missing ranks instead of blocking forever; ranks
+leave through a bounded exit barrier; and
+``mxnet_tpu.parallel.elastic.ElasticRunner`` adds heartbeat liveness +
+epoch-versioned membership with bit-exact checkpoint hand-off, so a
+SIGKILLed worker rejoins and the loss stays bit-identical.
 """
 from .bucketing import Bucket, bucket_cap_bytes, plan_buckets  # noqa: F401
-from .kvstore import (KVStore, KVStoreDistAsyncEmu, KVStoreLocal,  # noqa: F401
+from .kvstore import (BarrierTimeoutError, KVStore,  # noqa: F401
+                      KVStoreDistAsyncEmu, KVStoreLocal,
                       KVStoreTPUSync, create)

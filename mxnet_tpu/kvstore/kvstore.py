@@ -1,8 +1,12 @@
 """KVStore implementations (see package docstring for the design map)."""
 from __future__ import annotations
 
+import os
 import pickle
+import threading
 import time
+import warnings
+import weakref
 from typing import Dict, List, Optional
 
 import numpy as _np
@@ -45,8 +49,163 @@ def _nd_bytes(v) -> int:
 def _payload_bytes(vals) -> int:
     return sum(_nd_bytes(v) for v in vals)
 
-__all__ = ["KVStore", "KVStoreDistAsyncEmu", "KVStoreLocal",
-           "KVStoreTPUSync", "create"]
+__all__ = ["BarrierTimeoutError", "KVStore", "KVStoreDistAsyncEmu",
+           "KVStoreLocal", "KVStoreTPUSync", "create"]
+
+
+# ---------------------------------------------------------------------------
+# Bounded barriers — a dead worker must surface as a typed error naming
+# the site and the missing ranks, never as an unbounded hang.
+# ---------------------------------------------------------------------------
+
+# SPMD-consistent store-creation ordinal (every process creates its
+# stores in the same program order) — namespaces each store's
+# cross-process barrier keys so two stores can never alias rendezvous.
+_STORE_ORDINAL = 0
+
+
+class BarrierTimeoutError(MXNetError):
+    """A kvstore barrier (local drain or cross-process rendezvous) did
+    not complete within ``MXNET_KV_BARRIER_TIMEOUT`` — the typed signal
+    the elastic runtime and exit paths branch on instead of wedging."""
+
+
+def _barrier_timeout_s() -> float:
+    """Default barrier bound (seconds). <= 0 disables the bound (the
+    pre-supervision behavior, for jobs that want to block forever)."""
+    try:
+        return float(os.environ.get("MXNET_KV_BARRIER_TIMEOUT", "300"))
+    except ValueError as e:
+        raise MXNetError(
+            "MXNET_KV_BARRIER_TIMEOUT="
+            f"{os.environ['MXNET_KV_BARRIER_TIMEOUT']!r} is not a "
+            "number") from e
+
+
+def _bounded_waitall(site: str, timeout: float) -> None:
+    """Drain local async device work, bounded: ``waitall`` runs on a
+    daemon thread joined with ``timeout``. On expiry the caller gets
+    :class:`BarrierTimeoutError` naming the site — the wedged device
+    work stays wedged (nothing can cancel it), but the *process* regains
+    control to checkpoint, report, or exit."""
+    from .. import ndarray as _nd
+
+    if timeout <= 0:
+        _nd.waitall()
+        return
+    done = threading.Event()
+    err: List[BaseException] = []
+
+    def _drain():
+        try:
+            _nd.waitall()
+        except BaseException as e:  # noqa: BLE001 - re-raised below
+            err.append(e)
+        finally:
+            done.set()
+
+    threading.Thread(target=_drain, name="mxnet-kv-barrier-wait",
+                     daemon=True).start()
+    if not done.wait(timeout):
+        raise BarrierTimeoutError(
+            f"kvstore.barrier[{site}]: local device drain did not "
+            f"complete within {timeout:g}s (MXNET_KV_BARRIER_TIMEOUT) — "
+            "outstanding async work is wedged (dead collective peer?)")
+    if err:
+        raise err[0]
+
+
+def _coord_client():
+    """The jax coordination-service KV client, or None when this process
+    was not bootstrapped through ``jax.distributed``."""
+    try:
+        from jax._src import distributed
+
+        return distributed.global_state.client
+    except Exception:
+        return None
+
+
+def dist_initialized() -> bool:
+    """Is ``jax.distributed`` bootstrapped in this process?
+    ``jax.distributed.is_initialized`` only exists in newer jax; older
+    containers (this one included) fall back to the coordination-service
+    client handle, which is set by ``initialize`` and cleared by
+    ``shutdown`` on every version in support."""
+    import jax
+
+    fn = getattr(jax.distributed, "is_initialized", None)
+    if fn is not None:
+        return bool(fn())
+    return _coord_client() is not None
+
+
+def _kv_set_once(client, key: str, value: str) -> None:
+    """``key_value_set`` tolerating re-announcement (a retried barrier
+    attempt re-sets its own key; ALREADY_EXISTS is success)."""
+    try:
+        client.key_value_set(key, value)
+    except Exception as e:  # noqa: BLE001 - status string filtered
+        # only ALREADY_EXISTS is success; "does not exist" / NOT_FOUND
+        # style failures must surface (a swallowed announcement would
+        # make every PEER's timeout blame this healthy rank)
+        msg = str(e).lower()
+        if not ("already" in msg and "exist" in msg):
+            raise
+
+
+def _cross_process_barrier(client, site: str, seq: int, rank: int,
+                           num_workers: int, timeout: float,
+                           poll_interval: float = 0.05,
+                           key_ns: str = "",
+                           time_fn=time.monotonic,
+                           sleep_fn=time.sleep) -> List[int]:
+    """Rendezvous ``num_workers`` ranks through the coordination-service
+    KV store: announce ``.../{site}/{seq}/{rank}``, poll the directory
+    until every rank announced or the deadline passes. On expiry raises
+    :class:`BarrierTimeoutError` naming the site AND the missing ranks —
+    the diagnostic a hung ``psum`` can never give. Announcements are
+    idempotent, so the surrounding ``fault.retry_call`` is safe."""
+    prefix = f"mxnet_tpu/barrier/{key_ns}{site}/{int(seq)}"
+    _kv_set_once(client, f"{prefix}/{int(rank)}", str(int(rank)))
+    deadline = time_fn() + timeout
+    while True:
+        if _fault_state.enabled:
+            fault.check("kvstore.barrier", f"{site} seq {seq}")
+        present = set()
+        for item in client.key_value_dir_get(prefix):
+            key = item[0] if isinstance(item, (tuple, list)) else item
+            tail = str(key).rsplit("/", 1)[-1]
+            if tail.isdigit():
+                present.add(int(tail))
+        if len(present) >= num_workers:
+            return sorted(present)
+        if timeout > 0 and time_fn() >= deadline:
+            missing = sorted(set(range(num_workers)) - present)
+            raise BarrierTimeoutError(
+                f"kvstore.barrier[{site}] (seq {seq}) timed out after "
+                f"{timeout:g}s: missing ranks {missing} of "
+                f"{num_workers} (arrived: {sorted(present)}) — restart "
+                "the dead worker (tools/launch.py --max-restarts) or "
+                "tear the job down; MXNET_KV_BARRIER_TIMEOUT bounds "
+                "this wait")
+        sleep_fn(poll_interval)
+
+
+def _register_exit_barrier(store: "KVStore") -> None:
+    """Run the store's bounded exit barrier at interpreter exit so a
+    multi-process job's ranks leave together when they can — and leave
+    ANYWAY (with a warning) when a peer is already gone."""
+    import atexit
+
+    ref = weakref.ref(store)
+
+    def _hook():
+        s = ref()
+        if s is not None:
+            s._barrier_before_exit()
+
+    atexit.register(_hook)
 
 
 def create(name="local") -> "KVStore":
@@ -230,13 +389,47 @@ class KVStore:
         apply_state_bytes(states, self._updater.set_states, fname,
                           "load_optimizer_states")
 
-    def barrier(self):
-        from ..ndarray import waitall
+    def barrier(self, site: str = "user", timeout: Optional[float] = None):
+        """Synchronization barrier, BOUNDED (reference: kvstore.py::
+        barrier — an unbounded ``waitall``). Drains local async device
+        work within ``timeout`` seconds (default
+        ``MXNET_KV_BARRIER_TIMEOUT``, 300; <= 0 restores the unbounded
+        wait); distributed stores additionally rendezvous every process.
+        On expiry raises :class:`BarrierTimeoutError` naming ``site``
+        (and, cross-process, the missing ranks) instead of wedging the
+        job on a dead worker. Fault site ``kvstore.barrier``."""
+        timeout = _barrier_timeout_s() if timeout is None \
+            else float(timeout)
+        if _fault_state.enabled:
+            fault.check("kvstore.barrier", site)
+        _bounded_waitall(site, timeout)
 
-        waitall()
-
-    def _barrier_before_exit(self):
-        pass
+    def _barrier_before_exit(self) -> bool:
+        """Bounded exit drain (was a no-op): let a multi-process job's
+        ranks leave together, but NEVER wedge teardown — a barrier
+        timeout (dead peer) is reported as a warning carrying the typed
+        error and exit proceeds. Returns True when the barrier
+        completed. ``MXNET_KV_EXIT_BARRIER_TIMEOUT`` (default 10 s,
+        capped by the main barrier knob) bounds the wait."""
+        try:
+            cap = _barrier_timeout_s()
+            timeout = float(os.environ.get(
+                "MXNET_KV_EXIT_BARRIER_TIMEOUT", "10"))
+            if cap > 0:
+                timeout = min(timeout, cap)
+        except Exception:  # noqa: BLE001 - incl. MXNetError from a
+            # malformed knob: this runs from atexit, never raise
+            timeout = 10.0
+        try:
+            self.barrier(site="exit", timeout=timeout)
+            return True
+        except Exception as e:  # noqa: BLE001 - exit path: warn, never
+            # raise (incl. a coordination client already torn down by
+            # interpreter shutdown — this runs from atexit)
+            warnings.warn(
+                f"kvstore exit barrier abandoned (exit continues): {e}",
+                RuntimeWarning, stacklevel=2)
+            return False
 
 
 class KVStoreLocal(KVStore):
@@ -613,8 +806,53 @@ class KVStoreTPUSync(KVStoreLocal):
         super().__init__(type_name)
         if type_name in ("dist_sync", "dist_device_sync"):
             _maybe_init_distributed()
+            # dist modes are SUPERVISED: ranks leave through a bounded
+            # exit barrier (never wedging on a dead peer)
+            _register_exit_barrier(self)
         self._mesh = None
         self._reducers: Dict = {}
+        # cross-process barrier namespace: (store creation ordinal, per-
+        # site sequence). The ordinal is SPMD-consistent (every process
+        # creates its stores in the same program order), and keeps two
+        # stores' barriers from aliasing each other's rendezvous keys.
+        global _STORE_ORDINAL
+        _STORE_ORDINAL += 1
+        self._barrier_ns = _STORE_ORDINAL
+        self._barrier_seq: Dict[str, int] = {}
+
+    def barrier(self, site: str = "user", timeout: Optional[float] = None):
+        """Local drain + cross-process rendezvous, both bounded. The
+        rendezvous rides the coordination-service KV store (one
+        announce + a poll loop — per-site sequence numbers keep repeated
+        barriers distinct under the SPMD contract that every process
+        calls them in the same order), so a timeout can name exactly
+        which ranks never arrived — the diagnostic a hung psum cannot
+        give. Wrapped in ``fault.retry_call`` at ``kvstore.barrier``
+        (announcements are idempotent)."""
+        timeout = _barrier_timeout_s() if timeout is None \
+            else float(timeout)
+        t0 = time.monotonic()
+        super().barrier(site, timeout)
+        import jax
+
+        if jax.process_count() <= 1:
+            return
+        client = _coord_client()
+        if client is None:       # bootstrapped out-of-band (TPU pod rt)
+            return
+        # ONE budget for the whole barrier: the rendezvous gets what the
+        # local drain left (floored so an instant drain cannot zero it),
+        # not a fresh timeout — callers rely on the documented bound
+        remaining = timeout if timeout <= 0 else \
+            max(0.05, timeout - (time.monotonic() - t0))
+        seq = self._barrier_seq.get(site, 0) + 1
+        self._barrier_seq[site] = seq
+        fault.retry_call(
+            "kvstore.barrier",
+            lambda: _cross_process_barrier(
+                client, site, seq, self.rank, self.num_workers,
+                remaining, key_ns=f"s{self._barrier_ns}/"),
+            detail=f"site {site!r} seq {seq}")
 
     def attach_mesh(self, mesh):
         """Pin the reduction mesh (default: pushed copies' own devices in
@@ -959,12 +1197,39 @@ def _maybe_init_distributed():
     n = int(os.environ.get("DMLC_NUM_WORKER", "1"))
     if not uri or n <= 1:
         return
-    import jax
-
-    if jax.distributed.is_initialized():
+    if dist_initialized():
         return  # coordination service already up (launcher or user)
     port = os.environ.get("DMLC_PS_ROOT_PORT", "9091")
     rank = int(os.environ.get("DMLC_WORKER_ID", "0"))
-    jax.distributed.initialize(
-        coordinator_address=f"{uri}:{port}",
-        num_processes=n, process_id=rank)
+    # the rendezvous is BOUNDED: a worker that never comes up must
+    # surface as a typed error naming the site, not an eternal hang
+    # (MXNET_KV_BOOTSTRAP_TIMEOUT, falling back to the barrier knob)
+    try:
+        timeout_s = float(os.environ.get(
+            "MXNET_KV_BOOTSTRAP_TIMEOUT", "") or _barrier_timeout_s())
+    except ValueError as e:
+        raise MXNetError(
+            "MXNET_KV_BOOTSTRAP_TIMEOUT="
+            f"{os.environ['MXNET_KV_BOOTSTRAP_TIMEOUT']!r} is not a "
+            "number") from e
+    # jax wants an integer timeout and has no unbounded mode: <= 0 (the
+    # documented bound opt-out) maps to ~24 days, fractions round UP so
+    # 0.5 never truncates to instant failure
+    import math
+
+    timeout_s = 2**31 // 1000 if timeout_s <= 0 \
+        else max(1, math.ceil(timeout_s))
+    import jax
+
+    try:
+        jax.distributed.initialize(
+            coordinator_address=f"{uri}:{port}",
+            num_processes=n, process_id=rank,
+            initialization_timeout=timeout_s)
+    except Exception as e:
+        raise MXNetError(
+            f"kvstore.bootstrap: jax.distributed rendezvous at "
+            f"{uri}:{port} failed for rank {rank}/{n} within "
+            f"{timeout_s}s: {e} — check that all {n} workers launched "
+            "(tools/launch.py supervises and restarts them) and that "
+            "the coordinator address/port is reachable") from e

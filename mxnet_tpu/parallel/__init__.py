@@ -16,11 +16,13 @@ from .sharding import (PartitionSpec, ShardingRules, named_sharding,
                        spec_for_param)
 from .step import TrainStep
 from .checkpoint import save_sharded, restore_sharded
+from .elastic import ElasticRunner, HeartbeatBoard, Membership
 from .ring_attention import ring_attention, ring_attention_sharded
 from .pipeline import (Pipelined, pipeline_apply, pipeline_active,
                        pipeline_sharding_rules, pipeline_train_1f1b)
 
-__all__ = ["save_sharded", "restore_sharded",
+__all__ = ["ElasticRunner", "HeartbeatBoard", "Membership",
+           "save_sharded", "restore_sharded",
            "ring_attention", "ring_attention_sharded",
            "Pipelined", "pipeline_apply", "pipeline_active",
            "pipeline_sharding_rules", "pipeline_train_1f1b",

@@ -1,0 +1,585 @@
+"""``mx.parallel.elastic`` — elastic multi-host training runtime.
+
+The reference survived worker crashes because ps-lite's tracker restarted
+dead nodes and the parameter server kept the authoritative weights
+(PAPER.md §2.2). A TPU-native multi-controller job has neither: every
+process holds a full replica and a single dead worker hangs every sibling
+at its next collective. This module replaces the tracker with something
+strictly stronger — supervised, *epoch-versioned* membership with
+bit-exact state hand-off:
+
+* **Heartbeat liveness.** Every worker registers under a shared
+  coordinator directory (``coord_dir/hb/rank-NNNNN.json``, written once
+  with host/pid/incarnation) and a daemon thread touches the file every
+  ``MXNET_ELASTIC_HEARTBEAT_INTERVAL`` (0.5 s). A rank whose file goes
+  stale past ``MXNET_ELASTIC_HEARTBEAT_TIMEOUT`` (5 s) is dead to its
+  siblings — no RPC, no extra service, works for any shared filesystem
+  (one host's /tmp for local jobs, NFS/GCS-fuse across hosts). Touches
+  run under ``fault.retry_call`` at site ``elastic.heartbeat``.
+
+* **Membership epochs.** On any join/leave, every survivor (1)
+  checkpoints through :class:`~mxnet_tpu.checkpoint.CheckpointManager`
+  (bundle tagged with the elastic epoch + member set), (2) tears down
+  ``jax.distributed`` when the job is truly multi-process, (3)
+  re-bootstraps at the new world size (dense ranks over the sorted
+  survivor set, coordinator = new rank 0, port advanced by epoch so a
+  stale coordinator socket can never be re-joined), (4) restores the
+  bundle **bit-exactly** — params, optimizer counters, RNG stream and
+  compression residuals all ride the PR-3 bundle format — and continues.
+  The epoch id is threaded into telemetry
+  (``mxnet_elastic_membership_epoch``) and the bundle's ``extra`` tag.
+
+* **Graceful degradation.** A rank that stays dead just shrinks the
+  membership: survivors train on at the reduced world size, and
+  :class:`Membership` gives the deterministic shard re-assignment of the
+  data stream (``owns(index)`` / ``shard_indices(n)`` over dense ranks),
+  so every sample keeps exactly one owner at every epoch.
+
+A restarted worker (``tools/launch.py --max-restarts N`` respawns it
+with the same ``DMLC_WORKER_ID``) finds the newest valid bundle for its
+rank at :meth:`ElasticRunner.start` and resumes from it — kill a worker
+mid-step, rejoin, and the final loss is bit-identical to an
+uninterrupted run (``tools/chaos_check.py`` elastic gate).
+
+::
+
+    runner = elastic.ElasticRunner(coord_dir, params=net, trainer=trainer,
+                                   save_every=50)
+    losses = runner.run(lambda step, m: train_one_step(step, m), 10_000)
+
+``step_fn(step, membership)`` is the user's training step; shard the
+data stream with ``membership.owns(sample_index)`` and the re-assignment
+on membership change is automatic.
+"""
+from __future__ import annotations
+
+import json
+import os
+import socket
+import threading
+import time
+import weakref
+from dataclasses import dataclass
+from typing import Callable, Dict, List, Optional, Tuple
+
+from .. import fault, telemetry
+from ..base import MXNetError
+from ..checkpoint import CheckpointManager, atomic_write
+from ..fault import _state as _fault_state
+
+__all__ = ["ElasticRunner", "HeartbeatBoard", "Membership",
+           "live_runners"]
+
+_HB_DIR = "hb"
+_EPOCH_FILE = "EPOCH"
+_THREAD_PREFIX = "mxnet-elastic-heartbeat"
+
+# Runners whose heartbeat thread is (or may be) running — the test-suite
+# leak guard sweeps this (same pattern as serving.live_servers()).
+_RUNNERS: "weakref.WeakSet[ElasticRunner]" = weakref.WeakSet()
+
+
+def live_runners() -> List["ElasticRunner"]:
+    """Runners with a running heartbeat thread (leak-guard hook)."""
+    return [r for r in list(_RUNNERS) if r.heartbeat_running()]
+
+
+def _env_float(name: str, default: float) -> float:
+    raw = os.environ.get(name, "")
+    try:
+        return float(raw) if raw else default
+    except ValueError as e:
+        raise MXNetError(f"{name}={raw!r} is not a number") from e
+
+
+@dataclass(frozen=True)
+class Membership:
+    """One epoch of cluster membership.
+
+    ``members`` are *launch* ranks (the ``DMLC_WORKER_ID`` a worker was
+    started with — stable across restarts); ``rank``/``world_size`` are
+    the dense re-assignment over the sorted survivor set, which is what
+    collectives and data sharding use. Dense ranks are a pure function
+    of the member set, so every survivor computes the same assignment
+    without any extra coordination round.
+    """
+
+    epoch: int
+    rank: int                 # dense rank within this membership
+    world_size: int
+    members: Tuple[int, ...]  # sorted launch ranks alive this epoch
+    launch_rank: int          # this worker's launch rank
+
+    def owns(self, index: int) -> bool:
+        """Deterministic shard assignment: does this worker own sample
+        ``index`` of the (infinite) data stream at this epoch?"""
+        return int(index) % self.world_size == self.rank
+
+    def shard_indices(self, n: int) -> range:
+        """This worker's slice of ``range(n)`` (round-robin by dense
+        rank — the re-assignment every survivor agrees on)."""
+        return range(self.rank, int(n), self.world_size)
+
+
+class HeartbeatBoard:
+    """The per-rank heartbeat files under ``coord_dir/hb/``.
+
+    Registration writes ``rank-NNNNN.json`` once (atomic:
+    host/pid/incarnation/started); liveness afterwards is ONE ``utime``
+    touch per interval and ONE ``listdir`` + ``stat`` sweep per check —
+    no payload re-reads on the hot path. Staleness is wall-clock mtime
+    age, so it works across processes and (with a shared mount and sane
+    clock skew vs. the multi-second timeout) across hosts.
+    """
+
+    def __init__(self, coord_dir: str):
+        self.coord_dir = os.fspath(coord_dir)
+        self.hb_dir = os.path.join(self.coord_dir, _HB_DIR)
+        os.makedirs(self.hb_dir, exist_ok=True)
+
+    def path(self, rank: int) -> str:
+        return os.path.join(self.hb_dir, f"rank-{int(rank):05d}.json")
+
+    def register(self, rank: int, extra: Optional[Dict] = None) -> str:
+        info = {"rank": int(rank), "host": socket.gethostname(),
+                "pid": os.getpid(), "started_unix": time.time(),
+                "incarnation": f"{os.getpid()}-{time.time_ns()}"}
+        if extra:
+            info.update(extra)
+        p = self.path(rank)
+        atomic_write(p, json.dumps(info).encode("utf-8"))
+        return p
+
+    def touch(self, rank: int) -> None:
+        os.utime(self.path(rank), None)
+
+    def read(self, rank: int) -> Dict:
+        try:
+            with open(self.path(rank), "rb") as f:
+                info = json.loads(f.read().decode("utf-8"))
+            return info if isinstance(info, dict) else {}
+        except (OSError, ValueError, UnicodeDecodeError):
+            return {}
+
+    def mtimes(self) -> Dict[int, float]:
+        """rank -> heartbeat mtime for every registered rank."""
+        out: Dict[int, float] = {}
+        try:
+            entries = os.listdir(self.hb_dir)
+        except OSError:
+            return out
+        for e in entries:
+            if not (e.startswith("rank-") and e.endswith(".json")):
+                continue
+            try:
+                out[int(e[len("rank-"):-len(".json")])] = \
+                    os.path.getmtime(os.path.join(self.hb_dir, e))
+            except (ValueError, OSError):
+                continue
+        return out
+
+    def alive(self, timeout: float, now: Optional[float] = None) -> List[int]:
+        """Ranks whose heartbeat is fresher than ``timeout`` seconds."""
+        now = time.time() if now is None else now
+        return sorted(r for r, m in self.mtimes().items()
+                      if now - m <= timeout)
+
+
+class ElasticRunner:
+    """Supervised elastic training loop (see module docstring).
+
+    ``params``/``trainer`` are the Block and Gluon Trainer whose state
+    the epoch protocol checkpoints and restores (either may be None for
+    a state-free loop). One :class:`CheckpointManager` per launch rank
+    (prefix ``r{rank}``) lives under ``coord_dir/ckpts`` by default, so
+    all ranks of a local job share one directory without colliding.
+
+    Injection hooks ``bootstrap_fn(membership)`` / ``shutdown_fn()``
+    replace the real ``jax.distributed`` teardown/re-init in tests
+    (single process, faked sibling ranks).
+
+    ``distributed`` contract: ``None`` (auto) participates in epoch
+    teardown/re-bootstrap only when ``jax.distributed`` is ALREADY
+    initialized in this process — right for first-incarnation workers
+    that bootstrapped via ``create('dist_sync')``, and for
+    single-process / collective-free jobs (the chaos gate). A
+    **restarted** rank of a real multi-process job must pass
+    ``distributed=True`` and let the runner own the bootstrap: the
+    original coordinator port is dead, so it must NOT call
+    ``create('dist_sync')`` first — the runner instead waits for the
+    survivors' join commit and rendezvouses at the epoch-derived port
+    (see ``_await_join_commit``).
+    """
+
+    def __init__(self, coord_dir: str, *, params=None, trainer=None,
+                 rank: Optional[int] = None,
+                 world_size: Optional[int] = None,
+                 ckpt_dir: Optional[str] = None,
+                 ckpt_mgr: Optional[CheckpointManager] = None,
+                 keep_last: int = 3, save_every: int = 0,
+                 heartbeat_interval: Optional[float] = None,
+                 heartbeat_timeout: Optional[float] = None,
+                 join_timeout: Optional[float] = None,
+                 on_epoch: Optional[Callable] = None,
+                 distributed: Optional[bool] = None,
+                 bootstrap_fn: Optional[Callable] = None,
+                 shutdown_fn: Optional[Callable] = None):
+        self.coord_dir = os.fspath(coord_dir)
+        self.board = HeartbeatBoard(self.coord_dir)
+        self.launch_rank = int(os.environ.get("DMLC_WORKER_ID", "0")) \
+            if rank is None else int(rank)
+        self.launch_world = int(os.environ.get("DMLC_NUM_WORKER", "1")) \
+            if world_size is None else int(world_size)
+        if self.launch_world < 1:
+            raise MXNetError(
+                f"elastic world_size must be >= 1, got {self.launch_world}")
+        if not 0 <= self.launch_rank < self.launch_world:
+            raise MXNetError(
+                f"elastic rank {self.launch_rank} outside world of "
+                f"{self.launch_world}")
+        self.params = params
+        self.trainer = trainer
+        if ckpt_mgr is not None:
+            self.ckpt = ckpt_mgr
+        else:
+            self.ckpt = CheckpointManager(
+                ckpt_dir or os.path.join(self.coord_dir, "ckpts"),
+                prefix=f"r{self.launch_rank}", keep_last=keep_last)
+        self.save_every = int(save_every)
+        self.heartbeat_interval = _env_float(
+            "MXNET_ELASTIC_HEARTBEAT_INTERVAL", 0.5) \
+            if heartbeat_interval is None else float(heartbeat_interval)
+        self.heartbeat_timeout = _env_float(
+            "MXNET_ELASTIC_HEARTBEAT_TIMEOUT", 5.0) \
+            if heartbeat_timeout is None else float(heartbeat_timeout)
+        if self.heartbeat_timeout <= 0 or self.heartbeat_interval <= 0:
+            raise MXNetError(
+                "elastic heartbeat interval/timeout must be > 0")
+        self.join_timeout = _env_float("MXNET_ELASTIC_JOIN_TIMEOUT", 60.0) \
+            if join_timeout is None else float(join_timeout)
+        self.on_epoch = on_epoch
+        self._distributed = distributed
+        self._bootstrap_fn = bootstrap_fn
+        self._shutdown_fn = shutdown_fn
+        self.membership: Optional[Membership] = None
+        self.transitions: List[Dict] = []
+        self.start_step = 0
+        self.resumed_from: Optional[int] = None
+        self._started = False
+        self._last_completed = -1
+        self._hb_stop = threading.Event()
+        self._hb_thread: Optional[threading.Thread] = None
+        _RUNNERS.add(self)
+
+    # -- heartbeats ----------------------------------------------------
+    def heartbeat_running(self) -> bool:
+        t = self._hb_thread
+        return t is not None and t.is_alive()
+
+    def _touch(self) -> None:
+        if _fault_state.enabled:
+            fault.check("elastic.heartbeat",
+                        f"rank {self.launch_rank}")
+        self.board.touch(self.launch_rank)
+
+    def heartbeat(self) -> None:
+        """One liveness touch (bounded retry at ``elastic.heartbeat`` —
+        a transient shared-FS hiccup must not make this rank look dead).
+        The daemon thread calls this on every interval; call it manually
+        from inside very long steps if the step time can exceed the
+        sibling timeout."""
+        fault.retry_call("elastic.heartbeat", self._touch,
+                         detail=f"rank {self.launch_rank}")
+
+    def _hb_loop(self) -> None:
+        while not self._hb_stop.wait(self.heartbeat_interval):
+            try:
+                self.heartbeat()
+            except Exception:
+                # a persistently failing touch makes US look dead;
+                # the siblings' epoch protocol is the recovery path —
+                # killing the training thread from here would be worse
+                continue
+
+    # -- membership ----------------------------------------------------
+    def _alive_now(self) -> List[int]:
+        alive = set(self.board.alive(self.heartbeat_timeout))
+        alive.add(self.launch_rank)     # we are running this very line
+        return sorted(alive)
+
+    def _epoch_file(self) -> str:
+        return os.path.join(self.coord_dir, _EPOCH_FILE)
+
+    def _read_epoch_record(self) -> Tuple[int, Optional[Tuple[int, ...]]]:
+        """The shared ``(epoch, members)`` commit record (members None
+        for a legacy bare-int file)."""
+        try:
+            with open(self._epoch_file(), "rb") as f:
+                raw = f.read().decode("utf-8").strip()
+        except OSError:
+            return 0, None
+        try:
+            rec = json.loads(raw or "0")
+        except ValueError:
+            return 0, None
+        if isinstance(rec, dict):
+            try:
+                members = rec.get("members")
+                return int(rec.get("epoch", 0)), \
+                    tuple(int(r) for r in members) \
+                    if members is not None else None
+            except (TypeError, ValueError):
+                return 0, None
+        try:
+            return int(rec), None
+        except (TypeError, ValueError):
+            return 0, None
+
+    def _read_epoch(self) -> int:
+        return self._read_epoch_record()[0]
+
+    def _publish_epoch(self, epoch: int,
+                       members: Optional[Tuple[int, ...]] = None) -> None:
+        # best-effort monotonic max across ranks: the record is advisory
+        # for epoch numbering (late joiners adopt it) — but it is ALSO
+        # the rejoin-handshake signal (a joiner waits for a committed
+        # membership that includes it), so it carries the member set
+        if epoch > self._read_epoch():
+            atomic_write(self._epoch_file(), json.dumps(
+                {"epoch": int(epoch),
+                 "members": list(members or ())}).encode("utf-8"))
+
+    def _make_membership(self, epoch: int, members: List[int]) -> Membership:
+        members = sorted(members)
+        if self.launch_rank not in members:
+            members = sorted(members + [self.launch_rank])
+        return Membership(epoch=epoch,
+                          rank=members.index(self.launch_rank),
+                          world_size=len(members),
+                          members=tuple(members),
+                          launch_rank=self.launch_rank)
+
+    # -- lifecycle -----------------------------------------------------
+    def start(self) -> Membership:
+        """Register, start the heartbeat thread, wait for the initial
+        world (bounded by ``join_timeout`` — whoever registered by then
+        forms epoch 0's membership), and resume from this rank's newest
+        valid bundle when one exists (the rejoin path)."""
+        if self._started:
+            return self.membership
+        self.board.register(self.launch_rank)
+        self.board.touch(self.launch_rank)
+        self._hb_stop = threading.Event()
+        self._hb_thread = threading.Thread(
+            target=self._hb_loop,
+            name=f"{_THREAD_PREFIX}-r{self.launch_rank}", daemon=True)
+        self._hb_thread.start()
+        deadline = time.monotonic() + self.join_timeout
+        alive = self._alive_now()
+        while (len(alive) < self.launch_world
+               and time.monotonic() < deadline):
+            time.sleep(min(0.05, self.heartbeat_interval))
+            alive = self._alive_now()
+        epoch = self._read_epoch()
+        self.start_step = 0
+        step = self.ckpt.latest_step()
+        if step is not None:
+            meta = self._restore()
+            self.start_step = step + 1
+            self.resumed_from = step
+            tag = (meta.get("extra") or {}).get("elastic") or {}
+            bundle_epoch = int(tag.get("epoch", 0))
+            epoch = max(epoch, bundle_epoch)
+            telemetry.record_elastic_restart()
+            if self._is_distributed():
+                # rejoin handshake: the survivors commit our join as a
+                # transition (publishing the epoch record BEFORE their
+                # blocking re-bootstrap — see _transition), and we must
+                # enter the SAME rendezvous: wait for a committed
+                # membership that names us, then bootstrap at exactly
+                # the COMMITTED epoch AND member set — our own alive
+                # snapshot is stale by now (another rank may have died
+                # while we restarted), and a world-size disagreement
+                # would wedge the rendezvous on both sides
+                epoch, committed = self._await_join_commit(
+                    bundle_epoch, epoch)
+                if committed is not None:
+                    alive = list(committed)
+        self.membership = self._make_membership(epoch, alive)
+        self._last_completed = self.start_step - 1
+        self._publish_epoch(epoch, self.membership.members)
+        telemetry.set_elastic_epoch(epoch)
+        if (step is not None and self._is_distributed()
+                and self.membership.world_size > 1):
+            (self._bootstrap_fn or self._default_bootstrap)(self.membership)
+        self._started = True
+        return self.membership
+
+    def _await_join_commit(
+            self, bundle_epoch: int, epoch: int
+    ) -> Tuple[int, Optional[Tuple[int, ...]]]:
+        """Wait (bounded by ``join_timeout``) for the survivors to
+        commit a membership that INCLUDES this rank at an epoch past
+        the bundle we resumed from — their signal that they are in (or
+        about to enter) the re-bootstrap rendezvous for our join. A
+        plain epoch advance is not enough: the leave transition that
+        recorded our death also advanced it. Returns the committed
+        ``(epoch, members)`` — the rejoiner must adopt BOTH, not its
+        own alive snapshot. Times out to ``(best known epoch, None)``
+        (all survivors gone: continue solo, degraded)."""
+        deadline = time.monotonic() + self.join_timeout
+        while time.monotonic() < deadline:
+            cur, members = self._read_epoch_record()
+            if cur > bundle_epoch and members is not None \
+                    and self.launch_rank in members:
+                return max(cur, epoch), members
+            time.sleep(min(0.05, self.heartbeat_interval))
+        return epoch, None
+
+    def stop(self) -> None:
+        """Stop the heartbeat thread (idempotent). The heartbeat file is
+        left to go stale — that IS the leave signal to the siblings."""
+        self._hb_stop.set()
+        t = self._hb_thread
+        if t is not None and t.is_alive():
+            t.join(timeout=max(1.0, 4 * self.heartbeat_interval))
+        self._hb_thread = None
+        self._started = False
+
+    def __enter__(self):
+        self.start()
+        return self
+
+    def __exit__(self, *exc):
+        self.stop()
+        return False
+
+    # -- checkpoint round-trip -----------------------------------------
+    def _save(self, step: int, membership: Optional[Membership] = None):
+        m = membership or self.membership
+        tag = {"epoch": m.epoch if m else 0,
+               "members": list(m.members) if m else [self.launch_rank],
+               "launch_rank": self.launch_rank}
+        return self.ckpt.save(step, params=self.params,
+                              trainer=self.trainer,
+                              extra={"elastic": tag})
+
+    def _restore(self) -> Dict:
+        """Bit-exact restore from the newest valid bundle, bounded retry
+        at ``elastic.rejoin`` (restore is an idempotent overwrite)."""
+
+        def _do():
+            if _fault_state.enabled:
+                fault.check("elastic.rejoin",
+                            f"rank {self.launch_rank}")
+            return self.ckpt.restore(block=self.params,
+                                     trainer=self.trainer)
+
+        return fault.retry_call("elastic.rejoin", _do,
+                                detail=f"rank {self.launch_rank}")
+
+    # -- the epoch protocol --------------------------------------------
+    def check_membership(self) -> Membership:
+        """Compare the heartbeat board against the current membership;
+        on any join/leave run one epoch transition (checkpoint →
+        teardown → re-bootstrap → bit-exact restore). Called by
+        :meth:`run` between steps; call it yourself in a hand-rolled
+        loop."""
+        if not self._started:
+            raise MXNetError("ElasticRunner.start() before "
+                             "check_membership()")
+        alive = self._alive_now()
+        current = set(self.membership.members)
+        if set(alive) == current:
+            return self.membership
+        left = sorted(current - set(alive))
+        joined = sorted(set(alive) - current)
+        for r in left:
+            telemetry.record_elastic_heartbeat_miss(r)
+        return self._transition(alive, left, joined)
+
+    def _is_distributed(self) -> bool:
+        if self._distributed is not None:
+            return bool(self._distributed)
+        try:
+            from ..kvstore.kvstore import dist_initialized
+
+            return dist_initialized()
+        except Exception:
+            return False
+
+    def _default_shutdown(self) -> None:
+        import jax
+
+        jax.distributed.shutdown()
+
+    def _default_bootstrap(self, m: Membership) -> None:
+        # coordinator = the new rank 0's host; the port advances with
+        # the epoch so a survivor can never rendezvous with a stale
+        # coordinator socket from a previous epoch
+        host = self.board.read(m.members[0]).get("host") or "127.0.0.1"
+        base = int(os.environ.get("DMLC_PS_ROOT_PORT", "9091"))
+        import jax
+
+        jax.distributed.initialize(
+            coordinator_address=f"{host}:{base + 1 + m.epoch}",
+            num_processes=m.world_size, process_id=m.rank,
+            initialization_timeout=max(
+                1, int(_env_float("MXNET_KV_BARRIER_TIMEOUT", 300.0))))
+
+    def _transition(self, alive: List[int], left: List[int],
+                    joined: List[int]) -> Membership:
+        old = self.membership
+        epoch = max(old.epoch, self._read_epoch()) + 1
+        new = self._make_membership(epoch, alive)
+        # 1) survivors checkpoint BEFORE touching the collective runtime
+        # (a crash inside the re-bootstrap must lose at most this step)
+        if self._last_completed >= 0:
+            self._save(self._last_completed, new)
+        # 2) publish the commit record BEFORE the blocking re-bootstrap:
+        # a rejoining rank waits on it (_await_join_commit) to enter the
+        # same rendezvous — publishing after would deadlock the join
+        self._publish_epoch(epoch, new.members)
+        # 3) tear down the old world's collective runtime
+        distributed = self._is_distributed()
+        if distributed:
+            (self._shutdown_fn or self._default_shutdown)()
+        # 4) re-bootstrap at the new world size
+        if distributed:
+            (self._bootstrap_fn or self._default_bootstrap)(new)
+        # 5) restore bit-exact and continue
+        if self._last_completed >= 0:
+            self._restore()
+        self.membership = new
+        telemetry.set_elastic_epoch(epoch)
+        telemetry.record_elastic_restart(len(joined))
+        rec = {"epoch": epoch, "left": left, "joined": joined,
+               "world_size": new.world_size,
+               "step": self._last_completed}
+        self.transitions.append(rec)
+        if self.on_epoch is not None:
+            self.on_epoch(new, rec)
+        return new
+
+    # -- the supervised loop -------------------------------------------
+    def run(self, step_fn: Callable, num_steps: int) -> List:
+        """Run ``step_fn(step, membership)`` for steps
+        ``[start_step, num_steps)`` under supervision: heartbeat thread
+        alive throughout, membership checked between steps (join/leave
+        triggers the epoch protocol), a bundle saved every
+        ``save_every`` completed steps (0 = only at epoch transitions).
+        Returns the list of ``step_fn`` results for the steps THIS
+        incarnation ran (a resumed worker returns the tail)."""
+        self.start()
+        results = []
+        try:
+            for step in range(self.start_step, int(num_steps)):
+                m = self.check_membership()
+                results.append(step_fn(step, m))
+                self._last_completed = step
+                if self.save_every > 0 and \
+                        (step + 1) % self.save_every == 0:
+                    self._save(step)
+        finally:
+            self.stop()
+        return results

@@ -644,6 +644,37 @@ def record_step_skipped(reason: str) -> None:
             ("reason",)).labels(reason).inc()
 
 
+def set_elastic_epoch(epoch: int) -> None:
+    """Current elastic membership epoch (parallel/elastic.py) — bumps
+    on every worker join/leave re-bootstrap."""
+    if not _state.enabled:
+        return
+    gauge("mxnet_elastic_membership_epoch",
+          "Elastic membership epoch (monotonic; one bump per worker "
+          "join/leave re-bootstrap).").set(int(epoch))
+
+
+def record_elastic_restart(n: int = 1) -> None:
+    """Worker restarts observed by the elastic runtime: a rank's own
+    rejoin-restore from a bundle, plus each sibling rejoin it
+    witnesses."""
+    if not _state.enabled or n <= 0:
+        return
+    counter("mxnet_elastic_worker_restarts_total",
+            "Worker restarts observed by the elastic runtime "
+            "(self rejoin-restores + witnessed sibling rejoins).").inc(n)
+
+
+def record_elastic_heartbeat_miss(rank) -> None:
+    """One rank declared dead by heartbeat expiry
+    (MXNET_ELASTIC_HEARTBEAT_TIMEOUT exceeded)."""
+    if not _state.enabled:
+        return
+    counter("mxnet_elastic_heartbeat_miss_total",
+            "Heartbeat expiries (rank declared dead) by missed rank.",
+            ("rank",)).labels(str(rank)).inc()
+
+
 def record_data_wait(seconds: float, stage: str = "device_feed") -> None:
     """Time the consumer blocked waiting on an input-pipeline stage.
 

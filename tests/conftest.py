@@ -70,6 +70,33 @@ def serving_leak_guard():
 
 
 @pytest.fixture(autouse=True)
+def elastic_leak_guard():
+    """Guard for the elastic runtime: a test that leaves an
+    ElasticRunner's heartbeat thread running would keep touching
+    heartbeat files (and pin the runner's net/trainer state) under
+    every later test. Fail the leaking test loudly; tests call stop()
+    in teardown or use the runner as a context manager."""
+    yield
+    import sys
+    import threading
+
+    mod = sys.modules.get("mxnet_tpu.parallel.elastic")
+    if mod is None:        # elastic never imported: nothing to leak
+        return
+    leaked = mod.live_runners()
+    strays = [t.name for t in threading.enumerate()
+              if t.name.startswith("mxnet-elastic-")]
+    if leaked or strays:
+        for r in leaked:
+            r.stop()
+        pytest.fail(
+            f"test left elastic heartbeat thread(s) running: "
+            f"{[r.launch_rank for r in leaked] or strays}; call "
+            "ElasticRunner.stop() in teardown or use it as a context "
+            "manager")
+
+
+@pytest.fixture(autouse=True)
 def fault_leak_guard():
     """Mirror of the telemetry guard for the fault injector: a test that
     leaves fault injection globally enabled would make every later test

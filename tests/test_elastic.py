@@ -1,0 +1,540 @@
+"""Elastic multi-host runtime tests (mxnet_tpu/parallel/elastic.py,
+tools/launch.py supervision, bounded kvstore barriers).
+
+The acceptance contract this file proves:
+
+* heartbeat expiry marks a rank dead (and only expiry — fresh ranks
+  stay members), counted by ``mxnet_elastic_heartbeat_miss_total``;
+* a membership epoch transition (checkpoint → teardown → re-bootstrap →
+  restore) is bit-exact: the loss trajectory with dead/rejoin epochs
+  forced mid-run is identical to an uninterrupted run, and the epoch id
+  lands in telemetry and the bundle tag;
+* a restarted worker resumes from its newest bundle (same trajectory as
+  never having died) — the ``tools/chaos_check.py`` elastic gate proves
+  the same through real SIGKILL + ``tools/launch.py --max-restarts``;
+* the launcher supervises: fail-fast SIGTERMs siblings within the
+  bounded window (even when they ignore SIGTERM), elastic mode restarts
+  with bounded backoff up to ``--max-restarts``, the first failing
+  rank's exit code propagates, and the exit report is structured;
+* ``KVStore.barrier`` / ``_barrier_before_exit`` are bounded: a dead
+  worker surfaces as a typed ``BarrierTimeoutError`` naming the site
+  and the missing ranks, never an unbounded hang.
+"""
+import importlib.util
+import os
+import signal
+import sys
+import time
+
+import numpy as np
+import pytest
+
+import mxnet_tpu as mx
+from mxnet_tpu import autograd, fault, gluon, telemetry
+from mxnet_tpu import kvstore as kv
+from mxnet_tpu.base import MXNetError
+from mxnet_tpu.gluon import nn
+from mxnet_tpu.kvstore.kvstore import _cross_process_barrier
+from mxnet_tpu.parallel import elastic
+
+pytestmark = pytest.mark.elastic
+
+REPO_ROOT = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+# ---------------------------------------------------------------------------
+# helpers
+# ---------------------------------------------------------------------------
+
+def _metric_value(name, **labels):
+    m = telemetry.snapshot()["metrics"].get(name)
+    if not m:
+        return 0.0
+    for s in m.get("samples", []):
+        if all(s.get("labels", {}).get(k) == v for k, v in labels.items()):
+            return s["value"]
+    return 0.0
+
+
+def make_model(seed=3):
+    mx.random.seed(seed)
+    net = nn.Dense(4, in_units=8)
+    net.initialize(mx.init.Xavier())
+    trainer = gluon.Trainer(net.collect_params(), "adam",
+                            {"learning_rate": 0.05}, kvstore="tpu_sync")
+    x = mx.nd.array(np.random.RandomState(0).randn(8, 8).astype(np.float32))
+    y = mx.nd.array(np.random.RandomState(1).randn(8, 4).astype(np.float32))
+    return net, trainer, x, y
+
+
+def make_step_fn(net, trainer, x, y):
+    def step_fn(step, membership):
+        with autograd.record():
+            loss = ((net(x) - y) ** 2).mean()
+        loss.backward()
+        trainer.step(8)
+        return float(loss.asnumpy())
+    return step_fn
+
+
+def weights_of(net):
+    return {name: p.data().asnumpy()
+            for name, p in net._collect_params_with_prefix().items()}
+
+
+def plain_run(steps, seed=3):
+    """The oracle: the same training loop with no runner at all."""
+    net, trainer, x, y = make_model(seed)
+    fn = make_step_fn(net, trainer, x, y)
+    return [fn(s, None) for s in range(steps)], net
+
+
+# ---------------------------------------------------------------------------
+# heartbeat board + membership
+# ---------------------------------------------------------------------------
+
+class TestHeartbeatBoard:
+    def test_register_touch_alive(self, tmp_path):
+        board = elastic.HeartbeatBoard(str(tmp_path))
+        board.register(0)
+        board.register(3, extra={"note": "hi"})
+        assert board.alive(timeout=60.0) == [0, 3]
+        info = board.read(3)
+        assert info["rank"] == 3 and info["pid"] == os.getpid()
+        assert info["note"] == "hi" and info["host"]
+
+    def test_stale_rank_expires(self, tmp_path):
+        board = elastic.HeartbeatBoard(str(tmp_path))
+        board.register(0)
+        board.register(1)
+        old = time.time() - 100.0
+        os.utime(board.path(1), (old, old))
+        assert board.alive(timeout=5.0) == [0]
+        board.touch(1)          # a touch resurrects it
+        assert board.alive(timeout=5.0) == [0, 1]
+
+    def test_read_corrupt_file_is_empty_dict(self, tmp_path):
+        board = elastic.HeartbeatBoard(str(tmp_path))
+        with open(board.path(2), "w") as f:
+            f.write("{not json")
+        assert board.read(2) == {}
+        assert board.read(9) == {}   # never registered
+
+
+class TestMembership:
+    def test_dense_rank_over_survivors(self):
+        m = elastic.Membership(epoch=2, rank=1, world_size=2,
+                               members=(0, 3), launch_rank=3)
+        assert m.owns(1) and not m.owns(0)
+        assert list(m.shard_indices(6)) == [1, 3, 5]
+
+    def test_shard_reassignment_covers_stream(self):
+        # every sample has exactly one owner at every membership
+        for members in [(0, 1, 2), (0, 2), (2,)]:
+            owners = []
+            for dense, launch in enumerate(members):
+                m = elastic.Membership(epoch=1, rank=dense,
+                                       world_size=len(members),
+                                       members=members,
+                                       launch_rank=launch)
+                owners.append({i for i in range(12) if m.owns(i)})
+            assert set().union(*owners) == set(range(12))
+            assert sum(len(o) for o in owners) == 12
+
+
+# ---------------------------------------------------------------------------
+# ElasticRunner — supervised loop, rejoin, epoch protocol
+# ---------------------------------------------------------------------------
+
+class TestElasticRunner:
+    def test_run_saves_and_stops_heartbeat(self, tmp_path):
+        net, trainer, x, y = make_model()
+        runner = elastic.ElasticRunner(
+            str(tmp_path), params=net, trainer=trainer, world_size=1,
+            rank=0, save_every=2, heartbeat_interval=0.05)
+        losses = runner.run(make_step_fn(net, trainer, x, y), 4)
+        assert len(losses) == 4
+        assert not runner.heartbeat_running()
+        assert elastic.live_runners() == []
+        # bundles at steps 1 and 3, tagged with the elastic epoch
+        assert runner.ckpt.steps() == [3, 1]
+        tag = runner.ckpt.load(3)["extra"]["elastic"]
+        assert tag["epoch"] == 0 and tag["members"] == [0]
+
+    def test_rejoin_resumes_bit_exact(self, tmp_path):
+        full_losses, full_net = plain_run(8)
+        # first incarnation: 4 steps, bundle per step, then "dies"
+        net, trainer, x, y = make_model()
+        r1 = elastic.ElasticRunner(
+            str(tmp_path), params=net, trainer=trainer, world_size=1,
+            rank=0, save_every=1, heartbeat_interval=0.05)
+        head = r1.run(make_step_fn(net, trainer, x, y), 4)
+        # restarted incarnation: WRONG init on purpose; restore must win
+        net2, trainer2, x2, y2 = make_model(seed=99)
+        telemetry.enable()
+        try:
+            restarts0 = _metric_value("mxnet_elastic_worker_restarts_total")
+            r2 = elastic.ElasticRunner(
+                str(tmp_path), params=net2, trainer=trainer2,
+                world_size=1, rank=0, save_every=1,
+                heartbeat_interval=0.05)
+            r2.start()
+            assert r2.resumed_from == 3 and r2.start_step == 4
+            assert _metric_value(
+                "mxnet_elastic_worker_restarts_total") == restarts0 + 1
+            tail = r2.run(make_step_fn(net2, trainer2, x2, y2), 8)
+        finally:
+            telemetry.disable()
+        assert head + tail == full_losses
+        full_w, resumed_w = weights_of(full_net), weights_of(net2)
+        assert all(np.array_equal(v, resumed_w[k])
+                   for k, v in full_w.items())
+
+    def test_epoch_transitions_dead_then_rejoin_bit_exact(self, tmp_path):
+        baseline, baseline_net = plain_run(8)
+        net, trainer, x, y = make_model()
+        board = elastic.HeartbeatBoard(str(tmp_path))
+        sib = board.register(1)
+        future = time.time() + 1e6
+        os.utime(sib, (future, future))       # sibling "alive"
+        calls = []
+        events = []
+        runner = elastic.ElasticRunner(
+            str(tmp_path), params=net, trainer=trainer, world_size=2,
+            rank=0, heartbeat_interval=0.05, heartbeat_timeout=1.0,
+            join_timeout=0.2, distributed=True,
+            bootstrap_fn=lambda m: calls.append(("boot", m.world_size,
+                                                 m.rank)),
+            shutdown_fn=lambda: calls.append(("down",)),
+            on_epoch=lambda m, rec: events.append(rec))
+        inner = make_step_fn(net, trainer, x, y)
+
+        def step_fn(step, m):
+            out = inner(step, m)
+            if step == 3:       # sibling dies...
+                old = time.time() - 100.0
+                os.utime(sib, (old, old))
+            elif step == 5:     # ...and rejoins (fresh registration;
+                board.register(1)   # pinned future-fresh: a real worker
+                os.utime(sib, (future, future))  # would keep touching)
+            return out
+
+        telemetry.enable()
+        try:
+            losses = runner.run(step_fn, 8)
+            epoch_gauge = _metric_value("mxnet_elastic_membership_epoch")
+            miss = _metric_value("mxnet_elastic_heartbeat_miss_total",
+                                 rank="1")
+        finally:
+            telemetry.disable()
+        # two transitions: rank 1 left (world 2->1), then rejoined (1->2)
+        assert [e["left"] for e in events] == [[1], []]
+        assert [e["joined"] for e in events] == [[], [1]]
+        assert [e["world_size"] for e in events] == [1, 2]
+        assert [e["epoch"] for e in events] == [1, 2]
+        assert epoch_gauge == 2.0 and miss == 1.0
+        # teardown before re-bootstrap, at the right world sizes/ranks
+        assert calls == [("down",), ("boot", 1, 0),
+                         ("down",), ("boot", 2, 0)]
+        # the whole point: epochs cost NOTHING numerically
+        assert losses == baseline
+        base_w, w = weights_of(baseline_net), weights_of(net)
+        assert all(np.array_equal(v, w[k]) for k, v in base_w.items())
+        # the transition bundle carries the new epoch + member set
+        tag = runner.ckpt.load()["extra"]["elastic"]
+        assert tag["epoch"] in (1, 2) and 0 in tag["members"]
+
+    def test_degraded_world_reassigns_shards(self, tmp_path):
+        net, trainer, x, y = make_model()
+        board = elastic.HeartbeatBoard(str(tmp_path))
+        sib = board.register(1)
+        future = time.time() + 1e6
+        os.utime(sib, (future, future))
+        runner = elastic.ElasticRunner(
+            str(tmp_path), params=net, trainer=trainer, world_size=2,
+            rank=0, heartbeat_interval=0.05, heartbeat_timeout=1.0,
+            join_timeout=0.2, distributed=False)
+        seen = []
+
+        def step_fn(step, m):
+            seen.append((m.world_size, list(m.shard_indices(4))))
+            if step == 1:
+                old = time.time() - 100.0
+                os.utime(sib, (old, old))
+            return 0.0
+
+        runner.run(step_fn, 4)
+        # world 2: rank 0 owns [0, 2]; degraded world 1: owns all
+        assert seen[0] == (2, [0, 2])
+        assert seen[-1] == (1, [0, 1, 2, 3])
+
+    def test_distributed_rejoin_handshake(self, tmp_path):
+        """A restarted rank in REAL distributed mode must enter the
+        SAME re-bootstrap rendezvous the survivors opened for its join:
+        it waits for a committed membership that names it (the epoch
+        record published before the survivors' blocking bootstrap) and
+        bootstraps at that epoch — same epoch, same coordinator port."""
+        import json as _json
+
+        from mxnet_tpu.checkpoint import atomic_write
+
+        net, trainer, x, y = make_model()
+        r1 = elastic.ElasticRunner(
+            str(tmp_path), params=net, trainer=trainer, world_size=2,
+            rank=1, save_every=1, heartbeat_interval=0.05,
+            heartbeat_timeout=1.0, join_timeout=0.1, distributed=False)
+        r1.run(make_step_fn(net, trainer, x, y), 2)   # bundles @ epoch 0
+        # fake the survivor (rank 0) having committed the join at epoch 3
+        board = elastic.HeartbeatBoard(str(tmp_path))
+        sib = board.register(0)
+        os.utime(sib, (time.time() + 1e6,) * 2)
+        # a stray fresh heartbeat NOT in the committed membership: the
+        # rejoiner must adopt the COMMITTED set, not its alive snapshot
+        # (a world-size disagreement would wedge the rendezvous)
+        stray = board.register(7)
+        os.utime(stray, (time.time() + 1e6,) * 2)
+        atomic_write(os.path.join(str(tmp_path), "EPOCH"), _json.dumps(
+            {"epoch": 3, "members": [0, 1]}).encode("utf-8"))
+        boots = []
+        net2, trainer2, _, _ = make_model(seed=9)
+        r2 = elastic.ElasticRunner(
+            str(tmp_path), params=net2, trainer=trainer2, world_size=2,
+            rank=1, heartbeat_interval=0.05, heartbeat_timeout=5.0,
+            join_timeout=1.0, distributed=True,
+            bootstrap_fn=lambda m: boots.append(
+                (m.epoch, m.world_size, m.rank)),
+            shutdown_fn=lambda: None)
+        r2.start()
+        try:
+            assert r2.resumed_from == 1
+            assert boots == [(3, 2, 1)]
+            assert r2.membership.members == (0, 1)
+        finally:
+            r2.stop()
+
+    def test_heartbeat_fault_site_retried(self, tmp_path):
+        runner = elastic.ElasticRunner(str(tmp_path), world_size=1,
+                                       rank=0)
+        runner.board.register(0)
+        with fault.inject("elastic.heartbeat=once") as stats:
+            runner.heartbeat()     # first touch fails, retry wins
+            assert stats()["elastic.heartbeat"]["injected"] == 1
+
+    def test_rejoin_fault_site_retried(self, tmp_path):
+        net, trainer, x, y = make_model()
+        runner = elastic.ElasticRunner(
+            str(tmp_path), params=net, trainer=trainer, world_size=1,
+            rank=0, save_every=1, heartbeat_interval=0.05)
+        runner.run(make_step_fn(net, trainer, x, y), 2)
+        with fault.inject("elastic.rejoin=once") as stats:
+            meta = runner._restore()
+            assert stats()["elastic.rejoin"]["injected"] == 1
+        assert meta["step"] == 1
+
+    def test_context_manager_and_validation(self, tmp_path):
+        with pytest.raises(MXNetError, match="rank"):
+            elastic.ElasticRunner(str(tmp_path), world_size=2, rank=5)
+        with pytest.raises(MXNetError, match="interval"):
+            elastic.ElasticRunner(str(tmp_path), world_size=1, rank=0,
+                                  heartbeat_interval=0.0)
+        with elastic.ElasticRunner(str(tmp_path), world_size=1,
+                                   rank=0) as r:
+            assert r.heartbeat_running()
+            assert elastic.live_runners() == [r]
+        assert not r.heartbeat_running()
+
+
+# ---------------------------------------------------------------------------
+# bounded barriers
+# ---------------------------------------------------------------------------
+
+class TestBoundedBarrier:
+    def test_local_barrier_timeout_names_site(self, monkeypatch):
+        import mxnet_tpu.ndarray as ndmod
+
+        monkeypatch.setattr(ndmod, "waitall", lambda: time.sleep(1.0))
+        store = kv.create("local")
+        with pytest.raises(kv.BarrierTimeoutError,
+                           match=r"kvstore\.barrier\[exit\]"):
+            store.barrier(site="exit", timeout=0.1)
+
+    def test_timeout_env_knob(self, monkeypatch):
+        import mxnet_tpu.ndarray as ndmod
+
+        monkeypatch.setattr(ndmod, "waitall", lambda: time.sleep(1.0))
+        monkeypatch.setenv("MXNET_KV_BARRIER_TIMEOUT", "0.1")
+        store = kv.create("tpu_sync")
+        with pytest.raises(kv.BarrierTimeoutError,
+                           match="MXNET_KV_BARRIER_TIMEOUT"):
+            store.barrier()
+
+    def test_unbounded_optout_and_clean_pass(self, monkeypatch):
+        store = kv.create("tpu_sync")
+        store.barrier()                      # drains instantly: passes
+        monkeypatch.setenv("MXNET_KV_BARRIER_TIMEOUT", "0")
+        store.barrier(site="legacy")         # <= 0: unbounded path
+
+    def test_cross_process_barrier_rendezvous(self):
+        class Stub:
+            def __init__(self):
+                self.d = {}
+
+            def key_value_set(self, k, v):
+                if k in self.d:
+                    raise RuntimeError(f"ALREADY_EXISTS: {k}")
+                self.d[k] = v
+
+            def key_value_dir_get(self, p):
+                return [(k, v) for k, v in self.d.items()
+                        if k.startswith(p)]
+
+        c = Stub()
+        c.key_value_set("mxnet_tpu/barrier/step/1/1", "1")
+        assert _cross_process_barrier(c, "step", 1, 0, 2,
+                                      timeout=1.0) == [0, 1]
+        # re-announcing our own key (a retried attempt) is not an error
+        assert _cross_process_barrier(c, "step", 1, 0, 2,
+                                      timeout=1.0) == [0, 1]
+
+    def test_cross_process_barrier_names_missing_ranks(self):
+        class Stub:
+            def __init__(self):
+                self.d = {}
+
+            def key_value_set(self, k, v):
+                self.d[k] = v
+
+            def key_value_dir_get(self, p):
+                return [(k, v) for k, v in self.d.items()
+                        if k.startswith(p)]
+
+        with pytest.raises(kv.BarrierTimeoutError) as ei:
+            _cross_process_barrier(Stub(), "exit", 4, 0, 3, timeout=0.15)
+        msg = str(ei.value)
+        assert "kvstore.barrier[exit]" in msg
+        assert "missing ranks [1, 2]" in msg and "arrived: [0]" in msg
+
+    def test_barrier_fault_site(self):
+        store = kv.create("tpu_sync")
+        with fault.inject("kvstore.barrier=once"):
+            with pytest.raises(fault.FaultInjected):
+                store.barrier()
+        store.barrier()
+
+    def test_exit_barrier_never_wedges_or_raises(self, monkeypatch):
+        store = kv.create("local")
+        assert store._barrier_before_exit() is True
+        import mxnet_tpu.ndarray as ndmod
+
+        monkeypatch.setattr(ndmod, "waitall", lambda: time.sleep(1.0))
+        monkeypatch.setenv("MXNET_KV_EXIT_BARRIER_TIMEOUT", "0.1")
+        t0 = time.monotonic()
+        with pytest.warns(RuntimeWarning, match="exit barrier"):
+            assert store._barrier_before_exit() is False
+        assert time.monotonic() - t0 < 5.0
+
+
+# ---------------------------------------------------------------------------
+# tools/launch.py supervision (subprocess smoke workers — no jax import)
+# ---------------------------------------------------------------------------
+
+def _launch_mod():
+    spec = importlib.util.spec_from_file_location(
+        "mxnet_tpu_test_launch",
+        os.path.join(REPO_ROOT, "tools", "launch.py"))
+    mod = importlib.util.module_from_spec(spec)
+    spec.loader.exec_module(mod)
+    return mod
+
+
+def _run_launch(tmp_path, worker_src, n=2, extra_args=()):
+    mod = _launch_mod()
+    script = tmp_path / "worker.py"
+    script.write_text(worker_src)
+    report = tmp_path / "report.json"
+    rc = mod.main(["-n", str(n), "--poll-interval", "0.02",
+                   "--report", str(report),
+                   "--coord-dir", str(tmp_path / "coord"),
+                   *extra_args, "--", sys.executable, str(script)])
+    import json
+
+    with open(report) as f:
+        return rc, json.load(f)
+
+
+class TestLauncherSupervision:
+    def test_clean_run_exits_zero(self, tmp_path):
+        rc, rep = _run_launch(tmp_path, "import sys; sys.exit(0)\n")
+        assert rc == 0 and rep["rc"] == 0
+        assert all(w["final"] == 0 and w["restarts"] == 0
+                   for w in rep["workers"])
+
+    def test_fail_fast_terminates_siblings_and_propagates(self, tmp_path):
+        src = (
+            "import os, sys, time\n"
+            "if os.environ['DMLC_WORKER_ID'] == '1':\n"
+            "    time.sleep(0.1); sys.exit(7)\n"
+            "time.sleep(60)\n")
+        t0 = time.monotonic()
+        rc, rep = _run_launch(tmp_path, src,
+                              extra_args=["--term-window", "2"])
+        assert rc == 7
+        assert time.monotonic() - t0 < 30
+        by_rank = {w["rank"]: w for w in rep["workers"]}
+        assert by_rank[1]["final"] == 7
+        assert by_rank[0]["exits"][-1]["signal"] == "SIGTERM"
+        assert rep["mode"] == "fail_fast"
+
+    def test_dead_worker_never_wedges_even_ignoring_sigterm(self, tmp_path):
+        # rank 0 simulates "stuck in a dead collective": SIGTERM ignored
+        src = (
+            "import os, signal, sys, time\n"
+            "if os.environ['DMLC_WORKER_ID'] == '0':\n"
+            "    signal.signal(signal.SIGTERM, signal.SIG_IGN)\n"
+            "    time.sleep(60)\n"
+            "time.sleep(0.1); sys.exit(9)\n")
+        t0 = time.monotonic()
+        rc, rep = _run_launch(tmp_path, src,
+                              extra_args=["--term-window", "0.5"])
+        assert rc == 9
+        assert time.monotonic() - t0 < 30       # SIGKILL escalation won
+        by_rank = {w["rank"]: w for w in rep["workers"]}
+        assert by_rank[0]["exits"][-1]["signal"] == "SIGKILL"
+
+    def test_elastic_restart_with_backoff(self, tmp_path):
+        # every rank fails its first incarnation, succeeds after restart
+        src = (
+            "import os, sys\n"
+            "m = os.path.join(os.environ['MXNET_ELASTIC_COORD_DIR'],\n"
+            "                 'm-' + os.environ['DMLC_WORKER_ID'])\n"
+            "assert os.environ['MXNET_ELASTIC_RESTART'] == \\\n"
+            "    ('1' if os.path.exists(m) else '0')\n"
+            "if not os.path.exists(m):\n"
+            "    open(m, 'w').close(); sys.exit(3)\n"
+            "sys.exit(0)\n")
+        rc, rep = _run_launch(
+            tmp_path, src,
+            extra_args=["--max-restarts", "2",
+                        "--restart-backoff", "0.05"])
+        assert rc == 0 and rep["mode"] == "elastic"
+        assert all(w["restarts"] == 1 and w["final"] == 0
+                   for w in rep["workers"])
+        assert all(w["exits"][0]["exit_code"] == 3
+                   for w in rep["workers"])
+
+    def test_restart_budget_exhausted_propagates_code(self, tmp_path):
+        src = "import sys; sys.exit(5)\n"
+        rc, rep = _run_launch(
+            tmp_path, src, n=1,
+            extra_args=["--max-restarts", "1",
+                        "--restart-backoff", "0.05"])
+        assert rc == 5
+        w = rep["workers"][0]
+        assert w["restarts"] == 1 and len(w["exits"]) == 2
+
+    def test_signal_death_maps_to_128_plus_signum(self, tmp_path):
+        src = ("import os, signal\n"
+               "os.kill(os.getpid(), signal.SIGKILL)\n")
+        rc, rep = _run_launch(tmp_path, src, n=1)
+        assert rc == 128 + int(signal.SIGKILL)
+        assert rep["workers"][0]["exits"][0]["signal"] == "SIGKILL"

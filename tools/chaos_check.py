@@ -12,10 +12,19 @@ save is repeated, the run "crashes", and a fresh model resumes from the
 bundle — the resumed tail must match the uninterrupted run bit-for-bit
 (params + optimizer counters + RNG stream).
 
+The ELASTIC gate (ISSUE 8) runs the same contract through real process
+supervision: 2 workers under ``tools/launch.py --max-restarts 1``, one
+SIGKILLed mid-step (after backward, before the optimizer step), the
+supervisor restarts it, ``ElasticRunner`` resumes from the newest
+bundle — and every rank's loss trajectory (the survivor's THROUGH its
+membership-epoch transitions, the victim's resumed tail) must be
+bit-identical to an uninterrupted 2-worker run.
+
   python tools/chaos_check.py                 # default spec/steps
   python tools/chaos_check.py --steps 40 --seed 11 \
       --spec 'kvstore.push=every:7;kvstore.allreduce=p:0.1' \
       --json /tmp/chaos.json
+  python tools/chaos_check.py --skip-elastic  # in-process gates only
 
 Exit code 0 = all gates pass. Runs on the CPU oracle mesh
 (JAX_PLATFORMS=cpu; the fake cluster flag is set below if absent).
@@ -122,6 +131,195 @@ def weights_of(net):
             for name, p in net._collect_params_with_prefix().items()}
 
 
+# ---------------------------------------------------------------------------
+# elastic gate: SIGKILL a worker mid-step under the supervised launcher,
+# verify bit-exact rejoin from the newest CheckpointManager bundle.
+# ---------------------------------------------------------------------------
+
+_REPO_ROOT = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+_ELASTIC_WORKER = r'''
+import json, os, signal, sys, time
+sys.path.insert(0, os.environ["MXNET_REPO_ROOT"])
+os.environ.setdefault("JAX_PLATFORMS", "cpu")
+os.environ["XLA_FLAGS"] = "--xla_force_host_platform_device_count=1"
+
+import numpy as np
+import mxnet_tpu as mx
+from mxnet_tpu import autograd, gluon
+from mxnet_tpu.gluon import nn, loss as gloss
+from mxnet_tpu.parallel import elastic
+
+rank = int(os.environ["DMLC_WORKER_ID"])
+coord = os.environ["MXNET_ELASTIC_COORD_DIR"]
+steps = int(os.environ["ELASTIC_STEPS"])
+kill_at = int(os.environ.get("ELASTIC_KILL_AT", "-1"))
+kill_rank = int(os.environ.get("ELASTIC_KILL_RANK", "-1"))
+incarnation = os.environ.get("MXNET_ELASTIC_RESTART", "0")
+step_sleep = float(os.environ.get("ELASTIC_STEP_SLEEP", "0.12"))
+
+mx.random.seed(1234 + rank)
+net = nn.HybridSequential()
+net.add(nn.Dense(32, in_units=64, activation="relu"))
+net.add(nn.Dense(10, in_units=32))
+net.initialize(mx.init.Xavier())
+trainer = gluon.Trainer(net.collect_params(), "adam",
+                        {"learning_rate": 0.01}, kvstore="device")
+loss_fn = gloss.SoftmaxCrossEntropyLoss()
+rs = np.random.RandomState(100 + rank)    # private: never touch mx.random
+x = rs.randn(128, 64).astype(np.float32)
+y = rs.randint(0, 10, size=(128,)).astype(np.int32)
+
+runner = elastic.ElasticRunner(
+    coord, params=net, trainer=trainer, save_every=1,
+    heartbeat_interval=0.25, heartbeat_timeout=1.5, join_timeout=5.0,
+    on_epoch=lambda m, rec: print(
+        "ELASTIC_EPOCH %d %d left=%s joined=%s"
+        % (rank, rec["epoch"], rec["left"], rec["joined"]), flush=True))
+
+
+def step_fn(step, m):
+    lo = (step * 32) % 128
+    xb = mx.nd.array(x[lo:lo + 32])
+    yb = mx.nd.array(y[lo:lo + 32])
+    with autograd.record():
+        loss = loss_fn(net(xb), yb).mean()
+    loss.backward()
+    if rank == kill_rank and step == kill_at and incarnation == "0":
+        os.kill(os.getpid(), signal.SIGKILL)   # die MID-step
+    trainer.step(32)
+    time.sleep(step_sleep)
+    return float(loss.asnumpy())
+
+
+runner.start()
+if runner.resumed_from is not None:
+    print("ELASTIC_RESUME %d %d" % (rank, runner.start_step), flush=True)
+losses = runner.run(step_fn, steps)
+out = os.path.join(coord, "losses-r%d-i%s.json" % (rank, incarnation))
+with open(out, "w") as f:
+    json.dump({"start": runner.start_step, "losses": losses}, f)
+print("ELASTIC_OK %d" % rank, flush=True)
+'''
+
+
+def _launch_elastic(workdir, steps, kill_at=-1, kill_rank=-1,
+                    max_restarts=0):
+    """One supervised 2-worker run; returns (rc, stdout, report, coord)."""
+    import subprocess
+
+    coord = os.path.join(workdir, "coord")
+    report = os.path.join(workdir, "report.json")
+    worker = os.path.join(workdir, "worker.py")
+    with open(worker, "w") as f:
+        f.write(_ELASTIC_WORKER)
+    env = dict(os.environ,
+               MXNET_REPO_ROOT=_REPO_ROOT,
+               ELASTIC_STEPS=str(steps),
+               ELASTIC_KILL_AT=str(kill_at),
+               ELASTIC_KILL_RANK=str(kill_rank))
+    for k in ("DMLC_PS_ROOT_URI", "DMLC_PS_ROOT_PORT",
+              "DMLC_NUM_WORKER", "DMLC_WORKER_ID", "DMLC_ROLE",
+              "MXNET_FAULT_SPEC"):
+        env.pop(k, None)
+    try:
+        out = subprocess.run(
+            [sys.executable,
+             os.path.join(_REPO_ROOT, "tools", "launch.py"),
+             "-n", "2", "--poll-interval", "0.05",
+             "--max-restarts", str(max_restarts),
+             "--restart-backoff", "0.5", "--term-window", "5",
+             "--coord-dir", coord, "--report", report,
+             "--", sys.executable, worker],
+            env=env, capture_output=True, text=True, timeout=300)
+        rc, text = out.returncode, out.stdout + out.stderr
+    except subprocess.TimeoutExpired as e:
+        rc = -1
+        text = ((e.stdout or "") + (e.stderr or "")
+                if isinstance(e.stdout, str) or isinstance(e.stderr, str)
+                else "") + "\n[chaos] launcher run timed out"
+    # a launcher that died early leaves no report — the gate must FAIL
+    # with the captured output, not crash with FileNotFoundError
+    try:
+        with open(report) as f:
+            rep = json.load(f)
+    except (OSError, ValueError):
+        rep = {"rc": rc, "workers": []}
+    return rc, text, rep, coord
+
+
+def _read_losses(coord, rank, incarnation):
+    with open(os.path.join(
+            coord, f"losses-r{rank}-i{incarnation}.json")) as f:
+        return json.load(f)
+
+
+def elastic_gate(summary, steps=30, kill_at=6):
+    """SIGKILL rank 1 mid-step under ``launch.py --max-restarts 1``; the
+    restarted rank must resume from the newest bundle and every rank's
+    final loss must be bit-identical to an uninterrupted 2-worker run."""
+    workdir = tempfile.mkdtemp(prefix="chaos_elastic_")
+    try:
+        a_dir = os.path.join(workdir, "a")
+        b_dir = os.path.join(workdir, "b")
+        os.makedirs(a_dir)
+        os.makedirs(b_dir)
+        rc_a, out_a, rep_a, coord_a = _launch_elastic(a_dir, steps)
+        print(f"[chaos] elastic baseline: rc {rc_a}, restarts "
+              f"{[w['restarts'] for w in rep_a['workers']]}")
+        rc_b, out_b, rep_b, coord_b = _launch_elastic(
+            b_dir, steps, kill_at=kill_at, kill_rank=1, max_restarts=1)
+        by_rank = {w["rank"]: w for w in rep_b["workers"]}
+        w1 = by_rank.get(1, {"restarts": 0, "exits": []})
+        print(f"[chaos] elastic kill run: rc {rc_b}, rank 1 restarts "
+              f"{w1['restarts']}, rank 1 exits "
+              f"{[e['signal'] or e['exit_code'] for e in w1['exits']]}")
+
+        checks = {}
+        checks["both_runs_clean"] = rc_a == 0 and rc_b == 0
+        checks["victim_sigkilled_once"] = (
+            w1["restarts"] == 1 and bool(w1["exits"])
+            and w1["exits"][0].get("signal") == "SIGKILL")
+        resumed = f"ELASTIC_RESUME 1 {kill_at}" in out_b
+        checks["resumed_from_newest_bundle"] = resumed
+        checks["survivor_saw_epoch_transition"] = \
+            "ELASTIC_EPOCH 0 " in out_b
+
+        final_a = final_b = None
+        try:
+            a0 = _read_losses(coord_a, 0, "0")
+            b0 = _read_losses(coord_b, 0, "0")
+            checks["survivor_bit_identical"] = \
+                a0["losses"] == b0["losses"]
+            a1 = _read_losses(coord_a, 1, "0")
+            b1 = _read_losses(coord_b, 1, "1")     # resumed incarnation
+            checks["victim_tail_bit_identical"] = (
+                b1["start"] == kill_at
+                and b1["losses"] == a1["losses"][b1["start"]:])
+            checks["final_loss_bit_identical"] = \
+                b1["losses"][-1] == a1["losses"][-1]
+            final_a, final_b = a1["losses"][-1], b1["losses"][-1]
+        except (OSError, ValueError, IndexError, KeyError) as e:
+            # a worker that never wrote its losses file = gate FAIL
+            # with diagnostics, not a chaos_check crash
+            checks["loss_files_complete"] = False
+            print(f"[chaos]   elastic loss files incomplete: {e}")
+
+        ok = all(checks.values())
+        summary["gates"]["elastic_rejoin_bit_exact"] = {
+            "pass": ok, "checks": checks,
+            "final_loss_uninterrupted": final_a,
+            "final_loss_rejoined": final_b}
+        for name, v in checks.items():
+            print(f"[chaos]   elastic {name}: {v}")
+        if not ok:
+            tail = "\n".join(out_b.splitlines()[-30:])
+            print(f"[chaos] elastic kill-run tail:\n{tail}")
+        return ok
+    finally:
+        shutil.rmtree(workdir, ignore_errors=True)
+
+
 def main():
     ap = argparse.ArgumentParser(description=__doc__.split("\n")[0])
     ap.add_argument("--steps", type=int, default=24)
@@ -130,6 +328,9 @@ def main():
                     help="fault spec for the chaos run (all-retryable)")
     ap.add_argument("--json", default=None,
                     help="write the result summary to this path")
+    ap.add_argument("--skip-elastic", action="store_true",
+                    help="skip the subprocess elastic gate (launch.py "
+                    "SIGKILL + rejoin)")
     args = ap.parse_args()
 
     import numpy as np
@@ -198,6 +399,10 @@ def main():
         ok = ok and tail_equal and resumed_weights_equal
     finally:
         shutil.rmtree(ckpt_dir, ignore_errors=True)
+
+    # -- gate 4: SIGKILL a worker mid-step, supervised rejoin ----------
+    if not args.skip_elastic:
+        ok = elastic_gate(summary) and ok
 
     retry_counters = {}
     for s in telemetry.snapshot()["metrics"].get(

@@ -1,12 +1,29 @@
 #!/usr/bin/env python
-"""Multi-process launcher (reference: ``tools/launch.py`` + dmlc_tracker).
+"""Supervised multi-process launcher (reference: ``tools/launch.py`` +
+dmlc_tracker, whose tracker restarted dead ps-lite nodes).
 
-The reference starts a parameter-server tracker plus ssh/mpi workers. The
-TPU-native cluster is a multi-controller JAX job: every process runs the
-same program, rendezvouses through the coordination service, and XLA
-collectives ride ICI/DCN — so the launcher's whole job is to export the
-rendezvous env contract (SURVEY.md §5.6.4, the same DMLC_* names the
-reference's trainers already read) and fan out the command.
+The TPU-native cluster is a multi-controller JAX job: every process runs
+the same program, rendezvouses through the coordination service, and XLA
+collectives ride ICI/DCN — so the launcher exports the rendezvous env
+contract (SURVEY.md §5.6.4, the same DMLC_* names the reference's
+trainers already read), fans out the command, and then **supervises**:
+
+* **Poll-based wait.** All workers are polled together (never a serial
+  ``p.wait()`` on rank 0 while rank 3 is already dead and its siblings
+  hang in a collective).
+* **Fail-fast mode** (default, ``--max-restarts 0``): the first worker
+  to exit non-zero SIGTERMs the rest, escalating to SIGKILL after
+  ``--term-window`` seconds, and the launcher exits with the first
+  failing rank's code (signal deaths map to ``128+signum``).
+* **Elastic mode** (``--max-restarts N``): a dead rank is respawned with
+  the same ``DMLC_WORKER_ID`` after a bounded exponential backoff
+  (``--restart-backoff``, doubling per restart of that rank, capped at
+  30 s), up to N times per rank; workers built on
+  ``mxnet_tpu.parallel.elastic.ElasticRunner`` resume bit-exactly from
+  their newest checkpoint bundle. Exhausted restarts fall back to
+  fail-fast.
+* **Structured exit report.** A per-worker table (rank, restarts, every
+  exit code/signal) on stdout and, with ``--report PATH``, as JSON.
 
 Local mode (this machine, -n workers; smoke tests / 1 host with N chips):
 
@@ -15,27 +32,198 @@ Local mode (this machine, -n workers; smoke tests / 1 host with N chips):
 Multi-host mode (-H hostfile, one line per host; requires passwordless
 ssh, mirroring the reference's ssh launcher):
 
-    python tools/launch.py -n 8 -H hosts python train.py
+    python tools/launch.py -n 8 -H hosts --max-restarts 2 python train.py
+
+Caveat (shared with the reference's ssh launcher): signals reach the
+LOCAL ssh client, not the remote python — a fail-fast teardown or
+restart of an ssh-mode rank can orphan the remote process. Remote
+workers should run under the elastic runtime so an orphan is fenced by
+its own heartbeat/barrier timeouts; for hard kill guarantees use a
+per-host supervisor (one local launch.py per host) instead of ssh mode.
 
 Workers read: DMLC_PS_ROOT_URI / DMLC_PS_ROOT_PORT (coordinator address),
 DMLC_NUM_WORKER, DMLC_WORKER_ID — ``mxnet_tpu.kvstore.create('dist_sync')``
-bootstraps ``jax.distributed`` from exactly these.
+bootstraps ``jax.distributed`` from exactly these — plus
+MXNET_ELASTIC_COORD_DIR (the ElasticRunner heartbeat/epoch directory)
+and MXNET_ELASTIC_RESTART (this incarnation's restart count).
 """
 from __future__ import annotations
 
 import argparse
+import json
 import os
 import shlex
 import signal
 import socket
 import subprocess
 import sys
+import tempfile
+import time
+
+_BACKOFF_CAP_S = 30.0
 
 
 def _free_port():
     with socket.socket() as s:
         s.bind(("", 0))
         return s.getsockname()[1]
+
+
+def _signal_name(signum: int) -> str:
+    try:
+        return signal.Signals(signum).name
+    except ValueError:
+        return f"signal {signum}"
+
+
+def _exit_code(returncode: int) -> int:
+    """Shell convention: a signal death (Popen returncode -N) is 128+N."""
+    return 128 - returncode if returncode < 0 else returncode
+
+
+class _Worker:
+    """One rank's supervision record: how to (re)spawn it, the live
+    process handle, and the full exit history for the report."""
+
+    def __init__(self, rank: int, spawn):
+        self.rank = rank
+        self._spawn = spawn
+        self.proc: subprocess.Popen | None = None
+        self.restarts = 0
+        self.exits: list[dict] = []
+        self.done = False          # exited 0 — never restarted
+        self.restart_at: float | None = None   # pending respawn time
+
+    def spawn(self):
+        self.proc = self._spawn(self.rank, self.restarts)
+        self.restart_at = None
+
+    def poll(self):
+        """Returncode if the live process has exited, else None."""
+        return self.proc.poll() if self.proc is not None else None
+
+    def record_exit(self, returncode: int):
+        self.exits.append({"returncode": returncode,
+                           "exit_code": _exit_code(returncode),
+                           "signal": _signal_name(-returncode)
+                           if returncode < 0 else None,
+                           "time_unix": time.time()})
+        self.proc = None
+
+    def report(self) -> dict:
+        return {"rank": self.rank, "restarts": self.restarts,
+                "done": self.done, "exits": self.exits,
+                "final": self.exits[-1]["exit_code"] if self.exits
+                else None}
+
+
+def _terminate_all(workers, term_window: float):
+    """SIGTERM every live worker, escalate to SIGKILL after the bounded
+    window — a worker ignoring SIGTERM (or wedged in a dead collective)
+    cannot wedge the launcher."""
+    live = []
+    for w in workers:
+        if w.proc is None:
+            continue
+        rc = w.proc.poll()
+        if rc is not None:
+            # died between the supervision poll and teardown: reap and
+            # record it, or the exit report would claim it never exited
+            w.record_exit(rc)
+            if rc == 0:
+                w.done = True
+        else:
+            live.append(w)
+    for w in live:
+        try:
+            w.proc.send_signal(signal.SIGTERM)
+        except OSError:
+            pass
+    deadline = time.monotonic() + max(0.0, term_window)
+    for w in live:
+        remaining = deadline - time.monotonic()
+        try:
+            w.proc.wait(timeout=max(0.05, remaining))
+        except subprocess.TimeoutExpired:
+            try:
+                w.proc.kill()
+            except OSError:
+                pass
+            w.proc.wait()
+    for w in live:
+        if w.proc is not None:
+            w.record_exit(w.proc.returncode)
+
+
+def _print_report(workers, out=sys.stderr):
+    print("[launch] worker exit report:", file=out)
+    for w in workers:
+        attempts = []
+        for e in w.exits:
+            attempts.append(e["signal"] or f"exit {e['exit_code']}")
+        print(f"[launch]   rank {w.rank}: "
+              f"{' -> restart -> '.join(attempts) or 'never exited'}"
+              f" (restarts: {w.restarts})", file=out)
+
+
+def supervise(workers, *, max_restarts: int, restart_backoff: float,
+              term_window: float, poll_interval: float,
+              log=lambda msg: print(msg, file=sys.stderr)) -> int:
+    """The supervision loop (importable for tests). Spawns every worker,
+    polls them all, applies the fail-fast / elastic policy, and returns
+    the job's exit code (first failing rank's code, 0 when every rank
+    finished clean)."""
+    for w in workers:
+        w.spawn()
+    first_fail: int | None = None
+    try:
+        while True:
+            now = time.monotonic()
+            for w in workers:
+                if w.done or w.proc is None:
+                    # pending restart?
+                    if (not w.done and w.restart_at is not None
+                            and now >= w.restart_at):
+                        log(f"[launch] restarting rank {w.rank} "
+                            f"(restart #{w.restarts})")
+                        w.spawn()
+                    continue
+                rc = w.poll()
+                if rc is None:
+                    continue
+                w.record_exit(rc)
+                if rc == 0:
+                    w.done = True
+                    continue
+                code = _exit_code(rc)
+                desc = _signal_name(-rc) if rc < 0 else f"code {rc}"
+                if w.restarts < max_restarts:
+                    w.restarts += 1
+                    delay = min(
+                        restart_backoff * (2.0 ** (w.restarts - 1)),
+                        _BACKOFF_CAP_S)
+                    w.restart_at = now + delay
+                    log(f"[launch] rank {w.rank} died ({desc}); "
+                        f"restart #{w.restarts}/{max_restarts} "
+                        f"in {delay:.1f}s")
+                else:
+                    mode = "fail-fast" if max_restarts == 0 else \
+                        "restarts exhausted"
+                    log(f"[launch] rank {w.rank} died ({desc}); {mode}: "
+                        f"terminating remaining workers "
+                        f"(window {term_window:g}s)")
+                    first_fail = code
+                    break
+            if first_fail is not None:
+                _terminate_all(workers, term_window)
+                return first_fail
+            if all(w.done for w in workers):
+                return 0
+            time.sleep(poll_interval)
+    except KeyboardInterrupt:
+        log("[launch] interrupted; terminating workers")
+        _terminate_all(workers, term_window)
+        return 130
 
 
 def main(argv=None):
@@ -49,11 +237,29 @@ def main(argv=None):
                     help="coordinator port (default: pick a free one)")
     ap.add_argument("--env", action="append", default=[],
                     metavar="K=V", help="extra env to export to workers")
+    ap.add_argument("--max-restarts", type=int, default=0,
+                    help="per-rank restart budget (0 = fail-fast: first "
+                    "non-zero exit tears the job down)")
+    ap.add_argument("--restart-backoff", type=float, default=1.0,
+                    help="base restart delay (s); doubles per restart "
+                    f"of a rank, capped at {_BACKOFF_CAP_S:g}s")
+    ap.add_argument("--term-window", type=float, default=10.0,
+                    help="seconds between SIGTERM and SIGKILL when "
+                    "tearing the job down")
+    ap.add_argument("--poll-interval", type=float, default=0.2,
+                    help="supervision poll period (s)")
+    ap.add_argument("--coord-dir", default=None,
+                    help="shared elastic coordinator dir exported as "
+                    "MXNET_ELASTIC_COORD_DIR (default: a fresh tempdir)")
+    ap.add_argument("--report", default=None,
+                    help="write the per-worker exit report JSON here")
     ap.add_argument("command", nargs=argparse.REMAINDER,
                     help="worker command")
     args = ap.parse_args(argv)
     if not args.command:
         ap.error("no worker command given")
+    if args.max_restarts < 0:
+        ap.error("--max-restarts must be >= 0")
     cmd = args.command[1:] if args.command[0] == "--" else args.command
 
     hosts = None
@@ -67,40 +273,48 @@ def main(argv=None):
     root_uri = hosts[0] if hosts else "127.0.0.1"
     port = args.port or _free_port()
     extra = dict(kv.split("=", 1) for kv in args.env)
+    coord_dir = args.coord_dir or tempfile.mkdtemp(prefix="mxnet_elastic_")
+    os.makedirs(coord_dir, exist_ok=True)
 
-    procs = []
-    try:
-        for rank in range(args.num_workers):
-            env = dict(os.environ, **extra,
-                       DMLC_PS_ROOT_URI=root_uri,
-                       DMLC_PS_ROOT_PORT=str(port),
-                       DMLC_NUM_WORKER=str(args.num_workers),
-                       DMLC_WORKER_ID=str(rank),
-                       DMLC_ROLE="worker")
-            if hosts:
-                host = hosts[rank % len(hosts)]
-                exports = " ".join(
-                    f"{k}={shlex.quote(env[k])}"
-                    for k in ("DMLC_PS_ROOT_URI", "DMLC_PS_ROOT_PORT",
-                              "DMLC_NUM_WORKER", "DMLC_WORKER_ID",
-                              "DMLC_ROLE", *extra))
-                remote = f"cd {shlex.quote(os.getcwd())} && " \
-                         f"env {exports} {' '.join(map(shlex.quote, cmd))}"
-                p = subprocess.Popen(["ssh", "-o", "BatchMode=yes", host,
-                                      remote])
-            else:
-                p = subprocess.Popen(cmd, env=env)
-            procs.append(p)
-        rc = 0
-        for p in procs:
-            rc = p.wait() or rc
-        return rc
-    except KeyboardInterrupt:
-        for p in procs:
-            p.send_signal(signal.SIGTERM)
-        for p in procs:
-            p.wait()
-        return 130
+    def spawn(rank: int, restart_count: int) -> subprocess.Popen:
+        env = dict(os.environ, **extra,
+                   DMLC_PS_ROOT_URI=root_uri,
+                   DMLC_PS_ROOT_PORT=str(port),
+                   DMLC_NUM_WORKER=str(args.num_workers),
+                   DMLC_WORKER_ID=str(rank),
+                   DMLC_ROLE="worker",
+                   MXNET_ELASTIC_COORD_DIR=coord_dir,
+                   MXNET_ELASTIC_RESTART=str(restart_count))
+        if hosts:
+            host = hosts[rank % len(hosts)]
+            exports = " ".join(
+                f"{k}={shlex.quote(env[k])}"
+                for k in ("DMLC_PS_ROOT_URI", "DMLC_PS_ROOT_PORT",
+                          "DMLC_NUM_WORKER", "DMLC_WORKER_ID",
+                          "DMLC_ROLE", "MXNET_ELASTIC_COORD_DIR",
+                          "MXNET_ELASTIC_RESTART", *extra))
+            remote = f"cd {shlex.quote(os.getcwd())} && " \
+                     f"env {exports} {' '.join(map(shlex.quote, cmd))}"
+            return subprocess.Popen(["ssh", "-o", "BatchMode=yes", host,
+                                     remote])
+        return subprocess.Popen(cmd, env=env)
+
+    workers = [_Worker(rank, spawn) for rank in range(args.num_workers)]
+    rc = supervise(workers, max_restarts=args.max_restarts,
+                   restart_backoff=args.restart_backoff,
+                   term_window=args.term_window,
+                   poll_interval=args.poll_interval)
+    _print_report(workers)
+    if args.report:
+        with open(args.report, "w") as f:
+            json.dump({"rc": rc,
+                       "mode": "elastic" if args.max_restarts else
+                       "fail_fast",
+                       "max_restarts": args.max_restarts,
+                       "coord_dir": coord_dir,
+                       "workers": [w.report() for w in workers]},
+                      f, indent=1)
+    return rc
 
 
 if __name__ == "__main__":
