@@ -27,4 +27,4 @@ SIGKILLed worker rejoins and the loss stays bit-identical.
 from .bucketing import Bucket, bucket_cap_bytes, plan_buckets  # noqa: F401
 from .kvstore import (BarrierTimeoutError, KVStore,  # noqa: F401
                       KVStoreDistAsyncEmu, KVStoreLocal,
-                      KVStoreTPUSync, create)
+                      KVStoreTPUSync, create, reset_barrier_epoch)

@@ -7,7 +7,7 @@ import threading
 import time
 import warnings
 import weakref
-from typing import Dict, List, Optional
+from typing import Dict, List, Optional, Tuple
 
 import numpy as _np
 
@@ -50,7 +50,8 @@ def _payload_bytes(vals) -> int:
     return sum(_nd_bytes(v) for v in vals)
 
 __all__ = ["BarrierTimeoutError", "KVStore", "KVStoreDistAsyncEmu",
-           "KVStoreLocal", "KVStoreTPUSync", "create"]
+           "KVStoreLocal", "KVStoreTPUSync", "create",
+           "reset_barrier_epoch"]
 
 
 # ---------------------------------------------------------------------------
@@ -62,6 +63,27 @@ __all__ = ["BarrierTimeoutError", "KVStore", "KVStoreDistAsyncEmu",
 # stores in the same program order) — namespaces each store's
 # cross-process barrier keys so two stores can never alias rendezvous.
 _STORE_ORDINAL = 0
+
+# Elastic membership epoch the barrier keyspace is based on. Per-site
+# barrier sequence numbers live in process memory, so a restarted rank
+# would re-count from zero while survivors kept counting — the ranks
+# would announce under different key prefixes and every post-restart
+# barrier would time out. The elastic runtime calls
+# :func:`reset_barrier_epoch` at every membership transition (and at a
+# rejoiner's start), which re-bases EVERY rank's counters to zero under
+# an epoch-tagged namespace: survivors and the restarted rank meet at
+# seq 1 of the new epoch.
+_BARRIER_EPOCH = 0
+
+
+def reset_barrier_epoch(epoch: int) -> None:
+    """Re-base cross-process barrier sequence numbering to an elastic
+    membership ``epoch``. Called by ``parallel.elastic`` at each epoch
+    transition on every surviving rank (a restarted rank's counters are
+    fresh anyway), so all ranks' barriers rendezvous under the same
+    ``e{epoch}`` key namespace starting from sequence 1."""
+    global _BARRIER_EPOCH
+    _BARRIER_EPOCH = int(epoch)
 
 
 class BarrierTimeoutError(MXNetError):
@@ -80,6 +102,27 @@ def _barrier_timeout_s() -> float:
             "MXNET_KV_BARRIER_TIMEOUT="
             f"{os.environ['MXNET_KV_BARRIER_TIMEOUT']!r} is not a "
             "number") from e
+
+
+def _bootstrap_timeout_s() -> int:
+    """The ``jax.distributed.initialize`` rendezvous bound (seconds):
+    ``MXNET_KV_BOOTSTRAP_TIMEOUT`` falling back to the barrier knob.
+    jax wants a positive integer and has no unbounded mode, so <= 0
+    (the documented bound opt-out) maps to ~24 days, and fractions
+    round UP so 0.5 never truncates to instant failure. Shared by
+    ``_maybe_init_distributed`` and the elastic re-bootstrap so the
+    opt-out means the same thing at both sites."""
+    try:
+        t = float(os.environ.get(
+            "MXNET_KV_BOOTSTRAP_TIMEOUT", "") or _barrier_timeout_s())
+    except ValueError as e:
+        raise MXNetError(
+            "MXNET_KV_BOOTSTRAP_TIMEOUT="
+            f"{os.environ['MXNET_KV_BOOTSTRAP_TIMEOUT']!r} is not a "
+            "number") from e
+    import math
+
+    return 2**31 // 1000 if t <= 0 else max(1, math.ceil(t))
 
 
 def _bounded_waitall(site: str, timeout: float) -> None:
@@ -819,13 +862,30 @@ class KVStoreTPUSync(KVStoreLocal):
         _STORE_ORDINAL += 1
         self._barrier_ns = _STORE_ORDINAL
         self._barrier_seq: Dict[str, int] = {}
+        self._barrier_epoch = _BARRIER_EPOCH
+
+    def _next_barrier_seq(self, site: str) -> Tuple[int, str]:
+        """Allocate this barrier's (sequence, key namespace). Sequences
+        count per site IN process memory, so they are re-based whenever
+        the elastic membership epoch advanced (``reset_barrier_epoch``):
+        every survivor clears its counters at the transition and a
+        restarted rank's counters are fresh anyway, so all ranks meet at
+        seq 1 under the epoch-tagged namespace instead of the survivors
+        announcing seq k+1 against a rejoiner's seq 1 forever."""
+        if self._barrier_epoch != _BARRIER_EPOCH:
+            self._barrier_epoch = _BARRIER_EPOCH
+            self._barrier_seq.clear()
+        seq = self._barrier_seq.get(site, 0) + 1
+        self._barrier_seq[site] = seq
+        return seq, f"e{self._barrier_epoch}/s{self._barrier_ns}/"
 
     def barrier(self, site: str = "user", timeout: Optional[float] = None):
         """Local drain + cross-process rendezvous, both bounded. The
         rendezvous rides the coordination-service KV store (one
         announce + a poll loop — per-site sequence numbers keep repeated
         barriers distinct under the SPMD contract that every process
-        calls them in the same order), so a timeout can name exactly
+        calls them in the same order, re-based at each elastic epoch so
+        restarted ranks re-converge), so a timeout can name exactly
         which ranks never arrived — the diagnostic a hung psum cannot
         give. Wrapped in ``fault.retry_call`` at ``kvstore.barrier``
         (announcements are idempotent)."""
@@ -845,13 +905,12 @@ class KVStoreTPUSync(KVStoreLocal):
         # not a fresh timeout — callers rely on the documented bound
         remaining = timeout if timeout <= 0 else \
             max(0.05, timeout - (time.monotonic() - t0))
-        seq = self._barrier_seq.get(site, 0) + 1
-        self._barrier_seq[site] = seq
+        seq, key_ns = self._next_barrier_seq(site)
         fault.retry_call(
             "kvstore.barrier",
             lambda: _cross_process_barrier(
                 client, site, seq, self.rank, self.num_workers,
-                remaining, key_ns=f"s{self._barrier_ns}/"),
+                remaining, key_ns=key_ns),
             detail=f"site {site!r} seq {seq}")
 
     def attach_mesh(self, mesh):
@@ -1204,21 +1263,7 @@ def _maybe_init_distributed():
     # the rendezvous is BOUNDED: a worker that never comes up must
     # surface as a typed error naming the site, not an eternal hang
     # (MXNET_KV_BOOTSTRAP_TIMEOUT, falling back to the barrier knob)
-    try:
-        timeout_s = float(os.environ.get(
-            "MXNET_KV_BOOTSTRAP_TIMEOUT", "") or _barrier_timeout_s())
-    except ValueError as e:
-        raise MXNetError(
-            "MXNET_KV_BOOTSTRAP_TIMEOUT="
-            f"{os.environ['MXNET_KV_BOOTSTRAP_TIMEOUT']!r} is not a "
-            "number") from e
-    # jax wants an integer timeout and has no unbounded mode: <= 0 (the
-    # documented bound opt-out) maps to ~24 days, fractions round UP so
-    # 0.5 never truncates to instant failure
-    import math
-
-    timeout_s = 2**31 // 1000 if timeout_s <= 0 \
-        else max(1, math.ceil(timeout_s))
+    timeout_s = _bootstrap_timeout_s()
     import jax
 
     try:

@@ -26,8 +26,14 @@ bit-exact state hand-off:
   stale coordinator socket can never be re-joined), (4) restores the
   bundle **bit-exactly** — params, optimizer counters, RNG stream and
   compression residuals all ride the PR-3 bundle format — and continues.
-  The epoch id is threaded into telemetry
-  (``mxnet_elastic_membership_epoch``) and the bundle's ``extra`` tag.
+  The transition is committed through the shared ``EPOCH`` record
+  (epoch, member set, the survivors' last completed step): a survivor
+  that reads a record already committed for the same member set ADOPTS
+  its epoch (concurrent survivors can never split across epoch-derived
+  ports), and each transition re-bases the kvstore barrier-sequence
+  namespace so post-restart barriers still rendezvous. The epoch id is
+  threaded into telemetry (``mxnet_elastic_membership_epoch``) and the
+  bundle's ``extra`` tag.
 
 * **Graceful degradation.** A rank that stays dead just shrinks the
   membership: survivors train on at the reduced world size, and
@@ -39,7 +45,17 @@ A restarted worker (``tools/launch.py --max-restarts N`` respawns it
 with the same ``DMLC_WORKER_ID``) finds the newest valid bundle for its
 rank at :meth:`ElasticRunner.start` and resumes from it — kill a worker
 mid-step, rejoin, and the final loss is bit-identical to an
-uninterrupted run (``tools/chaos_check.py`` elastic gate).
+uninterrupted run (``tools/chaos_check.py`` elastic gate). A rejoiner
+in real distributed mode additionally reconciles to the survivors'
+committed step from the join record (``adopted_step``): the survivors
+trained on during the outage (or committed a step behind the victim's
+last save), and resuming from its own newest bundle would give it a
+different remaining step count — the mismatched steps wedge at a
+collective — and stale weights in every allreduce. It restores the
+bundle AT the committed step instead: its own when one exists, else a
+survivor's (survivors checkpoint at exactly that step before
+publishing the commit, and ``dist_sync`` data-parallel state is
+replicated across ranks).
 
 ::
 
@@ -58,6 +74,7 @@ import os
 import socket
 import threading
 import time
+import warnings
 import weakref
 from dataclasses import dataclass
 from typing import Callable, Dict, List, Optional, Tuple
@@ -82,6 +99,19 @@ _RUNNERS: "weakref.WeakSet[ElasticRunner]" = weakref.WeakSet()
 def live_runners() -> List["ElasticRunner"]:
     """Runners with a running heartbeat thread (leak-guard hook)."""
     return [r for r in list(_RUNNERS) if r.heartbeat_running()]
+
+
+def _sync_barrier_epoch(epoch: int) -> None:
+    """Re-base kvstore cross-process barrier sequence numbering to this
+    membership epoch (every survivor does this at the transition, a
+    restarted rank at start), so barriers after a restart rendezvous
+    under the same epoch-tagged keys instead of survivors waiting at
+    seq k+1 against the rejoiner's seq 1 forever."""
+    try:
+        from ..kvstore.kvstore import reset_barrier_epoch
+    except ImportError:   # kvstore unavailable: nothing to re-base
+        return
+    reset_barrier_epoch(epoch)
 
 
 def _env_float(name: str, default: float) -> float:
@@ -265,6 +295,9 @@ class ElasticRunner:
         self.transitions: List[Dict] = []
         self.start_step = 0
         self.resumed_from: Optional[int] = None
+        # set when a distributed rejoin skipped ahead to the survivors'
+        # committed step (the survivors trained on during our outage)
+        self.adopted_step: Optional[int] = None
         self._started = False
         self._last_completed = -1
         self._hb_stop = threading.Event()
@@ -310,44 +343,53 @@ class ElasticRunner:
     def _epoch_file(self) -> str:
         return os.path.join(self.coord_dir, _EPOCH_FILE)
 
-    def _read_epoch_record(self) -> Tuple[int, Optional[Tuple[int, ...]]]:
-        """The shared ``(epoch, members)`` commit record (members None
-        for a legacy bare-int file)."""
+    def _read_epoch_record(
+            self) -> Tuple[int, Optional[Tuple[int, ...]], Optional[int]]:
+        """The shared ``(epoch, members, step)`` commit record (members
+        and step None for a legacy bare-int or pre-step file). ``step``
+        is the committing survivors' last completed step — the rejoin
+        reconciliation point."""
         try:
             with open(self._epoch_file(), "rb") as f:
                 raw = f.read().decode("utf-8").strip()
         except OSError:
-            return 0, None
+            return 0, None, None
         try:
             rec = json.loads(raw or "0")
         except ValueError:
-            return 0, None
+            return 0, None, None
         if isinstance(rec, dict):
             try:
                 members = rec.get("members")
-                return int(rec.get("epoch", 0)), \
-                    tuple(int(r) for r in members) \
-                    if members is not None else None
+                step = rec.get("step")
+                return (int(rec.get("epoch", 0)),
+                        tuple(int(r) for r in members)
+                        if members is not None else None,
+                        int(step) if step is not None else None)
             except (TypeError, ValueError):
-                return 0, None
+                return 0, None, None
         try:
-            return int(rec), None
+            return int(rec), None, None
         except (TypeError, ValueError):
-            return 0, None
+            return 0, None, None
 
     def _read_epoch(self) -> int:
         return self._read_epoch_record()[0]
 
     def _publish_epoch(self, epoch: int,
-                       members: Optional[Tuple[int, ...]] = None) -> None:
+                       members: Optional[Tuple[int, ...]] = None,
+                       step: Optional[int] = None) -> None:
         # best-effort monotonic max across ranks: the record is advisory
         # for epoch numbering (late joiners adopt it) — but it is ALSO
         # the rejoin-handshake signal (a joiner waits for a committed
-        # membership that includes it), so it carries the member set
+        # membership that includes it), so it carries the member set and
+        # the survivors' committed step (the rejoiner's skip-ahead point)
         if epoch > self._read_epoch():
-            atomic_write(self._epoch_file(), json.dumps(
-                {"epoch": int(epoch),
-                 "members": list(members or ())}).encode("utf-8"))
+            rec = {"epoch": int(epoch), "members": list(members or ())}
+            if step is not None and step >= 0:   # -1: nothing completed
+                rec["step"] = int(step)
+            atomic_write(self._epoch_file(),
+                         json.dumps(rec).encode("utf-8"))
 
     def _make_membership(self, epoch: int, members: List[int]) -> Membership:
         members = sorted(members)
@@ -401,14 +443,19 @@ class ElasticRunner:
                 # snapshot is stale by now (another rank may have died
                 # while we restarted), and a world-size disagreement
                 # would wedge the rendezvous on both sides
-                epoch, committed = self._await_join_commit(
-                    bundle_epoch, epoch)
+                epoch, committed, committed_step = \
+                    self._await_join_commit(bundle_epoch, epoch)
                 if committed is not None:
                     alive = list(committed)
+                    if committed_step is not None \
+                            and committed_step != self.start_step - 1:
+                        self._reconcile_to(committed_step, committed)
         self.membership = self._make_membership(epoch, alive)
         self._last_completed = self.start_step - 1
-        self._publish_epoch(epoch, self.membership.members)
+        self._publish_epoch(epoch, self.membership.members,
+                            self._last_completed)
         telemetry.set_elastic_epoch(epoch)
+        _sync_barrier_epoch(epoch)
         if (step is not None and self._is_distributed()
                 and self.membership.world_size > 1):
             (self._bootstrap_fn or self._default_bootstrap)(self.membership)
@@ -417,24 +464,74 @@ class ElasticRunner:
 
     def _await_join_commit(
             self, bundle_epoch: int, epoch: int
-    ) -> Tuple[int, Optional[Tuple[int, ...]]]:
+    ) -> Tuple[int, Optional[Tuple[int, ...]], Optional[int]]:
         """Wait (bounded by ``join_timeout``) for the survivors to
         commit a membership that INCLUDES this rank at an epoch past
         the bundle we resumed from — their signal that they are in (or
         about to enter) the re-bootstrap rendezvous for our join. A
         plain epoch advance is not enough: the leave transition that
         recorded our death also advanced it. Returns the committed
-        ``(epoch, members)`` — the rejoiner must adopt BOTH, not its
-        own alive snapshot. Times out to ``(best known epoch, None)``
-        (all survivors gone: continue solo, degraded)."""
+        ``(epoch, members, step)`` — the rejoiner must adopt ALL of
+        them, not its own alive snapshot / bundle step (the survivors
+        trained on during the outage). Times out to
+        ``(best known epoch, None, None)`` (all survivors gone:
+        continue solo, degraded)."""
         deadline = time.monotonic() + self.join_timeout
         while time.monotonic() < deadline:
-            cur, members = self._read_epoch_record()
+            cur, members, step = self._read_epoch_record()
             if cur > bundle_epoch and members is not None \
                     and self.launch_rank in members:
-                return max(cur, epoch), members
+                return max(cur, epoch), members, step
             time.sleep(min(0.05, self.heartbeat_interval))
-        return epoch, None
+        return epoch, None, None
+
+    def _reconcile_to(self, step: int,
+                      members: Tuple[int, ...]) -> None:
+        """Align this rejoiner to the survivors' committed ``step`` —
+        resuming at our own bundle's step would give us a DIFFERENT
+        remaining step count than our peers (our extra or missing steps
+        wedge at a collective once the schedules drift apart), and
+        adopting the step count alone would pair our stale weights with
+        their step-``step`` weights in every allreduce. The survivors
+        checkpoint at exactly this step BEFORE publishing the join
+        commit (see ``_transition``), so under the shared checkpoint
+        layout a bundle at ``step`` exists by the time we read the
+        record: prefer our OWN (pure bit-exact replay — the
+        survivors-behind-us case), else restore a survivor's
+        (``dist_sync`` data-parallel state — params, optimizer
+        counters, and a seed-replicated RNG stream — is replicated
+        across ranks, so its bundle is our state at that step; per-rank
+        compression residuals ride along as the closest available
+        approximation, and are stale at a membership change either
+        way). When neither is reachable (custom ``ckpt_mgr`` layout),
+        the step count is still adopted so the schedules align."""
+        restored_from = None
+        if self.ckpt.is_valid(step):
+            self._restore(step=step)
+            restored_from = self.launch_rank
+        else:
+            for r in members:
+                if r == self.launch_rank:
+                    continue
+                mgr = CheckpointManager(self.ckpt.directory,
+                                        prefix=f"r{int(r)}",
+                                        keep_last=self.ckpt.keep_last)
+                if mgr.is_valid(step):
+                    self._restore(mgr, step=step)
+                    restored_from = int(r)
+                    break
+        if restored_from is not None:
+            self.resumed_from = step
+        else:
+            warnings.warn(
+                f"elastic rejoin: no bundle at the survivors' committed "
+                f"step {step} reachable under {self.ckpt.directory!r} "
+                f"(members {tuple(members)}); adopting the step count "
+                f"with state from step {self.resumed_from} — expect "
+                "numeric divergence until the next full checkpoint",
+                RuntimeWarning, stacklevel=3)
+        self.adopted_step = step
+        self.start_step = step + 1
 
     def stop(self) -> None:
         """Stop the heartbeat thread (idempotent). The heartbeat file is
@@ -464,16 +561,20 @@ class ElasticRunner:
                               trainer=self.trainer,
                               extra={"elastic": tag})
 
-    def _restore(self) -> Dict:
-        """Bit-exact restore from the newest valid bundle, bounded retry
-        at ``elastic.rejoin`` (restore is an idempotent overwrite)."""
+    def _restore(self, mgr: Optional[CheckpointManager] = None,
+                 step: Optional[int] = None) -> Dict:
+        """Bit-exact restore from the newest valid bundle (or ``step``,
+        or another rank's manager ``mgr`` — the join reconciliation),
+        bounded retry at ``elastic.rejoin`` (restore is an idempotent
+        overwrite)."""
+        mgr = self.ckpt if mgr is None else mgr
 
         def _do():
             if _fault_state.enabled:
                 fault.check("elastic.rejoin",
                             f"rank {self.launch_rank}")
-            return self.ckpt.restore(block=self.params,
-                                     trainer=self.trainer)
+            return mgr.restore(block=self.params,
+                               trainer=self.trainer, step=step)
 
         return fault.retry_call("elastic.rejoin", _do,
                                 detail=f"rank {self.launch_rank}")
@@ -516,30 +617,46 @@ class ElasticRunner:
     def _default_bootstrap(self, m: Membership) -> None:
         # coordinator = the new rank 0's host; the port advances with
         # the epoch so a survivor can never rendezvous with a stale
-        # coordinator socket from a previous epoch
+        # coordinator socket from a previous epoch. The timeout is the
+        # SAME mapping as the first bootstrap (_maybe_init_distributed):
+        # <= 0 is the documented unbounded opt-out, not a 1 s fuse
         host = self.board.read(m.members[0]).get("host") or "127.0.0.1"
         base = int(os.environ.get("DMLC_PS_ROOT_PORT", "9091"))
+        from ..kvstore.kvstore import _bootstrap_timeout_s
         import jax
 
         jax.distributed.initialize(
             coordinator_address=f"{host}:{base + 1 + m.epoch}",
             num_processes=m.world_size, process_id=m.rank,
-            initialization_timeout=max(
-                1, int(_env_float("MXNET_KV_BARRIER_TIMEOUT", 300.0))))
+            initialization_timeout=_bootstrap_timeout_s())
 
     def _transition(self, alive: List[int], left: List[int],
                     joined: List[int]) -> Membership:
         old = self.membership
-        epoch = max(old.epoch, self._read_epoch()) + 1
-        new = self._make_membership(epoch, alive)
+        new_members = tuple(sorted(set(alive)))  # _alive_now includes us
+        rec_epoch, rec_members, _rec_step = self._read_epoch_record()
+        if rec_epoch > old.epoch and rec_members == new_members:
+            # another survivor already committed THIS transition (same
+            # member set, newer epoch): adopt its epoch. Incrementing
+            # here would split the survivors across epochs — the first
+            # to transition at E+1, everyone who read its record at
+            # E+2 — and epoch-derived coordinator ports would wedge
+            # both rendezvous. The record is the transition's commit,
+            # not just advisory numbering.
+            epoch = rec_epoch
+        else:
+            epoch = max(old.epoch, rec_epoch) + 1
+        new = self._make_membership(epoch, list(new_members))
         # 1) survivors checkpoint BEFORE touching the collective runtime
         # (a crash inside the re-bootstrap must lose at most this step)
         if self._last_completed >= 0:
             self._save(self._last_completed, new)
         # 2) publish the commit record BEFORE the blocking re-bootstrap:
         # a rejoining rank waits on it (_await_join_commit) to enter the
-        # same rendezvous — publishing after would deadlock the join
-        self._publish_epoch(epoch, new.members)
+        # same rendezvous — publishing after would deadlock the join;
+        # it carries our committed step so the rejoiner can skip ahead
+        # to the survivors' schedule
+        self._publish_epoch(epoch, new.members, self._last_completed)
         # 3) tear down the old world's collective runtime
         distributed = self._is_distributed()
         if distributed:
@@ -552,6 +669,7 @@ class ElasticRunner:
             self._restore()
         self.membership = new
         telemetry.set_elastic_epoch(epoch)
+        _sync_barrier_epoch(epoch)
         telemetry.record_elastic_restart(len(joined))
         rec = {"epoch": epoch, "left": left, "joined": joined,
                "world_size": new.world_size,

@@ -42,6 +42,14 @@ pytestmark = pytest.mark.elastic
 REPO_ROOT = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
 
 
+@pytest.fixture(autouse=True)
+def _rebase_barrier_epoch_to_zero():
+    """Epoch transitions re-base the kvstore barrier-sequence epoch (a
+    process-wide global); reset it so tests stay order-independent."""
+    yield
+    kv.reset_barrier_epoch(0)
+
+
 # ---------------------------------------------------------------------------
 # helpers
 # ---------------------------------------------------------------------------
@@ -312,6 +320,197 @@ class TestElasticRunner:
         finally:
             r2.stop()
 
+    def test_concurrent_survivor_transitions_agree_on_epoch(self, tmp_path):
+        """With >= 2 survivors, the first to transition publishes E+1;
+        a survivor that reads that record must ADOPT E+1 for the same
+        member set, not compute E+2 — divergent epochs derive different
+        coordinator ports and wedge both re-bootstrap rendezvous."""
+        from mxnet_tpu.kvstore import kvstore as kvmod
+
+        board = elastic.HeartbeatBoard(str(tmp_path))
+        for r in (0, 1, 2):
+            os.utime(board.register(r), (time.time() + 1e6,) * 2)
+        doomed = board.path(2)
+        boots = {0: [], 1: []}
+        runners = {}
+        for r in (0, 1):
+            runners[r] = elastic.ElasticRunner(
+                str(tmp_path), world_size=3, rank=r,
+                heartbeat_interval=0.05, heartbeat_timeout=1.0,
+                join_timeout=0.5, distributed=True,
+                bootstrap_fn=lambda m, r=r: boots[r].append(
+                    (m.epoch, m.world_size, m.rank)),
+                shutdown_fn=lambda: None)
+            runners[r].start()
+        try:
+            assert runners[0].membership.members == (0, 1, 2)
+            assert runners[1].membership.members == (0, 1, 2)
+            old = time.time() - 100.0
+            os.utime(doomed, (old, old))
+            m0 = runners[0].check_membership()  # commits epoch 1
+            m1 = runners[1].check_membership()  # must adopt, not take 2
+        finally:
+            runners[0].stop()
+            runners[1].stop()
+        assert m0.epoch == m1.epoch == 1
+        assert m0.members == m1.members == (0, 1)
+        assert (m0.rank, m0.world_size) == (0, 2)
+        assert (m1.rank, m1.world_size) == (1, 2)
+        # both re-bootstrapped at the SAME epoch (same derived port)
+        assert boots[0] == [(1, 2, 0)] and boots[1] == [(1, 2, 1)]
+        # and the barrier keyspace re-based to the committed epoch
+        assert kvmod._BARRIER_EPOCH == 1
+
+    def test_rejoiner_adopts_survivor_committed_step_and_state(
+            self, tmp_path):
+        """The join commit record carries the survivors' last completed
+        step; a distributed rejoiner reconciles to it instead of
+        replaying its own (older) bundle tail against peers that moved
+        on — and it must adopt the survivors' STATE along with the step
+        (the survivors checkpointed at exactly that step before
+        publishing; replicated data-parallel state), else every
+        allreduce would pair its stale weights with theirs."""
+        import json as _json
+
+        from mxnet_tpu.checkpoint import CheckpointManager, atomic_write
+
+        net, trainer, x, y = make_model()
+        r1 = elastic.ElasticRunner(
+            str(tmp_path), params=net, trainer=trainer, world_size=2,
+            rank=1, save_every=1, heartbeat_interval=0.05,
+            heartbeat_timeout=1.0, join_timeout=0.1, distributed=False)
+        r1.run(make_step_fn(net, trainer, x, y), 2)   # own bundles @ 0, 1
+        # survivor rank 0: trained to step 9 and checkpointed there at
+        # the join transition (what _transition does before publishing)
+        netA, trainerA, xA, yA = make_model()
+        fnA = make_step_fn(netA, trainerA, xA, yA)
+        for s in range(10):
+            fnA(s, None)
+        surv = CheckpointManager(
+            os.path.join(str(tmp_path), "ckpts"), prefix="r0")
+        surv.save(9, params=netA, trainer=trainerA)
+        board = elastic.HeartbeatBoard(str(tmp_path))
+        os.utime(board.register(0), (time.time() + 1e6,) * 2)
+        atomic_write(os.path.join(str(tmp_path), "EPOCH"), _json.dumps(
+            {"epoch": 2, "members": [0, 1],
+             "step": 9}).encode("utf-8"))
+        net2, trainer2, _, _ = make_model(seed=9)
+        r2 = elastic.ElasticRunner(
+            str(tmp_path), params=net2, trainer=trainer2, world_size=2,
+            rank=1, heartbeat_interval=0.05, heartbeat_timeout=5.0,
+            join_timeout=1.0, distributed=True,
+            bootstrap_fn=lambda m: None, shutdown_fn=lambda: None)
+        r2.start()
+        try:
+            assert r2.adopted_step == 9 and r2.start_step == 10
+            assert r2.resumed_from == 9   # the survivor's bundle won
+            assert r2.membership.epoch == 2
+            assert r2.membership.members == (0, 1)
+            w_a, w_2 = weights_of(netA), weights_of(net2)
+            assert all(np.array_equal(v, w_2[k])
+                       for k, v in w_a.items())
+        finally:
+            r2.stop()
+
+    def test_rejoiner_falls_back_when_commit_is_behind_it(self, tmp_path):
+        """The victim can save RIGHT before dying while the survivors
+        commit the join still mid-step, i.e. at a step behind the
+        victim's newest bundle — reconciliation must align that
+        direction too (replay from the rejoiner's OWN bundle at the
+        committed step), or the schedules drift apart just the same."""
+        import json as _json
+
+        from mxnet_tpu.checkpoint import atomic_write
+
+        net, trainer, x, y = make_model()
+        r1 = elastic.ElasticRunner(
+            str(tmp_path), params=net, trainer=trainer, world_size=2,
+            rank=1, save_every=1, heartbeat_interval=0.05,
+            heartbeat_timeout=1.0, join_timeout=0.1, distributed=False)
+        r1.run(make_step_fn(net, trainer, x, y), 3)   # bundles @ 0, 1, 2
+        ref_net, ref_trainer, _, _ = make_model(seed=7)
+        r1.ckpt.restore(block=ref_net, trainer=ref_trainer, step=0)
+        board = elastic.HeartbeatBoard(str(tmp_path))
+        os.utime(board.register(0), (time.time() + 1e6,) * 2)
+        atomic_write(os.path.join(str(tmp_path), "EPOCH"), _json.dumps(
+            {"epoch": 2, "members": [0, 1],
+             "step": 0}).encode("utf-8"))
+        net2, trainer2, _, _ = make_model(seed=9)
+        r2 = elastic.ElasticRunner(
+            str(tmp_path), params=net2, trainer=trainer2, world_size=2,
+            rank=1, heartbeat_interval=0.05, heartbeat_timeout=5.0,
+            join_timeout=1.0, distributed=True,
+            bootstrap_fn=lambda m: None, shutdown_fn=lambda: None)
+        r2.start()
+        try:
+            assert r2.adopted_step == 0 and r2.start_step == 1
+            assert r2.resumed_from == 0
+            w_r, w_2 = weights_of(ref_net), weights_of(net2)
+            assert all(np.array_equal(v, w_2[k])
+                       for k, v in w_r.items())
+        finally:
+            r2.stop()
+
+    def test_rejoiner_warns_when_committed_step_unreachable(
+            self, tmp_path):
+        """No bundle at the committed step anywhere (custom checkpoint
+        layout): the step count is still adopted so the schedules
+        align, but LOUDLY — silently pairing stale weights with the
+        survivors' in every allreduce would be undebuggable."""
+        import json as _json
+
+        from mxnet_tpu.checkpoint import atomic_write
+
+        net, trainer, x, y = make_model()
+        r1 = elastic.ElasticRunner(
+            str(tmp_path), params=net, trainer=trainer, world_size=2,
+            rank=1, save_every=1, heartbeat_interval=0.05,
+            heartbeat_timeout=1.0, join_timeout=0.1, distributed=False)
+        r1.run(make_step_fn(net, trainer, x, y), 2)   # bundles @ 0, 1
+        board = elastic.HeartbeatBoard(str(tmp_path))
+        os.utime(board.register(0), (time.time() + 1e6,) * 2)
+        atomic_write(os.path.join(str(tmp_path), "EPOCH"), _json.dumps(
+            {"epoch": 2, "members": [0, 1],
+             "step": 9}).encode("utf-8"))   # no bundle @ 9 exists
+        net2, trainer2, _, _ = make_model(seed=9)
+        r2 = elastic.ElasticRunner(
+            str(tmp_path), params=net2, trainer=trainer2, world_size=2,
+            rank=1, heartbeat_interval=0.05, heartbeat_timeout=5.0,
+            join_timeout=1.0, distributed=True,
+            bootstrap_fn=lambda m: None, shutdown_fn=lambda: None)
+        with pytest.warns(RuntimeWarning, match="committed step 9"):
+            r2.start()
+        try:
+            assert r2.adopted_step == 9 and r2.start_step == 10
+            assert r2.resumed_from == 1   # stale state kept, loudly
+        finally:
+            r2.stop()
+
+    def test_rebootstrap_honors_timeout_optout(self, tmp_path,
+                                               monkeypatch):
+        """MXNET_KV_BARRIER_TIMEOUT <= 0 (the documented unbounded
+        opt-out) must map to the same ~24-day bound as the first
+        bootstrap, not a guaranteed-to-fail 1-second fuse on the
+        elastic re-bootstrap rendezvous."""
+        import jax
+
+        captured = {}
+        monkeypatch.setattr(jax.distributed, "initialize",
+                            lambda **kw: captured.update(kw))
+        monkeypatch.setenv("MXNET_KV_BARRIER_TIMEOUT", "0")
+        monkeypatch.delenv("MXNET_KV_BOOTSTRAP_TIMEOUT", raising=False)
+        monkeypatch.setenv("DMLC_PS_ROOT_PORT", "9091")
+        runner = elastic.ElasticRunner(str(tmp_path), world_size=1,
+                                       rank=0)
+        runner.board.register(0)
+        m = elastic.Membership(epoch=2, rank=0, world_size=1,
+                               members=(0,), launch_rank=0)
+        runner._default_bootstrap(m)
+        assert captured["initialization_timeout"] == 2**31 // 1000
+        assert captured["num_processes"] == 1
+        # coordinator port still advances with the epoch (base + 1 + e)
+        assert captured["coordinator_address"].endswith(":9094")
+
     def test_heartbeat_fault_site_retried(self, tmp_path):
         runner = elastic.ElasticRunner(str(tmp_path), world_size=1,
                                        rank=0)
@@ -413,6 +612,42 @@ class TestBoundedBarrier:
         msg = str(ei.value)
         assert "kvstore.barrier[exit]" in msg
         assert "missing ranks [1, 2]" in msg and "arrived: [0]" in msg
+
+    def test_barrier_seq_rebases_on_elastic_epoch(self):
+        """Per-site sequence numbers live in process memory, so a
+        restarted rank would announce seq 1 against the survivors'
+        seq k+1 forever; re-basing every rank's counters at each
+        membership epoch (epoch-tagged key namespace, sequences back
+        to 1) makes them meet again after a restart."""
+        kv.reset_barrier_epoch(0)
+        store = kv.create("tpu_sync")
+        ns = store._barrier_ns
+        assert store._next_barrier_seq("user") == (1, f"e0/s{ns}/")
+        assert store._next_barrier_seq("user") == (2, f"e0/s{ns}/")
+        assert store._next_barrier_seq("exit") == (1, f"e0/s{ns}/")
+        kv.reset_barrier_epoch(4)   # what the elastic transition does
+        assert store._next_barrier_seq("user") == (1, f"e4/s{ns}/")
+        assert store._next_barrier_seq("exit") == (1, f"e4/s{ns}/")
+        # a store created AFTER the transition (restarted rank) agrees
+        fresh = kv.create("tpu_sync")
+        seq, key_ns = fresh._next_barrier_seq("user")
+        assert seq == 1 and key_ns.startswith("e4/")
+
+    def test_bootstrap_timeout_mapping(self, monkeypatch):
+        """<= 0 (the documented unbounded opt-out) maps to ~24 days at
+        EVERY bootstrap site, and fractions round up, never to an
+        instant-failure 1 s rendezvous."""
+        from mxnet_tpu.kvstore.kvstore import _bootstrap_timeout_s
+
+        monkeypatch.delenv("MXNET_KV_BOOTSTRAP_TIMEOUT", raising=False)
+        monkeypatch.setenv("MXNET_KV_BARRIER_TIMEOUT", "0")
+        assert _bootstrap_timeout_s() == 2**31 // 1000
+        monkeypatch.setenv("MXNET_KV_BARRIER_TIMEOUT", "0.5")
+        assert _bootstrap_timeout_s() == 1
+        monkeypatch.setenv("MXNET_KV_BOOTSTRAP_TIMEOUT", "2.3")
+        assert _bootstrap_timeout_s() == 3
+        monkeypatch.setenv("MXNET_KV_BOOTSTRAP_TIMEOUT", "-1")
+        assert _bootstrap_timeout_s() == 2**31 // 1000
 
     def test_barrier_fault_site(self):
         store = kv.create("tpu_sync")
