@@ -125,9 +125,17 @@ if _os.environ.get("MXNET_XLA_CACHE", _cache_default()) != "0":
         # host namespacing have unknown host provenance (they're the
         # SIGILL-risk entries this scheme exists to quarantine) — delete
         # rather than migrate; they recompile once into the new subdir.
+        # Match ONLY the exact filenames the jax compilation cache
+        # writes (<fn>-<sha256 hex>-cache plus its -atime sidecar):
+        # MXNET_XLA_CACHE_DIR may point at a shared directory, and a
+        # broad *-cache sweep would unlink foreign files there.
+        import re as _re
+
+        _jax_cache_entry = _re.compile(
+            r".+-[0-9a-f]{64}-(cache|atime)$").fullmatch
         _base = _os.path.dirname(_cache_dir)
         for _f in _os.listdir(_base):
-            if _f.endswith("-cache") and _os.path.isfile(
+            if _jax_cache_entry(_f) and _os.path.isfile(
                     _os.path.join(_base, _f)):
                 try:
                     _os.unlink(_os.path.join(_base, _f))

@@ -348,7 +348,23 @@ def _sweep_node_recorded(node, acc, add_grad):
         # imperative invoke path instead. Gradients flow through the
         # cotangent chain only, matching the reference's contract that a
         # custom Function is twice-differentiable only if its backward is
-        # written with differentiable ops.
+        # written with differentiable ops. That contract is easy to
+        # violate silently (saved primals enter the backward as closure
+        # CONSTANTS — zero second-order sensitivity through them), so be
+        # loud about taking this path.
+        import warnings
+
+        warnings.warn(
+            f"create_graph=True through custom Function {node.name!r}: "
+            "no pure primal is recorded, so second-order terms flow "
+            "through the custom backward's OPS only — sensitivity "
+            "through values the forward saved (saved primals) is "
+            "silently ZERO unless the backward recomputes from its "
+            "cotangent inputs. Write the backward with differentiable "
+            "ops over its inputs, or use built-in ops for "
+            "twice-differentiated paths (see README, 'higher-order "
+            "autograd').",
+            RuntimeWarning, stacklevel=2)
         vjp_fn = node.vjp_fn
         in_avals = [(node.inputs[i].shape, node.inputs[i].dtype)
                     for i in float_in]

@@ -16,7 +16,11 @@ module makes the failure paths *testable*:
   ``datafeed.put`` (each batch staged by the async input pipeline —
   ``io.DeviceFeedIter``), ``serving.dispatch`` (every inference batch
   the model server dispatches), ``serving.reload`` (every model
-  hot-reload — ``serving.Server``), ``elastic.heartbeat`` (every
+  hot-reload — ``serving.Server``), ``serving.replica`` (every batch a
+  Router-managed replica dispatches; the dotted sub-sites
+  ``serving.replica.<i>`` target one replica — kill or wedge exactly
+  one instance of the fleet), ``serving.route`` (every routing
+  decision the serving Router makes), ``elastic.heartbeat`` (every
   liveness touch of the elastic runtime) and ``elastic.rejoin`` (every
   epoch-transition restore — ``parallel.elastic.ElasticRunner``).
   Like telemetry, every call site guards on one module-level flag
@@ -64,7 +68,7 @@ from .base import MXNetError
 __all__ = [
     "FaultInjected", "check", "inject", "install", "clear",
     "enable", "disable", "active", "stats", "parse_spec",
-    "retry_call", "is_transient", "SITES",
+    "retry_call", "is_transient", "has_policy", "SITES",
 ]
 
 # The instrumented points (documentation + spec validation). check() with
@@ -80,9 +84,15 @@ SITES = (
     "datafeed.put",
     "serving.dispatch",
     "serving.reload",
+    "serving.replica",
+    "serving.route",
     "elastic.heartbeat",
     "elastic.rejoin",
 )
+
+# Site families whose instrumented points check dotted per-instance
+# sub-sites (``<family>.<i>``) in addition to the family name.
+_SUBSITE_FAMILIES = ("serving.replica",)
 
 
 class FaultInjected(MXNetError):
@@ -172,10 +182,22 @@ def parse_spec(spec: str) -> Dict[str, _Policy]:
         site, policy = part.split("=", 1)
         site = site.strip()
         policy = policy.strip()
-        if site != "*" and site not in SITES:
+        # dotted SUB-sites name one instance of a replicated layer —
+        # allowed ONLY for families whose instrumented points actually
+        # check per-instance sub-sites (currently serving.replica.<i>,
+        # the Router's replica targeting); accepting them under every
+        # site would let kvstore.push.0=once install and silently
+        # never fire, defeating the typo-catching point of SITES
+        if site != "*" and site not in SITES and not any(
+                site.startswith(fam + ".")
+                and site[len(fam) + 1:].isdigit()
+                for fam in _SUBSITE_FAMILIES):
             raise MXNetError(
                 f"unknown fault site {site!r}; known sites: "
-                f"{', '.join(SITES)} (or '*' for all)")
+                f"{', '.join(SITES)} (or '*' for all, or a per-instance "
+                "sub-site of " + "/".join(_SUBSITE_FAMILIES)
+                + " like serving.replica.0 — the suffix is the integer "
+                "instance index)")
         kind, _, arg = policy.partition(":")
         kind = kind.strip()
         try:
@@ -240,6 +262,17 @@ def disable() -> None:
 
 def active() -> bool:
     return _state.enabled
+
+
+def has_policy(site: str) -> bool:
+    """Is a policy installed for exactly ``site`` (no ``*`` fallback)?
+
+    For replicated layers whose instances check dotted sub-sites
+    (``serving.replica.<i>``): the family check already honours ``*``,
+    so instance checks guard on this to avoid double-counting the
+    wildcard policy's hits."""
+    with _lock:
+        return site in _sites
 
 
 def stats() -> Dict[str, Dict[str, int]]:

@@ -1156,6 +1156,17 @@ class KVStoreDistAsyncEmu(KVStoreTPUSync):
     are an approximation of the reference's, not a match — ADR-002
     records the decision (SURVEY.md §5.8 "deprecated with emulation
     shim").
+
+    **Lockstep push-count contract.** The replica sync triggers every
+    ``staleness`` pushes per key, counted process-locally, and runs a
+    collective — so every process must push every key the SAME number
+    of times (the natural shape: identical training loops over equal
+    step counts). Uneven per-key push counts would leave the fast
+    processes inside a psum the slow ones never join; the sync
+    therefore runs a bounded rendezvous first
+    (``MXNET_KV_BARRIER_TIMEOUT``, default 300 s) and raises
+    :class:`BarrierTimeoutError` naming the key and the missing ranks
+    instead of deadlocking. ADR-002 records the contract.
     """
 
     def __init__(self, type_name="dist_async"):
@@ -1220,11 +1231,49 @@ class KVStoreDistAsyncEmu(KVStoreTPUSync):
         """Average the process-local replicas: one psum over all
         processes' devices (each local device contributes replica /
         n_local, so every process has unit weight regardless of its
-        device count), then divide by the process count."""
+        device count), then divide by the process count.
+
+        LOCKSTEP CONTRACT (see the class docstring and ADR-002): the
+        sync fires every ``staleness`` pushes *per key*, counted
+        process-locally — so every process must push each key the same
+        number of times. Uneven per-key push counts leave some
+        processes inside this collective and others never arriving,
+        which would wedge the psum forever; a bounded rendezvous runs
+        first (``MXNET_KV_BARRIER_TIMEOUT``) and raises
+        :class:`BarrierTimeoutError` NAMING the key and the missing
+        ranks instead."""
         import jax
 
         if jax.process_count() == 1:
             return
+        client = _coord_client()
+        if client is not None:
+            # pre-collective rendezvous, bounded: the psum itself can
+            # give no diagnostic when a peer never joins
+            timeout = _barrier_timeout_s()
+            # ONE site string for both the sequence counter and the
+            # rendezvous keys: allocating under one name but announcing
+            # under another would let an identically-named user barrier
+            # (independent counter) alias this rendezvous's KV prefix
+            # and release ranks that never actually met
+            site = f"async_sync/{key}"
+            seq, key_ns = self._next_barrier_seq(site)
+            try:
+                # tight poll: this runs per key every `staleness` pushes
+                # on a throughput path — the default 50 ms tick would
+                # quantize every sync by up to a tick per rank
+                _cross_process_barrier(
+                    client, site, seq, self.rank,
+                    self.num_workers, timeout, poll_interval=0.003,
+                    key_ns=key_ns)
+            except BarrierTimeoutError as e:
+                raise BarrierTimeoutError(
+                    f"dist_async replica sync for key {key!r} (sync "
+                    f"#{seq}) timed out: not every process reached "
+                    f"push-count multiple {self._staleness} for this "
+                    "key — dist_async requires LOCKSTEP per-key push "
+                    "counts across processes (see ADR-002); underlying: "
+                    f"{e}") from e
         src = self._store[key]
         local = jax.local_devices()
         scaled = src.data / float(len(local))

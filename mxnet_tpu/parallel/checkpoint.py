@@ -31,11 +31,22 @@ the framework's own):
 
 Restore requires slice-compatible shardings (the natural case: same mesh
 shape and rules). A mismatched slice raises with the missing key named.
+
+Stale-file hygiene: a save into a directory that already holds a
+checkpoint writes ALL new data under ``.saving`` temp names first (the
+old checkpoint survives a crash anywhere in the data-write phase), then
+— behind a cross-process barrier — process 0 removes the old save
+wholesale (``meta.json`` first, so the directory is loudly invalid
+rather than a silent mix of two saves), every process renames its files
+into place, and process 0 commits by writing ``meta.json`` last.
+Restore validates the on-disk index set against ``meta.json``'s process
+count and refuses both truncated and stale-extra checkpoints.
 """
 from __future__ import annotations
 
 import json
 import os
+import re
 from typing import Dict
 
 import numpy as _np
@@ -43,6 +54,27 @@ import numpy as _np
 from ..base import MXNetError
 
 __all__ = ["save_sharded", "restore_sharded"]
+
+# the exact artifact names this format writes — stale-file hygiene and
+# restore validation both key off these
+_SHARD_RE = re.compile(r"shard-(\d{5})-of-(\d{5})\.params")
+_INDEX_RE = re.compile(r"index-(\d{5})\.json")
+# in-progress saves write under this suffix so a crash mid-save can
+# never destroy or masquerade as the committed checkpoint
+_TMP_SUFFIX = ".saving"
+
+
+def _checkpoint_files(directory):
+    """(shard files, index files, has_meta) already present."""
+    shards, indexes, has_meta = [], [], False
+    for f in os.listdir(directory):
+        if _SHARD_RE.fullmatch(f):
+            shards.append(f)
+        elif _INDEX_RE.fullmatch(f):
+            indexes.append(f)
+        elif f == "meta.json":
+            has_meta = True
+    return shards, indexes, has_meta
 
 
 def _slice_key(index, shape) -> str:
@@ -103,6 +135,7 @@ def save_sharded(step, directory: str) -> None:
     os.makedirs(directory, exist_ok=True)
     pid, nproc = jax.process_index(), jax.process_count()
     fname = f"shard-{pid:05d}-of-{nproc:05d}.params"
+    iname = f"index-{pid:05d}.json"
 
     entries: Dict[str, _np.ndarray] = {}
     meta_arrays = {}
@@ -118,10 +151,50 @@ def save_sharded(step, directory: str) -> None:
             seen.add(ikey)
             entries[f"{name}@{ikey}"] = _np.asarray(sh.data)
 
+    # New data lands under temp names FIRST: a crash anywhere in the
+    # (long) data-write phase leaves the previous checkpoint in this
+    # directory fully intact. Only after every process has its shard on
+    # disk does process 0 sweep the OLD checkpoint (meta.json first —
+    # from that instant the directory is loudly "no valid checkpoint",
+    # never a silent mix of two saves), then everyone renames into
+    # place and process 0 commits with meta.json LAST.
+    tmp = _TMP_SUFFIX
     index = serialization.save_indexed(
-        os.path.join(directory, fname), entries)
-    with open(os.path.join(directory, f"index-{pid:05d}.json"), "w") as f:
+        os.path.join(directory, fname + tmp), entries)
+    with open(os.path.join(directory, iname + tmp), "w") as f:
         json.dump({"file": fname, "entries": index}, f)
+    if nproc > 1:
+        from jax.experimental import multihost_utils
+
+        multihost_utils.sync_global_devices("mxnet_tpu_sharded_ckpt_data")
+    if pid == 0:
+        # stale-file hygiene: files of a previous checkpoint (same or
+        # DIFFERENT process count) must never be resolvable by the new
+        # checkpoint's restore — remove the old save wholesale, plus
+        # any temp litter from a crashed earlier attempt
+        shards, indexes, has_meta = _checkpoint_files(directory)
+        if has_meta:
+            os.unlink(os.path.join(directory, "meta.json"))
+        # this save's OWN temp files (every rank's, not just p0's) are
+        # the new checkpoint — only temp names outside the current
+        # topology's name set are litter from a crashed attempt
+        current = {f"shard-{p:05d}-of-{nproc:05d}.params{tmp}"
+                   for p in range(nproc)}
+        current |= {f"index-{p:05d}.json{tmp}" for p in range(nproc)}
+        litter = [f for f in os.listdir(directory)
+                  if f.endswith(tmp) and f not in current
+                  and (_SHARD_RE.fullmatch(f[:-len(tmp)])
+                       or _INDEX_RE.fullmatch(f[:-len(tmp)]))]
+        for f in indexes + shards + litter:
+            os.unlink(os.path.join(directory, f))
+    if nproc > 1:
+        from jax.experimental import multihost_utils
+
+        multihost_utils.sync_global_devices("mxnet_tpu_sharded_ckpt_clean")
+    os.replace(os.path.join(directory, fname + tmp),
+               os.path.join(directory, fname))
+    os.replace(os.path.join(directory, iname + tmp),
+               os.path.join(directory, iname))
     # cross-process barrier BEFORE the commit marker: meta.json is written
     # LAST by process 0, so a checkpoint with meta.json present has every
     # shard fully on disk — a crash mid-save can never masquerade as a
@@ -156,15 +229,31 @@ class _ShardReader:
     THIS process's own file first, so a same-topology restore touches
     only local data."""
 
-    def __init__(self, directory):
+    def __init__(self, directory, nproc: int):
         import jax
 
         self._dir = directory
         own = f"index-{jax.process_index():05d}.json"
         self._key_to_loc: Dict[str, tuple] = {}
         idx_files = sorted(
-            f for f in os.listdir(directory)
-            if f.startswith("index-") and f.endswith(".json"))
+            f for f in os.listdir(directory) if _INDEX_RE.fullmatch(f))
+        # validate the index set against meta.json's process count: a
+        # missing index means a truncated checkpoint, an EXTRA one is a
+        # stale file from an older save (different topology) whose
+        # slices must never resolve
+        pids = {int(_INDEX_RE.fullmatch(f).group(1)) for f in idx_files}
+        expected = set(range(int(nproc)))
+        if pids != expected:
+            missing = sorted(expected - pids)
+            stale = sorted(pids - expected)
+            raise MXNetError(
+                f"restore_sharded: index files in {directory!r} do not "
+                f"match meta.json (nproc={nproc})"
+                + (f"; missing index files for processes {missing}"
+                   if missing else "")
+                + (f"; stale index files from processes {stale} of an "
+                   "older checkpoint — clean the directory" if stale
+                   else ""))
         # own index LAST so its entries override other processes'
         for idx in [f for f in idx_files if f != own] + \
                 ([own] if own in idx_files else []):
@@ -241,7 +330,7 @@ def restore_sharded(step, directory: str, example_data=None) -> None:
             f"({len(step._state_leaf_nds)} leaves vs checkpoint "
             f"{meta['n_state_leaves']}) — same optimizer required")
 
-    reader = _ShardReader(directory)
+    reader = _ShardReader(directory, meta["nproc"])
     for name, nd in _named_arrays(step):
         rec = meta["arrays"].get(name)
         arr = nd.data

@@ -137,6 +137,7 @@ def dumps(reset=False, format="table"):
         return _dumps_chrome_trace(reset)
     if format != "table":
         raise ValueError(f"unknown dumps format {format!r}")
+    mem_lines = _memory_lines()     # outside _lock: touches jax/devices
     with _lock:
         now = time.perf_counter()
         paused = _paused_total
@@ -145,6 +146,7 @@ def dumps(reset=False, format="table"):
         lines = ["Profile Statistics:"]
         if paused > 0:
             lines.append(f"(excluded paused time: {paused * 1e3:.3f} ms)")
+        lines.extend(mem_lines)
         lines.append(f"{'Name':<40}{'Calls':>8}{'Total(ms)':>12}"
                      f"{'Min(ms)':>10}{'Max(ms)':>10}{'Avg(ms)':>10}")
         for name in sorted(_spans):
@@ -173,6 +175,34 @@ def dumps(reset=False, format="table"):
     if _trace_dir:
         out += f"\n(XProf device trace: {_trace_dir})"
     return out
+
+
+def _memory_lines():
+    """Per-device allocator lines for ``dumps()`` when
+    ``set_config(profile_memory=True)`` — the reference's memory
+    profiling view, backed by ``storage.pool_stats()`` (PjRt's BFC pool
+    counters). Platforms with no stats (CPU) report zeros rather than
+    vanishing, so the flag's effect is always visible."""
+    if not _config["profile_memory"]:
+        return []
+    try:
+        import jax
+
+        from . import storage
+        from .context import Context
+
+        lines = []
+        for dev in jax.local_devices():
+            st = storage.pool_stats(Context(dev.platform, dev.id))
+            lines.append(
+                f"Memory::{dev.platform}({dev.id})"
+                f"  bytes_in_use={st['bytes_in_use']}"
+                f"  peak_bytes_in_use={st['peak_bytes_in_use']}"
+                f"  bytes_limit={st['bytes_limit']}"
+                f"  num_allocs={st['num_allocs']}")
+        return lines
+    except Exception:  # pragma: no cover - stats are best-effort
+        return ["Memory:: (device stats unavailable)"]
 
 
 def _dumps_chrome_trace(reset=False):

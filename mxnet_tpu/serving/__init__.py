@@ -5,12 +5,29 @@ requests enter through ``Server.submit`` (thread-safe, Future out), a
 scheduler drains them into dynamic batches padded onto a
 ``BucketGrid`` — the ``BucketingModule`` idea (PAPER.md §2.3) re-keyed
 to compiled-graph cache entries — and dispatches each batch as one warm
-XLA executable under a per-request latency SLO. Hot reload, fault
+XLA executable under a per-request latency SLO. ``Router`` fronts N
+``Server`` replicas behind the same ``submit() -> Future`` contract
+with least-loaded dispatch, per-replica circuit breakers, bounded
+failover (no future is ever lost) and deadline-aware admission control
+(synchronous typed ``ServerOverloaded`` shedding). Hot reload, fault
 injection/retry and Prometheus telemetry ride the PR-1/PR-3
-infrastructure; see :mod:`.server`, :mod:`.buckets`, :mod:`.reload`.
+infrastructure; see :mod:`.server`, :mod:`.buckets`, :mod:`.reload`,
+:mod:`.router`, :mod:`.health`.
 """
 from .buckets import BucketGrid
+from .health import CircuitBreaker, Heartbeat
 from .reload import ReloadWatcher
+from .router import (
+    FailoverExhausted,
+    ReplicaFault,
+    Router,
+    ServerOverloaded,
+    live_routers,
+)
 from .server import Server, live_servers
 
-__all__ = ["Server", "BucketGrid", "ReloadWatcher", "live_servers"]
+__all__ = [
+    "Server", "BucketGrid", "ReloadWatcher", "live_servers",
+    "Router", "ServerOverloaded", "FailoverExhausted", "ReplicaFault",
+    "CircuitBreaker", "Heartbeat", "live_routers",
+]

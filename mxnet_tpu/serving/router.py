@@ -1,0 +1,873 @@
+"""``mx.serving.Router`` — overload-safe multi-replica dispatch.
+
+One :class:`~.server.Server` replica batches well (PR 6) but has no
+failure story: a wedged or crashing replica takes its queue down with
+it, and under overload it queues until every deadline blows. The router
+is the serving analogue of the elastic training runtime (PR 8): scale
+*as* a robustness layer. It fronts N ``Server`` replicas (one per
+device or device group) behind the same ``submit() -> Future`` contract
+and owns four concerns the single server cannot:
+
+* **Least-loaded dispatch.** Each request is forwarded to the healthy
+  replica with the fewest outstanding router-forwarded requests, so a
+  slow replica sheds load to its siblings instead of growing a queue.
+
+* **Health tracking.** A :class:`~.health.CircuitBreaker` per replica:
+  ``MXNET_SERVING_BREAKER_FAILURES`` consecutive dispatch failures trip
+  it OPEN, and so does a *hung dispatch* — the replica scheduler's
+  heartbeat (touched once per loop iteration) going silent past
+  ``MXNET_SERVING_DISPATCH_TIMEOUT`` while router requests are in
+  flight there (a scheduler patiently filling a batch keeps touching;
+  a wedged model dispatch does not). After a cooldown it goes HALF_OPEN
+  and exactly one live request is routed through it as a probe —
+  success re-admits the replica, failure re-opens it with a doubled
+  cooldown. Probes take priority over least-loaded choice so recovery
+  is detected under any traffic level.
+
+* **Failover — no future is ever lost.** A failed or hung replica's
+  in-flight requests are re-submitted to healthy replicas under a
+  bounded retry budget (``MXNET_SERVING_RETRY_BUDGET`` extra
+  dispatches, default 2). Every future submitted to the router
+  resolves: with a result, or with a typed error
+  (:class:`ServerOverloaded` at admission / queued past deadline,
+  :class:`FailoverExhausted` when the budget is spent,
+  :class:`MXNetError` on stop without drain). The first resolution
+  wins; a late result from a replica already declared hung is dropped.
+
+* **Admission control.** The router queue is bounded (``max_queue``)
+  and sheds by *predicted deadline miss*: completion timestamps give a
+  service-rate estimate, and a request whose predicted queue wait
+  exceeds its own deadline is rejected **synchronously** with
+  :class:`ServerOverloaded` — at 2x sustainable load the router keeps
+  serving at capacity with bounded latency instead of queueing every
+  request into a blown deadline (``tools/serving_bench.py`` overload
+  stage gates goodput >= 90% of measured capacity).
+
+A scheduler-liveness watchdog (the PR-8 heartbeat pattern, in-process
+via :class:`~.health.Heartbeat`) covers the router's own dispatcher
+thread: if the loop goes silent past ``MXNET_SERVING_WATCHDOG_TIMEOUT``
+the monitor fails every queued future loudly and stops admission — a
+wedged dispatcher must not turn into a queue nobody drains.
+
+Fault sites: ``serving.route`` fires on every routing decision (a
+transient routing fault costs one unit of the request's retry budget,
+not replica health); ``serving.replica`` (and the per-instance
+``serving.replica.<index>`` sub-sites) fire inside a replica's dispatch
+— an injected fault there is a replica failure, a ``latency:S`` policy
+past the dispatch timeout is a hang. ``tools/chaos_check.py``'s serving
+gate kills one replica mid-traffic this way and asserts zero lost
+futures, survivor bit-identity, and half-open re-admission.
+
+Telemetry: ``mxnet_serving_replica_healthy{replica}`` (1 closed /
+0.5 half-open / 0 open), ``mxnet_serving_breaker_transitions_total``,
+``mxnet_serving_shed_total{reason}``,
+``mxnet_serving_failover_total{replica}``,
+``mxnet_serving_route_retry_total{reason}``,
+``mxnet_serving_router_queue_depth``,
+``mxnet_serving_router_queue_wait_seconds``.
+"""
+from __future__ import annotations
+
+import threading
+import time
+import weakref
+from collections import deque
+from concurrent.futures import Future
+from typing import List, Optional, Sequence
+
+import numpy as np
+
+from .. import fault, telemetry
+from ..base import MXNetError
+from ..fault import _state as _fault_state
+from ..telemetry import _state as _telemetry_state
+from .health import (
+    CLOSED,
+    HALF_OPEN,
+    OPEN,
+    CircuitBreaker,
+    Heartbeat,
+    _env_float,
+)
+from .server import Server
+
+__all__ = ["Router", "ServerOverloaded", "FailoverExhausted",
+           "ReplicaFault", "live_routers"]
+
+# every running router, for the test-suite leak guard (mirrors
+# server._live_servers)
+_live_routers = weakref.WeakSet()
+
+
+def live_routers():
+    """Routers whose dispatcher thread is currently running."""
+    return [r for r in list(_live_routers) if r.is_running]
+
+
+class ServerOverloaded(MXNetError):
+    """Typed admission-control rejection: the router queue is full, the
+    predicted queue wait exceeds the request's deadline, or the request's
+    deadline expired while it was still queued. Synchronous at
+    ``submit`` whenever the overload is knowable there — never a hung
+    future."""
+
+
+class FailoverExhausted(MXNetError):
+    """A request failed on every replica it was routed to and its retry
+    budget (``MXNET_SERVING_RETRY_BUDGET``) is spent. Chained to the
+    last underlying replica error."""
+
+
+class ReplicaFault(MXNetError):
+    """An injected ``serving.replica`` fault: the replica 'crashed' on
+    this dispatch. Deliberately NOT retry-transient — a killed replica
+    must fail over at the router, not retry locally inside the corpse."""
+
+
+_HEALTH_VALUE = {CLOSED: 1.0, HALF_OPEN: 0.5, OPEN: 0.0}
+
+
+class _RouteReq:
+    """One routed request: the router-facing future plus retry state.
+    ``resolve_*`` are first-wins (a failover copy and a late replica
+    result may race) and always leave the future resolved."""
+
+    __slots__ = ("sample", "future", "t_enqueue", "deadline", "attempts",
+                 "started", "_lock")
+
+    def __init__(self, sample, deadline_s: float):
+        self.sample = sample
+        self.future = Future()
+        self.t_enqueue = time.perf_counter()
+        self.deadline = self.t_enqueue + deadline_s
+        self.attempts = 0          # dispatch attempts so far
+        self.started = False       # set_running_or_notify_cancel done
+        self._lock = threading.Lock()
+
+    def begin(self) -> bool:
+        """First dispatch: flip the future to RUNNING; False if the
+        caller already cancelled it."""
+        if self.started:
+            return True
+        if not self.future.set_running_or_notify_cancel():
+            return False
+        self.started = True
+        return True
+
+    def resolve_result(self, result) -> bool:
+        with self._lock:
+            if self.future.done():
+                return False
+            if not self.started:
+                if not self.future.set_running_or_notify_cancel():
+                    return False
+                self.started = True
+            self.future.set_result(result)
+            return True
+
+    def resolve_exc(self, exc: BaseException) -> bool:
+        with self._lock:
+            if self.future.done():
+                return False
+            if not self.started:
+                if not self.future.set_running_or_notify_cancel():
+                    return False
+                self.started = True
+            self.future.set_exception(exc)
+            return True
+
+
+class _Flight:
+    """One request currently forwarded to one replica."""
+
+    __slots__ = ("req", "ridx", "t_sent", "rfut", "probe")
+
+    def __init__(self, req, ridx, t_sent, probe):
+        self.req = req
+        self.ridx = ridx
+        self.t_sent = t_sent
+        self.rfut = None
+        self.probe = probe
+
+
+class _Replica:
+    """Router-side state for one managed Server replica."""
+
+    __slots__ = ("server", "index", "breaker", "inflight", "n_ok",
+                 "n_failed", "last_state")
+
+    def __init__(self, server: Server, index: int,
+                 failure_threshold, cooldown_s):
+        self.server = server
+        self.index = index
+        self.breaker = CircuitBreaker(
+            server.name, failure_threshold=failure_threshold,
+            cooldown_s=cooldown_s)
+        self.inflight = 0          # router-forwarded, not yet resolved
+        self.n_ok = 0
+        self.n_failed = 0
+        self.last_state = CLOSED   # for transition counting
+
+
+class Router:
+    """Front N ``Server`` replicas behind one ``submit() -> Future``.
+
+    ::
+
+        reps = [serving.Server(build_net(), name=f"r{i}", ...)
+                for i in range(n)]
+        router = serving.Router(reps, slo_ms=50).start()
+        fut = router.submit(sample)          # same contract as Server
+        out = fut.result()                   # result or typed error
+        router.stop()
+
+    Replicas must share one bucket grid (same batch and shape buckets):
+    responses must be bit-identical whichever replica serves them, and
+    that only holds at matched buckets. ``start()`` starts replicas
+    that are not already running; ``stop()`` stops every replica
+    (pass ``stop_replicas=False`` to leave them serving).
+    """
+
+    def __init__(self, replicas: Sequence[Server],
+                 slo_ms: Optional[float] = None,
+                 max_queue: int = 4096,
+                 retry_budget: Optional[int] = None,
+                 dispatch_timeout_s: Optional[float] = None,
+                 watchdog_timeout_s: Optional[float] = None,
+                 name: Optional[str] = None):
+        replicas = list(replicas)
+        if not replicas:
+            raise MXNetError("Router needs at least one Server replica")
+        g0 = replicas[0].grid
+        for s in replicas[1:]:
+            if s.grid.batch_buckets != g0.batch_buckets or \
+                    s.grid.shape_buckets != g0.shape_buckets:
+                raise MXNetError(
+                    f"replica {s.name} has a different bucket grid than "
+                    f"{replicas[0].name} — replicas must share one grid "
+                    "(matched-bucket bit-identity)")
+        names = [s.name for s in replicas]
+        if len(set(names)) != len(names):
+            raise MXNetError(f"replica names must be unique, got {names}")
+        if max_queue < 1:
+            raise MXNetError(f"max_queue must be >= 1, got {max_queue}")
+        if retry_budget is None:
+            retry_budget = int(_env_float("MXNET_SERVING_RETRY_BUDGET", 2))
+        if retry_budget < 0:
+            raise MXNetError(
+                f"retry_budget must be >= 0, got {retry_budget}")
+        if dispatch_timeout_s is None:
+            dispatch_timeout_s = _env_float(
+                "MXNET_SERVING_DISPATCH_TIMEOUT", 30.0)
+        if dispatch_timeout_s < 0.2:
+            # an idle replica scheduler touches its heartbeat every
+            # <=0.1 s wait tick; a timeout inside that granularity
+            # would declare healthy replicas hung
+            raise MXNetError(
+                "dispatch timeout must be >= 0.2 s (scheduler "
+                f"heartbeat granularity), got {dispatch_timeout_s}")
+        if watchdog_timeout_s is None:
+            watchdog_timeout_s = _env_float(
+                "MXNET_SERVING_WATCHDOG_TIMEOUT", 5.0)
+        if watchdog_timeout_s <= 0:
+            raise MXNetError(
+                f"watchdog timeout must be > 0, got {watchdog_timeout_s}")
+        self.name = name or f"router_{id(self):x}"
+        self.grid = g0
+        self.slo_s = (slo_ms / 1e3 if slo_ms is not None
+                      else replicas[0].slo_s)
+        if self.slo_s <= 0:
+            raise MXNetError(f"slo_ms must be > 0, got {slo_ms}")
+        self.max_queue = int(max_queue)
+        self.retry_budget = int(retry_budget)
+        self.dispatch_timeout_s = float(dispatch_timeout_s)
+        self.watchdog_timeout_s = float(watchdog_timeout_s)
+        self._replicas: List[_Replica] = [
+            _Replica(s, i, None, None) for i, s in enumerate(replicas)]
+
+        self._cond = threading.Condition()
+        self._queue: deque = deque()
+        self._flights: dict = {}            # id(flight) -> _Flight
+        self._n_inflight = 0
+        self._done_ts: deque = deque(maxlen=64)   # completion timestamps
+        # predicted-wait shedding arms only past this backlog (queued +
+        # in flight): below a couple of full fleet batches the observed
+        # completion rate measures demand, not capacity, and a burst
+        # into an idle fleet would shed against a spuriously low
+        # estimate. Backlog counts IN-FLIGHT too — under overload the
+        # requests pile up in the replica queues, not the router's.
+        self._shed_arm_pending = max(
+            32, 2 * self.grid.max_batch * len(self._replicas))
+        self._accepting = False
+        self._running = False
+        self._wedged = False
+        self._routing: Optional[_RouteReq] = None   # popped, in _route
+        self._thread: Optional[threading.Thread] = None
+        self._monitor: Optional[threading.Thread] = None
+        self._monitor_stop = threading.Event()
+        self.hb = Heartbeat()
+        # always-on light counters (telemetry has the full story)
+        self.n_requests = 0
+        self.n_shed = 0
+        self.n_failovers = 0
+        self.n_ok = 0
+        self.n_errors = 0
+
+    # -- replica fault plumbing ----------------------------------------
+    def _replica_fault_hook(self, r: _Replica):
+        """The ``serving.replica`` injection point, run INSIDE the
+        replica's scheduler thread per dispatched batch. An injected
+        fault is wrapped :class:`ReplicaFault` (non-transient: the
+        replica's own ``serving.dispatch`` retry must NOT resurrect a
+        killed replica — failover at the router is the recovery path);
+        a ``latency:S`` policy sleeps here, which is exactly a hung
+        dispatch."""
+        name, idx = r.server.name, r.index
+
+        def hook(sig):
+            if not _fault_state.enabled:
+                return
+            sub = f"serving.replica.{idx}"
+            try:
+                fault.check("serving.replica", f"{name} batch={sig}")
+                if fault.has_policy(sub):   # no double-count under '*'
+                    fault.check(sub, f"{name} batch={sig}")
+            except fault.FaultInjected as e:
+                raise ReplicaFault(
+                    f"replica {name} (index {idx}) failed: {e}") from e
+        return hook
+
+    # -- lifecycle -----------------------------------------------------
+    @property
+    def is_running(self) -> bool:
+        return self._running or (self._thread is not None
+                                 and self._thread.is_alive())
+
+    def start(self) -> "Router":
+        if self.is_running:
+            raise MXNetError(f"{self.name}: already running")
+        for r in self._replicas:
+            # hooks live only while the router does: an orphaned hook on
+            # a server kept serving standalone would raise ReplicaFault
+            # (deliberately non-transient) with no failover layer left
+            r.server._pre_dispatch = self._replica_fault_hook(r)
+            if not r.server.is_running:
+                r.server.start()
+        self._accepting = True
+        self._running = True
+        self._wedged = False
+        self.hb.touch()
+        self._thread = threading.Thread(
+            target=self._dispatch_loop, name=self.name, daemon=True)
+        self._thread.start()
+        self._monitor_stop.clear()
+        self._monitor = threading.Thread(
+            target=self._monitor_loop, name=f"{self.name}-monitor",
+            daemon=True)
+        self._monitor.start()
+        _live_routers.add(self)
+        return self
+
+    def stop(self, drain: bool = True, timeout: Optional[float] = None,
+             stop_replicas: bool = True) -> None:
+        """Stop the router. ``drain=True`` (default) routes every queued
+        request and waits (bounded by ``timeout``) for in-flight ones;
+        ``drain=False`` fails queued futures with :class:`MXNetError`
+        (in-flight ones still resolve through their replicas)."""
+        deadline = (time.monotonic() + timeout) if timeout is not None \
+            else None
+        with self._cond:
+            self._accepting = False
+            if not drain:
+                pending, self._queue = list(self._queue), deque()
+            else:
+                pending = []
+            self._cond.notify_all()
+        self._fail_queued(pending)
+        if drain:
+            with self._cond:
+                while self._queue or self._n_inflight:
+                    if deadline is not None and \
+                            time.monotonic() >= deadline:
+                        break
+                    self._cond.wait(0.05)
+        with self._cond:
+            self._running = False
+            leftovers, self._queue = list(self._queue), deque()
+            self._cond.notify_all()
+        self._fail_queued(leftovers)    # drain timed out, queue wedged
+        self._monitor_stop.set()
+
+        def _remaining():
+            # ONE budget for the whole stop: joins and replica stops
+            # spend the same deadline (floored so a spent budget still
+            # makes each join/stop attempt briefly rather than hanging)
+            if deadline is None:
+                return None
+            return max(deadline - time.monotonic(), 0.1)
+
+        errors = []
+        for t in (self._thread, self._monitor):
+            if t is not None:
+                t.join(_remaining())
+                if t.is_alive():
+                    errors.append(MXNetError(
+                        f"{self.name}: thread {t.name} did not exit "
+                        f"within {timeout}s"))
+        self._thread = None
+        self._monitor = None
+        # belt for the stop-vs-failover race: anything that slipped
+        # into the queue after the leftovers sweep (a callback that won
+        # the requeue race an instant before _running flipped) has no
+        # consumer now — resolve it typed rather than strand it
+        with self._cond:
+            tail, self._queue = list(self._queue), deque()
+        self._fail_queued(tail)
+        for r in self._replicas:      # hooks die with the router, even
+            r.server._pre_dispatch = None   # when replicas keep serving
+        if stop_replicas:
+            for r in self._replicas:
+                srv = r.server
+                if not srv.is_running:
+                    continue
+                try:
+                    srv.stop(drain=drain, timeout=_remaining())
+                except MXNetError as e:   # a wedged replica must not
+                    errors.append(e)      # leak the rest un-stopped
+        _live_routers.discard(self)
+        if errors:
+            raise errors[0]
+
+    def _fail_queued(self, reqs) -> None:
+        """Resolve de-queued requests with the typed stopped error."""
+        for req in reqs:
+            if req.resolve_exc(MXNetError(
+                    f"{self.name}: router stopped before this request "
+                    "was dispatched")):
+                self._count_request("rejected")
+
+    def __enter__(self) -> "Router":
+        return self.start()
+
+    def __exit__(self, *exc) -> None:
+        self.stop(drain=not any(exc))
+
+    # -- admission -----------------------------------------------------
+    # completions older than the window do not inform the service-rate
+    # estimate, and gaps between completions are capped: idle time
+    # between traffic bursts is not service time, and counting it would
+    # make the router look slower than it is and shed spuriously
+    _PRED_WINDOW_S = 2.0
+    _PRED_GAP_CAP_S = 0.05
+
+    def _predicted_wait_locked(self, pending: int) -> float:
+        """Predicted time-to-completion for a request admitted now:
+        (pending work + two full fleet batches — the request waits out
+        the dispatch already RUNNING and then rides its OWN) over the
+        measured service rate (last <=64 completions inside a recent
+        window, busy time only). With fewer than 8 recent completions
+        there is no estimate — admit (the bounded queue still caps the
+        damage)."""
+        now = time.perf_counter()
+        ts = self._done_ts
+        while ts and now - ts[0] > self._PRED_WINDOW_S:
+            ts.popleft()
+        if len(ts) < 8:
+            return 0.0
+        busy = 0.0
+        prev = None
+        for t in ts:
+            if prev is not None:
+                busy += min(t - prev, self._PRED_GAP_CAP_S)
+            prev = t
+        busy += min(now - prev, self._PRED_GAP_CAP_S)
+        if busy <= 1e-6:
+            return 0.0
+        fleet_batch = self.grid.max_batch * len(self._replicas)
+        return (pending + 2 * fleet_batch) * busy / len(ts)
+
+    def submit(self, sample, deadline_ms: Optional[float] = None) -> Future:
+        """Enqueue one sample (no batch dimension) for the replica
+        fleet; same contract as :meth:`Server.submit`. Raises
+        synchronously — :class:`ServerOverloaded` on queue-full or a
+        predicted deadline miss, :class:`MXNetError` when stopped or no
+        shape bucket fits. Thread-safe."""
+        shape = getattr(sample, "shape", None)
+        if shape is None:
+            shape = np.asarray(sample).shape
+        self.grid.bucket_shape(shape)       # raises if no bucket fits
+        deadline_s = (deadline_ms / 1e3 if deadline_ms is not None
+                      else self.slo_s)
+        with self._cond:
+            if not self._accepting:
+                self._count_request("rejected")
+                raise MXNetError(f"{self.name}: router is not running")
+            pending = len(self._queue) + self._n_inflight
+            if pending >= self.max_queue:
+                self._shed_locked("queue_full")
+                raise ServerOverloaded(
+                    f"{self.name}: router queue full ({self.max_queue} "
+                    "requests queued or in flight)")
+            wait = (self._predicted_wait_locked(pending)
+                    if pending > self._shed_arm_pending else 0.0)
+            if wait > deadline_s:
+                self._shed_locked("predicted_wait")
+                raise ServerOverloaded(
+                    f"{self.name}: predicted queue wait {wait * 1e3:.1f}"
+                    f" ms exceeds the request deadline "
+                    f"{deadline_s * 1e3:.1f} ms ({pending} pending)")
+            req = _RouteReq(sample, deadline_s)
+            self._queue.append(req)
+            depth = len(self._queue)
+            self._cond.notify_all()
+        if _telemetry_state.enabled:
+            telemetry.set_router_queue_depth(depth)
+        return req.future
+
+    def _shed_locked(self, reason: str) -> None:
+        self.n_shed += 1
+        self.n_requests += 1
+        if _telemetry_state.enabled:
+            telemetry.record_serving_shed(reason)
+
+    def _count_request(self, outcome: str,
+                       t_enqueue: Optional[float] = None) -> None:
+        self.n_requests += 1
+        if outcome == "ok":
+            self.n_ok += 1
+        elif outcome == "error":
+            self.n_errors += 1
+        if _telemetry_state.enabled:
+            lat = (time.perf_counter() - t_enqueue
+                   if t_enqueue is not None else 0.0)
+            telemetry.record_router_request(lat, outcome)
+
+    # -- dispatcher ----------------------------------------------------
+    def _dispatch_loop(self) -> None:
+        try:
+            while True:
+                self.hb.touch()
+                with self._cond:
+                    while not self._queue and self._running:
+                        self._cond.wait(0.05)
+                        self.hb.touch()
+                    if not self._queue:
+                        return          # stopped, queue empty
+                    req = self._queue.popleft()
+                    # track the popped request IMMEDIATELY (same locked
+                    # section): if this thread wedges or dies anywhere
+                    # after the pop, the watchdog/containment must fail
+                    # THIS future too, not just the still-queued ones
+                    self._routing = req
+                    if _telemetry_state.enabled:
+                        telemetry.set_router_queue_depth(len(self._queue))
+                self._route(req)
+                self._routing = None
+        except BaseException:
+            # loud containment, same contract as Server: a dead
+            # dispatcher must not leave a queue nobody drains
+            self._fail_all_queued("dispatcher thread crashed")
+            raise
+
+    def _fail_all_queued(self, why: str) -> None:
+        with self._cond:
+            self._accepting = False
+            pending, self._queue = list(self._queue), deque()
+            routing = self._routing
+            self._cond.notify_all()
+        if routing is not None:
+            pending = [routing] + pending   # first-wins guards the race
+        for req in pending:                 # with a later un-wedge
+            if req.resolve_exc(MXNetError(f"{self.name}: {why}")):
+                self._count_request("error", t_enqueue=req.t_enqueue)
+
+    def _route(self, req: _RouteReq) -> None:
+        """Forward one request to the best replica, retrying admission
+        refusals briefly; requeues / resolves on terminal conditions."""
+        if req.future.done():
+            return      # already resolved (watchdog / late failover)
+        if not req.begin():
+            return                              # caller cancelled it
+        now = time.perf_counter()
+        if now >= req.deadline:
+            # shed-in-queue safety net: dispatching it would burn a
+            # replica slot on an already-dead request
+            if req.resolve_exc(ServerOverloaded(
+                    f"{self.name}: request deadline expired after "
+                    f"{(now - req.t_enqueue) * 1e3:.1f} ms in the router "
+                    f"queue ({req.attempts} dispatch attempt(s))")):
+                with self._cond:
+                    self._shed_locked("expired")
+            return
+        if _fault_state.enabled:
+            try:
+                fault.check("serving.route", f"{self.name}")
+            except fault.FaultInjected as e:
+                # a routing fault burns one unit of the request's
+                # budget (else every:1 would requeue forever) but is
+                # NOT replica health evidence
+                req.attempts += 1
+                self._retry_or_fail(req, e, reason="route_fault")
+                return
+        target = self._pick_replica()
+        if target is None:
+            # nothing healthy admits right now: put it back and let the
+            # dispatcher breathe (a breaker cooldown or an in-flight
+            # completion will move things)
+            with self._cond:
+                self._queue.appendleft(req)
+                self._cond.wait(0.005)
+            return
+        r, probe = target
+        flight = _Flight(req, r.index, time.perf_counter(), probe)
+        remaining_ms = max((req.deadline - time.perf_counter()) * 1e3,
+                           1.0)
+        with self._cond:
+            self._flights[id(flight)] = flight
+            r.inflight += 1
+            self._n_inflight += 1
+        try:
+            rfut = r.server.submit(req.sample, deadline_ms=remaining_ms)
+        except Exception as e:  # noqa: BLE001 - sync admission refusal
+            with self._cond:
+                # guard like _on_replica_done: the hung-dispatch sweep
+                # may have removed this flight (and decremented for it)
+                # between registration and the submit raising — an
+                # unconditional decrement would drive the counts
+                # negative and double-queue the request
+                live = self._flights.pop(id(flight), None) is not None
+                if live:
+                    r.inflight -= 1
+                    self._n_inflight -= 1
+                    self._cond.notify_all()
+            if not live:
+                return      # the sweep owns this request's fate now
+            if probe:
+                r.breaker.release_probe()
+            if isinstance(e, MXNetError) and not r.server.is_running:
+                # replica died between health check and submit
+                r.breaker.record_failure()
+                self._retry_or_fail(req, e, reason="replica_down",
+                                    replica=r)
+            else:
+                # queue-full style refusal: not a health event; retry
+                # the route (does not burn the retry budget — the
+                # request was never dispatched)
+                if _telemetry_state.enabled:
+                    telemetry.record_serving_route_retry("refused")
+                with self._cond:
+                    self._queue.appendleft(req)
+                    self._cond.wait(0.002)
+            return
+        req.attempts += 1
+        flight.rfut = rfut
+        if _telemetry_state.enabled:
+            telemetry.record_router_queue_wait(
+                flight.t_sent - req.t_enqueue)
+        rfut.add_done_callback(
+            lambda f, fl=flight: self._on_replica_done(fl, f))
+
+    def _pick_replica(self):
+        """(replica, is_probe) — HALF_OPEN probes first (recovery must
+        be detected under any traffic), then least-loaded CLOSED."""
+        live = [r for r in self._replicas if r.server.is_running]
+        for r in live:
+            if r.breaker.state == HALF_OPEN and r.breaker.admit():
+                return r, True
+        closed = [r for r in live if r.breaker.state == CLOSED]
+        for r in sorted(closed, key=lambda r: r.inflight):
+            if r.breaker.admit():
+                return r, False
+        return None
+
+    def _on_replica_done(self, flight: _Flight, rfut) -> None:
+        """Replica future resolved (runs on the replica's scheduler
+        thread — keep it quick). ``late`` = the hung-dispatch sweep
+        already removed this flight and failed it over; its breaker
+        verdict stands, but a late SUCCESS is still a usable result
+        (first resolution wins)."""
+        with self._cond:
+            late = self._flights.pop(id(flight), None) is None
+            if not late:
+                self._replicas[flight.ridx].inflight -= 1
+                self._n_inflight -= 1
+                self._cond.notify_all()
+        r = self._replicas[flight.ridx]
+        try:
+            exc = rfut.exception()
+        except BaseException as e:  # noqa: BLE001 - cancelled etc.
+            exc = e
+        if exc is None:
+            if not late:
+                r.breaker.record_success()
+                r.n_ok += 1
+                with self._cond:
+                    self._done_ts.append(time.perf_counter())
+            if flight.req.resolve_result(rfut.result()):
+                self._count_request("ok", t_enqueue=flight.req.t_enqueue)
+            return
+        if late:
+            return                  # hung flight already failed over
+        r.breaker.record_failure()
+        r.n_failed += 1
+        self._retry_or_fail(flight.req, exc, reason="replica_error",
+                            replica=r)
+
+    def _retry_or_fail(self, req: _RouteReq, exc: BaseException,
+                       reason: str, replica: Optional[_Replica] = None
+                       ) -> None:
+        """Failover: requeue at the FRONT (it has waited longest) under
+        the retry budget, else resolve with a typed error. Never leaves
+        the future unresolved."""
+        if req.future.done():
+            return
+        if _telemetry_state.enabled:
+            telemetry.record_serving_route_retry(reason)
+        budget = 1 + self.retry_budget           # total dispatches
+        requeued = False
+        if req.attempts < budget:
+            # re-check _running in the SAME critical section as the
+            # requeue: a stop() racing between a stale check and the
+            # appendleft would strand the request in a queue with no
+            # consumer — a lost future
+            with self._cond:
+                if self._running:
+                    self._queue.appendleft(req)
+                    self._cond.notify_all()
+                    requeued = True
+        if requeued:
+            self.n_failovers += 1
+            if _telemetry_state.enabled and replica is not None:
+                telemetry.record_serving_failover(replica.server.name)
+            return
+        detail = (f" (last replica: {replica.server.name})"
+                  if replica is not None else "")
+        if req.resolve_exc(FailoverExhausted(
+                f"{self.name}: request failed after {req.attempts} "
+                f"dispatch attempt(s), retry budget "
+                f"{self.retry_budget} spent{detail}: {exc}")):
+            self._count_request("error", t_enqueue=req.t_enqueue)
+
+    # -- monitor: hung dispatches, breaker gauges, watchdog ------------
+    def _monitor_loop(self) -> None:
+        interval = min(0.05, self.dispatch_timeout_s / 4)
+        while not self._monitor_stop.wait(interval):
+            self._sweep_hung()
+            self._publish_health()
+            self._check_dispatcher()
+
+    def _take_flights_of(self, r: _Replica) -> list:
+        """Remove and return every flight currently at replica ``r``
+        (their late resolutions, if any, are dropped first-wins)."""
+        with self._cond:
+            mine = [f for f in self._flights.values()
+                    if f.ridx == r.index]
+            for f in mine:
+                self._flights.pop(id(f), None)
+                r.inflight -= 1
+                self._n_inflight -= 1
+            if mine:
+                self._cond.notify_all()
+        return mine
+
+    def _sweep_hung(self) -> None:
+        """Hung-dispatch detection. Primary signal: a replica's
+        scheduler heartbeat (touched once per loop iteration, so
+        between touches at most ONE dispatch runs) stale past the
+        dispatch timeout while it has router flights outstanding — a
+        scheduler patiently filling a batch keeps touching, a wedged
+        dispatch does not. Trip the breaker and fail over EVERY flight
+        at that replica at once. Backstop: any single flight
+        outstanding a full timeout past its own deadline (a live
+        replica resolves by the deadline — its batch closes at
+        deadline - margin) fails over too, so a silently dropped
+        callback can never strand a future."""
+        now = time.perf_counter()
+        hung: List = []
+        for r in self._replicas:
+            srv = r.server
+            if not srv.is_running:
+                continue        # crash containment fails its futures
+            with self._cond:
+                busy = r.inflight > 0
+            if busy and srv.hb.stale(self.dispatch_timeout_s):
+                r.breaker.record_hang()
+                taken = self._take_flights_of(r)
+                r.n_failed += len(taken)
+                age = srv.hb.age()
+                for f in taken:
+                    hung.append((f, r, MXNetError(
+                        f"replica {srv.name} scheduler silent for "
+                        f"{age:.2f}s > MXNET_SERVING_DISPATCH_TIMEOUT="
+                        f"{self.dispatch_timeout_s:g}s with this "
+                        "request in flight (hung dispatch)")))
+        with self._cond:
+            overdue = [f for f in self._flights.values()
+                       if now > max(f.req.deadline, f.t_sent)
+                       + self.dispatch_timeout_s]
+            for f in overdue:
+                self._flights.pop(id(f), None)
+                self._replicas[f.ridx].inflight -= 1
+                self._n_inflight -= 1
+            if overdue:
+                self._cond.notify_all()
+        for f in overdue:
+            r = self._replicas[f.ridx]
+            r.breaker.record_hang()
+            r.n_failed += 1
+            hung.append((f, r, MXNetError(
+                f"dispatch at replica {r.server.name} still "
+                f"outstanding {self.dispatch_timeout_s:g}s past the "
+                "request deadline (unresponsive replica)")))
+        for f, r, err in hung:
+            self._retry_or_fail(f.req, err, reason="hung", replica=r)
+
+    def _publish_health(self) -> None:
+        for r in self._replicas:
+            state = r.breaker.state
+            if state != r.last_state:
+                if _telemetry_state.enabled:
+                    telemetry.record_breaker_transition(
+                        r.server.name, state)
+                r.last_state = state
+            if _telemetry_state.enabled:
+                telemetry.set_replica_health(
+                    r.server.name, _HEALTH_VALUE[state])
+
+    def _check_dispatcher(self) -> None:
+        if self._wedged or not self._running:
+            return
+        t = self._thread
+        dead = t is not None and not t.is_alive()
+        stale = self.hb.stale(self.watchdog_timeout_s)
+        if not (dead or stale):
+            return
+        # the dispatcher is gone or wedged: requests already forwarded
+        # will still resolve through their replicas, but the queue has
+        # no consumer — fail it loudly NOW (zero hung futures), and
+        # stop admitting
+        self._wedged = True
+        why = ("dispatcher thread died" if dead else
+               f"dispatcher silent for {self.hb.age():.1f}s > "
+               f"MXNET_SERVING_WATCHDOG_TIMEOUT="
+               f"{self.watchdog_timeout_s:g}s (wedged)")
+        self._fail_all_queued(f"scheduler-liveness watchdog: {why}")
+
+    # -- introspection -------------------------------------------------
+    def stats(self) -> dict:
+        with self._cond:
+            depth = len(self._queue)
+            inflight = self._n_inflight
+        return {
+            "requests": self.n_requests, "ok": self.n_ok,
+            "errors": self.n_errors, "shed": self.n_shed,
+            "failovers": self.n_failovers, "queue_depth": depth,
+            "inflight": inflight, "running": self.is_running,
+            "wedged": self._wedged,
+            "replicas": [
+                {"name": r.server.name, "index": r.index,
+                 "state": r.breaker.state, "inflight": r.inflight,
+                 "ok": r.n_ok, "failed": r.n_failed,
+                 "trips": r.breaker.n_trips}
+                for r in self._replicas],
+        }

@@ -46,6 +46,7 @@ from ..base import MXNetError
 from ..fault import _state as _fault_state
 from ..telemetry import _state as _telemetry_state
 from .buckets import BucketGrid
+from .health import Heartbeat
 
 __all__ = ["Server", "live_servers"]
 
@@ -124,6 +125,16 @@ class Server:
         self._running = False
         self._thread: Optional[threading.Thread] = None
         self._watcher = None        # reload.ReloadWatcher, when enabled
+        # pre-dispatch hook, set by serving.Router on managed replicas:
+        # runs INSIDE run() (the retried dispatch body) so an injected
+        # replica fault / latency lands exactly where a real replica
+        # failure would — in this scheduler thread, per batch
+        self._pre_dispatch = None
+        # scheduler-loop liveness beacon: touched once per loop
+        # iteration (so between two touches at most ONE dispatch runs).
+        # A Router reads it to tell a *hung* dispatch from a scheduler
+        # patiently filling a batch toward its deadline close.
+        self.hb = Heartbeat()
         self.loaded_step: Optional[int] = None
         # signatures actually compiled/used — the reload warmup manifest
         self._warm_sigs = set()
@@ -222,6 +233,7 @@ class Server:
     def _scheduler_loop(self) -> None:
         try:
             while True:
+                self.hb.touch()
                 batch, reason = self._next_batch()
                 if batch is None:
                     return
@@ -244,6 +256,7 @@ class Server:
         or (None, None) on shutdown with an empty queue."""
         with self._cond:
             while True:
+                self.hb.touch()
                 if not self._queue:
                     if not self._running:
                         return None, None
@@ -305,6 +318,9 @@ class Server:
         sig = (cap,) + key
 
         def run():
+            hook = self._pre_dispatch
+            if hook is not None:
+                hook(sig)
             if _fault_state.enabled:
                 fault.check("serving.dispatch", f"{self.name} batch={sig}")
             x = nd_array(payload, ctx=self.ctx)

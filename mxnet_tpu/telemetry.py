@@ -56,6 +56,10 @@ __all__ = [
     "record_serving_request", "record_serving_batch",
     "record_serving_queue_time", "set_serving_queue_depth",
     "record_serving_reload",
+    "record_serving_shed", "record_serving_failover",
+    "record_serving_route_retry", "record_router_queue_wait",
+    "set_router_queue_depth", "set_replica_health",
+    "record_breaker_transition", "record_router_request",
     "TrainingTelemetry", "xla_cost_analysis",
     "pop_telemetry_out_flag", "write_snapshot",
     "LATENCY_BUCKETS", "STEP_BUCKETS", "SEGMENT_BUCKETS",
@@ -771,6 +775,101 @@ def record_serving_reload(seconds: float, outcome: str = "ok") -> None:
         histogram("mxnet_serving_reload_seconds",
                   "Wall time to build, warm and swap in a reloaded "
                   "model.", buckets=STEP_BUCKETS).observe(seconds)
+
+
+def record_router_request(seconds: float, outcome: str = "ok") -> None:
+    """One Router-level request resolution. A SEPARATE family from
+    ``mxnet_serving_requests_total``: every routed request is also
+    counted by the replica Server that served it, and after a failover
+    the layers legitimately disagree (replica error, router ok) — one
+    shared counter would double-count RPS and mix the two stories."""
+    if not _state.enabled:
+        return
+    counter("mxnet_serving_router_requests_total",
+            "Router requests by final outcome (ok/error/rejected).",
+            ("outcome",)).labels(outcome).inc()
+    if outcome != "rejected":
+        histogram("mxnet_serving_router_request_seconds",
+                  "End-to-end router request latency (submit to future "
+                  "resolution).", buckets=SERVING_BUCKETS).observe(seconds)
+
+
+def record_serving_shed(reason: str) -> None:
+    """One request shed by the Router's admission control. ``reason``:
+    ``queue_full`` (bounded queue at capacity), ``predicted_wait``
+    (predicted queue wait exceeds the request's deadline) or
+    ``expired`` (deadline blew while queued — the in-queue safety
+    net)."""
+    if not _state.enabled:
+        return
+    counter("mxnet_serving_shed_total",
+            "Requests shed by router admission control, by reason "
+            "(queue_full/predicted_wait/expired).",
+            ("reason",)).labels(reason).inc()
+
+
+def record_serving_failover(replica: str) -> None:
+    """One request re-submitted away from a failed/hung replica."""
+    if not _state.enabled:
+        return
+    counter("mxnet_serving_failover_total",
+            "Requests failed over from a replica to a healthy sibling.",
+            ("replica",)).labels(replica).inc()
+
+
+def record_serving_route_retry(reason: str) -> None:
+    """One routing retry event at the Router. ``reason``:
+    ``route_fault`` (injected/transient routing failure),
+    ``replica_error`` (dispatch failed at the replica),
+    ``replica_down`` (replica stopped between health check and submit),
+    ``hung`` (dispatch exceeded the dispatch timeout), ``refused``
+    (replica queue refused the submit — retried, no budget burned)."""
+    if not _state.enabled:
+        return
+    counter("mxnet_serving_route_retry_total",
+            "Router routing retries, by reason (route_fault/"
+            "replica_error/replica_down/hung/refused).",
+            ("reason",)).labels(reason).inc()
+
+
+def record_router_queue_wait(seconds: float) -> None:
+    """Time one request spent in the ROUTER queue before being
+    forwarded to a replica (replica queue time is
+    ``mxnet_serving_time_in_queue_seconds``)."""
+    if not _state.enabled:
+        return
+    histogram("mxnet_serving_router_queue_wait_seconds",
+              "Time a request waited in the router queue before being "
+              "forwarded to a replica.",
+              buckets=SERVING_BUCKETS).observe(seconds)
+
+
+def set_router_queue_depth(depth: int) -> None:
+    """Requests currently waiting in the Router's global queue."""
+    if not _state.enabled:
+        return
+    gauge("mxnet_serving_router_queue_depth",
+          "Requests waiting in the serving router's global queue.").set(
+              depth)
+
+
+def set_replica_health(replica: str, value: float) -> None:
+    """Per-replica health gauge: 1 = closed (healthy), 0.5 = half-open
+    (probing), 0 = open (quarantined)."""
+    if not _state.enabled:
+        return
+    gauge("mxnet_serving_replica_healthy",
+          "Replica circuit-breaker health (1 closed / 0.5 half-open / "
+          "0 open).", ("replica",)).labels(replica).set(value)
+
+
+def record_breaker_transition(replica: str, to_state: str) -> None:
+    """One circuit-breaker state transition observed by the router."""
+    if not _state.enabled:
+        return
+    counter("mxnet_serving_breaker_transitions_total",
+            "Replica circuit-breaker state transitions, by target "
+            "state.", ("replica", "to")).labels(replica, to_state).inc()
 
 
 def record_training_step(seconds: float, examples: float,

@@ -56,17 +56,36 @@ def serving_leak_guard():
     yield
     import sys
 
+    # Both sweeps run BEFORE failing: a test that leaks a Router AND an
+    # unrelated standalone Server must have both stopped, or the
+    # surviving thread taxes every later test — routers first, since
+    # stopping a router stops its replicas too
+    problems = []
+    rmod = sys.modules.get("mxnet_tpu.serving.router")
+    if rmod is not None:
+        leaked_routers = rmod.live_routers()
+        if leaked_routers:
+            problems.append(
+                f"test left serving Router(s) running: "
+                f"{[r.name for r in leaked_routers]}; call stop() in "
+                "teardown or use the Router context manager")
+            for r in leaked_routers:
+                try:
+                    r.stop(drain=False, timeout=5)
+                except Exception:
+                    pass
     mod = sys.modules.get("mxnet_tpu.serving.server")
-    if mod is None:        # serving never imported: nothing to leak
-        return
-    leaked = mod.live_servers()
-    if leaked:
-        names = [s.name for s in leaked]
-        for s in leaked:
-            s.stop(drain=False)
-        pytest.fail(
-            f"test left serving Server(s) running: {names}; call "
-            "stop() in teardown or use the Server context manager")
+    if mod is not None:
+        leaked = mod.live_servers()
+        if leaked:
+            problems.append(
+                f"test left serving Server(s) running: "
+                f"{[s.name for s in leaked]}; call stop() in teardown "
+                "or use the Server context manager")
+            for s in leaked:
+                s.stop(drain=False)
+    if problems:
+        pytest.fail("; ".join(problems))
 
 
 @pytest.fixture(autouse=True)

@@ -290,11 +290,17 @@ class TestCreateGraph:
                 (x,) = self.saved_tensors
                 return 2 * x * dy
 
+        import pytest
+
         x = mx.nd.array([3.0])
         x.attach_grad()
         with autograd.record():
             y = Sq()(x)
-            g = autograd.grad(y, [x], create_graph=True)[0]
+            # the fallback is LOUD: zero saved-primal sensitivity is a
+            # contract, not a silent surprise (ADVICE r5)
+            with pytest.warns(RuntimeWarning,
+                              match="saved primals.*silently ZERO"):
+                g = autograd.grad(y, [x], create_graph=True)[0]
             assert abs(float(g.asnumpy()[0]) - 6.0) < 1e-6
             # g is live on the tape: downstream use is differentiable
             z = (g * g).sum()

@@ -73,6 +73,41 @@ class TestLocal:
         store.pull(0, out)
         np.testing.assert_allclose(out.asnumpy(), np.full((3,), -4.0))
 
+    def test_dist_async_sync_replicas_bounded_names_key(self, monkeypatch):
+        """Uneven per-key push counts must not wedge the replica-sync
+        psum forever (ADVICE r5): the pre-collective rendezvous is
+        bounded by MXNET_KV_BARRIER_TIMEOUT and the typed error names
+        the key, the lockstep contract, and ADR-002."""
+        import jax
+
+        from mxnet_tpu.kvstore import kvstore as kvmod
+
+        monkeypatch.setenv("MXNET_KVSTORE_DIST_ASYNC_EMU", "1")
+        store = kv.create("dist_async")
+        # fake a 2-process world where the peer never announces
+        monkeypatch.setattr(jax, "process_count", lambda: 2)
+        monkeypatch.setattr(jax, "process_index", lambda: 0)
+
+        class Stub:
+            def __init__(self):
+                self.d = {}
+
+            def key_value_set(self, k, v):
+                self.d[k] = v
+
+            def key_value_dir_get(self, p):
+                return [(k, v) for k, v in self.d.items()
+                        if k.startswith(p)]
+
+        monkeypatch.setattr(kvmod, "_coord_client", lambda: Stub())
+        monkeypatch.setenv("MXNET_KV_BARRIER_TIMEOUT", "0.15")
+        with pytest.raises(kv.BarrierTimeoutError) as ei:
+            store._sync_replicas("weight0")
+        msg = str(ei.value)
+        assert "'weight0'" in msg
+        assert "LOCKSTEP" in msg and "ADR-002" in msg
+        assert "missing ranks [1]" in msg
+
 
 class TestTPUSync:
     def test_push_is_one_collective(self):

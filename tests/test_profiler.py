@@ -39,6 +39,30 @@ class TestProfiler:
         assert "unit-counter" in table
         profiler.Marker("unit-marker").mark()
 
+    def test_profile_memory_reports_pool_stats(self):
+        """set_config(profile_memory=True) wires dumps() to
+        storage.pool_stats(): one Memory:: line per local device with
+        the allocator counters (zeros on CPU, which exposes no stats —
+        the line must still appear so the flag visibly works)."""
+        profiler.set_config(profile_memory=True)
+        try:
+            x = mx.nd.ones((8, 8))
+            (x * 2).asnumpy()
+            table = profiler.dumps()
+            mem = [ln for ln in table.splitlines()
+                   if ln.startswith("Memory::")]
+            import jax
+
+            assert len(mem) == len(jax.local_devices())
+            for ln in mem:
+                assert "bytes_in_use=" in ln
+                assert "peak_bytes_in_use=" in ln
+                assert "bytes_limit=" in ln
+        finally:
+            profiler.set_config(profile_memory=False)
+        assert not [ln for ln in profiler.dumps(reset=True).splitlines()
+                    if ln.startswith("Memory::")]
+
     def test_start_stop_trace(self, tmp_path):
         # device trace round-trip: start -> run a jitted op -> stop
         profiler.set_config(filename=str(tmp_path / "p.json"))

@@ -1,0 +1,589 @@
+"""Multi-replica serving router (mxnet_tpu/serving/router.py +
+health.py): circuit breaker cycle, least-loaded dispatch, failover
+bit-identity at matched buckets, shed-vs-queue admission boundary,
+hung-dispatch detection, scheduler-liveness watchdog, zero-lost-future
+invariant under ``serving.replica`` faults.
+
+Bitwise comparisons follow the test_serving.py discipline: matched
+batch buckets only (the same compiled executable) — replicas share one
+grid precisely so a failover cannot change a response's bits.
+"""
+import os
+import threading
+import time
+
+import numpy as np
+import pytest
+
+import mxnet_tpu as mx
+from mxnet_tpu import fault, serving, telemetry
+from mxnet_tpu.base import MXNetError
+from mxnet_tpu.gluon import nn
+from mxnet_tpu.serving.health import (
+    CLOSED, HALF_OPEN, OPEN, CircuitBreaker, Heartbeat,
+)
+from mxnet_tpu.serving.router import (
+    FailoverExhausted, ReplicaFault, Router, ServerOverloaded,
+)
+
+pytestmark = pytest.mark.serving
+
+
+def make_net(in_units=8, units=4, seed=0):
+    net = nn.Dense(units, in_units=in_units)
+    net.initialize()
+    rs = np.random.RandomState(seed)
+    net.weight.set_data(mx.nd.array(
+        rs.randn(units, in_units).astype(np.float32)))
+    net.bias.set_data(mx.nd.array(rs.randn(units).astype(np.float32)))
+    net.hybridize()
+    return net
+
+
+def make_replicas(n=2, slo_ms=30, seed=0, **kw):
+    return [serving.Server(make_net(seed=seed), batch_buckets=(2, 4),
+                           shape_buckets=[(8,)], slo_ms=slo_ms,
+                           name=f"rep{i}", **kw)
+            for i in range(n)]
+
+
+def traffic(n=16):
+    return [np.random.RandomState(100 + i).randn(8).astype(np.float32)
+            for i in range(n)]
+
+
+def single_replica_reference(xs):
+    """The bit-identity oracle: one Server over the same grid."""
+    srv = serving.Server(make_net(), batch_buckets=(2, 4),
+                         shape_buckets=[(8,)], slo_ms=30).start()
+    try:
+        return [srv.submit(x).result(timeout=30) for x in xs]
+    finally:
+        srv.stop()
+
+
+@pytest.fixture(autouse=True)
+def _fast_retry(monkeypatch):
+    monkeypatch.setenv("MXNET_COMM_RETRY_DELAY", "0.01")
+
+
+# ---------------------------------------------------------------------------
+# health.py: CircuitBreaker + Heartbeat units
+# ---------------------------------------------------------------------------
+
+class TestCircuitBreaker:
+    def _brk(self, **kw):
+        self.now = [0.0]
+        kw.setdefault("failure_threshold", 3)
+        kw.setdefault("cooldown_s", 1.0)
+        return CircuitBreaker("b", time_fn=lambda: self.now[0], **kw)
+
+    def test_closed_admits_and_failures_below_threshold_stay_closed(self):
+        b = self._brk()
+        assert b.state == CLOSED and b.admit()
+        b.record_failure()
+        b.record_failure()
+        assert b.state == CLOSED and b.admit()
+        b.record_success()          # success resets the streak
+        b.record_failure()
+        b.record_failure()
+        assert b.state == CLOSED
+
+    def test_threshold_trips_open_and_open_refuses(self):
+        b = self._brk()
+        for _ in range(3):
+            b.record_failure()
+        assert b.state == OPEN and not b.admit()
+        assert b.n_trips == 1
+
+    def test_open_half_open_close_cycle(self):
+        b = self._brk()
+        for _ in range(3):
+            b.record_failure()
+        self.now[0] = 0.5
+        assert not b.admit()                 # cooldown not elapsed
+        self.now[0] = 1.01
+        assert b.state == HALF_OPEN
+        assert b.admit()                     # THE probe
+        assert not b.admit()                 # only one probe at a time
+        b.record_success()
+        assert b.state == CLOSED and b.admit()
+        assert b.describe()["cooldown_s"] == 1.0   # streak reset
+
+    def test_probe_failure_reopens_with_doubled_cooldown(self):
+        b = self._brk()
+        for _ in range(3):
+            b.record_failure()
+        self.now[0] = 1.01
+        assert b.admit()
+        b.record_failure()                   # probe failed
+        assert b.state == OPEN and b.n_trips == 2
+        self.now[0] = 2.5                    # 1.01 + 1.49 < 2x cooldown
+        assert b.state == OPEN
+        self.now[0] = 3.02                   # past the doubled cooldown
+        assert b.state == HALF_OPEN
+
+    def test_hang_trips_immediately(self):
+        b = self._brk()
+        b.record_hang()
+        assert b.state == OPEN and b.n_trips == 1
+
+    def test_release_probe_frees_the_slot(self):
+        b = self._brk()
+        b.record_hang()
+        self.now[0] = 1.01
+        assert b.admit() and not b.admit()
+        b.release_probe()
+        assert b.admit()
+
+    def test_late_failure_while_open_is_ignored(self):
+        b = self._brk()
+        b.record_hang()
+        b.record_failure()                   # late verdict, no new trip
+        assert b.n_trips == 1
+
+    def test_validation(self):
+        with pytest.raises(MXNetError, match="threshold"):
+            CircuitBreaker(failure_threshold=0)
+        with pytest.raises(MXNetError, match="cooldown"):
+            CircuitBreaker(cooldown_s=0)
+
+
+def test_heartbeat_staleness():
+    hb = Heartbeat()
+    assert not hb.stale(0.2)
+    time.sleep(0.25)
+    assert hb.stale(0.2)
+    hb.touch()
+    assert not hb.stale(0.2)
+
+
+# ---------------------------------------------------------------------------
+# Router construction / validation
+# ---------------------------------------------------------------------------
+
+class TestRouterValidation:
+    def test_needs_replicas(self):
+        with pytest.raises(MXNetError, match="at least one"):
+            Router([])
+
+    def test_grids_must_match(self):
+        a = serving.Server(make_net(), batch_buckets=(2, 4),
+                           shape_buckets=[(8,)], name="a")
+        b = serving.Server(make_net(), batch_buckets=(2, 8),
+                           shape_buckets=[(8,)], name="b")
+        with pytest.raises(MXNetError, match="different bucket grid"):
+            Router([a, b])
+
+    def test_names_must_be_unique(self):
+        a = serving.Server(make_net(), batch_buckets=(2,),
+                           shape_buckets=[(8,)], name="same")
+        b = serving.Server(make_net(), batch_buckets=(2,),
+                           shape_buckets=[(8,)], name="same")
+        with pytest.raises(MXNetError, match="unique"):
+            Router([a, b])
+
+    def test_knob_validation(self):
+        rep = make_replicas(1)
+        with pytest.raises(MXNetError, match="max_queue"):
+            Router(rep, max_queue=0)
+        with pytest.raises(MXNetError, match="retry_budget"):
+            Router(rep, retry_budget=-1)
+        with pytest.raises(MXNetError, match="dispatch timeout"):
+            Router(rep, dispatch_timeout_s=0.05)
+        with pytest.raises(MXNetError, match="watchdog"):
+            Router(rep, watchdog_timeout_s=0)
+
+    def test_submit_rejects_unfit_shape_synchronously(self):
+        with Router(make_replicas(2), slo_ms=100) as router:
+            with pytest.raises(MXNetError, match="no shape bucket"):
+                router.submit(np.zeros((9,), np.float32))
+
+    def test_submit_when_stopped_raises(self):
+        router = Router(make_replicas(2))
+        with pytest.raises(MXNetError, match="not running"):
+            router.submit(np.zeros((8,), np.float32))
+
+
+# ---------------------------------------------------------------------------
+# routing: results, bit-identity, least-loaded spread
+# ---------------------------------------------------------------------------
+
+class TestRouting:
+    def test_results_bit_identical_to_single_replica(self):
+        xs = traffic(24)
+        refs = single_replica_reference(xs)
+        with Router(make_replicas(3), slo_ms=100) as router:
+            futs = [router.submit(x) for x in xs]
+            outs = [f.result(timeout=30) for f in futs]
+        assert all(np.array_equal(a, b) for a, b in zip(outs, refs))
+
+    def test_load_spreads_across_replicas(self):
+        xs = traffic(48)
+        with Router(make_replicas(2, slo_ms=10), slo_ms=100) as router:
+            futs = [router.submit(x) for x in xs]
+            for f in futs:
+                f.result(timeout=30)
+            served = [r["ok"] for r in router.stats()["replicas"]]
+        assert all(n > 0 for n in served), served
+        assert sum(served) == len(xs)
+
+    def test_context_manager_and_stats(self):
+        with Router(make_replicas(2), slo_ms=100) as router:
+            router.submit(traffic(1)[0]).result(timeout=30)
+            st = router.stats()
+            assert st["running"] and st["ok"] == 1 and not st["wedged"]
+        assert not router.is_running
+        assert serving.live_routers() == []
+
+    def test_stop_no_drain_fails_queued_typed(self):
+        # wedge both replicas so submissions stay queued at the router
+        # long enough to be failed by stop(drain=False)
+        with fault.inject("serving.replica=latency:0.5"):
+            router = Router(make_replicas(2, warmup=False),
+                            slo_ms=2000).start()
+            futs = [router.submit(x) for x in traffic(6)]
+            router.stop(drain=False, timeout=10)
+        resolved = 0
+        for f in futs:
+            try:
+                f.result(timeout=10)
+                resolved += 1
+            except MXNetError:
+                resolved += 1
+        assert resolved == len(futs)
+
+
+# ---------------------------------------------------------------------------
+# admission control: shed-vs-queue boundary
+# ---------------------------------------------------------------------------
+
+class TestAdmission:
+    def test_queue_full_sheds_synchronously_typed(self):
+        with fault.inject("serving.replica=latency:0.6"):
+            router = Router(make_replicas(1, warmup=False), slo_ms=5000,
+                            max_queue=3).start()
+            try:
+                for x in traffic(3):
+                    router.submit(x)
+                t0 = time.perf_counter()
+                with pytest.raises(ServerOverloaded, match="queue full"):
+                    router.submit(traffic(1)[0])
+                assert time.perf_counter() - t0 < 0.1   # synchronous
+                assert router.stats()["shed"] == 1
+            finally:
+                router.stop(drain=False, timeout=10)
+
+    def test_below_bound_admits(self):
+        with Router(make_replicas(2), slo_ms=100, max_queue=3) as router:
+            assert router.submit(traffic(1)[0]).result(timeout=30) \
+                is not None
+
+    def test_predicted_wait_shed_is_typed_and_counted(self, monkeypatch):
+        was = telemetry.enabled()
+        telemetry.reset()
+        telemetry.enable()
+        try:
+            with Router(make_replicas(2), slo_ms=100) as router:
+                # force the saturated regime with a slow measured rate
+                router._shed_arm_pending = -1
+                monkeypatch.setattr(router, "_predicted_wait_locked",
+                                    lambda pending: 9.9)
+                with pytest.raises(ServerOverloaded,
+                                   match="predicted queue wait"):
+                    router.submit(traffic(1)[0])
+                assert router.stats()["shed"] == 1
+            text = telemetry.prom_text()
+            assert 'mxnet_serving_shed_total{reason="predicted_wait"} 1' \
+                in text
+        finally:
+            telemetry.reset()
+            if not was:
+                telemetry.disable()
+
+    def test_unsaturated_burst_is_not_shed(self):
+        """The predicted-wait shed only arms under saturation: a burst
+        into an idle router must be admitted even when the measured
+        completion rate is low (it measures demand, not capacity)."""
+        with Router(make_replicas(2), slo_ms=60) as router:
+            xs = traffic(16)
+            futs = [router.submit(x) for x in xs]   # idle burst: all in
+            for f in futs:
+                f.result(timeout=30)
+            time.sleep(0.1)
+            futs = [router.submit(x) for x in xs]   # again, post-stats
+            for f in futs:
+                f.result(timeout=30)
+            assert router.stats()["shed"] == 0
+
+    def test_predicted_wait_math(self):
+        router = Router(make_replicas(1), slo_ms=100)
+        now = time.perf_counter()
+        # 16 completions 10 ms apart ending now: rate 100/s
+        router._done_ts.extend(now - 0.01 * (15 - i) for i in range(16))
+        w = router._predicted_wait_locked(pending=10)
+        assert 0.05 < w < 0.25, w
+        # fewer than 8 recent completions: no estimate
+        router._done_ts.clear()
+        router._done_ts.extend([now - 0.001] * 7)
+        assert router._predicted_wait_locked(pending=100) == 0.0
+
+
+# ---------------------------------------------------------------------------
+# failover: crash, hang, budget, zero-lost-future invariant
+# ---------------------------------------------------------------------------
+
+class TestFailover:
+    def test_replica_fault_fails_over_bit_identically(self):
+        xs = traffic(20)
+        refs = single_replica_reference(xs)
+        with Router(make_replicas(2), slo_ms=200) as router:
+            with fault.inject("serving.replica.0=every:1"):
+                futs = [router.submit(x) for x in xs]
+                outs = [f.result(timeout=30) for f in futs]
+            st = router.stats()
+        assert all(np.array_equal(a, b) for a, b in zip(outs, refs))
+        assert st["failovers"] > 0
+        by_name = {r["name"]: r for r in st["replicas"]}
+        assert by_name["rep0"]["state"] == OPEN
+        assert by_name["rep0"]["trips"] >= 1
+
+    def test_hung_replica_detected_and_failed_over(self):
+        xs = traffic(12)
+        refs = single_replica_reference(xs)
+        router = Router(make_replicas(2), slo_ms=3000,
+                        dispatch_timeout_s=0.3).start()
+        try:
+            with fault.inject("serving.replica.0=latency:1.2"):
+                futs = [router.submit(x) for x in xs]
+                outs = [f.result(timeout=30) for f in futs]
+                st = router.stats()
+            assert all(np.array_equal(a, b)
+                       for a, b in zip(outs, refs))
+            by_name = {r["name"]: r for r in st["replicas"]}
+            assert by_name["rep0"]["trips"] >= 1
+            time.sleep(1.3)         # let the latency sleeps drain
+        finally:
+            router.stop(timeout=30)
+
+    def test_breaker_reopens_then_probe_readmits(self):
+        """The full integration cycle: fault trips rep0 OPEN; after the
+        cooldown a HALF_OPEN probe carries a real request; once the
+        fault is cleared the probe succeeds and rep0 serves again."""
+        xs = traffic(8)
+        with Router(make_replicas(2, slo_ms=15), slo_ms=100) as router:
+            with fault.inject("serving.replica.0=every:1"):
+                futs = [router.submit(x) for x in xs]
+                for f in futs:
+                    f.result(timeout=30)
+                by_name = {r["name"]: r
+                           for r in router.stats()["replicas"]}
+                assert by_name["rep0"]["state"] == OPEN
+            # fault cleared; cooldown (1 s default) then probe
+            deadline = time.time() + 10
+            served_by_rep0 = 0
+            while time.time() < deadline:
+                time.sleep(0.2)
+                for x in xs:
+                    router.submit(x).result(timeout=30)
+                by_name = {r["name"]: r
+                           for r in router.stats()["replicas"]}
+                if by_name["rep0"]["state"] == CLOSED and \
+                        by_name["rep0"]["ok"] > 0:
+                    served_by_rep0 = by_name["rep0"]["ok"]
+                    break
+            assert served_by_rep0 > 0, router.stats()
+
+    def test_budget_exhaustion_is_typed_not_lost(self, monkeypatch):
+        """Every replica failing persistently (breakers held open-proof
+        so the budget, not the breaker, is what runs out): every future
+        resolves FailoverExhausted naming the attempts — never hangs."""
+        monkeypatch.setenv("MXNET_SERVING_BREAKER_FAILURES", "1000")
+        xs = traffic(10)
+        with Router(make_replicas(2), slo_ms=400,
+                    retry_budget=1) as router:
+            with fault.inject("serving.replica=every:1"):
+                futs = [router.submit(x) for x in xs]
+                outcomes = []
+                for f in futs:
+                    try:
+                        f.result(timeout=30)
+                        outcomes.append("ok")
+                    except FailoverExhausted as e:
+                        assert "retry budget 1 spent" in str(e)
+                        outcomes.append("exhausted")
+                    except ServerOverloaded:
+                        outcomes.append("expired")
+        assert len(outcomes) == len(xs)
+        assert outcomes.count("exhausted") == len(xs)
+
+    def test_all_breakers_open_expires_typed(self):
+        """When every breaker trips before a request's retries, queued
+        requests expire TYPED at their deadline instead of hanging on a
+        fleet with no healthy replica."""
+        xs = traffic(10)
+        with Router(make_replicas(2), slo_ms=400,
+                    retry_budget=1) as router:
+            with fault.inject("serving.replica=every:1"):
+                futs = [router.submit(x) for x in xs]
+                outcomes = []
+                for f in futs:
+                    try:
+                        f.result(timeout=30)
+                        outcomes.append("ok")
+                    except FailoverExhausted:
+                        outcomes.append("exhausted")
+                    except ServerOverloaded:
+                        outcomes.append("expired")
+        assert len(outcomes) == len(xs)
+        assert "ok" not in outcomes
+        assert "expired" in outcomes
+
+    def test_replica_fault_error_is_not_retried_inside_replica(self):
+        """ReplicaFault is non-transient by design: the replica's own
+        serving.dispatch retry must not resurrect a killed replica —
+        recovery belongs to the router."""
+        assert not fault.is_transient(ReplicaFault("killed"))
+
+    def test_route_fault_burns_budget_not_replica_health(self):
+        xs = traffic(6)
+        with Router(make_replicas(2), slo_ms=300) as router:
+            with fault.inject("serving.route=nth:2"):
+                futs = [router.submit(x) for x in xs]
+                for f in futs:
+                    f.result(timeout=30)
+            st = router.stats()
+        assert all(r["state"] == CLOSED for r in st["replicas"])
+        assert st["ok"] == len(xs)
+
+    def test_zero_lost_futures_under_mixed_chaos(self):
+        """The tentpole invariant, small-scale: every submitted future
+        resolves (result or typed error) under a p-fault storm."""
+        xs = traffic(40)
+        with Router(make_replicas(2), slo_ms=300) as router:
+            with fault.inject("serving.replica=p:0.3;serving.route=p:0.1",
+                              seed=7):
+                futs = []
+                for x in xs:
+                    try:
+                        futs.append(router.submit(x))
+                    except ServerOverloaded:
+                        pass        # synchronous shed = resolved too
+                done = 0
+                for f in futs:
+                    try:
+                        f.result(timeout=30)
+                        done += 1
+                    except MXNetError:
+                        done += 1
+        assert done == len(futs)
+
+
+# ---------------------------------------------------------------------------
+# scheduler-liveness watchdog
+# ---------------------------------------------------------------------------
+
+class TestWatchdog:
+    def test_wedged_dispatcher_fails_futures_and_stops_admission(
+            self, monkeypatch):
+        wedge = threading.Event()
+        router = Router(make_replicas(2), slo_ms=5000,
+                        watchdog_timeout_s=0.3).start()
+        try:
+            monkeypatch.setattr(
+                router, "_pick_replica",
+                lambda: (wedge.wait(30), None)[1])
+            futs = [router.submit(x) for x in traffic(3)]
+            deadline = time.time() + 10
+            while time.time() < deadline and not router.stats()["wedged"]:
+                time.sleep(0.05)
+            assert router.stats()["wedged"]
+            for f in futs:
+                with pytest.raises(MXNetError, match="watchdog"):
+                    f.result(timeout=10)
+            with pytest.raises(MXNetError, match="not running"):
+                router.submit(traffic(1)[0])
+        finally:
+            wedge.set()             # release the dispatcher thread
+            router.stop(drain=False, timeout=10)
+
+    def test_healthy_router_never_trips_watchdog(self):
+        with Router(make_replicas(2), slo_ms=100,
+                    watchdog_timeout_s=0.3) as router:
+            time.sleep(0.8)         # idle loop touches the heartbeat
+            router.submit(traffic(1)[0]).result(timeout=30)
+            assert not router.stats()["wedged"]
+
+
+# ---------------------------------------------------------------------------
+# fault spec: dotted sub-sites
+# ---------------------------------------------------------------------------
+
+class TestSubSites:
+    def test_parse_spec_accepts_replica_subsite(self):
+        pols = fault.parse_spec("serving.replica.0=once")
+        assert "serving.replica.0" in pols
+
+    def test_parse_spec_still_rejects_unknown(self):
+        with pytest.raises(MXNetError, match="unknown fault site"):
+            fault.parse_spec("serving.replicaX=once")
+        with pytest.raises(MXNetError, match="unknown fault site"):
+            fault.parse_spec("bogus.site=once")
+        # sub-sites exist only for families that check them, and the
+        # suffix must be an instance INDEX — a name would install
+        # silently and never fire
+        with pytest.raises(MXNetError, match="unknown fault site"):
+            fault.parse_spec("kvstore.push.0=once")
+        with pytest.raises(MXNetError, match="unknown fault site"):
+            fault.parse_spec("serving.replica.rep0=once")
+
+    def test_has_policy_is_exact(self):
+        with fault.inject("serving.replica.1=once"):
+            assert fault.has_policy("serving.replica.1")
+            assert not fault.has_policy("serving.replica")
+            assert not fault.has_policy("serving.replica.0")
+
+    def test_subsite_targets_exactly_one_replica(self):
+        xs = traffic(12)
+        with Router(make_replicas(2), slo_ms=200) as router:
+            with fault.inject("serving.replica.1=every:1"):
+                futs = [router.submit(x) for x in xs]
+                for f in futs:
+                    f.result(timeout=30)
+            by_name = {r["name"]: r for r in router.stats()["replicas"]}
+        assert by_name["rep1"]["trips"] >= 1
+        assert by_name["rep0"]["trips"] == 0
+        assert by_name["rep0"]["state"] == CLOSED
+
+
+# ---------------------------------------------------------------------------
+# telemetry
+# ---------------------------------------------------------------------------
+
+class TestRouterTelemetry:
+    def test_health_shed_failover_metrics_exported(self):
+        was = telemetry.enabled()
+        telemetry.reset()
+        telemetry.enable()
+        try:
+            xs = traffic(10)
+            with Router(make_replicas(2), slo_ms=200,
+                        max_queue=4096) as router:
+                with fault.inject("serving.replica.0=every:1"):
+                    futs = [router.submit(x) for x in xs]
+                    for f in futs:
+                        f.result(timeout=30)
+                time.sleep(0.2)     # a monitor tick publishes gauges
+                text = telemetry.prom_text()
+            assert 'mxnet_serving_replica_healthy{replica="rep0"} 0' \
+                in text
+            assert 'mxnet_serving_replica_healthy{replica="rep1"} 1' \
+                in text
+            assert "mxnet_serving_failover_total" in text
+            assert "mxnet_serving_route_retry_total" in text
+            assert "mxnet_serving_breaker_transitions_total" in text
+            assert "mxnet_serving_router_queue_wait_seconds" in text
+        finally:
+            telemetry.reset()
+            if not was:
+                telemetry.disable()

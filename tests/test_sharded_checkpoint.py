@@ -100,6 +100,56 @@ class TestShardedCheckpoint:
         norms = [k for k in keys if k.startswith("layer0.attn_norm.weight@")]
         assert len(norms) == 1, norms
 
+    def test_save_cleans_stale_checkpoint_files(self, tmp_path):
+        """Saving into a directory holding an OLDER checkpoint (here:
+        planted shard/index files from a fake 8-process topology) must
+        remove it wholesale — restore would otherwise resolve slices
+        from the stale files — while leaving foreign files alone
+        (ADVICE r5: user-pointed shared dirs)."""
+        mesh = par.make_mesh({"dp": 2, "tp": 4})
+        rs = onp.random.RandomState(5)
+        x, y = _batch(rs)
+        net, step = _build(mesh, seed=3)
+        step(x, y)
+        (tmp_path / "shard-00007-of-00008.params").write_bytes(b"stale")
+        (tmp_path / "index-00007.json").write_text(json.dumps(
+            {"file": "shard-00007-of-00008.params", "entries": {}}))
+        (tmp_path / "meta.json").write_text("{}")
+        (tmp_path / "notes.txt").write_text("foreign file, keep me")
+        step.save_sharded(str(tmp_path))
+        names = set(os.listdir(tmp_path))
+        assert "shard-00007-of-00008.params" not in names
+        assert "index-00007.json" not in names
+        assert "notes.txt" in names
+        # and the fresh checkpoint round-trips
+        ref_loss, _ = step(x, y)
+        net2, step2 = _build(mesh, seed=99)
+        step2.restore_sharded(str(tmp_path), example_data=(x,))
+        got_loss, _ = step2(x, y)
+        assert float(got_loss.asnumpy()) == float(ref_loss.asnumpy())
+
+    def test_restore_validates_index_set_against_meta(self, tmp_path):
+        """A stale index file that survived (e.g. a checkpoint written
+        by a custom tool) must be refused, not silently consulted; a
+        missing one means a truncated checkpoint."""
+        mesh = par.make_mesh({"dp": 2, "tp": 4})
+        rs = onp.random.RandomState(6)
+        x, y = _batch(rs)
+        _, step = _build(mesh, seed=3)
+        step(x, y)
+        step.save_sharded(str(tmp_path))
+        # plant a stale EXTRA index (as if an older multi-proc save)
+        (tmp_path / "index-00003.json").write_text(json.dumps(
+            {"file": "shard-00003-of-00004.params", "entries": {}}))
+        _, step2 = _build(mesh, seed=99)
+        with pytest.raises(Exception, match="stale index files"):
+            step2.restore_sharded(str(tmp_path), example_data=(x,))
+        os.unlink(tmp_path / "index-00003.json")
+        # remove the REAL index: truncated checkpoint
+        os.unlink(tmp_path / "index-00000.json")
+        with pytest.raises(Exception, match="missing index files"):
+            step2.restore_sharded(str(tmp_path), example_data=(x,))
+
     def test_mismatched_model_raises(self, tmp_path):
         mesh = par.make_mesh({"dp": 2, "tp": 4})
         rs = onp.random.RandomState(3)

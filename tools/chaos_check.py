@@ -20,11 +20,21 @@ bundle — and every rank's loss trajectory (the survivor's THROUGH its
 membership-epoch transitions, the victim's resumed tail) must be
 bit-identical to an uninterrupted 2-worker run.
 
+The SERVING gate (ISSUE 9) turns the same discipline on the inference
+router: a 2-replica ``serving.Router`` under continuous traffic has one
+replica killed mid-traffic via ``serving.replica.0`` faults — 100% of
+submitted futures must resolve (result or typed error, zero lost/hung),
+responses served by the healthy replica must be bit-identical to a
+single-replica run at matched buckets, survivor p99 must stay bounded,
+and after the fault clears the breaker must re-admit the replica
+through a half-open probe.
+
   python tools/chaos_check.py                 # default spec/steps
   python tools/chaos_check.py --steps 40 --seed 11 \
       --spec 'kvstore.push=every:7;kvstore.allreduce=p:0.1' \
       --json /tmp/chaos.json
   python tools/chaos_check.py --skip-elastic  # in-process gates only
+  python tools/chaos_check.py --skip-serving  # training gates only
 
 Exit code 0 = all gates pass. Runs on the CPU oracle mesh
 (JAX_PLATFORMS=cpu; the fake cluster flag is set below if absent).
@@ -320,6 +330,160 @@ def elastic_gate(summary, steps=30, kill_at=6):
         shutil.rmtree(workdir, ignore_errors=True)
 
 
+# ---------------------------------------------------------------------------
+# serving gate: kill one Router replica mid-traffic via serving.replica
+# faults; zero lost futures, survivor bit-identity, breaker re-admission.
+# ---------------------------------------------------------------------------
+
+SERVING_SLO_MS = 100.0
+
+
+def _serving_net(seed=0):
+    import numpy as np
+
+    import mxnet_tpu as mx
+    from mxnet_tpu.gluon import nn
+
+    net = nn.Dense(16, in_units=32)
+    net.initialize()
+    rs = np.random.RandomState(seed)
+    net.weight.set_data(mx.nd.array(
+        rs.randn(16, 32).astype(np.float32)))
+    net.bias.set_data(mx.nd.array(rs.randn(16).astype(np.float32)))
+    net.hybridize()
+    return net
+
+
+def serving_gate(summary):
+    """Kill replica 0 of a 2-replica Router mid-traffic (``serving.
+    replica.0=every:1``), then clear the fault and wait for half-open
+    re-admission. Gates: every submitted future resolves (zero lost),
+    responses are bit-identical to a single-replica run at matched
+    buckets, survivor p99 stays bounded, replica 0 trips and is
+    re-admitted."""
+    import time as _time
+
+    import numpy as np
+
+    from mxnet_tpu import fault as flt
+    from mxnet_tpu import serving
+    from mxnet_tpu.base import MXNetError
+
+    os.environ["MXNET_COMM_RETRY_DELAY"] = "0.01"
+    os.environ["MXNET_SERVING_BREAKER_FAILURES"] = "2"
+    os.environ["MXNET_SERVING_BREAKER_COOLDOWN"] = "0.4"
+
+    grid = dict(batch_buckets=(2, 4, 8), shape_buckets=[(32,)],
+                slo_ms=SERVING_SLO_MS)
+    samples = [np.random.RandomState(1000 + i).randn(32).astype(np.float32)
+               for i in range(32)]
+
+    # single-replica reference: the bit-identity oracle (same grid)
+    ref_srv = serving.Server(_serving_net(), name="oracle", **grid)
+    ref_srv.start()
+    refs = [ref_srv.submit(x).result(timeout=60) for x in samples]
+    ref_srv.stop()
+
+    replicas = [serving.Server(_serving_net(), name=f"rep{i}", **grid)
+                for i in range(2)]
+    router = serving.Router(replicas, slo_ms=SERVING_SLO_MS,
+                            dispatch_timeout_s=2.0)
+    router.start()
+    checks = {}
+    lat_clean, lat_fault = [], []
+    records = []        # (sample_idx, future, phase, t_submit)
+
+    def submit_phase(n, phase, lats, pace_s=0.004):
+        for i in range(n):
+            idx = i % len(samples)
+            t0 = _time.perf_counter()
+            try:
+                fut = router.submit(samples[idx])
+            except MXNetError:
+                records.append((idx, None, phase, t0))  # typed sync shed
+                continue
+            fut.add_done_callback(
+                lambda f, t0=t0: lats.append(_time.perf_counter() - t0)
+                if not f.exception() else None)
+            records.append((idx, fut, phase, t0))
+            _time.sleep(pace_s)
+
+    try:
+        submit_phase(60, "clean", lat_clean)
+        flt.install("serving.replica.0=every:1")
+        submit_phase(80, "fault", lat_fault)        # the kill window
+        injected = flt.stats()["serving.replica.0"]["injected"]
+        flt.clear()
+        # recovery: keep trickling traffic until the breaker closes and
+        # replica 0 serves again (half-open probe re-admission)
+        readmitted = False
+        rep0_ok_at_clear = router.stats()["replicas"][0]["ok"]
+        deadline = _time.time() + 20
+        while _time.time() < deadline:
+            submit_phase(8, "recover", [])
+            st = {r["name"]: r for r in router.stats()["replicas"]}
+            if st["rep0"]["state"] == "closed" and \
+                    st["rep0"]["ok"] > rep0_ok_at_clear:
+                readmitted = True
+                break
+            _time.sleep(0.1)
+
+        n_ok = n_typed = n_lost = n_bits_bad = 0
+        for idx, fut, phase, _t0 in records:
+            if fut is None:
+                n_typed += 1            # synchronous typed shed
+                continue
+            try:
+                out = fut.result(timeout=30)
+            except MXNetError:
+                n_typed += 1
+                continue
+            except Exception:           # noqa: BLE001 - untyped = fail
+                n_lost += 1
+                continue
+            n_ok += 1
+            if not np.array_equal(out, refs[idx]):
+                n_bits_bad += 1
+        undone = sum(1 for _i, f, _p, _t in records
+                     if f is not None and not f.done())
+        stats = router.stats()
+        by_name = {r["name"]: r for r in stats["replicas"]}
+
+        def p99(xs):
+            xs = sorted(xs)
+            return xs[min(len(xs) - 1, int(0.99 * len(xs)))] if xs else 0.0
+
+        p99_clean, p99_fault = p99(lat_clean), p99(lat_fault)
+        bound = 3.0 * SERVING_SLO_MS / 1e3
+        checks["fault_actually_injected"] = injected > 0
+        checks["zero_lost_futures"] = n_lost == 0 and undone == 0
+        checks["all_resolutions_typed"] = n_typed + n_ok == len(records)
+        checks["survivor_bit_identical"] = n_bits_bad == 0 and n_ok > 0
+        checks["replica_tripped"] = by_name["rep0"]["trips"] >= 1
+        checks["replica_readmitted_by_probe"] = readmitted
+        checks["survivor_p99_bounded"] = p99_fault <= bound
+        ok = all(checks.values())
+        summary["gates"]["serving_failover_zero_lost"] = {
+            "pass": ok, "checks": checks,
+            "requests": len(records), "ok": n_ok,
+            "typed_errors": n_typed, "lost": n_lost + undone,
+            "failovers": stats["failovers"],
+            "rep0_trips": by_name["rep0"]["trips"],
+            "p99_clean_ms": round(p99_clean * 1e3, 2),
+            "p99_fault_ms": round(p99_fault * 1e3, 2),
+            "p99_bound_ms": bound * 1e3}
+        print(f"[chaos] serving: {len(records)} requests, {n_ok} ok, "
+              f"{n_typed} typed errors, {n_lost + undone} lost; "
+              f"{stats['failovers']} failovers; p99 clean/fault "
+              f"{p99_clean * 1e3:.1f}/{p99_fault * 1e3:.1f} ms")
+        for name, v in checks.items():
+            print(f"[chaos]   serving {name}: {v}")
+        return ok
+    finally:
+        flt.clear()
+        router.stop(drain=False, timeout=30)
+
+
 def main():
     ap = argparse.ArgumentParser(description=__doc__.split("\n")[0])
     ap.add_argument("--steps", type=int, default=24)
@@ -331,6 +495,9 @@ def main():
     ap.add_argument("--skip-elastic", action="store_true",
                     help="skip the subprocess elastic gate (launch.py "
                     "SIGKILL + rejoin)")
+    ap.add_argument("--skip-serving", action="store_true",
+                    help="skip the serving failover gate (Router "
+                    "replica kill mid-traffic)")
     args = ap.parse_args()
 
     import numpy as np
@@ -403,6 +570,10 @@ def main():
     # -- gate 4: SIGKILL a worker mid-step, supervised rejoin ----------
     if not args.skip_elastic:
         ok = elastic_gate(summary) and ok
+
+    # -- gate 5: kill a serving replica mid-traffic, zero lost futures -
+    if not args.skip_serving:
+        ok = serving_gate(summary) and ok
 
     retry_counters = {}
     for s in telemetry.snapshot()["metrics"].get(
