@@ -161,6 +161,19 @@ def main():
     _emit(record)
     _write_telemetry(telemetry_out)
 
+    # stage 2.7: compilation-service cold start (subprocess matrix —
+    # cold / warm-disk / warm-manifest, train + serve; CPU children, no
+    # accelerator contention with this process)
+    if _remaining_s() > 120:
+        try:
+            record.update(_coldstart_extra())
+        except Exception as e:
+            record["coldstart_error"] = repr(e)[:200]
+    else:
+        record["coldstart_skipped"] = "budget"
+    _emit(record)
+    _write_telemetry(telemetry_out)
+
     # release this process's step/model buffers before the BERT/Llama
     # subprocesses run — the chip's HBM is shared with children, and the
     # resident ResNet state otherwise costs them batch-size headroom
@@ -626,6 +639,18 @@ def _run_sub(script, timeout_s):
         raise
     line = stdout.strip().splitlines()[-1]
     return json.loads(line)
+
+
+def _coldstart_extra():
+    """Stage 2.7: cold-start-to-first-step / first-response, cold vs
+    warm disk cache vs warm + signature manifest (ROADMAP item 5's
+    acceptance metric; tools/coldstart_bench.py)."""
+    if os.environ.get("BENCH_SKIP_COLDSTART"):
+        return {}
+    cap = float(os.environ.get("BENCH_COLDSTART_TIMEOUT_S", "600"))
+    rec = _run_sub(os.path.join("tools", "coldstart_bench.py"),
+                   min(cap, max(_remaining_s(), 60)))
+    return {k: v for k, v in rec.items() if k.startswith("coldstart_")}
 
 
 def _bert_extra():

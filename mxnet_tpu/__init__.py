@@ -37,115 +37,17 @@ import os as _os
 if not _os.environ.get("JAX_DEFAULT_PRNG_IMPL"):
     _jax.config.update("jax_default_prng_impl", "rbg")
 
-# Persistent XLA compilation cache (reference counterpart: MXNet's op-level
-# autotune caches / CUDA kernel cache). Training-step executables for
-# transformer-sized models take minutes to build; caching them on disk makes
-# the second process start in seconds. MXNET_XLA_CACHE_DIR overrides the
-# base location; MXNET_XLA_CACHE=0 disables.
-#
-# The cache is namespaced per host-CPU feature set: jax's cache key does not
-# include host ISA features, so an XLA:CPU AOT executable compiled on an
-# AVX-512/AMX host replays on a host without them ("could lead to execution
-# errors such as SIGILL" — cpu_aot_loader). A host with a different
-# /proc/cpuinfo flag set gets its own subdirectory and recompiles.
+# Persistent XLA compilation cache — the compilation service's disk tier
+# (reference counterpart: MXNet's op-level autotune caches / CUDA kernel
+# cache). Training-step executables for transformer-sized models take
+# minutes to build; caching them on disk makes the second process start in
+# seconds. ISA-namespacing, size-capped GC and the knobs
+# (MXNET_XLA_CACHE[_DIR|_MIN_COMPILE_S|_MAX_BYTES]) live in
+# compiler/persistent.py; the signature manifest + AOT warm-start that
+# replay INTO this cache live in the sibling compiler modules.
+from .compiler import persistent as _persistent
 
-
-# ISA-extension prefixes (x86 `flags` / ARM `Features`) that codegen can
-# actually depend on; kernel-mitigation and power-management flags (md_clear,
-# ibrs, retbleed, ...) churn with microcode/kernel updates and must not key
-# the cache — they'd force full recompiles on identical hardware.
-_ISA_PREFIXES = (
-    "sse", "avx", "amx", "fma", "bmi", "aes", "sha", "mmx", "f16c",
-    "pclmul", "vpclmul", "gfni", "vaes", "adx", "lzcnt", "popcnt", "abm",
-    "movbe", "movdir", "xsave", "rtm", "rdrnd", "rdseed", "rdpid",
-    "fsgsbase", "invpcid", "clflush", "clwb", "cldemote", "wbnoinvd",
-    "serialize", "cmov", "cx8", "cx16", "fxsr", "crc32",
-    "lahf", "kl", "widekl", "waitpkg", "enqcmd", "uintr", "hreset", "lm",
-    "neon", "asimd", "sve", "fp", "fphp", "crypto", "atomics", "lse",
-)
-# deliberately absent: rtm/hle/tsxldtrk — TSX is routinely disabled by
-# microcode mitigations (flag churn on identical hardware) and XLA codegen
-# never emits it.
-
-
-def _host_cpu_tag() -> str:
-    import hashlib
-    import platform
-
-    feats = ""
-    try:
-        with open("/proc/cpuinfo") as f:
-            for line in f:
-                if line.startswith(("flags", "Features")):
-                    toks = line.split(":", 1)[1].split()
-                    feats = " ".join(
-                        sorted(t for t in toks if t.startswith(_ISA_PREFIXES)))
-                    break
-    except OSError:
-        pass
-    if not feats:
-        # degraded path (no readable /proc/cpuinfo — non-Linux or /proc
-        # unmounted): only the coarse arch is known, so hosts of the same
-        # arch but different ISA extensions share a namespace and the
-        # cross-host AOT protection is WEAK here; the distinct prefix
-        # keeps these entries out of any verified-feature namespace.
-        feats = "weak:" + (platform.processor() or platform.machine()
-                           or "unknown")
-    return hashlib.sha1(feats.encode()).hexdigest()[:12]
-
-
-def _cache_default() -> str:
-    # Pure-CPU processes (tests, the driver's virtual-mesh dryrun) default
-    # to NO persistent cache: their compiles are cheap, and XLA:CPU AOT
-    # entries are what trigger the cpu_aot_loader feature-probe warning on
-    # every later load (the probe doesn't know the +prefer-no-scatter/
-    # +prefer-no-gather tuning pseudo-features this XLA version compiles
-    # with — benign same-host noise, but it pollutes driver artifacts and
-    # reads like SIGILL risk). TPU-capable processes keep the cache (the
-    # minutes-long transformer TrainStep compiles are the whole point);
-    # their host-side CPU jits stay under the 1 s min-compile-time bar, so
-    # no CPU AOT entries get written and the warning cannot fire.
-    plats = _os.environ.get("JAX_PLATFORMS", "")
-    toks = [t.strip() for t in plats.split(",") if t.strip()]
-    if toks and all(t == "cpu" for t in toks):
-        return "0"
-    return "1"
-
-
-if _os.environ.get("MXNET_XLA_CACHE", _cache_default()) != "0":
-    _cache_dir = _os.path.join(
-        _os.environ.get(
-            "MXNET_XLA_CACHE_DIR",
-            _os.path.join(_os.path.expanduser("~"), ".cache",
-                          "mxnet_tpu_xla")),
-        "host-" + _host_cpu_tag())
-    try:
-        _os.makedirs(_cache_dir, exist_ok=True)
-        # one-time cleanup: flat entries written by versions before the
-        # host namespacing have unknown host provenance (they're the
-        # SIGILL-risk entries this scheme exists to quarantine) — delete
-        # rather than migrate; they recompile once into the new subdir.
-        # Match ONLY the exact filenames the jax compilation cache
-        # writes (<fn>-<sha256 hex>-cache plus its -atime sidecar):
-        # MXNET_XLA_CACHE_DIR may point at a shared directory, and a
-        # broad *-cache sweep would unlink foreign files there.
-        import re as _re
-
-        _jax_cache_entry = _re.compile(
-            r".+-[0-9a-f]{64}-(cache|atime)$").fullmatch
-        _base = _os.path.dirname(_cache_dir)
-        for _f in _os.listdir(_base):
-            if _jax_cache_entry(_f) and _os.path.isfile(
-                    _os.path.join(_base, _f)):
-                try:
-                    _os.unlink(_os.path.join(_base, _f))
-                except OSError:
-                    pass
-        _jax.config.update("jax_compilation_cache_dir", _cache_dir)
-        _jax.config.update("jax_persistent_cache_min_compile_time_secs", 1.0)
-        _jax.config.update("jax_persistent_cache_min_entry_size_bytes", 0)
-    except Exception:  # pragma: no cover - cache is best-effort
-        pass
+_persistent.setup()
 
 from . import base
 from .base import MXNetError
@@ -184,6 +86,7 @@ from . import symbol
 from . import symbol as sym
 from . import tracing
 from . import telemetry
+from . import compiler
 from . import fault
 from . import checkpoint
 from . import serving

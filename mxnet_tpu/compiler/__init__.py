@@ -1,0 +1,70 @@
+"""``mxnet_tpu.compiler`` — the unified compilation service.
+
+One subsystem owns everything that turns a signature into an executable:
+
+* **signature keying** (:mod:`.keys`) — the canonical key every jit
+  cache uses (op/graph id + avals + shardings + routing knobs +
+  platform), replacing five ad-hoc schemes;
+* **site caches** (:mod:`.service.SiteCache`) — shared LRU policy with
+  hit/miss *and eviction* telemetry across the five cache sites;
+* **executable table** (:mod:`.service.ExecutableTable`) — in-process,
+  single-flight dedupe of XLA compiles keyed by lowered-HLO fingerprint
+  (N serving replicas = 1 compile);
+* **signature manifest** (:mod:`.manifest`) — append-only JSONL journal
+  of every compiled signature, written atomically under the
+  ``MXNET_XLA_CACHE_DIR`` layout;
+* **AOT warm-start** (:func:`warm_start`) — replay a manifest through
+  ``jax.jit(...).lower().compile()`` before first traffic;
+* **persistent disk tier** (:mod:`.persistent`) — the managed jax
+  compilation cache: ISA-namespaced, size-capped GC.
+
+This module is import-light (the package ``__init__`` imports
+``compiler.persistent`` before jax is configured); the service surface
+loads lazily on first use.
+"""
+from __future__ import annotations
+
+from . import keys
+from .keys import SigKey, fingerprint, graph_ident, routing_knobs, signature
+
+__all__ = [
+    "SigKey", "signature", "fingerprint", "graph_ident", "routing_knobs",
+    "Manifest", "enable_recording", "disable_recording", "recorder",
+    "record_signature", "default_manifest_path",
+    "SiteCache", "ExecutableTable", "GuardedExec", "exec_table",
+    "warm_start", "mark_event", "events", "seconds_since_import",
+    "cache_dir", "gc_cache", "keys",
+]
+
+_LAZY = {
+    "Manifest": ("manifest", "Manifest"),
+    "enable_recording": ("manifest", "enable_recording"),
+    "disable_recording": ("manifest", "disable_recording"),
+    "recorder": ("manifest", "recorder"),
+    "record_signature": ("manifest", "record_signature"),
+    "default_manifest_path": ("manifest", "default_path"),
+    "SiteCache": ("service", "SiteCache"),
+    "ExecutableTable": ("service", "ExecutableTable"),
+    "GuardedExec": ("service", "GuardedExec"),
+    "exec_table": ("service", "exec_table"),
+    "warm_start": ("service", "warm_start"),
+    "mark_event": ("service", "mark_event"),
+    "events": ("service", "events"),
+    "seconds_since_import": ("service", "seconds_since_import"),
+    "cache_dir": ("persistent", "cache_dir"),
+    "gc_cache": ("persistent", "gc_cache"),
+}
+
+
+def __getattr__(name):
+    try:
+        modname, attr = _LAZY[name]
+    except KeyError:
+        raise AttributeError(
+            f"module {__name__!r} has no attribute {name!r}") from None
+    import importlib
+
+    mod = importlib.import_module(f".{modname}", __name__)
+    value = getattr(mod, attr)
+    globals()[name] = value
+    return value

@@ -21,14 +21,14 @@ and op bulking. Here ``hybridize()`` wraps the block's forward in ONE
 """
 from __future__ import annotations
 
+import functools
 import re
 import threading
 from collections import OrderedDict
 from typing import List, Optional
 
-from .. import autograd, engine, random_state, telemetry, tracing
+from .. import autograd, engine, random_state, tracing
 from ..base import MXNetError, name_manager
-from ..telemetry import _state as _telemetry_state
 from ..context import Context, cpu, current_context
 from ..ndarray import NDArray
 from ..ndarray.ndarray import _wrap_jax, imperative_invoke, _LambdaOp
@@ -439,19 +439,39 @@ def make_pure_fn(block, param_arrays, ctx, training):
 
 class _CachedGraph:
     """One compiled executable per (shapes, dtypes, train-flag) key — the
-    jax.jit equivalent of ``src/imperative/cached_op.cc :: CachedOp``."""
+    jax.jit equivalent of ``src/imperative/cached_op.cc :: CachedOp``.
+
+    Routed through the compilation service: canonical signature keying
+    (``compiler.signature``), executables AOT-compiled via
+    ``jit(...).lower().compile()`` and deduped across architecturally
+    identical blocks through the in-process executable table (replica N
+    of a Router reuses replica 0's XLA compile), every build journaled to
+    the signature manifest for :func:`mxnet_tpu.compiler.warm_start`.
+    """
 
     def __init__(self, block, flags):
+        from ..compiler import service as _csvc
+
         self.block = block
         self.flags = dict(flags or {})
-        self._cache = {}
+        self._cache = _csvc.SiteCache("cached_op")
+        self._cells = {}     # training-flag -> cell memo (see _build)
 
     def clear(self):
         self._cache.clear()
 
-    def __call__(self, args: List[NDArray]):
-        import jax
+    def _key_for(self, args, param_arrays, training):
+        from ..compiler import signature
 
+        # trace-time routing knobs (Pallas fused kernels, hash dropout)
+        # select different op bodies — they key the cache like shapes do
+        return signature(
+            "cached_op", id(self.block),
+            avals=tuple((tuple(a.shape), str(a.dtype)) for a in args),
+            extra=(tuple((tuple(a.shape), str(a.dtype))
+                         for a in param_arrays), training))
+
+    def __call__(self, args: List[NDArray]):
         block = self.block
         ctx = args[0].context if args else current_context()
         params = [p for p in block.collect_params().values()]
@@ -460,22 +480,11 @@ class _CachedGraph:
             raise DeferredInitializationError  # caller runs one eager pass
         param_arrays = [p.data(ctx) for p in params]
         training = autograd.is_training()
-        from ..ops.registry import _routing_knobs
-
-        key = (
-            tuple((a.shape, str(a.dtype)) for a in args),
-            tuple((a.shape, str(a.dtype)) for a in param_arrays),
-            training,
-            # trace-time routing knobs (Pallas fused kernels, hash
-            # dropout) select different op bodies — key them like shapes
-            _routing_knobs(),
-        )
-        entry = self._cache.get(key)
-        if _telemetry_state.enabled:
-            telemetry.record_cache("cached_op", hit=entry is not None)
-        if entry is None:
+        key = self._key_for(args, param_arrays, training)
+        entry = self._cache.lookup(key)
+        if entry is self._cache.MISS:
             entry = self._build(param_arrays, args, ctx, training)
-            self._cache[key] = entry
+            self._cache.insert(key, entry)
         jitted, cell = entry["jitted"], entry["cell"]
         rng = random_state.get_state_key()
 
@@ -503,7 +512,108 @@ class _CachedGraph:
         import jax
 
         pure, cell = make_pure_fn(self.block, param_arrays, ctx, training)
-        return {"jitted": jax.jit(pure), "cell": cell}
+        # training-mode graphs run under autograd recording (jax.vjp over
+        # call_fn) where a Compiled cannot serve — sealing would compile
+        # an executable whose every use is the tracer fallback; plain jit
+        # traces once and serves both. Inference graphs (the serving warm
+        # path) seal through the service.
+        if training:
+            return {"jitted": jax.jit(pure), "cell": cell}
+        jitted = None
+        try:
+            from .. import compiler
+            from ..compiler import service as _csvc
+
+            # AOT through the service's persistence stack: the canonical
+            # signature (graph structure + forward bytecode + avals +
+            # routing + platform) keys the in-process executable table —
+            # replica N of one architecture reuses replica 0's XLA
+            # compile — and the exported-StableHLO blob store, so a
+            # fresh process skips the trace too. The trace (when one
+            # runs) settles `cell`; a blob hit settles it via the
+            # cell-shape probe below.
+            psds = tuple(jax.ShapeDtypeStruct(tuple(a.shape), a.data.dtype)
+                         for a in param_arrays)
+            isds = tuple(jax.ShapeDtypeStruct(tuple(a.shape), a.data.dtype)
+                         for a in args)
+            with random_state.preserved_stream():
+                rng = random_state.get_state_key()
+            rsds = jax.ShapeDtypeStruct(tuple(rng.shape), rng.dtype)
+            graph = compiler.graph_ident(self.block)
+            arg_avals = tuple((tuple(a.shape), str(a.data.dtype))
+                              for a in args)
+            sig_fp = compiler.keys.fingerprint(compiler.keys.encode((
+                "cached_op", graph,
+                tuple((tuple(a.shape), str(a.data.dtype))
+                      for a in param_arrays),
+                arg_avals, (tuple(rng.shape), str(rng.dtype)), training,
+                compiler.routing_knobs(),
+                jax.default_backend(), jax.__version__)))
+            jitted = _csvc.seal_executable(
+                sig_fp, jax.jit(pure), (psds, rsds) + isds,
+                fallback=functools.partial(jax.jit, pure))
+            if cell["treedef"] is None:
+                # exported-blob hit: nothing traced `pure`, so the cell
+                # (output treedef + aux arrays) is still unset — reuse
+                # the memo from a sibling signature (structure is a
+                # property of the block, not the batch shape), else
+                # settle it with one host-side shape probe (no compile)
+                memo = self._cells.get(training)
+                if memo is not None:
+                    cell.update(memo)
+                else:
+                    jax.eval_shape(pure, psds, rsds, *isds)
+            if cell["treedef"] is not None:
+                self._cells[training] = {
+                    k: cell[k]
+                    for k in ("aux_arrays", "treedef", "n_out")}
+            compiler.record_signature("cached_op", {
+                "graph": graph, "args": arg_avals, "training": training,
+                "routing": compiler.routing_knobs()})
+        except Exception:
+            # AOT lowering is an optimization; blocks whose forward needs
+            # concrete values (or exotic placements) keep the trace-at-
+            # first-call jit path
+            jitted = None
+        if jitted is None:
+            jitted = jax.jit(pure)
+        return {"jitted": jitted, "cell": cell}
+
+    def warm_spec(self, spec) -> str:
+        """AOT-compile one recorded ``cached_op`` manifest entry against
+        this graph's live block — no real dispatch, just
+        ``jit(...).lower().compile()`` through the executable table.
+        Returns the warm outcome ("replayed"/"deduped"/"skipped")."""
+        from .. import autograd as _ag
+        from ..ndarray import zeros as _nd_zeros
+
+        arg_avals = spec.get("args") or ()
+        args = [_nd_zeros(tuple(shape), dtype=dtype)
+                for shape, dtype in arg_avals]
+        if not args:
+            return "skipped"
+        training = bool(spec.get("training", False))
+        block = self.block
+        params = [p for p in block.collect_params().values()]
+        if any(p._data is None for p in params):
+            try:
+                with _ag.pause():
+                    block._deferred_infer_shape(*args)
+            except Exception:
+                return "skipped"    # warm cannot settle this graph
+            params = [p for p in block.collect_params().values()]
+        ctx = args[0].context
+        param_arrays = [p.data(ctx) for p in params]
+        key = self._key_for(args, param_arrays, training)
+        if key in self._cache:
+            return "deduped"
+        prev = _ag.set_training(training)
+        try:
+            entry = self._build(param_arrays, args, ctx, training)
+        finally:
+            _ag.set_training(prev)
+        self._cache.insert(key, entry)
+        return "replayed"
 
     def warmup(self, arg_specs, dtype="float32", ctx=None):
         """AOT-compile one cache entry per input signature, ahead of any
@@ -520,8 +630,12 @@ class _CachedGraph:
         Drives a real zero-filled call through ``__call__`` per spec
         (inference mode, gradient tape paused), so both the trace cache
         here AND jax's executable cache are warm — a later request with
-        that signature is a pure cache hit. Returns the number of
-        entries newly compiled (0 = everything was already warm).
+        that signature is a pure cache hit. A signature already seated
+        by an AOT warm (manifest replay, a previous warmup) is skipped
+        without dispatching — its executable exists, re-executing it
+        would only burn device time per bucket per reload. Returns the
+        number of entries newly compiled (0 = everything was already
+        warm).
         """
         from .. import autograd as _ag
         from ..ndarray import zeros as _nd_zeros
@@ -539,12 +653,38 @@ class _CachedGraph:
                     shape, dt = tuple(item), dtype
                 args.append(_nd_zeros(shape, ctx=ctx, dtype=dt))
             with _ag.pause():
+                if self._is_warm(args):
+                    continue
                 try:
                     self(args)
                 except DeferredInitializationError:
                     self.block._deferred_infer_shape(*args)
                     self(args)
         return len(self._cache) - before
+
+    def _is_warm(self, args) -> bool:
+        """Whether this exact call signature already has a compiled
+        entry (telemetry-silent — a warmup probe is not a serving
+        lookup)."""
+        params = [p for p in self.block.collect_params().values()]
+        if any(p._data is None for p in params):
+            return False
+        ctx = args[0].context if args else current_context()
+        param_arrays = [p.data(ctx) for p in params]
+        key = self._key_for(args, param_arrays, autograd.is_training())
+        return key in self._cache
+
+
+def warm_cached_op_spec(block, spec) -> str:
+    """``compiler.warm_start``'s cached_op replay hook: seat one recorded
+    input signature in ``block``'s graph cache, AOT-compiled. The block
+    is hybridized if it is not already (a warm target must serve through
+    the compiled path for the warm entry to be the one hit)."""
+    if getattr(block, "_active", None) is False:
+        block.hybridize()
+    if block._cached_graph is None:
+        block._cached_graph = _CachedGraph(block, block._flags)
+    return block._cached_graph.warm_spec(spec)
 
 
 class HybridBlock(Block):

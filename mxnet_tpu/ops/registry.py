@@ -24,7 +24,6 @@ keyword(-only) parameters with defaults. The wrapper generators use
 """
 from __future__ import annotations
 
-import collections
 import functools
 import inspect
 import threading
@@ -282,32 +281,30 @@ def list_ops() -> List[str]:
 # Reference analogue: MXNet's imperative path pays ~µs dispatch per op
 # (SURVEY.md §3.1); ours pays a jit-cache lookup. Executables are cached by
 # (op name, attr values); XLA itself caches by input shape/dtype underneath.
+# Routed through the compilation service (compiler.SiteCache): one
+# canonical keying scheme, LRU policy preserved, evictions observable.
 # ---------------------------------------------------------------------------
 
+from ..compiler import keys as _ckeys
+from ..compiler import manifest as _cmanifest
 
-# hit/miss telemetry: the lru-cached body below only runs on a miss, and
-# only in the calling thread, so a thread-local flag is race-free where a
-# cache_info().misses delta would misattribute a concurrent thread's miss
-_cache_probe = threading.local()
+# canonical name kept: block.py / step.py key their caches with the same
+# knobs (the compilation service owns the definition now)
+_routing_knobs = _ckeys.routing_knobs
 
-
-def _routing_knobs() -> tuple:
-    """Trace-time routing env knobs that select a DIFFERENT op body for
-    the same (op, attrs, shapes) signature — like ``platform`` below,
-    they must key every executable cache or a knob toggle would keep
-    replaying the previously-traced body (round-9 review finding:
-    MXNET_PALLAS_FUSED flipped after a warm cache never engaged the
-    fused kernels)."""
-    import os
-
-    return (os.environ.get("MXNET_PALLAS_FUSED", "0") == "1",
-            os.environ.get("MXNET_TPU_HASH_DROPOUT", "0") == "1")
+_EAGER_CACHE = None
 
 
-@functools.lru_cache(maxsize=4096)
-def _cached_call(opname: str, attr_items: tuple, n_tensors: int,
-                 has_rng: bool, platform: str, routing: tuple = ()):
-    _cache_probe.miss = True
+def _eager_cache():
+    global _EAGER_CACHE
+    if _EAGER_CACHE is None:
+        from ..compiler import service as _csvc
+
+        _EAGER_CACHE = _csvc.shared_cache("eager_op", maxsize=4096)
+    return _EAGER_CACHE
+
+
+def _build_eager(opname: str, attr_items: tuple, has_rng: bool):
     # `platform` keys the cache even though the traced fn only reads it
     # ambiently: op impls dispatch on current_execution_platform() at
     # TRACE time (Pallas kernels, int8 MXU paths), so one executable per
@@ -331,6 +328,38 @@ def _cached_call(opname: str, attr_items: tuple, n_tensors: int,
 
     pure.__name__ = opname
     return jax.jit(pure)
+
+
+def _eager_executable(opname: str, attr_items: tuple, n_tensors: int,
+                      has_rng: bool, platform: str, routing: tuple = (),
+                      record: bool = True):
+    """(jitted fn, cache hit) through the service's eager_op site cache."""
+    cache = _eager_cache()
+    key = _ckeys.signature("eager_op", opname, attrs=attr_items,
+                           platform=platform, routing=routing,
+                           extra=(n_tensors, has_rng))
+    fn = cache.lookup(key, record=record)
+    if fn is not cache.MISS:
+        return fn, True
+    fn = _build_eager(opname, attr_items, has_rng)
+    cache.insert(key, fn)
+    return fn, False
+
+
+def _cached_call(opname: str, attr_items: tuple, n_tensors: int,
+                 has_rng: bool, platform: str, routing: tuple = ()):
+    """Compat shim over the service cache (amp and tests call this
+    directly); telemetry-silent — the dispatch path records through
+    :func:`_eager_executable`."""
+    return _eager_executable(opname, attr_items, n_tensors, has_rng,
+                             platform, routing, record=False)[0]
+
+
+def _cached_call_clear():
+    _eager_cache().clear()
+
+
+_cached_call.cache_clear = _cached_call_clear
 
 
 def _harmonize_devices(tensors):
@@ -450,15 +479,18 @@ def _eager_call(opdef: OpDef, tensors, attrs, rng=None):
                 return opdef.fn(None, *tensors, **attrs)
             return opdef.fn(*tensors, **attrs)
         routing = _routing_knobs()
+        fn, hit = _eager_executable(opdef.name, attr_items, len(tensors),
+                                    rng is not None, platform, routing)
         if _telemetry_state.enabled:
-            _cache_probe.miss = False
-            fn = _cached_call(opdef.name, attr_items, len(tensors),
-                              rng is not None, platform, routing)
-            telemetry.record_cache("eager_op", hit=not _cache_probe.miss)
             telemetry.record_xla_dispatch("eager_op")
-        else:
-            fn = _cached_call(opdef.name, attr_items, len(tensors),
-                              rng is not None, platform, routing)
+        if not hit and _cmanifest.recorder() is not None:
+            _cmanifest.record_signature("eager_op", {
+                "op": opdef.name, "attrs": attr_items,
+                "avals": tuple((tuple(t.shape), str(t.dtype))
+                               if hasattr(t, "shape") else None
+                               for t in tensors),
+                "has_rng": rng is not None, "platform": platform,
+                "routing": routing})
         if rng is not None:
             return fn(rng, *tensors)
         return fn(*tensors)
@@ -658,18 +690,29 @@ def _segment_avals(opname: str, attr_items: tuple, aval_key: tuple,
     return tuple((tuple(o.shape), o.dtype) for o in outs), out_is_seq
 
 
-# signature -> jitted fused function; LRU-bounded. The signature encodes
-# the complete segment semantics (per-node op/attrs/static-literals/wiring,
-# runtime-arg shapes+dtypes, live-output mask, platform), so a hit replays
-# a compiled executable for a structurally identical segment.
-_FUSED_CACHE: "collections.OrderedDict" = collections.OrderedDict()
+# signature -> jitted fused function; LRU-bounded through the service's
+# fused_segment site cache. The signature encodes the complete segment
+# semantics (per-node op/attrs/static-literals/wiring, runtime-arg
+# shapes+dtypes, live-output mask, platform), so a hit replays a compiled
+# executable for a structurally identical segment. Evictions are counted
+# (mxnet_jit_cache_evictions_total{cache="fused_segment"}) and the evicted
+# signature logged at debug — cache thrash used to be silent here.
 _FUSED_CACHE_MAX = 1024
-_fused_lock = threading.Lock()
+_FUSED_CACHE = None
+
+
+def _fused_cache():
+    global _FUSED_CACHE
+    if _FUSED_CACHE is None:
+        from ..compiler import service as _csvc
+
+        _FUSED_CACHE = _csvc.shared_cache("fused_segment",
+                                          maxsize=_FUSED_CACHE_MAX)
+    return _FUSED_CACHE
 
 
 def fused_segment_cache_clear() -> None:
-    with _fused_lock:
-        _FUSED_CACHE.clear()
+    _fused_cache().clear()
 
 
 def _build_fused(nodes, live_mask):
@@ -723,21 +766,21 @@ def execute_segment(seg, reason: str) -> None:
             if pv is not None:
                 live.append(pv)
     live_mask = tuple((pv.node_index, pv.out_index) for pv in live)
-    sig = (tuple(n.sig for n in seg.nodes), live_mask, seg.platform,
-           _routing_knobs())
-    with _fused_lock:
-        jitted = _FUSED_CACHE.get(sig)
-        hit = jitted is not None
-        if hit:
-            _FUSED_CACHE.move_to_end(sig)
+    node_sigs = tuple(n.sig for n in seg.nodes)
+    routing = _routing_knobs()
+    cache = _fused_cache()
+    key = _ckeys.signature("fused_segment", node_sigs,
+                           platform=seg.platform, routing=routing,
+                           extra=(live_mask,))
+    jitted = cache.lookup(key)
+    hit = jitted is not cache.MISS
     if not hit:
         jitted = _build_fused(tuple(seg.nodes), live_mask)
-        with _fused_lock:
-            _FUSED_CACHE[sig] = jitted
-            while len(_FUSED_CACHE) > _FUSED_CACHE_MAX:
-                _FUSED_CACHE.popitem(last=False)
-    if _telemetry_state.enabled:
-        telemetry.record_cache("fused_segment", hit=hit)
+        cache.insert(key, jitted)
+        if _cmanifest.recorder() is not None:
+            _cmanifest.record_signature("fused_segment", {
+                "nodes": node_sigs, "live": live_mask,
+                "platform": seg.platform, "routing": routing})
     with execution_platform(seg.platform):
         outs = jitted(*seg.consts)
     if _telemetry_state.enabled:
@@ -751,3 +794,132 @@ def execute_segment(seg, reason: str) -> None:
 
     if profiler.state() == "run":
         profiler.record_span("Bulk::flush", time.perf_counter() - t0)
+
+
+# ---------------------------------------------------------------------------
+# Manifest warm-start replay (compiler.warm_start's op-level sites).
+# ---------------------------------------------------------------------------
+
+
+def _platform_available(platform) -> bool:
+    import jax
+
+    if not platform:
+        return False
+    try:
+        return bool(jax.devices(platform))
+    except Exception:
+        return False
+
+
+# (op key, avals) fingerprints already driven by warm_eager_spec: a
+# reload (or replica N) replaying the same manifest must not re-dispatch
+# every recorded op on device — one zero-filled drive per signature per
+# process is the whole point
+_WARMED_EAGER: set = set()
+_warmed_eager_lock = threading.Lock()
+
+
+def warm_eager_spec(spec: dict) -> str:
+    """Replay one ``eager_op`` manifest entry: rebuild the per-op jitted
+    executable and drive one zero-filled dispatch at the recorded avals so
+    jax's executable cache (and the persistent disk tier) is hot before
+    real traffic. Returns the warm outcome ("replayed"/"deduped"/
+    "skipped")."""
+    import jax.numpy as jnp
+
+    from .. import random_state
+    from ..base import execution_platform
+    from ..compiler import keys as _keys
+
+    opname = spec.get("op")
+    platform = spec.get("platform")
+    if opname not in _REGISTRY or not _platform_available(platform):
+        return "skipped"
+    attr_items = tuple(spec.get("attrs", ()))
+    avals = spec.get("avals", ())
+    has_rng = bool(spec.get("has_rng"))
+    warmed_fp = _keys.fingerprint(_keys.encode(
+        (opname, attr_items, avals, has_rng, platform,
+         _routing_knobs())))
+    with _warmed_eager_lock:
+        if warmed_fp in _WARMED_EAGER:
+            return "deduped"
+    fn, hit = _eager_executable(opname, attr_items, len(avals), has_rng,
+                                platform, _routing_knobs(), record=False)
+    args = []
+    for av in avals:
+        if av is None:
+            args.append(None)
+        else:
+            shape, dtype = av
+            args.append(jnp.zeros(tuple(shape), dtype=dtype))
+    with random_state.preserved_stream():
+        rng = random_state.get_state_key() if has_rng else None
+        with execution_platform(platform):
+            out = fn(rng, *args) if has_rng else fn(*args)
+    import jax
+
+    jax.block_until_ready(out)
+    # marked warm only AFTER the dispatch succeeds: a failed replay must
+    # stay retryable on the next warm_start, not report "deduped" forever
+    with _warmed_eager_lock:
+        _WARMED_EAGER.add(warmed_fp)
+    return "deduped" if hit else "replayed"
+
+
+def warm_fused_spec(spec: dict) -> str:
+    """Replay one ``fused_segment`` manifest entry: rebuild the segment
+    program from the registry, AOT-compile it through the service's
+    executable table (``jit(...).lower().compile()``) and seat it in the
+    fused cache under the exact signature live recording computes — a
+    later structurally identical segment flushes straight into the warm
+    executable."""
+    import jax
+
+    from ..base import execution_platform
+    from ..compiler import service as _csvc
+
+    node_sigs = spec.get("nodes")
+    live_mask = spec.get("live")
+    platform = spec.get("platform")
+    if not node_sigs or live_mask is None \
+            or not _platform_available(platform):
+        return "skipped"
+    node_sigs = tuple(node_sigs)
+    live_mask = tuple(live_mask)
+    cache = _fused_cache()
+    key = _ckeys.signature("fused_segment", node_sigs, platform=platform,
+                           routing=_routing_knobs(), extra=(live_mask,))
+    if key in cache:
+        return "deduped"
+    nodes = []
+    const_avals = {}
+    for nsig in node_sigs:
+        opname, attr_items, sig_inputs = nsig
+        opdef = _REGISTRY.get(opname)
+        if opdef is None:
+            return "skipped"
+        input_specs = []
+        for s in sig_inputs:
+            if s[0] == "a":
+                input_specs.append(("a", s[1]))
+                const_avals[s[1]] = (tuple(s[2]), s[3])
+            else:
+                input_specs.append(tuple(s))
+        nodes.append(engine._SegmentNode(
+            opname, opdef.fn, tuple(attr_items), tuple(input_specs),
+            0, False, nsig))
+    nodes = tuple(nodes)
+    if sorted(const_avals) != list(range(len(const_avals))):
+        return "skipped"    # torn spec: const slots must be dense
+    sds = [jax.ShapeDtypeStruct(const_avals[i][0], const_avals[i][1])
+           for i in range(len(const_avals))]
+    with execution_platform(platform):
+        lowered = _build_fused(nodes, live_mask).lower(*sds)
+        fp = _csvc.fingerprint_lowered(lowered)
+        compiled = _csvc.exec_table.get_or_build(fp, lowered.compile)
+    guarded = _csvc.GuardedExec(
+        compiled, lambda: _build_fused(nodes, live_mask))
+    cache.insert(key, guarded)
+    return "replayed"

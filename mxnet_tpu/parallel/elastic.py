@@ -70,6 +70,7 @@ on membership change is automatic.
 from __future__ import annotations
 
 import json
+import logging
 import os
 import socket
 import threading
@@ -253,7 +254,8 @@ class ElasticRunner:
                  on_epoch: Optional[Callable] = None,
                  distributed: Optional[bool] = None,
                  bootstrap_fn: Optional[Callable] = None,
-                 shutdown_fn: Optional[Callable] = None):
+                 shutdown_fn: Optional[Callable] = None,
+                 warm_start: Optional[Callable] = None):
         self.coord_dir = os.fspath(coord_dir)
         self.board = HeartbeatBoard(self.coord_dir)
         self.launch_rank = int(os.environ.get("DMLC_WORKER_ID", "0")) \
@@ -291,6 +293,13 @@ class ElasticRunner:
         self._distributed = distributed
         self._bootstrap_fn = bootstrap_fn
         self._shutdown_fn = shutdown_fn
+        # compilation-service hook: called with the new Membership after
+        # every (re-)bootstrap — start() AND each epoch transition — so a
+        # rejoiner/survivor replays its signature manifest
+        # (``compiler.warm_start(manifest, train_steps=[step])``) and
+        # re-enters training hot instead of paying a full retrace at
+        # every membership epoch
+        self._warm_start_fn = warm_start
         self.membership: Optional[Membership] = None
         self.transitions: List[Dict] = []
         self.start_step = 0
@@ -459,8 +468,27 @@ class ElasticRunner:
         if (step is not None and self._is_distributed()
                 and self.membership.world_size > 1):
             (self._bootstrap_fn or self._default_bootstrap)(self.membership)
+        self._run_warm_start(self.membership)
         self._started = True
         return self.membership
+
+    def _run_warm_start(self, membership: Membership) -> None:
+        """Replay compile signatures after a (re-)bootstrap so the next
+        step is a cache hit. Best-effort: a warm failure costs a retrace
+        on the first step, never the membership transition."""
+        if self._warm_start_fn is None:
+            return
+        t0 = time.perf_counter()
+        try:
+            self._warm_start_fn(membership)
+        except Exception:
+            logging.getLogger(__name__).exception(
+                "elastic warm_start hook failed; first step will retrace")
+            return
+        from .. import compiler
+
+        compiler.mark_event("elastic_warm_done")
+        telemetry.record_elastic_warm(time.perf_counter() - t0)
 
     def _await_join_commit(
             self, bundle_epoch: int, epoch: int
@@ -667,6 +695,11 @@ class ElasticRunner:
         # 5) restore bit-exact and continue
         if self._last_completed >= 0:
             self._restore()
+        # 6) warm the compile caches for the new world BEFORE the next
+        # step dispatches — PR 8's teardown + re-bootstrap made every
+        # membership epoch pay a cold retrace; the manifest replay turns
+        # that into executable-table / disk-cache hits
+        self._run_warm_start(new)
         self.membership = new
         telemetry.set_elastic_epoch(epoch)
         _sync_barrier_epoch(epoch)

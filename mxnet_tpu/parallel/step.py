@@ -25,9 +25,8 @@ from __future__ import annotations
 from typing import Dict, List, Optional, Sequence
 
 from .. import optimizer as opt_mod
-from .. import random_state, telemetry, tracing
+from .. import random_state, tracing
 from ..base import MXNetError
-from ..telemetry import _state as _telemetry_state
 from ..context import current_context
 from ..ndarray import NDArray
 from ..gluon.block import (make_pure_fn, nested_flatten_nd,
@@ -109,7 +108,9 @@ class TrainStep:
         # inside the first traced step
         resolve_remat_policy(remat)
         self.remat = remat
-        self._cache: Dict = {}
+        from ..compiler import service as _csvc
+
+        self._cache = _csvc.SiteCache("train_step")
         self._params = None          # List[Parameter]
         self._param_specs = None     # per-param PartitionSpec
         self._trainable = None       # indices into _params
@@ -484,10 +485,21 @@ class TrainStep:
         batch_sh = tuple(ns(self._batch_spec(v))
                          for v in list(data_tuple) + list(label_tuple))
         in_sh = (param_sh, state_sh, rep, rep, rep) + batch_sh
-        donate = (0, 1)
-        if self.donate_inputs:
-            # batch args start after (params, states, t, lr, rng)
-            donate = donate + tuple(range(5, 5 + len(batch_sh)))
+        import os
+
+        if os.environ.get("MXNET_TPU_DONATE", "1") == "0":
+            # donation off (MXNET_TPU_DONATE=0): an HBM optimization
+            # with no value on host memory, and XLA:CPU's persistent-
+            # cache deserializer is unreliable for executables carrying
+            # input-output aliasing metadata (heap corruption on load,
+            # reproduced with plain jax.jit on this container's jax) —
+            # CPU processes that opt into the disk tier set this
+            donate: tuple = ()
+        else:
+            donate = (0, 1)
+            if self.donate_inputs:
+                # batch args start after (params, states, t, lr, rng)
+                donate = donate + tuple(range(5, 5 + len(batch_sh)))
         # outputs: params/states keep their layout (no per-step reshard);
         # loss replicated; model outputs/aux left to XLA (None = inferred)
         jitted = jax.jit(
@@ -496,8 +508,27 @@ class TrainStep:
             out_shardings=(param_sh, state_sh, rep, None, None),
             donate_argnums=donate,
         )
+
+        def cell_probe():
+            # settle `cell` (output treedef + aux arrays) without a
+            # compile when an exported-blob hit skipped the trace
+            if pipe is not None or cell["treedef"] is not None:
+                return
+            pvals = tuple(
+                jax.ShapeDtypeStruct(tuple(p.shape),
+                                     jax.numpy.dtype(str(p.dtype)))
+                for p in self._params)
+            with random_state.preserved_stream():
+                rng_t = random_state.get_state_key()
+            jax.eval_shape(
+                pure, pvals,
+                jax.ShapeDtypeStruct(tuple(rng_t.shape), rng_t.dtype),
+                *(jax.ShapeDtypeStruct(tuple(v.shape),
+                                       jax.numpy.dtype(str(v.dtype)))
+                  for v in data_tuple))
+
         return {"jitted": jitted, "cell": cell, "batch_sh": batch_sh,
-                "loss_only": loss_only}
+                "loss_only": loss_only, "cell_probe": cell_probe}
 
     def aot_compile(self, data, label=()):
         """AOT-compile the sharded train step on ABSTRACT parameters.
@@ -634,6 +665,191 @@ class TrainStep:
             v._set_data(jax.device_put(
                 v.data, named_sharding(self.mesh, self._batch_spec(v))))
 
+    # -- cache spine (compilation service) -------------------------------
+    def _key_for(self, data_tuple, label_tuple):
+        import os
+
+        from ..compiler import signature
+
+        # routing knobs key the cache like shapes do: the traced body
+        # dispatches on them (Pallas fused kernels, hash dropout), so a
+        # knob toggled between steps must re-trace, not replay. The
+        # donation knob is a BUILD-time knob of this site specifically —
+        # toggling MXNET_TPU_DONATE between steps must not replay an
+        # executable with the other aliasing contract
+        return signature(
+            "train_step", id(self),
+            avals=tuple((tuple(v.shape), str(v.dtype))
+                        for v in data_tuple + label_tuple),
+            extra=(len(data_tuple), True,
+                   os.environ.get("MXNET_TPU_DONATE", "1") != "0"))
+
+    def _entry_for(self, data_tuple, label_tuple):
+        """The compiled entry for this batch signature: cache hit, or
+        build + AOT-compile through the service's executable table and
+        journal the signature to the manifest."""
+        key = self._key_for(data_tuple, label_tuple)
+        entry = self._cache.lookup(key)
+        if entry is not self._cache.MISS:
+            return entry
+        if self.donate_inputs and len(self._cache):
+            # shape change with input donation: invalidate the stale
+            # lowerings. Their input buffers were donated — a later
+            # cache hit replaying a batch staged for the OLD shape
+            # would dispatch against donated-dead buffers (an opaque
+            # XLA RuntimeError at best, garbage reads at worst);
+            # re-lowering on return to a shape forces fresh staging.
+            # Deliberate trade: a donating step fed ALTERNATING
+            # shapes re-lowers on every switch. Donation is for
+            # single-use streamed batches (one bucket shape per
+            # step instance); alternating-bucket replay wants
+            # donate_inputs=False, which keeps every lowering.
+            self._cache.clear()
+        entry = self._build(data_tuple, label_tuple, True)
+        self._aot_seal(entry, data_tuple, label_tuple)
+        self._cache.insert(key, entry)
+        from .. import compiler
+
+        compiler.record_signature("train_step", {
+            "ident": self.warm_ident(),
+            "data": tuple((tuple(v.shape), str(v.dtype))
+                          for v in data_tuple),
+            "label": tuple((tuple(v.shape), str(v.dtype))
+                           for v in label_tuple),
+            "routing": compiler.routing_knobs()})
+        return entry
+
+    def _aot_seal(self, entry, data_tuple, label_tuple):
+        """AOT-compile the entry's step executable ahead of dispatch
+        through the service's persistence stack: in-process executable
+        table (a duplicate step recipe shares one XLA compile), the
+        exported-StableHLO blob store (a warm process skips the trace),
+        and jax's persistent compile cache (it skips the compile). Falls
+        back to the plain trace-at-first-call jit on any surprise."""
+        import os as _os
+
+        import jax
+        import numpy as np
+
+        try:
+            from ..compiler import keys as _ckeys
+            from ..compiler import service as _csvc
+
+            jitted = entry["jitted"]
+            param_sds = tuple(
+                jax.ShapeDtypeStruct(tuple(p.shape),
+                                     jax.numpy.dtype(str(p.dtype)))
+                for p in self._params)
+            state_sds = tuple(
+                jax.ShapeDtypeStruct(tuple(s.shape),
+                                     jax.numpy.dtype(str(s.dtype)))
+                for s in self._state_leaf_nds)
+            with random_state.preserved_stream():
+                rng = random_state.get_state_key()
+            batch_sds = tuple(
+                jax.ShapeDtypeStruct(tuple(v.shape),
+                                     jax.numpy.dtype(str(v.dtype)))
+                for v in tuple(data_tuple) + tuple(label_tuple))
+            args = (param_sds, state_sds,
+                    jax.ShapeDtypeStruct((), np.int32),
+                    jax.ShapeDtypeStruct((), np.float32),
+                    jax.ShapeDtypeStruct(tuple(rng.shape), rng.dtype)
+                    ) + batch_sds
+            from ..base import execution_platform
+            from .mesh import use_mesh
+
+            platform = self.mesh.devices.flat[0].platform
+            donate = _os.environ.get("MXNET_TPU_DONATE", "1") != "0"
+            with execution_platform(platform), use_mesh(self.mesh):
+                if donate:
+                    # donation-carrying programs stay on the direct
+                    # lower path (export round-trips drop aliasing);
+                    # still table-deduped + disk-compile-cached
+                    lowered = jitted.lower(*args)
+                    fp = _csvc.fingerprint_lowered(lowered)
+                    compiled = _csvc.exec_table.get_or_build(
+                        fp, lowered.compile)
+                    entry["jitted"] = _csvc.GuardedExec(
+                        compiled, lambda: jitted)
+                else:
+                    loss = self.loss
+                    loss_id = _ckeys.graph_ident(loss) \
+                        if hasattr(loss, "collect_params") \
+                        else _ckeys.callable_ident(loss)
+                    sig_fp = _ckeys.fingerprint(_ckeys.encode((
+                        "train_step", self.warm_ident(), loss_id,
+                        tuple((tuple(s.shape), str(s.dtype))
+                              for s in param_sds + state_sds + args[5:]),
+                        (tuple(rng.shape), str(rng.dtype)),
+                        _ckeys.routing_knobs(), platform,
+                        jax.__version__)))
+                    sealed = _csvc.seal_executable(
+                        sig_fp, jitted, args, fallback=lambda: jitted)
+                    if entry["cell"]["aux_arrays"] is None:
+                        try:
+                            entry["cell_probe"]()
+                        except Exception:
+                            # cell can't settle abstractly: keep the
+                            # trace-at-first-call jit (it settles cell
+                            # concretely)
+                            sealed = jitted
+                    entry["jitted"] = sealed
+        except Exception:
+            pass    # trace-at-first-call path stays
+
+    def warm_ident(self) -> str:
+        """Routing ident for ``train_step`` manifest entries: net
+        architecture + optimizer class + mesh layout + step config. Loose
+        by design — the replay re-lowers against THIS live step, so a
+        loose match costs a compile, never a wrong executable."""
+        from ..compiler import fingerprint, graph_ident
+
+        return fingerprint((
+            graph_ident(self.net), type(self.optimizer).__name__,
+            tuple(self.mesh.axis_names),
+            tuple(int(self.mesh.shape[a]) for a in self.mesh.axis_names),
+            tuple(self.batch_axis), self.seq_axis,
+            str(self.remat), bool(self.loss_only)))
+
+    def warm(self, data, label=()) -> str:
+        """AOT-compile this step for one batch signature before training
+        dispatches it (the manifest replay target; callable directly with
+        template NDArrays or ``(shape, dtype)`` specs). Settles
+        parameters and optimizer state if needed, then builds + compiles
+        the executable into the step cache — the first real ``__call__``
+        with this signature is a pure cache hit, zero retraces."""
+        from ..ndarray import zeros as nd_zeros
+
+        def to_nd(v):
+            if isinstance(v, NDArray):
+                return nd_zeros(tuple(v.shape), dtype=str(v.dtype))
+            if isinstance(v, (list, tuple)) and v \
+                    and isinstance(v[0], (int,)):
+                return nd_zeros(tuple(v), dtype="float32")
+            shape, dtype = v
+            return nd_zeros(tuple(shape), dtype=dtype)
+
+        data_tuple = tuple(to_nd(v) for v in _as_tuple(data))
+        label_tuple = tuple(to_nd(v) for v in _as_tuple(label))
+        if getattr(self, "_aot_only", False):
+            raise MXNetError("this TrainStep was used for aot_compile; "
+                             "warm() needs a live step")
+        if self._params is None:
+            self._settle_params(data_tuple)
+            self._init_states()
+        hit = self._key_for(data_tuple, label_tuple) in self._cache
+        # route through the live entry path: it owns the donation-
+        # invalidation rule (a donating step must never hold two batch
+        # shapes at once — a warm() that seeded several would hand real
+        # traffic donated-dead buffers on the alternate shape)
+        self._entry_for(data_tuple, label_tuple)
+        return "deduped" if hit else "replayed"
+
+    def warm_from_spec(self, spec) -> str:
+        """``compiler.warm_start``'s train_step replay hook."""
+        return self.warm(tuple(spec.get("data") or ()),
+                         tuple(spec.get("label") or ()))
+
     # -- call ------------------------------------------------------------
     def __call__(self, data, label):
         import jax
@@ -648,35 +864,7 @@ class TrainStep:
         if self._params is None:
             self._settle_params(data_tuple)
             self._init_states()
-        training = True
-        # routing knobs key the cache like shapes do: the traced body
-        # dispatches on them (Pallas fused kernels, hash dropout), so a
-        # knob toggled between steps must re-trace, not replay
-        from ..ops.registry import _routing_knobs
-
-        key = (len(data_tuple),
-               tuple((tuple(v.shape), str(v.dtype))
-                     for v in data_tuple + label_tuple), training,
-               _routing_knobs())
-        entry = self._cache.get(key)
-        if _telemetry_state.enabled:
-            telemetry.record_cache("train_step", hit=entry is not None)
-        if entry is None:
-            if self.donate_inputs and self._cache:
-                # shape change with input donation: invalidate the stale
-                # lowerings. Their input buffers were donated — a later
-                # cache hit replaying a batch staged for the OLD shape
-                # would dispatch against donated-dead buffers (an opaque
-                # XLA RuntimeError at best, garbage reads at worst);
-                # re-lowering on return to a shape forces fresh staging.
-                # Deliberate trade: a donating step fed ALTERNATING
-                # shapes re-lowers on every switch. Donation is for
-                # single-use streamed batches (one bucket shape per
-                # step instance); alternating-bucket replay wants
-                # donate_inputs=False, which keeps every lowering.
-                self._cache.clear()
-            entry = self._build(data_tuple, label_tuple, training)
-            self._cache[key] = entry
+        entry = self._entry_for(data_tuple, label_tuple)
         jitted, cell = entry["jitted"], entry["cell"]
 
         optimizer = self.optimizer
@@ -725,6 +913,11 @@ class TrainStep:
                 use_mesh(self.mesh):
             new_params, new_states, loss_val, outs, aux = jitted(
                 param_vals, state_vals, t, lr, rng, *batch_vals)
+        if not getattr(self, "_first_step_marked", False):
+            self._first_step_marked = True
+            from .. import compiler
+
+            compiler.mark_event("first_train_step")
 
         for p, v in zip(self._params, new_params):
             p.data()._set_data(v)

@@ -346,13 +346,49 @@ class Router:
     def start(self) -> "Router":
         if self.is_running:
             raise MXNetError(f"{self.name}: already running")
+        to_start = []
         for r in self._replicas:
             # hooks live only while the router does: an orphaned hook on
             # a server kept serving standalone would raise ReplicaFault
             # (deliberately non-transient) with no failover layer left
             r.server._pre_dispatch = self._replica_fault_hook(r)
             if not r.server.is_running:
-                r.server.start()
+                to_start.append(r.server)
+        if len(to_start) == 1:
+            to_start[0].start()
+        elif to_start:
+            # warm replicas CONCURRENTLY: Server.start() AOT-compiles the
+            # whole bucket grid, and N replicas of one architecture used
+            # to pay that serially, N times over. Grid compiles now route
+            # through the compilation service's in-process executable
+            # table (single-flight per lowered program), so the first
+            # replica to lower a bucket compiles it and the other N-1
+            # warm threads block briefly and share the executable —
+            # replica fleet warmup costs one compile set + (N-1) cheap
+            # traces, wall-clocked across a thread pool
+            from concurrent.futures import ThreadPoolExecutor
+
+            try:
+                with ThreadPoolExecutor(
+                        max_workers=min(8, len(to_start)),
+                        thread_name_prefix=f"{self.name}-warm") as pool:
+                    # list() re-raises the first failed replica start
+                    list(pool.map(lambda s: s.start(), to_start))
+            except BaseException:
+                # one replica failed mid-fleet-start: the pool already
+                # launched the others — stop every server THIS call
+                # started and drop the hooks, or they would keep serving
+                # standalone with a ReplicaFault hook and no failover
+                # layer above it
+                for r in self._replicas:
+                    r.server._pre_dispatch = None
+                for s in to_start:
+                    if s.is_running:
+                        try:
+                            s.stop(drain=False, timeout=5)
+                        except Exception:   # noqa: BLE001 - best effort
+                            pass
+                raise
         self._accepting = True
         self._running = True
         self._wedged = False

@@ -338,6 +338,11 @@ class Server:
                 self._count_request(outcome="error", t_enqueue=r.t_enqueue)
             return
         self.n_batches += 1
+        if self.n_batches == 1:
+            from .. import compiler
+
+            # replica cold-start milestone: start() -> first served batch
+            compiler.mark_event("first_response")
         if _telemetry_state.enabled:
             telemetry.record_serving_batch(n, cap, reason)
             for r in batch:
@@ -383,9 +388,26 @@ class Server:
         """AOT-compile ``block`` for every known signature: the full
         grid when it is enumerable (``prime=True`` + shape buckets), and
         always every signature this server has actually served — so a
-        hot-reloaded model is warm for live traffic before the swap."""
+        hot-reloaded model is warm for live traffic before the swap.
+
+        Warm compiles route through the compilation service: a replica
+        (or a reloaded model) whose program another in-process replica
+        already compiled is an executable-table hit, not a second XLA
+        compile — N replicas of one architecture warm for the price of
+        one. When a signature manifest is being recorded, its journal is
+        replayed against the block first, so signatures served by a
+        PREVIOUS process warm too (the manifest may know more than the
+        enumerable grid)."""
         if not self._warmup or not hasattr(block, "warmup"):
             return 0
+        from .. import compiler
+
+        man = compiler.recorder()
+        if man is not None:
+            try:
+                compiler.warm_start(man, blocks=[block])
+            except Exception:   # noqa: BLE001 - warm is best-effort
+                pass
         with self._model_lock:      # the scheduler adds sigs concurrently
             sigs = set(self._warm_sigs)
         if prime and self.grid.shape_buckets is not None:

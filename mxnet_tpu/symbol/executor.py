@@ -11,12 +11,10 @@ from __future__ import annotations
 
 from typing import Dict, List, Optional
 
-from .. import telemetry
 from ..base import MXNetError
 from ..context import Context, cpu, current_context
 from ..ndarray import NDArray, zeros as nd_zeros
 from ..ndarray.ndarray import _wrap_jax
-from ..telemetry import _state as _telemetry_state
 from .symbol import Symbol, _apply_opdef
 from ..ops.registry import get_op
 
@@ -105,7 +103,9 @@ class Executor:
             args_grad = dict(zip(arg_names, args_grad))
         self.grad_dict: Dict[str, NDArray] = dict(args_grad)
         self.outputs: List[NDArray] = []
-        self._fwd_cache = {}
+        from ..compiler import service as _csvc
+
+        self._fwd_cache = _csvc.SiteCache("executor")
         self._vjp = None
         self._is_train = False
 
@@ -113,11 +113,15 @@ class Executor:
     def _compiled(self, training: bool):
         import jax
 
-        key = training
-        fn = self._fwd_cache.get(key)
-        if _telemetry_state.enabled:
-            telemetry.record_cache("executor", hit=fn is not None)
-        if fn is None:
+        from .. import compiler
+
+        # canonical service key: the bound graph is fixed per Executor,
+        # so the signature varies only in the train flag (+ the routing
+        # knobs every compile cache keys on)
+        key = compiler.signature("executor", id(self._symbol),
+                                 extra=(training,))
+        fn = self._fwd_cache.lookup(key)
+        if fn is self._fwd_cache.MISS:
             sym = self._symbol
             arg_names = sym.list_arguments()
             aux_names = sym.list_auxiliary_states()
@@ -131,7 +135,12 @@ class Executor:
                 return tuple(outs), new_aux
 
             fn = jax.jit(pure)
-            self._fwd_cache[key] = fn
+            self._fwd_cache.insert(key, fn)
+            compiler.record_signature("executor", {
+                "args": {n: tuple(self.arg_dict[n].shape)
+                         for n in arg_names},
+                "training": training,
+                "routing": compiler.routing_knobs()})
         return fn
 
     def forward(self, is_train=False, **kwargs):

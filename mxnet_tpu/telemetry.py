@@ -46,7 +46,9 @@ __all__ = [
     "enable", "disable", "enabled", "reset",
     "counter", "gauge", "histogram",
     "dumps", "prom_text", "chrome_counter_events", "snapshot",
-    "record_op_dispatch", "record_cache", "record_kv",
+    "record_op_dispatch", "record_cache", "record_cache_eviction",
+    "record_cold_start", "record_warm_start", "record_elastic_warm",
+    "record_kv",
     "record_kv_collective", "record_kv_bucket", "record_kv_compression",
     "record_engine_wait", "set_live_arrays", "record_live_evictions",
     "record_training_step", "record_xla_dispatch", "record_bulk_flush",
@@ -471,6 +473,50 @@ def record_cache(cache: str, hit: bool) -> None:
             "Compile-cache lookups by cache and result.",
             ("cache", "result")).labels(
                 cache, "hit" if hit else "miss").inc()
+
+
+def record_cache_eviction(cache: str, n: int = 1) -> None:
+    """LRU eviction(s) from a compile cache (or the persistent XLA disk
+    tier). Previously silent — a thrashing cache recompiled forever with
+    nothing on the dashboard; now the rate is a first-class signal."""
+    if not _state.enabled:
+        return
+    counter("mxnet_jit_cache_evictions_total",
+            "Compile-cache LRU evictions by cache.",
+            ("cache",)).labels(cache).inc(n)
+
+
+def record_cold_start(event: str, seconds: float) -> None:
+    """A cold-start milestone (``compiler.mark_event``): seconds from
+    package import to the first ``warm_start_done`` / ``first_train_step``
+    / ``first_response``. Set once per event per process."""
+    if not _state.enabled:
+        return
+    gauge("mxnet_coldstart_seconds",
+          "Seconds from package import to each first-time lifecycle "
+          "event.", ("event",)).labels(event).set(seconds)
+
+
+def record_elastic_warm(seconds: float) -> None:
+    """Duration of one elastic warm_start hook (fires per membership
+    epoch — a DURATION histogram, distinct from the since-import
+    ``mxnet_coldstart_seconds`` milestones)."""
+    if not _state.enabled:
+        return
+    histogram("mxnet_elastic_warm_seconds",
+              "Elastic warm_start hook duration per (re-)bootstrap.",
+              buckets=STEP_BUCKETS).observe(seconds)
+
+
+def record_warm_start(outcome: str, n: int = 1) -> None:
+    """Manifest warm-start replay outcomes (``replayed``: compiled AOT,
+    ``deduped``: already in the in-process executable table, ``skipped``:
+    no provider for the entry, ``failed``)."""
+    if not _state.enabled:
+        return
+    counter("mxnet_compile_warm_total",
+            "Signature-manifest warm-start entries by outcome.",
+            ("outcome",)).labels(outcome).inc(n)
 
 
 def record_kv(op: str, nbytes: float, seconds: float) -> None:
