@@ -9,6 +9,9 @@ after EVERY completed stage (flushed), monotonically enriched:
     stage 2.5 comms exchange       -> line 3 (adds comms_* — per-key vs
              bucketed vs bucketed+2bit gradient exchange on the
              ResNet-50-scale param set; dispatch counts + loss gate)
+    stage 2.6 optimizer sweep      -> adds opt_sweep_* /
+             optimizer_dispatches_per_step (fused multi-tensor sweep vs
+             per-param updater loop on the same param set; BENCH_r06)
     stage 3  BERT-base subprocess  -> line 4 (adds bert_*)
     stage 4  Llama proxy subprocess-> line 5 (adds llama_proxy_*)
     stage 5  ResNet-50 real-data   -> line 6 (adds real_data_*)
@@ -158,6 +161,18 @@ def main():
             record["comms_error"] = repr(e)[:200]
     else:
         record["comms_skipped"] = "budget"
+    _emit(record)
+    _write_telemetry(telemetry_out)
+
+    # stage 2.6: fused multi-tensor optimizer sweep microbench (ISSUE 11
+    # / BENCH_r06: optimizer-phase dispatch collapse + sweep time)
+    if _remaining_s() > 30:
+        try:
+            record.update(_optimizer_extra())
+        except Exception as e:
+            record["opt_sweep_error"] = repr(e)[:200]
+    else:
+        record["opt_sweep_skipped"] = "budget"
     _emit(record)
     _write_telemetry(telemetry_out)
 
@@ -327,6 +342,26 @@ def _bulk_extra(chain_len=64, reps=10):
     }
 
 
+def _resnet50_param_shapes():
+    """The comms/optimizer microbench param set, loaded once from
+    tools/comms_bench.py (import is side-effect free)."""
+    global _RESNET_SHAPES
+    if _RESNET_SHAPES is None:
+        import importlib.util as ilu
+
+        spec = ilu.spec_from_file_location(
+            "comms_bench", os.path.join(
+                os.path.dirname(os.path.abspath(__file__)), "tools",
+                "comms_bench.py"))
+        cb = ilu.module_from_spec(spec)
+        spec.loader.exec_module(cb)
+        _RESNET_SHAPES = cb.resnet50_param_shapes()
+    return _RESNET_SHAPES
+
+
+_RESNET_SHAPES = None
+
+
 def _comms_extra(copies=2, reps=3):
     """Gradient-exchange microbench (stage 2.5): per-key vs bucketed vs
     bucketed+2bit on the ResNet-50-scale parameter set (ISSUE 5).
@@ -346,19 +381,11 @@ def _comms_extra(copies=2, reps=3):
     """
     if os.environ.get("BENCH_SKIP_COMMS"):
         return {}
-    import importlib.util as ilu
-
     import mxnet_tpu as mx
     from mxnet_tpu import kvstore as kvmod, telemetry
     from mxnet_tpu.kvstore import bucket_cap_bytes
 
-    spec = ilu.spec_from_file_location(
-        "comms_bench", os.path.join(
-            os.path.dirname(os.path.abspath(__file__)), "tools",
-            "comms_bench.py"))
-    cb = ilu.module_from_spec(spec)
-    spec.loader.exec_module(cb)   # import is side-effect free
-    shapes = cb.resnet50_param_shapes()
+    shapes = _resnet50_param_shapes()
     cap = bucket_cap_bytes()
 
     def collectives():
@@ -468,6 +495,97 @@ def _comms_loss_bit_identity(steps=4):
     losses_pk, w_pk = run(0)
     losses_bk, w_bk = run(25)
     return losses_pk == losses_bk and bool(np.array_equal(w_pk, w_bk))
+
+
+def _optimizer_extra(reps=3):
+    """Optimizer-sweep microbench (stage 2.6): the eager optimizer phase
+    on the ResNet-50-scale parameter set, per-param updater loop vs the
+    horizontally-fused multi-tensor sweep (ISSUE 11; first measured in
+    BENCH_r06).
+
+    Reports ``optimizer_dispatches_per_step`` for both paths (from the
+    ``mxnet_optimizer_dispatch_total`` counters — the O(params) ->
+    O(dtype buckets) collapse is the number this engine exists to move),
+    median wall time per optimizer phase, and the bit-identity gate
+    (fused Adam must match the per-param reference EXACTLY). Opt out
+    with BENCH_SKIP_OPTSWEEP=1.
+    """
+    if os.environ.get("BENCH_SKIP_OPTSWEEP"):
+        return {}
+    import mxnet_tpu as mx
+    from mxnet_tpu import optimizer as opt_mod, telemetry
+    from mxnet_tpu.optimizer import multi_tensor as mt
+
+    shapes = _resnet50_param_shapes()
+    rs = np.random.RandomState(0)
+    host_w = [rs.randn(*s).astype(np.float32) for s in shapes]
+    host_g = [rs.randn(*s).astype(np.float32) for s in shapes]
+
+    def dispatches():
+        fam = telemetry.snapshot()["metrics"].get(
+            "mxnet_optimizer_dispatch_total")
+        return {s["labels"]["path"]: s["value"]
+                for s in (fam["samples"] if fam else ())}
+
+    def run_path(fused):
+        prev = os.environ.get("MXNET_FUSED_OPTIMIZER")
+        os.environ["MXNET_FUSED_OPTIMIZER"] = "1" if fused else "0"
+        try:
+            o = opt_mod.create("adam", learning_rate=1e-3)
+            o.rescale_grad = 1.0 / 256
+            upd = opt_mod.get_updater(o)
+            ws = [mx.nd.array(w) for w in host_w]
+            gs = [mx.nd.array(g) for g in host_g]
+            items = [(i, w, g) for i, (w, g) in enumerate(zip(ws, gs))]
+
+            def sweep():
+                if fused:
+                    assert mt.eager_fused_update(o, upd, items)
+                else:
+                    for i, w, g in items:
+                        telemetry.record_optimizer_dispatch("per_param")
+                        upd(i, g, w)
+                mx.nd.waitall()
+
+            sweep()                      # warm: states + compiles
+            d0 = dispatches()
+            times = []
+            for _ in range(reps):
+                t0 = time.perf_counter()
+                sweep()
+                times.append(time.perf_counter() - t0)
+            d1 = dispatches()
+            per_step = sum(d1.values()) - sum(d0.values())
+            times.sort()
+            return (per_step / reps, times[len(times) // 2] * 1e3,
+                    [w.asnumpy() for w in ws])
+        finally:
+            if prev is None:
+                os.environ.pop("MXNET_FUSED_OPTIMIZER", None)
+            else:
+                os.environ["MXNET_FUSED_OPTIMIZER"] = prev
+
+    was_enabled = telemetry.enabled()
+    telemetry.enable()
+    try:
+        fused_n, fused_ms, fused_w = run_path(True)
+        perparam_n, perparam_ms, perparam_w = run_path(False)
+    finally:
+        if not was_enabled:
+            telemetry.disable()
+    identical = all(np.array_equal(a, b)
+                    for a, b in zip(fused_w, perparam_w))
+    return {
+        "opt_sweep_params": len(shapes),
+        "optimizer_dispatches_per_step": round(fused_n, 1),
+        "optimizer_dispatches_per_step_unfused": round(perparam_n, 1),
+        "opt_sweep_dispatch_reduction": round(
+            perparam_n / max(fused_n, 1.0), 1),
+        "opt_sweep_fused_ms_per_step": round(fused_ms, 2),
+        "opt_sweep_perparam_ms_per_step": round(perparam_ms, 2),
+        "opt_sweep_speedup": round(perparam_ms / max(fused_ms, 1e-9), 2),
+        "opt_sweep_bit_identical": bool(identical),
+    }
 
 
 def _real_data_extra(batch, steps=10, img_size=224, n_images=2048):

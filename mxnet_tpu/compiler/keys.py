@@ -44,7 +44,8 @@ def routing_knobs() -> tuple:
     executable cache or a knob toggle would keep replaying the
     previously-traced body."""
     return (os.environ.get("MXNET_PALLAS_FUSED", "0") == "1",
-            os.environ.get("MXNET_TPU_HASH_DROPOUT", "0") == "1")
+            os.environ.get("MXNET_TPU_HASH_DROPOUT", "0") == "1",
+            os.environ.get("MXNET_FUSED_OPTIMIZER", "1") != "0")
 
 
 class SigKey(NamedTuple):

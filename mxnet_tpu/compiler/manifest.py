@@ -43,7 +43,7 @@ MANIFEST_VERSION = 1
 # sites warm_start knows how to handle; an entry whose site is absent here
 # is stale (written by a newer/older build) and is skipped on load
 KNOWN_SITES = ("eager_op", "fused_segment", "cached_op", "train_step",
-               "executor")
+               "executor", "optimizer_sweep")
 
 
 def cache_base_dir() -> str:

@@ -480,6 +480,13 @@ def _replay_entry(entry: dict, blocks_by_ident: dict,
         if step is None:
             return "skipped"
         return step.warm_from_spec(spec)
+    if site == "optimizer_sweep":
+        # needs no provider: the spec fully determines the traced sweep
+        # (family + hyperparams + bucket layout), so a fresh process
+        # rebuilds and AOT-compiles it before the first Trainer.step
+        from ..optimizer import multi_tensor
+
+        return multi_tensor.warm_sweep_spec(spec)
     return "skipped"    # executor: replay needs a bound symbol graph
 
 

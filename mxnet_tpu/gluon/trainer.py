@@ -403,11 +403,65 @@ class Trainer:
                     continue
                 self._kvstore.pull(i, p.list_data(), priority=-i)
             return
+        if self._fused_update():
+            return
         for i, p in enumerate(self._params):
             if p.grad_req == "null":
                 continue
             for upd, arr, grad in zip(self._updaters, p.list_data(), p.list_grad()):
+                telemetry.record_optimizer_dispatch("per_param")
                 upd(i, grad, arr)
+
+    def _fused_update(self) -> bool:
+        """The horizontally-fused optimizer phase: pack every dense
+        trainable param of like dtype into one bucket and apply the
+        whole update as ONE jitted multi-tensor sweep per bucket
+        (optimizer/multi_tensor.py) — O(params) eager dispatches
+        collapse to O(dtype buckets). Engages for the fused families
+        (SGD/Adam/AdamW/LAMB, exact class) unless
+        ``MXNET_FUSED_OPTIMIZER=0``; row-sparse-grad params keep the
+        per-param path (their updater owns the lazy-row contract).
+        Bit-identical to the per-param loop — the test gate."""
+        from ..optimizer import multi_tensor as mt
+
+        if not mt.fused_sweep_enabled() \
+                or mt.family_of(self._optimizer) is None:
+            return False
+        if len(self._updaters) > 1 \
+                and self._optimizer.lr_scheduler is not None:
+            # per-param interleaves contexts per index, so mid-step
+            # num_update (the scheduler clock) evolves differently than
+            # a per-context sweep would see — keep the reference order
+            return False
+        dense, sparse = [], []
+        for i, p in enumerate(self._params):
+            if p.grad_req == "null":
+                continue
+            (sparse if getattr(p, "grad_stype", "default") == "row_sparse"
+             else dense).append(i)
+        if not dense:
+            return False
+        per_ctx_items = [
+            [(i, self._params[i].list_data()[ci],
+              self._params[i].list_grad()[ci]) for i in dense]
+            for ci in range(len(self._updaters))]
+        # plan EVERY context before applying ANY sweep: a fallback
+        # after context 0 already swept would re-run the per-param loop
+        # over it too (double update). The plans carry the validated
+        # bucket/state layout, so nothing is recomputed at apply time
+        plans = [mt.plan_eager(self._optimizer, upd, items)
+                 for upd, items in zip(self._updaters, per_ctx_items)]
+        if any(p is None for p in plans):
+            return False    # unfusable state layout: per-param loop
+        for plan, items in zip(plans, per_ctx_items):
+            mt.apply_eager_plan(self._optimizer, plan, items)
+        for i in sparse:
+            p = self._params[i]
+            for upd, arr, grad in zip(self._updaters, p.list_data(),
+                                      p.list_grad()):
+                telemetry.record_optimizer_dispatch("per_param")
+                upd(i, grad, arr)
+        return True
 
     # ------------------------------------------------------------------
     # envelope marker for trainer-state payloads that carry gradient-

@@ -210,8 +210,15 @@ def lamb_update_phase1(weight, grad, mean, var, *, beta1=0.9, beta2=0.999,
 # list and mutates momenta in place via mutable inputs; the functional
 # equivalent returns every updated tensor, interleaved per weight in input
 # order (same convention as the single-tensor ops above, which return
-# updated state as extra outputs). Inside a jitted step XLA fuses the whole
-# list into few kernels, which is the performance point of the upstream op.
+# updated state as extra outputs).
+#
+# Since the fused-sweep engine landed, these ops are RE-EXPRESSED on its
+# packed layout (``optimizer/multi_tensor.py::packed_apply``): members of
+# like dtype are coalesced into flat buffers and the whole group updates
+# in one elementwise sweep (the Pallas kernel on TPU under
+# MXNET_PALLAS_FUSED, the identical jnp math otherwise) — the upstream
+# op's one-kernel-per-list behavior, not just something XLA may or may
+# not fuse back together.
 # ---------------------------------------------------------------------------
 
 
@@ -224,20 +231,66 @@ def _per_weight(v, i):
     return v
 
 
+def _packed_groups(ws, gs, mp):
+    """Member-index groups from the sweep engine's ONE bucket planner
+    (dtype rule + the ``MXNET_OPT_BUCKET_MB`` size-class cap) —
+    re-deriving the grouping here would fork the contract."""
+    from ..optimizer import multi_tensor as mt
+
+    entries = [(tuple(w.shape), str(w.dtype), str(g.dtype))
+               for w, g in zip(ws, gs)]
+    return [list(b.members)
+            for b in mt.plan_buckets(entries, multi_precision=mp)]
+
+
+def _packed_multi_sgd(ws, gs, moms, w32s, lrs, wds, momentum,
+                      rescale_grad, clip_gradient):
+    """The packed SGD family sweep behind every ``multi_*sgd*`` op.
+
+    Returns per-member role dict lists (w/[mom]/[w32]) in input order.
+    """
+    from ..optimizer import multi_tensor as mt
+
+    n = len(ws)
+    static = {"momentum": float(momentum), "clip_gradient": clip_gradient}
+    out_w = [None] * n
+    out_m = [None] * n if moms is not None else None
+    out_w32 = [None] * n if w32s is not None else None
+    for idxs in _packed_groups(ws, gs, w32s is not None):
+        shapes = [tuple(ws[i].shape) for i in idxs]
+        ins = {"g": [gs[i] for i in idxs]}
+        if w32s is not None:
+            ins["w"] = [w32s[i] for i in idxs]
+            low_dtype = ws[idxs[0]].dtype
+        else:
+            ins["w"] = [ws[i] for i in idxs]
+            low_dtype = None
+        if moms is not None:
+            ins["mom"] = [moms[i] for i in idxs]
+        vecs = {"lr": [_per_weight(lrs, i) for i in idxs],
+                "wd": [_per_weight(wds, i) for i in idxs]}
+        new = mt.packed_apply("sgd", static, shapes, ins, vecs,
+                              rescale_grad, low_dtype=low_dtype)
+        for j, i in enumerate(idxs):
+            out_w[i] = new["w_low"][j] if w32s is not None else new["w"][j]
+            if out_m is not None:
+                out_m[i] = new["mom"][j]
+            if out_w32 is not None:
+                out_w32[i] = new["w"][j]
+    return out_w, out_m, out_w32
+
+
 @register("multi_sgd_update", variadic=True)
 def multi_sgd_update(*inputs, lrs, wds, rescale_grad=1.0, clip_gradient=-1.0,
                      num_weights=None):
     """Fused SGD over a parameter list. Inputs: w0, g0, w1, g1, ...;
     outputs: updated weights in order."""
     n = num_weights if num_weights is not None else len(inputs) // 2
-    outs = []
-    for i in range(n):
-        w, g = inputs[2 * i], inputs[2 * i + 1]
-        outs.append(sgd_update(w, g, lr=_per_weight(lrs, i),
-                               wd=_per_weight(wds, i),
-                               rescale_grad=rescale_grad,
-                               clip_gradient=clip_gradient))
-    return tuple(outs)
+    ws = [inputs[2 * i] for i in range(n)]
+    gs = [inputs[2 * i + 1] for i in range(n)]
+    out_w, _, _ = _packed_multi_sgd(ws, gs, None, None, lrs, wds, 0.0,
+                                    rescale_grad, clip_gradient)
+    return tuple(out_w)
 
 
 @register("multi_sgd_mom_update", variadic=True)
@@ -245,14 +298,15 @@ def multi_sgd_mom_update(*inputs, lrs, wds, momentum=0.0, rescale_grad=1.0,
                          clip_gradient=-1.0, num_weights=None):
     """Inputs: w0, g0, m0, w1, g1, m1, ...; outputs: w0', m0', w1', m1', ..."""
     n = num_weights if num_weights is not None else len(inputs) // 3
+    ws = [inputs[3 * i] for i in range(n)]
+    gs = [inputs[3 * i + 1] for i in range(n)]
+    ms = [inputs[3 * i + 2] for i in range(n)]
+    out_w, out_m, _ = _packed_multi_sgd(ws, gs, ms, None, lrs, wds,
+                                        momentum, rescale_grad,
+                                        clip_gradient)
     outs = []
     for i in range(n):
-        w, g, m = inputs[3 * i], inputs[3 * i + 1], inputs[3 * i + 2]
-        new_w, new_m = sgd_mom_update(
-            w, g, m, lr=_per_weight(lrs, i), momentum=momentum,
-            wd=_per_weight(wds, i), rescale_grad=rescale_grad,
-            clip_gradient=clip_gradient)
-        outs.extend((new_w, new_m))
+        outs.extend((out_w[i], out_m[i]))
     return tuple(outs)
 
 
@@ -261,13 +315,15 @@ def multi_mp_sgd_update(*inputs, lrs, wds, rescale_grad=1.0,
                         clip_gradient=-1.0, num_weights=None):
     """Inputs: w0, g0, w32_0, ...; outputs: w0', w32_0', ..."""
     n = num_weights if num_weights is not None else len(inputs) // 3
+    ws = [inputs[3 * i] for i in range(n)]
+    gs = [inputs[3 * i + 1] for i in range(n)]
+    w32s = [inputs[3 * i + 2] for i in range(n)]
+    out_w, _, out_w32 = _packed_multi_sgd(ws, gs, None, w32s, lrs, wds,
+                                          0.0, rescale_grad,
+                                          clip_gradient)
     outs = []
     for i in range(n):
-        w, g, w32 = inputs[3 * i], inputs[3 * i + 1], inputs[3 * i + 2]
-        new_w, new_w32 = mp_sgd_update(
-            w, g, w32, lr=_per_weight(lrs, i), wd=_per_weight(wds, i),
-            rescale_grad=rescale_grad, clip_gradient=clip_gradient)
-        outs.extend((new_w, new_w32))
+        outs.extend((out_w[i], out_w32[i]))
     return tuple(outs)
 
 
@@ -276,14 +332,108 @@ def multi_mp_sgd_mom_update(*inputs, lrs, wds, momentum=0.0, rescale_grad=1.0,
                             clip_gradient=-1.0, num_weights=None):
     """Inputs: w0, g0, m0, w32_0, ...; outputs: w0', m0', w32_0', ..."""
     n = num_weights if num_weights is not None else len(inputs) // 4
+    ws = [inputs[4 * i] for i in range(n)]
+    gs = [inputs[4 * i + 1] for i in range(n)]
+    ms = [inputs[4 * i + 2] for i in range(n)]
+    w32s = [inputs[4 * i + 3] for i in range(n)]
+    out_w, out_m, out_w32 = _packed_multi_sgd(ws, gs, ms, w32s, lrs, wds,
+                                              momentum, rescale_grad,
+                                              clip_gradient)
     outs = []
     for i in range(n):
-        w, g, m, w32 = inputs[4 * i:4 * i + 4]
-        new_w, new_m, new_w32 = mp_sgd_mom_update(
-            w, g, m, w32, lr=_per_weight(lrs, i), momentum=momentum,
-            wd=_per_weight(wds, i), rescale_grad=rescale_grad,
-            clip_gradient=clip_gradient)
-        outs.extend((new_w, new_m, new_w32))
+        outs.extend((out_w[i], out_m[i], out_w32[i]))
+    return tuple(outs)
+
+
+def _packed_multi_lamb(ws, gs, ms, vs, w32s, lrs, wds, beta1, beta2,
+                       epsilon, t, bias_correction, lower_bound,
+                       upper_bound, rescale_grad, clip_gradient):
+    from ..optimizer import multi_tensor as mt
+
+    n = len(ws)
+    static = {"beta1": beta1, "beta2": beta2, "epsilon": epsilon,
+              "bias_correction": bool(bias_correction),
+              "lower_bound": lower_bound, "upper_bound": upper_bound,
+              "clip_gradient": clip_gradient, "bc_recip": False}
+    out = {"w": [None] * n, "mean": [None] * n, "var": [None] * n,
+           "w32": [None] * n if w32s is not None else None}
+    for idxs in _packed_groups(ws, gs, w32s is not None):
+        shapes = [tuple(ws[i].shape) for i in idxs]
+        ins = {"g": [gs[i] for i in idxs],
+               "mean": [ms[i] for i in idxs],
+               "var": [vs[i] for i in idxs]}
+        if w32s is not None:
+            ins["w"] = [w32s[i] for i in idxs]
+            low_dtype = ws[idxs[0]].dtype
+        else:
+            ins["w"] = [ws[i] for i in idxs]
+            low_dtype = None
+        vecs = {"lr": [_per_weight(lrs, i) for i in idxs],
+                "wd": [_per_weight(wds, i) for i in idxs]}
+        if bias_correction:
+            vecs["bc1"] = [1.0 - beta1 ** t] * len(idxs)
+            vecs["bc2"] = [1.0 - beta2 ** t] * len(idxs)
+        new = mt.packed_apply("lamb", static, shapes, ins, vecs,
+                              rescale_grad, low_dtype=low_dtype)
+        for j, i in enumerate(idxs):
+            out["w"][i] = new["w_low"][j] if w32s is not None \
+                else new["w"][j]
+            out["mean"][i] = new["mean"][j]
+            out["var"][i] = new["var"][j]
+            if out["w32"] is not None:
+                out["w32"][i] = new["w"][j]
+    return out
+
+
+@register("multi_lamb_update", variadic=True)
+def multi_lamb_update(*inputs, lrs, wds, beta1=0.9, beta2=0.999,
+                      epsilon=1e-6, t=1, bias_correction=True,
+                      rescale_grad=1.0, clip_gradient=-1.0,
+                      lower_bound=-1.0, upper_bound=-1.0,
+                      num_weights=None):
+    """Horizontally-fused LAMB over a parameter list (reference:
+    mp_lamb_update_phase1/2 looped per weight). Inputs: w0, g0, m0, v0,
+    ...; outputs: w0', m0', v0', ... Both elementwise phases run on the
+    packed dtype buckets; the per-tensor trust-ratio norms run as one
+    ``multi_sum_sq``-style pass over the packed buffer."""
+    n = num_weights if num_weights is not None else len(inputs) // 4
+    ws = [inputs[4 * i] for i in range(n)]
+    gs = [inputs[4 * i + 1] for i in range(n)]
+    ms = [inputs[4 * i + 2] for i in range(n)]
+    vs = [inputs[4 * i + 3] for i in range(n)]
+    out = _packed_multi_lamb(ws, gs, ms, vs, None, lrs, wds, beta1,
+                             beta2, epsilon, t, bias_correction,
+                             lower_bound, upper_bound, rescale_grad,
+                             clip_gradient)
+    outs = []
+    for i in range(n):
+        outs.extend((out["w"][i], out["mean"][i], out["var"][i]))
+    return tuple(outs)
+
+
+@register("multi_mp_lamb_update", variadic=True)
+def multi_mp_lamb_update(*inputs, lrs, wds, beta1=0.9, beta2=0.999,
+                         epsilon=1e-6, t=1, bias_correction=True,
+                         rescale_grad=1.0, clip_gradient=-1.0,
+                         lower_bound=-1.0, upper_bound=-1.0,
+                         num_weights=None):
+    """Multi-precision fused LAMB. Inputs: w0, g0, m0, v0, w32_0, ...;
+    outputs: w0', m0', v0', w32_0', ... — the mp_lamb_update_phase1/2
+    pair horizontally fused across the list on the packed layout."""
+    n = num_weights if num_weights is not None else len(inputs) // 5
+    ws = [inputs[5 * i] for i in range(n)]
+    gs = [inputs[5 * i + 1] for i in range(n)]
+    ms = [inputs[5 * i + 2] for i in range(n)]
+    vs = [inputs[5 * i + 3] for i in range(n)]
+    w32s = [inputs[5 * i + 4] for i in range(n)]
+    out = _packed_multi_lamb(ws, gs, ms, vs, w32s, lrs, wds, beta1,
+                             beta2, epsilon, t, bias_correction,
+                             lower_bound, upper_bound, rescale_grad,
+                             clip_gradient)
+    outs = []
+    for i in range(n):
+        outs.extend((out["w"][i], out["mean"][i], out["var"][i],
+                     out["w32"][i]))
     return tuple(outs)
 
 

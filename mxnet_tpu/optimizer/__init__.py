@@ -1,3 +1,9 @@
-"""Optimizers (reference: python/mxnet/optimizer/)."""
+"""Optimizers (reference: python/mxnet/optimizer/).
+
+``multi_tensor`` holds the horizontally-fused multi-tensor sweep engine
+(dtype-bucketed packed updates — reference: the ``multi_sgd_*`` /
+``mp_lamb_*`` fused op family); imported lazily by its consumers
+(Trainer, TrainStep, the multi_* ops), not at package import.
+"""
 from .optimizer import *  # noqa: F401,F403
 from .optimizer import Optimizer, Updater, create, register, get_updater  # noqa: F401

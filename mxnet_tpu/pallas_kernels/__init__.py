@@ -13,8 +13,11 @@ from .flash_attention import (flash_attention, flash_attention_scan,
 from .fused_layers import (fused_bias_gelu, fused_layer_norm,
                            fused_layers_enabled, fused_ln_shape_supported,
                            fused_ln_supported, fused_rms_norm)
+from .fused_optimizer import (fused_opt_enabled, fused_opt_supported,
+                              sweep_pallas)
 
 __all__ = ["flash_attention", "flash_attention_scan", "flash_supported",
            "flash_shape_supported", "fused_layer_norm", "fused_rms_norm",
            "fused_bias_gelu", "fused_layers_enabled",
-           "fused_ln_shape_supported", "fused_ln_supported"]
+           "fused_ln_shape_supported", "fused_ln_supported",
+           "fused_opt_enabled", "fused_opt_supported", "sweep_pallas"]

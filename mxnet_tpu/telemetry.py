@@ -50,6 +50,7 @@ __all__ = [
     "record_cold_start", "record_warm_start", "record_elastic_warm",
     "record_kv",
     "record_kv_collective", "record_kv_bucket", "record_kv_compression",
+    "record_optimizer_dispatch", "record_optimizer_bucket",
     "record_engine_wait", "set_live_arrays", "record_live_evictions",
     "record_training_step", "record_xla_dispatch", "record_bulk_flush",
     "record_fault_injected", "record_retry", "record_checkpoint_write",
@@ -583,6 +584,31 @@ def record_pallas_dispatch(kernel: str, n: int = 1) -> None:
             "Pallas-kernel routings into compiled traces by kernel "
             "(adoption counter: one per kernel site per trace).",
             ("kernel",)).labels(kernel).inc(n)
+
+
+def record_optimizer_dispatch(path: str, n: int = 1) -> None:
+    """One optimizer-phase update dispatch on the eager Trainer path.
+    ``path``: ``per_param`` (one updater call per parameter — the
+    reference shape) or ``fused_sweep`` (one packed multi-tensor sweep
+    per dtype bucket). The O(params) -> O(buckets) collapse the fused
+    engine exists for is read directly off this counter."""
+    if not _state.enabled:
+        return
+    counter("mxnet_optimizer_dispatch_total",
+            "Optimizer-phase update dispatches by path "
+            "(per_param/fused_sweep).", ("path",)).labels(path).inc(n)
+
+
+def record_optimizer_bucket(nbytes: float, nparams: int) -> None:
+    """One fused optimizer bucket swept (packed multi-tensor update)."""
+    if not _state.enabled:
+        return
+    histogram("mxnet_optimizer_bucket_bytes",
+              "Parameter bytes per fused optimizer sweep bucket.",
+              buckets=BYTES_BUCKETS).observe(float(nbytes))
+    counter("mxnet_optimizer_bucketed_params_total",
+            "Parameters updated through fused multi-tensor sweeps."
+            ).inc(nparams)
 
 
 def record_kv_overlap(when: str, n: int = 1) -> None:

@@ -29,6 +29,23 @@ def _counter(snap, name, **labels):
     return total
 
 
+def _cache_misses(snap, base=None):
+    """Per-cache miss counts (positive only), optionally as the DELTA
+    from a ``base`` snapshot — the registry is process-global, so a
+    guard reading absolutes would blame misses other tests legitimately
+    recorded in THEIR telemetry windows (order fragility)."""
+    def read(s):
+        fam = s["metrics"].get("mxnet_jit_cache_total", {"samples": []})
+        return {sm["labels"]["cache"]: sm["value"]
+                for sm in fam["samples"]
+                if sm["labels"]["result"] == "miss"}
+
+    now = read(snap)
+    before = read(base) if base is not None else {}
+    return {k: v - before.get(k, 0) for k, v in now.items()
+            if v - before.get(k, 0) > 0}
+
+
 def _make_net(width=16, seed=0):
     mx.random.seed(seed)
     net = nn.HybridSequential(prefix="svc_")
@@ -318,13 +335,18 @@ class TestWarmStart:
 
             telemetry.enable()
             try:
+                base = telemetry.snapshot()
                 loss_warm, _ = warm(x, y)
                 loss_warm = loss_warm.asnumpy()
                 snap = telemetry.snapshot()
                 assert _counter(snap, "mxnet_jit_cache_total",
-                                cache="train_step", result="miss") == 0
+                                cache="train_step", result="miss") \
+                    == _counter(base, "mxnet_jit_cache_total",
+                                cache="train_step", result="miss")
                 assert _counter(snap, "mxnet_jit_cache_total",
-                                cache="train_step", result="hit") == 1
+                                cache="train_step", result="hit") \
+                    - _counter(base, "mxnet_jit_cache_total",
+                               cache="train_step", result="hit") == 1
             finally:
                 telemetry.disable()
             assert loss_warm.tobytes() == loss_cold.tobytes()
@@ -515,16 +537,11 @@ class TestRetraceGuard:
         step(x, y)                       # warm: compile once
         telemetry.enable()
         try:
+            base = telemetry.snapshot()
             for _ in range(3):
                 loss, _ = step(x, y)
             loss.asnumpy()
-            snap = telemetry.snapshot()
-            fam = snap["metrics"].get("mxnet_jit_cache_total",
-                                      {"samples": []})
-            misses = {s["labels"]["cache"]: s["value"]
-                      for s in fam["samples"]
-                      if s["labels"]["result"] == "miss"
-                      and s["value"] > 0}
+            misses = _cache_misses(telemetry.snapshot(), base)
             assert not misses, (
                 f"steady-state training re-traced after warmup: {misses}")
         finally:
@@ -542,16 +559,11 @@ class TestRetraceGuard:
             srv.submit(np.zeros((8,), np.float32)).result(timeout=60)
             telemetry.enable()
             try:
+                base = telemetry.snapshot()
                 for _ in range(3):
                     srv.submit(
                         np.zeros((8,), np.float32)).result(timeout=60)
-                snap = telemetry.snapshot()
-                fam = snap["metrics"].get("mxnet_jit_cache_total",
-                                          {"samples": []})
-                misses = {s["labels"]["cache"]: s["value"]
-                          for s in fam["samples"]
-                          if s["labels"]["result"] == "miss"
-                          and s["value"] > 0}
+                misses = _cache_misses(telemetry.snapshot(), base)
                 assert not misses, (
                     f"steady-state serving re-traced after warmup: "
                     f"{misses}")

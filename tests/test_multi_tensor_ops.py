@@ -96,3 +96,142 @@ def test_multi_mp_sgd_update_matches_loop():
                                    rtol=1e-3)
         np.testing.assert_allclose(outs[2 * i + 1].asnumpy(),
                                    w322.asnumpy(), rtol=1e-6)
+
+
+# ---------------------------------------------------------------------------
+# packed-layout re-expression (the fused-sweep engine behind the ops)
+# ---------------------------------------------------------------------------
+
+
+def test_multi_sgd_mixed_dtype_buckets():
+    """A call mixing fp32 and fp16 weights splits into per-dtype packed
+    buckets and still matches the looped oracle member-wise."""
+    ws32, gs32 = _params(n=2, seed=1)
+    ws16, gs16 = _params(n=2, seed=2, dtype=np.float16)
+    ws = [ws32[0], ws16[0], ws32[1], ws16[1]]
+    gs = [gs32[0], gs16[0], gs32[1], gs16[1]]
+    lrs = (0.1, 0.2, 0.05, 0.15)
+    wds = (0.0, 1e-3, 1e-4, 0.0)
+    inputs = [t for pair in zip(ws, gs) for t in pair]
+    outs = mx.nd.multi_sgd_update(*inputs, lrs=lrs, wds=wds,
+                                  num_weights=4)
+    for i, (w, g) in enumerate(zip(ws, gs)):
+        want = mx.nd.sgd_update(w, g, lr=lrs[i], wd=wds[i])
+        assert outs[i].dtype == w.dtype
+        np.testing.assert_allclose(outs[i].asnumpy().astype(np.float32),
+                                   want.asnumpy().astype(np.float32),
+                                   rtol=2e-3)
+
+
+def test_multi_sgd_mom_zero_momentum_still_rewrites_mom():
+    """momentum=0 through the packed path keeps the op contract: the
+    momentum buffer is rewritten to -lr*g, not passed through."""
+    ws, gs = _params(n=2)
+    ms = [mx.nd.zeros(w.shape) + 0.5 for w in ws]
+    inputs = [t for trip in zip(ws, gs, ms) for t in trip]
+    outs = mx.nd.multi_sgd_mom_update(*inputs, lrs=LRS[:2], wds=WDS[:2],
+                                      momentum=0.0, num_weights=2)
+    for i, (w, g, m) in enumerate(zip(ws, gs, ms)):
+        w2, m2 = mx.nd.sgd_mom_update(w, g, m, lr=LRS[i], wd=WDS[i],
+                                      momentum=0.0)
+        np.testing.assert_allclose(outs[2 * i + 1].asnumpy(),
+                                   m2.asnumpy(), rtol=1e-6)
+        assert not np.allclose(outs[2 * i + 1].asnumpy(), 0.5)
+
+
+def _lamb_loop_oracle(w, g, m, v, lr, wd, t, **kw):
+    """Looped single-tensor composition: phase1 -> norms -> phase2."""
+    upd, m2, v2 = mx.nd.lamb_update_phase1(
+        w, g, m, v, t=t, wd=wd, **kw)
+    r1 = w.norm()
+    r2 = upd.norm()
+    w2 = mx.nd.lamb_update_phase2(w, upd, r1, r2, lr=lr)
+    return w2, m2, v2
+
+
+def test_multi_lamb_update_matches_loop():
+    ws, gs = _params()
+    ms = [mx.nd.zeros(w.shape) + 0.01 for w in ws]
+    vs = [mx.nd.zeros(w.shape) + 0.001 for w in ws]
+    inputs = [t for quad in zip(ws, gs, ms, vs) for t in quad]
+    outs = mx.nd.multi_lamb_update(*inputs, lrs=LRS, wds=WDS, t=3,
+                                   rescale_grad=0.5, num_weights=3)
+    for i in range(3):
+        w2, m2, v2 = _lamb_loop_oracle(
+            ws[i], gs[i], ms[i], vs[i], LRS[i], WDS[i], 3,
+            rescale_grad=0.5)
+        np.testing.assert_allclose(outs[3 * i].asnumpy(), w2.asnumpy(),
+                                   rtol=1e-5, atol=1e-7)
+        np.testing.assert_allclose(outs[3 * i + 1].asnumpy(),
+                                   m2.asnumpy(), rtol=1e-6)
+        np.testing.assert_allclose(outs[3 * i + 2].asnumpy(),
+                                   v2.asnumpy(), rtol=1e-6)
+
+
+def test_multi_mp_lamb_update_matches_loop():
+    ws, gs = _params(dtype=np.float16)
+    w32s = [w.astype("float32") for w in ws]
+    ms = [mx.nd.zeros(w.shape, dtype="float32") for w in ws]
+    vs = [mx.nd.zeros(w.shape, dtype="float32") + 1e-4 for w in ws]
+    inputs = [t for q in zip(ws, gs, ms, vs, w32s) for t in q]
+    outs = mx.nd.multi_mp_lamb_update(*inputs, lrs=LRS, wds=WDS, t=2,
+                                      num_weights=3)
+    for i in range(3):
+        g32 = gs[i].astype("float32")
+        upd, m2, v2 = mx.nd.mp_lamb_update_phase1(
+            ws[i], g32, ms[i], vs[i], w32s[i], t=2, wd=WDS[i])
+        r1 = w32s[i].norm()
+        r2 = upd.norm()
+        w2, w322 = mx.nd.mp_lamb_update_phase2(
+            ws[i], upd, r1, r2, w32s[i], lr=LRS[i])
+        assert outs[4 * i].dtype == np.float16       # low weight kept
+        assert outs[4 * i + 3].dtype == np.float32   # master fp32
+        np.testing.assert_allclose(
+            outs[4 * i].asnumpy().astype(np.float32),
+            w2.asnumpy().astype(np.float32), rtol=2e-3)
+        np.testing.assert_allclose(outs[4 * i + 3].asnumpy(),
+                                   w322.asnumpy(), rtol=1e-5, atol=1e-7)
+        np.testing.assert_allclose(outs[4 * i + 1].asnumpy(),
+                                   m2.asnumpy(), rtol=1e-6)
+
+
+def test_packed_sweep_pallas_interpret_matches_lax():
+    """The Pallas sweep kernel (interpret mode = CPU oracle) agrees with
+    the identical-formula lax fallback to FMA-contraction tolerance."""
+    from mxnet_tpu.optimizer import multi_tensor as mt
+
+    rs = np.random.RandomState(0)
+    shapes = [(4, 5), (7,), (2, 3, 2)]
+    ws = [rs.randn(*s).astype(np.float32) for s in shapes]
+    gs = [rs.randn(*s).astype(np.float32) for s in shapes]
+    ms = [np.zeros(s, np.float32) + 0.1 for s in shapes]
+    vs = [np.zeros(s, np.float32) + 0.2 for s in shapes]
+    static = {"beta1": 0.9, "beta2": 0.999, "epsilon": 1e-8,
+              "clip_gradient": None}
+    ins = {"w": ws, "g": gs, "mean": ms, "var": vs}
+    vecs = {"lr": list(LRS), "wd": list(WDS)}
+    lax_out = mt.packed_apply("adam", static, shapes, ins, vecs, 0.5,
+                              platform="cpu")
+    ker_out = mt.packed_apply("adam", static, shapes, ins, vecs, 0.5,
+                              platform="cpu", interpret=True)
+    for role in ("w", "mean", "var"):
+        for a, b in zip(lax_out[role], ker_out[role]):
+            np.testing.assert_allclose(np.asarray(a), np.asarray(b),
+                                       rtol=1e-6, atol=1e-7)
+
+
+def test_segment_sumsq_matches_per_member_norms():
+    import jax.numpy as jnp
+
+    from mxnet_tpu.optimizer import multi_tensor as mt
+
+    rs = np.random.RandomState(3)
+    shapes = [(16, 32), (7,), (3, 5, 7)]
+    arrs = [rs.randn(*s).astype(np.float32) for s in shapes]
+    sizes = [int(np.prod(s)) for s in shapes]
+    offsets = np.concatenate([[0], np.cumsum(sizes)]).tolist()
+    flat = jnp.concatenate([jnp.asarray(a).reshape(-1) for a in arrs])
+    out = np.asarray(mt.segment_sumsq(flat, shapes, offsets))
+    for i, a in enumerate(arrs):
+        want = float(jnp.sum(jnp.square(jnp.asarray(a))))
+        assert out[i] == np.float32(want)
