@@ -86,6 +86,8 @@ SITES = (
     "serving.reload",
     "serving.replica",
     "serving.route",
+    "serving.upgrade",
+    "controller.scale",
     "elastic.heartbeat",
     "elastic.rejoin",
 )

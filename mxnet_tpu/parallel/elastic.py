@@ -41,6 +41,19 @@ bit-exact state hand-off:
   data stream (``owns(index)`` / ``shard_indices(n)`` over dense ranks),
   so every sample keeps exactly one owner at every epoch.
 
+* **Preemption as the common case.** Spot/preemptible capacity makes
+  leave/join routine, not exceptional: ``install_preemption_handler``
+  turns the provider's SIGTERM notice into a *graceful* leave — the
+  loop finishes the current step, checkpoints at that boundary,
+  unlinks its heartbeat file (siblings see the departure immediately,
+  no staleness wait) and raises :class:`Preempted`, whose
+  ``exit_code`` (75, ``PREEMPTED_EXIT_CODE``) tells
+  ``tools/launch.py`` to respawn it OUTSIDE the ``--max-restarts``
+  failure budget with a flat backoff. ``tools/chaos_check.py``'s
+  preemption gate drives a scripted preemption schedule through this
+  path and asserts the trajectory stays bit-identical to an
+  uninterrupted run at sustained throughput.
+
 A restarted worker (``tools/launch.py --max-restarts N`` respawns it
 with the same ``DMLC_WORKER_ID``) finds the newest valid bundle for its
 rank at :meth:`ElasticRunner.start` and resumes from it — kill a worker
@@ -72,6 +85,7 @@ from __future__ import annotations
 import json
 import logging
 import os
+import signal as _signal
 import socket
 import threading
 import time
@@ -86,7 +100,25 @@ from ..checkpoint import CheckpointManager, atomic_write
 from ..fault import _state as _fault_state
 
 __all__ = ["ElasticRunner", "HeartbeatBoard", "Membership",
-           "live_runners"]
+           "Preempted", "PREEMPTED_EXIT_CODE", "live_runners"]
+
+# EX_TEMPFAIL: "capacity reclaimed, respawn me" — tools/launch.py treats
+# workers exiting with this code as preempted (restarted outside the
+# --max-restarts failure budget, flat backoff)
+PREEMPTED_EXIT_CODE = 75
+
+
+class Preempted(MXNetError):
+    """Raised by :meth:`ElasticRunner.run` after a graceful preemption
+    leave: the state is checkpointed at ``step`` (the last completed
+    step), the heartbeat is retired, and the process should exit with
+    :attr:`exit_code` (``PREEMPTED_EXIT_CODE``) so the supervisor
+    respawns it as a preemption, not a failure."""
+
+    def __init__(self, msg: str, step: int):
+        super().__init__(msg)
+        self.step = int(step)
+        self.exit_code = PREEMPTED_EXIT_CODE
 
 _HB_DIR = "hb"
 _EPOCH_FILE = "EPOCH"
@@ -215,6 +247,16 @@ class HeartbeatBoard:
         return sorted(r for r, m in self.mtimes().items()
                       if now - m <= timeout)
 
+    def remove(self, rank: int) -> None:
+        """Retire a rank's heartbeat file — the FAST leave signal: a
+        gracefully-leaving rank (preemption) unlinks its file so the
+        siblings see the departure on their next membership check
+        instead of waiting out the staleness timeout."""
+        try:
+            os.unlink(self.path(rank))
+        except OSError:
+            pass
+
 
 class ElasticRunner:
     """Supervised elastic training loop (see module docstring).
@@ -311,6 +353,10 @@ class ElasticRunner:
         self._last_completed = -1
         self._hb_stop = threading.Event()
         self._hb_thread: Optional[threading.Thread] = None
+        self._preempt = threading.Event()
+        self._preempt_reason = ""
+        self._old_handlers: Dict[int, object] = {}
+        self._preempt_signal_spec: tuple = ()   # re-armed by start()
         _RUNNERS.add(self)
 
     # -- heartbeats ----------------------------------------------------
@@ -418,6 +464,15 @@ class ElasticRunner:
         valid bundle when one exists (the rejoin path)."""
         if self._started:
             return self.membership
+        if self._preempt_signal_spec and not self._old_handlers:
+            # a previous run()'s stop() restored the OS handlers; the
+            # user's one-time install_preemption_handler() stays in
+            # force across this runner's phases
+            try:
+                self.install_preemption_handler(
+                    self._preempt_signal_spec)
+            except ValueError:
+                pass    # not the main thread: run unprotected
         self.board.register(self.launch_rank)
         self.board.touch(self.launch_rank)
         self._hb_stop = threading.Event()
@@ -561,15 +616,77 @@ class ElasticRunner:
         self.adopted_step = step
         self.start_step = step + 1
 
+    # -- preemption (graceful leave: spot/preemptible capacity) --------
+    def request_preemption(self, reason: str = "requested") -> None:
+        """Flag this worker for a graceful leave: the supervised loop
+        finishes the CURRENT step, checkpoints at that boundary,
+        retires its heartbeat (fast leave — the file is unlinked, not
+        left to go stale), and raises :class:`Preempted`. Safe from any
+        thread and from signal handlers (a bare ``Event.set``)."""
+        self._preempt_reason = reason
+        self._preempt.set()
+
+    @property
+    def preemption_requested(self) -> bool:
+        return self._preempt.is_set()
+
+    def install_preemption_handler(self, signals=(_signal.SIGTERM,)
+                                   ) -> "ElasticRunner":
+        """Route OS preemption notice (cloud spot reclaim is a SIGTERM
+        with a grace window) into :meth:`request_preemption`. Previous
+        handlers are restored by :meth:`stop` — and because ``run()``
+        stops the runner on the way out, the installation is
+        remembered and **re-armed by the next** :meth:`start`/``run()``
+        of this runner, so multi-phase training stays covered between
+        phases it drives itself. Main thread only (a CPython
+        signal-module constraint)."""
+        self._preempt_signal_spec = tuple(signals)
+        for sig in signals:
+            old = _signal.signal(
+                sig, lambda signum, frame:
+                self.request_preemption(
+                    f"signal {_signal.Signals(signum).name}"))
+            self._old_handlers.setdefault(int(sig), old)
+        return self
+
+    def _restore_signal_handlers(self) -> None:
+        handlers, self._old_handlers = self._old_handlers, {}
+        for sig, old in handlers.items():
+            try:
+                _signal.signal(sig, old)
+            except (ValueError, TypeError, OSError):
+                pass
+
+    def _graceful_leave(self) -> None:
+        """The preemption protocol: checkpoint at the completed-step
+        boundary (this bundle is what the respawned incarnation — or a
+        surviving peer adopting our shard — resumes from), stop the
+        heartbeat thread, and unlink the heartbeat file so the
+        siblings' membership check sees the leave NOW instead of after
+        the staleness timeout."""
+        if self._last_completed >= 0:
+            self._save(self._last_completed)
+        telemetry.record_elastic_preemption()
+        self.stop()
+        self.board.remove(self.launch_rank)
+        logging.getLogger(__name__).info(
+            "rank %d preempted (%s): checkpointed step %d, left",
+            self.launch_rank, self._preempt_reason,
+            self._last_completed)
+
     def stop(self) -> None:
-        """Stop the heartbeat thread (idempotent). The heartbeat file is
-        left to go stale — that IS the leave signal to the siblings."""
+        """Stop the heartbeat thread (idempotent) and restore any
+        preemption signal handlers. The heartbeat file is left to go
+        stale — that IS the leave signal to the siblings (a graceful
+        preemption leave additionally unlinks it — see
+        :meth:`_graceful_leave`)."""
         self._hb_stop.set()
         t = self._hb_thread
         if t is not None and t.is_alive():
             t.join(timeout=max(1.0, 4 * self.heartbeat_interval))
         self._hb_thread = None
         self._started = False
+        self._restore_signal_handlers()
 
     def __enter__(self):
         self.start()
@@ -725,6 +842,17 @@ class ElasticRunner:
         results = []
         try:
             for step in range(self.start_step, int(num_steps)):
+                if self._preempt.is_set():
+                    # graceful leave at the step BOUNDARY: the current
+                    # step's work is committed, the next one never
+                    # starts half-done
+                    self._graceful_leave()
+                    raise Preempted(
+                        f"rank {self.launch_rank} preempted "
+                        f"({self._preempt_reason}) after step "
+                        f"{self._last_completed}; state checkpointed — "
+                        f"exit with code {PREEMPTED_EXIT_CODE} for a "
+                        "preemption respawn", self._last_completed)
                 m = self.check_membership()
                 results.append(step_fn(step, m))
                 self._last_completed = step

@@ -9,12 +9,24 @@ XLA executable under a per-request latency SLO. ``Router`` fronts N
 ``Server`` replicas behind the same ``submit() -> Future`` contract
 with least-loaded dispatch, per-replica circuit breakers, bounded
 failover (no future is ever lost) and deadline-aware admission control
-(synchronous typed ``ServerOverloaded`` shedding). Hot reload, fault
-injection/retry and Prometheus telemetry ride the PR-1/PR-3
-infrastructure; see :mod:`.server`, :mod:`.buckets`, :mod:`.reload`,
-:mod:`.router`, :mod:`.health`.
+(synchronous typed ``ServerOverloaded`` shedding). The fleet is
+elastic: ``Router.add_replica``/``remove_replica`` grow and drain it
+live, ``FleetController`` drives them from the router's own traffic
+signals, and ``rolling_upgrade`` walks a new model through the fleet
+with breaker-gated automatic rollback (see :mod:`.controller`). Hot
+reload, fault injection/retry and Prometheus telemetry ride the
+PR-1/PR-3 infrastructure; see :mod:`.server`, :mod:`.buckets`,
+:mod:`.reload`, :mod:`.router`, :mod:`.health`.
 """
 from .buckets import BucketGrid
+from .controller import (
+    FleetController,
+    FleetSignals,
+    ScalePolicy,
+    UpgradeRolledBack,
+    live_controllers,
+    rolling_upgrade,
+)
 from .health import CircuitBreaker, Heartbeat
 from .reload import ReloadWatcher
 from .router import (
@@ -30,4 +42,6 @@ __all__ = [
     "Server", "BucketGrid", "ReloadWatcher", "live_servers",
     "Router", "ServerOverloaded", "FailoverExhausted", "ReplicaFault",
     "CircuitBreaker", "Heartbeat", "live_routers",
+    "FleetController", "FleetSignals", "ScalePolicy",
+    "UpgradeRolledBack", "rolling_upgrade", "live_controllers",
 ]

@@ -68,6 +68,7 @@ Telemetry: ``mxnet_serving_replica_healthy{replica}`` (1 closed /
 """
 from __future__ import annotations
 
+import logging
 import threading
 import time
 import weakref
@@ -93,6 +94,8 @@ from .server import Server
 
 __all__ = ["Router", "ServerOverloaded", "FailoverExhausted",
            "ReplicaFault", "live_routers"]
+
+_log = logging.getLogger(__name__)
 
 # every running router, for the test-suite leak guard (mirrors
 # server._live_servers)
@@ -178,23 +181,29 @@ class _RouteReq:
 
 
 class _Flight:
-    """One request currently forwarded to one replica."""
+    """One request currently forwarded to one replica. Holds the
+    :class:`_Replica` OBJECT, not a position in the replica list — the
+    list is mutable now (``add_replica``/``remove_replica``) and a
+    positional index would dangle the moment the fleet changes under an
+    outstanding dispatch."""
 
-    __slots__ = ("req", "ridx", "t_sent", "rfut", "probe")
+    __slots__ = ("req", "rep", "t_sent", "rfut", "probe")
 
-    def __init__(self, req, ridx, t_sent, probe):
+    def __init__(self, req, rep, t_sent, probe):
         self.req = req
-        self.ridx = ridx
+        self.rep = rep
         self.t_sent = t_sent
         self.rfut = None
         self.probe = probe
 
 
 class _Replica:
-    """Router-side state for one managed Server replica."""
+    """Router-side state for one managed Server replica. ``index`` is a
+    stable id assigned at admission (monotonic, never reused), not a
+    list position."""
 
     __slots__ = ("server", "index", "breaker", "inflight", "n_ok",
-                 "n_failed", "last_state")
+                 "n_failed", "last_state", "draining")
 
     def __init__(self, server: Server, index: int,
                  failure_threshold, cooldown_s):
@@ -207,6 +216,8 @@ class _Replica:
         self.n_ok = 0
         self.n_failed = 0
         self.last_state = CLOSED   # for transition counting
+        self.draining = False      # remove_replica in progress: no new
+        #                            dispatches, in-flight ones finish
 
 
 class Router:
@@ -249,6 +260,7 @@ class Router:
         names = [s.name for s in replicas]
         if len(set(names)) != len(names):
             raise MXNetError(f"replica names must be unique, got {names}")
+        self._next_index = len(replicas)   # stable replica ids, never reused
         if max_queue < 1:
             raise MXNetError(f"max_queue must be >= 1, got {max_queue}")
         if retry_budget is None:
@@ -282,22 +294,20 @@ class Router:
         self.retry_budget = int(retry_budget)
         self.dispatch_timeout_s = float(dispatch_timeout_s)
         self.watchdog_timeout_s = float(watchdog_timeout_s)
+        # copy-on-write: fleet changes REPLACE the list (atomic store
+        # under the GIL), so dispatcher/monitor threads iterating a
+        # captured snapshot never see a half-mutated fleet
         self._replicas: List[_Replica] = [
             _Replica(s, i, None, None) for i, s in enumerate(replicas)]
+        # serializes fleet admin (add/remove/rolling upgrade) — the
+        # dispatch path never takes it
+        self._admin_lock = threading.Lock()
 
         self._cond = threading.Condition()
         self._queue: deque = deque()
         self._flights: dict = {}            # id(flight) -> _Flight
         self._n_inflight = 0
         self._done_ts: deque = deque(maxlen=64)   # completion timestamps
-        # predicted-wait shedding arms only past this backlog (queued +
-        # in flight): below a couple of full fleet batches the observed
-        # completion rate measures demand, not capacity, and a burst
-        # into an idle fleet would shed against a spuriously low
-        # estimate. Backlog counts IN-FLIGHT too — under overload the
-        # requests pile up in the replica queues, not the router's.
-        self._shed_arm_pending = max(
-            32, 2 * self.grid.max_batch * len(self._replicas))
         self._accepting = False
         self._running = False
         self._wedged = False
@@ -312,6 +322,18 @@ class Router:
         self.n_failovers = 0
         self.n_ok = 0
         self.n_errors = 0
+
+    @property
+    def _shed_arm_pending(self) -> int:
+        # predicted-wait shedding arms only past this backlog (queued +
+        # in flight): below a couple of full fleet batches the observed
+        # completion rate measures demand, not capacity, and a burst
+        # into an idle fleet would shed against a spuriously low
+        # estimate. Backlog counts IN-FLIGHT too — under overload the
+        # requests pile up in the replica queues, not the router's.
+        # A property because the fleet is elastic now: the threshold
+        # tracks the CURRENT replica count.
+        return max(32, 2 * self.grid.max_batch * len(self._replicas))
 
     # -- replica fault plumbing ----------------------------------------
     def _replica_fault_hook(self, r: _Replica):
@@ -488,6 +510,171 @@ class Router:
     def __exit__(self, *exc) -> None:
         self.stop(drain=not any(exc))
 
+    # -- fleet management (the control plane's seam) -------------------
+    def _check_compatible(self, server: Server) -> None:
+        g0 = self.grid
+        if server.grid.batch_buckets != g0.batch_buckets or \
+                server.grid.shape_buckets != g0.shape_buckets:
+            raise MXNetError(
+                f"replica {server.name} has a different bucket grid "
+                "than the fleet — replicas must share one grid "
+                "(matched-bucket bit-identity)")
+        if any(r.server.name == server.name for r in self._replicas):
+            raise MXNetError(
+                f"replica name {server.name!r} already in the fleet")
+
+    def add_replica(self, server: Server) -> None:
+        """Admit one more ``Server`` replica into the fleet, live.
+
+        The server's grid must match the fleet's (bit-identity at
+        matched buckets) and its name must be unique. On a running
+        router the server is started first when it is not already —
+        ``Server.start()`` AOT-warms the whole bucket grid through the
+        compilation service, so a scale-up of an architecture any
+        in-process replica already compiled is an executable-table hit,
+        not a fresh XLA compile — and only then joins the dispatch set:
+        no request is ever routed at a cold replica. Thread-safe
+        (serialized with ``remove_replica``/rolling upgrades)."""
+        with self._admin_lock:      # serializes fleet admin: the name /
+            self._check_compatible(server)   # grid check cannot race
+            if self.is_running:
+                server._pre_dispatch = self._replica_fault_hook_for(server)
+                if not server.is_running:
+                    try:
+                        server.start()      # warm BEFORE taking traffic
+                    except BaseException:
+                        server._pre_dispatch = None
+                        raise
+            with self._cond:
+                rep = _Replica(server, self._next_index, None, None)
+                self._next_index += 1
+                # the start-window hook had no stable index; swap in
+                # the real one (sub-site ``serving.replica.<index>``)
+                if self.is_running:
+                    server._pre_dispatch = self._replica_fault_hook(rep)
+                self._replicas = self._replicas + [rep]
+                self._cond.notify_all()
+        if _telemetry_state.enabled:
+            telemetry.set_fleet_size(len(self._replicas))
+
+    def _replica_fault_hook_for(self, server: Server):
+        """Placeholder hook for the start window of an admitted-but-not-
+        yet-committed replica: family site only (it has no stable index
+        yet). Replaced by the indexed hook at commit."""
+        name = server.name
+
+        def hook(sig):
+            if not _fault_state.enabled:
+                return
+            try:
+                fault.check("serving.replica", f"{name} batch={sig}")
+            except fault.FaultInjected as e:
+                raise ReplicaFault(
+                    f"replica {name} (joining) failed: {e}") from e
+        return hook
+
+    def remove_replica(self, name: str, drain: bool = True,
+                       timeout: Optional[float] = None,
+                       stop_server: bool = True) -> Server:
+        """Retire the replica called ``name`` from the fleet.
+
+        ``drain=True`` (default) first stops routing NEW requests at it
+        (the picker skips draining replicas) and waits — bounded by
+        ``timeout`` — for its router-forwarded in-flight requests to
+        resolve; anything still outstanding at the deadline is failed
+        over to the rest of the fleet (zero lost futures). The replica
+        is then detached and, with ``stop_server=True``, stopped.
+        Removing the LAST replica is refused — scale to zero is
+        ``Router.stop()``, not a drain. Returns the detached
+        ``Server``."""
+        with self._admin_lock:
+            # deadline starts AFTER the admin lock is ours: time spent
+            # queued behind a rolling upgrade's bakes or a scale-up
+            # warm must not consume the caller's drain budget
+            deadline = (time.monotonic() + timeout) \
+                if timeout is not None else None
+            with self._cond:
+                target = next((r for r in self._replicas
+                               if r.server.name == name), None)
+                if target is None:
+                    raise MXNetError(
+                        f"{self.name}: no replica named {name!r}")
+                if len(self._replicas) <= 1:
+                    raise MXNetError(
+                        f"{self.name}: refusing to remove the last "
+                        f"replica {name!r} — stop the router instead")
+                target.draining = True
+                self._cond.notify_all()
+            if drain and self.is_running:
+                with self._cond:
+                    while target.inflight > 0:
+                        if deadline is not None and \
+                                time.monotonic() >= deadline:
+                            break
+                        self._cond.wait(0.02)
+            # anything still in flight (drain=False, or the timeout
+            # expired): evict and fail over — the fleet it drains into
+            # is healthy, the replica is leaving either way
+            evicted = self._take_flights_of(target)
+            for f in evicted:
+                self._retry_or_fail(
+                    f.req,
+                    MXNetError(f"replica {name} drained out of the "
+                               "fleet with this request in flight"),
+                    reason="drained", replica=target)
+            with self._cond:
+                self._replicas = [r for r in self._replicas
+                                  if r is not target]
+                self._cond.notify_all()
+            target.server._pre_dispatch = None
+        if _telemetry_state.enabled:
+            telemetry.set_fleet_size(len(self._replicas))
+        if stop_server and target.server.is_running:
+            remaining = (max(deadline - time.monotonic(), 0.1)
+                         if deadline is not None else None)
+            try:
+                target.server.stop(drain=drain, timeout=remaining)
+            except MXNetError:
+                # a scheduler wedged in dispatch can outlive the drain
+                # deadline — the REMOVAL already succeeded (replica
+                # detached, flights failed over), so don't fail it;
+                # the daemon thread exits when the dispatch returns
+                _log.warning(
+                    "%s: removed replica %s did not stop within its "
+                    "drain deadline (scheduler wedged in dispatch?); "
+                    "its thread will exit when the dispatch returns",
+                    self.name, name)
+        return target.server
+
+    def replicas(self) -> list:
+        """Fleet snapshot for the control plane: one dict per replica
+        (name, stable index, breaker state, inflight, draining)."""
+        return [{"name": r.server.name, "index": r.index,
+                 "state": r.breaker.state, "inflight": r.inflight,
+                 "draining": r.draining, "server": r.server,
+                 "breaker": r.breaker}
+                for r in self._replicas]
+
+    def fleet_size(self, include_draining: bool = False) -> int:
+        reps = self._replicas
+        if include_draining:
+            return len(reps)
+        return sum(1 for r in reps if not r.draining)
+
+    def predicted_wait(self) -> float:
+        """The admission controller's current completion-time estimate
+        for a request submitted now (0.0 when there is no estimate) —
+        the autoscaler's primary scale-up signal. Armed by the same
+        backlog threshold as predicted-wait shedding: an idle fleet
+        that JUST finished a burst still has a nonzero raw estimate
+        (a fresh request would ride a full fleet batch), and reporting
+        it would scale up a fleet with nothing queued."""
+        with self._cond:
+            pending = len(self._queue) + self._n_inflight
+            if pending <= self._shed_arm_pending:
+                return 0.0
+            return self._predicted_wait_locked(pending)
+
     # -- admission -----------------------------------------------------
     # completions older than the window do not inform the service-rate
     # estimate, and gaps between completions are capped: idle time
@@ -655,7 +842,7 @@ class Router:
                 self._cond.wait(0.005)
             return
         r, probe = target
-        flight = _Flight(req, r.index, time.perf_counter(), probe)
+        flight = _Flight(req, r, time.perf_counter(), probe)
         remaining_ms = max((req.deadline - time.perf_counter()) * 1e3,
                            1.0)
         with self._cond:
@@ -705,8 +892,12 @@ class Router:
 
     def _pick_replica(self):
         """(replica, is_probe) — HALF_OPEN probes first (recovery must
-        be detected under any traffic), then least-loaded CLOSED."""
-        live = [r for r in self._replicas if r.server.is_running]
+        be detected under any traffic), then least-loaded CLOSED.
+        Draining replicas (a ``remove_replica`` in progress) take no new
+        work — their in-flight dispatches finish through the normal
+        resolution path."""
+        live = [r for r in self._replicas
+                if r.server.is_running and not r.draining]
         for r in live:
             if r.breaker.state == HALF_OPEN and r.breaker.admit():
                 return r, True
@@ -725,10 +916,10 @@ class Router:
         with self._cond:
             late = self._flights.pop(id(flight), None) is None
             if not late:
-                self._replicas[flight.ridx].inflight -= 1
+                flight.rep.inflight -= 1
                 self._n_inflight -= 1
                 self._cond.notify_all()
-        r = self._replicas[flight.ridx]
+        r = flight.rep
         try:
             exc = rfut.exception()
         except BaseException as e:  # noqa: BLE001 - cancelled etc.
@@ -797,7 +988,7 @@ class Router:
         (their late resolutions, if any, are dropped first-wins)."""
         with self._cond:
             mine = [f for f in self._flights.values()
-                    if f.ridx == r.index]
+                    if f.rep is r]
             for f in mine:
                 self._flights.pop(id(f), None)
                 r.inflight -= 1
@@ -843,12 +1034,12 @@ class Router:
                        + self.dispatch_timeout_s]
             for f in overdue:
                 self._flights.pop(id(f), None)
-                self._replicas[f.ridx].inflight -= 1
+                f.rep.inflight -= 1
                 self._n_inflight -= 1
             if overdue:
                 self._cond.notify_all()
         for f in overdue:
-            r = self._replicas[f.ridx]
+            r = f.rep
             r.breaker.record_hang()
             r.n_failed += 1
             hung.append((f, r, MXNetError(
@@ -900,10 +1091,12 @@ class Router:
             "failovers": self.n_failovers, "queue_depth": depth,
             "inflight": inflight, "running": self.is_running,
             "wedged": self._wedged,
+            "fleet_size": self.fleet_size(),
             "replicas": [
                 {"name": r.server.name, "index": r.index,
                  "state": r.breaker.state, "inflight": r.inflight,
                  "ok": r.n_ok, "failed": r.n_failed,
+                 "draining": r.draining,
                  "trips": r.breaker.n_trips}
                 for r in self._replicas],
         }

@@ -136,6 +136,11 @@ class Server:
         # patiently filling a batch toward its deadline close.
         self.hb = Heartbeat()
         self.loaded_step: Optional[int] = None
+        # monotonic model-version counter: bumps on every swap_model /
+        # reload; a rolling-upgrade rollback restores the OLD number so
+        # fleet version agreement is observable (Router/controller read
+        # it, never write it)
+        self.model_version = 0
         # signatures actually compiled/used — the reload warmup manifest
         self._warm_sigs = set()
         # always-on light counters (telemetry covers the full story)
@@ -418,13 +423,22 @@ class Server:
             block.hybridize()
         return block.warmup(sorted(sigs), dtype=self.dtype, ctx=self.ctx)
 
-    def swap_model(self, block) -> None:
+    def current_model(self):
+        """The block currently being served (the rolling-upgrade
+        machinery keeps it for rollback)."""
+        return self._model
+
+    def swap_model(self, block, version: Optional[int] = None) -> None:
         """Atomically replace the served model with ``block``, warming it
         for every signature in live use first — requests dispatched
-        during the warmup keep hitting the old graph."""
+        during the warmup keep hitting the old graph. ``version``
+        overrides the monotonic bump (a rollback restores the old
+        number)."""
         self._warm_block(block, prime=True)
         with self._model_lock:
             self._model = block
+            self.model_version = (self.model_version + 1
+                                  if version is None else int(version))
         self.n_reloads += 1
 
     def reload(self, manager, model_factory, step: Optional[int] = None
@@ -483,4 +497,5 @@ class Server:
         return {"requests": self.n_requests, "batches": self.n_batches,
                 "errors": self.n_errors, "reloads": self.n_reloads,
                 "queue_depth": depth, "loaded_step": self.loaded_step,
+                "model_version": self.model_version,
                 "running": self.is_running}

@@ -751,6 +751,63 @@ def record_elastic_heartbeat_miss(rank) -> None:
             ("rank",)).labels(str(rank)).inc()
 
 
+def record_elastic_preemption() -> None:
+    """One graceful preemption leave: the runner checkpointed at the
+    step boundary and exited for the supervisor to respawn (spot /
+    preemptible capacity reclaim — the control plane's common case,
+    not a failure)."""
+    if not _state.enabled:
+        return
+    counter("mxnet_elastic_preemptions_total",
+            "Graceful preemption leaves (checkpoint-then-exit on the "
+            "preemption signal).").inc()
+
+
+def set_fleet_size(n: int) -> None:
+    """Current serving replica count behind the Router (non-draining) —
+    the autoscaler's actuator state."""
+    if not _state.enabled:
+        return
+    gauge("mxnet_controller_fleet_size",
+          "Serving replicas currently in the Router fleet "
+          "(draining replicas excluded).").set(int(n))
+
+
+def record_fleet_scale(direction: str, outcome: str = "ok") -> None:
+    """One autoscaler action: ``direction`` up/down, ``outcome`` ok /
+    failed (replica factory or start raised — the controller contains
+    it and retries on a later tick)."""
+    if not _state.enabled:
+        return
+    counter("mxnet_controller_scale_total",
+            "Autoscaler scale actions by direction and outcome.",
+            ("direction", "outcome")).labels(direction, outcome).inc()
+
+
+def record_fleet_scale_seconds(direction: str, seconds: float) -> None:
+    """Wall seconds for one completed scale action — scale-up includes
+    the replica's full grid warmup (the number that must stay small for
+    autoscaling to matter; warm-started spawn via the compilation
+    service is what keeps it small)."""
+    if not _state.enabled:
+        return
+    histogram("mxnet_controller_scale_seconds",
+              "Scale-action duration (up includes replica warmup).",
+              ("direction",), buckets=STEP_BUCKETS
+              ).labels(direction).observe(seconds)
+
+
+def record_upgrade_replica(outcome: str) -> None:
+    """Rolling-upgrade per-replica outcomes: ``ok`` (swapped and baked
+    healthy), ``rolled_back`` (this replica's old model was restored),
+    ``aborted`` (rollout stopped before touching this replica)."""
+    if not _state.enabled:
+        return
+    counter("mxnet_serving_upgrade_total",
+            "Rolling-upgrade replica outcomes.",
+            ("outcome",)).labels(outcome).inc()
+
+
 def record_data_wait(seconds: float, stage: str = "device_feed") -> None:
     """Time the consumer blocked waiting on an input-pipeline stage.
 

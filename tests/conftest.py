@@ -56,11 +56,26 @@ def serving_leak_guard():
     yield
     import sys
 
-    # Both sweeps run BEFORE failing: a test that leaks a Router AND an
-    # unrelated standalone Server must have both stopped, or the
-    # surviving thread taxes every later test — routers first, since
-    # stopping a router stops its replicas too
+    # All sweeps run BEFORE failing: a test that leaks a
+    # FleetController AND a Router AND an unrelated standalone Server
+    # must have all three stopped, or the surviving thread taxes every
+    # later test — controllers first (a live one could re-scale the
+    # router mid-teardown), then routers (stopping one stops its
+    # replicas too), then servers
     problems = []
+    cmod = sys.modules.get("mxnet_tpu.serving.controller")
+    if cmod is not None:
+        leaked_controllers = cmod.live_controllers()
+        if leaked_controllers:
+            problems.append(
+                f"test left FleetController(s) running: "
+                f"{[c.name for c in leaked_controllers]}; call stop() "
+                "in teardown or use the context manager")
+            for c in leaked_controllers:
+                try:
+                    c.stop(timeout=5)
+                except Exception:
+                    pass
     rmod = sys.modules.get("mxnet_tpu.serving.router")
     if rmod is not None:
         leaked_routers = rmod.live_routers()

@@ -773,3 +773,226 @@ class TestLauncherSupervision:
         rc, rep = _run_launch(tmp_path, src, n=1)
         assert rc == 128 + int(signal.SIGKILL)
         assert rep["workers"][0]["exits"][0]["signal"] == "SIGKILL"
+
+
+# ---------------------------------------------------------------------------
+# preemption: graceful checkpoint-then-leave (the control plane's
+# training half — spot reclaim as the common case, not a failure)
+# ---------------------------------------------------------------------------
+
+class TestPreemption:
+    def _runner(self, tmp_path, net, trainer, **kw):
+        kw.setdefault("save_every", 0)
+        kw.setdefault("heartbeat_interval", 0.05)
+        return elastic.ElasticRunner(
+            str(tmp_path), params=net, trainer=trainer, world_size=1,
+            rank=0, **kw)
+
+    def test_graceful_leave_checkpoints_and_retires_heartbeat(
+            self, tmp_path):
+        net, trainer, x, y = make_model()
+        runner = self._runner(tmp_path, net, trainer)
+        fn = make_step_fn(net, trainer, x, y)
+
+        def step_fn(step, m):
+            if step == 3:
+                runner.request_preemption("test notice")
+            return fn(step, m)
+        telemetry.enable()
+        try:
+            pre0 = _metric_value("mxnet_elastic_preemptions_total")
+            with pytest.raises(elastic.Preempted) as ei:
+                runner.run(step_fn, 8)
+            assert _metric_value(
+                "mxnet_elastic_preemptions_total") == pre0 + 1
+        finally:
+            telemetry.disable()
+        # the flag is checked at the NEXT step boundary: step 3 ran to
+        # completion, the leave committed it
+        assert ei.value.step == 3
+        assert ei.value.exit_code == elastic.PREEMPTED_EXIT_CODE == 75
+        # save_every=0: the graceful-leave bundle is the ONLY bundle
+        assert runner.ckpt.steps() == [3]
+        # fast leave: the heartbeat file is UNLINKED, not left to
+        # go stale
+        assert not os.path.exists(runner.board.path(0))
+        assert not runner.heartbeat_running()
+
+    def test_preempted_resume_is_bit_exact(self, tmp_path):
+        baseline, baseline_net = plain_run(8)
+        net, trainer, x, y = make_model()
+        r1 = self._runner(tmp_path, net, trainer)
+        fn1 = make_step_fn(net, trainer, x, y)
+        head = []
+
+        def step_fn(step, m):
+            loss = fn1(step, m)
+            head.append(loss)
+            if step == 3:
+                r1.request_preemption()
+            return loss
+        with pytest.raises(elastic.Preempted):
+            r1.run(step_fn, 8)
+        # the respawned incarnation (wrong init on purpose) resumes
+        # from the graceful-leave bundle
+        net2, trainer2, x2, y2 = make_model(seed=99)
+        r2 = self._runner(tmp_path, net2, trainer2)
+        r2.start()
+        assert r2.resumed_from == 3 and r2.start_step == 4
+        tail = r2.run(make_step_fn(net2, trainer2, x2, y2), 8)
+        assert head + tail == baseline
+        full_w, resumed_w = weights_of(baseline_net), weights_of(net2)
+        assert all(np.array_equal(v, resumed_w[k])
+                   for k, v in full_w.items())
+
+    def test_sigterm_handler_drives_graceful_leave(self, tmp_path):
+        net, trainer, x, y = make_model()
+        runner = self._runner(tmp_path, net, trainer)
+        old = signal.getsignal(signal.SIGTERM)
+        runner.install_preemption_handler()
+        fn = make_step_fn(net, trainer, x, y)
+
+        def step_fn(step, m):
+            loss = fn(step, m)
+            if step == 2:
+                # the reclaim notice arrives MID-step; this step still
+                # completes and the leave lands at the boundary
+                os.kill(os.getpid(), signal.SIGTERM)
+            return loss
+        try:
+            with pytest.raises(elastic.Preempted) as ei:
+                runner.run(step_fn, 8)
+            assert ei.value.step == 2
+            assert "SIGTERM" in str(ei.value)
+        finally:
+            runner.stop()
+        # stop() restored the previous handler
+        assert signal.getsignal(signal.SIGTERM) == old
+
+    def test_handler_rearmed_across_runner_phases(self, tmp_path):
+        """run() stops the runner on the way out (restoring OS
+        handlers); a one-time install_preemption_handler() must still
+        cover the NEXT run() of the same runner — multi-phase training
+        stays preemption-protected between the phases."""
+        net, trainer, x, y = make_model()
+        runner = self._runner(tmp_path, net, trainer, save_every=1)
+        old = signal.getsignal(signal.SIGTERM)
+        runner.install_preemption_handler()
+        fn = make_step_fn(net, trainer, x, y)
+        try:
+            runner.run(fn, 2)                  # phase 1, no preemption
+            # phase 1's stop() restored the OS handler...
+            assert signal.getsignal(signal.SIGTERM) == old
+
+            def step_fn(step, m):
+                loss = fn(step, m)
+                if step == 3:
+                    os.kill(os.getpid(), signal.SIGTERM)
+                return loss
+            # ...but phase 2 re-arms it and the notice still lands
+            with pytest.raises(elastic.Preempted) as ei:
+                runner.run(step_fn, 6)
+            assert ei.value.step == 3
+        finally:
+            runner.stop()
+        assert signal.getsignal(signal.SIGTERM) == old
+
+    def test_preemption_before_start_leaves_at_first_boundary(
+            self, tmp_path):
+        net, trainer, x, y = make_model()
+        runner = self._runner(tmp_path, net, trainer)
+        runner.request_preemption("early notice")
+        assert runner.preemption_requested
+        with pytest.raises(elastic.Preempted) as ei:
+            runner.run(make_step_fn(net, trainer, x, y), 8)
+        # nothing completed yet: nothing to checkpoint, step is -1
+        assert ei.value.step == -1
+        assert runner.ckpt.steps() == []
+
+    def test_siblings_see_fast_leave_immediately(self, tmp_path):
+        board = elastic.HeartbeatBoard(str(tmp_path))
+        board.register(0)
+        board.register(1)
+        assert board.alive(timeout=60) == [0, 1]
+        board.remove(1)
+        # no staleness wait: the unlink IS the leave signal
+        assert board.alive(timeout=60) == [0]
+        board.remove(1)                     # idempotent
+
+
+class TestLauncherPreemption:
+    def test_preempt_exit_respawns_outside_failure_budget(
+            self, tmp_path):
+        # first incarnation exits 75 (graceful leave), second exits 0 —
+        # under --max-restarts 0 (fail-fast) the job must still succeed
+        src = (
+            "import os, sys\n"
+            "m = os.path.join(os.environ['MXNET_ELASTIC_COORD_DIR'],\n"
+            "                 'p-' + os.environ['DMLC_WORKER_ID'])\n"
+            "if not os.path.exists(m):\n"
+            "    open(m, 'w').close(); sys.exit(75)\n"
+            "assert os.environ['MXNET_ELASTIC_RESTART'] == '1'\n"
+            "sys.exit(0)\n")
+        rc, rep = _run_launch(
+            tmp_path, src,
+            extra_args=["--max-restarts", "0",
+                        "--restart-backoff", "0.05"])
+        assert rc == 0
+        assert all(w["preemptions"] == 1 and w["restarts"] == 0
+                   and w["final"] == 0 for w in rep["workers"])
+        assert all(w["exits"][0]["exit_code"] == 75
+                   for w in rep["workers"])
+
+    def test_preempt_budget_exhausted_becomes_failure(self, tmp_path):
+        src = "import sys; sys.exit(75)\n"
+        rc, rep = _run_launch(
+            tmp_path, src, n=1,
+            extra_args=["--max-restarts", "0",
+                        "--max-preempt-restarts", "2",
+                        "--restart-backoff", "0.05"])
+        assert rc == 75             # budget spent -> ordinary failure
+        w = rep["workers"][0]
+        assert w["preemptions"] == 2 and len(w["exits"]) == 3
+
+    def test_preempt_rc_zero_disables_preemption_handling(
+            self, tmp_path):
+        src = "import sys; sys.exit(75)\n"
+        rc, rep = _run_launch(
+            tmp_path, src, n=1,
+            extra_args=["--preempt-rc", "0"])
+        assert rc == 75             # plain fail-fast
+        assert rep["workers"][0]["preemptions"] == 0
+
+    def test_supervisor_sigterm_forwards_reaps_and_reports(
+            self, tmp_path):
+        """An interrupted supervisor must not orphan its workers: the
+        signal is forwarded (workers see SIGTERM and exit clean), the
+        report JSON is still written, and the launcher exits
+        128+signum."""
+        import threading
+
+        src = (
+            "import signal, sys, time\n"
+            "signal.signal(signal.SIGTERM,\n"
+            "              lambda s, f: sys.exit(0))\n"
+            "time.sleep(60)\n")
+        # the in-process launcher installs its handlers in THIS (main)
+        # thread; a timer delivers the signal mid-supervision
+        before = signal.getsignal(signal.SIGTERM)
+        timer = threading.Timer(
+            1.0, lambda: os.kill(os.getpid(), signal.SIGTERM))
+        timer.start()
+        t0 = time.monotonic()
+        try:
+            rc, rep = _run_launch(tmp_path, src,
+                                  extra_args=["--term-window", "5"])
+        finally:
+            timer.cancel()
+        assert rc == 128 + int(signal.SIGTERM)
+        assert time.monotonic() - t0 < 30      # no 60 s worker wait
+        assert rep["rc"] == rc
+        # forwarded SIGTERM, workers exited clean (0), none orphaned
+        assert all(w["exits"][-1]["exit_code"] == 0
+                   for w in rep["workers"])
+        # the supervisor restored the previous handlers on the way out
+        assert signal.getsignal(signal.SIGTERM) == before

@@ -1,0 +1,649 @@
+"""Traffic-driven control plane (mxnet_tpu/serving/controller.py +
+Router fleet membership): dynamic ``add_replica``/``remove_replica``
+with drain semantics, the ScalePolicy hysteresis decision function,
+FleetController observe-decide-act ticks with contained failures,
+rolling upgrades with breaker-gated automatic rollback, and the
+control-plane fault sites / telemetry.
+
+The drain invariant proved here is the fleet-change extension of the
+router's zero-lost-future contract: a replica leaving the fleet —
+drained clean, drain-deadline expired, or breaker-tripped — never
+strands a submitted future; anything still in flight fails over to the
+survivors. Bitwise comparisons follow the test_serving.py discipline
+(matched batch buckets = the same compiled executable).
+"""
+import threading
+import time
+
+import numpy as np
+import pytest
+
+import mxnet_tpu as mx
+from mxnet_tpu import fault, serving, telemetry
+from mxnet_tpu.base import MXNetError
+from mxnet_tpu.gluon import nn
+from mxnet_tpu.serving.controller import (
+    FleetController, FleetSignals, ScalePolicy, UpgradeRolledBack,
+    rolling_upgrade,
+)
+from mxnet_tpu.serving.health import CLOSED, OPEN
+from mxnet_tpu.serving.router import Router
+
+pytestmark = pytest.mark.serving
+
+
+def make_net(seed=0, units=4):
+    net = nn.Dense(units, in_units=8)
+    net.initialize()
+    rs = np.random.RandomState(seed)
+    net.weight.set_data(mx.nd.array(
+        rs.randn(units, 8).astype(np.float32)))
+    net.bias.set_data(mx.nd.array(rs.randn(units).astype(np.float32)))
+    net.hybridize()
+    return net
+
+
+def make_server(name, seed=0, slo_ms=60, **kw):
+    return serving.Server(make_net(seed=seed), batch_buckets=(2, 4),
+                          shape_buckets=[(8,)], slo_ms=slo_ms,
+                          name=name, **kw)
+
+
+def make_router(n=2, seed=0, slo_ms=60, **kw):
+    return Router([make_server(f"rep{i}", seed=seed, slo_ms=slo_ms)
+                   for i in range(n)], slo_ms=slo_ms, **kw)
+
+
+def traffic(n=16):
+    return [np.random.RandomState(300 + i).randn(8).astype(np.float32)
+            for i in range(n)]
+
+
+def oracle(xs, seed=0):
+    """Single-replica reference over the same grid (matched buckets)."""
+    srv = make_server("oracle", seed=seed).start()
+    try:
+        return [srv.submit(x).result(timeout=30) for x in xs]
+    finally:
+        srv.stop()
+
+
+class _SlowBlock(mx.gluon.Block):
+    """Eager block with a controlled dispatch latency — keeps requests
+    IN FLIGHT long enough for drain tests to observe them."""
+
+    def __init__(self, delay_s=0.15, **kw):
+        super().__init__(**kw)
+        self.delay_s = delay_s
+
+    def forward(self, x):
+        time.sleep(self.delay_s)
+        return x * 2
+
+
+def make_slow_server(name, delay_s=0.15, slo_ms=2000):
+    return serving.Server(_SlowBlock(delay_s), batch_buckets=(2, 4),
+                          shape_buckets=[(8,)], slo_ms=slo_ms,
+                          name=name)
+
+
+@pytest.fixture(autouse=True)
+def _fast_retry(monkeypatch):
+    monkeypatch.setenv("MXNET_COMM_RETRY_DELAY", "0.01")
+
+
+# ---------------------------------------------------------------------------
+# dynamic fleet membership: add_replica / remove_replica / drain
+# ---------------------------------------------------------------------------
+
+class TestFleetMembership:
+    def test_add_replica_serves_bit_identical(self):
+        xs = traffic(8)
+        refs = oracle(xs)
+        with make_router(2) as router:
+            newcomer = make_server("rep2")
+            router.add_replica(newcomer)
+            assert router.fleet_size() == 3
+            assert newcomer.is_running     # started + warmed at admission
+            outs = [router.submit(x).result(timeout=30) for x in xs]
+        assert all(np.array_equal(a, b) for a, b in zip(outs, refs))
+
+    def test_add_replica_validates_grid_and_name(self):
+        with make_router(2) as router:
+            bad_grid = serving.Server(make_net(), batch_buckets=(2, 4, 8),
+                                      shape_buckets=[(8,)], slo_ms=60,
+                                      name="odd")
+            with pytest.raises(MXNetError, match="different bucket grid"):
+                router.add_replica(bad_grid)
+            with pytest.raises(MXNetError, match="already in the fleet"):
+                router.add_replica(make_server("rep0"))
+            assert router.fleet_size() == 2
+
+    def test_remove_unknown_and_last_replica_refused(self):
+        with make_router(2) as router:
+            with pytest.raises(MXNetError, match="no replica named"):
+                router.remove_replica("ghost")
+            router.remove_replica("rep0")
+            with pytest.raises(MXNetError, match="last"):
+                router.remove_replica("rep1")
+            assert router.fleet_size() == 1
+
+    def test_remove_with_drain_resolves_every_inflight_future(self):
+        """The drain invariant: a replica leaving mid-traffic strands
+        nothing — queued work finishes or fails over, every future
+        resolves with a result."""
+        reps = [make_slow_server(f"slow{i}") for i in range(2)]
+        router = Router(reps, slo_ms=2000)
+        router.start()
+        try:
+            xs = traffic(12)
+            futs = [router.submit(x) for x in xs]
+            time.sleep(0.05)           # some dispatches now in flight
+            srv = router.remove_replica("slow0", drain=True, timeout=10)
+            assert not srv.is_running
+            outs = [f.result(timeout=30) for f in futs]
+            assert all(np.array_equal(o, x * 2)
+                       for o, x in zip(outs, xs))
+            assert router.fleet_size() == 1
+        finally:
+            router.stop(drain=False, timeout=30)
+
+    def test_drain_deadline_expiry_fails_over_not_hangs(self):
+        """A replica wedged in dispatch cannot stall its own removal:
+        the drain deadline expires, the stuck flight is evicted and
+        retried on the survivors, and remove_replica returns."""
+        reps = [make_slow_server("wedge", delay_s=1.2),
+                make_slow_server("healthy", delay_s=0.01)]
+        router = Router(reps, slo_ms=8000, dispatch_timeout_s=30)
+        router.start()
+        try:
+            xs = traffic(4)
+            futs = [router.submit(x) for x in xs]
+            deadline = time.time() + 10
+            while not any(r["name"] == "wedge" and r["inflight"] > 0
+                          for r in router.stats()["replicas"]):
+                assert time.time() < deadline, "nothing routed at wedge"
+                time.sleep(0.01)
+            t0 = time.monotonic()
+            wedge = router.remove_replica("wedge", drain=True,
+                                          timeout=0.2)
+            assert time.monotonic() - t0 < 1.0     # bounded, not 1.2 s
+            outs = [f.result(timeout=30) for f in futs]
+            assert all(np.array_equal(o, x * 2)
+                       for o, x in zip(outs, xs))
+            # the wedged scheduler exits once its dispatch returns —
+            # wait it out so the leak guard sees a clean house
+            deadline = time.time() + 10
+            while wedge.is_running and time.time() < deadline:
+                time.sleep(0.05)
+            assert not wedge.is_running
+        finally:
+            router.stop(drain=False, timeout=30)
+
+    def test_draining_replica_takes_no_new_work(self):
+        with make_router(2) as router:
+            with router._cond:
+                target = next(r for r in router._replicas
+                              if r.server.name == "rep0")
+                target.draining = True
+            ok0 = next(r["ok"] for r in router.stats()["replicas"]
+                       if r["name"] == "rep0")
+            for x in traffic(8):
+                router.submit(x).result(timeout=30)
+            assert next(r["ok"] for r in router.stats()["replicas"]
+                        if r["name"] == "rep0") == ok0
+            assert router.fleet_size() == 1
+            assert router.fleet_size(include_draining=True) == 2
+
+    def test_drained_replica_breaker_state_discarded(self):
+        """Re-admitting a previously-tripped replica starts a FRESH
+        breaker (and a fresh stable index): the drain retired the old
+        health record along with the membership."""
+        with make_router(2) as router:
+            rep0 = next(r for r in router.replicas()
+                        if r["name"] == "rep0")
+            rep0["breaker"].record_hang()          # hang trips OPEN
+            assert rep0["breaker"].state == OPEN
+            old_index = rep0["index"]
+            srv = router.remove_replica("rep0", drain=True, timeout=5,
+                                        stop_server=False)
+            router.add_replica(srv)
+            fresh = next(r for r in router.replicas()
+                         if r["name"] == "rep0")
+            assert fresh["state"] == CLOSED
+            assert fresh["breaker"].n_trips == 0
+            assert fresh["index"] > old_index      # ids never reused
+            router.submit(traffic(1)[0]).result(timeout=30)
+
+    def test_predicted_wait_zero_on_idle_fleet(self):
+        """The autoscaler signal is ARMED like predicted-wait shedding:
+        an idle fleet that just served a burst reports 0.0, not the
+        raw two-fleet-batch estimate (which would scale up a fleet
+        with nothing queued)."""
+        with make_router(2) as router:
+            for x in traffic(12):
+                router.submit(x).result(timeout=30)
+            assert router.predicted_wait() == 0.0
+
+    def test_stats_expose_fleet_shape(self):
+        with make_router(2) as router:
+            st = router.stats()
+            assert st["fleet_size"] == 2
+            assert all(r["draining"] is False for r in st["replicas"])
+            snap = router.replicas()
+            assert [r["index"] for r in snap] == [0, 1]
+            assert {r["name"] for r in snap} == {"rep0", "rep1"}
+
+
+# ---------------------------------------------------------------------------
+# ScalePolicy: the pure decision function, fake clock
+# ---------------------------------------------------------------------------
+
+def signals(n=2, queue=0, inflight=0, shed=0, wait=0.0, slo=0.1,
+            max_batch=4):
+    return FleetSignals(n_replicas=n, queue_depth=queue,
+                        inflight=inflight, shed_delta=shed,
+                        predicted_wait_s=wait, slo_s=slo,
+                        max_batch=max_batch)
+
+
+class TestScalePolicy:
+    def _policy(self, **kw):
+        self.now = [0.0]
+        kw.setdefault("min_replicas", 1)
+        kw.setdefault("max_replicas", 4)
+        kw.setdefault("up_cooldown_s", 2.0)
+        kw.setdefault("down_utilization", 0.25)
+        kw.setdefault("down_hold_s", 10.0)
+        kw.setdefault("down_cooldown_s", 5.0)
+        return ScalePolicy(time_fn=lambda: self.now[0], **kw)
+
+    def test_shed_scales_up(self):
+        p = self._policy()
+        assert p.desired(signals(n=2, shed=3)) == 3
+        assert p.last_reason == "shed"
+
+    def test_predicted_wait_scales_up(self):
+        p = self._policy(up_wait_factor=0.5)
+        assert p.desired(signals(n=2, wait=0.06, slo=0.1)) == 3
+        assert p.last_reason == "predicted_wait"
+        p2 = self._policy(up_wait_factor=0.5)
+        assert p2.desired(signals(n=2, wait=0.04, slo=0.1)) == 2
+
+    def test_up_cooldown_limits_one_step_per_window(self):
+        p = self._policy(up_cooldown_s=2.0)
+        assert p.desired(signals(n=2, shed=1)) == 3
+        self.now[0] = 1.0
+        assert p.desired(signals(n=3, shed=1)) == 3     # cooling down
+        self.now[0] = 2.5
+        assert p.desired(signals(n=3, shed=1)) == 4
+
+    def test_bounds_always_win(self):
+        p = self._policy(max_replicas=2)
+        assert p.desired(signals(n=2, shed=5)) == 2     # at max
+        p2 = self._policy(min_replicas=2, down_hold_s=0.0,
+                          down_cooldown_s=0.0)
+        assert p2.desired(signals(n=2)) == 2            # at min
+
+    def test_scale_down_needs_sustained_quiet(self):
+        p = self._policy(down_hold_s=10.0, down_cooldown_s=0.0)
+        assert p.desired(signals(n=3)) == 3             # hold starts
+        self.now[0] = 5.0
+        assert p.desired(signals(n=3)) == 3             # still holding
+        self.now[0] = 10.5
+        assert p.desired(signals(n=3)) == 2
+        assert p.last_reason == "idle"
+
+    def test_pressure_resets_the_hold_clock(self):
+        p = self._policy(down_hold_s=10.0, down_cooldown_s=0.0)
+        p.desired(signals(n=3))                         # hold starts
+        self.now[0] = 9.0
+        p.desired(signals(n=3, shed=1))                 # pressure!
+        self.now[0] = 12.0
+        assert p.desired(signals(n=3)) == 3             # clock restarted
+        self.now[0] = 22.5
+        assert p.desired(signals(n=3)) == 2
+
+    def test_busy_fleet_is_not_quiet(self):
+        p = self._policy(down_hold_s=0.0, down_cooldown_s=0.0)
+        # utilization 8/(3*4) = 0.67 >= 0.25: not quiet
+        assert p.desired(signals(n=3, inflight=8)) == 3
+        assert p.last_reason == "steady"
+
+    def test_down_cooldown_one_step_per_window(self):
+        p = self._policy(down_hold_s=0.0, down_cooldown_s=5.0)
+        self.now[0] = 0.1
+        assert p.desired(signals(n=4)) == 3
+        self.now[0] = 2.0
+        assert p.desired(signals(n=3)) == 3             # cooling down
+        self.now[0] = 5.5
+        assert p.desired(signals(n=3)) == 2
+
+    def test_validation(self):
+        with pytest.raises(MXNetError, match="min_replicas"):
+            ScalePolicy(min_replicas=0)
+        with pytest.raises(MXNetError, match="max_replicas"):
+            ScalePolicy(min_replicas=3, max_replicas=2)
+        with pytest.raises(MXNetError, match="up_wait_factor"):
+            ScalePolicy(up_wait_factor=0.0)
+        with pytest.raises(MXNetError, match="cooldowns"):
+            ScalePolicy(up_cooldown_s=-1.0)
+
+    def test_utilization_property(self):
+        assert signals(n=2, inflight=8, max_batch=4).utilization == 1.0
+        assert signals(n=0, inflight=8).utilization == 0.0
+
+
+# ---------------------------------------------------------------------------
+# FleetController: observe-decide-act, contained failures, fault site
+# ---------------------------------------------------------------------------
+
+class TestFleetController:
+    def _controller(self, router, **kw):
+        spawned = []
+
+        def factory(i):
+            srv = make_server(f"auto{i}")
+            spawned.append(srv)
+            return srv
+        kw.setdefault("policy", ScalePolicy(1, 4, up_cooldown_s=0.0))
+        ctl = FleetController(router, factory, interval_s=0.05, **kw)
+        ctl._test_spawned = spawned
+        return ctl
+
+    def test_shed_pressure_scales_up(self):
+        with make_router(2) as router:
+            ctl = self._controller(router)
+            assert ctl.tick() is None                  # steady
+            router.n_shed += 1                         # a shed happened
+            assert ctl.tick() == "up"
+            assert router.fleet_size() == 3
+            assert ctl.n_scale_up == 1
+            assert ctl.scale_events[-1]["reason"] == "shed"
+            # the spawned replica actually serves
+            out = router.submit(traffic(1)[0]).result(timeout=30)
+            assert out is not None
+
+    def test_factory_failure_contained_and_retried(self):
+        with make_router(2) as router:
+            calls = [0]
+
+            def flaky(i):
+                calls[0] += 1
+                if calls[0] == 1:
+                    raise RuntimeError("spawn infra hiccup")
+                return make_server(f"auto{i}")
+            ctl = FleetController(
+                router, flaky, interval_s=0.05,
+                policy=ScalePolicy(1, 4, up_cooldown_s=0.0))
+            router.n_shed += 1
+            assert ctl.tick() is None                  # contained
+            assert ctl.n_scale_failed == 1
+            assert router.fleet_size() == 2
+            router.n_shed += 1
+            assert ctl.tick() == "up"                  # retried, won
+            assert router.fleet_size() == 3 and calls[0] == 2
+
+    def test_scale_down_drains_idlest_replica(self):
+        clock = [0.0]
+        with make_router(3) as router:
+            ctl = self._controller(
+                router, policy=ScalePolicy(
+                    1, 4, down_hold_s=0.0, down_cooldown_s=0.0,
+                    time_fn=lambda: clock[0]))
+            clock[0] = 1.0
+            assert ctl.tick() == "down"
+            assert router.fleet_size() == 2
+            assert ctl.n_scale_down == 1
+            # ties on inflight=0 break to the NEWEST (highest index)
+            assert {r["name"] for r in router.replicas()} \
+                == {"rep0", "rep1"}
+
+    def test_failed_scale_up_does_not_burn_the_cooldown(self):
+        """The up-cooldown paces SUCCESSFUL additions: a failed spawn
+        un-stamps it, so the very next tick retries instead of
+        shedding through a whole cooldown window."""
+        with make_router(2) as router:
+            calls = [0]
+
+            def flaky(i):
+                calls[0] += 1
+                if calls[0] == 1:
+                    raise RuntimeError("spawn infra hiccup")
+                return make_server(f"auto{i}")
+            ctl = FleetController(
+                router, flaky, interval_s=0.05,
+                policy=ScalePolicy(1, 4, up_cooldown_s=3600.0))
+            router.n_shed += 1
+            assert ctl.tick() is None              # failed, contained
+            router.n_shed += 1
+            assert ctl.tick() == "up"              # no cooldown wait
+            assert router.fleet_size() == 3
+
+    def test_controller_scale_fault_site_contained(self):
+        with make_router(2) as router:
+            ctl = self._controller(router)
+            router.n_shed += 1
+            with fault.inject("controller.scale=once"):
+                assert ctl.tick() is None
+            assert ctl.n_scale_failed == 1
+            assert router.fleet_size() == 2            # fleet untouched
+            router.n_shed += 1
+            assert ctl.tick() == "up"                  # next tick wins
+
+    def test_thread_lifecycle_and_leak_registry(self):
+        from mxnet_tpu.serving.controller import live_controllers
+        with make_router(2) as router:
+            ctl = self._controller(router)
+            with ctl:
+                assert ctl.is_running
+                assert ctl in live_controllers()
+                time.sleep(0.15)                       # a few ticks
+            assert not ctl.is_running
+            assert ctl not in live_controllers()
+            assert ctl.n_ticks >= 1
+            st = ctl.stats()
+            assert st["fleet_size"] == 2 and not st["running"]
+
+    def test_validation(self):
+        with make_router(2) as router:
+            with pytest.raises(MXNetError, match="interval"):
+                FleetController(router, make_server, interval_s=0.0)
+
+    def test_controller_telemetry_exported(self):
+        was = telemetry.enabled()
+        telemetry.reset()
+        telemetry.enable()
+        try:
+            with make_router(2) as router:
+                ctl = self._controller(router)
+                router.n_shed += 1
+                ctl.tick()
+                with fault.inject("controller.scale=once"):
+                    router.n_shed += 1
+                    ctl.tick()
+            text = telemetry.prom_text()
+            assert "mxnet_controller_fleet_size 3" in text
+            assert 'mxnet_controller_scale_total{direction="up",' \
+                'outcome="ok"} 1' in text
+            assert 'mxnet_controller_scale_total{direction="up",' \
+                'outcome="failed"} 1' in text
+            assert "mxnet_controller_scale_seconds" in text
+        finally:
+            telemetry.reset()
+            if not was:
+                telemetry.disable()
+
+
+# ---------------------------------------------------------------------------
+# rolling upgrades: one-at-a-time swap, bake, automatic rollback
+# ---------------------------------------------------------------------------
+
+class TestRollingUpgrade:
+    def test_upgrade_flips_fleet_to_new_model(self):
+        xs = traffic(8)
+        refs_v2 = oracle(xs, seed=1)
+        with make_router(2) as router:
+            out = rolling_upgrade(router, lambda s: make_net(seed=1),
+                                  bake_s=0.05)
+            assert out["version"] == 1
+            assert sorted(out["upgraded"]) == ["rep0", "rep1"]
+            assert [r["server"].model_version
+                    for r in router.replicas()] == [1, 1]
+            got = [router.submit(x).result(timeout=30) for x in xs]
+        assert all(np.array_equal(a, b) for a, b in zip(got, refs_v2))
+
+    def test_upgrade_under_traffic_loses_nothing(self):
+        xs = traffic(8)
+        refs = {1: oracle(xs, seed=0), 2: oracle(xs, seed=1)}
+        with make_router(2) as router:
+            stop = threading.Event()
+            futs = []
+
+            def feed():
+                i = 0
+                while not stop.is_set():
+                    futs.append((i % len(xs),
+                                 router.submit(xs[i % len(xs)])))
+                    i += 1
+                    time.sleep(0.004)
+            t = threading.Thread(target=feed)
+            t.start()
+            try:
+                time.sleep(0.1)
+                rolling_upgrade(router, lambda s: make_net(seed=1),
+                                bake_s=0.1)
+                time.sleep(0.1)
+            finally:
+                stop.set()
+                t.join()
+            for idx, f in futs:
+                got = f.result(timeout=30)     # zero lost futures
+                assert any(np.array_equal(got, refs[v][idx])
+                           for v in (1, 2))
+
+    def test_broken_build_rolls_back_swapped_replicas(self):
+        xs = traffic(6)
+        refs_v1 = oracle(xs, seed=0)
+        with make_router(2) as router:
+            calls = [0]
+
+            def poisoned(server):
+                calls[0] += 1
+                if calls[0] == 2:              # AFTER rep0 swapped
+                    raise RuntimeError("bad weights blob")
+                return make_net(seed=1)
+            with pytest.raises(UpgradeRolledBack, match="rolled"):
+                rolling_upgrade(router, poisoned, bake_s=0.05)
+            # every replica back on the OLD model and version
+            assert [r["server"].model_version
+                    for r in router.replicas()] == [0, 0]
+            got = [router.submit(x).result(timeout=30) for x in xs]
+        assert all(np.array_equal(a, b) for a, b in zip(got, refs_v1))
+
+    def test_upgrade_fault_site_aborts_rollout(self):
+        with make_router(2) as router:
+            with fault.inject("serving.upgrade=once"):
+                with pytest.raises(UpgradeRolledBack):
+                    rolling_upgrade(router,
+                                    lambda s: make_net(seed=1),
+                                    bake_s=0.05)
+            assert [r["server"].model_version
+                    for r in router.replicas()] == [0, 0]
+            router.submit(traffic(1)[0]).result(timeout=30)
+
+    def test_breaker_trip_during_bake_rolls_back(self):
+        """The bake watches the router's own health evidence: tripping
+        the freshly-upgraded replica's breaker mid-bake rolls the whole
+        rollout back."""
+        with make_router(2) as router:
+            errs = []
+
+            def run():
+                try:
+                    rolling_upgrade(router, lambda s: make_net(seed=1),
+                                    bake_s=5.0)
+                except BaseException as e:   # noqa: BLE001
+                    errs.append(e)
+            t = threading.Thread(target=run)
+            t.start()
+            try:
+                first = router.replicas()[0]
+                deadline = time.time() + 10
+                while first["server"].model_version == 0:
+                    assert time.time() < deadline, "swap never happened"
+                    time.sleep(0.01)
+                first["breaker"].record_hang()         # trips OPEN
+            finally:
+                t.join(timeout=30)
+            assert len(errs) == 1
+            assert isinstance(errs[0], UpgradeRolledBack)
+            assert "breaker" in str(errs[0].__cause__)
+            assert [r["server"].model_version
+                    for r in router.replicas()] == [0, 0]
+
+    def test_upgrade_telemetry_outcomes(self):
+        was = telemetry.enabled()
+        telemetry.reset()
+        telemetry.enable()
+        try:
+            with make_router(2) as router:
+                rolling_upgrade(router, lambda s: make_net(seed=1),
+                                bake_s=0.02)
+                calls = [0]
+
+                def poisoned(server):
+                    calls[0] += 1
+                    if calls[0] == 2:
+                        raise RuntimeError("boom")
+                    return make_net(seed=2)
+                with pytest.raises(UpgradeRolledBack):
+                    rolling_upgrade(router, poisoned, bake_s=0.02)
+            text = telemetry.prom_text()
+            assert 'mxnet_serving_upgrade_total{outcome="ok"} 3' in text
+            assert 'mxnet_serving_upgrade_total{' \
+                'outcome="rolled_back"} 1' in text
+            assert 'mxnet_serving_upgrade_total{' \
+                'outcome="aborted"} 1' in text
+        finally:
+            telemetry.reset()
+            if not was:
+                telemetry.disable()
+
+    def test_degraded_fleet_refuses_upgrade_before_swapping(self):
+        """A breaker already non-CLOSED would fail its bake instantly
+        and blame pre-existing unhealth on the new build — the rollout
+        is refused up front, typed, with nothing swapped."""
+        with make_router(2) as router:
+            rep0 = next(r for r in router.replicas()
+                        if r["name"] == "rep0")
+            rep0["breaker"].record_hang()
+            calls = [0]
+
+            def factory(server):
+                calls[0] += 1
+                return make_net(seed=1)
+            with pytest.raises(MXNetError, match="fleet not healthy"):
+                rolling_upgrade(router, factory, bake_s=0.02)
+            assert calls[0] == 0                   # nothing built
+            assert [r["server"].model_version
+                    for r in router.replicas()] == [0, 0]
+
+    def test_no_upgradable_replicas_raises(self):
+        with make_router(2) as router:
+            with router._cond:
+                for r in router._replicas:
+                    r.draining = True
+            with pytest.raises(MXNetError, match="no replicas"):
+                rolling_upgrade(router, lambda s: make_net(seed=1))
+
+
+# ---------------------------------------------------------------------------
+# fault-site registry
+# ---------------------------------------------------------------------------
+
+def test_control_plane_fault_sites_registered():
+    assert "controller.scale" in fault.SITES
+    assert "serving.upgrade" in fault.SITES
+    # parse accepts them (the chaos harness depends on it)
+    fault.parse_spec("controller.scale=once;serving.upgrade=nth:2")

@@ -286,7 +286,10 @@ class TestAdmission:
         try:
             with Router(make_replicas(2), slo_ms=100) as router:
                 # force the saturated regime with a slow measured rate
-                router._shed_arm_pending = -1
+                # (_shed_arm_pending is a property now — fleet-size
+                # dependent — so patch it at the class)
+                monkeypatch.setattr(Router, "_shed_arm_pending",
+                                    property(lambda self: -1))
                 monkeypatch.setattr(router, "_predicted_wait_locked",
                                     lambda pending: 9.9)
                 with pytest.raises(ServerOverloaded,

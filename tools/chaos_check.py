@@ -29,6 +29,24 @@ single-replica run at matched buckets, survivor p99 must stay bounded,
 and after the fault clears the breaker must re-admit the replica
 through a half-open probe.
 
+The PREEMPTION gate (control plane) runs a *scripted preemption
+schedule*: rank 1 receives SIGTERM (the cloud's spot-reclaim notice)
+twice mid-run, each time checkpointing at the step boundary and
+exiting ``PREEMPTED_EXIT_CODE`` for ``launch.py`` to respawn OUTSIDE
+the ``--max-restarts`` failure budget (``save_every=0``, so the
+graceful-leave bundle is the only resume point). The stitched
+trajectory and the survivor's must be bit-identical to an
+uninterrupted run, and every incarnation must sustain the baseline
+step rate — leave/join as the common case.
+
+The ROLLING-UPGRADE gate (control plane) walks a new model through a
+3-replica fleet under continuous traffic (``serving.rolling_upgrade``):
+zero lost futures, every response bit-identical to its submit window's
+single-replica version oracle, and a poisoned build — the
+``serving.upgrade`` fault fires AFTER the first replica already
+swapped — must roll the whole fleet back automatically with at least
+N-1 replicas healthy throughout.
+
   python tools/chaos_check.py                 # default spec/steps
   python tools/chaos_check.py --steps 40 --seed 11 \
       --spec 'kvstore.push=every:7;kvstore.allreduce=p:0.1' \
@@ -213,21 +231,17 @@ print("ELASTIC_OK %d" % rank, flush=True)
 '''
 
 
-def _launch_elastic(workdir, steps, kill_at=-1, kill_rank=-1,
-                    max_restarts=0):
-    """One supervised 2-worker run; returns (rc, stdout, report, coord)."""
+def _launch_job(workdir, worker_src, env_extra, launch_args):
+    """One supervised 2-worker run of ``worker_src`` under launch.py;
+    returns (rc, stdout+stderr, report, coord)."""
     import subprocess
 
     coord = os.path.join(workdir, "coord")
     report = os.path.join(workdir, "report.json")
     worker = os.path.join(workdir, "worker.py")
     with open(worker, "w") as f:
-        f.write(_ELASTIC_WORKER)
-    env = dict(os.environ,
-               MXNET_REPO_ROOT=_REPO_ROOT,
-               ELASTIC_STEPS=str(steps),
-               ELASTIC_KILL_AT=str(kill_at),
-               ELASTIC_KILL_RANK=str(kill_rank))
+        f.write(worker_src)
+    env = dict(os.environ, MXNET_REPO_ROOT=_REPO_ROOT, **env_extra)
     for k in ("DMLC_PS_ROOT_URI", "DMLC_PS_ROOT_PORT",
               "DMLC_NUM_WORKER", "DMLC_WORKER_ID", "DMLC_ROLE",
               "MXNET_FAULT_SPEC"):
@@ -237,9 +251,9 @@ def _launch_elastic(workdir, steps, kill_at=-1, kill_rank=-1,
             [sys.executable,
              os.path.join(_REPO_ROOT, "tools", "launch.py"),
              "-n", "2", "--poll-interval", "0.05",
-             "--max-restarts", str(max_restarts),
              "--restart-backoff", "0.5", "--term-window", "5",
              "--coord-dir", coord, "--report", report,
+             *launch_args,
              "--", sys.executable, worker],
             env=env, capture_output=True, text=True, timeout=300)
         rc, text = out.returncode, out.stdout + out.stderr
@@ -256,6 +270,17 @@ def _launch_elastic(workdir, steps, kill_at=-1, kill_rank=-1,
     except (OSError, ValueError):
         rep = {"rc": rc, "workers": []}
     return rc, text, rep, coord
+
+
+def _launch_elastic(workdir, steps, kill_at=-1, kill_rank=-1,
+                    max_restarts=0):
+    """One supervised 2-worker run; returns (rc, stdout, report, coord)."""
+    return _launch_job(
+        workdir, _ELASTIC_WORKER,
+        {"ELASTIC_STEPS": str(steps),
+         "ELASTIC_KILL_AT": str(kill_at),
+         "ELASTIC_KILL_RANK": str(kill_rank)},
+        ["--max-restarts", str(max_restarts)])
 
 
 def _read_losses(coord, rank, incarnation):
@@ -325,6 +350,194 @@ def elastic_gate(summary, steps=30, kill_at=6):
         if not ok:
             tail = "\n".join(out_b.splitlines()[-30:])
             print(f"[chaos] elastic kill-run tail:\n{tail}")
+        return ok
+    finally:
+        shutil.rmtree(workdir, ignore_errors=True)
+
+
+# ---------------------------------------------------------------------------
+# preemption gate: a scripted preemption schedule (SIGTERM = the cloud's
+# spot reclaim notice) must cost zero bits and sustain throughput —
+# leave/join as the COMMON case, not a failure.
+# ---------------------------------------------------------------------------
+
+_PREEMPT_WORKER = r'''
+import json, os, signal, sys, time
+sys.path.insert(0, os.environ["MXNET_REPO_ROOT"])
+os.environ.setdefault("JAX_PLATFORMS", "cpu")
+os.environ["XLA_FLAGS"] = "--xla_force_host_platform_device_count=1"
+
+import numpy as np
+import mxnet_tpu as mx
+from mxnet_tpu import autograd, gluon
+from mxnet_tpu.gluon import nn, loss as gloss
+from mxnet_tpu.parallel import elastic
+
+rank = int(os.environ["DMLC_WORKER_ID"])
+coord = os.environ["MXNET_ELASTIC_COORD_DIR"]
+steps = int(os.environ["ELASTIC_STEPS"])
+schedule = [int(s) for s in os.environ.get("PREEMPT_AT", "").split(",")
+            if s]
+preempt_rank = int(os.environ.get("PREEMPT_RANK", "-1"))
+incarnation = int(os.environ.get("MXNET_ELASTIC_RESTART", "0"))
+step_sleep = float(os.environ.get("ELASTIC_STEP_SLEEP", "0.12"))
+
+mx.random.seed(1234 + rank)
+net = nn.HybridSequential()
+net.add(nn.Dense(32, in_units=64, activation="relu"))
+net.add(nn.Dense(10, in_units=32))
+net.initialize(mx.init.Xavier())
+trainer = gluon.Trainer(net.collect_params(), "adam",
+                        {"learning_rate": 0.01}, kvstore="device")
+loss_fn = gloss.SoftmaxCrossEntropyLoss()
+rs = np.random.RandomState(100 + rank)    # private: never touch mx.random
+x = rs.randn(128, 64).astype(np.float32)
+y = rs.randint(0, 10, size=(128,)).astype(np.int32)
+
+# save_every=0: the graceful-leave checkpoint is the ONLY bundle this
+# rank writes — resume correctness rides entirely on the preemption
+# protocol, which is the point of the gate
+runner = elastic.ElasticRunner(
+    coord, params=net, trainer=trainer, save_every=0,
+    heartbeat_interval=0.25, heartbeat_timeout=1.5, join_timeout=5.0,
+    on_epoch=lambda m, rec: print(
+        "ELASTIC_EPOCH %d %d left=%s joined=%s"
+        % (rank, rec["epoch"], rec["left"], rec["joined"]), flush=True))
+runner.install_preemption_handler()
+losses = []
+
+
+def step_fn(step, m):
+    lo = (step * 32) % 128
+    xb = mx.nd.array(x[lo:lo + 32])
+    yb = mx.nd.array(y[lo:lo + 32])
+    with autograd.record():
+        loss = loss_fn(net(xb), yb).mean()
+    loss.backward()
+    if rank == preempt_rank and incarnation < len(schedule) \
+            and step == schedule[incarnation]:
+        # the scripted reclaim notice arrives MID-step; the handler only
+        # flags the runner — this step still completes, the leave is at
+        # the boundary
+        os.kill(os.getpid(), signal.SIGTERM)
+    trainer.step(32)
+    losses.append(float(loss.asnumpy()))
+    time.sleep(step_sleep)
+    return losses[-1]
+
+
+runner.start()
+if runner.resumed_from is not None:
+    print("ELASTIC_RESUME %d %d" % (rank, runner.start_step), flush=True)
+t0 = time.perf_counter()
+rc = 0
+try:
+    runner.run(step_fn, steps)
+except elastic.Preempted as e:
+    print("ELASTIC_PREEMPTED %d %d" % (rank, e.step), flush=True)
+    rc = e.exit_code
+seconds = time.perf_counter() - t0
+out = os.path.join(coord, "losses-r%d-i%d.json" % (rank, incarnation))
+with open(out, "w") as f:
+    json.dump({"start": runner.start_step, "losses": losses,
+               "seconds": seconds}, f)
+print("ELASTIC_OK %d" % rank, flush=True)
+sys.exit(rc)
+'''
+
+
+def _launch_preempt(workdir, steps, schedule=(), preempt_rank=-1):
+    return _launch_job(
+        workdir, _PREEMPT_WORKER,
+        {"ELASTIC_STEPS": str(steps),
+         "PREEMPT_AT": ",".join(str(s) for s in schedule),
+         "PREEMPT_RANK": str(preempt_rank)},
+        # fail-fast on real failures; preemptions ride their own budget
+        ["--max-restarts", "0", "--max-preempt-restarts", "4"])
+
+
+def preemption_gate(summary, steps=30, schedule=(6, 14)):
+    """Rank 1 is preempted TWICE on a schedule (SIGTERM mid-step →
+    graceful checkpoint-then-leave → supervisor respawns it outside the
+    restart budget). Gates: the stitched trajectory is bit-identical to
+    an uninterrupted run, the survivor's too, preemptions never touch
+    the failure budget, every leave checkpoints (save_every=0: there is
+    no other bundle), and per-incarnation step throughput is sustained."""
+    workdir = tempfile.mkdtemp(prefix="chaos_preempt_")
+    try:
+        a_dir = os.path.join(workdir, "a")
+        b_dir = os.path.join(workdir, "b")
+        os.makedirs(a_dir)
+        os.makedirs(b_dir)
+        rc_a, out_a, rep_a, coord_a = _launch_preempt(a_dir, steps)
+        print(f"[chaos] preempt baseline: rc {rc_a}")
+        rc_b, out_b, rep_b, coord_b = _launch_preempt(
+            b_dir, steps, schedule=schedule, preempt_rank=1)
+        by_rank = {w["rank"]: w for w in rep_b["workers"]}
+        w1 = by_rank.get(1, {"restarts": 0, "preemptions": 0,
+                             "exits": []})
+        print(f"[chaos] preempt run: rc {rc_b}, rank 1 preemptions "
+              f"{w1['preemptions']}, restarts {w1['restarts']}, exits "
+              f"{[e['exit_code'] for e in w1['exits']]}")
+
+        checks = {}
+        checks["both_runs_clean"] = rc_a == 0 and rc_b == 0
+        checks["preemptions_outside_restart_budget"] = (
+            w1["preemptions"] == len(schedule)
+            and w1["restarts"] == 0
+            and [e["exit_code"] for e in w1["exits"]]
+            == [75] * len(schedule) + [0])
+        checks["every_leave_checkpointed"] = all(
+            f"ELASTIC_PREEMPTED 1 {s}" in out_b for s in schedule)
+        checks["resumed_at_each_boundary"] = all(
+            f"ELASTIC_RESUME 1 {s + 1}" in out_b for s in schedule)
+        # the survivor's epoch protocol observed the fast leave AND the
+        # rejoin (the survivor may legitimately finish its own steps
+        # before LATER preemption cycles complete — respawn pays the
+        # interpreter/jax import — so gate on the first cycle, not all)
+        checks["survivor_saw_leave_and_join"] = (
+            "left=[1]" in out_b and "joined=[1]" in out_b)
+
+        rate_floor = None
+        try:
+            a0 = _read_losses(coord_a, 0, "0")
+            b0 = _read_losses(coord_b, 0, "0")
+            checks["survivor_bit_identical"] = \
+                a0["losses"] == b0["losses"]
+            a1 = _read_losses(coord_a, 1, "0")
+            parts = [_read_losses(coord_b, 1, str(i))
+                     for i in range(len(schedule) + 1)]
+            stitched = [v for p in parts for v in p["losses"]]
+            checks["victim_trajectory_bit_identical"] = \
+                stitched == a1["losses"]
+            checks["incarnations_start_at_commit"] = all(
+                parts[i + 1]["start"] == schedule[i] + 1
+                for i in range(len(schedule)))
+            # sustained throughput: every incarnation's steady step rate
+            # within a generous factor of the uninterrupted run's (the
+            # preemption machinery must not tax the steps themselves)
+            base_rate = len(a1["losses"]) / max(a1["seconds"], 1e-9)
+            rates = [len(p["losses"]) / max(p["seconds"], 1e-9)
+                     for p in parts if p["losses"]]
+            rate_floor = min(rates) / base_rate if rates else 0.0
+            checks["throughput_sustained"] = rate_floor >= 0.3
+        except (OSError, ValueError, IndexError, KeyError) as e:
+            checks["loss_files_complete"] = False
+            print(f"[chaos]   preempt loss files incomplete: {e}")
+
+        ok = all(checks.values())
+        summary["gates"]["preemption_schedule_bit_exact"] = {
+            "pass": ok, "checks": checks, "schedule": list(schedule),
+            "rank1_preemptions": w1.get("preemptions"),
+            "rate_vs_baseline": rate_floor}
+        for name, v in checks.items():
+            print(f"[chaos]   preempt {name}: {v}")
+        if rate_floor is not None:
+            print(f"[chaos]   preempt min incarnation rate: "
+                  f"{rate_floor:.2f}x baseline")
+        if not ok:
+            tail = "\n".join(out_b.splitlines()[-30:])
+            print(f"[chaos] preempt run tail:\n{tail}")
         return ok
     finally:
         shutil.rmtree(workdir, ignore_errors=True)
@@ -484,6 +697,177 @@ def serving_gate(summary):
         router.stop(drain=False, timeout=30)
 
 
+# ---------------------------------------------------------------------------
+# rolling-upgrade gate: walk a new model through a 3-replica fleet under
+# continuous traffic — zero lost futures, every response bit-identical to
+# SOME version's single-replica oracle, and a poisoned build triggers
+# automatic rollback with the fleet never dropping below N-1 healthy.
+# ---------------------------------------------------------------------------
+
+def upgrade_gate(summary):
+    """Rolling upgrade of a 3-replica Router under paced traffic.
+
+    Phase 1: upgrade v1 -> v2 (``rolling_upgrade``; one replica drains
+    its bake while N-1 serve). Phase 2: a poisoned v3 rollout — the
+    ``serving.upgrade`` fault site fires on the SECOND replica, after
+    the first already swapped — must roll the fleet back to v2
+    automatically (:class:`UpgradeRolledBack`). Gates: zero lost
+    futures end-to-end, every response bit-identical to its submit
+    window's version oracle (v1 before / v2 after, the transient window
+    may serve either side of the swap), version agreement after each
+    phase, fleet >= N-1 healthy throughout, and the fleet still serving
+    v2 after the rollback."""
+    import threading as _threading
+    import time as _time
+
+    import numpy as np
+
+    from mxnet_tpu import fault as flt
+    from mxnet_tpu import serving
+    from mxnet_tpu.base import MXNetError
+
+    grid = dict(batch_buckets=(2, 4, 8), shape_buckets=[(32,)],
+                slo_ms=SERVING_SLO_MS)
+    samples = [np.random.RandomState(2000 + i).randn(32).astype(np.float32)
+               for i in range(24)]
+
+    # per-version single-replica oracles (matched grid = matched buckets)
+    oracle = {}
+    for ver, seed in (("v1", 0), ("v2", 1), ("v3", 2)):
+        srv = serving.Server(_serving_net(seed), name=f"oracle_{ver}",
+                             **grid)
+        srv.start()
+        oracle[ver] = [srv.submit(x).result(timeout=60) for x in samples]
+        srv.stop()
+
+    replicas = [serving.Server(_serving_net(0), name=f"urep{i}", **grid)
+                for i in range(3)]
+    router = serving.Router(replicas, slo_ms=SERVING_SLO_MS,
+                            dispatch_timeout_s=2.0)
+    router.start()
+
+    records = []            # (sample_idx, future, t_submit)
+    rec_lock = _threading.Lock()
+    stop_traffic = _threading.Event()
+    min_healthy = [len(replicas)]
+
+    def traffic():
+        i = 0
+        while not stop_traffic.is_set():
+            idx = i % len(samples)
+            i += 1
+            t0 = _time.perf_counter()
+            try:
+                fut = router.submit(samples[idx])
+            except MXNetError:
+                fut = None          # typed synchronous shed
+            with rec_lock:
+                records.append((idx, fut, t0))
+            healthy = sum(1 for r in router.stats()["replicas"]
+                          if r["state"] == "closed"
+                          and not r["draining"])
+            min_healthy[0] = min(min_healthy[0], healthy)
+            _time.sleep(0.004)
+
+    checks = {}
+    t = _threading.Thread(target=traffic, daemon=True)
+    t.start()
+    try:
+        _time.sleep(0.4)                                # v1 window
+        t_up0 = _time.perf_counter()
+        out = serving.rolling_upgrade(
+            router, lambda s: _serving_net(1), bake_s=0.25)
+        t_up1 = _time.perf_counter()
+        versions = [r["server"].model_version
+                    for r in router.replicas()]
+        checks["upgrade_version_agreement"] = (
+            versions == [out["version"]] * 3
+            and len(out["upgraded"]) == 3)
+        _time.sleep(0.4)                                # v2 window
+
+        # poisoned v3: first replica swaps, the second's fault fires —
+        # the whole rollout must roll back
+        t_bad0 = _time.perf_counter()
+        flt.install("serving.upgrade=nth:2")
+        rolled_back = False
+        try:
+            serving.rolling_upgrade(
+                router, lambda s: _serving_net(2), bake_s=0.25)
+        except serving.UpgradeRolledBack:
+            rolled_back = True
+        finally:
+            flt.clear()
+        t_bad1 = _time.perf_counter()
+        checks["poisoned_build_rolled_back"] = rolled_back
+        checks["rollback_version_agreement"] = (
+            [r["server"].model_version for r in router.replicas()]
+            == [out["version"]] * 3)
+        _time.sleep(0.4)                                # v2-again window
+    finally:
+        stop_traffic.set()
+        t.join(timeout=10)
+
+    try:
+        n_ok = n_typed = n_lost = n_bits_bad = 0
+        for idx, fut, t0 in records:
+            if fut is None:
+                n_typed += 1
+                continue
+            try:
+                got = fut.result(timeout=30)
+            except MXNetError:
+                n_typed += 1
+                continue
+            except Exception:       # noqa: BLE001 - untyped = lost
+                n_lost += 1
+                continue
+            n_ok += 1
+            # window classification is by SUBMIT time; a request queued
+            # just before a rollout can be dispatched just after its
+            # first swap, so each rollout's "either version" window
+            # extends BACKWARD by the maximum legitimate queue dwell
+            # (the request deadline = the SLO — older than that it
+            # would have expired, not served)
+            margin = SERVING_SLO_MS / 1e3 + 0.05
+            if t0 < t_up0 - margin:
+                allowed = ("v1",)
+            elif t0 < t_up1:
+                allowed = ("v1", "v2")     # mid-rollout: either side
+            elif t0 < t_bad0 - margin:
+                allowed = ("v2",)
+            elif t0 < t_bad1:
+                allowed = ("v2", "v3")     # poisoned window pre-rollback
+            else:
+                allowed = ("v2",)          # rollback restored v2
+            if not any(np.array_equal(got, oracle[v][idx])
+                       for v in allowed):
+                n_bits_bad += 1
+        undone = sum(1 for _i, f, _t in records
+                     if f is not None and not f.done())
+        checks["zero_lost_futures"] = n_lost == 0 and undone == 0
+        checks["responses_match_version_oracles"] = \
+            n_bits_bad == 0 and n_ok > 0
+        checks["fleet_never_below_n_minus_1"] = \
+            min_healthy[0] >= len(replicas) - 1
+        ok = all(checks.values())
+        summary["gates"]["rolling_upgrade_zero_lost"] = {
+            "pass": ok, "checks": checks, "requests": len(records),
+            "ok": n_ok, "typed_errors": n_typed,
+            "lost": n_lost + undone, "bits_bad": n_bits_bad,
+            "min_healthy": min_healthy[0],
+            "upgrade_seconds": round(t_up1 - t_up0, 2)}
+        print(f"[chaos] upgrade: {len(records)} requests, {n_ok} ok, "
+              f"{n_typed} typed, {n_lost + undone} lost, "
+              f"{n_bits_bad} bit-mismatched; min healthy "
+              f"{min_healthy[0]}/3; rollout {t_up1 - t_up0:.2f}s")
+        for name, v in checks.items():
+            print(f"[chaos]   upgrade {name}: {v}")
+        return ok
+    finally:
+        flt.clear()
+        router.stop(drain=False, timeout=30)
+
+
 def main():
     ap = argparse.ArgumentParser(description=__doc__.split("\n")[0])
     ap.add_argument("--steps", type=int, default=24)
@@ -498,6 +882,12 @@ def main():
     ap.add_argument("--skip-serving", action="store_true",
                     help="skip the serving failover gate (Router "
                     "replica kill mid-traffic)")
+    ap.add_argument("--skip-preempt", action="store_true",
+                    help="skip the scripted-preemption gate (graceful "
+                    "SIGTERM leave/rejoin under launch.py)")
+    ap.add_argument("--skip-upgrade", action="store_true",
+                    help="skip the rolling-upgrade gate (3-replica "
+                    "fleet under traffic, poisoned-build rollback)")
     args = ap.parse_args()
 
     import numpy as np
@@ -574,6 +964,14 @@ def main():
     # -- gate 5: kill a serving replica mid-traffic, zero lost futures -
     if not args.skip_serving:
         ok = serving_gate(summary) and ok
+
+    # -- gate 6: scripted preemption schedule, bit-exact + sustained --
+    if not args.skip_preempt:
+        ok = preemption_gate(summary) and ok
+
+    # -- gate 7: rolling upgrade under traffic, poisoned-build rollback -
+    if not args.skip_upgrade:
+        ok = upgrade_gate(summary) and ok
 
     retry_counters = {}
     for s in telemetry.snapshot()["metrics"].get(

@@ -22,6 +22,22 @@ trainers already read), fans out the command, and then **supervises**:
   ``mxnet_tpu.parallel.elastic.ElasticRunner`` resume bit-exactly from
   their newest checkpoint bundle. Exhausted restarts fall back to
   fail-fast.
+* **Preemption is not failure.** A worker exiting with ``--preempt-rc``
+  (default 75, ``elastic.PREEMPTED_EXIT_CODE`` — what an
+  ``ElasticRunner`` worker exits with after its graceful
+  checkpoint-then-leave) is respawned with a FLAT ``--restart-backoff``
+  delay and does **not** burn the ``--max-restarts`` failure budget:
+  spot capacity reclaim is the steady state of a preemptible fleet,
+  not a crash. A separate ``--max-preempt-restarts`` budget (default
+  100) bounds runaway preempt-exit loops; both budgets advance the
+  worker's ``MXNET_ELASTIC_RESTART`` incarnation.
+* **Interrupting the supervisor does not orphan the job.** The
+  supervisor installs its own SIGTERM/SIGINT handlers for the duration
+  of the run: the signal is forwarded to every worker, reaped with the
+  same SIGTERM→SIGKILL escalation window, the exit report (and
+  ``--report`` JSON) is still written, and the launcher exits
+  ``128+signum`` — so ctrl-C, a CI timeout, or the supervisor's OWN
+  preemption tears the whole tree down cleanly.
 * **Structured exit report.** A per-worker table (rank, restarts, every
   exit code/signal) on stdout and, with ``--report PATH``, as JSON.
 
@@ -89,13 +105,19 @@ class _Worker:
         self.rank = rank
         self._spawn = spawn
         self.proc: subprocess.Popen | None = None
-        self.restarts = 0
+        self.restarts = 0          # failure restarts (--max-restarts)
+        self.preemptions = 0       # preempt-rc respawns (separate budget)
         self.exits: list[dict] = []
         self.done = False          # exited 0 — never restarted
         self.restart_at: float | None = None   # pending respawn time
 
     def spawn(self):
-        self.proc = self._spawn(self.rank, self.restarts)
+        # the incarnation counter covers BOTH budgets: a worker names
+        # per-incarnation artifacts (loss logs, reports) by
+        # MXNET_ELASTIC_RESTART and a preemption respawn is a new
+        # incarnation exactly like a failure restart
+        self.proc = self._spawn(self.rank,
+                                self.restarts + self.preemptions)
         self.restart_at = None
 
     def poll(self):
@@ -112,6 +134,7 @@ class _Worker:
 
     def report(self) -> dict:
         return {"rank": self.rank, "restarts": self.restarts,
+                "preemptions": self.preemptions,
                 "done": self.done, "exits": self.exits,
                 "final": self.exits[-1]["exit_code"] if self.exits
                 else None}
@@ -163,21 +186,67 @@ def _print_report(workers, out=sys.stderr):
             attempts.append(e["signal"] or f"exit {e['exit_code']}")
         print(f"[launch]   rank {w.rank}: "
               f"{' -> restart -> '.join(attempts) or 'never exited'}"
-              f" (restarts: {w.restarts})", file=out)
+              f" (restarts: {w.restarts}, preemptions: "
+              f"{w.preemptions})", file=out)
+
+
+class _SupervisorSignal:
+    """Signal latch for the supervision loop: the handler only records
+    the signum (async-signal-safe), the loop acts on it — forwarding
+    the teardown to the workers through the normal escalation path
+    instead of dying and orphaning them."""
+
+    def __init__(self):
+        self.signum: int | None = None
+        self._old: dict[int, object] = {}
+
+    def install(self, signals=(signal.SIGTERM, signal.SIGINT)):
+        for sig in signals:
+            try:
+                self._old[int(sig)] = signal.signal(
+                    sig, lambda signum, frame:
+                    setattr(self, "signum", signum))
+            except ValueError:
+                # not the main thread (supervise() driven from a test
+                # harness thread): run unhandled, the loop still works
+                pass
+        return self
+
+    def restore(self):
+        old, self._old = self._old, {}
+        for sig, handler in old.items():
+            try:
+                signal.signal(sig, handler)
+            except (ValueError, TypeError, OSError):
+                pass
 
 
 def supervise(workers, *, max_restarts: int, restart_backoff: float,
               term_window: float, poll_interval: float,
+              preempt_rc: int = 75, max_preempt_restarts: int = 100,
               log=lambda msg: print(msg, file=sys.stderr)) -> int:
     """The supervision loop (importable for tests). Spawns every worker,
-    polls them all, applies the fail-fast / elastic policy, and returns
-    the job's exit code (first failing rank's code, 0 when every rank
-    finished clean)."""
+    polls them all, applies the fail-fast / elastic / preemption policy,
+    and returns the job's exit code (first failing rank's code, 0 when
+    every rank finished clean). An exit with ``preempt_rc`` (<=0
+    disables) is a graceful preemption leave: respawned with a flat
+    backoff against its own ``max_preempt_restarts`` budget, the
+    failure budget untouched — even a ``--max-restarts 0`` fail-fast
+    job rides out preemptions. SIGTERM/SIGINT at the supervisor tears
+    the job down (forwarded SIGTERM, SIGKILL escalation) and returns
+    ``128+signum`` — the caller still writes its report."""
+    interrupt = _SupervisorSignal().install()
     for w in workers:
         w.spawn()
     first_fail: int | None = None
     try:
         while True:
+            if interrupt.signum is not None:
+                log(f"[launch] supervisor got "
+                    f"{_signal_name(interrupt.signum)}; terminating "
+                    f"workers (window {term_window:g}s)")
+                _terminate_all(workers, term_window)
+                return 128 + interrupt.signum
             now = time.monotonic()
             for w in workers:
                 if w.done or w.proc is None:
@@ -197,6 +266,20 @@ def supervise(workers, *, max_restarts: int, restart_backoff: float,
                     continue
                 code = _exit_code(rc)
                 desc = _signal_name(-rc) if rc < 0 else f"code {rc}"
+                if preempt_rc > 0 and code == preempt_rc and \
+                        w.preemptions < max_preempt_restarts:
+                    # graceful preemption leave: the worker checkpointed
+                    # and asked to be respawned. Flat backoff (the
+                    # doubling is for FAILING workers; a preempted one
+                    # is healthy) and no failure-budget spend.
+                    w.preemptions += 1
+                    delay = min(restart_backoff, _BACKOFF_CAP_S)
+                    w.restart_at = now + delay
+                    log(f"[launch] rank {w.rank} preempted (rc "
+                        f"{preempt_rc}); respawn "
+                        f"#{w.preemptions}/{max_preempt_restarts} "
+                        f"in {delay:.1f}s (restart budget untouched)")
+                    continue
                 if w.restarts < max_restarts:
                     w.restarts += 1
                     delay = min(
@@ -221,9 +304,13 @@ def supervise(workers, *, max_restarts: int, restart_backoff: float,
                 return 0
             time.sleep(poll_interval)
     except KeyboardInterrupt:
+        # reachable only when the SIGINT handler could not be installed
+        # (non-main thread): same teardown, conventional 130
         log("[launch] interrupted; terminating workers")
         _terminate_all(workers, term_window)
         return 130
+    finally:
+        interrupt.restore()
 
 
 def main(argv=None):
@@ -243,6 +330,13 @@ def main(argv=None):
     ap.add_argument("--restart-backoff", type=float, default=1.0,
                     help="base restart delay (s); doubles per restart "
                     f"of a rank, capped at {_BACKOFF_CAP_S:g}s")
+    ap.add_argument("--preempt-rc", type=int, default=75,
+                    help="exit code meaning 'gracefully preempted, "
+                    "respawn me' (elastic.PREEMPTED_EXIT_CODE; 0 "
+                    "disables preemption handling)")
+    ap.add_argument("--max-preempt-restarts", type=int, default=100,
+                    help="per-rank preemption respawn budget (separate "
+                    "from --max-restarts; preemptions are not failures)")
     ap.add_argument("--term-window", type=float, default=10.0,
                     help="seconds between SIGTERM and SIGKILL when "
                     "tearing the job down")
@@ -260,6 +354,8 @@ def main(argv=None):
         ap.error("no worker command given")
     if args.max_restarts < 0:
         ap.error("--max-restarts must be >= 0")
+    if args.max_preempt_restarts < 0:
+        ap.error("--max-preempt-restarts must be >= 0")
     cmd = args.command[1:] if args.command[0] == "--" else args.command
 
     hosts = None
@@ -303,7 +399,9 @@ def main(argv=None):
     rc = supervise(workers, max_restarts=args.max_restarts,
                    restart_backoff=args.restart_backoff,
                    term_window=args.term_window,
-                   poll_interval=args.poll_interval)
+                   poll_interval=args.poll_interval,
+                   preempt_rc=args.preempt_rc,
+                   max_preempt_restarts=args.max_preempt_restarts)
     _print_report(workers)
     if args.report:
         with open(args.report, "w") as f:
@@ -311,6 +409,8 @@ def main(argv=None):
                        "mode": "elastic" if args.max_restarts else
                        "fail_fast",
                        "max_restarts": args.max_restarts,
+                       "preempt_rc": args.preempt_rc,
+                       "max_preempt_restarts": args.max_preempt_restarts,
                        "coord_dir": coord_dir,
                        "workers": [w.report() for w in workers]},
                       f, indent=1)

@@ -37,6 +37,15 @@ for, plus the two correctness gates:
    synchronous and typed (``ServerOverloaded`` raised at ``submit``),
    goodput must stay >= 90% of the measured capacity, and accepted-
    request p99 must stay inside the SLO.
+7. **scale-up gate** — the control plane's number: time from the scale
+   DECISION to the new replica's first served response. Cold = the
+   first replica of a never-before-seen architecture (pays the full
+   trace + XLA compile per bucket signature); warm = ``add_replica``
+   on a live router whose fleet already compiled the grid (the
+   compilation service's single-flight executable table turns every
+   bucket into a cache hit). Acceptance: warm >= 2x faster than cold —
+   autoscaling only works when a scale-up costs seconds, not a
+   retrace.
 
 Emits bench.py's JSON contract — one flushed line per completed stage,
 monotonically enriched, ``{"metric", "value", "unit", "vs_baseline"}``
@@ -72,6 +81,8 @@ if __name__ == "__main__":
 import numpy as np
 
 SPEEDUP_BAR = 3.0      # ISSUE 6 acceptance: batched >= 3x eager
+SCALEUP_BAR = 2.0      # control plane: warm scale-up >= 2x faster than
+                       # a cold replica spawn (decision-to-first-response)
 IN_UNITS = 512
 HIDDEN = 256
 CLASSES = 10
@@ -445,6 +456,77 @@ def overload_stage(n_replicas=2, t_capacity=2.0, t_overload=4.0,
     }, ok
 
 
+def build_scale_net(seed: int = 0, hidden: int = HIDDEN + 64):
+    """A DISTINCT architecture for the scale-up stage: stages 1-6
+    already compiled ``build_net``'s bucket signatures in this process,
+    so the cold-spawn measurement needs shapes the executable table has
+    never seen."""
+    import mxnet_tpu as mx
+    from mxnet_tpu.gluon import nn
+
+    net = nn.HybridSequential()
+    with net.name_scope():
+        net.add(nn.Dense(hidden, activation="relu", in_units=IN_UNITS),
+                nn.Dense(CLASSES, in_units=hidden))
+    net.initialize()
+    rs = np.random.RandomState(seed)
+    for p in net.collect_params().values():
+        p.set_data(mx.nd.array(
+            (rs.randn(*p.shape) * 0.05).astype(np.float32)))
+    net.hybridize()
+    return net
+
+
+def scaleup_stage(slo_ms):
+    """Scale-decision-to-first-response, cold vs warm (the autoscaler's
+    latency): cold = first replica of a fresh architecture (trace +
+    compile per bucket), warm = ``Router.add_replica`` once the fleet
+    compiled the grid (executable-table hits). Returns (metrics, ok):
+    warm must be >= ``SCALEUP_BAR`` x faster and the scaled-up
+    replica's first response bit-identical to the fleet's."""
+    from mxnet_tpu import serving
+
+    buckets = (MIN_BUCKET, 4, 8)
+    x = make_traffic(1, seed=5)[0]
+
+    def mk(name):
+        return serving.Server(build_scale_net(),
+                              batch_buckets=buckets,
+                              shape_buckets=[(IN_UNITS,)],
+                              slo_ms=slo_ms, name=name)
+
+    # cold spawn: decision -> first response, nothing compiled yet
+    t0 = time.perf_counter()
+    first = mk("scale0")
+    first.start()
+    ref = first.submit(x).result(timeout=300)
+    t_cold = time.perf_counter() - t0
+
+    router = serving.Router([first], slo_ms=slo_ms).start()
+    try:
+        # warm scale-up: the same decision once the fleet is hot —
+        # add_replica starts + grid-warms the new replica (single-
+        # flight executable table) before it takes traffic
+        t0 = time.perf_counter()
+        newcomer = mk("scale1")
+        router.add_replica(newcomer)
+        out = newcomer.submit(x).result(timeout=300)
+        t_warm = time.perf_counter() - t0
+    finally:
+        router.stop(timeout=60)
+    identical = np.array_equal(out, ref)
+    speedup = t_cold / max(t_warm, 1e-9)
+    ok = speedup >= SCALEUP_BAR and identical
+    return {
+        "serving_scaleup_cold_s": round(t_cold, 3),
+        "serving_scaleup_warm_s": round(t_warm, 3),
+        "serving_scaleup_speedup": round(speedup, 2),
+        "serving_scaleup_bar": SCALEUP_BAR,
+        "serving_scaleup_bit_identical": bool(identical),
+        "serving_scaleup_gate": bool(ok),
+    }, ok
+
+
 def quantized_net(samples, calib_batches=4, batch=32):
     """build_net() again (same weights), int8-quantized with naive
     calibration over the bench traffic."""
@@ -606,12 +688,18 @@ def main():
     record.update(overload)
     _emit(record)
 
+    # stage 7: scale-up decision-to-first-response, warm vs cold
+    scaleup, scaleup_ok = scaleup_stage(slo_ms)
+    record.update(scaleup)
+    _emit(record)
+
     if telemetry_out:
         from mxnet_tpu import telemetry
 
         telemetry.write_snapshot(telemetry_out)
     return 0 if (identical and reload_ok and speedup >= SPEEDUP_BAR
-                 and router_identical and overload_ok) else 1
+                 and router_identical and overload_ok
+                 and scaleup_ok) else 1
 
 
 if __name__ == "__main__":
