@@ -20,7 +20,10 @@ module makes the failure paths *testable*:
   Router-managed replica dispatches; the dotted sub-sites
   ``serving.replica.<i>`` target one replica — kill or wedge exactly
   one instance of the fleet), ``serving.route`` (every routing
-  decision the serving Router makes), ``elastic.heartbeat`` (every
+  decision the serving Router makes), ``serving.ingress`` (every
+  submit frame the socket ingress handles), ``worker.spawn`` (every
+  replica worker process launch — dotted ``worker.spawn.<i>``
+  sub-sites target one worker's spawn path), ``elastic.heartbeat`` (every
   liveness touch of the elastic runtime) and ``elastic.rejoin`` (every
   epoch-transition restore — ``parallel.elastic.ElasticRunner``).
   Like telemetry, every call site guards on one module-level flag
@@ -87,14 +90,16 @@ SITES = (
     "serving.replica",
     "serving.route",
     "serving.upgrade",
+    "serving.ingress",
     "controller.scale",
+    "worker.spawn",
     "elastic.heartbeat",
     "elastic.rejoin",
 )
 
 # Site families whose instrumented points check dotted per-instance
 # sub-sites (``<family>.<i>``) in addition to the family name.
-_SUBSITE_FAMILIES = ("serving.replica",)
+_SUBSITE_FAMILIES = ("serving.replica", "worker.spawn")
 
 
 class FaultInjected(MXNetError):

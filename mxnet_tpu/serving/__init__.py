@@ -13,22 +13,42 @@ failover (no future is ever lost) and deadline-aware admission control
 elastic: ``Router.add_replica``/``remove_replica`` grow and drain it
 live, ``FleetController`` drives them from the router's own traffic
 signals, and ``rolling_upgrade`` walks a new model through the fleet
-with breaker-gated automatic rollback (see :mod:`.controller`). Hot
-reload, fault injection/retry and Prometheus telemetry ride the
-PR-1/PR-3 infrastructure; see :mod:`.server`, :mod:`.buckets`,
-:mod:`.reload`, :mod:`.router`, :mod:`.health`.
+with breaker-gated automatic rollback (see :mod:`.controller`).
+
+The fleet is also **crash-isolated**: a replica may be an
+out-of-process worker (``RemoteReplica`` over
+``python -m mxnet_tpu.serving.worker``, one supervised OS process per
+replica speaking the :mod:`.wire` frame protocol) — a segfault or
+SIGKILL there is an unambiguous, typed failure the router routes
+around and the supervisor respawns with backoff. ``Ingress`` puts a
+socket edge in front of the Router (bounded per-connection windows,
+backpressure as typed error frames; ``IngressClient`` is the matching
+client), and ``ScrapeFleetSignals`` feeds the autoscaler from
+``/metrics`` scrapes so the control plane works across address
+spaces. Hot reload, fault injection/retry and Prometheus telemetry
+ride the PR-1/PR-3 infrastructure; see :mod:`.server`,
+:mod:`.buckets`, :mod:`.reload`, :mod:`.router`, :mod:`.health`,
+:mod:`.wire`, :mod:`.worker`, :mod:`.remote`, :mod:`.ingress`.
 """
 from .buckets import BucketGrid
 from .controller import (
     FleetController,
     FleetSignals,
     ScalePolicy,
+    ScrapeFleetSignals,
     UpgradeRolledBack,
     live_controllers,
     rolling_upgrade,
 )
 from .health import CircuitBreaker, Heartbeat
+from .ingress import (
+    Ingress,
+    IngressClient,
+    IngressDisconnected,
+    live_ingresses,
+)
 from .reload import ReloadWatcher
+from .remote import RemoteReplica, WorkerCrashed, live_workers
 from .router import (
     FailoverExhausted,
     ReplicaFault,
@@ -43,5 +63,8 @@ __all__ = [
     "Router", "ServerOverloaded", "FailoverExhausted", "ReplicaFault",
     "CircuitBreaker", "Heartbeat", "live_routers",
     "FleetController", "FleetSignals", "ScalePolicy",
+    "ScrapeFleetSignals",
     "UpgradeRolledBack", "rolling_upgrade", "live_controllers",
+    "RemoteReplica", "WorkerCrashed", "live_workers",
+    "Ingress", "IngressClient", "IngressDisconnected", "live_ingresses",
 ]

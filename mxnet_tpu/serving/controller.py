@@ -73,7 +73,8 @@ from .health import CLOSED, _env_float
 from .router import Router
 
 __all__ = ["FleetController", "FleetSignals", "ScalePolicy",
-           "UpgradeRolledBack", "rolling_upgrade", "live_controllers"]
+           "ScrapeFleetSignals", "UpgradeRolledBack", "rolling_upgrade",
+           "live_controllers"]
 
 _log = logging.getLogger(__name__)
 
@@ -114,6 +115,101 @@ class FleetSignals:
         a full largest-bucket batch outstanding)."""
         cap = self.n_replicas * self.max_batch
         return self.inflight / cap if cap > 0 else 0.0
+
+
+class ScrapeFleetSignals:
+    """Build :class:`FleetSignals` from ``/metrics`` scrapes instead of
+    in-process router state — the control plane's signal source when
+    the fleet it scales is NOT in its address space (out-of-process
+    replica workers, or a router host observed by a separate
+    controller process).
+
+    ::
+
+        exporter = telemetry.start_exporter()          # router host
+        src = ScrapeFleetSignals(exporter.url,
+                                 slo_s=router.slo_s,
+                                 max_batch=router.grid.max_batch)
+        ctl = FleetController(router, factory, signals_source=src)
+
+    Scrapes the router host's exporter for the gauges the Router's
+    monitor publishes every tick (``mxnet_serving_router_queue_depth``,
+    ``mxnet_serving_router_inflight``,
+    ``mxnet_serving_predicted_wait_seconds``,
+    ``mxnet_controller_fleet_size``) plus the
+    ``mxnet_serving_shed_total`` counter, whose between-scrape delta is
+    computed here (counters are cumulative on the wire). ``slo_s`` and
+    ``max_batch`` are deploy-time configuration, not scrapable state.
+
+    A failed scrape returns ``None`` — the controller skips that tick
+    (no signal is not the same as a quiet fleet; acting on a default
+    would tear down capacity every time the exporter hiccups).
+
+    ``router`` selects ONE router's gauge series by its ``{router=}``
+    label when the scraped process hosts several Routers (the bench
+    does; a deployed host usually has one). Without it the gauges are
+    summed across routers — exact for a single-router host, ambiguous
+    otherwise. ``mxnet_serving_shed_total`` has no router dimension,
+    so the shed delta is always process-wide: point this source at an
+    exporter whose process serves one fleet when sheds matter.
+    """
+
+    def __init__(self, url: str, slo_s: float, max_batch: int,
+                 timeout_s: float = 2.0,
+                 router: Optional[str] = None):
+        if slo_s <= 0 or max_batch < 1:
+            raise MXNetError(
+                f"slo_s must be > 0 and max_batch >= 1, got "
+                f"{slo_s}/{max_batch}")
+        self.url = url
+        self.slo_s = float(slo_s)
+        self.max_batch = int(max_batch)
+        self.timeout_s = float(timeout_s)
+        self.router_label = ({"router": router} if router is not None
+                             else None)
+        self._last_shed: Optional[float] = None
+        self.n_scrapes = 0
+        self.n_failures = 0
+
+    def __call__(self) -> Optional[FleetSignals]:
+        try:
+            parsed = telemetry.scrape(self.url, timeout_s=self.timeout_s)
+        except Exception as e:  # noqa: BLE001 - a missed scrape skips
+            self.n_failures += 1            # the tick, typed+logged
+            _log.warning("scrape of %s failed (%s); skipping this "
+                         "tick", self.url, e)
+            return None
+        self.n_scrapes += 1
+        shed = telemetry.prom_value(parsed, "mxnet_serving_shed_total")
+        if self._last_shed is None:
+            delta = 0.0     # first scrape: no window to delta over
+        else:
+            # counter reset (router restart) reads as delta<0: clamp —
+            # stale pressure must not survive a restart
+            delta = max(shed - self._last_shed, 0.0)
+        self._last_shed = shed
+        n_replicas = telemetry.prom_value(
+            parsed, "mxnet_controller_fleet_size",
+            labels=self.router_label, default=-1.0)
+        if n_replicas < 1:
+            # the router host publishes its gauges from the monitor
+            # tick — an exporter that answers before the first tick (or
+            # with telemetry disabled) has no fleet view yet; no signal
+            # beats a made-up one
+            return None
+        return FleetSignals(
+            n_replicas=int(n_replicas),
+            queue_depth=int(telemetry.prom_value(
+                parsed, "mxnet_serving_router_queue_depth",
+                labels=self.router_label)),
+            inflight=int(telemetry.prom_value(
+                parsed, "mxnet_serving_router_inflight",
+                labels=self.router_label)),
+            shed_delta=int(delta),
+            predicted_wait_s=telemetry.prom_value(
+                parsed, "mxnet_serving_predicted_wait_seconds",
+                labels=self.router_label),
+            slo_s=self.slo_s, max_batch=self.max_batch)
 
 
 class ScalePolicy:
@@ -255,6 +351,8 @@ class FleetController:
                  policy: Optional[ScalePolicy] = None,
                  interval_s: Optional[float] = None,
                  drain_timeout_s: float = 30.0,
+                 signals_source: Optional[Callable[
+                     [], Optional[FleetSignals]]] = None,
                  name: Optional[str] = None):
         if interval_s is None:
             interval_s = _env_float("MXNET_CONTROLLER_INTERVAL", 0.5)
@@ -264,6 +362,7 @@ class FleetController:
         self.router = router
         self.replica_factory = replica_factory
         self.policy = policy or ScalePolicy()
+        self.signals_source = signals_source
         self.interval_s = float(interval_s)
         self.drain_timeout_s = float(drain_timeout_s)
         self.name = name or f"controller_{id(self):x}"
@@ -293,7 +392,8 @@ class FleetController:
         self._thread.start()
         _live_controllers.add(self)
         if _telemetry_state.enabled:
-            telemetry.set_fleet_size(self.router.fleet_size())
+            telemetry.set_fleet_size(self.router.fleet_size(),
+                                     router=self.router.name)
         return self
 
     def stop(self, timeout: Optional[float] = None) -> None:
@@ -341,11 +441,18 @@ class FleetController:
 
     def tick(self) -> Optional[str]:
         """Observe, decide, act (at most one scale action). Returns
-        ``"up"`` / ``"down"`` / ``None`` for what happened."""
+        ``"up"`` / ``"down"`` / ``None`` for what happened. With a
+        ``signals_source`` (e.g. :class:`ScrapeFleetSignals`) the
+        observation comes from there — a source returning ``None``
+        (failed scrape) skips the tick entirely: no decision on no
+        data."""
         self.n_ticks += 1
         if not self.router.is_running:
             return None
-        s = self.signals()
+        s = self.signals_source() if self.signals_source is not None \
+            else self.signals()
+        if s is None:
+            return None
         want = self.policy.desired(s)
         if want > s.n_replicas:
             return "up" if self._scale_up() else None
@@ -498,6 +605,18 @@ def rolling_upgrade(router: Router, model_factory: Callable,
                 f"rolling_upgrade: fleet not healthy — breaker not "
                 f"closed on {sick}; let the fleet recover (half-open "
                 "probes re-admit) before upgrading")
+        # in-place swap needs the in-process Server surface; an
+        # out-of-process RemoteReplica has no swap_model — refuse the
+        # whole rollout typed BEFORE anything is swapped (upgrading a
+        # worker fleet is respawn-with-a-new-factory, not a live swap)
+        remote = [r["name"] for r in reps
+                  if not hasattr(r["server"], "swap_model")]
+        if remote:
+            raise MXNetError(
+                f"rolling_upgrade: replicas {remote} are out-of-process"
+                " workers without in-place swap_model; upgrade a worker"
+                " fleet by respawning workers with the new factory "
+                "(remove_replica/add_replica)")
         new_version = (max(r["server"].model_version for r in reps) + 1
                        if version is None else int(version))
         done: List[tuple] = []      # (rep, old_block, old_version)

@@ -1,0 +1,501 @@
+"""``mx.serving.Ingress`` — socket ingress in front of the Router.
+
+The network edge of the serving stack (ROADMAP item 1's "network
+ingress in front of Router"): stdlib-only connection handling that
+turns :mod:`.wire` ``submit`` frames from remote clients into
+:meth:`Router.submit` calls and streams ``result`` frames back. Three
+properties it guarantees:
+
+* **Backpressure is synchronous and typed, never a dropped
+  connection.** Each connection has a bounded in-flight window
+  (``window`` submits outstanding); a submit past it is answered with
+  an ``overloaded`` error frame IMMEDIATELY — and the Router's own
+  admission control (:class:`~.router.ServerOverloaded` at submit,
+  queue-full, predicted-wait, deadline expiry) and failover exhaustion
+  (:class:`~.router.FailoverExhausted`) map onto the same typed error
+  frames. A client always learns WHY, at submit time, instead of
+  timing out against a silently shed request.
+
+* **A bad client costs one connection.** A torn or corrupt frame
+  (:class:`~.wire.FrameError`) closes that connection; in-flight
+  requests already forwarded keep resolving at the Router (their
+  result frames are dropped — the socket is gone, the futures are
+  not). The accept loop, the Router, and every other connection are
+  untouched.
+
+* **Every accepted request resolves.** The per-request done-callbacks
+  ride the Router's zero-lost-future invariant; a result that cannot
+  be written back (client went away) is discarded, never blocks the
+  replica that produced it.
+
+Fault site ``serving.ingress`` fires per handled frame: an injected
+fault resolves THAT request with a typed error frame (counted as
+``rejected{reason="fault"}``) — chaos runs exercise the edge without
+touching the fleet.
+
+Telemetry: ``mxnet_ingress_connections{state}`` (``open`` = currently
+connected, ``busy`` = with >= 1 in-flight request),
+``mxnet_ingress_rejected_total{reason}`` (``window_full`` /
+``overloaded`` / ``failover_exhausted`` / ``bad_frame`` / ``fault`` /
+``error``), ``mxnet_ingress_requests_total{outcome}`` +
+``mxnet_ingress_request_seconds``.
+
+:class:`IngressClient` is the matching stdlib client: ``submit() ->
+Future`` over one connection, error frames reconstructed into the
+SAME typed exceptions the in-process Router raises — code written
+against ``Router.submit`` ports to the socket edge unchanged.
+"""
+from __future__ import annotations
+
+import logging
+import socket
+import threading
+import time
+import weakref
+from concurrent.futures import Future
+from typing import Optional
+
+from .. import fault, telemetry
+from ..base import MXNetError
+from ..fault import _state as _fault_state
+from ..telemetry import _state as _telemetry_state
+from . import wire
+
+__all__ = ["Ingress", "IngressClient", "IngressDisconnected",
+           "live_ingresses"]
+
+_log = logging.getLogger(__name__)
+
+# every running ingress, for the test-suite leak guard (a leaked bound
+# socket + accept thread would tax every later test)
+_live_ingresses = weakref.WeakSet()
+
+
+def live_ingresses():
+    """Ingresses whose accept loop is currently running."""
+    return [i for i in list(_live_ingresses) if i.is_running]
+
+
+class IngressDisconnected(MXNetError):
+    """The ingress connection dropped with this request in flight. The
+    client-side analogue of :class:`~.remote.WorkerCrashed`: typed and
+    immediate, never a hung future."""
+
+
+class _Conn:
+    """One accepted connection: socket, coalescing writer, bounded
+    window."""
+
+    __slots__ = ("sock", "addr", "writer", "lock", "inflight",
+                 "closed")
+
+    def __init__(self, sock, addr):
+        self.sock = sock
+        self.addr = addr
+        # coalescing write side: result frames stream back-to-back
+        # under load, and the router's done-callbacks must never block
+        # on a slow client's socket (see wire.FrameWriter)
+        self.writer = wire.FrameWriter(sock, name="ingress-conn-writer")
+        self.lock = threading.Lock()
+        self.inflight = 0
+        self.closed = False
+
+    def send(self, frame) -> bool:
+        """Best-effort framed send; False once the socket is gone (a
+        result for a departed client is discarded, not an error)."""
+        if self.closed:
+            return False
+        try:
+            self.writer.send(frame)
+            return True
+        except (OSError, wire.FrameError):
+            self.closed = True
+            return False
+
+    def close(self):
+        self.closed = True
+        self.writer.close(flush=True, timeout=1.0)
+        try:
+            self.sock.shutdown(socket.SHUT_RDWR)
+        except OSError:
+            pass
+        try:
+            self.sock.close()
+        except OSError:
+            pass
+
+
+class Ingress:
+    """Serve a :class:`~.router.Router` (or a single ``Server`` — same
+    submit contract) over TCP.
+
+    ::
+
+        router = serving.Router(replicas, slo_ms=50).start()
+        ing = serving.Ingress(router, port=0, window=64).start()
+        ... serving.IngressClient("127.0.0.1", ing.port) ...
+        ing.stop(); router.stop()
+
+    ``window`` bounds per-connection in-flight submits (typed
+    ``overloaded`` frame past it — the backpressure contract);
+    ``max_connections`` bounds handler threads (excess accepts are
+    closed immediately). The ingress OWNS neither the router nor its
+    replicas — stopping it closes the edge, the fleet keeps serving.
+    """
+
+    def __init__(self, router, host: str = "127.0.0.1", port: int = 0,
+                 window: int = 64, max_connections: int = 256,
+                 name: Optional[str] = None):
+        if window < 1:
+            raise MXNetError(f"window must be >= 1, got {window}")
+        if max_connections < 1:
+            raise MXNetError(
+                f"max_connections must be >= 1, got {max_connections}")
+        self.router = router
+        self.host = host
+        self.request_port = int(port)
+        self.window = int(window)
+        self.max_connections = int(max_connections)
+        self.name = name or f"ingress_{id(self):x}"
+        self.port: Optional[int] = None
+        self._listener: Optional[socket.socket] = None
+        self._accept_thread: Optional[threading.Thread] = None
+        self._conns: set = set()
+        self._conns_lock = threading.Lock()
+        self._running = False
+        self._gauges_next = 0.0     # next conn-gauge scan (rate limit)
+        # light counters (telemetry has the labeled story)
+        self.n_accepted = 0
+        self.n_requests = 0
+        self.n_rejected = 0
+
+    # -- lifecycle -----------------------------------------------------
+    @property
+    def is_running(self) -> bool:
+        t = self._accept_thread
+        return self._running and t is not None and t.is_alive()
+
+    def start(self) -> "Ingress":
+        if self.is_running:
+            raise MXNetError(f"{self.name}: already running")
+        listener = socket.socket(socket.AF_INET, socket.SOCK_STREAM)
+        listener.setsockopt(socket.SOL_SOCKET, socket.SO_REUSEADDR, 1)
+        listener.bind((self.host, self.request_port))
+        listener.listen(128)
+        # a blocking accept() does not reliably wake when another
+        # thread closes the socket — poll so stop() is bounded
+        listener.settimeout(0.25)
+        self._listener = listener
+        self.port = listener.getsockname()[1]
+        self._running = True
+        self._accept_thread = threading.Thread(
+            target=self._accept_loop, name=self.name, daemon=True)
+        self._accept_thread.start()
+        _live_ingresses.add(self)
+        self._publish_conn_gauges(force=True)
+        return self
+
+    def stop(self, timeout: Optional[float] = None) -> None:
+        """Close the edge: stop accepting, drop every connection (their
+        in-flight requests keep resolving at the router; the result
+        frames are discarded). The router keeps serving."""
+        self._running = False
+        listener, self._listener = self._listener, None
+        if listener is not None:
+            try:
+                listener.shutdown(socket.SHUT_RDWR)
+            except OSError:
+                pass        # not connected on this platform: the
+            try:            # accept poll timeout bounds the exit
+                listener.close()
+            except OSError:
+                pass
+        with self._conns_lock:
+            conns = list(self._conns)
+        for c in conns:
+            c.close()
+        t = self._accept_thread
+        if t is not None:
+            t.join(timeout if timeout is not None else 10.0)
+            if t.is_alive():
+                raise MXNetError(
+                    f"{self.name}: accept thread did not exit")
+        self._accept_thread = None
+        _live_ingresses.discard(self)
+        self._publish_conn_gauges(force=True)
+
+    def __enter__(self) -> "Ingress":
+        return self.start()
+
+    def __exit__(self, *exc) -> None:
+        self.stop()
+
+    # -- accept / per-connection handling ------------------------------
+    def _accept_loop(self) -> None:
+        listener = self._listener
+        while self._running and listener is not None:
+            try:
+                sock, addr = listener.accept()
+            except socket.timeout:
+                continue            # poll tick: re-check _running
+            except OSError:
+                return              # listener closed by stop()
+            with self._conns_lock:
+                full = len(self._conns) >= self.max_connections
+            if full:
+                # the connection cap is load shedding too: refuse with
+                # a typed frame, then close — not a silent RST
+                try:
+                    wire.send_frame(sock, {
+                        "kind": "result", "id": None, "ok": False,
+                        "etype": "overloaded",
+                        "error": f"{self.name}: connection limit "
+                                 f"({self.max_connections}) reached"})
+                except (OSError, wire.FrameError):
+                    pass
+                try:
+                    sock.close()
+                except OSError:
+                    pass
+                self._count_rejected("connection_limit")
+                continue
+            sock.setsockopt(socket.IPPROTO_TCP, socket.TCP_NODELAY, 1)
+            conn = _Conn(sock, addr)
+            with self._conns_lock:
+                self._conns.add(conn)
+            self.n_accepted += 1
+            self._publish_conn_gauges(force=True)
+            threading.Thread(
+                target=self._conn_loop, args=(conn,),
+                name=f"{self.name}-conn", daemon=True).start()
+
+    def _conn_loop(self, conn: _Conn) -> None:
+        try:
+            rf = wire.reader(conn.sock)     # buffered read side
+            while self._running:
+                try:
+                    frame = wire.recv_frame(rf)
+                except wire.ConnectionClosed:
+                    return          # client went away (clean or torn)
+                except (wire.FrameError, OSError):
+                    # corrupt stream: this connection is unusable; the
+                    # partial frame was discarded, everything else in
+                    # the process is untouched
+                    self._count_rejected("bad_frame")
+                    return
+                if frame["kind"] == "submit":
+                    self._handle_submit(conn, frame)
+                elif frame["kind"] == "ping":
+                    conn.send({"kind": "pong", "id": frame.get("id")})
+                # unknown kinds ignored (protocol growth)
+        finally:
+            conn.close()
+            with self._conns_lock:
+                self._conns.discard(conn)
+            self._publish_conn_gauges(force=True)
+
+    def _handle_submit(self, conn: _Conn, frame: dict) -> None:
+        req_id = frame.get("id")
+        t0 = time.perf_counter()
+        if _fault_state.enabled:
+            try:
+                fault.check("serving.ingress", f"{self.name}")
+            except fault.FaultInjected as e:
+                self._reject(conn, req_id, "fault", e)
+                return
+        with conn.lock:
+            if conn.inflight >= self.window:
+                # THE backpressure frame: synchronous, typed, while the
+                # window's requests are still in flight
+                self._reject(conn, req_id, "window_full", MXNetError(
+                    f"{self.name}: per-connection window "
+                    f"({self.window} in flight) is full"),
+                    etype="overloaded")
+                return
+            conn.inflight += 1
+        try:
+            fut = self.router.submit(frame["sample"],
+                                     deadline_ms=frame.get("deadline_ms"))
+        except Exception as e:  # noqa: BLE001 - typed onto the wire
+            with conn.lock:
+                conn.inflight -= 1
+            etype, _msg = wire.encode_error(e)
+            reason = etype if etype in ("overloaded",
+                                        "failover_exhausted") else "error"
+            self._reject(conn, req_id, reason, e, etype=etype)
+            return
+        self._publish_conn_gauges()
+        fut.add_done_callback(
+            lambda f, c=conn, i=req_id, t=t0: self._on_done(c, i, f, t))
+
+    def _on_done(self, conn: _Conn, req_id, fut, t0: float) -> None:
+        with conn.lock:
+            conn.inflight -= 1
+        try:
+            payload = fut.result()
+        except Exception as e:  # noqa: BLE001 - typed onto the wire
+            etype, msg = wire.encode_error(e)
+            delivered = conn.send({"kind": "result", "id": req_id,
+                                   "ok": False, "etype": etype,
+                                   "error": msg})
+            self._count_request("error", t0)
+        else:
+            delivered = conn.send({"kind": "result", "id": req_id,
+                                   "ok": True, "payload": payload})
+            self._count_request("ok" if delivered else "undeliverable",
+                                t0)
+        self._publish_conn_gauges()
+
+    # -- counters ------------------------------------------------------
+    def _reject(self, conn: _Conn, req_id, reason: str,
+                exc: BaseException, etype: Optional[str] = None) -> None:
+        if etype is None:
+            etype, _ = wire.encode_error(exc)
+        conn.send({"kind": "result", "id": req_id, "ok": False,
+                   "etype": etype, "error": str(exc)})
+        self._count_rejected(reason)
+
+    def _count_rejected(self, reason: str) -> None:
+        self.n_rejected += 1
+        if _telemetry_state.enabled:
+            telemetry.record_ingress_rejected(reason)
+
+    def _count_request(self, outcome: str, t0: float) -> None:
+        self.n_requests += 1
+        if _telemetry_state.enabled:
+            telemetry.record_ingress_request(
+                time.perf_counter() - t0, outcome)
+
+    def _publish_conn_gauges(self, force: bool = False) -> None:
+        if not _telemetry_state.enabled:
+            return
+        # gauges feed ~1 Hz scrapes; recounting every connection under
+        # the shared lock on EVERY submit/done would put O(conns) work
+        # + lock contention on the hot path this stack optimizes.
+        # Rate-limit the scan; accept/close (force) always publish.
+        now = time.monotonic()
+        if not force and now < self._gauges_next:
+            return
+        self._gauges_next = now + 0.25
+        with self._conns_lock:
+            conns = list(self._conns)
+        busy = sum(1 for c in conns if c.inflight > 0)
+        telemetry.set_ingress_connections("open", len(conns))
+        telemetry.set_ingress_connections("busy", busy)
+
+    def stats(self) -> dict:
+        with self._conns_lock:
+            n_conns = len(self._conns)
+            inflight = sum(c.inflight for c in self._conns)
+        return {"name": self.name, "port": self.port,
+                "running": self.is_running, "connections": n_conns,
+                "inflight": inflight, "accepted": self.n_accepted,
+                "requests": self.n_requests,
+                "rejected": self.n_rejected}
+
+
+class IngressClient:
+    """Stdlib client for one :class:`Ingress` connection.
+
+    ::
+
+        with serving.IngressClient("127.0.0.1", port) as cli:
+            out = cli.submit(sample).result(timeout=5)
+
+    ``submit`` returns a Future that resolves with the result payload
+    or raises the SAME typed exceptions the in-process Router does
+    (``ServerOverloaded`` for backpressure/admission, reconstructed
+    from the error frame) — or :class:`IngressDisconnected` the moment
+    the connection drops with requests outstanding. Thread-safe
+    submits; one reader thread resolves by request id."""
+
+    def __init__(self, host: str, port: int,
+                 connect_timeout_s: float = 10.0):
+        self.host, self.port = host, int(port)
+        self._sock = wire.connect(host, int(port),
+                                  timeout=connect_timeout_s)
+        self._sock.settimeout(None)
+        # coalescing writer: burst submits share syscalls, and a
+        # stalled ingress stalls the writer thread, not the submitter
+        self._writer = wire.FrameWriter(self._sock,
+                                        name="ingress-client-writer")
+        self._lock = threading.Lock()
+        self._futures: dict = {}
+        self._next_id = 0
+        self._closed = False
+        self._reader = threading.Thread(
+            target=self._reader_loop, name="ingress-client", daemon=True)
+        self._reader.start()
+
+    def submit(self, sample, deadline_ms: Optional[float] = None
+               ) -> Future:
+        fut = Future()
+        with self._lock:
+            if self._closed:
+                raise IngressDisconnected(
+                    "ingress connection is closed")
+            self._next_id += 1
+            req_id = self._next_id
+            self._futures[req_id] = fut
+        frame = {"kind": "submit", "id": req_id, "sample": sample}
+        if deadline_ms is not None:
+            frame["deadline_ms"] = float(deadline_ms)
+        try:
+            self._writer.send(frame)
+        except (OSError, wire.FrameError) as e:
+            self._fail_all(f"send failed: {e}")
+            raise IngressDisconnected(
+                f"ingress connection lost at submit: {e}") from e
+        return fut
+
+    def _reader_loop(self) -> None:
+        try:
+            rf = wire.reader(self._sock)    # buffered read side
+            while True:
+                frame = wire.recv_frame(rf)
+                if frame["kind"] != "result":
+                    continue
+                with self._lock:
+                    fut = self._futures.pop(frame.get("id"), None)
+                if fut is None or \
+                        not fut.set_running_or_notify_cancel():
+                    continue
+                if frame.get("ok"):
+                    fut.set_result(frame.get("payload"))
+                else:
+                    fut.set_exception(wire.decode_error(
+                        frame.get("etype", "mxnet_error"),
+                        frame.get("error", "ingress error")))
+        except (wire.FrameError, OSError) as e:
+            self._fail_all(f"connection lost: {e}")
+
+    def _fail_all(self, why: str) -> None:
+        with self._lock:
+            if self._closed:
+                pending = {}
+            else:
+                self._closed = True
+                pending, self._futures = self._futures, {}
+        exc = IngressDisconnected(
+            f"ingress client: {why}; "
+            f"{len(pending)} request(s) were in flight")
+        for fut in pending.values():
+            if fut.set_running_or_notify_cancel():
+                try:
+                    fut.set_exception(exc)
+                except Exception:   # noqa: BLE001
+                    pass
+        self._writer.close(flush=False, timeout=1.0)
+        try:
+            self._sock.close()
+        except OSError:
+            pass
+
+    def close(self) -> None:
+        self._fail_all("closed by the client")
+
+    def __enter__(self) -> "IngressClient":
+        return self
+
+    def __exit__(self, *exc) -> None:
+        self.close()

@@ -1,0 +1,596 @@
+"""``mx.serving.RemoteReplica`` — a crash-isolated replica worker handle.
+
+The parent-side half of the out-of-process serving stack: a
+``RemoteReplica`` satisfies the same dispatch contract the Router's
+in-process ``_Replica.server`` does (``name`` / ``grid`` / ``slo_s`` /
+``hb`` / ``is_running`` / ``submit() -> Future`` / ``start`` /
+``stop``), but the model lives in a SEPARATE supervised OS process
+(:mod:`.worker`) reached over one :mod:`.wire` connection. The router's
+breakers, hung-dispatch watchdog, failover, drain and zero-lost-future
+invariant apply unchanged — what changes is the *failure signal*:
+
+* **Connection drop and ``waitpid`` are unambiguous.** An in-process
+  replica can only look "slow" until a timeout says otherwise; a
+  worker whose socket EOFs or whose process is reaped CRASHED, full
+  stop. Every in-flight future resolves immediately with the typed
+  :class:`WorkerCrashed` (never a hang on a dead process), and
+  ``crash_count`` bumps — the Router's monitor reads it and trips the
+  breaker at once (crash != slow: no failure-threshold grace for a
+  corpse).
+
+* **Hung is still hung.** The worker streams health frames carrying
+  its server SCHEDULER heartbeat's age; this handle's ``hb`` replays
+  them, so the router's existing hung-dispatch sweep sees a wedged
+  remote dispatch exactly as it saw an in-process one — and a wedged
+  worker whose health frames keep flowing is distinguished from a
+  dead one.
+
+* **Supervision with exponential backoff.** A crashed worker is
+  respawned (``respawn=True``) after ``MXNET_WORKER_RESPAWN_BACKOFF``
+  seconds, doubling per consecutive crash (capped), up to
+  ``MXNET_WORKER_MAX_RESPAWNS`` times; the respawned process warms its
+  grid through the compilation service's persistent disk cache +
+  exported StableHLO (the executable table does not span processes,
+  the disk tier does), and the router re-admits it through the
+  breaker's half-open probe. ``mxnet_worker_restarts_total{replica}``
+  counts re-spawns.
+
+Fault sites: ``worker.spawn`` fires on every process launch (spawn and
+respawn), with the indexed ``worker.spawn.<i>`` sub-site (PR-9 form)
+targeting one worker's spawn path — ``i`` is the replica's stable
+``worker_index``, assigned at construction, process-wide monotonic.
+
+The model is specified as an importable factory (``module:function`` +
+kwargs + extra ``sys.path`` entries), not a closure — it must be
+reconstructable across an exec boundary, the ``tools/launch.py``
+contract. ``_pre_dispatch`` (the Router's in-process fault hook) is
+accepted and ignored: injected dispatch faults cannot reach another
+address space — chaos runs target workers with ``worker.spawn`` sites
+and real signals (``tools/chaos_check.py`` gate 8 SIGKILLs one).
+"""
+from __future__ import annotations
+
+import itertools
+import json
+import logging
+import os
+import socket
+import subprocess
+import sys
+import threading
+import time
+import weakref
+from concurrent.futures import Future
+from typing import Optional, Sequence
+
+import numpy as np
+
+from .. import fault, telemetry
+from ..base import MXNetError
+from ..fault import _state as _fault_state
+from ..telemetry import _state as _telemetry_state
+from . import wire
+from .buckets import BucketGrid
+from .health import Heartbeat, _env_float
+
+__all__ = ["RemoteReplica", "WorkerCrashed", "live_workers"]
+
+_log = logging.getLogger(__name__)
+
+_REPO_ROOT = os.path.abspath(
+    os.path.join(os.path.dirname(__file__), "..", ".."))
+
+# every constructed handle with a possibly-live child, for the
+# test-suite leak guard (mirrors server._live_servers)
+_live_workers = weakref.WeakSet()
+
+# stable worker indices for the worker.spawn.<i> fault sub-site —
+# process-wide monotonic, never reused (the serving.replica.<i> rule)
+_WORKER_INDEX = itertools.count()
+
+_RESPAWN_BACKOFF_CAP_S = 30.0
+
+
+def live_workers():
+    """Handles whose worker process is currently alive (leak guard)."""
+    out = []
+    for w in list(_live_workers):
+        p = w.proc
+        if p is not None and p.poll() is None:
+            out.append(w)
+    return out
+
+
+class WorkerCrashed(MXNetError):
+    """The worker process died (connection drop / waitpid) with this
+    request in flight. Unambiguous and typed — the router fails over;
+    nothing waits on a corpse."""
+
+
+class RemoteReplica:
+    """One supervised out-of-process replica behind the Server contract.
+
+    ::
+
+        rep = serving.RemoteReplica(
+            "my_models:build_resnet", name="w0",
+            batch_buckets=(2, 4, 8), shape_buckets=[(3, 224, 224)],
+            slo_ms=50, python_paths=["/path/to/models"])
+        router = serving.Router([rep, ...], slo_ms=50).start()
+
+    ``factory`` is ``module:function`` importable IN THE CHILD (use
+    ``python_paths`` for directories outside the environment);
+    ``factory_kwargs`` must be JSON-able. The grid arguments must match
+    what the worker builds — the hello frame cross-checks and start()
+    fails typed on drift. ``batch_timeout_ms`` (default 5) caps the
+    oldest queued request's co-batching wait in the worker's server —
+    out-of-process arrival streams are SPREAD by the socket pipeline,
+    so the deadline-keyed close alone pins p50 at the SLO edge; pass
+    ``None`` to restore it. ``metrics_port`` exposes the worker's own
+    ``/metrics``+``/healthz`` (0 = ephemeral, discovered via
+    :attr:`metrics_port` after start).
+    """
+
+    def __init__(self, factory: str, name: str,
+                 batch_buckets: Sequence[int] = (1, 2, 4, 8, 16, 32),
+                 shape_buckets=None, slo_ms: float = 100.0,
+                 batch_timeout_ms: Optional[float] = 5.0,
+                 dtype: str = "float32", factory_kwargs: Optional[dict] = None,
+                 python_paths: Sequence[str] = (),
+                 env: Optional[dict] = None, warmup: bool = True,
+                 max_queue: int = 4096,
+                 respawn: bool = True,
+                 max_respawns: Optional[int] = None,
+                 respawn_backoff_s: Optional[float] = None,
+                 spawn_timeout_s: float = 180.0,
+                 health_interval_s: float = 0.05,
+                 metrics_port: Optional[int] = None):
+        if slo_ms <= 0:
+            raise MXNetError(f"slo_ms must be > 0, got {slo_ms}")
+        self.factory = factory
+        self.factory_kwargs = dict(factory_kwargs or {})
+        json.dumps(self.factory_kwargs)   # fail at construction, typed
+        self.name = name
+        self.grid = BucketGrid(batch_buckets, shape_buckets)
+        self.slo_s = slo_ms / 1e3
+        if batch_timeout_ms is not None and batch_timeout_ms <= 0:
+            raise MXNetError(
+                f"batch_timeout_ms must be > 0 (or None for the "
+                f"deadline-keyed close), got {batch_timeout_ms}")
+        self.batch_timeout_ms = batch_timeout_ms
+        self.dtype = dtype
+        self.python_paths = [os.path.abspath(p) for p in python_paths]
+        self.extra_env = dict(env or {})
+        self.warmup = bool(warmup)
+        self.max_queue = int(max_queue)
+        self.respawn = bool(respawn)
+        if max_respawns is None:
+            max_respawns = int(_env_float("MXNET_WORKER_MAX_RESPAWNS", 8))
+        if respawn_backoff_s is None:
+            respawn_backoff_s = _env_float(
+                "MXNET_WORKER_RESPAWN_BACKOFF", 0.5)
+        if respawn_backoff_s <= 0:
+            raise MXNetError(
+                f"respawn backoff must be > 0, got {respawn_backoff_s}")
+        self.max_respawns = int(max_respawns)
+        self.respawn_backoff_s = float(respawn_backoff_s)
+        self.spawn_timeout_s = float(spawn_timeout_s)
+        self.health_interval_s = float(health_interval_s)
+        self.request_metrics_port = metrics_port
+        self.metrics_port: Optional[int] = None
+        self.worker_index = next(_WORKER_INDEX)
+
+        self.hb = Heartbeat()
+        self._pre_dispatch = None     # Router compat; ignored (see doc)
+        self.model_version = 0        # Router/controller read-only compat
+        self.proc: Optional[subprocess.Popen] = None
+        self._sock: Optional[socket.socket] = None
+        self._writer: Optional[wire.FrameWriter] = None
+        self._lock = threading.Lock()
+        self._futures: dict = {}      # id -> Future
+        self._next_id = 0
+        self._incarnation = 0         # bumps per successful spawn
+        self._down_handled = -1       # last incarnation whose death ran
+        self._running = False
+        self._stopping = False
+        self._respawner: Optional[threading.Thread] = None
+        self.crash_count = 0          # unexpected downs (the router's
+        #                               crash-trip signal)
+        self.n_restarts = 0           # successful respawns
+        self.n_requests = 0
+        self.n_ok = 0
+        self.n_errors = 0
+
+    # -- lifecycle -----------------------------------------------------
+    @property
+    def is_running(self) -> bool:
+        # the cheap flag, NOT proc.poll(): the router's picker reads
+        # this per routed request, and a waitpid syscall per replica
+        # per submit is real cost on the inline fast path. The
+        # dedicated waitpid/reader threads flip _running within the
+        # same signal latency; a submit racing the flip fails its
+        # writer send and resolves typed through _on_down anyway.
+        return self._running and self.proc is not None
+
+    def start(self) -> "RemoteReplica":
+        if self.is_running:
+            raise MXNetError(f"{self.name}: worker already running")
+        self._stopping = False
+        self._spawn_once()
+        _live_workers.add(self)
+        return self
+
+    def _spawn_cmd(self, port: int):
+        cmd = [sys.executable, "-m", "mxnet_tpu.serving.worker",
+               "--connect", f"127.0.0.1:{port}",
+               "--factory", self.factory,
+               "--factory-kwargs", json.dumps(self.factory_kwargs),
+               "--name", self.name,
+               "--batch-buckets",
+               ",".join(str(b) for b in self.grid.batch_buckets),
+               "--shape-buckets",
+               json.dumps([list(s) for s in self.grid.shape_buckets]
+                          if self.grid.shape_buckets else None),
+               "--slo-ms", str(self.slo_s * 1e3),
+               "--dtype", self.dtype,
+               "--max-queue", str(self.max_queue),
+               "--health-interval", str(self.health_interval_s)]
+        if self.batch_timeout_ms is not None:
+            cmd += ["--batch-timeout-ms", str(self.batch_timeout_ms)]
+        for p in self.python_paths:
+            cmd += ["--path", p]
+        if not self.warmup:
+            cmd.append("--no-warmup")
+        if self.request_metrics_port is not None:
+            cmd += ["--metrics-port", str(self.request_metrics_port)]
+        return cmd
+
+    def _spawn_env(self):
+        env = dict(os.environ, **self.extra_env)
+        paths = [_REPO_ROOT] + self.python_paths
+        prev = env.get("PYTHONPATH")
+        if prev:
+            paths.append(prev)
+        env["PYTHONPATH"] = os.pathsep.join(paths)
+        # the child must not clobber the parent's exit-hook snapshot
+        env.pop("MXNET_TELEMETRY_OUT", None)
+        return env
+
+    def _spawn(self, port: int) -> subprocess.Popen:
+        """The exec seam — tests monkeypatch this to run a protocol-
+        speaking fake in-thread; production launches the real child."""
+        return subprocess.Popen(self._spawn_cmd(port),
+                                env=self._spawn_env())
+
+    def _spawn_once(self) -> None:
+        """One supervised process launch: fault site, listener, exec,
+        hello handshake, grid cross-check, reader+waitpid threads.
+        Raises typed on any failure (the caller owns retry/backoff)."""
+        if self._stopping:
+            raise MXNetError(f"{self.name}: handle is stopping")
+        if _fault_state.enabled:
+            sub = f"worker.spawn.{self.worker_index}"
+            fault.check("worker.spawn", self.name)
+            if fault.has_policy(sub):    # no double-count under '*'
+                fault.check(sub, self.name)
+        listener = socket.socket(socket.AF_INET, socket.SOCK_STREAM)
+        conn = None
+        try:
+            listener.bind(("127.0.0.1", 0))
+            listener.listen(1)
+            listener.settimeout(self.spawn_timeout_s)
+            port = listener.getsockname()[1]
+            self.proc = self._spawn(port)
+            try:
+                conn, _addr = listener.accept()
+            except socket.timeout:
+                raise MXNetError(
+                    f"{self.name}: worker did not connect back within "
+                    f"{self.spawn_timeout_s:g}s (build/warmup hung or "
+                    "crashed; see its stderr)") from None
+            conn.setsockopt(socket.IPPROTO_TCP, socket.TCP_NODELAY, 1)
+            conn.settimeout(self.spawn_timeout_s)
+            hello = wire.recv_frame(conn)
+            if hello.get("kind") != "hello":
+                raise MXNetError(
+                    f"{self.name}: expected hello, got "
+                    f"{hello.get('kind')!r}")
+            got_batch = tuple(hello["batch_buckets"])
+            got_shapes = (tuple(tuple(s) for s in hello["shape_buckets"])
+                          if hello["shape_buckets"] else None)
+            if got_batch != self.grid.batch_buckets or \
+                    got_shapes != self.grid.shape_buckets:
+                raise MXNetError(
+                    f"{self.name}: worker grid {got_batch}/{got_shapes} "
+                    f"does not match the handle's "
+                    f"{self.grid.batch_buckets}/{self.grid.shape_buckets}"
+                    " — matched-bucket bit-identity would not hold")
+            conn.settimeout(None)
+            self.metrics_port = hello.get("metrics_port")
+            writer = wire.FrameWriter(conn, name=f"{self.name}-writer")
+            with self._lock:
+                self._sock = conn
+                self._writer = writer
+                self._incarnation += 1
+                inc = self._incarnation
+                self._running = True
+            self.hb.touch()
+            threading.Thread(
+                target=self._reader_loop, args=(conn, inc),
+                name=f"{self.name}-reader", daemon=True).start()
+            threading.Thread(
+                target=self._waitpid_loop, args=(self.proc, inc),
+                name=f"{self.name}-waitpid", daemon=True).start()
+        except BaseException:
+            if conn is not None:
+                try:
+                    conn.close()
+                except OSError:
+                    pass
+            p = self.proc
+            if p is not None and p.poll() is None:
+                p.kill()
+                p.wait()
+            self._running = False
+            raise
+        finally:
+            listener.close()
+
+    # -- the two unambiguous failure signals ---------------------------
+    def _reader_loop(self, conn: socket.socket, inc: int) -> None:
+        try:
+            rf = wire.reader(conn)      # buffered: result/health frames
+            while True:                 # stream back-to-back under load
+                frame = wire.recv_frame(rf)
+                kind = frame["kind"]
+                if kind == "result":
+                    self._on_result(frame)
+                elif kind == "health":
+                    # replay the worker scheduler's heartbeat age into
+                    # this handle's beacon: the router's hung-dispatch
+                    # sweep reads it across the process boundary
+                    self.hb._t = time.monotonic() - max(
+                        float(frame.get("age", 0.0)), 0.0)
+        except wire.FrameError as e:
+            # EOF (clean or half-written frame) or corrupt stream —
+            # either way the connection is dead and partial bytes were
+            # DISCARDED, not parsed
+            self._on_down(inc, f"connection lost: {e}")
+        except OSError as e:
+            self._on_down(inc, f"connection error: {e}")
+
+    def _waitpid_loop(self, proc: subprocess.Popen, inc: int) -> None:
+        rc = proc.wait()
+        if rc < 0:
+            why = f"worker process killed by signal {-rc}"
+        else:
+            why = f"worker process exited rc={rc}"
+        self._on_down(inc, why)
+
+    def _close_and_fail(self, sock, writer, pending,
+                        exc: MXNetError) -> None:
+        """Shared teardown tail for _on_down/stop: close the connection
+        halves, fail every pending future typed (first resolution
+        wins — a future the worker already resolved is left alone)."""
+        if writer is not None:
+            writer.close(flush=False, timeout=1.0)
+        if sock is not None:
+            try:
+                sock.close()
+            except OSError:
+                pass
+        for fut in pending.values():
+            if fut.set_running_or_notify_cancel():
+                try:
+                    fut.set_exception(exc)
+                except Exception:   # noqa: BLE001 - already resolved
+                    pass
+
+    def _on_down(self, inc: int, why: str) -> None:
+        """Connection drop / waitpid for incarnation ``inc``: fail every
+        in-flight future typed, bump ``crash_count`` (unless this is a
+        requested stop), schedule the respawner. First signal wins."""
+        with self._lock:
+            if inc != self._incarnation or self._down_handled >= inc:
+                return                  # stale or already handled
+            self._down_handled = inc
+            self._running = False
+            pending, self._futures = self._futures, {}
+            sock, self._sock = self._sock, None
+            writer, self._writer = self._writer, None
+            stopping = self._stopping
+        self._close_and_fail(sock, writer, pending, WorkerCrashed(
+            f"worker {self.name}: {why}; "
+            f"{len(pending)} request(s) were in flight"))
+        if stopping:
+            return
+        self.crash_count += 1
+        self.n_errors += len(pending)
+        if self.respawn and self.n_restarts < self.max_respawns:
+            t = threading.Thread(target=self._respawn_loop,
+                                 name=f"{self.name}-respawn",
+                                 daemon=True)
+            self._respawner = t
+            t.start()
+
+    def _respawn_loop(self) -> None:
+        """Exponential-backoff respawn until one spawn succeeds or the
+        budget is spent — ``max_respawns`` bounds FAILED attempts too
+        (a permanently-broken spawn path — deleted factory module, disk
+        full — must reach a terminal state, not churn a failed exec
+        every 30 s forever). Each failed attempt doubles the delay
+        (capped); warm start through the persistent compile cache keeps
+        the success path cheap."""
+        attempt = 0
+        while not self._stopping and \
+                self.n_restarts < self.max_respawns and \
+                attempt < self.max_respawns:
+            delay = min(self.respawn_backoff_s * (2.0 ** attempt),
+                        _RESPAWN_BACKOFF_CAP_S)
+            time.sleep(delay)
+            if self._stopping:
+                return
+            try:
+                self._spawn_once()
+            except Exception as e:  # noqa: BLE001 - retried w/ backoff
+                attempt += 1
+                _log.warning("%s: respawn attempt %d failed (%s); "
+                             "backing off", self.name, attempt, e)
+                if _telemetry_state.enabled:
+                    telemetry.record_worker_restart(self.name,
+                                                    outcome="failed")
+                continue
+            if self._stopping:
+                # stop() ran while we were spawning: this fresh child
+                # must not outlive the handle (stop()'s sweep may have
+                # already passed it by)
+                p = self.proc
+                if p is not None and p.poll() is None:
+                    p.kill()
+                    p.wait()
+                self._running = False
+                return
+            self.n_restarts += 1
+            _log.info("%s: worker respawned (pid %s, restart %d)",
+                      self.name, self.proc.pid, self.n_restarts)
+            if _telemetry_state.enabled:
+                telemetry.record_worker_restart(self.name)
+            return
+        if not self._stopping and attempt >= self.max_respawns:
+            _log.error("%s: respawn budget spent (%d failed attempts); "
+                       "giving up — the replica stays down",
+                       self.name, attempt)
+
+    # -- dispatch ------------------------------------------------------
+    def submit(self, sample, deadline_ms: Optional[float] = None
+               ) -> Future:
+        """Same contract as :meth:`Server.submit`, across the process
+        boundary. Synchronous typed raise when the worker is down (the
+        router reads that + ``is_running`` as replica death and fails
+        over); otherwise a Future that ALWAYS resolves — with the
+        worker's result/typed error, or :class:`WorkerCrashed` the
+        instant the process dies."""
+        arr = sample.asnumpy() if hasattr(sample, "asnumpy") \
+            else np.asarray(sample)
+        arr = np.ascontiguousarray(arr, dtype=self.dtype)
+        self.grid.bucket_shape(arr.shape)   # typed sync, not mid-batch
+        fut = Future()
+        with self._lock:
+            if not self._running or self._writer is None:
+                self.n_requests += 1
+                raise MXNetError(
+                    f"{self.name}: worker process is not running")
+            self._next_id += 1
+            req_id = self._next_id
+            self._futures[req_id] = fut
+            writer = self._writer
+            inc = self._incarnation
+        self.n_requests += 1
+        frame = {"kind": "submit", "id": req_id, "sample": arr}
+        if deadline_ms is not None:
+            frame["deadline_ms"] = float(deadline_ms)
+        try:
+            # coalescing writer: the caller (the router's single
+            # dispatch thread) enqueues and returns — it never blocks
+            # on this worker's socket
+            writer.send(frame)
+        except (OSError, wire.FrameError) as e:
+            self._on_down(inc, f"send failed: {e}")
+            raise MXNetError(
+                f"{self.name}: worker connection lost at submit: {e}"
+            ) from e
+        return fut
+
+    def _on_result(self, frame: dict) -> None:
+        with self._lock:
+            fut = self._futures.pop(frame["id"], None)
+        if fut is None:
+            return          # late result for a crashed-and-failed id
+        if not fut.set_running_or_notify_cancel():
+            return
+        if frame.get("ok"):
+            self.n_ok += 1
+            fut.set_result(frame.get("payload"))
+        else:
+            self.n_errors += 1
+            fut.set_exception(wire.decode_error(
+                frame.get("etype", "mxnet_error"),
+                frame.get("error", "worker error")))
+
+    # -- stop ----------------------------------------------------------
+    def stop(self, drain: bool = True,
+             timeout: Optional[float] = None) -> None:
+        """Stop the worker process: polite stop frame (honoring
+        ``drain``), bounded wait, SIGTERM -> SIGKILL escalation. Also
+        disarms the respawner — a stop is not a crash. Safe to call
+        twice and after a crash."""
+        self._stopping = True
+        budget = 30.0 if timeout is None else max(float(timeout), 0.1)
+        deadline = time.monotonic() + budget
+        with self._lock:
+            writer = self._writer
+        proc = self.proc
+        if writer is not None and self._running:
+            try:
+                writer.send({"kind": "stop", "drain": bool(drain),
+                             "timeout": budget})
+            except (OSError, wire.FrameError):
+                pass
+        if proc is not None:
+            try:
+                proc.wait(max(deadline - time.monotonic(), 0.1))
+            except subprocess.TimeoutExpired:
+                proc.terminate()
+                try:
+                    proc.wait(5)
+                except subprocess.TimeoutExpired:
+                    proc.kill()
+                    proc.wait()
+        # waitpid/reader threads observe the death and fail leftovers;
+        # do it inline too in case they already exited (double-stop)
+        with self._lock:
+            pending, self._futures = self._futures, {}
+            self._running = False
+            s2, self._sock = self._sock, None
+            w2, self._writer = self._writer, None
+        self._close_and_fail(s2, w2, pending, MXNetError(
+            f"{self.name}: worker stopped before this request "
+            "resolved"))
+        # a respawn racing this stop either aborts at its _stopping
+        # checks or kills its own fresh child; join it briefly, then
+        # sweep any process that slipped through the window — a stop()
+        # must never leak a live worker past the leak guard
+        t = self._respawner
+        if t is not None and t is not threading.current_thread() \
+                and t.is_alive():
+            t.join(5.0)
+        p = self.proc
+        if p is not None and p.poll() is None:
+            p.kill()
+            p.wait()
+        _live_workers.discard(self)
+
+    def __enter__(self) -> "RemoteReplica":
+        return self.start()
+
+    def __exit__(self, *exc) -> None:
+        self.stop(drain=not any(exc))
+
+    # -- introspection -------------------------------------------------
+    @property
+    def metrics_url(self) -> Optional[str]:
+        if self.metrics_port is None:
+            return None
+        return f"http://127.0.0.1:{self.metrics_port}/metrics"
+
+    def stats(self) -> dict:
+        with self._lock:
+            inflight = len(self._futures)
+        p = self.proc
+        return {"name": self.name, "pid": p.pid if p else None,
+                "running": self.is_running, "inflight": inflight,
+                "requests": self.n_requests, "ok": self.n_ok,
+                "errors": self.n_errors, "crashes": self.crash_count,
+                "restarts": self.n_restarts,
+                "worker_index": self.worker_index,
+                "metrics_port": self.metrics_port}

@@ -203,7 +203,7 @@ class _Replica:
     list position."""
 
     __slots__ = ("server", "index", "breaker", "inflight", "n_ok",
-                 "n_failed", "last_state", "draining")
+                 "n_failed", "last_state", "draining", "crashes_seen")
 
     def __init__(self, server: Server, index: int,
                  failure_threshold, cooldown_s):
@@ -218,6 +218,12 @@ class _Replica:
         self.last_state = CLOSED   # for transition counting
         self.draining = False      # remove_replica in progress: no new
         #                            dispatches, in-flight ones finish
+        # last RemoteReplica.crash_count this router turned into a
+        # breaker trip — seeded from the server's CURRENT count, not 0:
+        # a worker with prior crash history re-admitted via add_replica
+        # (or fronted by a new Router) must not trip its fresh breaker
+        # for crashes that predate this membership
+        self.crashes_seen = getattr(server, "crash_count", 0)
 
 
 class Router:
@@ -237,6 +243,13 @@ class Router:
     that only holds at matched buckets. ``start()`` starts replicas
     that are not already running; ``stop()`` stops every replica
     (pass ``stop_replicas=False`` to leave them serving).
+
+    A replica may be an in-process :class:`Server` or an out-of-process
+    :class:`~.remote.RemoteReplica` (same dispatch contract) — breakers,
+    hung-dispatch detection, failover and drain apply identically, and
+    a remote replica's ``crash_count`` (connection drop / ``waitpid``)
+    trips its breaker immediately: process death is unambiguous,
+    unlike a slow dispatch.
     """
 
     def __init__(self, replicas: Sequence[Server],
@@ -555,7 +568,8 @@ class Router:
                 self._replicas = self._replicas + [rep]
                 self._cond.notify_all()
         if _telemetry_state.enabled:
-            telemetry.set_fleet_size(len(self._replicas))
+            telemetry.set_fleet_size(len(self._replicas),
+                                     router=self.name)
 
     def _replica_fault_hook_for(self, server: Server):
         """Placeholder hook for the start window of an admitted-but-not-
@@ -628,7 +642,8 @@ class Router:
                 self._cond.notify_all()
             target.server._pre_dispatch = None
         if _telemetry_state.enabled:
-            telemetry.set_fleet_size(len(self._replicas))
+            telemetry.set_fleet_size(len(self._replicas),
+                                     router=self.name)
         if stop_server and target.server.is_running:
             remaining = (max(deadline - time.monotonic(), 0.1)
                          if deadline is not None else None)
@@ -714,7 +729,10 @@ class Router:
         fleet; same contract as :meth:`Server.submit`. Raises
         synchronously — :class:`ServerOverloaded` on queue-full or a
         predicted deadline miss, :class:`MXNetError` when stopped or no
-        shape bucket fits. Thread-safe."""
+        shape bucket fits. Thread-safe. When the queue is empty the
+        dispatch itself runs on this thread (never blocking on it —
+        replica submits are enqueue-and-return); a backlog is drained
+        in FIFO order by the dispatcher thread."""
         shape = getattr(sample, "shape", None)
         if shape is None:
             shape = np.asarray(sample).shape
@@ -740,11 +758,36 @@ class Router:
                     f" ms exceeds the request deadline "
                     f"{deadline_s * 1e3:.1f} ms ({pending} pending)")
             req = _RouteReq(sample, deadline_s)
-            self._queue.append(req)
-            depth = len(self._queue)
-            self._cond.notify_all()
+            # fast path: with nothing queued ahead (FIFO preserved),
+            # route on the SUBMITTING thread — decode-to-dispatch is
+            # one GIL hold with no queue hand-off and no dispatcher
+            # wake-up. On a contended interpreter the hand-off is not
+            # free: a wave of submits used to sit in the queue burning
+            # deadline while the dispatcher thread waited for its next
+            # slice (measured as head-of-line expiry through the socket
+            # ingress). The dispatcher thread still owns the backlog:
+            # anything the fast path cannot place immediately falls
+            # back to the queue it drains. Under FAULT INJECTION the
+            # fast path stands down entirely: chaos targets the
+            # dispatcher's routing loop (``serving.route`` hits burn
+            # budget there, latency faults wedge the dispatcher where
+            # the watchdog contains them) — routing on a caller thread
+            # would move the blast radius onto the client. With faults
+            # off, every surface the fast path touches
+            # (``_pick_replica``, a replica ``submit``) is
+            # enqueue-and-return by construction, so ``submit`` stays
+            # non-blocking.
+            inline = not self._queue and not _fault_state.enabled
+            if not inline:
+                self._queue.append(req)
+                depth = len(self._queue)
+                self._cond.notify_all()
+            else:
+                depth = 0
+        if inline:
+            self._route(req, inline=True)
         if _telemetry_state.enabled:
-            telemetry.set_router_queue_depth(depth)
+            telemetry.set_router_queue_depth(depth, router=self.name)
         return req.future
 
     def _shed_locked(self, reason: str) -> None:
@@ -783,7 +826,8 @@ class Router:
                     # THIS future too, not just the still-queued ones
                     self._routing = req
                     if _telemetry_state.enabled:
-                        telemetry.set_router_queue_depth(len(self._queue))
+                        telemetry.set_router_queue_depth(
+                            len(self._queue), router=self.name)
                 self._route(req)
                 self._routing = None
         except BaseException:
@@ -804,9 +848,14 @@ class Router:
             if req.resolve_exc(MXNetError(f"{self.name}: {why}")):
                 self._count_request("error", t_enqueue=req.t_enqueue)
 
-    def _route(self, req: _RouteReq) -> None:
+    def _route(self, req: _RouteReq, inline: bool = False) -> None:
         """Forward one request to the best replica, retrying admission
-        refusals briefly; requeues / resolves on terminal conditions."""
+        refusals briefly; requeues / resolves on terminal conditions.
+        ``inline=True`` = running on the SUBMITTING thread (the fast
+        path): transient can't-place-right-now conditions hand the
+        request to the dispatcher's queue instead of backing off in
+        place — a client/ingress thread must not sleep inside
+        ``submit``."""
         if req.future.done():
             return      # already resolved (watchdog / late failover)
         if not req.begin():
@@ -837,9 +886,7 @@ class Router:
             # nothing healthy admits right now: put it back and let the
             # dispatcher breathe (a breaker cooldown or an in-flight
             # completion will move things)
-            with self._cond:
-                self._queue.appendleft(req)
-                self._cond.wait(0.005)
+            self._hand_to_dispatcher(req, inline, wait_s=0.005)
             return
         r, probe = target
         flight = _Flight(req, r, time.perf_counter(), probe)
@@ -878,9 +925,7 @@ class Router:
                 # request was never dispatched)
                 if _telemetry_state.enabled:
                     telemetry.record_serving_route_retry("refused")
-                with self._cond:
-                    self._queue.appendleft(req)
-                    self._cond.wait(0.002)
+                self._hand_to_dispatcher(req, inline, wait_s=0.002)
             return
         req.attempts += 1
         flight.rfut = rfut
@@ -889,6 +934,29 @@ class Router:
                 flight.t_sent - req.t_enqueue)
         rfut.add_done_callback(
             lambda f, fl=flight: self._on_replica_done(fl, f))
+
+    def _hand_to_dispatcher(self, req: _RouteReq, inline: bool,
+                            wait_s: float) -> None:
+        """A route attempt could not place ``req`` right now (no
+        admitting replica / transient refusal). Dispatcher thread:
+        head-requeue and breathe — it owns the backoff loop. Inline
+        fast path: tail-enqueue for the dispatcher and return (the
+        submitting thread must not sleep here); if the router stopped
+        while we were routing, resolve typed instead of stranding the
+        request in a queue nobody will drain."""
+        with self._cond:
+            if not inline:
+                self._queue.appendleft(req)
+                self._cond.wait(wait_s)
+                return
+            if self._accepting:
+                self._queue.append(req)
+                self._cond.notify_all()
+                return
+        if req.resolve_exc(MXNetError(
+                f"{self.name}: router stopped before this request "
+                "was dispatched")):
+            self._count_request("rejected")
 
     def _pick_replica(self):
         """(replica, is_probe) — HALF_OPEN probes first (recovery must
@@ -937,6 +1005,24 @@ class Router:
             return                  # hung flight already failed over
         r.breaker.record_failure()
         r.n_failed += 1
+        if r.breaker.state == OPEN:
+            # the trip's collateral: every OTHER flight at this replica
+            # is sitting in its batch queue and would ride the same
+            # sick dispatch — or worse, wait out the deadline-close
+            # window first and fail over with no deadline left. Evict
+            # them through the failover path NOW, while their budgets
+            # still buy a healthy replica (their late resolutions, if
+            # the replica gets to them anyway, drop first-wins).
+            for f in self._take_flights_of(r):
+                if f.rfut is not None:
+                    f.rfut.cancel()     # spare the sick replica's queue
+                r.n_failed += 1
+                self._retry_or_fail(
+                    f.req,
+                    MXNetError(
+                        f"replica {r.server.name} circuit breaker "
+                        "opened with this request in flight"),
+                    reason="breaker_open", replica=r)
         self._retry_or_fail(flight.req, exc, reason="replica_error",
                             replica=r)
 
@@ -1051,6 +1137,16 @@ class Router:
 
     def _publish_health(self) -> None:
         for r in self._replicas:
+            # out-of-process replicas report crashes explicitly
+            # (connection drop / waitpid — see serving/remote.py): an
+            # UNAMBIGUOUS death trips the breaker immediately instead
+            # of burning a failure threshold against a corpse (crash !=
+            # slow); the respawned worker re-enters through the
+            # half-open probe like any recovered replica
+            cc = getattr(r.server, "crash_count", 0)
+            if cc > r.crashes_seen:
+                r.crashes_seen = cc
+                r.breaker.record_hang()
             state = r.breaker.state
             if state != r.last_state:
                 if _telemetry_state.enabled:
@@ -1060,6 +1156,18 @@ class Router:
             if _telemetry_state.enabled:
                 telemetry.set_replica_health(
                     r.server.name, _HEALTH_VALUE[state])
+        if _telemetry_state.enabled:
+            # the scrape-fed control plane's signal set: every gauge a
+            # remote FleetController needs rides /metrics from here
+            with self._cond:
+                depth = len(self._queue)
+                inflight = self._n_inflight
+            telemetry.set_router_queue_depth(depth, router=self.name)
+            telemetry.set_router_inflight(inflight, router=self.name)
+            telemetry.set_predicted_wait(self.predicted_wait(),
+                                         router=self.name)
+            telemetry.set_fleet_size(self.fleet_size(),
+                                     router=self.name)
 
     def _check_dispatcher(self) -> None:
         if self._wedged or not self._running:

@@ -93,6 +93,21 @@ class Server:
     however empty the batch is; under load batches close early on
     ``full``. ``deadline_ms=`` at submit overrides per request.
 
+    ``batch_timeout_ms`` caps how long the OLDEST queued request waits
+    for co-batching before its batch closes anyway (the TF-Serving
+    ``batch_timeout`` knob). ``None`` (default) keeps the legacy
+    deadline-keyed patience: the scheduler fills toward the biggest
+    bucket until ``deadline - close_margin``. That patience is optimal
+    when arrivals come in tight waves (an in-process closed loop
+    refills atomically), but an arrival stream SPREAD by a pipeline —
+    results trickling back over a socket, clients refilling one by one
+    — never quite fills the bucket, so every batch closes at the SLO
+    edge and p50 ~= SLO however light the load (measured: 100% of
+    worker batches ``deadline``-closed through the ingress). A few ms
+    here trades a few points of occupancy for an SLO-independent
+    latency floor; out-of-process workers default it on
+    (``serving.RemoteReplica(batch_timeout_ms=5)``).
+
     ``dtype``: samples are cast to it on submit. Futures resolve with
     numpy arrays (or the model's output structure with numpy leaves).
     """
@@ -101,18 +116,25 @@ class Server:
                  shape_buckets=None, slo_ms: float = 100.0,
                  close_margin_ms: float = 5.0, max_queue: int = 4096,
                  dtype: str = "float32", ctx=None, warmup: bool = True,
-                 name: Optional[str] = None):
+                 name: Optional[str] = None,
+                 batch_timeout_ms: Optional[float] = None):
         if slo_ms <= 0:
             raise MXNetError(f"slo_ms must be > 0, got {slo_ms}")
         if close_margin_ms < 0 or close_margin_ms >= slo_ms:
             raise MXNetError(
                 f"close_margin_ms must be in [0, slo_ms), got "
                 f"{close_margin_ms} (slo_ms={slo_ms})")
+        if batch_timeout_ms is not None and batch_timeout_ms <= 0:
+            raise MXNetError(
+                f"batch_timeout_ms must be > 0 (or None for the "
+                f"deadline-keyed close), got {batch_timeout_ms}")
         if max_queue < 1:
             raise MXNetError(f"max_queue must be >= 1, got {max_queue}")
         self.grid = BucketGrid(batch_buckets, shape_buckets)
         self.slo_s = slo_ms / 1e3
         self.margin_s = close_margin_ms / 1e3
+        self.batch_timeout_s = (batch_timeout_ms / 1e3
+                                if batch_timeout_ms is not None else None)
         self.max_queue = int(max_queue)
         self.dtype = dtype
         self.ctx = ctx
@@ -277,14 +299,24 @@ class Server:
                 # the head's: a short-deadline request behind a lazy head
                 # (same key: it rides this batch; different key: it is
                 # served right after) must not wait out the head's SLO
-                close_at = min(r.deadline for r in self._queue) \
+                deadline_at = min(r.deadline for r in self._queue) \
                     - self.margin_s
+                # batch timeout: the head is the oldest enqueue (submit
+                # order is FIFO even when deadline_ms overrides are not)
+                # — cap its co-batching wait independently of the SLO
+                timeout_at = (head.t_enqueue + self.batch_timeout_s
+                              if self.batch_timeout_s is not None
+                              else None)
+                close_at = deadline_at if timeout_at is None \
+                    else min(deadline_at, timeout_at)
                 if matching >= cap:
                     reason = "full"
                 elif not self._running:
                     reason = "drain"
                 elif now >= close_at:
-                    reason = "deadline"
+                    reason = ("timeout" if timeout_at is not None
+                              and timeout_at <= close_at + 1e-9
+                              and now < deadline_at else "deadline")
                 else:
                     # fill otherwise: sleep until the head's close time
                     # or the next submit, whichever is first

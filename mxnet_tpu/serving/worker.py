@@ -1,0 +1,278 @@
+"""``python -m mxnet_tpu.serving.worker`` — one replica as an OS process.
+
+The crash-isolation half of the distributed serving story (ROADMAP
+item 5, the reference's ps-lite server processes under
+``tools/launch.py``): a replica worker runs a full
+:class:`~.server.Server` in its OWN process and speaks the
+:mod:`.wire` frame protocol back to the router over one TCP
+connection. A segfault, OOM kill, or wedged XLA call here costs this
+process — the router, its ingress, and every sibling replica live in
+other address spaces and route around the corpse
+(:class:`~.remote.RemoteReplica` is the parent-side handle).
+
+Protocol (child connects BACK to the parent's listener — the parent
+owns the only well-known port, workers are ephemeral)::
+
+    child -> parent   hello  {name, pid, batch_buckets, shape_buckets,
+                              slo_ms, metrics_port}
+    parent -> child   submit {id, sample, deadline_ms}
+    child -> parent   result {id, ok, payload | etype+error}
+    child -> parent   health {age, queue_depth, requests, batches,
+                              errors}     (every --health-interval s;
+                              ``age`` is the server SCHEDULER
+                              heartbeat's age, so a wedged dispatch is
+                              visible to the router's hung-dispatch
+                              sweep across the process boundary)
+    parent -> child   stop   {drain}
+    child -> parent   bye    {}
+
+Warm start: ``Server.start()`` AOT-warms the bucket grid through the
+compilation service, and in a fresh process that routes through the
+persistent XLA disk cache + exported-StableHLO blobs
+(``MXNET_XLA_CACHE*`` env, inherited from the parent) — a respawned
+worker of a known architecture replays executables instead of
+re-tracing, which is what makes crash-respawn cheap enough to be the
+recovery path.
+
+The model comes from an importable factory (``--factory mod:fn``,
+``--path`` entries prepended to ``sys.path``, ``--factory-kwargs``
+JSON) — the same spec-not-closure contract ``tools/launch.py`` workers
+follow, because a factory cannot be shipped across an exec boundary.
+
+Orphan fencing: EOF on the parent connection stops the server and
+exits — a worker never outlives its router. ``--metrics-port`` exposes
+this process's own ``/metrics`` + ``/healthz``
+(:func:`mxnet_tpu.telemetry.start_exporter`); port 0 picks an
+ephemeral one, reported in the hello frame for scrape discovery.
+"""
+from __future__ import annotations
+
+import argparse
+import importlib
+import json
+import os
+import sys
+import threading
+import time
+
+__all__ = ["main", "load_factory"]
+
+
+def load_factory(spec: str, paths=()):
+    """Resolve ``mod:fn`` to a callable, with ``paths`` prepended to
+    ``sys.path`` first (idempotent)."""
+    from ..base import MXNetError
+
+    for p in paths:
+        p = os.path.abspath(p)
+        if p not in sys.path:
+            sys.path.insert(0, p)
+    mod_name, _, fn_name = spec.partition(":")
+    if not mod_name or not fn_name:
+        raise MXNetError(f"--factory must be module:function, got {spec!r}")
+    mod = importlib.import_module(mod_name)
+    fn = getattr(mod, fn_name, None)
+    if not callable(fn):
+        raise MXNetError(f"{spec!r} does not name a callable")
+    return fn
+
+
+def _parse_args(argv):
+    ap = argparse.ArgumentParser(
+        prog="mxnet_tpu.serving.worker",
+        description="one serving replica as a supervised OS process")
+    ap.add_argument("--connect", required=True,
+                    help="host:port of the parent's listener")
+    ap.add_argument("--factory", required=True,
+                    help="model factory as module:function")
+    ap.add_argument("--factory-kwargs", default="{}",
+                    help="JSON kwargs for the factory")
+    ap.add_argument("--path", action="append", default=[],
+                    help="prepend to sys.path before importing the "
+                         "factory (repeatable)")
+    ap.add_argument("--name", required=True)
+    ap.add_argument("--batch-buckets", required=True,
+                    help="comma-separated batch buckets, e.g. 2,4,8")
+    ap.add_argument("--shape-buckets", default="null",
+                    help="JSON list of sample-shape lists, or null")
+    ap.add_argument("--slo-ms", type=float, default=100.0)
+    ap.add_argument("--batch-timeout-ms", type=float, default=None,
+                    help="cap the oldest queued request's co-batching "
+                         "wait (ms); omit for the deadline-keyed close")
+    ap.add_argument("--dtype", default="float32")
+    ap.add_argument("--max-queue", type=int, default=4096)
+    ap.add_argument("--no-warmup", action="store_true",
+                    help="skip the AOT grid warmup (eager/test models)")
+    ap.add_argument("--health-interval", type=float, default=0.05)
+    ap.add_argument("--metrics-port", type=int, default=None,
+                    help="serve /metrics + /healthz on this port "
+                         "(0 = ephemeral); omit to disable")
+    return ap.parse_args(argv)
+
+
+def main(argv=None) -> int:
+    args = _parse_args(argv if argv is not None else sys.argv[1:])
+
+    # build BEFORE connecting back: the parent's accept timeout bounds
+    # model build + grid warmup, and a factory that cannot import must
+    # fail this process loudly, not hand the router a dead replica
+    from .. import telemetry
+    from ..base import MXNetError
+    from . import wire
+    from .server import Server
+
+    factory = load_factory(args.factory, args.path)
+    block = factory(**json.loads(args.factory_kwargs))
+    shape_buckets = json.loads(args.shape_buckets)
+    if shape_buckets is not None:
+        shape_buckets = [tuple(s) for s in shape_buckets]
+    server = Server(
+        block,
+        batch_buckets=tuple(int(b) for b in
+                            args.batch_buckets.split(",")),
+        shape_buckets=shape_buckets, slo_ms=args.slo_ms,
+        batch_timeout_ms=args.batch_timeout_ms,
+        dtype=args.dtype, max_queue=args.max_queue,
+        warmup=not args.no_warmup, name=args.name)
+    server.start()
+
+    exporter = None
+    if args.metrics_port is not None:
+        telemetry.enable()
+        exporter = telemetry.start_exporter(
+            port=args.metrics_port,
+            healthz_fn=lambda: {
+                "ok": server.is_running, "name": args.name,
+                "pid": os.getpid(), "hb_age": server.hb.age(),
+                **server.stats()})
+
+    host, port = wire.parse_hostport(args.connect)
+    sock = wire.connect(host, port, timeout=30.0)
+    sock.settimeout(None)
+    # coalescing writer: result frames from concurrent done-callbacks
+    # stream out in batched sendalls, and no callback ever blocks on
+    # the router's socket
+    writer = wire.FrameWriter(sock, name=f"{args.name}-writer")
+    send = writer.send
+
+    send({"kind": "hello", "name": args.name, "pid": os.getpid(),
+          "batch_buckets": list(server.grid.batch_buckets),
+          "shape_buckets": ([list(s) for s in server.grid.shape_buckets]
+                            if server.grid.shape_buckets else None),
+          "slo_ms": args.slo_ms,
+          "metrics_port": exporter.port if exporter else None})
+
+    stop_health = threading.Event()
+
+    def health_loop():
+        while not stop_health.wait(args.health_interval):
+            st = server.stats()
+            try:
+                send({"kind": "health", "age": server.hb.age(),
+                      "queue_depth": st["queue_depth"],
+                      "requests": st["requests"],
+                      "batches": st["batches"],
+                      "errors": st["errors"]})
+            except (OSError, wire.FrameError):
+                return          # stream unusable (parent gone or
+                #                 poisoned); reader/on_done own exit
+
+    threading.Thread(target=health_loop, name=f"{args.name}-health",
+                     daemon=True).start()
+
+    def on_done(req_id, fut):
+        try:
+            payload = fut.result()
+        except Exception as e:  # noqa: BLE001 - typed onto the wire
+            etype, msg = wire.encode_error(e)
+            frame = {"kind": "result", "id": req_id, "ok": False,
+                     "etype": etype, "error": msg}
+        else:
+            frame = {"kind": "result", "id": req_id, "ok": True,
+                     "payload": payload}
+        try:
+            send(frame)
+        except (OSError, wire.ConnectionClosed):
+            pass                # parent gone; nothing to report to
+        except wire.FrameError:
+            # unencodable model output: the writer is poisoned and
+            # this process can never answer anything again — dying
+            # LOUDLY turns it into the unambiguous crash signal the
+            # parent fails over and respawns on, instead of a zombie
+            # that reads submits forever and answers none (the
+            # hung-dispatch sweep would re-time-out every request)
+            sys.stderr.write(
+                f"{args.name}: model output not encodable for the "
+                "serving wire; exiting\n")
+            sys.stderr.flush()
+            os._exit(1)
+
+    rc = 0
+    rf = wire.reader(sock)      # buffered: streamed submits cost a
+    try:                        # fraction of a syscall each
+        while True:
+            try:
+                frame = wire.recv_frame(rf)
+            except wire.ConnectionClosed:
+                # orphan fencing: the router died — do not serve a
+                # queue nobody reads; exit and let supervision decide
+                server.stop(drain=False, timeout=10)
+                return 0
+            kind = frame["kind"]
+            if kind == "submit":
+                req_id = frame["id"]
+                try:
+                    fut = server.submit(frame["sample"],
+                                        deadline_ms=frame.get(
+                                            "deadline_ms"))
+                except Exception as e:  # noqa: BLE001 - sync refusal
+                    etype, msg = wire.encode_error(e)
+                    try:
+                        send({"kind": "result", "id": req_id,
+                              "ok": False, "etype": etype,
+                              "error": msg})
+                    except (OSError, wire.ConnectionClosed):
+                        # parent gone mid-reply: same orphan fencing
+                        # as EOF on recv, not a crash
+                        server.stop(drain=False, timeout=10)
+                        return 0
+                    continue
+                fut.add_done_callback(
+                    lambda f, i=req_id: on_done(i, f))
+            elif kind == "stop":
+                try:
+                    server.stop(drain=bool(frame.get("drain", True)),
+                                timeout=frame.get("timeout"))
+                except MXNetError:
+                    rc = 1      # wedged scheduler: report, still exit
+                try:
+                    send({"kind": "bye"})
+                except (OSError, wire.ConnectionClosed):
+                    pass        # stopping anyway; nothing to report to
+                return rc
+            elif kind == "ping":
+                try:
+                    send({"kind": "pong", "id": frame.get("id")})
+                except (OSError, wire.ConnectionClosed):
+                    server.stop(drain=False, timeout=10)
+                    return 0
+            # unknown kinds are ignored: protocol growth must not kill
+            # old workers
+    finally:
+        stop_health.set()
+        if exporter is not None:
+            exporter.stop()
+        writer.close(flush=True)    # the bye frame must reach the wire
+        try:
+            sock.close()
+        except OSError:
+            pass
+        if server.is_running:
+            try:
+                server.stop(drain=False, timeout=10)
+            except MXNetError:
+                pass
+
+
+if __name__ == "__main__":
+    sys.exit(main())

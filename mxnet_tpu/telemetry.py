@@ -46,6 +46,8 @@ __all__ = [
     "enable", "disable", "enabled", "reset",
     "counter", "gauge", "histogram",
     "dumps", "prom_text", "chrome_counter_events", "snapshot",
+    "start_exporter", "MetricsExporter",
+    "parse_prom_text", "emit_prom_text", "scrape", "prom_value",
     "record_op_dispatch", "record_cache", "record_cache_eviction",
     "record_cold_start", "record_warm_start", "record_elastic_warm",
     "record_kv",
@@ -63,6 +65,9 @@ __all__ = [
     "record_serving_route_retry", "record_router_queue_wait",
     "set_router_queue_depth", "set_replica_health",
     "record_breaker_transition", "record_router_request",
+    "record_worker_restart", "record_ingress_rejected",
+    "record_ingress_request", "set_ingress_connections",
+    "set_router_inflight", "set_predicted_wait",
     "TrainingTelemetry", "xla_cost_analysis",
     "pop_telemetry_out_flag", "write_snapshot",
     "LATENCY_BUCKETS", "STEP_BUCKETS", "SEGMENT_BUCKETS",
@@ -375,6 +380,213 @@ def prom_text() -> str:
                     f"{name}{_prom_labels(s['labels'])} "
                     f"{_fmt_float(s['value'])}")
     return "\n".join(lines) + "\n"
+
+
+# ---------------------------------------------------------------------------
+# HTTP exporter + scrape parser: the cross-process half of telemetry.
+# A process (serving worker, router host) exposes /metrics + /healthz via
+# stdlib http.server; a scraper (FleetController's ScrapeFleetSignals,
+# Prometheus itself) pulls the text format back and parses it — the only
+# signal channel that works when the observed fleet is not in the
+# observer's address space.
+# ---------------------------------------------------------------------------
+
+class MetricsExporter:
+    """Serve ``/metrics`` (Prometheus text 0.0.4 via :func:`prom_text`)
+    and ``/healthz`` (JSON; ``healthz_fn`` supplies the body) from a
+    daemon thread. ``port=0`` binds an ephemeral port — read
+    :attr:`port` after construction."""
+
+    def __init__(self, port: int = 0, host: str = "127.0.0.1",
+                 healthz_fn=None):
+        import http.server
+
+        exporter = self
+
+        class _Handler(http.server.BaseHTTPRequestHandler):
+            def do_GET(self):          # noqa: N802 - stdlib contract
+                if self.path.split("?", 1)[0] == "/metrics":
+                    body = prom_text().encode("utf-8")
+                    ctype = "text/plain; version=0.0.4; charset=utf-8"
+                elif self.path.split("?", 1)[0] == "/healthz":
+                    try:
+                        payload = (exporter.healthz_fn()
+                                   if exporter.healthz_fn else
+                                   {"ok": True, "pid": os.getpid()})
+                    except Exception as e:  # noqa: BLE001 - report it
+                        payload = {"ok": False, "error": str(e)}
+                    body = json.dumps(payload).encode("utf-8")
+                    ctype = "application/json"
+                else:
+                    self.send_error(404)
+                    return
+                self.send_response(200)
+                self.send_header("Content-Type", ctype)
+                self.send_header("Content-Length", str(len(body)))
+                self.end_headers()
+                self.wfile.write(body)
+
+            def log_message(self, *a):  # scrapes are high-rate; silence
+                pass
+
+        self.healthz_fn = healthz_fn
+        self._server = http.server.ThreadingHTTPServer(
+            (host, port), _Handler)
+        self._server.daemon_threads = True
+        self.host = host
+        self.port = self._server.server_address[1]
+        self._thread = threading.Thread(
+            target=self._server.serve_forever,
+            name=f"telemetry-exporter-{self.port}", daemon=True)
+        self._thread.start()
+
+    @property
+    def url(self) -> str:
+        return f"http://{self.host}:{self.port}/metrics"
+
+    def stop(self, timeout: Optional[float] = 5.0) -> None:
+        self._server.shutdown()
+        self._server.server_close()
+        self._thread.join(timeout)
+
+
+def start_exporter(port: int = 0, host: str = "127.0.0.1",
+                   healthz_fn=None) -> MetricsExporter:
+    """Start a :class:`MetricsExporter`; returns it (``.port``/``.url``/
+    ``.stop()``)."""
+    return MetricsExporter(port=port, host=host, healthz_fn=healthz_fn)
+
+
+def _unquote_label(s: str, i: int) -> Tuple[str, int]:
+    """Parse one double-quoted prometheus label value starting at the
+    opening quote ``s[i]``; returns (value, index past closing quote).
+    Inverse of :func:`_esc_label`: ``\\\\``, ``\\"`` and ``\\n``."""
+    if s[i] != '"':
+        raise ValueError(f"expected '\"' at col {i} of {s!r}")
+    i += 1
+    buf: List[str] = []
+    while True:
+        if i >= len(s):
+            raise ValueError(f"unterminated label value in {s!r}")
+        c = s[i]
+        if c == "\\":
+            nxt = s[i + 1] if i + 1 < len(s) else ""
+            buf.append({"\\": "\\", '"': '"', "n": "\n"}.get(nxt, nxt))
+            i += 2
+        elif c == '"':
+            return "".join(buf), i + 1
+        else:
+            buf.append(c)
+            i += 1
+
+
+def _parse_sample_line(line: str) -> Tuple[str, Dict[str, str], float]:
+    """One exposition sample line -> (sample_name, labels, value)."""
+    brace = line.find("{")
+    if brace == -1:
+        name, _, val = line.partition(" ")
+        return name, {}, float(val)
+    name = line[:brace]
+    labels: Dict[str, str] = {}
+    i = brace + 1
+    while i < len(line) and line[i] != "}":
+        eq = line.index("=", i)
+        key = line[i:eq].strip().lstrip(",").strip()
+        value, i = _unquote_label(line, eq + 1)
+        labels[key] = value
+        if i < len(line) and line[i] == ",":
+            i += 1
+    if i >= len(line) or line[i] != "}":
+        raise ValueError(f"unterminated label set in {line!r}")
+    return name, labels, float(line[i + 1:].strip())
+
+
+def parse_prom_text(text: str) -> Dict[str, Dict]:
+    """Parse Prometheus text exposition (the :func:`prom_text` format)
+    into ``{family: {"type", "help", "samples": [{"name", "labels",
+    "value"}]}}``. Histogram ``_bucket``/``_sum``/``_count`` samples are
+    attributed to their family; label-value escaping is fully reversed
+    (``\\\\`` / ``\\"`` / ``\\n``). Malformed lines raise ``ValueError``
+    — a scrape that half-parses is worse than one that fails."""
+    out: Dict[str, Dict] = {}
+
+    def family(name: str) -> Dict:
+        fam = out.get(name)
+        if fam is None:
+            fam = out[name] = {"type": None, "help": "", "samples": []}
+        return fam
+
+    for line in text.splitlines():
+        line = line.strip()
+        if not line:
+            continue
+        if line.startswith("# HELP "):
+            name, _, help_text = line[len("# HELP "):].partition(" ")
+            family(name)["help"] = help_text
+        elif line.startswith("# TYPE "):
+            name, _, kind = line[len("# TYPE "):].partition(" ")
+            family(name)["type"] = kind.strip()
+        elif line.startswith("#"):
+            continue
+        else:
+            sname, labels, value = _parse_sample_line(line)
+            fam_name = sname
+            if fam_name not in out:
+                for suffix in ("_bucket", "_sum", "_count"):
+                    if sname.endswith(suffix) and \
+                            sname[: -len(suffix)] in out:
+                        fam_name = sname[: -len(suffix)]
+                        break
+            family(fam_name)["samples"].append(
+                {"name": sname, "labels": labels, "value": value})
+    return out
+
+
+def emit_prom_text(parsed: Dict[str, Dict]) -> str:
+    """Re-emit a :func:`parse_prom_text` structure as exposition text
+    (label values re-escaped) — ``parse -> emit -> parse`` is the
+    identity, which is what makes the scrape channel trustworthy."""
+    lines: List[str] = []
+    for name in sorted(parsed):
+        fam = parsed[name]
+        if fam.get("help"):
+            lines.append(f"# HELP {name} {fam['help']}")
+        if fam.get("type"):
+            lines.append(f"# TYPE {name} {fam['type']}")
+        for s in fam["samples"]:
+            lines.append(f"{s['name']}{_prom_labels(s['labels'])} "
+                         f"{_fmt_float(s['value'])}")
+    return "\n".join(lines) + "\n"
+
+
+def scrape(url: str, timeout_s: float = 2.0) -> Dict[str, Dict]:
+    """HTTP GET ``url`` (a ``/metrics`` endpoint) and parse it. Stdlib
+    urllib; raises on HTTP/socket errors (the caller decides whether a
+    failed scrape is fatal — the autoscaler skips the tick)."""
+    import urllib.request
+
+    with urllib.request.urlopen(url, timeout=timeout_s) as resp:
+        return parse_prom_text(resp.read().decode("utf-8"))
+
+
+def prom_value(parsed: Dict[str, Dict], name: str,
+               labels: Optional[Dict[str, str]] = None,
+               default: float = 0.0) -> float:
+    """Sum of the samples named exactly ``name`` whose labels are a
+    superset of ``labels`` (counters with label dimensions scrape back
+    as one series per labelset; the controller wants the total)."""
+    fam = parsed.get(name)
+    if fam is None:
+        return default
+    want = labels or {}
+    total, hit = 0.0, False
+    for s in fam["samples"]:
+        if s["name"] != name:
+            continue
+        if all(s["labels"].get(k) == v for k, v in want.items()):
+            total += s["value"]
+            hit = True
+    return total if hit else default
 
 
 def chrome_counter_events(ts_us: Optional[float] = None) -> List[Dict]:
@@ -763,14 +975,17 @@ def record_elastic_preemption() -> None:
             "preemption signal).").inc()
 
 
-def set_fleet_size(n: int) -> None:
+def set_fleet_size(n: int, router: str = "") -> None:
     """Current serving replica count behind the Router (non-draining) —
-    the autoscaler's actuator state."""
+    the autoscaler's actuator state. Labeled by ``router``: a process
+    may host several Routers (the bench does), and a scrape-fed
+    controller must be able to tell whose fleet it is reading."""
     if not _state.enabled:
         return
     gauge("mxnet_controller_fleet_size",
           "Serving replicas currently in the Router fleet "
-          "(draining replicas excluded).").set(int(n))
+          "(draining replicas excluded).",
+          ("router",)).labels(router).set(int(n))
 
 
 def record_fleet_scale(direction: str, outcome: str = "ok") -> None:
@@ -973,13 +1188,14 @@ def record_router_queue_wait(seconds: float) -> None:
               buckets=SERVING_BUCKETS).observe(seconds)
 
 
-def set_router_queue_depth(depth: int) -> None:
-    """Requests currently waiting in the Router's global queue."""
+def set_router_queue_depth(depth: int, router: str = "") -> None:
+    """Requests currently waiting in the Router's global queue
+    (labeled per router — see :func:`set_fleet_size`)."""
     if not _state.enabled:
         return
     gauge("mxnet_serving_router_queue_depth",
-          "Requests waiting in the serving router's global queue.").set(
-              depth)
+          "Requests waiting in the serving router's global queue.",
+          ("router",)).labels(router).set(depth)
 
 
 def set_replica_health(replica: str, value: float) -> None:
@@ -999,6 +1215,86 @@ def record_breaker_transition(replica: str, to_state: str) -> None:
     counter("mxnet_serving_breaker_transitions_total",
             "Replica circuit-breaker state transitions, by target "
             "state.", ("replica", "to")).labels(replica, to_state).inc()
+
+
+def record_worker_restart(replica: str, outcome: str = "ok") -> None:
+    """One worker-process respawn by the :class:`RemoteReplica`
+    supervisor. ``outcome="ok"`` counts a successful restart
+    (``mxnet_worker_restarts_total{replica}``); ``"failed"`` counts a
+    spawn attempt that raised and re-entered backoff (a separate
+    family — a flapping spawn path must not read as recoveries)."""
+    if not _state.enabled:
+        return
+    if outcome == "ok":
+        counter("mxnet_worker_restarts_total",
+                "Successful worker-process respawns by replica.",
+                ("replica",)).labels(replica).inc()
+    else:
+        counter("mxnet_worker_respawn_failures_total",
+                "Failed worker respawn attempts by replica (retried "
+                "with exponential backoff).", ("replica",)
+                ).labels(replica).inc()
+
+
+def set_ingress_connections(state: str, n: int) -> None:
+    """Current ingress connection gauge. ``state``: ``open`` (accepted,
+    connected) or ``busy`` (with >= 1 request in flight)."""
+    if not _state.enabled:
+        return
+    gauge("mxnet_ingress_connections",
+          "Ingress connections by state (open/busy).",
+          ("state",)).labels(state).set(n)
+
+
+def record_ingress_rejected(reason: str) -> None:
+    """One request rejected at the ingress with a typed error frame.
+    ``reason``: ``window_full`` (per-connection backpressure),
+    ``overloaded`` (router admission shed), ``failover_exhausted``,
+    ``connection_limit``, ``bad_frame`` (corrupt/torn stream),
+    ``fault`` (injected ``serving.ingress`` fault), ``error``."""
+    if not _state.enabled:
+        return
+    counter("mxnet_ingress_rejected_total",
+            "Requests rejected at the ingress by reason.",
+            ("reason",)).labels(reason).inc()
+
+
+def record_ingress_request(seconds: float, outcome: str = "ok") -> None:
+    """One ingress request resolved end-to-end (frame in -> result
+    frame out). ``outcome``: ``ok``, ``error`` (typed error frame), or
+    ``undeliverable`` (resolved after the client disconnected)."""
+    if not _state.enabled:
+        return
+    counter("mxnet_ingress_requests_total",
+            "Ingress requests by outcome (ok/error/undeliverable).",
+            ("outcome",)).labels(outcome).inc()
+    histogram("mxnet_ingress_request_seconds",
+              "Ingress request latency (submit frame received to "
+              "result frame written).",
+              buckets=SERVING_BUCKETS).observe(seconds)
+
+
+def set_router_inflight(n: int, router: str = "") -> None:
+    """Requests the Router has forwarded to replicas and not yet
+    resolved — the scrape-fed utilization numerator (labeled per
+    router — see :func:`set_fleet_size`)."""
+    if not _state.enabled:
+        return
+    gauge("mxnet_serving_router_inflight",
+          "Router requests forwarded to replicas, unresolved.",
+          ("router",)).labels(router).set(n)
+
+
+def set_predicted_wait(seconds: float, router: str = "") -> None:
+    """The Router admission controller's current predicted queue wait
+    (0 when unarmed) — the scrape-fed autoscaler's scale-up signal
+    (labeled per router — see :func:`set_fleet_size`)."""
+    if not _state.enabled:
+        return
+    gauge("mxnet_serving_predicted_wait_seconds",
+          "Admission controller's predicted completion wait for a "
+          "request submitted now (0 = no estimate/unarmed).",
+          ("router",)).labels(router).set(seconds)
 
 
 def record_training_step(seconds: float, examples: float,

@@ -60,8 +60,10 @@ def serving_leak_guard():
     # FleetController AND a Router AND an unrelated standalone Server
     # must have all three stopped, or the surviving thread taxes every
     # later test — controllers first (a live one could re-scale the
-    # router mid-teardown), then routers (stopping one stops its
-    # replicas too), then servers
+    # router mid-teardown), then ingresses (the edge holds a router),
+    # then routers (stopping one stops its replicas too), then
+    # servers, then standalone worker PROCESSES (a leaked subprocess
+    # would pin its port, its model, and a whole interpreter)
     problems = []
     cmod = sys.modules.get("mxnet_tpu.serving.controller")
     if cmod is not None:
@@ -74,6 +76,19 @@ def serving_leak_guard():
             for c in leaked_controllers:
                 try:
                     c.stop(timeout=5)
+                except Exception:
+                    pass
+    imod = sys.modules.get("mxnet_tpu.serving.ingress")
+    if imod is not None:
+        leaked_ingresses = imod.live_ingresses()
+        if leaked_ingresses:
+            problems.append(
+                f"test left serving Ingress(es) bound and accepting: "
+                f"{[i.name for i in leaked_ingresses]}; call stop() in "
+                "teardown or use the context manager")
+            for i in leaked_ingresses:
+                try:
+                    i.stop(timeout=5)
                 except Exception:
                     pass
     rmod = sys.modules.get("mxnet_tpu.serving.router")
@@ -99,6 +114,24 @@ def serving_leak_guard():
                 "or use the Server context manager")
             for s in leaked:
                 s.stop(drain=False)
+    wmod = sys.modules.get("mxnet_tpu.serving.remote")
+    if wmod is not None:
+        leaked_workers = wmod.live_workers()
+        if leaked_workers:
+            problems.append(
+                f"test left worker subprocess(es) alive: "
+                f"{[(w.name, w.proc.pid if w.proc else None) for w in leaked_workers]}; "
+                "call RemoteReplica.stop() in teardown or use the "
+                "context manager")
+            for w in leaked_workers:
+                try:
+                    w.stop(drain=False, timeout=5)
+                except Exception:
+                    pass
+                p = w.proc
+                if p is not None and p.poll() is None:
+                    p.kill()        # the guard REAPS: a zombie python
+                    p.wait()        # must not outlive the test run
     if problems:
         pytest.fail("; ".join(problems))
 

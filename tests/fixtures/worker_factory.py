@@ -1,0 +1,39 @@
+"""Model factories importable by serving worker subprocesses.
+
+A :class:`~mxnet_tpu.serving.remote.RemoteReplica` ships a
+``module:function`` spec (not a closure) across the exec boundary;
+tests point workers here via ``python_paths=[tests/fixtures]``.
+Weights are seeded deterministically so a worker's responses are
+bit-identical to an in-process oracle built from the same factory.
+"""
+import numpy as np
+
+
+def tiny_net(seed=0, in_units=8, units=4):
+    """The test_serving_router make_net model, importable by spec."""
+    import mxnet_tpu as mx
+    from mxnet_tpu.gluon import nn
+
+    net = nn.Dense(units, in_units=in_units)
+    net.initialize()
+    rs = np.random.RandomState(seed)
+    net.weight.set_data(mx.nd.array(
+        rs.randn(units, in_units).astype(np.float32)))
+    net.bias.set_data(mx.nd.array(rs.randn(units).astype(np.float32)))
+    net.hybridize()
+    return net
+
+
+def paced_block(dispatch_ms=20.0):
+    """Eager block with a fixed dispatch latency — overload/backpressure
+    tests need a controlled service rate, not raw speed."""
+    import time
+
+    import mxnet_tpu as mx
+
+    class PacedBlock(mx.gluon.Block):
+        def forward(self, x):
+            time.sleep(dispatch_ms / 1e3)
+            return x * 2
+
+    return PacedBlock()

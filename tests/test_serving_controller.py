@@ -463,7 +463,11 @@ class TestFleetController:
                     router.n_shed += 1
                     ctl.tick()
             text = telemetry.prom_text()
-            assert "mxnet_controller_fleet_size 3" in text
+            # labeled per router: a multi-router process must not
+            # overwrite one shared series (the scrape-fed controller
+            # filters by this label)
+            assert 'mxnet_controller_fleet_size{router="' in text
+            assert '"} 3' in text
             assert 'mxnet_controller_scale_total{direction="up",' \
                 'outcome="ok"} 1' in text
             assert 'mxnet_controller_scale_total{direction="up",' \

@@ -351,6 +351,35 @@ class TestFailover:
         assert by_name["rep0"]["state"] == OPEN
         assert by_name["rep0"]["trips"] >= 1
 
+    def test_breaker_trip_evicts_queued_flights_promptly(self):
+        """When a replica's breaker opens, flights still sitting in its
+        BATCH QUEUE (a non-full bucket's remainder) must fail over
+        immediately — not ride the sick replica's deadline-close and
+        retry with no deadline left. A long SLO makes the stranding
+        unmistakable: without eviction the remainder serves only at
+        ~deadline-close (>= slo/2 in); with it everything resolves
+        early."""
+        # 16 requests: however many land on rep0 before its breaker
+        # trips, they ALL end up at rep1 = four FULL 4-batches (every
+        # close is bucket-full, none is deadline-keyed) — so a fast
+        # finish is only possible if the trip evicts rep0's remainder
+        xs = traffic(16)
+        refs = single_replica_reference(xs)
+        with Router(make_replicas(2, slo_ms=3000), slo_ms=3000) as router:
+            with fault.inject("serving.replica.0=every:1"):
+                t0 = time.perf_counter()
+                futs = [router.submit(x) for x in xs]
+                outs = [f.result(timeout=30) for f in futs]
+                elapsed = time.perf_counter() - t0
+            st = router.stats()
+        assert all(np.array_equal(a, b) for a, b in zip(outs, refs))
+        assert elapsed < 1.5, \
+            f"remainder flights rode the tripped replica's deadline-" \
+            f"close ({elapsed:.2f}s for a 3s SLO) instead of failing " \
+            "over at the breaker trip"
+        by_name = {r["name"]: r for r in st["replicas"]}
+        assert by_name["rep0"]["state"] == OPEN
+
     def test_hung_replica_detected_and_failed_over(self):
         xs = traffic(12)
         refs = single_replica_reference(xs)
@@ -496,16 +525,23 @@ class TestWatchdog:
             monkeypatch.setattr(
                 router, "_pick_replica",
                 lambda: (wedge.wait(30), None)[1])
-            futs = [router.submit(x) for x in traffic(3)]
-            deadline = time.time() + 10
-            while time.time() < deadline and not router.stats()["wedged"]:
-                time.sleep(0.05)
-            assert router.stats()["wedged"]
-            for f in futs:
-                with pytest.raises(MXNetError, match="watchdog"):
-                    f.result(timeout=10)
-            with pytest.raises(MXNetError, match="not running"):
-                router.submit(traffic(1)[0])
+            # any enabled fault spec makes submit's inline fast path
+            # stand down, so routing runs on the DISPATCHER — the
+            # thread this test wedges (chaos's contract: the wedge is
+            # contained by the watchdog, not exported to submitters);
+            # nth:10**6 never actually fires
+            with fault.inject("serving.route=nth:1000000"):
+                futs = [router.submit(x) for x in traffic(3)]
+                deadline = time.time() + 10
+                while time.time() < deadline \
+                        and not router.stats()["wedged"]:
+                    time.sleep(0.05)
+                assert router.stats()["wedged"]
+                for f in futs:
+                    with pytest.raises(MXNetError, match="watchdog"):
+                        f.result(timeout=10)
+                with pytest.raises(MXNetError, match="not running"):
+                    router.submit(traffic(1)[0])
         finally:
             wedge.set()             # release the dispatcher thread
             router.stop(drain=False, timeout=10)

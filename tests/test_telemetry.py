@@ -414,6 +414,93 @@ class TestExporters:
 
 
 # ---------------------------------------------------------------------------
+# scrape parser: the /metrics channel must be lossless, or the
+# cross-process control plane acts on corrupted signals
+# ---------------------------------------------------------------------------
+
+class TestScrapeParser:
+    def test_prom_text_round_trips_through_the_parser(self, tel):
+        """parse -> emit -> parse is the identity on a real payload —
+        including label values holding every escaped character ('"',
+        newline, backslash). A scrape channel that mangles one label
+        would silently mis-attribute a replica's metrics."""
+        telemetry.counter("rt_total", "labels with teeth", ("op",)) \
+            .labels('quote " backslash \\ newline \n mix \\"\n').inc(3)
+        telemetry.counter("rt_total", "labels with teeth", ("op",)) \
+            .labels("plain").inc(1)
+        telemetry.gauge("rt_gauge", "a gauge", ("k",)) \
+            .labels("\\n is two chars, \n is one").set(-2.5)
+        telemetry.histogram("rt_lat", "a histogram", ("op",),
+                            buckets=(0.1, 1.0)).labels("x").observe(0.5)
+        text = telemetry.prom_text()
+        parsed = telemetry.parse_prom_text(text)
+        emitted = telemetry.emit_prom_text(parsed)
+        assert telemetry.parse_prom_text(emitted) == parsed
+        # and the re-emitted text is still valid exposition format
+        check_prom_text(emitted)
+        # the hairy label survived BOTH trips byte-for-byte
+        hairy = 'quote " backslash \\ newline \n mix \\"\n'
+        ops = [s["labels"]["op"]
+               for s in parsed["rt_total"]["samples"]]
+        assert hairy in ops
+        assert telemetry.prom_value(parsed, "rt_total",
+                                    {"op": hairy}) == 3.0
+        assert telemetry.prom_value(
+            parsed, "rt_gauge",
+            {"k": "\\n is two chars, \n is one"}) == -2.5
+
+    def test_histogram_samples_attributed_to_family(self, tel):
+        telemetry.histogram("rt_h", "h", buckets=(0.5,)).observe(0.2)
+        parsed = telemetry.parse_prom_text(telemetry.prom_text())
+        names = {s["name"] for s in parsed["rt_h"]["samples"]}
+        assert {"rt_h_bucket", "rt_h_sum", "rt_h_count"} <= names
+        assert "rt_h_bucket" not in parsed     # no orphan family
+        assert parsed["rt_h"]["type"] == "histogram"
+
+    def test_prom_value_sums_label_series(self, tel):
+        c = telemetry.counter("rt_sum_total", "c", ("reason",))
+        c.labels("a").inc(2)
+        c.labels("b").inc(3)
+        parsed = telemetry.parse_prom_text(telemetry.prom_text())
+        assert telemetry.prom_value(parsed, "rt_sum_total") == 5.0
+        assert telemetry.prom_value(parsed, "rt_sum_total",
+                                    {"reason": "b"}) == 3.0
+        assert telemetry.prom_value(parsed, "rt_sum_total",
+                                    {"reason": "nope"},
+                                    default=-1.0) == -1.0
+        assert telemetry.prom_value(parsed, "rt_missing",
+                                    default=7.0) == 7.0
+
+    def test_malformed_lines_raise(self, tel):
+        for bad in ('rt{op="unterminated 1',
+                    'rt{op="v"',
+                    "rt notafloat"):
+            with pytest.raises(ValueError):
+                telemetry.parse_prom_text(bad)
+
+    def test_exporter_serves_metrics_and_healthz(self, tel):
+        import urllib.error
+        import urllib.request
+
+        telemetry.counter("rt_exp_total", "c").inc(4)
+        exp = telemetry.start_exporter(
+            healthz_fn=lambda: {"ok": True, "who": "test"})
+        try:
+            parsed = telemetry.scrape(exp.url)
+            assert telemetry.prom_value(parsed, "rt_exp_total") == 4.0
+            with urllib.request.urlopen(
+                    f"http://127.0.0.1:{exp.port}/healthz",
+                    timeout=5) as resp:
+                hz = json.loads(resp.read())
+            assert hz == {"ok": True, "who": "test"}
+            with pytest.raises(urllib.error.HTTPError):
+                urllib.request.urlopen(
+                    f"http://127.0.0.1:{exp.port}/nope", timeout=5)
+        finally:
+            exp.stop()
+
+
+# ---------------------------------------------------------------------------
 # training-step observability
 # ---------------------------------------------------------------------------
 

@@ -47,6 +47,19 @@ single-replica version oracle, and a poisoned build — the
 swapped — must roll the whole fleet back automatically with at least
 N-1 replicas healthy throughout.
 
+The WORKER gate (out-of-process serving) is the SERVING gate's contract
+against a REAL process death: a 2-worker fleet of ``RemoteReplica``
+subprocesses serves paced traffic through the socket ``Ingress``, one
+worker is SIGKILLed (-9) mid-traffic — 100% of client requests must
+resolve (result or typed error frame), survivor responses must be
+bit-identical to an in-process oracle at matched buckets, the dead
+worker's breaker must trip and its RESPAWNED process be re-admitted
+via half-open probe, and the router/ingress process itself must never
+die. A second phase closes the scrape-fed loop: a ``FleetController``
+whose only signal channel is ``/metrics`` scrapes must grow the
+multi-process fleet under synthetic pressure and shrink it back after
+the hold window.
+
   python tools/chaos_check.py                 # default spec/steps
   python tools/chaos_check.py --steps 40 --seed 11 \
       --spec 'kvstore.push=every:7;kvstore.allreduce=p:0.1' \
@@ -868,6 +881,237 @@ def upgrade_gate(summary):
         router.stop(drain=False, timeout=30)
 
 
+# ---------------------------------------------------------------------------
+# worker gate: SIGKILL an out-of-process replica WORKER under paced
+# traffic flowing through the socket ingress — the crash-isolation
+# contract. Every client request resolves (result or typed error),
+# survivors stay bit-identical to the oracle, the dead worker's breaker
+# trips and its respawn is re-admitted via half-open probe, and the
+# router/ingress process itself never dies. A second phase closes the
+# scrape-fed loop: a FleetController whose ONLY signal channel is
+# /metrics scrapes grows the multi-process fleet under synthetic
+# pressure and shrinks it back after the hold window.
+# ---------------------------------------------------------------------------
+
+def worker_gate(summary):
+    """Crash-isolated worker fleet under ingress traffic + scrape-fed
+    scaling. Two gates written to ``summary``:
+    ``worker_crash_isolation_zero_lost`` and
+    ``scrape_fed_scale_multiprocess``."""
+    import signal as _signal
+    import time as _time
+
+    import numpy as np
+
+    from mxnet_tpu import serving
+    from mxnet_tpu.base import MXNetError
+
+    os.environ["MXNET_COMM_RETRY_DELAY"] = "0.01"
+    os.environ["MXNET_SERVING_BREAKER_FAILURES"] = "2"
+    os.environ["MXNET_SERVING_BREAKER_COOLDOWN"] = "0.4"
+
+    tools_dir = os.path.dirname(os.path.abspath(__file__))
+    grid = dict(batch_buckets=(2, 4), shape_buckets=[(32,)],
+                slo_ms=SERVING_SLO_MS)
+    samples = [np.random.RandomState(2000 + i).randn(32).astype(np.float32)
+               for i in range(32)]
+
+    # single-replica in-process oracle: the bit-identity reference the
+    # worker PROCESSES must match at the same buckets
+    ref_srv = serving.Server(_serving_net(), name="oracle", **grid)
+    ref_srv.start()
+    refs = [ref_srv.submit(x).result(timeout=120) for x in samples]
+    ref_srv.stop()
+
+    def make_worker(i):
+        return serving.RemoteReplica(
+            "chaos_check:_serving_net", name=f"w{i}",
+            python_paths=[tools_dir], respawn_backoff_s=0.3,
+            spawn_timeout_s=300, **grid)
+
+    workers = [make_worker(i) for i in range(2)]
+    router = serving.Router(workers, slo_ms=SERVING_SLO_MS,
+                            dispatch_timeout_s=2.0)
+    t_spawn0 = _time.time()
+    router.start()          # spawns both worker processes, warm + hello
+    print(f"[chaos] worker: 2 worker processes up in "
+          f"{_time.time() - t_spawn0:.1f}s (pids "
+          f"{[w.proc.pid for w in workers]})")
+    ing = serving.Ingress(router, window=64)
+    ing.start()
+    cli = serving.IngressClient("127.0.0.1", ing.port)
+    checks = {}
+    records = []            # (sample_idx, future)
+
+    def submit_phase(n, pace_s=0.004):
+        for i in range(n):
+            idx = i % len(samples)
+            records.append((idx, cli.submit(samples[idx])))
+            _time.sleep(pace_s)
+
+    try:
+        # -- gate 8: kill one worker PROCESS mid-traffic ---------------
+        submit_phase(40)                    # clean window
+        victim_pid = workers[0].proc.pid
+        submit_phase(10)
+        os.kill(victim_pid, _signal.SIGKILL)
+        submit_phase(70)                    # the kill + failover window
+        # recovery: trickle traffic until the respawned worker is
+        # re-admitted (breaker CLOSED again and serving)
+        readmitted = False
+        w0_ok_at_kill = {r["name"]: r for r in
+                         router.stats()["replicas"]}["w0"]["ok"]
+        deadline = _time.time() + 90
+        while _time.time() < deadline:
+            submit_phase(8)
+            st = {r["name"]: r for r in router.stats()["replicas"]}
+            if st["w0"]["state"] == "closed" and \
+                    st["w0"]["ok"] > w0_ok_at_kill and \
+                    workers[0].n_restarts >= 1:
+                readmitted = True
+                break
+            _time.sleep(0.1)
+
+        n_ok = n_typed = n_lost = n_bits_bad = 0
+        for idx, fut in records:
+            try:
+                out = fut.result(timeout=60)
+            except MXNetError:
+                n_typed += 1                # typed = resolved
+                continue
+            except Exception:   # noqa: BLE001 - untyped = lost
+                n_lost += 1
+                continue
+            n_ok += 1
+            if not np.array_equal(out, refs[idx]):
+                n_bits_bad += 1
+        undone = sum(1 for _i, f in records if not f.done())
+        by_name = {r["name"]: r for r in router.stats()["replicas"]}
+        edge_alive = router.is_running and ing.is_running
+        final_ok = False
+        if edge_alive:
+            try:
+                final_ok = np.array_equal(
+                    cli.submit(samples[0]).result(timeout=60), refs[0])
+            except MXNetError:
+                final_ok = False
+
+        checks["worker_process_killed"] = \
+            workers[0].crash_count >= 1 and \
+            workers[0].proc.pid != victim_pid
+        checks["zero_lost_futures"] = n_lost == 0 and undone == 0
+        checks["all_resolutions_typed"] = n_typed + n_ok == len(records)
+        checks["survivor_bit_identical"] = n_bits_bad == 0 and n_ok > 0
+        checks["worker_breaker_tripped"] = by_name["w0"]["trips"] >= 1
+        checks["respawn_readmitted_by_probe"] = readmitted
+        checks["router_ingress_survived"] = edge_alive and final_ok
+        ok = all(checks.values())
+        summary["gates"]["worker_crash_isolation_zero_lost"] = {
+            "pass": ok, "checks": checks,
+            "requests": len(records), "ok": n_ok,
+            "typed_errors": n_typed, "lost": n_lost + undone,
+            "victim_pid": victim_pid,
+            "respawned_pid": workers[0].proc.pid,
+            "worker_restarts": workers[0].n_restarts,
+            "w0_trips": by_name["w0"]["trips"]}
+        print(f"[chaos] worker: {len(records)} requests, {n_ok} ok, "
+              f"{n_typed} typed errors, {n_lost + undone} lost; "
+              f"victim pid {victim_pid} -> respawned "
+              f"{workers[0].proc.pid}")
+        for name, v in checks.items():
+            print(f"[chaos]   worker {name}: {v}")
+
+        # -- scrape-fed scaling of the SAME multi-process fleet --------
+        ok = _scrape_scale_phase(summary, router, make_worker,
+                                 _time) and ok
+        return ok
+    finally:
+        cli.close()
+        ing.stop()
+        router.stop(drain=False, timeout=60)
+
+
+def _scrape_scale_phase(summary, router, make_worker, _time):
+    """The controller's only view of the fleet is /metrics scrapes of
+    the router host's exporter — no shared memory with the workers it
+    scales. Synthetic pressure (the admission controller's predicted
+    wait, published as a gauge) must grow the fleet by one REAL worker
+    process; quiet must shrink it back after the hold window."""
+    from mxnet_tpu import serving, telemetry
+
+    telemetry.enable()
+    exporter = telemetry.start_exporter()
+    checks = {}
+    n0 = router.fleet_size()
+    counter = [n0]
+
+    def factory(i):
+        counter[0] += 1
+        return make_worker(counter[0] + 100)   # unique names
+
+    src = serving.ScrapeFleetSignals(
+        exporter.url, slo_s=router.slo_s,
+        max_batch=router.grid.max_batch)
+    policy = serving.ScalePolicy(
+        n0, n0 + 1, up_cooldown_s=0.1, down_utilization=0.5,
+        down_hold_s=1.0, down_cooldown_s=0.1)
+    ctl = serving.FleetController(router, factory, policy=policy,
+                                  signals_source=src)
+    real_predicted_wait = router.predicted_wait
+    try:
+        deadline = _time.time() + 30
+        while src() is None and _time.time() < deadline:
+            _time.sleep(0.1)    # router monitor publishes its gauges
+        router.predicted_wait = lambda: 10.0    # synthetic pressure
+        scaled_up = False
+        t0 = _time.time()
+        deadline = _time.time() + 120
+        while _time.time() < deadline:
+            if ctl.tick() == "up":
+                scaled_up = True
+                break
+            _time.sleep(0.1)
+        t_up = _time.time() - t0
+        new = router.fleet_size() - n0
+        new_worker_is_process = False
+        if scaled_up:
+            from mxnet_tpu.serving import remote as _remote
+            pids = {w.proc.pid for w in _remote.live_workers()}
+            new_worker_is_process = len(pids) >= n0 + 1
+        router.predicted_wait = real_predicted_wait     # quiet again
+        scaled_down = False
+        t1 = _time.time()
+        deadline = _time.time() + 60
+        while _time.time() < deadline:
+            if ctl.tick() == "down":
+                scaled_down = True
+                break
+            _time.sleep(0.1)
+        held = _time.time() - t1
+        checks["scaled_up_from_scrape"] = scaled_up and new == 1
+        checks["new_replica_is_worker_process"] = new_worker_is_process
+        checks["scaled_down_after_hold"] = \
+            scaled_down and router.fleet_size() == n0
+        checks["hold_window_respected"] = held >= 0.9
+        ok = all(checks.values())
+        summary["gates"]["scrape_fed_scale_multiprocess"] = {
+            "pass": ok, "checks": checks,
+            "fleet_before": n0, "scrapes": src.n_scrapes,
+            "scrape_failures": src.n_failures,
+            "scale_up_s": round(t_up, 2), "hold_s": round(held, 2)}
+        print(f"[chaos] scrape-scale: {n0} -> {n0 + new} -> "
+              f"{router.fleet_size()} workers via {src.n_scrapes} "
+              f"scrapes (up in {t_up:.1f}s, held {held:.1f}s)")
+        for name, v in checks.items():
+            print(f"[chaos]   scrape-scale {name}: {v}")
+        return ok
+    finally:
+        router.predicted_wait = real_predicted_wait
+        if ctl.is_running:
+            ctl.stop()
+        exporter.stop()
+
+
 def main():
     ap = argparse.ArgumentParser(description=__doc__.split("\n")[0])
     ap.add_argument("--steps", type=int, default=24)
@@ -888,6 +1132,10 @@ def main():
     ap.add_argument("--skip-upgrade", action="store_true",
                     help="skip the rolling-upgrade gate (3-replica "
                     "fleet under traffic, poisoned-build rollback)")
+    ap.add_argument("--skip-worker", action="store_true",
+                    help="skip the out-of-process worker gate (SIGKILL "
+                    "a replica worker process under ingress traffic + "
+                    "scrape-fed fleet scaling)")
     args = ap.parse_args()
 
     import numpy as np
@@ -972,6 +1220,11 @@ def main():
     # -- gate 7: rolling upgrade under traffic, poisoned-build rollback -
     if not args.skip_upgrade:
         ok = upgrade_gate(summary) and ok
+
+    # -- gate 8: SIGKILL an out-of-process worker under ingress traffic,
+    #    then scrape-fed scaling of the multi-process fleet ------------
+    if not args.skip_worker:
+        ok = worker_gate(summary) and ok
 
     retry_counters = {}
     for s in telemetry.snapshot()["metrics"].get(
