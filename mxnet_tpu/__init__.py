@@ -84,6 +84,7 @@ from . import module as mod
 from . import parallel
 from . import symbol
 from . import symbol as sym
+from . import mutation
 from . import tracing
 from . import telemetry
 from . import compiler

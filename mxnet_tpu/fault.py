@@ -331,10 +331,16 @@ def check(site: str, detail: str = "") -> None:
     action, n = pol.hit()
     if action == "pass":
         return
-    from . import telemetry
+    from . import telemetry, tracing
 
     if telemetry._state.enabled:
         telemetry.record_fault_injected(site)
+    if tracing._state.enabled:
+        # annotate the live span (if any request trace is ambient on
+        # this thread): the injected fault becomes part of the story
+        # the dumped trace tells
+        tracing.note(f"fault injected: {site}"
+                     + (f" ({detail})" if detail else ""))
     if action == "sleep":
         time.sleep(pol.arg)
         return
@@ -394,15 +400,19 @@ def retry_call(site: str, fn, detail: str = "",
         raise MXNetError(f"retry attempts must be >= 1, got {attempts}")
     if base_delay is None:
         base_delay = float(os.environ.get("MXNET_COMM_RETRY_DELAY", "0.05"))
-    from . import telemetry
+    from . import telemetry, tracing
 
     attempt = 1
     while True:
         if telemetry._state.enabled:
             telemetry.record_retry(site, "retry")
+        if tracing._state.enabled:
+            tracing.note(f"retry {attempt}/{attempts} at {site}: {last}")
         if attempt >= attempts:
             if telemetry._state.enabled:
                 telemetry.record_retry(site, "exhausted")
+            if tracing._state.enabled:
+                tracing.note(f"retries exhausted at {site}")
             extra = f" ({detail})" if detail else ""
             raise MXNetError(
                 f"{site}{extra} failed after {attempts} attempt(s); "
@@ -422,6 +432,8 @@ def retry_call(site: str, fn, detail: str = "",
             continue
         if telemetry._state.enabled:
             telemetry.record_retry(site, "recovered")
+        if tracing._state.enabled:
+            tracing.note(f"recovered at {site} on attempt {attempt}")
         return result
 
 

@@ -14,7 +14,7 @@ and op bulking. Here ``hybridize()`` wraps the block's forward in ONE
 * parameters enter as executable inputs so autograd can differentiate the
   whole fused step via one ``jax.vjp``;
 * in-place aux-state writes during the trace (BatchNorm moving stats) are
-  captured by ``mxnet_tpu.tracing`` and returned as extra outputs, then
+  captured by ``mxnet_tpu.mutation`` and returned as extra outputs, then
   written back — the functional re-design of MXNet's mutable aux states;
 * random ops draw from a per-call PRNG key input, so one compiled
   executable yields fresh dropout masks per step with zero recompiles.
@@ -27,7 +27,7 @@ import threading
 from collections import OrderedDict
 from typing import List, Optional
 
-from .. import autograd, engine, random_state, tracing
+from .. import autograd, engine, mutation, random_state
 from ..base import MXNetError, name_manager
 from ..context import Context, cpu, current_context
 from ..ndarray import NDArray
@@ -396,7 +396,7 @@ def make_pure_fn(block, param_arrays, ctx, training):
     Returns ``(pure, cell)`` where ``pure(param_vals, rng, *input_vals) ->
     (out_vals, aux_vals)`` is jax-traceable and ``cell`` carries the output
     treedef plus the aux-state NDArrays mutated during the trace (BatchNorm
-    moving stats etc. — see mxnet_tpu.tracing). This is the single lowering
+    moving stats etc. — see mxnet_tpu.mutation). This is the single lowering
     seam shared by CachedOp (hybridize) and the sharded train step
     (mxnet_tpu.parallel.step); reference: src/imperative/cached_op.cc.
     """
@@ -405,7 +405,7 @@ def make_pure_fn(block, param_arrays, ctx, training):
         prev_rec = autograd.set_recording(False)
         prev_train = autograd.set_training(training)
         olds = [arr._data for arr in param_arrays]
-        with tracing.mutation_scope() as log:
+        with mutation.mutation_scope() as log:
             with random_state.scoped_key(rng):
                 try:
                     for arr, v in zip(param_arrays, param_vals):
@@ -752,7 +752,7 @@ class HybridBlock(Block):
             # optimize_for swapped in a backend-transformed graph
             return opt(*args)
         if self._active and args and isinstance(args[0], NDArray) \
-                and not tracing.is_tracing():
+                and not mutation.is_tracing():
             if self._cached_graph is None:
                 self._cached_graph = _CachedGraph(self, self._flags)
             try:

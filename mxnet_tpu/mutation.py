@@ -1,0 +1,51 @@
+"""Functional-mutation capture for traced (hybridized) execution.
+
+Reference problem: MXNet ops mutate state in place during forward —
+BatchNorm moving stats (aux states), RNG state — and CachedOp simply
+re-executes those mutations imperatively
+(``src/imperative/cached_op.cc :: CachedOp::Forward``).
+
+Under XLA everything inside a jit trace is pure, so in-place writes of
+traced values must become *extra outputs* of the compiled function. While a
+hybridize trace is active, ``NDArray._set_data`` routes tracer writes here;
+the CachedGraph returns the logged values as additional outputs and writes
+the concrete results back after execution. This is the TPU-native
+re-design of MXNet's aux-state mutation contract.
+"""
+from __future__ import annotations
+
+import contextlib
+import threading
+from typing import List
+
+_state = threading.local()
+
+
+class MutationLog:
+    def __init__(self):
+        self.arrays: List = []  # NDArray objects, in first-write order
+        # (arr, payload-before-first-traced-write) pairs; parallel to arrays
+        self.originals: List = []
+
+    def log(self, arr) -> None:
+        if not any(a is arr for a in self.arrays):
+            self.arrays.append(arr)
+            self.originals.append((arr, arr._data))
+
+
+def active_log():
+    return getattr(_state, "log", None)
+
+
+def is_tracing() -> bool:
+    return getattr(_state, "log", None) is not None
+
+
+@contextlib.contextmanager
+def mutation_scope():
+    prev = getattr(_state, "log", None)
+    _state.log = MutationLog()
+    try:
+        yield _state.log
+    finally:
+        _state.log = prev

@@ -117,15 +117,15 @@ class NDArray:
 
     def _set_data(self, new_jax) -> None:
         """Functionally replace the payload (an in-place write in API terms)."""
-        from .. import tracing
+        from .. import mutation
 
-        log = tracing.active_log()
+        log = mutation.active_log()
         if log is not None:
             import jax as _jax
 
             if isinstance(new_jax, _jax.core.Tracer) or isinstance(self._data, _jax.core.Tracer):
                 # traced (hybridized) execution: record so the compiled graph
-                # returns this as an extra output (see tracing.py). Views
+                # returns this as an extra output (see mutation.py). Views
                 # write through to their base so base reads stay coherent
                 # within the trace; the BASE is what gets logged/returned.
                 if self._base is not None:

@@ -25,7 +25,7 @@ from __future__ import annotations
 from typing import Dict, List, Optional, Sequence
 
 from .. import optimizer as opt_mod
-from .. import random_state, tracing
+from .. import mutation, random_state
 from ..base import MXNetError
 from ..context import current_context
 from ..ndarray import NDArray
@@ -457,7 +457,7 @@ class TrainStep:
             new_params = list(param_vals)
             new_state_vals = list(state_vals)
             with optimizer.dynamic(t, lr):
-                with tracing.mutation_scope():
+                with mutation.mutation_scope():
                     fused_items = []      # (k, w, g, leaves)
                     fused_slots = {}      # k -> (i, [state_val idx])
                     pos = 0

@@ -242,6 +242,12 @@ def _dumps_chrome_trace(reset=False):
                 _pause_started = now
     # merge telemetry's counter series onto the same timeline
     events.extend(telemetry.chrome_counter_events())
+    # ... and the request-tracing spans (serving traces + flow-linked
+    # batch spans + flight-recorder instants) when tracing is on
+    from . import tracing as _req_tracing
+
+    if _req_tracing.enabled():
+        events.extend(_req_tracing.chrome_trace_events())
     doc = {"traceEvents": events, "displayTimeUnit": "ms",
            "otherData": {"excluded_paused_ms": paused * 1e3}}
     if _trace_dir:

@@ -55,10 +55,11 @@ import weakref
 from concurrent.futures import Future
 from typing import Optional
 
-from .. import fault, telemetry
+from .. import fault, telemetry, tracing
 from ..base import MXNetError
 from ..fault import _state as _fault_state
 from ..telemetry import _state as _telemetry_state
+from ..tracing import _state as _tracing_state
 from . import wire
 
 __all__ = ["Ingress", "IngressClient", "IngressDisconnected",
@@ -313,24 +314,50 @@ class Ingress:
                     etype="overloaded")
                 return
             conn.inflight += 1
+        tr = None
+        if _tracing_state.enabled:
+            # adopt the client's context from the frame header (absent
+            # or malformed = mint fresh — a bad peer degrades to a
+            # server-side-only trace, never a crash)
+            tr = tracing.adopt(frame.get("trace"), ingress=self.name)
+            if tr is None:
+                tr = tracing.new_trace("request", ingress=self.name)
+            # ingress.decode: frame-in to router-admission (codec +
+            # fault site + window check) — latency_report's framing leg
+            dsp = tr.begin("ingress.decode", ingress=self.name)
+            # backdate to frame receipt: t0 was stamped before the
+            # fault site and window check this span accounts for
+            dsp.ts -= int((time.perf_counter() - t0) * 1e6)
+            dsp.end()
         try:
-            fut = self.router.submit(frame["sample"],
-                                     deadline_ms=frame.get("deadline_ms"))
+            if tr is not None:
+                with tracing.active(tr, tr.root or tr.remote_parent):
+                    fut = self.router.submit(
+                        frame["sample"],
+                        deadline_ms=frame.get("deadline_ms"))
+            else:
+                fut = self.router.submit(
+                    frame["sample"], deadline_ms=frame.get("deadline_ms"))
         except Exception as e:  # noqa: BLE001 - typed onto the wire
             with conn.lock:
                 conn.inflight -= 1
             etype, _msg = wire.encode_error(e)
             reason = etype if etype in ("overloaded",
                                         "failover_exhausted") else "error"
+            if tr is not None:
+                tr.finish(reason)
             self._reject(conn, req_id, reason, e, etype=etype)
             return
         self._publish_conn_gauges()
         fut.add_done_callback(
-            lambda f, c=conn, i=req_id, t=t0: self._on_done(c, i, f, t))
+            lambda f, c=conn, i=req_id, t=t0, r=tr:
+            self._on_done(c, i, f, t, r))
 
-    def _on_done(self, conn: _Conn, req_id, fut, t0: float) -> None:
+    def _on_done(self, conn: _Conn, req_id, fut, t0: float,
+                 tr=None) -> None:
         with conn.lock:
             conn.inflight -= 1
+        rts = tracing.now_us() if tr is not None else 0
         try:
             payload = fut.result()
         except Exception as e:  # noqa: BLE001 - typed onto the wire
@@ -338,12 +365,23 @@ class Ingress:
             delivered = conn.send({"kind": "result", "id": req_id,
                                    "ok": False, "etype": etype,
                                    "error": msg})
-            self._count_request("error", t0)
+            if tr is not None:
+                tr.add_raw("ingress.reply", ts=rts,
+                           dur=tracing.now_us() - rts, etype=etype)
+                tr.finish(type(e).__name__)
+            self._count_request("error", t0, trace_id=(
+                tr.trace_id if tr is not None else None))
         else:
             delivered = conn.send({"kind": "result", "id": req_id,
                                    "ok": True, "payload": payload})
+            if tr is not None:
+                tr.add_raw("ingress.reply", ts=rts,
+                           dur=tracing.now_us() - rts)
+                tr.finish("ok" if delivered else "undeliverable")
             self._count_request("ok" if delivered else "undeliverable",
-                                t0)
+                                t0, trace_id=(
+                                    tr.trace_id if tr is not None
+                                    else None))
         self._publish_conn_gauges()
 
     # -- counters ------------------------------------------------------
@@ -360,11 +398,12 @@ class Ingress:
         if _telemetry_state.enabled:
             telemetry.record_ingress_rejected(reason)
 
-    def _count_request(self, outcome: str, t0: float) -> None:
+    def _count_request(self, outcome: str, t0: float,
+                       trace_id: Optional[str] = None) -> None:
         self.n_requests += 1
         if _telemetry_state.enabled:
             telemetry.record_ingress_request(
-                time.perf_counter() - t0, outcome)
+                time.perf_counter() - t0, outcome, trace_id=trace_id)
 
     def _publish_conn_gauges(self, force: bool = False) -> None:
         if not _telemetry_state.enabled:
@@ -440,6 +479,12 @@ class IngressClient:
         frame = {"kind": "submit", "id": req_id, "sample": sample}
         if deadline_ms is not None:
             frame["deadline_ms"] = float(deadline_ms)
+        if _tracing_state.enabled:
+            # propagate the caller's ambient trace context across the
+            # socket (absent field = untraced; old servers ignore it)
+            amb = tracing.ambient()
+            if amb is not None:
+                frame["trace"] = amb[0].wire(amb[1])
         try:
             self._writer.send(frame)
         except (OSError, wire.FrameError) as e:

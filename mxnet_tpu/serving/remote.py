@@ -65,10 +65,11 @@ from typing import Optional, Sequence
 
 import numpy as np
 
-from .. import fault, telemetry
+from .. import fault, telemetry, tracing
 from ..base import MXNetError
 from ..fault import _state as _fault_state
 from ..telemetry import _state as _telemetry_state
+from ..tracing import _state as _tracing_state
 from . import wire
 from .buckets import BucketGrid
 from .health import Heartbeat, _env_float
@@ -188,6 +189,7 @@ class RemoteReplica:
         self._writer: Optional[wire.FrameWriter] = None
         self._lock = threading.Lock()
         self._futures: dict = {}      # id -> Future
+        self._traces: dict = {}       # id -> Trace (tracing on only)
         self._next_id = 0
         self._incarnation = 0         # bumps per successful spawn
         self._down_handled = -1       # last incarnation whose death ran
@@ -396,9 +398,14 @@ class RemoteReplica:
             self._down_handled = inc
             self._running = False
             pending, self._futures = self._futures, {}
+            ptraces, self._traces = self._traces, {}
             sock, self._sock = self._sock, None
             writer, self._writer = self._writer, None
             stopping = self._stopping
+        for tr in ptraces.values():
+            # annotate BEFORE the futures fail: the finish-callbacks
+            # seal these traces, and the crash is the explanation
+            tr.note(f"worker {self.name} crashed: {why}")
         self._close_and_fail(sock, writer, pending, WorkerCrashed(
             f"worker {self.name}: {why}; "
             f"{len(pending)} request(s) were in flight"))
@@ -406,6 +413,9 @@ class RemoteReplica:
             return
         self.crash_count += 1
         self.n_errors += len(pending)
+        if _tracing_state.enabled:
+            tracing.record_event("crash", replica=self.name, why=why,
+                                 inflight=len(pending))
         if self.respawn and self.n_restarts < self.max_respawns:
             t = threading.Thread(target=self._respawn_loop,
                                  name=f"{self.name}-respawn",
@@ -439,6 +449,10 @@ class RemoteReplica:
                 if _telemetry_state.enabled:
                     telemetry.record_worker_restart(self.name,
                                                     outcome="failed")
+                if _tracing_state.enabled:
+                    tracing.record_event("respawn", replica=self.name,
+                                         outcome="failed",
+                                         attempt=attempt)
                 continue
             if self._stopping:
                 # stop() ran while we were spawning: this fresh child
@@ -455,6 +469,10 @@ class RemoteReplica:
                       self.name, self.proc.pid, self.n_restarts)
             if _telemetry_state.enabled:
                 telemetry.record_worker_restart(self.name)
+            if _tracing_state.enabled:
+                tracing.record_event("respawn", replica=self.name,
+                                     outcome="ok",
+                                     restarts=self.n_restarts)
             return
         if not self._stopping and attempt >= self.max_respawns:
             _log.error("%s: respawn budget spent (%d failed attempts); "
@@ -489,6 +507,14 @@ class RemoteReplica:
         frame = {"kind": "submit", "id": req_id, "sample": arr}
         if deadline_ms is not None:
             frame["deadline_ms"] = float(deadline_ms)
+        if _tracing_state.enabled:
+            # ship the ambient span context in the frame header — the
+            # worker adopts it, and its spans ride the result frame back
+            amb = tracing.ambient()
+            if amb is not None:
+                frame["trace"] = amb[0].wire(amb[1])
+                with self._lock:
+                    self._traces[req_id] = amb[0]
         try:
             # coalescing writer: the caller (the router's single
             # dispatch thread) enqueues and returns — it never blocks
@@ -504,8 +530,20 @@ class RemoteReplica:
     def _on_result(self, frame: dict) -> None:
         with self._lock:
             fut = self._futures.pop(frame["id"], None)
+            tr = self._traces.pop(frame["id"], None)
         if fut is None:
             return          # late result for a crashed-and-failed id
+        if tr is not None:
+            # adopt the worker's piggybacked spans BEFORE resolving the
+            # future: finish-callbacks seal the trace at resolution.
+            # trace_ts = the worker's send timestamp (same-host wall
+            # clock) -> the wire.return span is the socket leg home.
+            tr.merge(frame.get("spans"))
+            sent = frame.get("trace_ts")
+            if isinstance(sent, (int, float)):
+                tr.add_raw("wire.return", ts=int(sent),
+                           dur=tracing.now_us() - int(sent),
+                           replica=self.name)
         if not fut.set_running_or_notify_cancel():
             return
         if frame.get("ok"):

@@ -78,10 +78,11 @@ from typing import List, Optional, Sequence
 
 import numpy as np
 
-from .. import fault, telemetry
+from .. import fault, telemetry, tracing
 from ..base import MXNetError
 from ..fault import _state as _fault_state
 from ..telemetry import _state as _telemetry_state
+from ..tracing import _state as _tracing_state
 from .health import (
     CLOSED,
     HALF_OPEN,
@@ -136,7 +137,7 @@ class _RouteReq:
     result may race) and always leave the future resolved."""
 
     __slots__ = ("sample", "future", "t_enqueue", "deadline", "attempts",
-                 "started", "_lock")
+                 "started", "_lock", "trace", "span", "own_trace")
 
     def __init__(self, sample, deadline_s: float):
         self.sample = sample
@@ -146,6 +147,12 @@ class _RouteReq:
         self.attempts = 0          # dispatch attempts so far
         self.started = False       # set_running_or_notify_cancel done
         self._lock = threading.Lock()
+        # tracing (MXNET_TRACING=1): the request's Trace, its currently
+        # open router.queue span, and whether this router minted the
+        # trace (an ingress that handed it in finishes it instead)
+        self.trace = None
+        self.span = None
+        self.own_trace = False
 
     def begin(self) -> bool:
         """First dispatch: flip the future to RUNNING; False if the
@@ -187,7 +194,7 @@ class _Flight:
     positional index would dangle the moment the fleet changes under an
     outstanding dispatch."""
 
-    __slots__ = ("req", "rep", "t_sent", "rfut", "probe")
+    __slots__ = ("req", "rep", "t_sent", "rfut", "probe", "span")
 
     def __init__(self, req, rep, t_sent, probe):
         self.req = req
@@ -195,6 +202,7 @@ class _Flight:
         self.t_sent = t_sent
         self.rfut = None
         self.probe = probe
+        self.span = None      # router.attempt span (tracing on)
 
 
 class _Replica:
@@ -515,6 +523,8 @@ class Router:
             if req.resolve_exc(MXNetError(
                     f"{self.name}: router stopped before this request "
                     "was dispatched")):
+                if req.span is not None:
+                    req.span.end(outcome="stopped")
                 self._count_request("rejected")
 
     def __enter__(self) -> "Router":
@@ -758,6 +768,23 @@ class Router:
                     f" ms exceeds the request deadline "
                     f"{deadline_s * 1e3:.1f} ms ({pending} pending)")
             req = _RouteReq(sample, deadline_s)
+            if _tracing_state.enabled:
+                # the span must exist BEFORE the queue append: the
+                # dispatcher thread may route this request before
+                # submit returns
+                amb = tracing.ambient()
+                if amb is not None:
+                    req.trace = amb[0]
+                    req.span = req.trace.begin(
+                        "router.queue", parent=amb[1], router=self.name)
+                else:
+                    req.trace = tracing.new_trace(
+                        "request", router=self.name)
+                    req.own_trace = True
+                    req.span = req.trace.begin(
+                        "router.queue", router=self.name)
+                    req.future.add_done_callback(
+                        req.trace.finish_from_future)
             # fast path: with nothing queued ahead (FIFO preserved),
             # route on the SUBMITTING thread — decode-to-dispatch is
             # one GIL hold with no queue hand-off and no dispatcher
@@ -795,9 +822,12 @@ class Router:
         self.n_requests += 1
         if _telemetry_state.enabled:
             telemetry.record_serving_shed(reason)
+        if _tracing_state.enabled:
+            tracing.record_event("shed", reason=reason, router=self.name)
 
     def _count_request(self, outcome: str,
-                       t_enqueue: Optional[float] = None) -> None:
+                       t_enqueue: Optional[float] = None,
+                       trace_id: Optional[str] = None) -> None:
         self.n_requests += 1
         if outcome == "ok":
             self.n_ok += 1
@@ -806,7 +836,8 @@ class Router:
         if _telemetry_state.enabled:
             lat = (time.perf_counter() - t_enqueue
                    if t_enqueue is not None else 0.0)
-            telemetry.record_router_request(lat, outcome)
+            telemetry.record_router_request(lat, outcome,
+                                            trace_id=trace_id)
 
     # -- dispatcher ----------------------------------------------------
     def _dispatch_loop(self) -> None:
@@ -846,7 +877,13 @@ class Router:
             pending = [routing] + pending   # first-wins guards the race
         for req in pending:                 # with a later un-wedge
             if req.resolve_exc(MXNetError(f"{self.name}: {why}")):
+                if req.span is not None:
+                    req.span.end(outcome="error")
                 self._count_request("error", t_enqueue=req.t_enqueue)
+        if _tracing_state.enabled:
+            tracing.record_event("router_wedged", router=self.name,
+                                 why=why)
+            tracing.maybe_dump("router_wedged")
 
     def _route(self, req: _RouteReq, inline: bool = False) -> None:
         """Forward one request to the best replica, retrying admission
@@ -868,6 +905,8 @@ class Router:
                     f"{self.name}: request deadline expired after "
                     f"{(now - req.t_enqueue) * 1e3:.1f} ms in the router "
                     f"queue ({req.attempts} dispatch attempt(s))")):
+                if req.span is not None:
+                    req.span.end(outcome="expired")
                 with self._cond:
                     self._shed_locked("expired")
             return
@@ -879,6 +918,8 @@ class Router:
                 # budget (else every:1 would requeue forever) but is
                 # NOT replica health evidence
                 req.attempts += 1
+                if req.trace is not None:
+                    req.trace.note(f"injected route fault: {e}")
                 self._retry_or_fail(req, e, reason="route_fault")
                 return
         target = self._pick_replica()
@@ -896,8 +937,26 @@ class Router:
             self._flights[id(flight)] = flight
             r.inflight += 1
             self._n_inflight += 1
+        if req.trace is not None:
+            # queue time ends the moment a replica is chosen; each
+            # dispatch attempt gets its own span so a failover reads as
+            # attempt-on-victim -> attempt-on-survivor under one trace
+            if req.span is not None:
+                req.span.end()
+                req.span = None
+            flight.span = req.trace.begin(
+                "router.attempt", replica=r.server.name,
+                attempt=req.attempts + 1)
         try:
-            rfut = r.server.submit(req.sample, deadline_ms=remaining_ms)
+            if flight.span is not None:
+                # ambient context so the replica's submit (local Server
+                # or RemoteReplica wire frame) joins this trace
+                with tracing.active(req.trace, flight.span):
+                    rfut = r.server.submit(req.sample,
+                                           deadline_ms=remaining_ms)
+            else:
+                rfut = r.server.submit(req.sample,
+                                       deadline_ms=remaining_ms)
         except Exception as e:  # noqa: BLE001 - sync admission refusal
             with self._cond:
                 # guard like _on_replica_done: the hung-dispatch sweep
@@ -912,6 +971,14 @@ class Router:
                     self._cond.notify_all()
             if not live:
                 return      # the sweep owns this request's fate now
+            if flight.span is not None:
+                flight.span.end(outcome="refused",
+                                error=type(e).__name__)
+                # back to queued state: reopen a queue span so the
+                # re-route attempt is attributed to scheduling time
+                req.span = req.trace.begin("router.queue",
+                                           router=self.name,
+                                           requeue="refused")
             if probe:
                 r.breaker.release_probe()
             if isinstance(e, MXNetError) and not r.server.is_running:
@@ -956,6 +1023,8 @@ class Router:
         if req.resolve_exc(MXNetError(
                 f"{self.name}: router stopped before this request "
                 "was dispatched")):
+            if req.span is not None:
+                req.span.end(outcome="stopped")
             self._count_request("rejected")
 
     def _pick_replica(self):
@@ -998,9 +1067,16 @@ class Router:
                 r.n_ok += 1
                 with self._cond:
                     self._done_ts.append(time.perf_counter())
+            if flight.span is not None:
+                flight.span.end(outcome="ok")
             if flight.req.resolve_result(rfut.result()):
-                self._count_request("ok", t_enqueue=flight.req.t_enqueue)
+                self._count_request(
+                    "ok", t_enqueue=flight.req.t_enqueue,
+                    trace_id=(flight.req.trace.trace_id
+                              if flight.req.trace is not None else None))
             return
+        if flight.span is not None:
+            flight.span.end(outcome="error", error=type(exc).__name__)
         if late:
             return                  # hung flight already failed over
         r.breaker.record_failure()
@@ -1052,6 +1128,19 @@ class Router:
             self.n_failovers += 1
             if _telemetry_state.enabled and replica is not None:
                 telemetry.record_serving_failover(replica.server.name)
+            if req.trace is not None:
+                victim = (replica.server.name if replica is not None
+                          else "?")
+                req.trace.note(
+                    f"failover: {reason} on {victim} "
+                    f"({type(exc).__name__}: {exc}); requeued "
+                    f"(attempt {req.attempts} of {budget})")
+                if req.span is None or req.span._done:
+                    req.span = req.trace.begin(
+                        "router.queue", router=self.name, requeue=reason)
+                tracing.record_event(
+                    "failover", router=self.name, reason=reason,
+                    replica=victim, trace_id=req.trace.trace_id)
             return
         detail = (f" (last replica: {replica.server.name})"
                   if replica is not None else "")
@@ -1059,7 +1148,16 @@ class Router:
                 f"{self.name}: request failed after {req.attempts} "
                 f"dispatch attempt(s), retry budget "
                 f"{self.retry_budget} spent{detail}: {exc}")):
-            self._count_request("error", t_enqueue=req.t_enqueue)
+            if req.span is not None:
+                req.span.end(outcome="exhausted")
+            if req.trace is not None:
+                tracing.record_event(
+                    "failover_exhausted", router=self.name,
+                    reason=reason, trace_id=req.trace.trace_id)
+            self._count_request(
+                "error", t_enqueue=req.t_enqueue,
+                trace_id=(req.trace.trace_id
+                          if req.trace is not None else None))
 
     # -- monitor: hung dispatches, breaker gauges, watchdog ------------
     def _monitor_loop(self) -> None:
@@ -1133,6 +1231,8 @@ class Router:
                 f"outstanding {self.dispatch_timeout_s:g}s past the "
                 "request deadline (unresponsive replica)")))
         for f, r, err in hung:
+            if f.span is not None:
+                f.span.end(outcome="hung")
             self._retry_or_fail(f.req, err, reason="hung", replica=r)
 
     def _publish_health(self) -> None:
@@ -1147,11 +1247,25 @@ class Router:
             if cc > r.crashes_seen:
                 r.crashes_seen = cc
                 r.breaker.record_hang()
+                if _tracing_state.enabled:
+                    tracing.record_event(
+                        "worker_crash", replica=r.server.name,
+                        crash_count=cc, router=self.name)
             state = r.breaker.state
             if state != r.last_state:
                 if _telemetry_state.enabled:
                     telemetry.record_breaker_transition(
                         r.server.name, state)
+                if _tracing_state.enabled:
+                    tracing.record_event(
+                        "breaker", replica=r.server.name,
+                        from_state=r.last_state, to_state=state,
+                        router=self.name)
+                    if state == OPEN:
+                        # a breaker trip is exactly the moment the
+                        # flight recorder exists for: persist the ring
+                        # so the trip can be explained post-mortem
+                        tracing.maybe_dump("breaker_open")
                 r.last_state = state
             if _telemetry_state.enabled:
                 telemetry.set_replica_health(

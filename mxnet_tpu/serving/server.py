@@ -33,6 +33,7 @@ all exported via ``telemetry.prom_text()``.
 """
 from __future__ import annotations
 
+import contextlib
 import threading
 import time
 import weakref
@@ -41,10 +42,11 @@ from typing import Optional, Sequence, Tuple
 
 import numpy as np
 
-from .. import autograd, fault, telemetry
+from .. import autograd, fault, telemetry, tracing
 from ..base import MXNetError
 from ..fault import _state as _fault_state
 from ..telemetry import _state as _telemetry_state
+from ..tracing import _state as _tracing_state
 from .buckets import BucketGrid
 from .health import Heartbeat
 
@@ -61,7 +63,8 @@ def live_servers():
 
 
 class _Request:
-    __slots__ = ("sample", "shape_key", "future", "t_enqueue", "deadline")
+    __slots__ = ("sample", "shape_key", "future", "t_enqueue", "deadline",
+                 "trace", "span", "own_trace")
 
     def __init__(self, sample, shape_key, deadline_s):
         self.sample = sample
@@ -69,6 +72,12 @@ class _Request:
         self.future = Future()
         self.t_enqueue = time.perf_counter()
         self.deadline = self.t_enqueue + deadline_s
+        # tracing (MXNET_TRACING=1): the request's Trace, its live
+        # batch.wait span, and whether THIS server minted the trace
+        # (a router/worker that handed it in finishes it instead)
+        self.trace = None
+        self.span = None
+        self.own_trace = False
 
 
 class Server:
@@ -205,6 +214,7 @@ class Server:
                         MXNetError(f"{self.name}: server stopped before "
                                    "this request was dispatched"))
                     self._count_request(outcome="rejected")
+                    self._end_trace_rejected(r)
             self._cond.notify_all()
         if self._watcher is not None:
             self._watcher.stop(timeout)
@@ -240,12 +250,26 @@ class Server:
         deadline_s = (deadline_ms / 1e3 if deadline_ms is not None
                       else self.slo_s)
         req = _Request(arr, bucket, deadline_s)
+        if _tracing_state.enabled:
+            # the span must exist BEFORE the queue append: the scheduler
+            # may batch-close this request before submit returns
+            amb = tracing.ambient()
+            if amb is not None:
+                req.trace = amb[0]
+                req.span = req.trace.begin(
+                    "batch.wait", parent=amb[1], replica=self.name)
+            else:
+                req.trace = tracing.new_trace("request", replica=self.name)
+                req.own_trace = True
+                req.span = req.trace.begin("batch.wait", replica=self.name)
         with self._cond:
             if not self._running:
                 self._count_request(outcome="rejected")
+                self._end_trace_rejected(req)
                 raise MXNetError(f"{self.name}: server is not running")
             if len(self._queue) >= self.max_queue:
                 self._count_request(outcome="rejected")
+                self._end_trace_rejected(req)
                 raise MXNetError(
                     f"{self.name}: submission queue full "
                     f"({self.max_queue} requests)")
@@ -276,6 +300,7 @@ class Server:
                 if r.future.set_running_or_notify_cancel():
                     r.future.set_exception(MXNetError(
                         f"{self.name}: scheduler thread crashed"))
+                    self._end_trace_rejected(r, "error")
             raise
 
     def _next_batch(self):
@@ -354,6 +379,17 @@ class Server:
         model = self._model          # reload swaps the attribute, not us
         sig = (cap,) + key
 
+        bsp = None
+        if _tracing_state.enabled:
+            traced = [(r.trace, r.span) for r in batch
+                      if r.trace is not None]
+            if traced:
+                # the N co-batched wait spans end here (flow-linked to
+                # the ONE dispatch span that serves them all)
+                bsp = tracing.begin_batch(
+                    traced, wait_tags={"close_reason": reason},
+                    replica=self.name, sig=str(sig), reason=reason)
+
         def run():
             hook = self._pre_dispatch
             if hook is not None:
@@ -365,15 +401,28 @@ class Server:
                 out = model(x)
             return self._materialize(out)
 
+        # injected faults / retries inside the dispatch annotate the
+        # batch span (fault.py calls tracing.note against the ambient)
+        amb = (tracing.active(batch[0].trace, bsp) if bsp is not None
+               else contextlib.nullcontext())
         try:
-            leaves, tree = fault.retry_call(
-                "serving.dispatch", run, detail=self.name)
+            with amb:
+                leaves, tree = fault.retry_call(
+                    "serving.dispatch", run, detail=self.name)
         except Exception as e:  # noqa: BLE001 - forwarded to the futures
             self.n_errors += 1
+            tracing.end_batch(bsp, outcome="error",
+                              error=type(e).__name__)
             for r in batch:
                 r.future.set_exception(e)
-                self._count_request(outcome="error", t_enqueue=r.t_enqueue)
+                self._count_request(
+                    outcome="error", t_enqueue=r.t_enqueue,
+                    trace_id=r.trace.trace_id if r.trace is not None
+                    else None)
+                if r.own_trace:
+                    r.trace.finish(type(e).__name__)
             return
+        tracing.end_batch(bsp, outcome="ok")
         self.n_batches += 1
         if self.n_batches == 1:
             from .. import compiler
@@ -394,7 +443,12 @@ class Server:
                 # array for as long as the caller holds the result
                 r.future.set_result(nested_unflatten_nd(
                     tree, [leaf[i].copy() for leaf in leaves]))
-                self._count_request(outcome="ok", t_enqueue=r.t_enqueue)
+                self._count_request(
+                    outcome="ok", t_enqueue=r.t_enqueue,
+                    trace_id=r.trace.trace_id if r.trace is not None
+                    else None)
+                if r.own_trace:
+                    r.trace.finish("ok")
         except Exception as e:  # noqa: BLE001 - e.g. non-batch-major leaf
             self.n_errors += 1
             for r in batch:
@@ -402,6 +456,8 @@ class Server:
                     r.future.set_exception(e)
                     self._count_request(outcome="error",
                                         t_enqueue=r.t_enqueue)
+                if r.own_trace:
+                    r.trace.finish(type(e).__name__)
 
     @staticmethod
     def _materialize(out):
@@ -412,13 +468,24 @@ class Server:
         flat, tree = nested_flatten_nd(out)
         return [leaf.asnumpy() for leaf in flat], tree
 
-    def _count_request(self, outcome: str, t_enqueue: Optional[float] = None
-                       ) -> None:
+    def _count_request(self, outcome: str, t_enqueue: Optional[float] = None,
+                       trace_id: Optional[str] = None) -> None:
         self.n_requests += 1
         if _telemetry_state.enabled:
             lat = (time.perf_counter() - t_enqueue
                    if t_enqueue is not None else 0.0)
-            telemetry.record_serving_request(lat, outcome)
+            telemetry.record_serving_request(lat, outcome,
+                                             trace_id=trace_id)
+
+    @staticmethod
+    def _end_trace_rejected(req: _Request, status: str = "rejected") -> None:
+        """Seal a traced request that never reached a batch."""
+        if req.trace is None:
+            return
+        if req.span is not None:
+            req.span.end(outcome=status)
+        if req.own_trace:
+            req.trace.finish(status)
 
     # -- model management ----------------------------------------------
     def _warm_block(self, block, prime: bool = False) -> int:

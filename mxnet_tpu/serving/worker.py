@@ -116,10 +116,26 @@ def main(argv=None) -> int:
     # build BEFORE connecting back: the parent's accept timeout bounds
     # model build + grid warmup, and a factory that cannot import must
     # fail this process loudly, not hand the router a dead replica
-    from .. import telemetry
+    from .. import telemetry, tracing
     from ..base import MXNetError
+    from ..tracing import _state as _tracing_state
     from . import wire
     from .server import Server
+
+    tracing.set_process_name(args.name)
+    try:
+        import signal
+
+        def _on_sigterm(signum, frame):
+            # the supervisor's polite kill: persist the flight recorder
+            # (MXNET_TRACING_OUT, per-pid path) before dying — the
+            # dump is this process's last words
+            tracing.maybe_dump("sigterm")
+            os._exit(143)
+
+        signal.signal(signal.SIGTERM, _on_sigterm)
+    except ValueError:
+        pass        # not the main thread (in-process test harness)
 
     factory = load_factory(args.factory, args.path)
     block = factory(**json.loads(args.factory_kwargs))
@@ -180,7 +196,7 @@ def main(argv=None) -> int:
     threading.Thread(target=health_loop, name=f"{args.name}-health",
                      daemon=True).start()
 
-    def on_done(req_id, fut):
+    def on_done(req_id, fut, tr=None):
         try:
             payload = fut.result()
         except Exception as e:  # noqa: BLE001 - typed onto the wire
@@ -190,6 +206,14 @@ def main(argv=None) -> int:
         else:
             frame = {"kind": "result", "id": req_id, "ok": True,
                      "payload": payload}
+        if tr is not None:
+            # piggyback this request's worker-side spans on the result
+            # frame; trace_ts stamps the send so the parent can
+            # reconstruct the wire.return leg (same-host wall clock)
+            tr.finish("ok" if frame["ok"] else frame.get("etype",
+                                                         "error"))
+            frame["spans"] = tr.export_spans()
+            frame["trace_ts"] = tracing.now_us()
         try:
             send(frame)
         except (OSError, wire.ConnectionClosed):
@@ -216,29 +240,48 @@ def main(argv=None) -> int:
             except wire.ConnectionClosed:
                 # orphan fencing: the router died — do not serve a
                 # queue nobody reads; exit and let supervision decide
+                tracing.maybe_dump("orphaned")
                 server.stop(drain=False, timeout=10)
                 return 0
             kind = frame["kind"]
             if kind == "submit":
                 req_id = frame["id"]
+                tr = None
+                if _tracing_state.enabled:
+                    # the frame header's span context: adopt it so the
+                    # server's batch.wait/dispatch spans join the
+                    # router-side trace (absent/malformed = untraced)
+                    tr = tracing.adopt(frame.get("trace"),
+                                       worker=args.name)
                 try:
-                    fut = server.submit(frame["sample"],
-                                        deadline_ms=frame.get(
-                                            "deadline_ms"))
+                    if tr is not None:
+                        with tracing.active(tr, tr.remote_parent):
+                            fut = server.submit(
+                                frame["sample"],
+                                deadline_ms=frame.get("deadline_ms"))
+                    else:
+                        fut = server.submit(frame["sample"],
+                                            deadline_ms=frame.get(
+                                                "deadline_ms"))
                 except Exception as e:  # noqa: BLE001 - sync refusal
                     etype, msg = wire.encode_error(e)
+                    res = {"kind": "result", "id": req_id,
+                           "ok": False, "etype": etype, "error": msg}
+                    if tr is not None:
+                        tr.finish(etype)
+                        res["spans"] = tr.export_spans()
+                        res["trace_ts"] = tracing.now_us()
                     try:
-                        send({"kind": "result", "id": req_id,
-                              "ok": False, "etype": etype,
-                              "error": msg})
+                        send(res)
                     except (OSError, wire.ConnectionClosed):
                         # parent gone mid-reply: same orphan fencing
                         # as EOF on recv, not a crash
+                        tracing.maybe_dump("orphaned")
                         server.stop(drain=False, timeout=10)
                         return 0
                     continue
                 fut.add_done_callback(
-                    lambda f, i=req_id: on_done(i, f))
+                    lambda f, i=req_id, t=tr: on_done(i, f, t))
             elif kind == "stop":
                 try:
                     server.stop(drain=bool(frame.get("drain", True)),

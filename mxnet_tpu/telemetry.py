@@ -170,15 +170,20 @@ class _Gauge:
 
 
 class _Histogram:
-    __slots__ = ("edges", "counts", "sum", "count")
+    __slots__ = ("edges", "counts", "sum", "count", "exemplars")
 
     def __init__(self, edges: Tuple[float, ...]):
         self.edges = edges
         self.counts = [0] * (len(edges) + 1)   # last slot = +Inf
         self.sum = 0.0
         self.count = 0
+        # OpenMetrics exemplars: bucket index -> (labels, value, ts).
+        # None until the first exemplar so plain observes stay
+        # allocation-free; kept as last-write-wins per bucket.
+        self.exemplars = None
 
-    def observe(self, value: float) -> None:
+    def observe(self, value: float,
+                exemplar: Optional[Dict[str, str]] = None) -> None:
         i = 0
         edges = self.edges
         n = len(edges)
@@ -190,6 +195,10 @@ class _Histogram:
             self.counts[i] += 1
             self.sum += value
             self.count += 1
+            if exemplar is not None:
+                if self.exemplars is None:
+                    self.exemplars = {}
+                self.exemplars[i] = (dict(exemplar), value, time.time())
 
 
 _KINDS = {"counter": _Counter, "gauge": _Gauge, "histogram": _Histogram}
@@ -243,8 +252,9 @@ class _Family:
     def dec(self, amount: float = 1.0):
         self._solo().dec(amount)
 
-    def observe(self, value: float):
-        self._solo().observe(value)
+    def observe(self, value: float,
+                exemplar: Optional[Dict[str, str]] = None):
+        self._solo().observe(value, exemplar=exemplar)
 
 
 def _get_or_create(name, kind, help, labelnames, buckets=None) -> _Family:
@@ -314,14 +324,26 @@ def snapshot() -> Dict:
                 with _lock:
                     counts = list(child.counts)
                     hsum, hcount = child.sum, child.count
+                    exemplars = (dict(child.exemplars)
+                                 if child.exemplars else None)
                 cum = 0
                 buckets = {}
-                for edge, c in zip(fam.buckets, counts):
+                edges = list(fam.buckets) + [math.inf]
+                ex_out = {}
+                for i, (edge, c) in enumerate(zip(edges, counts)):
                     cum += c
-                    buckets[_fmt_float(edge)] = cum
+                    le = _fmt_float(edge)
+                    buckets[le] = cum
+                    if exemplars is not None and i in exemplars:
+                        xlabels, xval, xts = exemplars[i]
+                        ex_out[le] = {"labels": xlabels, "value": xval,
+                                      "ts": xts}
                 buckets["+Inf"] = hcount
-                samples.append({"labels": labels, "sum": hsum,
-                                "count": hcount, "buckets": buckets})
+                sample = {"labels": labels, "sum": hsum,
+                          "count": hcount, "buckets": buckets}
+                if ex_out:
+                    sample["exemplars"] = ex_out
+                samples.append(sample)
             else:
                 samples.append({"labels": labels, "value": child.value})
         out["metrics"][fam.name] = {
@@ -366,10 +388,19 @@ def prom_text() -> str:
         lines.append(f"# TYPE {name} {fam['type']}")
         for s in fam["samples"]:
             if fam["type"] == "histogram":
+                exemplars = s.get("exemplars") or {}
                 for le, cum in s["buckets"].items():
-                    lines.append(
-                        f"{name}_bucket"
-                        f"{_prom_labels(s['labels'], ('le', le))} {cum}")
+                    line = (f"{name}_bucket"
+                            f"{_prom_labels(s['labels'], ('le', le))} {cum}")
+                    ex = exemplars.get(le)
+                    if ex is not None:
+                        # OpenMetrics exemplar suffix:
+                        #   ... 5 # {trace_id="deadbeef"} 0.053 1690000000.0
+                        line += (f" # {_prom_labels(ex['labels'])} "
+                                 f"{_fmt_float(ex['value'])}"
+                                 + (f" {_fmt_float(ex['ts'])}"
+                                    if ex.get("ts") is not None else ""))
+                    lines.append(line)
                 lines.append(
                     f"{name}_sum{_prom_labels(s['labels'])} "
                     f"{_fmt_float(s['sum'])}")
@@ -405,10 +436,22 @@ class MetricsExporter:
 
         class _Handler(http.server.BaseHTTPRequestHandler):
             def do_GET(self):          # noqa: N802 - stdlib contract
-                if self.path.split("?", 1)[0] == "/metrics":
+                path = self.path.split("?", 1)[0]
+                if path == "/metrics":
                     body = prom_text().encode("utf-8")
                     ctype = "text/plain; version=0.0.4; charset=utf-8"
-                elif self.path.split("?", 1)[0] == "/healthz":
+                elif path == "/varz":
+                    # the /metrics payload without the prometheus
+                    # lossiness: full JSON snapshot, exemplars included
+                    body = dumps(indent=2).encode("utf-8")
+                    ctype = "application/json"
+                elif path == "/traces":
+                    # flight-recorder ring as JSONL (one event or
+                    # completed trace per line); empty when tracing off
+                    from . import tracing
+                    body = tracing.dump_jsonl().encode("utf-8")
+                    ctype = "application/jsonl"
+                elif path == "/healthz":
                     try:
                         payload = (exporter.healthz_fn()
                                    if exporter.healthz_fn else
@@ -480,25 +523,58 @@ def _unquote_label(s: str, i: int) -> Tuple[str, int]:
             i += 1
 
 
-def _parse_sample_line(line: str) -> Tuple[str, Dict[str, str], float]:
-    """One exposition sample line -> (sample_name, labels, value)."""
+def _parse_label_set(s: str, i: int) -> Tuple[Dict[str, str], int]:
+    """Parse ``{k="v",...}`` starting at the opening brace ``s[i]``;
+    returns (labels, index past the closing brace)."""
+    labels: Dict[str, str] = {}
+    i += 1
+    while i < len(s) and s[i] != "}":
+        eq = s.index("=", i)
+        key = s[i:eq].strip().lstrip(",").strip()
+        value, i = _unquote_label(s, eq + 1)
+        labels[key] = value
+        if i < len(s) and s[i] == ",":
+            i += 1
+    if i >= len(s) or s[i] != "}":
+        raise ValueError(f"unterminated label set in {s!r}")
+    return labels, i + 1
+
+
+def _parse_exemplar(text: str) -> Dict:
+    """OpenMetrics exemplar tail ``{labels} value [ts]`` -> dict."""
+    text = text.strip()
+    labels: Dict[str, str] = {}
+    i = 0
+    if text.startswith("{"):
+        labels, i = _parse_label_set(text, 0)
+    rest = text[i:].split()
+    if not rest:
+        raise ValueError(f"exemplar with no value in {text!r}")
+    ex: Dict = {"labels": labels, "value": float(rest[0])}
+    if len(rest) > 1:
+        ex["ts"] = float(rest[1])
+    return ex
+
+
+def _parse_sample_line(line: str
+                       ) -> Tuple[str, Dict[str, str], float,
+                                  Optional[Dict]]:
+    """One exposition sample line -> (sample_name, labels, value,
+    exemplar-or-None). The `` # {...} v [ts]`` OpenMetrics exemplar
+    suffix is preserved structurally, never folded into the value."""
     brace = line.find("{")
     if brace == -1:
-        name, _, val = line.partition(" ")
-        return name, {}, float(val)
+        main, _, ex_text = line.partition(" # ")
+        name, _, val = main.partition(" ")
+        return (name, {}, float(val),
+                _parse_exemplar(ex_text) if ex_text else None)
     name = line[:brace]
-    labels: Dict[str, str] = {}
-    i = brace + 1
-    while i < len(line) and line[i] != "}":
-        eq = line.index("=", i)
-        key = line[i:eq].strip().lstrip(",").strip()
-        value, i = _unquote_label(line, eq + 1)
-        labels[key] = value
-        if i < len(line) and line[i] == ",":
-            i += 1
-    if i >= len(line) or line[i] != "}":
-        raise ValueError(f"unterminated label set in {line!r}")
-    return name, labels, float(line[i + 1:].strip())
+    # the main label set may contain a quoted '#': parse it first, then
+    # look for the exemplar separator in the remainder only
+    labels, i = _parse_label_set(line, brace)
+    main, _, ex_text = line[i:].partition(" # ")
+    return (name, labels, float(main.strip()),
+            _parse_exemplar(ex_text) if ex_text else None)
 
 
 def parse_prom_text(text: str) -> Dict[str, Dict]:
@@ -529,7 +605,7 @@ def parse_prom_text(text: str) -> Dict[str, Dict]:
         elif line.startswith("#"):
             continue
         else:
-            sname, labels, value = _parse_sample_line(line)
+            sname, labels, value, exemplar = _parse_sample_line(line)
             fam_name = sname
             if fam_name not in out:
                 for suffix in ("_bucket", "_sum", "_count"):
@@ -537,8 +613,10 @@ def parse_prom_text(text: str) -> Dict[str, Dict]:
                             sname[: -len(suffix)] in out:
                         fam_name = sname[: -len(suffix)]
                         break
-            family(fam_name)["samples"].append(
-                {"name": sname, "labels": labels, "value": value})
+            sample = {"name": sname, "labels": labels, "value": value}
+            if exemplar is not None:
+                sample["exemplar"] = exemplar
+            family(fam_name)["samples"].append(sample)
     return out
 
 
@@ -554,8 +632,15 @@ def emit_prom_text(parsed: Dict[str, Dict]) -> str:
         if fam.get("type"):
             lines.append(f"# TYPE {name} {fam['type']}")
         for s in fam["samples"]:
-            lines.append(f"{s['name']}{_prom_labels(s['labels'])} "
-                         f"{_fmt_float(s['value'])}")
+            line = (f"{s['name']}{_prom_labels(s['labels'])} "
+                    f"{_fmt_float(s['value'])}")
+            ex = s.get("exemplar")
+            if ex is not None:
+                line += (f" # {_prom_labels(ex['labels'])} "
+                         f"{_fmt_float(ex['value'])}"
+                         + (f" {_fmt_float(ex['ts'])}"
+                            if ex.get("ts") is not None else ""))
+            lines.append(line)
     return "\n".join(lines) + "\n"
 
 
@@ -645,9 +730,14 @@ def pop_telemetry_out_flag(argv: Sequence[str]
 
 
 def write_snapshot(path: str) -> None:
-    """Write an indented JSON snapshot to ``path`` (tool exit hook)."""
-    with open(path, "w") as f:
-        f.write(dumps(indent=2))
+    """Write an indented JSON snapshot to ``path`` (tool exit hook).
+
+    Atomic (temp + fsync + rename via :func:`checkpoint.atomic_write`):
+    a scraper or post-mortem reader never sees a half-written snapshot,
+    and a crash mid-dump leaves the previous one intact."""
+    from . import checkpoint   # lazy: avoid import cycle at module load
+
+    checkpoint.atomic_write(path, dumps(indent=2).encode("utf-8"))
 
 
 # MXNET_TELEMETRY_OUT=PATH: enable recording and write a snapshot at
@@ -1054,11 +1144,15 @@ def record_images_decoded(n: int) -> None:
             "Images decoded and augmented by the input pipeline.").inc(n)
 
 
-def record_serving_request(seconds: float, outcome: str = "ok") -> None:
+def record_serving_request(seconds: float, outcome: str = "ok",
+                           trace_id: Optional[str] = None) -> None:
     """One served request, end-to-end (submit -> future resolved).
     ``outcome``: ``ok``, ``error`` (dispatch failed after retries) or
     ``rejected`` (queue full / server stopped — no latency recorded).
-    p50/p99 come from the histogram quantiles."""
+    p50/p99 come from the histogram quantiles. ``trace_id`` (when the
+    request was traced) becomes an OpenMetrics exemplar on the latency
+    bucket it lands in — the jump from "p99 is slow" to THE trace that
+    explains it."""
     if not _state.enabled:
         return
     counter("mxnet_serving_requests_total",
@@ -1067,7 +1161,10 @@ def record_serving_request(seconds: float, outcome: str = "ok") -> None:
     if outcome != "rejected":
         histogram("mxnet_serving_request_seconds",
                   "End-to-end request latency (submit to future "
-                  "resolution).", buckets=SERVING_BUCKETS).observe(seconds)
+                  "resolution).", buckets=SERVING_BUCKETS).observe(
+            seconds,
+            exemplar=({"trace_id": trace_id}
+                      if trace_id is not None else None))
 
 
 def record_serving_batch(n_real: int, capacity: int, reason: str) -> None:
@@ -1121,12 +1218,15 @@ def record_serving_reload(seconds: float, outcome: str = "ok") -> None:
                   "model.", buckets=STEP_BUCKETS).observe(seconds)
 
 
-def record_router_request(seconds: float, outcome: str = "ok") -> None:
+def record_router_request(seconds: float, outcome: str = "ok",
+                          trace_id: Optional[str] = None) -> None:
     """One Router-level request resolution. A SEPARATE family from
     ``mxnet_serving_requests_total``: every routed request is also
     counted by the replica Server that served it, and after a failover
     the layers legitimately disagree (replica error, router ok) — one
-    shared counter would double-count RPS and mix the two stories."""
+    shared counter would double-count RPS and mix the two stories.
+    ``trace_id`` rides along as an exemplar (see
+    :func:`record_serving_request`)."""
     if not _state.enabled:
         return
     counter("mxnet_serving_router_requests_total",
@@ -1135,7 +1235,10 @@ def record_router_request(seconds: float, outcome: str = "ok") -> None:
     if outcome != "rejected":
         histogram("mxnet_serving_router_request_seconds",
                   "End-to-end router request latency (submit to future "
-                  "resolution).", buckets=SERVING_BUCKETS).observe(seconds)
+                  "resolution).", buckets=SERVING_BUCKETS).observe(
+            seconds,
+            exemplar=({"trace_id": trace_id}
+                      if trace_id is not None else None))
 
 
 def record_serving_shed(reason: str) -> None:
@@ -1259,10 +1362,13 @@ def record_ingress_rejected(reason: str) -> None:
             ("reason",)).labels(reason).inc()
 
 
-def record_ingress_request(seconds: float, outcome: str = "ok") -> None:
+def record_ingress_request(seconds: float, outcome: str = "ok",
+                           trace_id: Optional[str] = None) -> None:
     """One ingress request resolved end-to-end (frame in -> result
     frame out). ``outcome``: ``ok``, ``error`` (typed error frame), or
-    ``undeliverable`` (resolved after the client disconnected)."""
+    ``undeliverable`` (resolved after the client disconnected).
+    ``trace_id`` rides along as an exemplar (see
+    :func:`record_serving_request`)."""
     if not _state.enabled:
         return
     counter("mxnet_ingress_requests_total",
@@ -1271,7 +1377,10 @@ def record_ingress_request(seconds: float, outcome: str = "ok") -> None:
     histogram("mxnet_ingress_request_seconds",
               "Ingress request latency (submit frame received to "
               "result frame written).",
-              buckets=SERVING_BUCKETS).observe(seconds)
+              buckets=SERVING_BUCKETS).observe(
+        seconds,
+        exemplar=({"trace_id": trace_id}
+                  if trace_id is not None else None))
 
 
 def set_router_inflight(n: int, router: str = "") -> None:

@@ -47,6 +47,25 @@ def telemetry_leak_guard():
 
 
 @pytest.fixture(autouse=True)
+def tracing_leak_guard():
+    """Mirror of the telemetry guard for request tracing: a test that
+    enables mx.tracing globally and forgets to disable it would make
+    every later serving test mint spans (and grow the flight-recorder
+    ring) on its hot path — fail loudly instead. Tests that want
+    tracing call tracing.reset() (disable + clear ring) in teardown."""
+    from mxnet_tpu import tracing
+
+    was_enabled = tracing.enabled()
+    yield
+    leaked = tracing.enabled() and not was_enabled
+    if leaked:
+        tracing.reset()
+        pytest.fail(
+            "test left mx.tracing globally enabled; call "
+            "tracing.reset() (or disable()) in teardown")
+
+
+@pytest.fixture(autouse=True)
 def serving_leak_guard():
     """Guard for the serving stack: a test that leaves a Server's
     scheduler (or reload-watcher) thread running would keep dispatching

@@ -880,3 +880,57 @@ class TestRealWorkerProcess:
         finally:
             rep.stop()
         assert rep.proc.poll() is not None
+
+    def test_trace_propagates_across_the_process_boundary(self):
+        """One traced request through a REAL worker subprocess: the
+        span context rides the submit frame header, the worker's
+        batch.wait/dispatch spans come home on the result frame, and
+        the merged trace carries one trace_id across two pids plus the
+        reconstructed wire.return leg."""
+        from mxnet_tpu import tracing
+
+        rep = serving.RemoteReplica(
+            "worker_factory:tiny_net", name="traced0",
+            batch_buckets=(2, 4), shape_buckets=[(8,)], slo_ms=50,
+            python_paths=[FIXTURES], spawn_timeout_s=300,
+            env={"MXNET_TRACING": "1"})
+        tracing.reset()         # clean ring: this test counts traces
+        tracing.enable()
+        try:
+            rep.start()
+            router = serving.Router([rep], slo_ms=5000).start()
+            try:
+                x = traffic(1)[0]
+                router.submit(x).result(timeout=60)
+                wait_until(
+                    lambda: any(
+                        r["status"] == "ok"
+                        for r in tracing.recorder().traces()),
+                    30, msg="router seals the merged trace")
+            finally:
+                router.stop(timeout=60)
+            recs = [r for r in tracing.recorder().traces()
+                    if r["status"] == "ok"]
+            assert len(recs) == 1
+            rec = recs[0]
+            spans = rec["spans"]
+            names = {s["name"] for s in spans}
+            # router-side stages AND worker-side stages in ONE record
+            assert {"request", "router.queue", "router.attempt",
+                    "batch.wait", "dispatch", "wire.return"} <= names
+            pids = {s["pid"] for s in spans}
+            assert len(pids) == 2, f"expected two pids, got {pids}"
+            procs = {s["proc"] for s in spans}
+            assert "traced0" in procs   # worker set_process_name
+            assert all(s["trace_id"] == rec["trace_id"] for s in spans)
+            # worker-side spans hang off the router's attempt span
+            # via the wire context, not off a disconnected root
+            attempt = [s for s in spans
+                       if s["name"] == "router.attempt"][0]
+            worker_side = [s for s in spans if s["pid"] != os.getpid()]
+            assert worker_side
+            assert any(s.get("parent_id") == attempt["span_id"]
+                       for s in worker_side)
+        finally:
+            rep.stop()
+            tracing.reset()

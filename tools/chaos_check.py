@@ -580,6 +580,32 @@ def _serving_net(seed=0):
     return net
 
 
+def _failover_trace(traces, victim):
+    """The flight-recorder record that EXPLAINS a failover: one trace
+    whose span chain reads dispatch-on-victim -> typed failure ->
+    re-dispatch-on-survivor -> ok, all under one trace_id. Returns the
+    record (or None)."""
+    for t in traces:
+        attempts = [s for s in t.get("spans", [])
+                    if s.get("name") == "router.attempt"]
+        victim_failed = any(
+            s.get("tags", {}).get("replica") == victim
+            and s.get("tags", {}).get("outcome") not in (None, "ok")
+            for s in attempts)
+        survivor_ok = any(
+            s.get("tags", {}).get("replica") != victim
+            and s.get("tags", {}).get("outcome") == "ok"
+            for s in attempts)
+        # the attempt chain must share the trace id (batch spans are
+        # dict-copied from the owning sibling trace and keep its id)
+        one_id = all(s.get("trace_id") == t.get("trace_id")
+                     for s in attempts)
+        if victim_failed and survivor_ok and one_id \
+                and t.get("status") == "ok":
+            return t
+    return None
+
+
 def serving_gate(summary):
     """Kill replica 0 of a 2-replica Router mid-traffic (``serving.
     replica.0=every:1``), then clear the fault and wait for half-open
@@ -592,12 +618,17 @@ def serving_gate(summary):
     import numpy as np
 
     from mxnet_tpu import fault as flt
-    from mxnet_tpu import serving
+    from mxnet_tpu import serving, tracing
     from mxnet_tpu.base import MXNetError
 
     os.environ["MXNET_COMM_RETRY_DELAY"] = "0.01"
     os.environ["MXNET_SERVING_BREAKER_FAILURES"] = "2"
     os.environ["MXNET_SERVING_BREAKER_COOLDOWN"] = "0.4"
+
+    # flight recorder on: the gate must not just survive the kill, it
+    # must be able to EXPLAIN it from the dumped trace afterwards
+    tracing.reset()
+    tracing.enable()
 
     grid = dict(batch_buckets=(2, 4, 8), shape_buckets=[(32,)],
                 slo_ms=SERVING_SLO_MS)
@@ -688,6 +719,10 @@ def serving_gate(summary):
         checks["replica_tripped"] = by_name["rep0"]["trips"] >= 1
         checks["replica_readmitted_by_probe"] = readmitted
         checks["survivor_p99_bounded"] = p99_fault <= bound
+        from mxnet_tpu import tracing as _tr
+        explained = _failover_trace(_tr.recorder().traces(), "rep0")
+        checks["flight_recorder_explains_failover"] = \
+            explained is not None
         ok = all(checks.values())
         summary["gates"]["serving_failover_zero_lost"] = {
             "pass": ok, "checks": checks,
@@ -697,7 +732,8 @@ def serving_gate(summary):
             "rep0_trips": by_name["rep0"]["trips"],
             "p99_clean_ms": round(p99_clean * 1e3, 2),
             "p99_fault_ms": round(p99_fault * 1e3, 2),
-            "p99_bound_ms": bound * 1e3}
+            "p99_bound_ms": bound * 1e3,
+            "explaining_trace": (explained or {}).get("trace_id")}
         print(f"[chaos] serving: {len(records)} requests, {n_ok} ok, "
               f"{n_typed} typed errors, {n_lost + undone} lost; "
               f"{stats['failovers']} failovers; p99 clean/fault "
@@ -708,6 +744,7 @@ def serving_gate(summary):
     finally:
         flt.clear()
         router.stop(drain=False, timeout=30)
+        tracing.disable()
 
 
 # ---------------------------------------------------------------------------
@@ -903,12 +940,18 @@ def worker_gate(summary):
 
     import numpy as np
 
-    from mxnet_tpu import serving
+    from mxnet_tpu import serving, tracing
     from mxnet_tpu.base import MXNetError
 
     os.environ["MXNET_COMM_RETRY_DELAY"] = "0.01"
     os.environ["MXNET_SERVING_BREAKER_FAILURES"] = "2"
     os.environ["MXNET_SERVING_BREAKER_COOLDOWN"] = "0.4"
+
+    # flight recorder on, in THIS process and (via env) the worker
+    # processes: the SIGKILL below must leave an explaining trace
+    tracing.reset()
+    tracing.enable()
+    os.environ["MXNET_TRACING"] = "1"
 
     tools_dir = os.path.dirname(os.path.abspath(__file__))
     grid = dict(batch_buckets=(2, 4), shape_buckets=[(32,)],
@@ -1005,6 +1048,17 @@ def worker_gate(summary):
         checks["worker_breaker_tripped"] = by_name["w0"]["trips"] >= 1
         checks["respawn_readmitted_by_probe"] = readmitted
         checks["router_ingress_survived"] = edge_alive and final_ok
+        # the flight recorder must EXPLAIN the kill: a crash event in
+        # the ring, and a failed-over request's trace reading
+        # dispatch-on-victim -> WorkerCrashed -> ok-on-survivor under
+        # one trace_id
+        rec = tracing.recorder()
+        explained = _failover_trace(rec.traces(), "w0")
+        checks["flight_recorder_captured_kill"] = any(
+            e.get("event") in ("crash", "worker_crash")
+            for e in rec.events())
+        checks["flight_recorder_explains_failover"] = \
+            explained is not None
         ok = all(checks.values())
         summary["gates"]["worker_crash_isolation_zero_lost"] = {
             "pass": ok, "checks": checks,
@@ -1013,7 +1067,8 @@ def worker_gate(summary):
             "victim_pid": victim_pid,
             "respawned_pid": workers[0].proc.pid,
             "worker_restarts": workers[0].n_restarts,
-            "w0_trips": by_name["w0"]["trips"]}
+            "w0_trips": by_name["w0"]["trips"],
+            "explaining_trace": (explained or {}).get("trace_id")}
         print(f"[chaos] worker: {len(records)} requests, {n_ok} ok, "
               f"{n_typed} typed errors, {n_lost + undone} lost; "
               f"victim pid {victim_pid} -> respawned "
@@ -1029,6 +1084,8 @@ def worker_gate(summary):
         cli.close()
         ing.stop()
         router.stop(drain=False, timeout=60)
+        tracing.disable()
+        os.environ.pop("MXNET_TRACING", None)
 
 
 def _scrape_scale_phase(summary, router, make_worker, _time):

@@ -1,0 +1,199 @@
+"""Per-stage latency decomposition from request-trace JSONL dumps.
+
+Usage:
+  python tools/latency_report.py /tmp/traces.*.jsonl
+  python tools/latency_report.py --json /tmp/traces.12345.jsonl
+
+Reads flight-recorder dumps (``MXNET_TRACING_OUT`` / ``/traces`` /
+``mx.tracing.dump``) — one JSON object per line, completed traces and
+structured events interleaved — and answers the question the serving
+histograms cannot: **which stage** makes a p99 slow. Every request
+trace is split into its named spans (``ingress.decode``,
+``router.queue``, ``router.attempt``, ``batch.wait``, ``dispatch``,
+``wire.return``, ``ingress.reply``) and each stage's p50/p99 is
+reported alongside its share of end-to-end time.
+
+The three-bucket rollup at the end maps stages onto the same
+framing / socket / scheduling decomposition ``tools/serving_bench.py``
+stage 8 derives from first principles (codec microbench + socket RTT):
+
+* framing     — ``ingress.decode`` + ``ingress.reply`` (codec seams);
+* socket      — ``wire.return`` (the measured socket leg home; the
+  outbound leg hides inside router.attempt's wire wait);
+* scheduling  — ``router.queue`` + ``batch.wait`` (time spent waiting
+  for a thread or a batch slot, not moving bytes).
+
+So ``serving_bench``'s analytical split and this tool's measured split
+cross-check each other: derived from traces alone, no benchmark run
+needed.
+
+Stage spans may overlap (``router.attempt`` contains the replica-side
+spans), so shares are reported against the root request span, not
+summed to 100%.
+"""
+from __future__ import annotations
+
+import argparse
+import json
+import sys
+from typing import Dict, List
+
+# stage -> serving_bench overhead bucket
+_BUCKETS = {
+    "ingress.decode": "framing",
+    "ingress.reply": "framing",
+    "wire.return": "socket",
+    "router.queue": "scheduling",
+    "batch.wait": "scheduling",
+}
+
+# presentation order; anything else observed is appended alphabetically
+_STAGE_ORDER = ["ingress.decode", "router.queue", "router.attempt",
+                "batch.wait", "dispatch", "wire.return", "ingress.reply",
+                "request"]
+
+
+def _pctl(xs: List[float], q: float) -> float:
+    if not xs:
+        return 0.0
+    xs = sorted(xs)
+    i = min(int(q * len(xs)), len(xs) - 1)
+    return xs[i]
+
+
+def load_traces(paths) -> tuple:
+    """Parse dump files -> (traces, events). Unparseable lines are
+    counted, not fatal — dumps happen at crash time."""
+    traces, events, bad = [], [], 0
+    for path in paths:
+        with open(path) as f:
+            for line in f:
+                line = line.strip()
+                if not line:
+                    continue
+                try:
+                    obj = json.loads(line)
+                except ValueError:
+                    bad += 1
+                    continue
+                if "trace_id" in obj and "spans" in obj:
+                    traces.append(obj)
+                elif "event" in obj:
+                    events.append(obj)
+    if bad:
+        print(f"warning: {bad} unparseable line(s) skipped",
+              file=sys.stderr)
+    return traces, events
+
+
+def stage_latencies(traces) -> Dict[str, List[float]]:
+    """stage name -> list of per-request durations (ms). A stage that
+    appears more than once in a trace (failover retries both
+    router.queue and router.attempt) contributes its SUM — the request
+    paid all of it."""
+    out: Dict[str, List[float]] = {}
+    for t in traces:
+        per: Dict[str, float] = {}
+        for s in t.get("spans", []):
+            name = s.get("name")
+            dur = s.get("dur")
+            if not isinstance(name, str) or \
+                    not isinstance(dur, (int, float)):
+                continue
+            per[name] = per.get(name, 0.0) + dur / 1e3
+        for name, ms in per.items():
+            out.setdefault(name, []).append(ms)
+    return out
+
+
+def report(traces, events) -> Dict:
+    stages = stage_latencies(traces)
+    roots = stages.get("request", [])
+    root_p50 = _pctl(roots, 0.50)
+
+    order = [s for s in _STAGE_ORDER if s in stages]
+    order += sorted(s for s in stages if s not in _STAGE_ORDER)
+
+    table = []
+    for name in order:
+        xs = stages[name]
+        p50 = _pctl(xs, 0.50)
+        table.append({
+            "stage": name, "n": len(xs),
+            "p50_ms": round(p50, 3),
+            "p99_ms": round(_pctl(xs, 0.99), 3),
+            "max_ms": round(max(xs), 3),
+            "share_of_request_p50": (round(p50 / root_p50, 3)
+                                     if root_p50 else None),
+        })
+
+    rollup = {"framing": 0.0, "socket": 0.0, "scheduling": 0.0}
+    for name, bucket in _BUCKETS.items():
+        rollup[bucket] += _pctl(stages.get(name, []), 0.50)
+
+    statuses: Dict[str, int] = {}
+    for t in traces:
+        st = t.get("status", "open")
+        statuses[st] = statuses.get(st, 0) + 1
+    ev_kinds: Dict[str, int] = {}
+    for e in events:
+        k = e.get("event", "?")
+        ev_kinds[k] = ev_kinds.get(k, 0) + 1
+
+    return {
+        "traces": len(traces),
+        "statuses": statuses,
+        "events": ev_kinds,
+        "stages": table,
+        # serving_bench stage-8 cross-check (measured, per-request p50)
+        "serving_ingress_overhead_framing_ms": round(rollup["framing"], 3),
+        "serving_ingress_overhead_socket_ms": round(rollup["socket"], 3),
+        "serving_ingress_overhead_scheduling_ms":
+            round(rollup["scheduling"], 3),
+    }
+
+
+def _print_table(rep: Dict) -> None:
+    print(f"{rep['traces']} trace(s); statuses: {rep['statuses']}")
+    if rep["events"]:
+        print(f"recorder events: {rep['events']}")
+    print()
+    hdr = f"{'stage':<16}{'n':>6}{'p50 ms':>10}{'p99 ms':>10}" \
+          f"{'max ms':>10}{'share':>8}"
+    print(hdr)
+    print("-" * len(hdr))
+    for row in rep["stages"]:
+        share = ("" if row["share_of_request_p50"] is None
+                 else f"{row['share_of_request_p50']:.0%}")
+        print(f"{row['stage']:<16}{row['n']:>6}{row['p50_ms']:>10.3f}"
+              f"{row['p99_ms']:>10.3f}{row['max_ms']:>10.3f}{share:>8}")
+    print()
+    print("overhead rollup (p50, serving_bench stage-8 buckets):")
+    for k in ("framing", "socket", "scheduling"):
+        print(f"  {k:<11} "
+              f"{rep[f'serving_ingress_overhead_{k}_ms']:.3f} ms")
+
+
+def main(argv=None) -> int:
+    ap = argparse.ArgumentParser(
+        prog="tools/latency_report.py",
+        description="per-stage p50/p99 decomposition from trace JSONL")
+    ap.add_argument("paths", nargs="+", help="trace dump file(s)")
+    ap.add_argument("--json", action="store_true",
+                    help="emit the report as JSON instead of a table")
+    args = ap.parse_args(argv)
+
+    traces, events = load_traces(args.paths)
+    if not traces:
+        print("no completed traces found", file=sys.stderr)
+        return 1
+    rep = report(traces, events)
+    if args.json:
+        print(json.dumps(rep, indent=2, sort_keys=True))
+    else:
+        _print_table(rep)
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())
