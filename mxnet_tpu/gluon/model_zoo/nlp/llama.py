@@ -19,8 +19,9 @@ from ... import nn
 from ...parameter import Parameter
 
 __all__ = ["RMSNorm", "LlamaAttention", "LlamaMLP", "LlamaBlock",
-           "LlamaModel", "llama_tiny", "llama_3_8b", "llama_sharding_rules",
-           "LlamaModelPP", "llama_tiny_pp", "llama_pp_sharding_rules"]
+           "LlamaModel", "LlamaDecodeEngine", "llama_tiny", "llama_3_8b",
+           "llama_sharding_rules", "LlamaModelPP", "llama_tiny_pp",
+           "llama_pp_sharding_rules"]
 
 
 class RMSNorm(HybridBlock):
@@ -141,6 +142,17 @@ class LlamaModel(HybridBlock):
                  ce_chunk=None, prefix=None, params=None):
         super().__init__(prefix=prefix, params=params)
         self._units = units
+        # architecture record for the paged decode engine (serving):
+        # everything the pure decode forward needs that the blocks
+        # otherwise keep in closed-over layer attributes
+        num_kv = num_kv_heads or num_heads
+        self._decode_cfg = {
+            "vocab_size": int(vocab_size), "num_layers": int(num_layers),
+            "units": int(units), "num_heads": int(num_heads),
+            "num_kv_heads": int(num_kv),
+            "head_dim": int(units // num_heads),
+            "rope_theta": float(rope_theta), "eps": float(eps),
+        }
         # per-block gradient rematerialization (jax.checkpoint) inside
         # compiled train steps — pretrain-scale memory policy. ``remat``
         # may be a bool (True = save-nothing "full" policy) or a policy
@@ -214,6 +226,19 @@ class LlamaModel(HybridBlock):
             return F._contrib_softmax_ce_head(h, w, None, labels,
                                               chunk=self._ce_chunk)
         return self.lm_head(h)
+
+    def decode_engine(self, pool, dtype: str = "float32"
+                      ) -> "LlamaDecodeEngine":
+        """Build the paged-KV decode engine for serving (the seam
+        ``serving.Server`` probes for to enable ``submit_generate``).
+        ``pool``: a :class:`mxnet_tpu.serving.kvcache.PagePool`."""
+        from ...parameter import DeferredInitializationError
+        try:
+            return LlamaDecodeEngine(self, pool, dtype=dtype)
+        except DeferredInitializationError:
+            from .... import nd
+            self(nd.zeros((1, 2), dtype="int32"))  # materialize shapes
+            return LlamaDecodeEngine(self, pool, dtype=dtype)
 
 
 class LlamaModelPP(HybridBlock):
@@ -299,6 +324,209 @@ def llama_sharding_rules(tp_axis="tp"):
         (r"(out|down)_weight$", P(None, tp_axis)),
         (r"(embed|lm_head)_weight$", P(tp_axis, None)),
     ])
+
+
+# ---------------------------------------------------------------------------
+# paged-KV decode engine (serving)
+# ---------------------------------------------------------------------------
+
+_DECODE_SITE = "serving_decode"
+
+
+def _paged_forward(params, tokens, positions, page_table, lengths,
+                   k_arena, v_arena, *, cfg, page_size):
+    """Pure cache-aware forward: embeds ``tokens`` (B, L) at absolute
+    ``positions`` (B, L), scatters each layer's K/V into the paged
+    arenas, attends through the page table, and returns the logits of
+    the LAST valid input position per row plus the updated arenas.
+
+    One function serves both phases — prefill is (B, len-bucket),
+    decode is (B, 1) — so both compile through the same cache site and
+    the decode step is ONE executable per batch bucket. Positions at or
+    beyond a row's ``lengths`` (bucket padding, whole-row batch
+    padding) scatter into the reserved scratch page 0 and are masked
+    out of every attention read — bit-transparent padding, extended to
+    the cache.
+    """
+    import jax
+    import jax.numpy as jnp
+
+    from ....ops.attention import paged_attention, rms_norm, rope_at
+
+    embed_w, layer_params, norm_w, head_w = params
+    n_heads = cfg["num_heads"]
+    n_kv = cfg["num_kv_heads"]
+    d = cfg["head_dim"]
+    theta = cfg["rope_theta"]
+    eps = cfg["eps"]
+    ps = int(page_size)
+    b, l = tokens.shape
+    w_pages = page_table.shape[1]
+
+    x = jnp.take(embed_w, tokens, axis=0)               # (B, L, U)
+    real = positions < lengths[:, None]
+    page_of = jnp.clip(positions // ps, 0, w_pages - 1)
+    page_ids = jnp.take_along_axis(page_table, page_of, axis=1)
+    slot = jnp.where(real, page_ids * ps + positions % ps,
+                     positions % ps)                    # padding -> scratch
+    slot_flat = slot.reshape(-1)
+
+    for li, (anw, qw, kvw, ow, mnw, guw, dw) in enumerate(layer_params):
+        h = rms_norm(x, anw, eps=eps)
+        q = (h @ qw.T).reshape(b, l, n_heads, d)
+        kv = (h @ kvw.T).reshape(b, l, 2 * n_kv, d)
+        k, v = kv[:, :, :n_kv], kv[:, :, n_kv:]
+        q = rope_at(q, positions, theta=theta)
+        k = rope_at(k, positions, theta=theta)
+        k_arena = k_arena.at[li, slot_flat].set(k.reshape(b * l, n_kv, d))
+        v_arena = v_arena.at[li, slot_flat].set(v.reshape(b * l, n_kv, d))
+        att = paged_attention(q.transpose(0, 2, 1, 3), k_arena[li],
+                              v_arena[li], page_table, lengths,
+                              q_positions=positions, page_size=ps)
+        att = att.transpose(0, 2, 1, 3).reshape(b, l, n_heads * d)
+        x = x + att @ ow.T
+        hm = rms_norm(x, mnw, eps=eps)
+        gate, up = jnp.split(hm @ guw.T, 2, axis=-1)
+        x = x + (jax.nn.silu(gate) * up) @ dw.T
+
+    hfin = rms_norm(x, norm_w, eps=eps)
+    # logits of the last REAL input row: axis index lengths-1-positions[:,0]
+    # (prefill: lengths-1; decode L=1: always 0). Whatever L, this is a
+    # (B, U) @ (U, V) contraction — the same lowering for both phases.
+    last = jnp.clip(lengths - 1 - positions[:, 0], 0, l - 1)
+    h_last = jnp.take_along_axis(
+        hfin, last[:, None, None].astype(jnp.int32), axis=1)[:, 0]
+    return h_last @ head_w.T, k_arena, v_arena
+
+
+class LlamaDecodeEngine:
+    """Cache-aware generation engine over one :class:`LlamaModel`.
+
+    Owns the per-replica K/V arenas (pages allocated from ``pool``) and
+    dispatches :func:`_paged_forward` through the compiler service's
+    ``serving_decode`` cache site: one executable per (batch-bucket,
+    len-bucket) prefill signature, ONE ``(batch, 1)`` executable per
+    batch bucket for every decode step — zero steady-state retraces
+    (``mxnet_jit_cache_total{cache="serving_decode"}`` is the marker).
+
+    Not thread-safe by design: exactly one scheduler thread drives it
+    (the :class:`~mxnet_tpu.serving.server.Server` contract).
+    """
+
+    def __init__(self, model, pool, dtype: str = "float32"):
+        from ....serving.kvcache import make_kv_arena
+
+        self.cfg = dict(model._decode_cfg)
+        self.pool = pool
+        self.page_size = pool.page_size
+        self.dtype = dtype
+        self._ident = ("llama", tuple(sorted(self.cfg.items())), dtype)
+        self.k_arena, self.v_arena = make_kv_arena(
+            self.cfg["num_layers"], pool, self.cfg["num_kv_heads"],
+            self.cfg["head_dim"], dtype)
+        self.refresh_params(model)
+
+    def refresh_params(self, model) -> None:
+        """(Re)extract the weight arrays — called at build and after a
+        model swap once no in-flight generate still needs the old
+        weights (a request's whole completion runs on ONE version)."""
+        import jax.numpy as jnp
+
+        def w(p):
+            return jnp.asarray(p.data().data, dtype=self.dtype)
+
+        self._params = (
+            w(model.embed.weight),
+            tuple((w(blk.attn_norm.weight), w(blk.attention.q_proj.weight),
+                   w(blk.attention.kv_proj.weight),
+                   w(blk.attention.out_proj.weight),
+                   w(blk.mlp_norm.weight), w(blk.mlp.gate_up.weight),
+                   w(blk.mlp.down.weight))
+                  for blk in model.blocks),
+            w(model.norm.weight), w(model.lm_head.weight))
+
+    # -- dispatch ------------------------------------------------------
+    def _fn(self, b, l, w_pages):
+        import functools
+
+        import jax
+
+        from ....compiler import service as _csvc
+        from ....compiler import signature
+
+        cache = _csvc.shared_cache(_DECODE_SITE)
+        key = signature(
+            _DECODE_SITE, self._ident,
+            avals=((b, l), (b, w_pages), self.dtype),
+            attrs=(self.page_size,), platform=jax.default_backend())
+        fn = cache.lookup(key)
+        if fn is not cache.MISS:
+            return fn
+        # CPU XLA does not honor donation (it would warn per call);
+        # elsewhere the arenas are donated so the scatter updates alias
+        jit_kw = {} if jax.default_backend() == "cpu" \
+            else {"donate_argnums": (5, 6)}
+        fn = jax.jit(functools.partial(_paged_forward, cfg=self.cfg,
+                                       page_size=self.page_size), **jit_kw)
+        cache.insert(key, fn)
+        return fn
+
+    def forward(self, tokens, positions, page_table, lengths):
+        """Run one cache-aware forward; numpy in, numpy logits (B, vocab)
+        out; the arenas advance in place (functionally)."""
+        import jax.numpy as jnp
+        import numpy as _np
+
+        tokens = _np.asarray(tokens, dtype=_np.int32)
+        fn = self._fn(tokens.shape[0], tokens.shape[1],
+                      _np.shape(page_table)[1])
+        logits, self.k_arena, self.v_arena = fn(
+            self._params, jnp.asarray(tokens),
+            jnp.asarray(_np.asarray(positions, dtype=_np.int32)),
+            jnp.asarray(_np.asarray(page_table, dtype=_np.int32)),
+            jnp.asarray(_np.asarray(lengths, dtype=_np.int32)),
+            self.k_arena, self.v_arena)
+        return _np.asarray(logits)
+
+    def prefill(self, tokens, lengths, page_table):
+        """Prefill (B, len-bucket) prompts; ``lengths`` are the real
+        prompt lengths. Returns the next-token logits per row."""
+        import numpy as _np
+
+        b, l = _np.shape(tokens)
+        positions = _np.broadcast_to(_np.arange(l, dtype=_np.int32), (b, l))
+        return self.forward(tokens, positions, page_table, lengths)
+
+    def decode_step(self, tokens, lengths, page_table):
+        """One continuous-batching decode step: ``tokens`` (B,) are the
+        rows' newest tokens, already counted in ``lengths``. ONE
+        (B, 1)-shaped executable regardless of how deep each row is."""
+        import numpy as _np
+
+        tokens = _np.asarray(tokens, dtype=_np.int32).reshape(-1, 1)
+        positions = (_np.asarray(lengths, dtype=_np.int32) - 1
+                     ).reshape(-1, 1)
+        return self.forward(tokens, positions, page_table, lengths)
+
+    def forward_full(self, tokens):
+        """No-cache full-recompute oracle: run the whole (B, L) prefix
+        through scratch pages and return the next-token logits. Frees
+        its pages before returning — the O(n²) baseline path."""
+        import numpy as _np
+
+        tokens = _np.asarray(tokens, dtype=_np.int32)
+        b, l = tokens.shape
+        owners = [object() for _ in range(b)]
+        width = self.pool.pages_for(l)
+        table = _np.zeros((b, width), dtype=_np.int32)
+        try:
+            for i, o in enumerate(owners):
+                table[i] = self.pool.alloc(o, l)
+            return self.prefill(tokens,
+                                _np.full((b,), l, dtype=_np.int32), table)
+        finally:
+            for o in owners:
+                self.pool.free(o)
 
 
 def llama_tiny(**kwargs):

@@ -164,6 +164,111 @@ def rms_norm(data, weight, *, eps=1e-6):
     return (x32 * inv).astype(data.dtype) * weight
 
 
+def _paged_reference(q, k_arena, v_arena, page_table, lengths,
+                     q_positions, page_size, scale):
+    """Eager paged attention: gather K/V rows through the page table,
+    then masked f32-softmax attention. The CPU oracle for the Pallas
+    paged kernel, and the decode path everywhere off-TPU."""
+    b, h, lq, d = q.shape
+    kv = k_arena.shape[-2]
+    ps = int(page_size)
+    # flat slot indices for every token position the tables can reach:
+    # token i of row b lives at page_table[b, i//ps]*ps + i%ps
+    slots = (page_table[:, :, None] * ps
+             + jnp.arange(ps, dtype=page_table.dtype)[None, None, :])
+    slots = slots.reshape(b, -1)                        # (B, T)
+    k = jnp.take(k_arena, slots, axis=0)                # (B, T, KV, D)
+    v = jnp.take(v_arena, slots, axis=0)
+    if kv != h:
+        rep = h // kv
+        k = jnp.repeat(k, rep, axis=2)
+        v = jnp.repeat(v, rep, axis=2)
+    k = k.transpose(0, 2, 1, 3)                         # (B, H, T, D)
+    v = v.transpose(0, 2, 1, 3)
+    scores = jnp.einsum("bhqd,bhkd->bhqk", q, k)
+    scores = scores.astype(jnp.float32) * scale
+    key_pos = jnp.arange(slots.shape[1], dtype=jnp.int32)
+    # causal over the request's own timeline: key position <= query
+    # position (which is <= length-1 for every real row). A padding row
+    # (length 0, position 0) sees only scratch key 0 — garbage, sliced
+    # away by the batcher before any caller looks.
+    mask = key_pos[None, None, None, :] <= \
+        q_positions[:, None, :, None]
+    mask = mask & (key_pos[None, None, None, :]
+                   < lengths[:, None, None, None])
+    scores = jnp.where(mask, scores, jnp.float32(-1e9))
+    probs = jax.nn.softmax(scores, axis=-1).astype(q.dtype)
+    return jnp.einsum("bhqk,bhkd->bhqd", probs, v)
+
+
+@register("_contrib_paged_attention", aliases=["paged_attention"])
+def paged_attention(query, k_arena, v_arena, page_table, lengths,
+                    q_positions=None, *, page_size, scale=None):
+    """Attention over a paged KV cache (serving decode path).
+
+    ``query``: (B, H, Lq, D); ``k_arena``/``v_arena``: (slots, KV, D) —
+    ONE layer's arena from :func:`mxnet_tpu.serving.kvcache.make_kv_arena`;
+    ``page_table``: (B, P) int32 page ids (scratch page 0 pads the
+    tail); ``lengths``: (B,) int32 tokens valid per row INCLUDING the
+    current query tokens; ``q_positions``: (B, Lq) absolute positions of
+    the query rows (default: the trailing positions, i.e.
+    ``lengths - Lq + arange(Lq)`` — the decode/prefill common case).
+
+    Under ``MXNET_PALLAS_FUSED=1`` the single-query decode shape routes
+    to the Pallas paged kernel on TPU when eligible
+    (pallas_kernels/paged_attention.py); everything else runs the eager
+    gather, which doubles as the kernel's bit-oracle.
+    """
+    if scale is None:
+        scale = 1.0 / math.sqrt(query.shape[-1])
+    lq = query.shape[2]
+    if q_positions is None:
+        q_positions = (lengths[:, None] - lq
+                       + jnp.arange(lq, dtype=lengths.dtype)[None, :])
+    from ..pallas_kernels.fused_layers import fused_layers_enabled
+    from ..pallas_kernels.paged_attention import (paged_attention_kernel,
+                                                  paged_supported)
+
+    if lq == 1 and fused_layers_enabled() \
+            and paged_supported(query, k_arena, page_size):
+        from .. import telemetry
+
+        telemetry.record_pallas_dispatch("paged_attention")
+        return paged_attention_kernel(query, k_arena, v_arena,
+                                      page_table, lengths,
+                                      page_size=page_size, scale=scale)
+    return _paged_reference(query, k_arena, v_arena, page_table, lengths,
+                            q_positions, page_size, scale)
+
+
+def rope_at(data, positions, *, theta=10000.0, interleaved=False):
+    """:func:`rope` with explicit per-row absolute positions —
+    ``positions`` (B, L) int — the decode-step form, where every row of
+    the batch sits at a different depth of its own sequence. Bitwise
+    identical to :func:`rope` when
+    ``positions == offset + arange(L)`` broadcast over the batch (the
+    cos/sin tables are built from positions the same way)."""
+    b, l, h, d = data.shape
+    pos = positions.astype(jnp.float32)                  # (B, L)
+    inv_freq = 1.0 / (theta ** (jnp.arange(0, d, 2, dtype=jnp.float32) / d))
+    angles = pos[:, :, None] * inv_freq[None, None, :]   # (B, L, D/2)
+    cos = jnp.cos(angles)[:, :, None, :]
+    sin = jnp.sin(angles)[:, :, None, :]
+    if interleaved:
+        x1 = data[..., 0::2].astype(jnp.float32)
+        x2 = data[..., 1::2].astype(jnp.float32)
+    else:
+        x1 = data[..., : d // 2].astype(jnp.float32)
+        x2 = data[..., d // 2:].astype(jnp.float32)
+    r1 = x1 * cos - x2 * sin
+    r2 = x2 * cos + x1 * sin
+    if interleaved:
+        out = jnp.stack([r1, r2], axis=-1).reshape((b, l, h, d))
+    else:
+        out = jnp.concatenate([r1, r2], axis=-1)
+    return out.astype(data.dtype)
+
+
 @register("_contrib_rope", aliases=["rope"])
 def rope(data, *, theta=10000.0, position_offset=0, interleaved=False):
     """Rotary position embedding over (B, L, H, D).

@@ -15,9 +15,13 @@ from .fused_layers import (fused_bias_gelu, fused_layer_norm,
                            fused_ln_supported, fused_rms_norm)
 from .fused_optimizer import (fused_opt_enabled, fused_opt_supported,
                               sweep_pallas)
+from .paged_attention import (paged_attention_kernel,
+                              paged_shape_supported, paged_supported)
 
 __all__ = ["flash_attention", "flash_attention_scan", "flash_supported",
            "flash_shape_supported", "fused_layer_norm", "fused_rms_norm",
            "fused_bias_gelu", "fused_layers_enabled",
            "fused_ln_shape_supported", "fused_ln_supported",
-           "fused_opt_enabled", "fused_opt_supported", "sweep_pallas"]
+           "fused_opt_enabled", "fused_opt_supported", "sweep_pallas",
+           "paged_attention_kernel", "paged_shape_supported",
+           "paged_supported"]

@@ -1,0 +1,157 @@
+"""Paged single-query (decode) attention kernel.
+
+The decode step of an autoregressive request attends one query row
+against every cached K/V token of that request, where the cache lives
+in fixed-size pages of a shared arena (:mod:`mxnet_tpu.serving.kvcache`)
+addressed through a per-request page table. The kernel is the
+vLLM-style shape of that read: grid ``(batch, n_pages)``, the page
+table scalar-prefetched so the BlockSpec index map steers each grid
+step's DMA straight at the right arena page — no gather materializes,
+no (batch, max_len) K/V copy exists, and VMEM holds one page of K and V
+per step. Online softmax accumulates across the page axis exactly like
+the flash kernels (f32 statistics, rescale-by-alpha per block).
+
+Eligibility mirrors flash_attention: ``paged_supported`` gates on TPU
+execution (``base.current_execution_platform``) plus Mosaic-friendly
+shapes — head_dim a multiple of 128 and page_size a multiple of 8 (the
+(sublane, lane) tile of an f32 page block). The eager gather in
+``ops/attention.py`` (``_contrib_paged_attention``'s reference path) is
+the bit-oracle; CPU tests run this kernel in ``interpret=True`` mode
+against it.
+"""
+from __future__ import annotations
+
+import functools
+
+import jax
+import jax.numpy as jnp
+
+__all__ = ["paged_attention_kernel", "paged_supported",
+           "paged_shape_supported"]
+
+
+def paged_shape_supported(q, k_arena, page_size: int) -> bool:
+    """Platform-independent shape eligibility: one query row per batch
+    element, f32-tileable page blocks, and a head grouping the MXU can
+    contract without relayout."""
+    if q.ndim != 4 or q.shape[2] != 1:
+        return False            # decode kernel: exactly one query row
+    d = q.shape[-1]
+    h = q.shape[1]
+    kv = k_arena.shape[-2]
+    if d % 128 or d != k_arena.shape[-1]:
+        return False
+    if page_size % 8 or k_arena.shape[0] % page_size:
+        return False
+    return h % kv == 0
+
+
+def paged_supported(q, k_arena, page_size: int) -> bool:
+    """TPU execution + shape eligibility (same contract as
+    ``flash_supported``: platform comes from the framework's jit entry
+    points, so a CPU-context op never takes the kernel path)."""
+    from ..base import current_execution_platform
+
+    if current_execution_platform(q) != "tpu":
+        return False
+    return paged_shape_supported(q, k_arena, page_size)
+
+
+def _decode_kernel(pt_ref, len_ref, q_ref, k_ref, v_ref, o_ref,
+                   acc_ref, stat_ref, *, scale, page_size, n_pages_req,
+                   h, kv, d):
+    """One (batch row, page) grid step: score the query heads against
+    this page's keys, fold into the online-softmax accumulator, emit on
+    the last page."""
+    from jax.experimental import pallas as pl
+
+    b = pl.program_id(0)
+    j = pl.program_id(1)
+
+    @pl.when(j == 0)
+    def _init():
+        acc_ref[...] = jnp.zeros_like(acc_ref)
+        stat_ref[0, :] = jnp.full((h,), -jnp.inf, jnp.float32)
+        stat_ref[1, :] = jnp.zeros((h,), jnp.float32)
+
+    q = q_ref[0].astype(jnp.float32) * scale        # (H, D)
+    k = k_ref[...].astype(jnp.float32)              # (ps, KV, D)
+    v = v_ref[...].astype(jnp.float32)
+    rep = h // kv
+    # GQA without materializing repeated keys: group q rows per kv head
+    qg = q.reshape(kv, rep, d)
+    s = jax.lax.dot_general(qg, k,
+                            (((2,), (2,)), ((0,), (1,))))  # (KV, rep, ps)
+    s = s.reshape(h, page_size)
+    pos = j * page_size + jax.lax.broadcasted_iota(
+        jnp.int32, (h, page_size), 1)
+    valid = pos < len_ref[b]
+    s = jnp.where(valid, s, -jnp.inf)
+
+    m_prev = stat_ref[0, :]
+    l_prev = stat_ref[1, :]
+    m_new = jnp.maximum(m_prev, jnp.max(s, axis=1))
+    # a fully-masked page (tail pages of a short request) keeps m at
+    # -inf; exp(-inf - -inf) would be NaN — pin the rescale to 0/1
+    alpha = jnp.where(jnp.isfinite(m_prev), jnp.exp(m_prev - m_new), 0.0)
+    alpha = jnp.where(jnp.isfinite(m_new), alpha, 1.0)
+    p = jnp.where(valid, jnp.exp(s - m_new[:, None]), 0.0)  # (H, ps)
+    stat_ref[0, :] = m_new
+    stat_ref[1, :] = l_prev * alpha + jnp.sum(p, axis=1)
+    pv = jax.lax.dot_general(p.reshape(kv, rep, page_size), v,
+                             (((2,), (0,)), ((0,), (1,))))  # (KV, rep, D)
+    acc_ref[...] = acc_ref[...] * alpha[:, None] + pv.reshape(h, d)
+
+    @pl.when(j == n_pages_req - 1)
+    def _emit():
+        l = stat_ref[1, :]
+        l = jnp.where(l == 0.0, 1.0, l)     # padding row: all-masked
+        o_ref[0] = (acc_ref[...] / l[:, None]).astype(o_ref.dtype)
+
+
+def paged_attention_kernel(q, k_arena, v_arena, page_table, lengths, *,
+                           page_size: int, scale: float,
+                           interpret: bool = False):
+    """Decode attention over paged K/V.
+
+    ``q``: (B, H, 1, D); ``k_arena``/``v_arena``: (slots, KV, D) — ONE
+    layer's arena; ``page_table``: (B, P) int32 page ids (scratch page 0
+    pads the tail); ``lengths``: (B,) int32 valid tokens per row.
+    Returns (B, H, 1, D) in q's dtype.
+    """
+    from jax.experimental import pallas as pl
+    from jax.experimental.pallas import tpu as pltpu
+
+    b, h, _, d = q.shape
+    kv = k_arena.shape[-2]
+    n_pages_req = page_table.shape[1]
+    q3 = q.reshape(b, h, d)
+    kernel = functools.partial(
+        _decode_kernel, scale=float(scale), page_size=int(page_size),
+        n_pages_req=int(n_pages_req), h=h, kv=kv, d=d)
+    grid_spec = pltpu.PrefetchScalarGridSpec(
+        num_scalar_prefetch=2,
+        grid=(b, n_pages_req),
+        in_specs=[
+            pl.BlockSpec((1, h, d), lambda bi, j, pt, ln: (bi, 0, 0)),
+            # the scalar-prefetched page table steers each step's DMA:
+            # block index IS the page id (block size = one page)
+            pl.BlockSpec((page_size, kv, d),
+                         lambda bi, j, pt, ln: (pt[bi, j], 0, 0)),
+            pl.BlockSpec((page_size, kv, d),
+                         lambda bi, j, pt, ln: (pt[bi, j], 0, 0)),
+        ],
+        out_specs=pl.BlockSpec((1, h, d), lambda bi, j, pt, ln: (bi, 0, 0)),
+        scratch_shapes=[
+            pltpu.VMEM((h, d), jnp.float32),
+            pltpu.VMEM((2, h), jnp.float32),
+        ],
+    )
+    out = pl.pallas_call(
+        kernel,
+        grid_spec=grid_spec,
+        out_shape=jax.ShapeDtypeStruct((b, h, d), q.dtype),
+        interpret=interpret,
+    )(page_table.astype(jnp.int32), lengths.astype(jnp.int32),
+      q3, k_arena, v_arena)
+    return out.reshape(b, h, 1, d)

@@ -15,6 +15,17 @@ live, ``FleetController`` drives them from the router's own traffic
 signals, and ``rolling_upgrade`` walks a new model through the fleet
 with breaker-gated automatic rollback (see :mod:`.controller`).
 
+The stack also serves **autoregressive decode** with continuous
+batching: ``Server.submit_generate() -> GenerateHandle`` streams
+tokens as they are produced, per-request KV state lives in a paged
+``PagePool`` (:mod:`.kvcache`), prefill lands on the ``BucketGrid``'s
+length buckets, and every decode step for every in-flight request
+rejoins one warm ``(batch, 1)`` executable — zero steady-state
+retraces. Capacity exhaustion is a synchronous typed ``CacheFull``.
+The same contract crosses the process boundary: ``RemoteReplica``,
+``Router`` and ``IngressClient`` all expose ``submit_generate`` with
+token streaming over the wire.
+
 The fleet is also **crash-isolated**: a replica may be an
 out-of-process worker (``RemoteReplica`` over
 ``python -m mxnet_tpu.serving.worker``, one supervised OS process per
@@ -30,7 +41,7 @@ ride the PR-1/PR-3 infrastructure; see :mod:`.server`,
 :mod:`.buckets`, :mod:`.reload`, :mod:`.router`, :mod:`.health`,
 :mod:`.wire`, :mod:`.worker`, :mod:`.remote`, :mod:`.ingress`.
 """
-from .buckets import BucketGrid
+from .buckets import DEFAULT_LEN_BUCKETS, BucketGrid
 from .controller import (
     FleetController,
     FleetSignals,
@@ -47,6 +58,7 @@ from .ingress import (
     IngressDisconnected,
     live_ingresses,
 )
+from .kvcache import CacheFull, PagePool
 from .reload import ReloadWatcher
 from .remote import RemoteReplica, WorkerCrashed, live_workers
 from .router import (
@@ -56,10 +68,11 @@ from .router import (
     ServerOverloaded,
     live_routers,
 )
-from .server import Server, live_servers
+from .server import GenerateHandle, Server, live_servers
 
 __all__ = [
     "Server", "BucketGrid", "ReloadWatcher", "live_servers",
+    "GenerateHandle", "PagePool", "CacheFull", "DEFAULT_LEN_BUCKETS",
     "Router", "ServerOverloaded", "FailoverExhausted", "ReplicaFault",
     "CircuitBreaker", "Heartbeat", "live_routers",
     "FleetController", "FleetSignals", "ScalePolicy",

@@ -14,6 +14,15 @@ path instead of executors — two axes:
   zero-padded up to the smallest bucket that fits (same rank, every dim
   >=), the BucketingModule move. ``None`` = exact-shape mode: no sample
   padding, one compiled entry per distinct sample shape seen.
+* **len buckets** — allowed PREFILL lengths for autoregressive
+  generate requests. The generate key space is (batch, prefill-len,
+  decode-step): prefill dispatches compile per (batch bucket, len
+  bucket), while the decode-step axis collapses to the single constant
+  ``(batch, 1)`` signature — however deep each co-batched request is in
+  its own completion, every decode step lands on ONE warm executable
+  per batch bucket (zero steady-state retraces). Requests at different
+  decode depths are equal-shaped by construction, which is what lets
+  continuous batching re-form the batch every step.
 
 Padding is part of the serving contract exactly as it was for
 BucketingModule: the model sees the padded input (a bucketed sequence
@@ -39,18 +48,30 @@ from ..base import MXNetError
 __all__ = ["BucketGrid"]
 
 DEFAULT_BATCH_BUCKETS = (1, 2, 4, 8, 16, 32)
+DEFAULT_LEN_BUCKETS = (16, 32, 64, 128, 256)
 
 
 class BucketGrid:
-    """The (batch buckets x shape buckets) padding grid.
+    """The (batch buckets x shape buckets x len buckets) padding grid.
 
     ``batch_buckets``: positive ints; dispatches are padded up to the
     smallest bucket >= the drained request count (capped at the largest).
     ``shape_buckets``: sample-shape tuples, or None for exact-shape mode.
+    ``len_buckets``: allowed prefill lengths for generate requests, or
+    None when the server does no autoregressive decode.
     """
 
     def __init__(self, batch_buckets: Sequence[int] = DEFAULT_BATCH_BUCKETS,
-                 shape_buckets: Optional[Sequence[Tuple[int, ...]]] = None):
+                 shape_buckets: Optional[Sequence[Tuple[int, ...]]] = None,
+                 len_buckets: Optional[Sequence[int]] = None):
+        self.len_buckets: Optional[Tuple[int, ...]] = None
+        if len_buckets is not None:
+            lens = sorted({int(b) for b in len_buckets})
+            if not lens or lens[0] < 1:
+                raise MXNetError(
+                    f"len_buckets must be positive ints, got "
+                    f"{len_buckets!r}")
+            self.len_buckets = tuple(lens)
         buckets = sorted({int(b) for b in batch_buckets})
         if not buckets or buckets[0] < 1:
             raise MXNetError(
@@ -83,6 +104,33 @@ class BucketGrid:
             if b >= n:
                 return b
         return self.max_batch
+
+    def prefill_bucket(self, length: int) -> int:
+        """Smallest len bucket >= ``length`` — the padded prefill
+        length of a generate request. Raises :class:`MXNetError` when
+        the grid has no len buckets or the prompt outgrows the largest
+        (rejected at submit, not discovered as a retrace mid-serve)."""
+        if self.len_buckets is None:
+            raise MXNetError("this grid has no len_buckets: the server "
+                             "was not configured for generate requests")
+        for b in self.len_buckets:
+            if b >= length:
+                return b
+        raise MXNetError(
+            f"no len bucket fits prompt length {length}; buckets: "
+            f"{list(self.len_buckets)}")
+
+    def generate_signatures(self) -> List[Tuple[int, int]]:
+        """Every (batch_bucket, len) input signature of the generate
+        key space: the prefill grid plus the single decode-step column
+        ``(batch, 1)`` — the warmup manifest for a decode-capable
+        server."""
+        if self.len_buckets is None:
+            return []
+        sigs = [(b, l) for l in self.len_buckets
+                for b in self.batch_buckets]
+        sigs += [(b, 1) for b in self.batch_buckets]
+        return sigs
 
     def bucket_shape(self, shape: Sequence[int]) -> Tuple[int, ...]:
         """The padded sample shape for a request of ``shape``: the

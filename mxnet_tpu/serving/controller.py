@@ -108,6 +108,8 @@ class FleetSignals:
     predicted_wait_s: float  # admission controller's estimate (0 = none)
     slo_s: float             # the fleet's latency objective
     max_batch: int           # one replica's largest batch bucket
+    token_rate: float = 0.0  # decoded tokens/s over the last window
+    #                          (0.0 when the fleet serves no generates)
 
     @property
     def utilization(self) -> float:
@@ -138,7 +140,10 @@ class ScrapeFleetSignals:
     ``mxnet_serving_predicted_wait_seconds``,
     ``mxnet_controller_fleet_size``) plus the
     ``mxnet_serving_shed_total`` counter, whose between-scrape delta is
-    computed here (counters are cumulative on the wire). ``slo_s`` and
+    computed here (counters are cumulative on the wire), and the
+    ``mxnet_serving_tokens_total`` counter, rated into decode
+    tokens/s over the scrape window (``FleetSignals.token_rate``; 0.0
+    on a fleet that serves no generates). ``slo_s`` and
     ``max_batch`` are deploy-time configuration, not scrapable state.
 
     A failed scrape returns ``None`` — the controller skips that tick
@@ -168,6 +173,10 @@ class ScrapeFleetSignals:
         self.router_label = ({"router": router} if router is not None
                              else None)
         self._last_shed: Optional[float] = None
+        # decode token-rate window: previous tokens_total reading and
+        # when it was taken (same reset-clamp rule as the shed counter)
+        self._last_tokens: Optional[float] = None
+        self._last_tokens_t: float = 0.0
         self.n_scrapes = 0
         self.n_failures = 0
 
@@ -188,6 +197,16 @@ class ScrapeFleetSignals:
             # stale pressure must not survive a restart
             delta = max(shed - self._last_shed, 0.0)
         self._last_shed = shed
+        now = time.monotonic()
+        tokens = telemetry.prom_value(
+            parsed, "mxnet_serving_tokens_total", default=0.0)
+        if self._last_tokens is None or now <= self._last_tokens_t:
+            token_rate = 0.0    # first scrape: no window to rate over
+        else:
+            token_rate = (max(tokens - self._last_tokens, 0.0)
+                          / (now - self._last_tokens_t))
+        self._last_tokens = tokens
+        self._last_tokens_t = now
         n_replicas = telemetry.prom_value(
             parsed, "mxnet_controller_fleet_size",
             labels=self.router_label, default=-1.0)
@@ -209,7 +228,8 @@ class ScrapeFleetSignals:
             predicted_wait_s=telemetry.prom_value(
                 parsed, "mxnet_serving_predicted_wait_seconds",
                 labels=self.router_label),
-            slo_s=self.slo_s, max_batch=self.max_batch)
+            slo_s=self.slo_s, max_batch=self.max_batch,
+            token_rate=token_rate)
 
 
 class ScalePolicy:
@@ -368,6 +388,8 @@ class FleetController:
         self.name = name or f"controller_{id(self):x}"
         self._spawned = 0           # factory indices, never reused
         self._last_shed = router.n_shed
+        self._last_tokens = self._fleet_tokens()
+        self._last_tokens_t = time.monotonic()
         self._stop = threading.Event()
         self._thread: Optional[threading.Thread] = None
         # light counters
@@ -425,11 +447,26 @@ class FleetController:
                 _log.exception("%s: tick failed (contained)", self.name)
 
     # -- one control iteration -----------------------------------------
+    def _fleet_tokens(self) -> int:
+        """Fleet-wide decoded-token counter (in-process servers only —
+        a RemoteReplica's tokens are scrape territory, see
+        :class:`ScrapeFleetSignals`)."""
+        return sum(getattr(rep.server, "n_tokens", 0)
+                   for rep in self.router._replicas)
+
     def signals(self) -> FleetSignals:
         r = self.router
         shed = r.n_shed
         delta = shed - self._last_shed
         self._last_shed = shed
+        now = time.monotonic()
+        tokens = self._fleet_tokens()
+        dt = now - self._last_tokens_t
+        # a removed replica takes its counter with it: clamp, same as
+        # the scrape source does on counter reset
+        token_rate = (max(tokens - self._last_tokens, 0) / dt
+                      if dt > 0 else 0.0)
+        self._last_tokens, self._last_tokens_t = tokens, now
         with r._cond:
             depth = len(r._queue)
             inflight = r._n_inflight
@@ -437,7 +474,7 @@ class FleetController:
             n_replicas=r.fleet_size(), queue_depth=depth,
             inflight=inflight, shed_delta=delta,
             predicted_wait_s=r.predicted_wait(), slo_s=r.slo_s,
-            max_batch=r.grid.max_batch)
+            max_batch=r.grid.max_batch, token_rate=token_rate)
 
     def tick(self) -> Optional[str]:
         """Observe, decide, act (at most one scale action). Returns

@@ -55,6 +55,8 @@ import weakref
 from concurrent.futures import Future
 from typing import Optional
 
+import numpy as np
+
 from .. import fault, telemetry, tracing
 from ..base import MXNetError
 from ..fault import _state as _fault_state
@@ -286,6 +288,8 @@ class Ingress:
                     return
                 if frame["kind"] == "submit":
                     self._handle_submit(conn, frame)
+                elif frame["kind"] == "generate":
+                    self._handle_generate(conn, frame)
                 elif frame["kind"] == "ping":
                     conn.send({"kind": "pong", "id": frame.get("id")})
                 # unknown kinds ignored (protocol growth)
@@ -384,12 +388,110 @@ class Ingress:
                                     else None))
         self._publish_conn_gauges()
 
+    def _handle_generate(self, conn: _Conn, frame: dict) -> None:
+        """One streaming generate over the edge: tokens go back as
+        ``token`` frames as the fleet decodes them, the ``gen_done``
+        finale carries the authoritative full array or the typed error
+        (``kvcache_full`` stays typed across the socket). A generate
+        occupies one slot of the connection's in-flight window for its
+        WHOLE completion — long completions are backpressure too."""
+        req_id = frame.get("id")
+        t0 = time.perf_counter()
+        if _fault_state.enabled:
+            try:
+                fault.check("serving.ingress", f"{self.name}")
+            except fault.FaultInjected as e:
+                self._reject(conn, req_id, "fault", e,
+                             kind="gen_done")
+                return
+        with conn.lock:
+            if conn.inflight >= self.window:
+                self._reject(conn, req_id, "window_full", MXNetError(
+                    f"{self.name}: per-connection window "
+                    f"({self.window} in flight) is full"),
+                    etype="overloaded", kind="gen_done")
+                return
+            conn.inflight += 1
+        tr = None
+        if _tracing_state.enabled:
+            tr = tracing.adopt(frame.get("trace"), ingress=self.name)
+            if tr is None:
+                tr = tracing.new_trace("generate", ingress=self.name)
+            dsp = tr.begin("ingress.decode", ingress=self.name)
+            dsp.ts -= int((time.perf_counter() - t0) * 1e6)
+            dsp.end()
+
+        def on_token(i, token):
+            conn.send({"kind": "token", "id": req_id, "i": int(i),
+                       "token": int(token)})
+
+        try:
+            if tr is not None:
+                with tracing.active(tr, tr.root or tr.remote_parent):
+                    handle = self.router.submit_generate(
+                        frame["prompt"],
+                        int(frame["max_new_tokens"]),
+                        deadline_ms=frame.get("deadline_ms"),
+                        on_token=on_token)
+            else:
+                handle = self.router.submit_generate(
+                    frame["prompt"], int(frame["max_new_tokens"]),
+                    deadline_ms=frame.get("deadline_ms"),
+                    on_token=on_token)
+        except Exception as e:  # noqa: BLE001 - typed onto the wire
+            with conn.lock:
+                conn.inflight -= 1
+            etype, _msg = wire.encode_error(e)
+            reason = etype if etype in (
+                "overloaded", "failover_exhausted",
+                "kvcache_full") else "error"
+            if tr is not None:
+                tr.finish(reason)
+            self._reject(conn, req_id, reason, e, etype=etype,
+                         kind="gen_done")
+            return
+        self._publish_conn_gauges()
+        handle.future.add_done_callback(
+            lambda f, c=conn, i=req_id, t=t0, r=tr:
+            self._on_gen_done(c, i, f, t, r))
+
+    def _on_gen_done(self, conn: _Conn, req_id, fut, t0: float,
+                     tr=None) -> None:
+        with conn.lock:
+            conn.inflight -= 1
+        rts = tracing.now_us() if tr is not None else 0
+        try:
+            payload = fut.result()
+        except Exception as e:  # noqa: BLE001 - typed onto the wire
+            etype, msg = wire.encode_error(e)
+            conn.send({"kind": "gen_done", "id": req_id, "ok": False,
+                       "etype": etype, "error": msg})
+            if tr is not None:
+                tr.add_raw("ingress.reply", ts=rts,
+                           dur=tracing.now_us() - rts, etype=etype)
+                tr.finish(type(e).__name__)
+            self._count_request("error", t0, trace_id=(
+                tr.trace_id if tr is not None else None))
+        else:
+            delivered = conn.send({"kind": "gen_done", "id": req_id,
+                                   "ok": True, "payload": payload})
+            if tr is not None:
+                tr.add_raw("ingress.reply", ts=rts,
+                           dur=tracing.now_us() - rts)
+                tr.finish("ok" if delivered else "undeliverable")
+            self._count_request("ok" if delivered else "undeliverable",
+                                t0, trace_id=(
+                                    tr.trace_id if tr is not None
+                                    else None))
+        self._publish_conn_gauges()
+
     # -- counters ------------------------------------------------------
     def _reject(self, conn: _Conn, req_id, reason: str,
-                exc: BaseException, etype: Optional[str] = None) -> None:
+                exc: BaseException, etype: Optional[str] = None,
+                kind: str = "result") -> None:
         if etype is None:
             etype, _ = wire.encode_error(exc)
-        conn.send({"kind": "result", "id": req_id, "ok": False,
+        conn.send({"kind": kind, "id": req_id, "ok": False,
                    "etype": etype, "error": str(exc)})
         self._count_rejected(reason)
 
@@ -460,6 +562,7 @@ class IngressClient:
                                         name="ingress-client-writer")
         self._lock = threading.Lock()
         self._futures: dict = {}
+        self._gens: dict = {}       # id -> GenerateHandle (streaming)
         self._next_id = 0
         self._closed = False
         self._reader = threading.Thread(
@@ -493,12 +596,61 @@ class IngressClient:
                 f"ingress connection lost at submit: {e}") from e
         return fut
 
+    def submit_generate(self, prompt, max_new_tokens: int,
+                        deadline_ms: Optional[float] = None,
+                        on_token=None):
+        """Same contract as :meth:`Router.submit_generate`, over the
+        socket: a :class:`~.server.GenerateHandle` whose tokens stream
+        in as the fleet decodes them (``on_token`` fires on this
+        client's reader thread) and whose future resolves from the
+        ``gen_done`` finale — result array, the SAME typed errors
+        (``CacheFull``, ``ServerOverloaded``), or
+        :class:`IngressDisconnected` if the connection drops
+        mid-stream."""
+        from .server import GenerateHandle
+
+        handle = GenerateHandle(on_token)
+        with self._lock:
+            if self._closed:
+                raise IngressDisconnected(
+                    "ingress connection is closed")
+            self._next_id += 1
+            req_id = self._next_id
+            self._gens[req_id] = handle
+        arr = np.ascontiguousarray(np.asarray(prompt),
+                                   dtype=np.int32).reshape(-1)
+        frame = {"kind": "generate", "id": req_id, "prompt": arr,
+                 "max_new_tokens": int(max_new_tokens)}
+        if deadline_ms is not None:
+            frame["deadline_ms"] = float(deadline_ms)
+        if _tracing_state.enabled:
+            amb = tracing.ambient()
+            if amb is not None:
+                frame["trace"] = amb[0].wire(amb[1])
+        try:
+            self._writer.send(frame)
+        except (OSError, wire.FrameError) as e:
+            self._fail_all(f"send failed: {e}")
+            raise IngressDisconnected(
+                f"ingress connection lost at submit: {e}") from e
+        return handle
+
     def _reader_loop(self) -> None:
         try:
             rf = wire.reader(self._sock)    # buffered read side
             while True:
                 frame = wire.recv_frame(rf)
-                if frame["kind"] != "result":
+                kind = frame["kind"]
+                if kind == "token":
+                    with self._lock:
+                        handle = self._gens.get(frame.get("id"))
+                    if handle is not None:
+                        handle._push(int(frame["token"]))
+                    continue
+                if kind == "gen_done":
+                    self._on_gen_done(frame)
+                    continue
+                if kind != "result":
                     continue
                 with self._lock:
                     fut = self._futures.pop(frame.get("id"), None)
@@ -514,22 +666,54 @@ class IngressClient:
         except (wire.FrameError, OSError) as e:
             self._fail_all(f"connection lost: {e}")
 
+    def _on_gen_done(self, frame: dict) -> None:
+        with self._lock:
+            handle = self._gens.pop(frame.get("id"), None)
+        if handle is None:
+            return
+        if frame.get("ok"):
+            payload = np.asarray(frame.get("payload"),
+                                 dtype=np.int32)
+            # token frames are best-effort; the finale is authoritative
+            for i in range(len(handle.tokens()), payload.size):
+                handle._push(int(payload[i]))
+            try:
+                handle.future.set_result(payload)
+            except Exception:   # noqa: BLE001 - already resolved
+                pass
+        else:
+            try:
+                handle.future.set_exception(wire.decode_error(
+                    frame.get("etype", "mxnet_error"),
+                    frame.get("error", "ingress error")))
+            except Exception:   # noqa: BLE001 - already resolved
+                pass
+        handle._seal()
+
     def _fail_all(self, why: str) -> None:
         with self._lock:
             if self._closed:
-                pending = {}
+                pending, gens = {}, {}
             else:
                 self._closed = True
                 pending, self._futures = self._futures, {}
+                gens, self._gens = self._gens, {}
         exc = IngressDisconnected(
             f"ingress client: {why}; "
-            f"{len(pending)} request(s) were in flight")
+            f"{len(pending) + len(gens)} request(s) were in flight")
         for fut in pending.values():
             if fut.set_running_or_notify_cancel():
                 try:
                     fut.set_exception(exc)
                 except Exception:   # noqa: BLE001
                     pass
+        for h in gens.values():
+            if h.future.set_running_or_notify_cancel():
+                try:
+                    h.future.set_exception(exc)
+                except Exception:   # noqa: BLE001
+                    pass
+            h._seal()
         self._writer.close(flush=False, timeout=1.0)
         try:
             self._sock.close()

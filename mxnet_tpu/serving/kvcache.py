@@ -1,0 +1,240 @@
+"""Paged KV-cache pool for autoregressive decode (ROADMAP item 1).
+
+The reference's ``BucketingModule`` amortized *compilation* across
+sequence lengths but re-ran full-sequence compute every step; the
+modern answer is a KV cache, and the serving-grade shape of that cache
+is **paged** (vLLM's insight): keys/values live in fixed-size pages
+inside one preallocated per-replica arena, and each request owns a
+*list* of pages rather than a contiguous max-length slab. Continuous
+batching then composes freely — requests of wildly different lengths
+join and leave the decode batch at every step without copying or
+re-packing anybody's cache.
+
+This module is the **accounting** half: :class:`PagePool` hands out
+page ids from a free list, tracks per-owner page lists, and raises the
+typed :class:`CacheFull` when the arena cannot fit a request —
+admission control, wired into the Router's shed machinery exactly like
+``ServerOverloaded`` (shed reason ``kvcache_full``). The **storage**
+half is a pair of arena arrays (:func:`make_kv_arena`) indexed by flat
+slot: token ``i`` of a request whose page table is ``pt`` lives at slot
+``pt[i // page_size] * page_size + i % page_size``.
+
+Page 0 is **reserved as scratch**: batch-padding rows and padded tail
+positions scatter their (meaningless) K/V there, so a padded dispatch
+can write unconditionally without ever corrupting a live request's
+pages — the same bit-transparent-padding contract the batcher already
+guarantees (see :mod:`.buckets`).
+
+Fixed-size pages cannot fragment in the classical sense (any free page
+serves any request), but a long-lived fleet still wants
+:meth:`PagePool.defrag`: it computes the permutation that packs live
+pages down to the lowest indices (arena locality, and the precondition
+for shrinking an arena), and :func:`apply_defrag` replays that
+permutation onto the arena arrays.
+
+Telemetry (``MXNET_TELEMETRY=1``): every alloc/free publishes
+``mxnet_serving_kvcache_pages{state=free|used|reserved}``.
+"""
+from __future__ import annotations
+
+import threading
+from collections import deque
+from typing import Dict, List, Optional, Tuple
+
+import numpy as np
+
+from ..base import MXNetError
+from ..telemetry import _state as _telemetry_state
+
+__all__ = ["CacheFull", "PagePool", "make_kv_arena", "apply_defrag"]
+
+
+class CacheFull(MXNetError):
+    """Typed admission error: the KV arena cannot hold this request.
+
+    Raised synchronously at admission (never as a wedged future) and
+    shipped over :mod:`.wire` under the stable name ``kvcache_full`` so
+    a remote caller gets this exact type back. The Router counts it as
+    a shed (``mxnet_serving_shed_total{reason="kvcache_full"}``).
+    """
+
+
+class PagePool:
+    """Free-list allocator over ``n_pages`` fixed-size cache pages.
+
+    ``page_size`` is in tokens. Page 0 is reserved as the padding
+    scratch page and is never handed out. Thread-safe: the serving
+    scheduler allocates while ``stats()``/telemetry readers observe.
+    """
+
+    def __init__(self, n_pages: int, page_size: int = 16):
+        if n_pages < 2:
+            raise MXNetError(
+                f"PagePool needs >= 2 pages (page 0 is the reserved "
+                f"scratch page), got {n_pages}")
+        if page_size < 1:
+            raise MXNetError(f"page_size must be >= 1, got {page_size}")
+        self.n_pages = int(n_pages)
+        self.page_size = int(page_size)
+        self._lock = threading.Lock()
+        self._free: deque = deque(range(1, self.n_pages))
+        self._owned: Dict[object, List[int]] = {}
+        self._publish()
+
+    # -- capacity ------------------------------------------------------
+    @property
+    def slots(self) -> int:
+        """Total arena slots (tokens), scratch page included."""
+        return self.n_pages * self.page_size
+
+    @property
+    def capacity_tokens(self) -> int:
+        """Tokens the pool can hold for real requests (scratch excluded)."""
+        return (self.n_pages - 1) * self.page_size
+
+    def pages_for(self, n_tokens: int) -> int:
+        return -(-max(int(n_tokens), 1) // self.page_size)
+
+    # -- allocation ----------------------------------------------------
+    def alloc(self, owner, n_tokens: int) -> List[int]:
+        """Allocate pages covering ``n_tokens`` for ``owner``. Raises
+        :class:`CacheFull` (allocating nothing) when the free list is
+        short — admission is all-or-nothing, so a request can never
+        wedge half-allocated."""
+        need = self.pages_for(n_tokens)
+        with self._lock:
+            if owner in self._owned:
+                raise MXNetError(f"PagePool: owner {owner!r} already holds "
+                                 f"{len(self._owned[owner])} page(s)")
+            if need > len(self._free):
+                raise CacheFull(
+                    f"kv cache full: need {need} page(s) for {n_tokens} "
+                    f"token(s), {len(self._free)} of "
+                    f"{self.n_pages - 1} free")
+            pages = [self._free.popleft() for _ in range(need)]
+            self._owned[owner] = pages
+        self._publish()
+        return list(pages)
+
+    def extend(self, owner, n_tokens: int) -> List[int]:
+        """Grow ``owner``'s allocation to cover ``n_tokens`` total.
+        Raises :class:`CacheFull` without changing the allocation when
+        the free list cannot cover the growth."""
+        need = self.pages_for(n_tokens)
+        with self._lock:
+            held = self._owned.get(owner)
+            if held is None:
+                raise MXNetError(f"PagePool: unknown owner {owner!r}")
+            grow = need - len(held)
+            if grow <= 0:
+                return list(held)
+            if grow > len(self._free):
+                raise CacheFull(
+                    f"kv cache full: owner {owner!r} needs {grow} more "
+                    f"page(s), {len(self._free)} free")
+            held.extend(self._free.popleft() for _ in range(grow))
+            pages = list(held)
+        self._publish()
+        return pages
+
+    def free(self, owner) -> int:
+        """Return ``owner``'s pages to the free list (idempotent);
+        returns the number of pages released."""
+        with self._lock:
+            pages = self._owned.pop(owner, None)
+            if pages:
+                self._free.extend(pages)
+        self._publish()
+        return len(pages) if pages else 0
+
+    def page_table(self, owner, width: Optional[int] = None) -> np.ndarray:
+        """``owner``'s page list as an int32 vector padded with the
+        scratch page (0) up to ``width`` — the dense per-row page table
+        a batched dispatch gathers through."""
+        with self._lock:
+            pages = list(self._owned.get(owner, ()))
+        if width is None:
+            width = len(pages)
+        if len(pages) > width:
+            raise MXNetError(
+                f"PagePool: owner {owner!r} holds {len(pages)} page(s), "
+                f"page_table width {width} too small")
+        out = np.zeros((width,), dtype=np.int32)
+        out[:len(pages)] = pages
+        return out
+
+    # -- observability -------------------------------------------------
+    def stats(self) -> dict:
+        with self._lock:
+            used = sum(len(p) for p in self._owned.values())
+            return {"free": len(self._free), "used": used, "reserved": 1,
+                    "owners": len(self._owned),
+                    "page_size": self.page_size,
+                    "n_pages": self.n_pages}
+
+    def _publish(self) -> None:
+        if not _telemetry_state.enabled:
+            return
+        from .. import telemetry
+
+        s = self.stats()
+        telemetry.set_kvcache_pages(s["free"], s["used"], s["reserved"])
+
+    # -- defrag --------------------------------------------------------
+    def defrag(self) -> List[Tuple[int, int]]:
+        """Pack live pages down to the lowest page indices. Returns the
+        ``(src, dst)`` page moves performed (empty when already packed);
+        the caller replays them onto the arena with
+        :func:`apply_defrag` *before* the next dispatch reads it.
+        Accounting (page lists, free list) is updated here atomically.
+        """
+        with self._lock:
+            live = sorted(p for pages in self._owned.values()
+                          for p in pages)
+            # target: live pages occupy 1..len(live) in order
+            target = {src: dst for dst, src in
+                      enumerate(live, start=1) if src != dst}
+            if not target:
+                return []
+            moves = sorted(target.items(), key=lambda m: m[1])
+            for pages in self._owned.values():
+                for i, p in enumerate(pages):
+                    pages[i] = target.get(p, p)
+            n_live = len(live)
+            self._free = deque(range(n_live + 1, self.n_pages))
+            return moves
+
+
+def make_kv_arena(n_layers: int, pool: PagePool, n_kv_heads: int,
+                  head_dim: int, dtype="float32"):
+    """Preallocate the per-replica K and V arenas:
+    ``(n_layers, pool.slots, n_kv_heads, head_dim)`` zeros each.
+
+    The arenas are committed to a device (``device_put``) so their
+    sharding matches what jit outputs carry — an uncommitted zeros
+    array keys the first executable differently and forces a silent
+    one-time recompile on the second forward."""
+    import jax
+    import jax.numpy as jnp
+
+    shape = (int(n_layers), pool.slots, int(n_kv_heads), int(head_dim))
+    dev = jax.local_devices()[0]
+    return (jax.device_put(jnp.zeros(shape, dtype=dtype), dev),
+            jax.device_put(jnp.zeros(shape, dtype=dtype), dev))
+
+
+def apply_defrag(arena, moves, page_size: int):
+    """Replay :meth:`PagePool.defrag` page moves onto one arena array
+    (``(..., slots, heads, dim)`` with slots on axis 1). Moves are
+    applied from one snapshot, so overlapping src/dst chains are safe.
+    """
+    if not moves:
+        return arena
+    import jax.numpy as jnp
+
+    src = np.concatenate([np.arange(s * page_size, (s + 1) * page_size)
+                          for s, _ in moves])
+    dst = np.concatenate([np.arange(d * page_size, (d + 1) * page_size)
+                          for _, d in moves])
+    rows = jnp.take(arena, jnp.asarray(src), axis=1)
+    return arena.at[:, jnp.asarray(dst)].set(rows)

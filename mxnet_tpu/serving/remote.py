@@ -145,14 +145,25 @@ class RemoteReplica:
                  respawn_backoff_s: Optional[float] = None,
                  spawn_timeout_s: float = 180.0,
                  health_interval_s: float = 0.05,
-                 metrics_port: Optional[int] = None):
+                 metrics_port: Optional[int] = None,
+                 decode_pages: Optional[int] = None,
+                 page_size: int = 16,
+                 len_buckets: Optional[Sequence[int]] = None,
+                 max_generate_tokens: Optional[int] = None):
         if slo_ms <= 0:
             raise MXNetError(f"slo_ms must be > 0, got {slo_ms}")
         self.factory = factory
         self.factory_kwargs = dict(factory_kwargs or {})
         json.dumps(self.factory_kwargs)   # fail at construction, typed
         self.name = name
-        self.grid = BucketGrid(batch_buckets, shape_buckets)
+        self.decode_pages = decode_pages
+        self.page_size = int(page_size)
+        self.max_generate_tokens = max_generate_tokens
+        if decode_pages is not None and len_buckets is None:
+            from .buckets import DEFAULT_LEN_BUCKETS
+            len_buckets = DEFAULT_LEN_BUCKETS
+        self.grid = BucketGrid(batch_buckets, shape_buckets,
+                               len_buckets=len_buckets)
         self.slo_s = slo_ms / 1e3
         if batch_timeout_ms is not None and batch_timeout_ms <= 0:
             raise MXNetError(
@@ -189,6 +200,7 @@ class RemoteReplica:
         self._writer: Optional[wire.FrameWriter] = None
         self._lock = threading.Lock()
         self._futures: dict = {}      # id -> Future
+        self._gens: dict = {}         # id -> GenerateHandle (streaming)
         self._traces: dict = {}       # id -> Trace (tracing on only)
         self._next_id = 0
         self._incarnation = 0         # bumps per successful spawn
@@ -239,6 +251,14 @@ class RemoteReplica:
                "--health-interval", str(self.health_interval_s)]
         if self.batch_timeout_ms is not None:
             cmd += ["--batch-timeout-ms", str(self.batch_timeout_ms)]
+        if self.decode_pages is not None:
+            cmd += ["--decode-pages", str(self.decode_pages),
+                    "--page-size", str(self.page_size),
+                    "--len-buckets",
+                    ",".join(str(b) for b in self.grid.len_buckets)]
+            if self.max_generate_tokens is not None:
+                cmd += ["--max-generate-tokens",
+                        str(self.max_generate_tokens)]
         for p in self.python_paths:
             cmd += ["--path", p]
         if not self.warmup:
@@ -307,6 +327,14 @@ class RemoteReplica:
                     f"does not match the handle's "
                     f"{self.grid.batch_buckets}/{self.grid.shape_buckets}"
                     " — matched-bucket bit-identity would not hold")
+            if self.decode_pages is not None:
+                got_lens = (tuple(hello.get("len_buckets"))
+                            if hello.get("len_buckets") else None)
+                if got_lens != self.grid.len_buckets:
+                    raise MXNetError(
+                        f"{self.name}: worker len buckets {got_lens} do "
+                        f"not match the handle's {self.grid.len_buckets}"
+                        " — generate bit-identity would not hold")
             conn.settimeout(None)
             self.metrics_port = hello.get("metrics_port")
             writer = wire.FrameWriter(conn, name=f"{self.name}-writer")
@@ -347,6 +375,13 @@ class RemoteReplica:
                 kind = frame["kind"]
                 if kind == "result":
                     self._on_result(frame)
+                elif kind == "token":
+                    with self._lock:
+                        handle = self._gens.get(frame["id"])
+                    if handle is not None:
+                        handle._push(int(frame["token"]))
+                elif kind == "gen_done":
+                    self._on_gen_done(frame)
                 elif kind == "health":
                     # replay the worker scheduler's heartbeat age into
                     # this handle's beacon: the router's hung-dispatch
@@ -398,6 +433,7 @@ class RemoteReplica:
             self._down_handled = inc
             self._running = False
             pending, self._futures = self._futures, {}
+            gens, self._gens = self._gens, {}
             ptraces, self._traces = self._traces, {}
             sock, self._sock = self._sock, None
             writer, self._writer = self._writer, None
@@ -406,13 +442,17 @@ class RemoteReplica:
             # annotate BEFORE the futures fail: the finish-callbacks
             # seal these traces, and the crash is the explanation
             tr.note(f"worker {self.name} crashed: {why}")
-        self._close_and_fail(sock, writer, pending, WorkerCrashed(
+        crashed = WorkerCrashed(
             f"worker {self.name}: {why}; "
-            f"{len(pending)} request(s) were in flight"))
+            f"{len(pending) + len(gens)} request(s) were in flight")
+        self._close_and_fail(sock, writer, pending, crashed)
+        # streaming generates fail typed too — and are NEVER replayed
+        # (the caller may have consumed half the completion already)
+        self._fail_gens(gens, crashed)
         if stopping:
             return
         self.crash_count += 1
-        self.n_errors += len(pending)
+        self.n_errors += len(pending) + len(gens)
         if _tracing_state.enabled:
             tracing.record_event("crash", replica=self.name, why=why,
                                  inflight=len(pending))
@@ -555,6 +595,105 @@ class RemoteReplica:
                 frame.get("etype", "mxnet_error"),
                 frame.get("error", "worker error")))
 
+    # -- generate (paged-KV streaming) ---------------------------------
+    def submit_generate(self, prompt, max_new_tokens: int,
+                        deadline_ms: Optional[float] = None,
+                        on_token=None):
+        """Same contract as :meth:`Server.submit_generate`, across the
+        process boundary: a :class:`~.server.GenerateHandle` whose
+        tokens stream back as ``token`` frames (``on_token`` fires on
+        this handle's reader thread) and whose future resolves from the
+        final ``gen_done`` frame — with the worker's full token array
+        or typed error, or :class:`WorkerCrashed` the instant the
+        process dies mid-stream (never replayed: the caller may have
+        consumed half the completion)."""
+        from .server import GenerateHandle
+
+        if self.grid.len_buckets is None:
+            raise MXNetError(
+                f"{self.name}: worker was not configured for generate "
+                "(construct with decode_pages=)")
+        arr = prompt.asnumpy() if hasattr(prompt, "asnumpy") \
+            else np.asarray(prompt)
+        arr = np.ascontiguousarray(arr, dtype=np.int32).reshape(-1)
+        self.grid.prefill_bucket(arr.size)   # typed sync, not mid-serve
+        handle = GenerateHandle(on_token)
+        with self._lock:
+            if not self._running or self._writer is None:
+                self.n_requests += 1
+                raise MXNetError(
+                    f"{self.name}: worker process is not running")
+            self._next_id += 1
+            req_id = self._next_id
+            self._gens[req_id] = handle
+            writer = self._writer
+            inc = self._incarnation
+        self.n_requests += 1
+        frame = {"kind": "generate", "id": req_id, "prompt": arr,
+                 "max_new_tokens": int(max_new_tokens)}
+        if deadline_ms is not None:
+            frame["deadline_ms"] = float(deadline_ms)
+        if _tracing_state.enabled:
+            amb = tracing.ambient()
+            if amb is not None:
+                frame["trace"] = amb[0].wire(amb[1])
+                with self._lock:
+                    self._traces[req_id] = amb[0]
+        try:
+            writer.send(frame)
+        except (OSError, wire.FrameError) as e:
+            self._on_down(inc, f"send failed: {e}")
+            raise MXNetError(
+                f"{self.name}: worker connection lost at submit: {e}"
+            ) from e
+        return handle
+
+    def _on_gen_done(self, frame: dict) -> None:
+        with self._lock:
+            handle = self._gens.pop(frame["id"], None)
+            tr = self._traces.pop(frame["id"], None)
+        if handle is None:
+            return          # late finale for a crashed-and-failed id
+        if tr is not None:
+            tr.merge(frame.get("spans"))
+            sent = frame.get("trace_ts")
+            if isinstance(sent, (int, float)):
+                tr.add_raw("wire.return", ts=int(sent),
+                           dur=tracing.now_us() - int(sent),
+                           replica=self.name)
+        if frame.get("ok"):
+            payload = np.asarray(frame.get("payload"), dtype=np.int32)
+            # token frames are best-effort; the finale is authoritative
+            # — push any tail the stream missed before resolving
+            for i in range(len(handle.tokens()), payload.size):
+                handle._push(int(payload[i]))
+            self.n_ok += 1
+            try:
+                handle.future.set_result(payload)
+            except Exception:   # noqa: BLE001 - already resolved
+                pass
+        else:
+            self.n_errors += 1
+            try:
+                handle.future.set_exception(wire.decode_error(
+                    frame.get("etype", "mxnet_error"),
+                    frame.get("error", "worker error")))
+            except Exception:   # noqa: BLE001 - already resolved
+                pass
+        handle._seal()
+
+    @staticmethod
+    def _fail_gens(gens: dict, exc: MXNetError) -> None:
+        """Crash/stop tail for streaming handles: resolve typed (first
+        resolution wins) and wake every next_token waiter."""
+        for h in gens.values():
+            if h.future.set_running_or_notify_cancel():
+                try:
+                    h.future.set_exception(exc)
+                except Exception:   # noqa: BLE001 - already resolved
+                    pass
+            h._seal()
+
     # -- stop ----------------------------------------------------------
     def stop(self, drain: bool = True,
              timeout: Optional[float] = None) -> None:
@@ -588,12 +727,15 @@ class RemoteReplica:
         # do it inline too in case they already exited (double-stop)
         with self._lock:
             pending, self._futures = self._futures, {}
+            gens, self._gens = self._gens, {}
             self._running = False
             s2, self._sock = self._sock, None
             w2, self._writer = self._writer, None
-        self._close_and_fail(s2, w2, pending, MXNetError(
+        stopped = MXNetError(
             f"{self.name}: worker stopped before this request "
-            "resolved"))
+            "resolved")
+        self._close_and_fail(s2, w2, pending, stopped)
+        self._fail_gens(gens, stopped)
         # a respawn racing this stop either aborts at its _stopping
         # checks or kills its own fresh child; join it briefly, then
         # sweep any process that slipped through the window — a stop()
@@ -623,7 +765,7 @@ class RemoteReplica:
 
     def stats(self) -> dict:
         with self._lock:
-            inflight = len(self._futures)
+            inflight = len(self._futures) + len(self._gens)
         p = self.proc
         return {"name": self.name, "pid": p.pid if p else None,
                 "running": self.is_running, "inflight": inflight,

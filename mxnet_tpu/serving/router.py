@@ -91,6 +91,7 @@ from .health import (
     Heartbeat,
     _env_float,
 )
+from .kvcache import CacheFull
 from .server import Server
 
 __all__ = ["Router", "ServerOverloaded", "FailoverExhausted",
@@ -816,6 +817,122 @@ class Router:
         if _telemetry_state.enabled:
             telemetry.set_router_queue_depth(depth, router=self.name)
         return req.future
+
+    def submit_generate(self, prompt, max_new_tokens: int,
+                        deadline_ms: Optional[float] = None,
+                        on_token=None):
+        """Route one autoregressive generate to a decode-capable
+        replica (least-loaded CLOSED breaker). Returns the replica's
+        :class:`~.server.GenerateHandle` directly — tokens stream
+        straight from the serving replica; the router stays out of the
+        per-token path.
+
+        Unlike :meth:`submit`, a generate does NOT fail over
+        mid-stream: by the time a replica dies the caller may have
+        consumed half the completion, and replaying it elsewhere would
+        duplicate streamed tokens. A crash resolves the handle's
+        future with the typed replica error and counts as breaker
+        evidence — the CALLER decides whether to resubmit.
+        :class:`~.kvcache.CacheFull` (the request can never fit the
+        replica's cache budget) sheds synchronously and typed
+        (``mxnet_serving_shed_total{reason="kvcache_full"}``) —
+        replicas share one cache geometry, so another replica would
+        refuse it identically."""
+        with self._cond:
+            if not self._accepting:
+                self._count_request("rejected")
+                raise MXNetError(f"{self.name}: router is not running")
+        last_err: Optional[MXNetError] = None
+        # half-open probes excluded: one multi-second generate is a
+        # bad canary — recovery detection stays on short requests
+        live = [r for r in self._replicas
+                if r.server.is_running and not r.draining
+                and r.breaker.state == CLOSED]
+        for r in sorted(live, key=lambda r: r.inflight):
+            if not r.breaker.admit():
+                continue
+            trace = span = None
+            own = False
+            if _tracing_state.enabled:
+                amb = tracing.ambient()
+                if amb is not None:
+                    trace = amb[0]
+                    span = trace.begin("router.generate", parent=amb[1],
+                                       replica=r.server.name)
+                else:
+                    trace = tracing.new_trace("generate",
+                                              router=self.name)
+                    own = True
+                    span = trace.begin("router.generate",
+                                       replica=r.server.name)
+            try:
+                if span is not None:
+                    with tracing.active(trace, span):
+                        handle = r.server.submit_generate(
+                            prompt, max_new_tokens,
+                            deadline_ms=deadline_ms, on_token=on_token)
+                else:
+                    handle = r.server.submit_generate(
+                        prompt, max_new_tokens, deadline_ms=deadline_ms,
+                        on_token=on_token)
+            except CacheFull:
+                if span is not None:
+                    span.end(outcome="shed")
+                if own:
+                    trace.finish("kvcache_full")
+                with self._cond:
+                    self._shed_locked("kvcache_full")
+                raise
+            except MXNetError as e:
+                # this replica refuses (decode off / queue full): not
+                # terminal for the request — try the next one
+                if span is not None:
+                    span.end(outcome="refused", error=type(e).__name__)
+                if own:
+                    trace.finish("refused")
+                last_err = e
+                continue
+            with self._cond:
+                r.inflight += 1
+                self._n_inflight += 1
+            t_enq = time.perf_counter()
+
+            def _done(f, rep=r, sp=span, tr=trace, own_tr=own,
+                      t0=t_enq):
+                with self._cond:
+                    rep.inflight -= 1
+                    self._n_inflight -= 1
+                    self._cond.notify_all()
+                try:
+                    exc = f.exception()
+                except BaseException as e:  # noqa: BLE001 - cancelled
+                    exc = e
+                if exc is None:
+                    rep.breaker.record_success()
+                    rep.n_ok += 1
+                elif not isinstance(exc, CacheFull):
+                    # CacheFull is capacity, not health; anything else
+                    # (crash, fault, wedge) is breaker evidence
+                    rep.breaker.record_failure()
+                    rep.n_failed += 1
+                if sp is not None:
+                    sp.end(outcome="ok" if exc is None else "error")
+                self._count_request(
+                    "ok" if exc is None else "error", t_enqueue=t0,
+                    trace_id=tr.trace_id if tr is not None else None)
+                if own_tr:
+                    tr.finish("ok" if exc is None
+                              else type(exc).__name__)
+
+            handle.future.add_done_callback(_done)
+            return handle
+        if last_err is not None:
+            raise last_err
+        with self._cond:
+            self._shed_locked("queue_full")
+        raise ServerOverloaded(
+            f"{self.name}: no decode-capable healthy replica admits "
+            "generate requests right now")
 
     def _shed_locked(self, reason: str) -> None:
         self.n_shed += 1

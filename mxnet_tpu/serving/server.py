@@ -47,10 +47,11 @@ from ..base import MXNetError
 from ..fault import _state as _fault_state
 from ..telemetry import _state as _telemetry_state
 from ..tracing import _state as _tracing_state
-from .buckets import BucketGrid
+from .buckets import DEFAULT_LEN_BUCKETS, BucketGrid
 from .health import Heartbeat
+from .kvcache import CacheFull, PagePool
 
-__all__ = ["Server", "live_servers"]
+__all__ = ["Server", "GenerateHandle", "live_servers"]
 
 # every running server, for the test-suite leak guard: a test that leaves
 # a scheduler (or watcher) thread running would tax every later test
@@ -78,6 +79,89 @@ class _Request:
         self.trace = None
         self.span = None
         self.own_trace = False
+
+
+class GenerateHandle:
+    """Streaming handle for one autoregressive generate request.
+
+    ``future`` resolves to the full int32 token array when the
+    completion finishes (or raises the typed failure — ``CacheFull``,
+    ``WorkerCrashed``, ``MXNetError`` — exactly like ``submit``'s
+    future: a generate NEVER wedges). Tokens stream as they are
+    decoded: ``on_token(index, token)`` fires per token (from the
+    scheduler/reader thread — keep it cheap), ``tokens()`` snapshots
+    what has arrived, and ``next_token(i)`` blocks until token ``i``
+    exists or the stream ends (returns None when it ended first).
+    """
+
+    def __init__(self, on_token=None):
+        self.future = Future()
+        self._on_token = on_token
+        self._cond = threading.Condition()
+        self._tokens: list = []
+
+    def _push(self, token: int) -> None:
+        with self._cond:
+            self._tokens.append(int(token))
+            i = len(self._tokens) - 1
+            self._cond.notify_all()
+        cb = self._on_token
+        if cb is not None:
+            try:
+                cb(i, int(token))
+            except Exception:   # noqa: BLE001 - user callback stays user's
+                pass
+
+    def _seal(self) -> None:
+        """Wake every next_token() waiter once the future resolved."""
+        with self._cond:
+            self._cond.notify_all()
+
+    def tokens(self) -> list:
+        with self._cond:
+            return list(self._tokens)
+
+    def next_token(self, i: int, timeout: Optional[float] = None):
+        """Block until token ``i`` streams in; None when the request
+        finished (or failed — check ``future``) before producing it."""
+        deadline = (time.perf_counter() + timeout
+                    if timeout is not None else None)
+        with self._cond:
+            while len(self._tokens) <= i:
+                if self.future.done():
+                    return None
+                wait = 0.05 if deadline is None \
+                    else min(0.05, deadline - time.perf_counter())
+                if wait <= 0:
+                    return None
+                self._cond.wait(wait)
+            return self._tokens[i]
+
+    def result(self, timeout: Optional[float] = None):
+        return self.future.result(timeout)
+
+
+class _GenRequest:
+    __slots__ = ("prompt", "max_new", "handle", "pages", "length",
+                 "generated", "t_submit", "t_last", "deadline", "trace",
+                 "span", "own_trace", "len_bucket", "model_version")
+
+    def __init__(self, prompt, max_new, handle, deadline_s):
+        self.prompt = prompt                 # 1-D int32 token array
+        self.max_new = int(max_new)
+        self.handle = handle
+        self.pages = None                    # page list once admitted
+        self.length = len(prompt)            # tokens written OR known
+        self.generated: list = []
+        self.t_submit = time.perf_counter()
+        self.t_last = self.t_submit          # last token emit (per-token lat)
+        self.deadline = (self.t_submit + deadline_s
+                         if deadline_s is not None else None)
+        self.trace = None
+        self.span = None                     # live gen.queue / phase span
+        self.own_trace = False
+        self.len_bucket = 0
+        self.model_version = -1
 
 
 class Server:
@@ -126,7 +210,10 @@ class Server:
                  close_margin_ms: float = 5.0, max_queue: int = 4096,
                  dtype: str = "float32", ctx=None, warmup: bool = True,
                  name: Optional[str] = None,
-                 batch_timeout_ms: Optional[float] = None):
+                 batch_timeout_ms: Optional[float] = None,
+                 decode_pages: Optional[int] = None, page_size: int = 16,
+                 len_buckets=None,
+                 max_generate_tokens: Optional[int] = None):
         if slo_ms <= 0:
             raise MXNetError(f"slo_ms must be > 0, got {slo_ms}")
         if close_margin_ms < 0 or close_margin_ms >= slo_ms:
@@ -139,7 +226,32 @@ class Server:
                 f"deadline-keyed close), got {batch_timeout_ms}")
         if max_queue < 1:
             raise MXNetError(f"max_queue must be >= 1, got {max_queue}")
-        self.grid = BucketGrid(batch_buckets, shape_buckets)
+        # autoregressive decode: a page pool + a model-provided decode
+        # engine turn on submit_generate (see _decode_tick)
+        self._decode_pages = decode_pages
+        if decode_pages is not None and len_buckets is None:
+            len_buckets = DEFAULT_LEN_BUCKETS
+        self.grid = BucketGrid(batch_buckets, shape_buckets,
+                               len_buckets=len_buckets)
+        self._page_size = int(page_size)
+        if decode_pages is not None:
+            cap = (int(decode_pages) - 1) * self._page_size
+            self._max_gen_tokens = int(
+                max_generate_tokens if max_generate_tokens is not None
+                else min(cap, self.grid.len_buckets[-1] + 256))
+            if self._max_gen_tokens > cap:
+                raise MXNetError(
+                    f"max_generate_tokens={self._max_gen_tokens} exceeds "
+                    f"the pool's {cap}-token capacity "
+                    f"({decode_pages} pages x {page_size}, scratch "
+                    "page excluded)")
+        self._pool: Optional[PagePool] = None
+        self._engine = None
+        self._engine_version = -1
+        self._gen_table_w = 0
+        self._gen_pending: list = []
+        self._gen_active: list = []
+        self.n_tokens = 0
         self.slo_s = slo_ms / 1e3
         self.margin_s = close_margin_ms / 1e3
         self.batch_timeout_s = (batch_timeout_ms / 1e3
@@ -153,6 +265,7 @@ class Server:
         self._model_lock = threading.Lock()
         self._cond = threading.Condition()
         self._queue: list = []
+        self._drain = True
         self._running = False
         self._thread: Optional[threading.Thread] = None
         self._watcher = None        # reload.ReloadWatcher, when enabled
@@ -191,6 +304,23 @@ class Server:
         if self.is_running:
             raise MXNetError(f"{self.name}: already running")
         self._warm_block(self._model, prime=True)
+        if self._decode_pages is not None:
+            if not hasattr(self._model, "decode_engine"):
+                raise MXNetError(
+                    f"{self.name}: decode_pages set but the model has no "
+                    "decode_engine() seam (paged-KV generate needs a "
+                    "decode-capable model)")
+            self._pool = PagePool(self._decode_pages, self._page_size)
+            # the engine dtype is the KV/compute dtype, not the request
+            # I/O dtype: token servers run dtype="int32" but the cache
+            # must hold floats (bf16/f32 servers keep their precision)
+            eng_dt = (self.dtype
+                      if np.issubdtype(np.dtype(self.dtype), np.floating)
+                      else "float32")
+            self._engine = self._model.decode_engine(self._pool,
+                                                     dtype=eng_dt)
+            self._engine_version = self.model_version
+            self._gen_table_w = self._pool.pages_for(self._max_gen_tokens)
         self._running = True
         self._thread = threading.Thread(
             target=self._scheduler_loop, name=self.name, daemon=True)
@@ -205,6 +335,7 @@ class Server:
         ``drain=False`` fails pending futures with :class:`MXNetError`."""
         with self._cond:
             self._running = False
+            self._drain = bool(drain)
             if not drain:
                 pending, self._queue = self._queue, []
                 for r in pending:
@@ -280,6 +411,307 @@ class Server:
             telemetry.set_serving_queue_depth(depth)
         return req.future
 
+    def submit_generate(self, prompt, max_new_tokens: int,
+                        deadline_ms: Optional[float] = None,
+                        on_token=None) -> GenerateHandle:
+        """Enqueue one autoregressive generate request: ``prompt`` is a
+        1-D int32 token array, ``max_new_tokens`` the completion budget
+        (greedy decode). Returns a :class:`GenerateHandle` streaming
+        tokens as the continuous batcher produces them.
+
+        Rejection is synchronous and typed, like :meth:`submit`:
+        :class:`~.kvcache.CacheFull` when the request cannot EVER fit
+        the cache budget, :class:`MXNetError` when no len bucket fits
+        the prompt or the server is not running. A request admitted but
+        later starved (deadline blown waiting for pages) fails its
+        future typed — a generate never wedges on an exhausted arena.
+
+        ``deadline_ms`` bounds the WHOLE completion (default: none —
+        generates outlive the per-request SLO by design).
+        """
+        if self._decode_pages is None:
+            raise MXNetError(f"{self.name}: decode is not enabled "
+                             "(construct the server with decode_pages=)")
+        arr = prompt.asnumpy() if hasattr(prompt, "asnumpy") \
+            else np.asarray(prompt)
+        arr = np.ascontiguousarray(arr, dtype=np.int32).reshape(-1)
+        if arr.size < 1:
+            raise MXNetError(f"{self.name}: empty prompt")
+        if int(max_new_tokens) < 1:
+            raise MXNetError(
+                f"max_new_tokens must be >= 1, got {max_new_tokens}")
+        len_bucket = self.grid.prefill_bucket(arr.size)  # raises: no fit
+        total = arr.size + int(max_new_tokens)
+        if total > self._max_gen_tokens:
+            if _telemetry_state.enabled:
+                telemetry.record_serving_shed("kvcache_full")
+            raise CacheFull(
+                f"{self.name}: prompt {arr.size} + max_new_tokens "
+                f"{max_new_tokens} exceeds the {self._max_gen_tokens}-"
+                "token per-request cache budget")
+        handle = GenerateHandle(on_token)
+        req = _GenRequest(arr, max_new_tokens, handle,
+                          deadline_ms / 1e3 if deadline_ms is not None
+                          else None)
+        req.len_bucket = len_bucket
+        if _tracing_state.enabled:
+            amb = tracing.ambient()
+            if amb is not None:
+                req.trace = amb[0]
+                req.span = req.trace.begin("gen.queue", parent=amb[1],
+                                           replica=self.name)
+            else:
+                req.trace = tracing.new_trace(
+                    "generate", replica=self.name,
+                    prompt_len=int(arr.size),
+                    max_new=int(max_new_tokens))
+                req.own_trace = True
+                req.span = req.trace.begin("gen.queue", replica=self.name)
+        with self._cond:
+            if not self._running:
+                self._count_request(outcome="rejected")
+                self._end_gen_rejected(req)
+                raise MXNetError(f"{self.name}: server is not running")
+            if len(self._gen_pending) >= self.max_queue:
+                self._count_request(outcome="rejected")
+                self._end_gen_rejected(req)
+                raise MXNetError(
+                    f"{self.name}: generate queue full "
+                    f"({self.max_queue} requests)")
+            self._gen_pending.append(req)
+            self._cond.notify_all()
+        return handle
+
+    @staticmethod
+    def _end_gen_rejected(req: "_GenRequest",
+                          status: str = "rejected") -> None:
+        if req.trace is None:
+            return
+        if req.span is not None:
+            req.span.end(outcome=status)
+            req.span = None
+        if req.own_trace:
+            req.trace.finish(status)
+
+    # -- decode phase (continuous batching) ----------------------------
+    def _decode_tick(self) -> bool:
+        """One continuous-batching turn: admit pending generates
+        (prefill), then run ONE decode step for every active request.
+        Requests join and leave the decode batch at any step boundary.
+        Returns False when nothing could move (scheduler backs off)."""
+        progressed = False
+        now = time.perf_counter()
+        with self._cond:
+            active = list(self._gen_active)
+            pending = list(self._gen_pending)
+        # deferred weight swap: a completion runs entirely on ONE model
+        # version, so a hot reload only reaches the decode engine
+        # between completions — never mid-request
+        if not active and self._engine_version != self.model_version:
+            self._engine.refresh_params(self._model)
+            self._engine_version = self.model_version
+        # -- admission: all-or-nothing page allocation per request
+        admitted = []
+        for g in pending:
+            if g.deadline is not None and now > g.deadline:
+                self._remove_pending(g)
+                self._finalize_gen(g, error=MXNetError(
+                    f"{self.name}: generate deadline expired before "
+                    "prefill (cache/backlog starvation)"))
+                progressed = True
+                continue
+            if len(admitted) >= self.grid.max_batch:
+                break
+            try:
+                g.pages = self._pool.alloc(g, g.length + g.max_new)
+            except CacheFull as e:
+                if not active and not admitted:
+                    # nothing holds pages and it STILL does not fit:
+                    # waiting cannot help — shed typed, never wedge
+                    if _telemetry_state.enabled:
+                        telemetry.record_serving_shed("kvcache_full")
+                    self._remove_pending(g)
+                    self._finalize_gen(g, error=e)
+                    progressed = True
+                    continue
+                break       # actives will free pages; retry next tick
+            self._remove_pending(g)
+            admitted.append(g)
+        if admitted:
+            groups: dict = {}
+            for g in admitted:
+                groups.setdefault(g.len_bucket, []).append(g)
+            for lb in sorted(groups):
+                self._prefill_batch(groups[lb], lb)
+            progressed = True
+        # -- one decode step per active request (chunked to the grid)
+        with self._cond:
+            active = list(self._gen_active)
+        expired = [g for g in active
+                   if g.deadline is not None and now > g.deadline]
+        for g in expired:
+            self._finalize_gen(g, error=MXNetError(
+                f"{self.name}: generate deadline expired at token "
+                f"{len(g.generated)}/{g.max_new}"))
+        active = [g for g in active if g not in expired]
+        cap = self.grid.max_batch
+        for i in range(0, len(active), cap):
+            self._decode_batch(active[i:i + cap])
+        return progressed or bool(active) or bool(expired)
+
+    def _remove_pending(self, g) -> None:
+        with self._cond:
+            try:
+                self._gen_pending.remove(g)
+            except ValueError:
+                pass
+
+    def _prefill_batch(self, group, len_bucket: int) -> None:
+        """Prefill one len-bucket group: write the prompts' K/V into
+        their pages and emit each request's FIRST token (the
+        time-to-first-token dispatch)."""
+        cap = self.grid.batch_bucket(len(group))
+        w = self._gen_table_w
+        tokens = np.zeros((cap, len_bucket), dtype=np.int32)
+        lengths = np.zeros((cap,), dtype=np.int32)
+        table = np.zeros((cap, w), dtype=np.int32)
+        for i, g in enumerate(group):
+            tokens[i, :g.prompt.size] = g.prompt
+            lengths[i] = g.prompt.size
+            table[i, :len(g.pages)] = g.pages
+            g.model_version = self._engine_version
+            if g.span is not None:          # gen.queue ends here
+                g.span.end(outcome="ok")
+            g.span = (g.trace.begin("prefill", replica=self.name,
+                                    len_bucket=len_bucket)
+                      if g.trace is not None else None)
+        sig = (cap, len_bucket)
+
+        def run():
+            hook = self._pre_dispatch
+            if hook is not None:
+                hook(sig)
+            if _fault_state.enabled:
+                fault.check("serving.dispatch",
+                            f"{self.name} prefill={sig}")
+            return self._engine.prefill(tokens, lengths, table)
+
+        try:
+            logits = fault.retry_call("serving.dispatch", run,
+                                      detail=self.name)
+        except Exception as e:  # noqa: BLE001 - forwarded to handles
+            self.n_errors += 1
+            for g in group:
+                self._finalize_gen(g, error=e)
+            return
+        self.n_batches += 1
+        if _telemetry_state.enabled:
+            telemetry.record_serving_batch(len(group), cap, "prefill")
+        with self._cond:
+            self._gen_active.extend(group)
+        t_now = time.perf_counter()
+        for i, g in enumerate(group):
+            if g.span is not None:
+                g.span.end(outcome="ok")
+                g.span = None
+            self._emit_token(g, int(np.argmax(logits[i])), t_now)
+
+    def _decode_batch(self, chunk) -> None:
+        """ONE decode step for up to max_batch active requests — the
+        (batch, 1) executable, whatever depth each request is at."""
+        cap = self.grid.batch_bucket(len(chunk))
+        w = self._gen_table_w
+        tokens = np.zeros((cap,), dtype=np.int32)
+        lengths = np.zeros((cap,), dtype=np.int32)
+        table = np.zeros((cap, w), dtype=np.int32)
+        spans = []
+        for i, g in enumerate(chunk):
+            tokens[i] = g.generated[-1]
+            lengths[i] = g.length
+            table[i, :len(g.pages)] = g.pages
+            spans.append(g.trace.begin("decode.step", replica=self.name,
+                                       token=len(g.generated))
+                         if g.trace is not None else None)
+        sig = (cap, 1)
+
+        def run():
+            hook = self._pre_dispatch
+            if hook is not None:
+                hook(sig)
+            if _fault_state.enabled:
+                fault.check("serving.dispatch", f"{self.name} decode={sig}")
+            return self._engine.decode_step(tokens, lengths, table)
+
+        try:
+            logits = fault.retry_call("serving.dispatch", run,
+                                      detail=self.name)
+        except Exception as e:  # noqa: BLE001 - forwarded to handles
+            self.n_errors += 1
+            for g, sp in zip(chunk, spans):
+                if sp is not None:
+                    sp.end(outcome="error", error=type(e).__name__)
+            for g in chunk:
+                self._finalize_gen(g, error=e)
+            return
+        if _telemetry_state.enabled:
+            telemetry.record_decode_step(len(chunk))
+        t_now = time.perf_counter()
+        for i, (g, sp) in enumerate(zip(chunk, spans)):
+            if sp is not None:
+                sp.end(outcome="ok")
+            self._emit_token(g, int(np.argmax(logits[i])), t_now)
+
+    def _emit_token(self, g, token: int, t_now: float) -> None:
+        g.generated.append(token)
+        g.length += 1
+        self.n_tokens += 1
+        if _telemetry_state.enabled:
+            telemetry.record_token(t_now - g.t_last)
+        g.t_last = t_now
+        g.handle._push(token)
+        if len(g.generated) >= g.max_new:
+            self._finalize_gen(g)
+
+    def _finalize_gen(self, g, error: Optional[Exception] = None) -> None:
+        """Resolve one generate request: free its pages, leave the
+        batch, settle the future (exactly once) and seal the stream."""
+        if g.pages is not None:
+            self._pool.free(g)
+            g.pages = None
+        with self._cond:
+            try:
+                self._gen_active.remove(g)
+            except ValueError:
+                pass
+        fut = g.handle.future
+        try:
+            if error is None:
+                fut.set_result(np.asarray(g.generated, dtype=np.int32))
+            else:
+                fut.set_exception(error)
+        except Exception:   # noqa: BLE001 - already settled (racing stop)
+            pass
+        g.handle._seal()
+        if error is not None:
+            self.n_errors += 1
+        self._count_request(
+            outcome="ok" if error is None else "error",
+            t_enqueue=g.t_submit,
+            trace_id=g.trace.trace_id if g.trace is not None else None)
+        if g.span is not None:
+            g.span.end(outcome="ok" if error is None else "error")
+            g.span = None
+        if g.own_trace and g.trace is not None:
+            g.trace.finish("ok" if error is None
+                           else type(error).__name__)
+
+    def _fail_generates(self, exc: Exception) -> None:
+        with self._cond:
+            doomed = self._gen_pending + self._gen_active
+            self._gen_pending = []
+        for g in doomed:
+            self._finalize_gen(g, error=exc)
+
     # -- scheduler -----------------------------------------------------
     def _scheduler_loop(self) -> None:
         try:
@@ -287,8 +719,19 @@ class Server:
                 self.hb.touch()
                 batch, reason = self._next_batch()
                 if batch is None:
+                    # non-drain shutdown may leave generates behind
+                    self._fail_generates(MXNetError(
+                        f"{self.name}: server stopped before this "
+                        "generate completed"))
                     return
-                self._dispatch(batch, reason)
+                if batch:
+                    self._dispatch(batch, reason)
+                if self._gen_pending or self._gen_active:
+                    if not self._decode_tick():
+                        # nothing admissible this instant (pool full,
+                        # actives still hold pages): breathe, retry
+                        with self._cond:
+                            self._cond.wait(0.005)
         except BaseException:
             # a scheduler death must be LOUD, not a server that accepts
             # requests into a queue nobody drains: stop accepting and
@@ -301,17 +744,26 @@ class Server:
                     r.future.set_exception(MXNetError(
                         f"{self.name}: scheduler thread crashed"))
                     self._end_trace_rejected(r, "error")
+            self._fail_generates(MXNetError(
+                f"{self.name}: scheduler thread crashed"))
             raise
 
     def _next_batch(self):
-        """Block until a batch should close; returns (requests, reason)
-        or (None, None) on shutdown with an empty queue."""
+        """Block until a batch should close; returns (requests, reason),
+        ``([], "decode")`` when decode work should run NOW (continuous
+        batching never parks the scheduler while generates are live),
+        or (None, None) on shutdown with nothing left to serve."""
         with self._cond:
             while True:
                 self.hb.touch()
+                gen_work = bool(self._gen_pending or self._gen_active)
                 if not self._queue:
                     if not self._running:
+                        if gen_work and self._drain:
+                            return [], "decode"
                         return None, None
+                    if gen_work:
+                        return [], "decode"
                     self._cond.wait(0.1)
                     continue
                 head = self._queue[0]
@@ -343,6 +795,11 @@ class Server:
                               and timeout_at <= close_at + 1e-9
                               and now < deadline_at else "deadline")
                 else:
+                    if gen_work:
+                        # decode steps interleave with the batch fill:
+                        # the classic batch keeps its SLO patience, the
+                        # scheduler just doesn't SLEEP through it
+                        return [], "decode"
                     # fill otherwise: sleep until the head's close time
                     # or the next submit, whichever is first
                     self._cond.wait(min(close_at - now, 0.1))
@@ -593,8 +1050,15 @@ class Server:
         """Light always-on counters (telemetry has the full story)."""
         with self._cond:
             depth = len(self._queue)
-        return {"requests": self.n_requests, "batches": self.n_batches,
-                "errors": self.n_errors, "reloads": self.n_reloads,
-                "queue_depth": depth, "loaded_step": self.loaded_step,
-                "model_version": self.model_version,
-                "running": self.is_running}
+            gen_pending = len(self._gen_pending)
+            gen_active = len(self._gen_active)
+        out = {"requests": self.n_requests, "batches": self.n_batches,
+               "errors": self.n_errors, "reloads": self.n_reloads,
+               "queue_depth": depth, "loaded_step": self.loaded_step,
+               "model_version": self.model_version,
+               "running": self.is_running}
+        if self._decode_pages is not None:
+            out.update(tokens=self.n_tokens, generates_pending=gen_pending,
+                       generates_active=gen_active,
+                       kvcache=self._pool.stats() if self._pool else None)
+        return out

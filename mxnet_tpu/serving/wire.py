@@ -431,12 +431,14 @@ def _error_registry():
     # the full serving package, and router imports server — keep the
     # import graph shallow until an error actually crosses the wire
     from ..fault import FaultInjected
+    from .kvcache import CacheFull
     from .router import FailoverExhausted, ServerOverloaded
 
     return {
         "overloaded": ServerOverloaded,
         "failover_exhausted": FailoverExhausted,
         "fault_injected": FaultInjected,
+        "kvcache_full": CacheFull,
         "mxnet_error": MXNetError,
     }
 
@@ -445,7 +447,8 @@ def encode_error(exc: BaseException) -> Tuple[str, str]:
     """``(etype, message)`` wire form of ``exc`` — the most specific
     registered type wins, anything unknown degrades to ``internal``."""
     reg = _error_registry()
-    for name in ("overloaded", "failover_exhausted", "fault_injected"):
+    for name in ("overloaded", "failover_exhausted", "fault_injected",
+                 "kvcache_full"):
         if isinstance(exc, reg[name]):
             return name, str(exc)
     if isinstance(exc, MXNetError):

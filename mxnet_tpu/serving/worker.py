@@ -101,6 +101,16 @@ def _parse_args(argv):
                          "wait (ms); omit for the deadline-keyed close")
     ap.add_argument("--dtype", default="float32")
     ap.add_argument("--max-queue", type=int, default=4096)
+    ap.add_argument("--decode-pages", type=int, default=None,
+                    help="enable paged-KV autoregressive generate with "
+                         "this many cache pages")
+    ap.add_argument("--page-size", type=int, default=16,
+                    help="tokens per KV-cache page")
+    ap.add_argument("--len-buckets", default=None,
+                    help="comma-separated prefill length buckets, e.g. "
+                         "16,32,64 (decode mode only)")
+    ap.add_argument("--max-generate-tokens", type=int, default=None,
+                    help="per-request prompt+completion token cap")
     ap.add_argument("--no-warmup", action="store_true",
                     help="skip the AOT grid warmup (eager/test models)")
     ap.add_argument("--health-interval", type=float, default=0.05)
@@ -142,6 +152,8 @@ def main(argv=None) -> int:
     shape_buckets = json.loads(args.shape_buckets)
     if shape_buckets is not None:
         shape_buckets = [tuple(s) for s in shape_buckets]
+    len_buckets = (tuple(int(b) for b in args.len_buckets.split(","))
+                   if args.len_buckets else None)
     server = Server(
         block,
         batch_buckets=tuple(int(b) for b in
@@ -149,7 +161,10 @@ def main(argv=None) -> int:
         shape_buckets=shape_buckets, slo_ms=args.slo_ms,
         batch_timeout_ms=args.batch_timeout_ms,
         dtype=args.dtype, max_queue=args.max_queue,
-        warmup=not args.no_warmup, name=args.name)
+        warmup=not args.no_warmup, name=args.name,
+        decode_pages=args.decode_pages, page_size=args.page_size,
+        len_buckets=len_buckets,
+        max_generate_tokens=args.max_generate_tokens)
     server.start()
 
     exporter = None
@@ -175,6 +190,8 @@ def main(argv=None) -> int:
           "batch_buckets": list(server.grid.batch_buckets),
           "shape_buckets": ([list(s) for s in server.grid.shape_buckets]
                             if server.grid.shape_buckets else None),
+          "len_buckets": (list(server.grid.len_buckets)
+                          if server.grid.len_buckets else None),
           "slo_ms": args.slo_ms,
           "metrics_port": exporter.port if exporter else None})
 
@@ -231,6 +248,46 @@ def main(argv=None) -> int:
             sys.stderr.flush()
             os._exit(1)
 
+    def on_gen_done(req_id, fut, tr=None):
+        """Final frame of one generate stream: the full token array or
+        the typed error, after every token frame for this id."""
+        try:
+            payload = fut.result()
+        except Exception as e:  # noqa: BLE001 - typed onto the wire
+            etype, msg = wire.encode_error(e)
+            frame = {"kind": "gen_done", "id": req_id, "ok": False,
+                     "etype": etype, "error": msg}
+        else:
+            frame = {"kind": "gen_done", "id": req_id, "ok": True,
+                     "payload": payload}
+        if tr is not None:
+            tr.finish("ok" if frame["ok"] else frame.get("etype",
+                                                         "error"))
+            frame["spans"] = tr.export_spans()
+            frame["trace_ts"] = tracing.now_us()
+        try:
+            send(frame)
+        except (OSError, wire.ConnectionClosed):
+            pass
+        except wire.FrameError:
+            sys.stderr.write(
+                f"{args.name}: generate result not encodable for the "
+                "serving wire; exiting\n")
+            sys.stderr.flush()
+            os._exit(1)
+
+    def token_sender(req_id):
+        # per-token streaming leg: best-effort — a dead parent is the
+        # reader loop's signal to handle, and the final gen_done frame
+        # carries the authoritative full token array anyway
+        def on_token(i, token):
+            try:
+                send({"kind": "token", "id": req_id, "i": int(i),
+                      "token": int(token)})
+            except (OSError, wire.FrameError):
+                pass
+        return on_token
+
     rc = 0
     rf = wire.reader(sock)      # buffered: streamed submits cost a
     try:                        # fraction of a syscall each
@@ -282,6 +339,43 @@ def main(argv=None) -> int:
                     continue
                 fut.add_done_callback(
                     lambda f, i=req_id, t=tr: on_done(i, f, t))
+            elif kind == "generate":
+                req_id = frame["id"]
+                tr = None
+                if _tracing_state.enabled:
+                    tr = tracing.adopt(frame.get("trace"),
+                                       worker=args.name)
+                try:
+                    if tr is not None:
+                        with tracing.active(tr, tr.remote_parent):
+                            handle = server.submit_generate(
+                                frame["prompt"],
+                                int(frame["max_new_tokens"]),
+                                deadline_ms=frame.get("deadline_ms"),
+                                on_token=token_sender(req_id))
+                    else:
+                        handle = server.submit_generate(
+                            frame["prompt"],
+                            int(frame["max_new_tokens"]),
+                            deadline_ms=frame.get("deadline_ms"),
+                            on_token=token_sender(req_id))
+                except Exception as e:  # noqa: BLE001 - sync refusal
+                    etype, msg = wire.encode_error(e)
+                    res = {"kind": "gen_done", "id": req_id,
+                           "ok": False, "etype": etype, "error": msg}
+                    if tr is not None:
+                        tr.finish(etype)
+                        res["spans"] = tr.export_spans()
+                        res["trace_ts"] = tracing.now_us()
+                    try:
+                        send(res)
+                    except (OSError, wire.ConnectionClosed):
+                        tracing.maybe_dump("orphaned")
+                        server.stop(drain=False, timeout=10)
+                        return 0
+                    continue
+                handle.future.add_done_callback(
+                    lambda f, i=req_id, t=tr: on_gen_done(i, f, t))
             elif kind == "stop":
                 try:
                     server.stop(drain=bool(frame.get("drain", True)),

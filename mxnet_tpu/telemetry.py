@@ -62,6 +62,7 @@ __all__ = [
     "record_serving_queue_time", "set_serving_queue_depth",
     "record_serving_reload",
     "record_serving_shed", "record_serving_failover",
+    "record_decode_step", "record_token", "set_kvcache_pages",
     "record_serving_route_retry", "record_router_queue_wait",
     "set_router_queue_depth", "set_replica_health",
     "record_breaker_transition", "record_router_request",
@@ -1244,15 +1245,56 @@ def record_router_request(seconds: float, outcome: str = "ok",
 def record_serving_shed(reason: str) -> None:
     """One request shed by the Router's admission control. ``reason``:
     ``queue_full`` (bounded queue at capacity), ``predicted_wait``
-    (predicted queue wait exceeds the request's deadline) or
-    ``expired`` (deadline blew while queued — the in-queue safety
-    net)."""
+    (predicted queue wait exceeds the request's deadline), ``expired``
+    (deadline blew while queued — the in-queue safety net) or
+    ``kvcache_full`` (a generate request that cannot fit the paged
+    KV-cache budget)."""
     if not _state.enabled:
         return
     counter("mxnet_serving_shed_total",
             "Requests shed by router admission control, by reason "
-            "(queue_full/predicted_wait/expired).",
+            "(queue_full/predicted_wait/expired/kvcache_full).",
             ("reason",)).labels(reason).inc()
+
+
+def record_decode_step(n_requests: int) -> None:
+    """One continuous-batching decode step: a single (batch, 1)
+    executable advancing ``n_requests`` co-batched completions by one
+    token each."""
+    if not _state.enabled:
+        return
+    counter("mxnet_serving_decode_steps_total",
+            "Autoregressive decode steps dispatched (one fused "
+            "(batch, 1) executable per step).").inc()
+    histogram("mxnet_serving_decode_batch_width",
+              "Active completions co-batched per decode step.",
+              buckets=(1, 2, 4, 8, 16, 32, 64)).observe(n_requests)
+
+
+def record_token(seconds: float) -> None:
+    """One emitted token's inter-token latency (prefill first token:
+    submit -> first token, i.e. TTFT)."""
+    if not _state.enabled:
+        return
+    counter("mxnet_serving_tokens_total",
+            "Tokens emitted by autoregressive decode (prefill first "
+            "tokens included).").inc()
+    histogram("mxnet_serving_token_seconds",
+              "Per-token latency: time since the previous token of the "
+              "same completion (first token: since submit — TTFT).",
+              buckets=SERVING_BUCKETS).observe(seconds)
+
+
+def set_kvcache_pages(free: int, used: int, reserved: int = 0) -> None:
+    """Paged KV-cache arena occupancy, by page state."""
+    if not _state.enabled:
+        return
+    g = gauge("mxnet_serving_kvcache_pages",
+              "KV-cache arena pages by state (free/used/reserved).",
+              ("state",))
+    g.labels("free").set(free)
+    g.labels("used").set(used)
+    g.labels("reserved").set(reserved)
 
 
 def record_serving_failover(replica: str) -> None:

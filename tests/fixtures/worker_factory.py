@@ -24,6 +24,28 @@ def tiny_net(seed=0, in_units=8, units=4):
     return net
 
 
+def tiny_llama(seed=7, vocab_size=64, num_layers=2, units=32,
+               hidden_size=64, num_heads=4, num_kv_heads=2):
+    """A 2-layer LLaMA small enough to decode on CPU in a test worker.
+
+    ``mx.random.seed`` makes ``initialize()`` reproducible, so a worker
+    process and an in-process oracle built from the same spec hold
+    bit-identical weights — the decode bit-identity tests depend on it.
+    """
+    import mxnet_tpu as mx
+    from mxnet_tpu.gluon.model_zoo.nlp import LlamaModel
+
+    mx.random.seed(seed)
+    net = LlamaModel(vocab_size=vocab_size, num_layers=num_layers,
+                     units=units, hidden_size=hidden_size,
+                     num_heads=num_heads, num_kv_heads=num_kv_heads,
+                     rope_theta=10000.0, eps=1e-6)
+    net.initialize()
+    net(mx.nd.zeros((1, 2), dtype="int32"))  # materialize deferred shapes
+    net.hybridize()
+    return net
+
+
 def paced_block(dispatch_ms=20.0):
     """Eager block with a fixed dispatch latency — overload/backpressure
     tests need a controlled service rate, not raw speed."""

@@ -60,6 +60,17 @@ whose only signal channel is ``/metrics`` scrapes must grow the
 multi-process fleet under synthetic pressure and shrink it back after
 the hold window.
 
+The GENERATE gate (continuous-batching decode) kills a replica worker
+process mid-completion: 8 streaming generates are in flight across a
+2-worker fleet when one worker takes SIGKILL. A generate does not fail
+over mid-stream (replay would duplicate streamed tokens), so the
+contract is typed resolution: every casualty handle resolves with the
+typed replica error, its streamed tokens are a clean prefix of the
+full-recompute oracle completion, its stream is sealed (no token
+after the error), completions on the survivor stay bit-identical to
+the oracle, and the survivor keeps serving fresh generates after the
+kill.
+
   python tools/chaos_check.py                 # default spec/steps
   python tools/chaos_check.py --steps 40 --seed 11 \
       --spec 'kvstore.push=every:7;kvstore.allreduce=p:0.1' \
@@ -576,6 +587,22 @@ def _serving_net(seed=0):
     net.weight.set_data(mx.nd.array(
         rs.randn(16, 32).astype(np.float32)))
     net.bias.set_data(mx.nd.array(rs.randn(16).astype(np.float32)))
+    net.hybridize()
+    return net
+
+
+def _decode_net(seed=7):
+    """Token model for the generate gate — seeded so worker-process
+    weights are bit-identical to the in-process oracle's."""
+    import mxnet_tpu as mx
+    from mxnet_tpu.gluon.model_zoo.nlp import LlamaModel
+
+    mx.random.seed(seed)
+    net = LlamaModel(vocab_size=64, num_layers=2, units=32,
+                     hidden_size=64, num_heads=4, num_kv_heads=2,
+                     rope_theta=10000.0, eps=1e-6)
+    net.initialize()
+    net(mx.nd.zeros((1, 2), dtype="int32"))    # materialize shapes
     net.hybridize()
     return net
 
@@ -1169,6 +1196,142 @@ def _scrape_scale_phase(summary, router, make_worker, _time):
         exporter.stop()
 
 
+def _decode_oracle(net, prompt, n_new, buckets=(8, 16, 32, 64, 128)):
+    """Full-recompute greedy completion, padded to length buckets so
+    the oracle compiles a handful of shapes instead of one per step
+    (causal attention makes suffix padding bit-transparent)."""
+    import numpy as np
+
+    import mxnet_tpu as mx
+
+    toks = [int(t) for t in prompt]
+    for _ in range(n_new):
+        length = len(toks)
+        bucket = next(b for b in buckets if b >= length)
+        arr = np.zeros((1, bucket), np.int32)
+        arr[0, :length] = toks
+        logits = net(mx.nd.array(arr, dtype="int32")).asnumpy()
+        toks.append(int(np.argmax(logits[0, length - 1])))
+    return toks[len(prompt):]
+
+
+def generate_gate(summary):
+    """Gate 9: SIGKILL a replica worker process while it is streaming
+    autoregressive completions. A generate does NOT fail over
+    mid-stream (replaying it elsewhere would duplicate streamed
+    tokens) — the contract under fire here is *typed resolution*:
+    every in-flight handle on the victim resolves with the typed
+    replica error, its streamed tokens are a clean prefix of the
+    oracle completion, its stream is sealed, and the survivor keeps
+    serving bit-identical completions throughout."""
+    import signal as _signal
+    import time as _time
+
+    import numpy as np
+
+    from mxnet_tpu import serving
+    from mxnet_tpu.base import MXNetError
+
+    os.environ["MXNET_COMM_RETRY_DELAY"] = "0.01"
+    tools_dir = os.path.dirname(os.path.abspath(__file__))
+    n_new = 120
+    prompts = [np.array([3, 1, 4, 1, 5], np.int32),
+               np.array([2, 7, 1, 8, 2, 8, 1], np.int32)]
+
+    net = _decode_net()
+    oracles = [_decode_oracle(net, p, n_new) for p in prompts]
+
+    grid = dict(batch_buckets=(1, 2), shape_buckets=[(8,)],
+                slo_ms=1000.0, dtype="int32", warmup=False,
+                decode_pages=96, page_size=4, len_buckets=(8, 16))
+
+    def make_worker(i):
+        return serving.RemoteReplica(
+            "chaos_check:_decode_net", name=f"g{i}",
+            python_paths=[tools_dir], respawn_backoff_s=0.3,
+            spawn_timeout_s=300, **grid)
+
+    workers = [make_worker(i) for i in range(2)]
+    router = serving.Router(workers, slo_ms=1000.0,
+                            dispatch_timeout_s=5.0)
+    t0 = _time.time()
+    router.start()
+    print(f"[chaos] generate: 2 decode workers up in "
+          f"{_time.time() - t0:.1f}s (pids "
+          f"{[w.proc.pid for w in workers]})")
+    checks = {}
+    try:
+        # warm the decode path on both workers so the kill lands in
+        # steady-state streaming, not in a compile
+        for w in workers:
+            w.submit_generate(prompts[0], 4).result(timeout=120)
+
+        streamed = [[] for _ in range(8)]
+        handles = []
+        for i in range(8):
+            handles.append(router.submit_generate(
+                prompts[i % 2], n_new,
+                on_token=lambda _i, t, i=i: streamed[i].append(int(t))))
+        _time.sleep(0.05)                   # let streams get going
+        victim_pid = workers[0].proc.pid
+        os.kill(victim_pid, _signal.SIGKILL)
+
+        n_ok = n_typed = n_lost = n_bits_bad = n_prefix_bad = 0
+        unsealed = 0
+        for i, h in enumerate(handles):
+            want = oracles[i % 2]
+            try:
+                out = h.result(timeout=120)
+            except MXNetError:
+                n_typed += 1                # typed = resolved
+                got = h.tokens()
+                if got != want[:len(got)] or \
+                        streamed[i] != want[:len(streamed[i])]:
+                    n_prefix_bad += 1
+                if h.next_token(len(got), timeout=5) is not None:
+                    unsealed += 1           # stream must be sealed
+                continue
+            except Exception:   # noqa: BLE001 - untyped = lost
+                n_lost += 1
+                continue
+            n_ok += 1
+            if list(out) != want or h.tokens() != want or \
+                    streamed[i] != want:
+                n_bits_bad += 1
+        undone = sum(1 for h in handles if not h.future.done())
+
+        # survivor still serves bit-identical completions
+        survivor_ok = False
+        try:
+            out = router.submit_generate(
+                prompts[0], n_new).result(timeout=120)
+            survivor_ok = list(out) == oracles[0]
+        except MXNetError:
+            survivor_ok = False
+
+        checks["worker_process_killed"] = workers[0].crash_count >= 1
+        checks["crash_hit_inflight_generate"] = n_typed >= 1
+        checks["zero_lost_generates"] = n_lost == 0 and undone == 0
+        checks["all_resolutions_typed"] = n_typed + n_ok == len(handles)
+        checks["completed_bit_identical"] = n_bits_bad == 0 and n_ok >= 1
+        checks["casualty_streams_clean_prefix"] = n_prefix_bad == 0
+        checks["casualty_streams_sealed"] = unsealed == 0
+        checks["survivor_serves_generates"] = survivor_ok
+        ok = all(checks.values())
+        summary["gates"]["generate_crash_typed_streams"] = {
+            "pass": ok, "checks": checks, "generates": len(handles),
+            "ok": n_ok, "typed_errors": n_typed,
+            "lost": n_lost + undone, "victim_pid": victim_pid}
+        print(f"[chaos] generate: {len(handles)} generates, {n_ok} ok, "
+              f"{n_typed} typed errors, {n_lost + undone} lost "
+              f"(victim pid {victim_pid})")
+        for name, v in checks.items():
+            print(f"[chaos]   generate {name}: {v}")
+        return ok
+    finally:
+        router.stop(drain=False, timeout=60)
+
+
 def main():
     ap = argparse.ArgumentParser(description=__doc__.split("\n")[0])
     ap.add_argument("--steps", type=int, default=24)
@@ -1193,6 +1356,10 @@ def main():
                     help="skip the out-of-process worker gate (SIGKILL "
                     "a replica worker process under ingress traffic + "
                     "scrape-fed fleet scaling)")
+    ap.add_argument("--skip-generate", action="store_true",
+                    help="skip the generate gate (SIGKILL a replica "
+                    "mid-completion; typed resolution of streaming "
+                    "handles, survivor bit-identity)")
     args = ap.parse_args()
 
     import numpy as np
@@ -1282,6 +1449,11 @@ def main():
     #    then scrape-fed scaling of the multi-process fleet ------------
     if not args.skip_worker:
         ok = worker_gate(summary) and ok
+
+    # -- gate 9: SIGKILL a replica mid-generate — typed resolution of
+    #    the streaming handles, survivor keeps completing ---------------
+    if not args.skip_generate:
+        ok = generate_gate(summary) and ok
 
     retry_counters = {}
     for s in telemetry.snapshot()["metrics"].get(

@@ -49,6 +49,7 @@ _BUCKETS = {
 
 # presentation order; anything else observed is appended alphabetically
 _STAGE_ORDER = ["ingress.decode", "router.queue", "router.attempt",
+                "gen.queue", "prefill", "decode.step",
                 "batch.wait", "dispatch", "wire.return", "ingress.reply",
                 "request"]
 
@@ -106,6 +107,44 @@ def stage_latencies(traces) -> Dict[str, List[float]]:
     return out
 
 
+def decode_rollup(traces) -> Dict:
+    """TTFT vs per-token latency for generate traces (those carrying
+    ``prefill`` / ``decode.step`` spans). TTFT is trace start to the
+    end of ``prefill`` — the first token is emitted there — so it
+    includes queueing and admission, which is what a caller feels.
+    Per-token latency is the gap between consecutive ``decode.step``
+    span ends inside one trace: the steady-state streaming interval,
+    which stays flat only while every step re-hits the one warm
+    ``(batch, 1)`` executable."""
+    ttfts: List[float] = []
+    gaps: List[float] = []
+    ntoks: List[int] = []
+    for t in traces:
+        spans = [s for s in t.get("spans", [])
+                 if isinstance(s.get("ts"), (int, float))]
+        pre = [s for s in spans if s.get("name") == "prefill"]
+        steps = [s for s in spans if s.get("name") == "decode.step"]
+        if not pre and not steps:
+            continue
+        t0 = min(s["ts"] for s in spans)
+        if pre:
+            first = min(p["ts"] + (p.get("dur") or 0) for p in pre)
+            ttfts.append((first - t0) / 1e3)
+        ends = sorted(s["ts"] + (s.get("dur") or 0) for s in steps)
+        gaps.extend((b - a) / 1e3 for a, b in zip(ends, ends[1:]))
+        ntoks.append(len(steps) + (1 if pre else 0))
+    if not ntoks:
+        return {}
+    return {
+        "generate_traces": len(ntoks),
+        "tokens_p50": _pctl([float(n) for n in ntoks], 0.50),
+        "ttft_p50_ms": round(_pctl(ttfts, 0.50), 3),
+        "ttft_p99_ms": round(_pctl(ttfts, 0.99), 3),
+        "per_token_p50_ms": round(_pctl(gaps, 0.50), 3),
+        "per_token_p99_ms": round(_pctl(gaps, 0.99), 3),
+    }
+
+
 def report(traces, events) -> Dict:
     stages = stage_latencies(traces)
     roots = stages.get("request", [])
@@ -140,7 +179,7 @@ def report(traces, events) -> Dict:
         k = e.get("event", "?")
         ev_kinds[k] = ev_kinds.get(k, 0) + 1
 
-    return {
+    rep = {
         "traces": len(traces),
         "statuses": statuses,
         "events": ev_kinds,
@@ -151,6 +190,10 @@ def report(traces, events) -> Dict:
         "serving_ingress_overhead_scheduling_ms":
             round(rollup["scheduling"], 3),
     }
+    dec = decode_rollup(traces)
+    if dec:
+        rep["decode"] = dec
+    return rep
 
 
 def _print_table(rep: Dict) -> None:
@@ -172,6 +215,15 @@ def _print_table(rep: Dict) -> None:
     for k in ("framing", "socket", "scheduling"):
         print(f"  {k:<11} "
               f"{rep[f'serving_ingress_overhead_{k}_ms']:.3f} ms")
+    dec = rep.get("decode")
+    if dec:
+        print()
+        print(f"decode rollup ({dec['generate_traces']} generate "
+              f"trace(s), {dec['tokens_p50']:.0f} tokens p50):")
+        print(f"  TTFT        p50 {dec['ttft_p50_ms']:.3f} ms   "
+              f"p99 {dec['ttft_p99_ms']:.3f} ms")
+        print(f"  per-token   p50 {dec['per_token_p50_ms']:.3f} ms   "
+              f"p99 {dec['per_token_p99_ms']:.3f} ms")
 
 
 def main(argv=None) -> int:
