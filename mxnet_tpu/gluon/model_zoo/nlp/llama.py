@@ -508,6 +508,20 @@ class LlamaDecodeEngine:
                      ).reshape(-1, 1)
         return self.forward(tokens, positions, page_table, lengths)
 
+    def apply_defrag(self, moves) -> None:
+        """Replay :meth:`PagePool.defrag` page moves onto this engine's
+        arenas — called by the serving scheduler between decode steps,
+        BEFORE any dispatch reads the renumbered page tables. In a
+        multi-tenant server every engine replays the SAME global
+        permutation (the pool's accounting is shared), so a page another
+        tenant owns moves its (garbage, for this engine) slots too —
+        harmless, and it keeps every arena consistent with the one page
+        numbering."""
+        from ....serving.kvcache import apply_defrag
+
+        self.k_arena = apply_defrag(self.k_arena, moves, self.page_size)
+        self.v_arena = apply_defrag(self.v_arena, moves, self.page_size)
+
     def forward_full(self, tokens):
         """No-cache full-recompute oracle: run the whole (B, L) prefix
         through scratch pages and return the next-token logits. Frees

@@ -40,8 +40,21 @@ spaces. Hot reload, fault injection/retry and Prometheus telemetry
 ride the PR-1/PR-3 infrastructure; see :mod:`.server`,
 :mod:`.buckets`, :mod:`.reload`, :mod:`.router`, :mod:`.health`,
 :mod:`.wire`, :mod:`.worker`, :mod:`.remote`, :mod:`.ingress`.
+
+The stack is **multi-tenant**: ``Server.register_model`` /
+``Router.register_model`` put several hybridized blocks behind one
+replica fleet (each tenant carries an SLO class, a priority, a
+weighted-fair share and an optional ``TokenBucket`` rate limit), the
+scheduler interleaves tenants per decode step under weighted
+admission, and when the shared KV-cache pool fills a higher-priority
+arrival preempts the lowest-priority active stream BETWEEN decode
+steps — the victim resolves typed (``Preempted``) with a sealed
+clean-prefix token stream, never a torn token. ``model=`` /
+``priority=`` ride every seam (wire frames, worker, ``RemoteReplica``,
+``Ingress``); an absent field means the default tenant, so old peers
+interoperate.
 """
-from .buckets import DEFAULT_LEN_BUCKETS, BucketGrid
+from .buckets import DEFAULT_LEN_BUCKETS, BucketGrid, TokenBucket
 from .controller import (
     FleetController,
     FleetSignals,
@@ -58,7 +71,7 @@ from .ingress import (
     IngressDisconnected,
     live_ingresses,
 )
-from .kvcache import CacheFull, PagePool
+from .kvcache import CacheFull, PagePool, Preempted
 from .reload import ReloadWatcher
 from .remote import RemoteReplica, WorkerCrashed, live_workers
 from .router import (
@@ -68,11 +81,18 @@ from .router import (
     ServerOverloaded,
     live_routers,
 )
-from .server import GenerateHandle, Server, live_servers
+from .server import (
+    DEFAULT_MODEL,
+    GenerateHandle,
+    Server,
+    TenantThrottled,
+    live_servers,
+)
 
 __all__ = [
     "Server", "BucketGrid", "ReloadWatcher", "live_servers",
     "GenerateHandle", "PagePool", "CacheFull", "DEFAULT_LEN_BUCKETS",
+    "DEFAULT_MODEL", "TenantThrottled", "Preempted", "TokenBucket",
     "Router", "ServerOverloaded", "FailoverExhausted", "ReplicaFault",
     "CircuitBreaker", "Heartbeat", "live_routers",
     "FleetController", "FleetSignals", "ScalePolicy",

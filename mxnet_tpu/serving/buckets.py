@@ -39,16 +39,61 @@ independent of co-batched traffic should start at batch bucket 2.
 """
 from __future__ import annotations
 
+import threading
+import time
 from typing import List, Optional, Sequence, Tuple
 
 import numpy as np
 
 from ..base import MXNetError
 
-__all__ = ["BucketGrid"]
+__all__ = ["BucketGrid", "TokenBucket"]
 
 DEFAULT_BATCH_BUCKETS = (1, 2, 4, 8, 16, 32)
 DEFAULT_LEN_BUCKETS = (16, 32, 64, 128, 256)
+
+
+class TokenBucket:
+    """Per-tenant admission rate limiter (the weighted-admission half of
+    multi-tenant serving): ``rate`` tokens/second refill into a bucket
+    of ``burst`` capacity, one token per admitted request. ``take()``
+    is non-blocking — an empty bucket is a SYNCHRONOUS, typed shed at
+    submit (``TenantThrottled``), never a queued request that starves
+    another tenant's deadline. Thread-safe (any submitter thread)."""
+
+    def __init__(self, rate: float, burst: Optional[float] = None):
+        rate = float(rate)
+        if rate <= 0:
+            raise MXNetError(f"token bucket rate must be > 0, got {rate}")
+        self.rate = rate
+        self.burst = float(burst) if burst is not None \
+            else max(1.0, rate)
+        if self.burst < 1:
+            raise MXNetError(
+                f"token bucket burst must be >= 1, got {self.burst}")
+        self._tokens = self.burst
+        self._t = time.monotonic()
+        self._lock = threading.Lock()
+
+    def take(self, n: float = 1.0) -> bool:
+        """Take ``n`` tokens if available; False (taking nothing) when
+        the bucket cannot cover them right now."""
+        now = time.monotonic()
+        with self._lock:
+            self._tokens = min(self.burst,
+                               self._tokens + (now - self._t) * self.rate)
+            self._t = now
+            if self._tokens < n:
+                return False
+            self._tokens -= n
+            return True
+
+    def level(self) -> float:
+        """Current token level (refilled to now) — observability only."""
+        now = time.monotonic()
+        with self._lock:
+            return min(self.burst,
+                       self._tokens + (now - self._t) * self.rate)
 
 
 class BucketGrid:

@@ -71,6 +71,7 @@ from ..fault import _state as _fault_state
 from ..telemetry import _state as _telemetry_state
 from .health import CLOSED, _env_float
 from .router import Router
+from .server import DEFAULT_MODEL
 
 __all__ = ["FleetController", "FleetSignals", "ScalePolicy",
            "ScrapeFleetSignals", "UpgradeRolledBack", "rolling_upgrade",
@@ -173,6 +174,11 @@ class ScrapeFleetSignals:
         self.router_label = ({"router": router} if router is not None
                              else None)
         self._last_shed: Optional[float] = None
+        # per-tenant router queue depths from the latest good scrape
+        # ({model: depth}) — a side-channel for multi-tenant dashboards
+        # and tests; FleetSignals itself stays tenant-agnostic (the
+        # scale policy sizes the fleet, not any one tenant)
+        self.last_tenant_depths: dict = {}
         # decode token-rate window: previous tokens_total reading and
         # when it was taken (same reset-clamp rule as the shed counter)
         self._last_tokens: Optional[float] = None
@@ -210,6 +216,23 @@ class ScrapeFleetSignals:
         n_replicas = telemetry.prom_value(
             parsed, "mxnet_controller_fleet_size",
             labels=self.router_label, default=-1.0)
+        # per-tenant queue depths (one gauge series per model); the
+        # router= label filter keeps replica-level series (router="")
+        # out when this source watches one named router
+        depths: dict = {}
+        fam = parsed.get("mxnet_serving_tenant_queue_depth")
+        if fam is not None:
+            want = self.router_label or {}
+            for s in fam["samples"]:
+                if s["name"] != "mxnet_serving_tenant_queue_depth":
+                    continue
+                if not all(s["labels"].get(k) == v
+                           for k, v in want.items()):
+                    continue
+                m = s["labels"].get("model", "")
+                if m:
+                    depths[m] = depths.get(m, 0) + int(s["value"])
+        self.last_tenant_depths = depths
         if n_replicas < 1:
             # the router host publishes its gauges from the monitor
             # tick — an exporter that answers before the first tick (or
@@ -604,7 +627,8 @@ def _bake(rep: dict, bake_s: float, poll_s: float = 0.05) -> Optional[str]:
 
 def rolling_upgrade(router: Router, model_factory: Callable,
                     bake_s: Optional[float] = None,
-                    version: Optional[int] = None) -> dict:
+                    version: Optional[int] = None,
+                    model: Optional[str] = None) -> dict:
     """Upgrade every replica of ``router`` to a new model, one at a
     time, with automatic rollback.
 
@@ -620,9 +644,15 @@ def rolling_upgrade(router: Router, model_factory: Callable,
     cause. On success every replica reports the same new
     ``model_version`` (``version`` or max(old)+1).
 
-    Returns ``{"version", "upgraded": [names...], "seconds"}``.
-    Serialized against scale actions via the router's admin lock — the
-    fleet cannot change shape mid-rollout.
+    ``model`` selects WHICH tenant is upgraded on a multi-tenant fleet
+    (default: the default tenant). The swap, the bake and a rollback
+    touch that tenant's block and version only — upgrading (or rolling
+    back) tenant A never rebuilds or rolls back tenant B, even though
+    both share the replica's cache pool and executable table.
+
+    Returns ``{"version", "model", "upgraded": [names...],
+    "seconds"}``. Serialized against scale actions via the router's
+    admin lock — the fleet cannot change shape mid-rollout.
     """
     if bake_s is None:
         bake_s = _env_float("MXNET_UPGRADE_BAKE", 1.0)
@@ -654,15 +684,28 @@ def rolling_upgrade(router: Router, model_factory: Callable,
                 " workers without in-place swap_model; upgrade a worker"
                 " fleet by respawning workers with the new factory "
                 "(remove_replica/add_replica)")
-        new_version = (max(r["server"].model_version for r in reps) + 1
-                       if version is None else int(version))
+        tenant = DEFAULT_MODEL if model is None else model
+        # every replica must serve the tenant BEFORE anything swaps —
+        # a mid-rollout unknown-model refusal would strand a partial
+        # upgrade (same shape as the remote refusal above)
+        missing = [r["name"] for r in reps
+                   if tenant not in r["server"].model_versions()]
+        if missing:
+            raise MXNetError(
+                f"rolling_upgrade: replicas {missing} do not serve "
+                f"model {tenant!r}; register it on the whole fleet "
+                "(Router.register_model) before upgrading it")
+        new_version = (
+            max(r["server"].model_versions()[tenant] for r in reps) + 1
+            if version is None else int(version))
         done: List[tuple] = []      # (rep, old_block, old_version)
 
         def _rollback(cause: BaseException, failed_at: str):
             for rep, old_block, old_version in reversed(done):
                 try:
                     rep["server"].swap_model(old_block,
-                                             version=old_version)
+                                             version=old_version,
+                                             model=tenant)
                 except Exception:   # noqa: BLE001 - keep restoring
                     _log.exception(
                         "rollback of replica %s failed — it keeps the "
@@ -670,19 +713,21 @@ def rolling_upgrade(router: Router, model_factory: Callable,
                 if _telemetry_state.enabled:
                     telemetry.record_upgrade_replica("rolled_back")
             raise UpgradeRolledBack(
-                f"upgrade to version {new_version} failed at replica "
-                f"{failed_at} ({cause}); {len(done)} replica(s) rolled "
-                "back to the previous model") from cause
+                f"upgrade of model {tenant!r} to version {new_version} "
+                f"failed at replica {failed_at} ({cause}); {len(done)} "
+                "replica(s) rolled back to the previous model"
+                ) from cause
 
         for rep in reps:
             server = rep["server"]
-            old_block = server.current_model()
-            old_version = server.model_version
+            old_block = server.current_model(model=tenant)
+            old_version = server.model_versions()[tenant]
             try:
                 if _fault_state.enabled:
                     fault.check("serving.upgrade", server.name)
                 new_block = model_factory(server)
-                server.swap_model(new_block, version=new_version)
+                server.swap_model(new_block, version=new_version,
+                                  model=tenant)
             except Exception as e:  # noqa: BLE001 - rollback path
                 if _telemetry_state.enabled:
                     telemetry.record_upgrade_replica("aborted")
@@ -693,8 +738,8 @@ def rolling_upgrade(router: Router, model_factory: Callable,
                 _rollback(MXNetError(failure), server.name)
             if _telemetry_state.enabled:
                 telemetry.record_upgrade_replica("ok")
-            _log.info("rolling upgrade: %s now at version %d",
-                      server.name, new_version)
-    return {"version": new_version,
+            _log.info("rolling upgrade: %s model %s now at version %d",
+                      server.name, tenant, new_version)
+    return {"version": new_version, "model": tenant,
             "upgraded": [r["name"] for r in reps],
             "seconds": time.perf_counter() - t_start}

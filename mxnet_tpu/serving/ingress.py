@@ -334,20 +334,27 @@ class Ingress:
             dsp.ts -= int((time.perf_counter() - t0) * 1e6)
             dsp.end()
         try:
+            # absent model/priority fields = default tenant: frames
+            # from peers that predate multi-tenancy route unchanged
             if tr is not None:
                 with tracing.active(tr, tr.root or tr.remote_parent):
                     fut = self.router.submit(
                         frame["sample"],
-                        deadline_ms=frame.get("deadline_ms"))
+                        deadline_ms=frame.get("deadline_ms"),
+                        model=frame.get("model"),
+                        priority=frame.get("priority"))
             else:
                 fut = self.router.submit(
-                    frame["sample"], deadline_ms=frame.get("deadline_ms"))
+                    frame["sample"], deadline_ms=frame.get("deadline_ms"),
+                    model=frame.get("model"),
+                    priority=frame.get("priority"))
         except Exception as e:  # noqa: BLE001 - typed onto the wire
             with conn.lock:
                 conn.inflight -= 1
             etype, _msg = wire.encode_error(e)
-            reason = etype if etype in ("overloaded",
-                                        "failover_exhausted") else "error"
+            reason = etype if etype in (
+                "overloaded", "failover_exhausted",
+                "throttled") else "error"
             if tr is not None:
                 tr.finish(reason)
             self._reject(conn, req_id, reason, e, etype=etype)
@@ -432,19 +439,22 @@ class Ingress:
                         frame["prompt"],
                         int(frame["max_new_tokens"]),
                         deadline_ms=frame.get("deadline_ms"),
-                        on_token=on_token)
+                        on_token=on_token,
+                        model=frame.get("model"),
+                        priority=frame.get("priority"))
             else:
                 handle = self.router.submit_generate(
                     frame["prompt"], int(frame["max_new_tokens"]),
                     deadline_ms=frame.get("deadline_ms"),
-                    on_token=on_token)
+                    on_token=on_token, model=frame.get("model"),
+                    priority=frame.get("priority"))
         except Exception as e:  # noqa: BLE001 - typed onto the wire
             with conn.lock:
                 conn.inflight -= 1
             etype, _msg = wire.encode_error(e)
             reason = etype if etype in (
                 "overloaded", "failover_exhausted",
-                "kvcache_full") else "error"
+                "kvcache_full", "throttled") else "error"
             if tr is not None:
                 tr.finish(reason)
             self._reject(conn, req_id, reason, e, etype=etype,
@@ -569,8 +579,9 @@ class IngressClient:
             target=self._reader_loop, name="ingress-client", daemon=True)
         self._reader.start()
 
-    def submit(self, sample, deadline_ms: Optional[float] = None
-               ) -> Future:
+    def submit(self, sample, deadline_ms: Optional[float] = None,
+               model: Optional[str] = None,
+               priority: Optional[int] = None) -> Future:
         fut = Future()
         with self._lock:
             if self._closed:
@@ -582,6 +593,12 @@ class IngressClient:
         frame = {"kind": "submit", "id": req_id, "sample": sample}
         if deadline_ms is not None:
             frame["deadline_ms"] = float(deadline_ms)
+        # tenant fields only when set: an old ingress ignores unknown
+        # header fields, an absent field means the default tenant
+        if model is not None:
+            frame["model"] = str(model)
+        if priority is not None:
+            frame["priority"] = int(priority)
         if _tracing_state.enabled:
             # propagate the caller's ambient trace context across the
             # socket (absent field = untraced; old servers ignore it)
@@ -598,7 +615,8 @@ class IngressClient:
 
     def submit_generate(self, prompt, max_new_tokens: int,
                         deadline_ms: Optional[float] = None,
-                        on_token=None):
+                        on_token=None, model: Optional[str] = None,
+                        priority: Optional[int] = None):
         """Same contract as :meth:`Router.submit_generate`, over the
         socket: a :class:`~.server.GenerateHandle` whose tokens stream
         in as the fleet decodes them (``on_token`` fires on this
@@ -623,6 +641,10 @@ class IngressClient:
                  "max_new_tokens": int(max_new_tokens)}
         if deadline_ms is not None:
             frame["deadline_ms"] = float(deadline_ms)
+        if model is not None:
+            frame["model"] = str(model)
+        if priority is not None:
+            frame["priority"] = int(priority)
         if _tracing_state.enabled:
             amb = tracing.ambient()
             if amb is not None:

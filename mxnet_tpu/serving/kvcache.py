@@ -46,7 +46,8 @@ import numpy as np
 from ..base import MXNetError
 from ..telemetry import _state as _telemetry_state
 
-__all__ = ["CacheFull", "PagePool", "make_kv_arena", "apply_defrag"]
+__all__ = ["CacheFull", "Preempted", "PagePool", "make_kv_arena",
+           "apply_defrag"]
 
 
 class CacheFull(MXNetError):
@@ -56,6 +57,18 @@ class CacheFull(MXNetError):
     shipped over :mod:`.wire` under the stable name ``kvcache_full`` so
     a remote caller gets this exact type back. The Router counts it as
     a shed (``mxnet_serving_shed_total{reason="kvcache_full"}``).
+    """
+
+
+class Preempted(MXNetError):
+    """This stream's pages were reclaimed for a higher-priority arrival.
+
+    Resolved onto the victim's ``GenerateHandle.future`` at a decode-step
+    boundary: every token streamed before the preemption is a clean,
+    sealed prefix (the chaos-gate-9 crash contract — never a torn
+    token), and the handle never wedges. Crosses :mod:`.wire` under the
+    stable name ``preempted``. Counted per tenant as
+    ``mxnet_serving_preempted_total{victim,beneficiary}``.
     """
 
 
@@ -163,7 +176,25 @@ class PagePool:
         out[:len(pages)] = pages
         return out
 
+    def owned(self, owner) -> List[int]:
+        """``owner``'s current page list (a copy). Needed after
+        :meth:`defrag`, which renumbers pages in place — any snapshot a
+        caller took at :meth:`alloc` time is stale the moment a defrag
+        runs."""
+        with self._lock:
+            return list(self._owned.get(owner, ()))
+
     # -- observability -------------------------------------------------
+    def frag_info(self) -> Tuple[int, int]:
+        """``(n_live, span)``: live page count and the highest live page
+        index (0 when empty). ``span - n_live`` is the number of free
+        holes below the high-water mark — the fragmentation measure the
+        serving scheduler's automatic :meth:`defrag` trigger thresholds
+        on (a packed pool has ``span == n_live``)."""
+        with self._lock:
+            live = [p for pages in self._owned.values() for p in pages]
+            return len(live), (max(live) if live else 0)
+
     def stats(self) -> dict:
         with self._lock:
             used = sum(len(p) for p in self._owned.values())

@@ -202,6 +202,7 @@ class RemoteReplica:
         self._futures: dict = {}      # id -> Future
         self._gens: dict = {}         # id -> GenerateHandle (streaming)
         self._traces: dict = {}       # id -> Trace (tracing on only)
+        self._registered: list = []   # tenant specs, replayed on respawn
         self._next_id = 0
         self._incarnation = 0         # bumps per successful spawn
         self._down_handled = -1       # last incarnation whose death ran
@@ -351,6 +352,13 @@ class RemoteReplica:
             threading.Thread(
                 target=self._waitpid_loop, args=(self.proc, inc),
                 name=f"{self.name}-waitpid", daemon=True).start()
+            # replay tenant registrations into the fresh process: the
+            # frame loop is sequential, so any later submit carrying
+            # model= lands after its tenant exists (no ack wait needed)
+            with self._lock:
+                specs = list(self._registered)
+            for spec in specs:
+                writer.send(dict(spec, kind="register_model"))
         except BaseException:
             if conn is not None:
                 try:
@@ -382,6 +390,8 @@ class RemoteReplica:
                         handle._push(int(frame["token"]))
                 elif kind == "gen_done":
                     self._on_gen_done(frame)
+                elif kind == "registered":
+                    self._on_registered(frame)
                 elif kind == "health":
                     # replay the worker scheduler's heartbeat age into
                     # this handle's beacon: the router's hung-dispatch
@@ -519,9 +529,85 @@ class RemoteReplica:
                        "giving up — the replica stays down",
                        self.name, attempt)
 
+    # -- tenants -------------------------------------------------------
+    def register_model(self, name: str, factory,
+                       slo_class: str = "standard", priority: int = 0,
+                       weight: float = 1.0,
+                       slo_ms: Optional[float] = None,
+                       rate_limit: Optional[float] = None,
+                       burst: Optional[float] = None,
+                       factory_kwargs: Optional[dict] = None,
+                       timeout: float = 60.0) -> None:
+        """Register tenant ``name`` on the worker process. ``factory``
+        must be an importable ``module:function`` spec string — the
+        same spec-not-closure contract as this handle's own
+        ``--factory``, because a live block cannot cross an exec
+        boundary (a callable raises typed). Blocks until the worker
+        acks (its warmup/engine build is inside that wait); the spec is
+        replayed automatically into every respawned incarnation."""
+        if callable(factory):
+            raise MXNetError(
+                f"{self.name}: register_model on an out-of-process "
+                "worker needs a 'module:function' factory spec, not a "
+                "callable (a live block cannot cross the exec boundary)")
+        spec = {"name": str(name), "factory": str(factory),
+                "factory_kwargs": dict(factory_kwargs or {}),
+                "paths": list(self.python_paths),
+                "slo_class": str(slo_class), "priority": int(priority),
+                "weight": float(weight)}
+        if slo_ms is not None:
+            spec["slo_ms"] = float(slo_ms)
+        if rate_limit is not None:
+            spec["rate_limit"] = float(rate_limit)
+        if burst is not None:
+            spec["burst"] = float(burst)
+        json.dumps(spec)                # fail at call time, typed
+        fut = Future()
+        with self._lock:
+            if not self._running or self._writer is None:
+                raise MXNetError(
+                    f"{self.name}: worker process is not running")
+            self._next_id += 1
+            req_id = self._next_id
+            self._futures[req_id] = fut
+            writer = self._writer
+            inc = self._incarnation
+        try:
+            writer.send(dict(spec, kind="register_model", id=req_id))
+        except (OSError, wire.FrameError) as e:
+            self._on_down(inc, f"send failed: {e}")
+            raise MXNetError(
+                f"{self.name}: worker connection lost at register: {e}"
+            ) from e
+        from concurrent.futures import TimeoutError as _FutTimeout
+
+        try:
+            fut.result(timeout)
+        except _FutTimeout:
+            with self._lock:
+                self._futures.pop(req_id, None)
+            raise MXNetError(
+                f"{self.name}: register_model({name!r}) did not ack "
+                f"within {timeout:g}s") from None
+        with self._lock:
+            self._registered.append(spec)
+
+    def _on_registered(self, frame: dict) -> None:
+        with self._lock:
+            fut = self._futures.pop(frame.get("id"), None)
+        if fut is None or not fut.set_running_or_notify_cancel():
+            return          # respawn replay ack (no waiter) or late
+        if frame.get("ok"):
+            fut.set_result(frame.get("name"))
+        else:
+            fut.set_exception(wire.decode_error(
+                frame.get("etype", "mxnet_error"),
+                frame.get("error", "register_model failed")))
+
     # -- dispatch ------------------------------------------------------
-    def submit(self, sample, deadline_ms: Optional[float] = None
-               ) -> Future:
+    def submit(self, sample, deadline_ms: Optional[float] = None,
+               model: Optional[str] = None,
+               priority: Optional[int] = None) -> Future:
         """Same contract as :meth:`Server.submit`, across the process
         boundary. Synchronous typed raise when the worker is down (the
         router reads that + ``is_running`` as replica death and fails
@@ -547,6 +633,10 @@ class RemoteReplica:
         frame = {"kind": "submit", "id": req_id, "sample": arr}
         if deadline_ms is not None:
             frame["deadline_ms"] = float(deadline_ms)
+        if model is not None:       # absent field = default tenant
+            frame["model"] = str(model)
+        if priority is not None:
+            frame["priority"] = int(priority)
         if _tracing_state.enabled:
             # ship the ambient span context in the frame header — the
             # worker adopts it, and its spans ride the result frame back
@@ -598,7 +688,8 @@ class RemoteReplica:
     # -- generate (paged-KV streaming) ---------------------------------
     def submit_generate(self, prompt, max_new_tokens: int,
                         deadline_ms: Optional[float] = None,
-                        on_token=None):
+                        on_token=None, model: Optional[str] = None,
+                        priority: Optional[int] = None):
         """Same contract as :meth:`Server.submit_generate`, across the
         process boundary: a :class:`~.server.GenerateHandle` whose
         tokens stream back as ``token`` frames (``on_token`` fires on
@@ -633,6 +724,10 @@ class RemoteReplica:
                  "max_new_tokens": int(max_new_tokens)}
         if deadline_ms is not None:
             frame["deadline_ms"] = float(deadline_ms)
+        if model is not None:       # absent field = default tenant
+            frame["model"] = str(model)
+        if priority is not None:
+            frame["priority"] = int(priority)
         if _tracing_state.enabled:
             amb = tracing.ambient()
             if amb is not None:

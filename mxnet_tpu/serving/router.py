@@ -92,7 +92,7 @@ from .health import (
     _env_float,
 )
 from .kvcache import CacheFull
-from .server import Server
+from .server import DEFAULT_MODEL, Server, TenantThrottled
 
 __all__ = ["Router", "ServerOverloaded", "FailoverExhausted",
            "ReplicaFault", "live_routers"]
@@ -138,9 +138,16 @@ class _RouteReq:
     result may race) and always leave the future resolved."""
 
     __slots__ = ("sample", "future", "t_enqueue", "deadline", "attempts",
-                 "started", "_lock", "trace", "span", "own_trace")
+                 "started", "_lock", "trace", "span", "own_trace",
+                 "model", "priority")
 
-    def __init__(self, sample, deadline_s: float):
+    def __init__(self, sample, deadline_s: float, model=None,
+                 priority=None):
+        # tenant fields ride the request through requeues and
+        # failovers: a retried dispatch must land in the SAME tenant's
+        # queue on the next replica
+        self.model = model
+        self.priority = priority
         self.sample = sample
         self.future = Future()
         self.t_enqueue = time.perf_counter()
@@ -324,6 +331,11 @@ class Router:
         # serializes fleet admin (add/remove/rolling upgrade) — the
         # dispatch path never takes it
         self._admin_lock = threading.Lock()
+        # tenant registry: name -> registration spec, so every replica
+        # (including ones admitted later) serves the same model set and
+        # submit() can reject an unknown tenant synchronously instead
+        # of refuse-spinning it against the fleet
+        self._models: dict = {}
 
         self._cond = threading.Condition()
         self._queue: deque = deque()
@@ -547,6 +559,87 @@ class Router:
             raise MXNetError(
                 f"replica name {server.name!r} already in the fleet")
 
+    def register_model(self, name: str, factory, *,
+                       slo_class: str = "standard", priority: int = 0,
+                       weight: float = 1.0,
+                       slo_ms: Optional[float] = None,
+                       rate_limit: Optional[float] = None,
+                       burst: Optional[float] = None,
+                       factory_kwargs: Optional[dict] = None) -> None:
+        """Register tenant ``name`` on EVERY replica in the fleet.
+
+        ``factory`` builds the tenant's block: a zero-(or kw-)arg
+        callable for in-process fleets (called once PER replica — each
+        replica owns its parameters), or a ``"module:function"`` spec
+        string, which is REQUIRED when any replica is out-of-process
+        (a callable cannot cross the exec boundary; the refusal is
+        typed, not a pickle crash). Replicas share one bucket grid, so
+        the tenant's executables land in the compilation service's
+        signature-keyed table once and every replica's warmup after the
+        first is a table hit. Serialized with fleet admin; replicas
+        admitted later via :meth:`add_replica` get the same model set
+        replayed before they take traffic."""
+        with self._admin_lock:
+            if name in self._models:
+                raise MXNetError(
+                    f"{self.name}: model {name!r} is already registered")
+            reps = list(self._replicas)
+            remote = [r for r in reps
+                      if not isinstance(r.server, Server)]
+            if remote and callable(factory):
+                raise MXNetError(
+                    f"{self.name}: model {name!r} uses a callable "
+                    "factory but the fleet includes out-of-process "
+                    f"replica {remote[0].server.name!r} — a callable "
+                    "cannot cross the process boundary; pass a "
+                    "'module:function' spec string instead")
+            kwargs = dict(factory_kwargs or {})
+            done: List[str] = []
+            try:
+                for r in reps:
+                    self._register_on(r.server, name, factory, kwargs,
+                                      slo_class, priority, weight,
+                                      slo_ms, rate_limit, burst)
+                    done.append(r.server.name)
+            except MXNetError as e:
+                # partial registration is worse than none — a request
+                # routed at an unregistered replica would refuse-spin.
+                # There is no unregister seam, so surface exactly which
+                # replicas took it and refuse the registry entry.
+                raise MXNetError(
+                    f"{self.name}: registering model {name!r} failed "
+                    f"after replicas {done} accepted it: {e}") from e
+            self._models[name] = {
+                "factory": factory, "factory_kwargs": kwargs,
+                "slo_class": slo_class, "priority": priority,
+                "weight": weight, "slo_ms": slo_ms,
+                "rate_limit": rate_limit, "burst": burst}
+
+    @staticmethod
+    def _register_on(server, name, factory, kwargs, slo_class,
+                     priority, weight, slo_ms, rate_limit, burst):
+        """Register one tenant on one replica, in-process or remote."""
+        if isinstance(server, Server):
+            if callable(factory):
+                block = factory(**kwargs)
+            else:
+                from .worker import load_factory
+                block = load_factory(factory)(**kwargs)
+            server.register_model(
+                name, block, slo_class=slo_class, priority=priority,
+                weight=weight, slo_ms=slo_ms, rate_limit=rate_limit,
+                burst=burst)
+        else:
+            server.register_model(
+                name, factory, slo_class=slo_class, priority=priority,
+                weight=weight, slo_ms=slo_ms, rate_limit=rate_limit,
+                burst=burst, factory_kwargs=kwargs)
+
+    def models(self) -> list:
+        """Registered tenant names (router registry; the default
+        tenant every replica carries is not listed)."""
+        return sorted(self._models)
+
     def add_replica(self, server: Server) -> None:
         """Admit one more ``Server`` replica into the fleet, live.
 
@@ -561,6 +654,19 @@ class Router:
         (serialized with ``remove_replica``/rolling upgrades)."""
         with self._admin_lock:      # serializes fleet admin: the name /
             self._check_compatible(server)   # grid check cannot race
+            # replay the tenant registry BEFORE the replica takes
+            # traffic: a submit(model=X) routed at a replica without X
+            # would refuse-spin against the fleet
+            have = getattr(server, "models", None)
+            have = set(have()) if have is not None else set()
+            for mname, spec in self._models.items():
+                if mname in have:
+                    continue
+                self._register_on(
+                    server, mname, spec["factory"],
+                    spec["factory_kwargs"], spec["slo_class"],
+                    spec["priority"], spec["weight"], spec["slo_ms"],
+                    spec["rate_limit"], spec["burst"])
             if self.is_running:
                 server._pre_dispatch = self._replica_fault_hook_for(server)
                 if not server.is_running:
@@ -735,15 +841,39 @@ class Router:
         fleet_batch = self.grid.max_batch * len(self._replicas)
         return (pending + 2 * fleet_batch) * busy / len(ts)
 
-    def submit(self, sample, deadline_ms: Optional[float] = None) -> Future:
+    def _check_model(self, model: Optional[str]) -> None:
+        """Reject an unknown tenant SYNCHRONOUSLY at admission: letting
+        it through would refuse-spin the request against every replica
+        until its deadline expired, reading as overload instead of a
+        caller bug. Tenants registered directly on an in-process Server
+        (bypassing the router registry) still pass."""
+        if model is None or model == DEFAULT_MODEL \
+                or model in self._models:
+            return
+        for r in self._replicas:
+            ms = getattr(r.server, "models", None)
+            if ms is not None:
+                if model in ms():
+                    return
+                break
+        self._count_request("rejected")
+        raise MXNetError(
+            f"{self.name}: unknown model {model!r} — register it with "
+            "Router.register_model first")
+
+    def submit(self, sample, deadline_ms: Optional[float] = None,
+               model: Optional[str] = None,
+               priority: Optional[int] = None) -> Future:
         """Enqueue one sample (no batch dimension) for the replica
         fleet; same contract as :meth:`Server.submit`. Raises
         synchronously — :class:`ServerOverloaded` on queue-full or a
-        predicted deadline miss, :class:`MXNetError` when stopped or no
-        shape bucket fits. Thread-safe. When the queue is empty the
-        dispatch itself runs on this thread (never blocking on it —
-        replica submits are enqueue-and-return); a backlog is drained
-        in FIFO order by the dispatcher thread."""
+        predicted deadline miss, :class:`MXNetError` when stopped, no
+        shape bucket fits, or ``model`` names an unregistered tenant.
+        Thread-safe. When the queue is empty the dispatch itself runs
+        on this thread (never blocking on it — replica submits are
+        enqueue-and-return); a backlog is drained in FIFO order by the
+        dispatcher thread."""
+        self._check_model(model)
         shape = getattr(sample, "shape", None)
         if shape is None:
             shape = np.asarray(sample).shape
@@ -756,19 +886,20 @@ class Router:
                 raise MXNetError(f"{self.name}: router is not running")
             pending = len(self._queue) + self._n_inflight
             if pending >= self.max_queue:
-                self._shed_locked("queue_full")
+                self._shed_locked("queue_full", model=model)
                 raise ServerOverloaded(
                     f"{self.name}: router queue full ({self.max_queue} "
                     "requests queued or in flight)")
             wait = (self._predicted_wait_locked(pending)
                     if pending > self._shed_arm_pending else 0.0)
             if wait > deadline_s:
-                self._shed_locked("predicted_wait")
+                self._shed_locked("predicted_wait", model=model)
                 raise ServerOverloaded(
                     f"{self.name}: predicted queue wait {wait * 1e3:.1f}"
                     f" ms exceeds the request deadline "
                     f"{deadline_s * 1e3:.1f} ms ({pending} pending)")
-            req = _RouteReq(sample, deadline_s)
+            req = _RouteReq(sample, deadline_s, model=model,
+                            priority=priority)
             if _tracing_state.enabled:
                 # the span must exist BEFORE the queue append: the
                 # dispatcher thread may route this request before
@@ -820,7 +951,8 @@ class Router:
 
     def submit_generate(self, prompt, max_new_tokens: int,
                         deadline_ms: Optional[float] = None,
-                        on_token=None):
+                        on_token=None, model: Optional[str] = None,
+                        priority: Optional[int] = None):
         """Route one autoregressive generate to a decode-capable
         replica (least-loaded CLOSED breaker). Returns the replica's
         :class:`~.server.GenerateHandle` directly — tokens stream
@@ -837,7 +969,11 @@ class Router:
         replica's cache budget) sheds synchronously and typed
         (``mxnet_serving_shed_total{reason="kvcache_full"}``) —
         replicas share one cache geometry, so another replica would
-        refuse it identically."""
+        refuse it identically. So does :class:`TenantThrottled`
+        (``reason="throttled"``) — retrying a tenant's rate-limit
+        refusal on a sibling would multiply the tenant's configured
+        rate by the fleet size."""
+        self._check_model(model)
         with self._cond:
             if not self._accepting:
                 self._count_request("rejected")
@@ -858,30 +994,42 @@ class Router:
                 if amb is not None:
                     trace = amb[0]
                     span = trace.begin("router.generate", parent=amb[1],
-                                       replica=r.server.name)
+                                       replica=r.server.name,
+                                       model=model or DEFAULT_MODEL)
                 else:
                     trace = tracing.new_trace("generate",
                                               router=self.name)
                     own = True
                     span = trace.begin("router.generate",
-                                       replica=r.server.name)
+                                       replica=r.server.name,
+                                       model=model or DEFAULT_MODEL)
             try:
                 if span is not None:
                     with tracing.active(trace, span):
                         handle = r.server.submit_generate(
                             prompt, max_new_tokens,
-                            deadline_ms=deadline_ms, on_token=on_token)
+                            deadline_ms=deadline_ms, on_token=on_token,
+                            model=model, priority=priority)
                 else:
                     handle = r.server.submit_generate(
                         prompt, max_new_tokens, deadline_ms=deadline_ms,
-                        on_token=on_token)
+                        on_token=on_token, model=model,
+                        priority=priority)
             except CacheFull:
                 if span is not None:
                     span.end(outcome="shed")
                 if own:
                     trace.finish("kvcache_full")
                 with self._cond:
-                    self._shed_locked("kvcache_full")
+                    self._shed_locked("kvcache_full", model=model)
+                raise
+            except TenantThrottled:
+                if span is not None:
+                    span.end(outcome="shed")
+                if own:
+                    trace.finish("throttled")
+                with self._cond:
+                    self._shed_locked("throttled", model=model)
                 raise
             except MXNetError as e:
                 # this replica refuses (decode off / queue full): not
@@ -929,18 +1077,20 @@ class Router:
         if last_err is not None:
             raise last_err
         with self._cond:
-            self._shed_locked("queue_full")
+            self._shed_locked("queue_full", model=model)
         raise ServerOverloaded(
             f"{self.name}: no decode-capable healthy replica admits "
             "generate requests right now")
 
-    def _shed_locked(self, reason: str) -> None:
+    def _shed_locked(self, reason: str,
+                     model: Optional[str] = None) -> None:
         self.n_shed += 1
         self.n_requests += 1
         if _telemetry_state.enabled:
-            telemetry.record_serving_shed(reason)
+            telemetry.record_serving_shed(reason, model=model)
         if _tracing_state.enabled:
-            tracing.record_event("shed", reason=reason, router=self.name)
+            tracing.record_event("shed", reason=reason, router=self.name,
+                                 model=model or DEFAULT_MODEL)
 
     def _count_request(self, outcome: str,
                        t_enqueue: Optional[float] = None,
@@ -1070,10 +1220,14 @@ class Router:
                 # or RemoteReplica wire frame) joins this trace
                 with tracing.active(req.trace, flight.span):
                     rfut = r.server.submit(req.sample,
-                                           deadline_ms=remaining_ms)
+                                           deadline_ms=remaining_ms,
+                                           model=req.model,
+                                           priority=req.priority)
             else:
                 rfut = r.server.submit(req.sample,
-                                       deadline_ms=remaining_ms)
+                                       deadline_ms=remaining_ms,
+                                       model=req.model,
+                                       priority=req.priority)
         except Exception as e:  # noqa: BLE001 - sync admission refusal
             with self._cond:
                 # guard like _on_replica_done: the hung-dispatch sweep
@@ -1088,6 +1242,19 @@ class Router:
                     self._cond.notify_all()
             if not live:
                 return      # the sweep owns this request's fate now
+            if isinstance(e, TenantThrottled):
+                # per-tenant rate-limit refusal: typed and TERMINAL —
+                # retrying on a sibling replica would multiply the
+                # tenant's configured rate by the fleet size
+                if flight.span is not None:
+                    flight.span.end(outcome="shed",
+                                    error=type(e).__name__)
+                if probe:
+                    r.breaker.release_probe()
+                if req.resolve_exc(e):
+                    with self._cond:
+                        self._shed_locked("throttled", model=req.model)
+                return
             if flight.span is not None:
                 flight.span.end(outcome="refused",
                                 error=type(e).__name__)
@@ -1393,12 +1560,22 @@ class Router:
             with self._cond:
                 depth = len(self._queue)
                 inflight = self._n_inflight
+                by_model: dict = {}
+                for q in self._queue:
+                    m = q.model or DEFAULT_MODEL
+                    by_model[m] = by_model.get(m, 0) + 1
             telemetry.set_router_queue_depth(depth, router=self.name)
             telemetry.set_router_inflight(inflight, router=self.name)
             telemetry.set_predicted_wait(self.predicted_wait(),
                                          router=self.name)
             telemetry.set_fleet_size(self.fleet_size(),
                                      router=self.name)
+            # per-tenant depth: every registered tenant gets a sample
+            # (zero included) so a drained queue reads as 0, not stale
+            for m in ({DEFAULT_MODEL} | set(self._models)
+                      | set(by_model)):
+                telemetry.set_tenant_queue_depth(
+                    by_model.get(m, 0), m, router=self.name)
 
     def _check_dispatcher(self) -> None:
         if self._wedged or not self._running:
@@ -1431,6 +1608,7 @@ class Router:
             "inflight": inflight, "running": self.is_running,
             "wedged": self._wedged,
             "fleet_size": self.fleet_size(),
+            "models": sorted(self._models),
             "replicas": [
                 {"name": r.server.name, "index": r.index,
                  "state": r.breaker.state, "inflight": r.inflight,

@@ -34,11 +34,12 @@ all exported via ``telemetry.prom_text()``.
 from __future__ import annotations
 
 import contextlib
+import itertools
 import threading
 import time
 import weakref
 from concurrent.futures import Future
-from typing import Optional, Sequence, Tuple
+from typing import Dict, Optional, Sequence, Tuple
 
 import numpy as np
 
@@ -47,11 +48,58 @@ from ..base import MXNetError
 from ..fault import _state as _fault_state
 from ..telemetry import _state as _telemetry_state
 from ..tracing import _state as _tracing_state
-from .buckets import DEFAULT_LEN_BUCKETS, BucketGrid
+from .buckets import DEFAULT_LEN_BUCKETS, BucketGrid, TokenBucket
 from .health import Heartbeat
-from .kvcache import CacheFull, PagePool
+from .kvcache import CacheFull, PagePool, Preempted
 
-__all__ = ["Server", "GenerateHandle", "live_servers"]
+__all__ = ["Server", "GenerateHandle", "TenantThrottled", "live_servers"]
+
+DEFAULT_MODEL = "default"
+
+
+class TenantThrottled(MXNetError):
+    """Typed per-tenant admission shed: this tenant's token bucket is
+    empty. Synchronous at submit (never a queued request burning another
+    tenant's deadline budget) and scoped to ONE tenant — the fleet is
+    not overloaded, this tenant's configured rate is. Crosses
+    :mod:`.wire` under the stable name ``throttled``."""
+
+
+class _Tenant:
+    """One registered model sharing this server's replica.
+
+    Tenants share the bucket grid, the scheduler thread, and (when
+    decode is on) the ONE :class:`PagePool` — page accounting is the
+    multi-tenant contention point priority preemption arbitrates. Each
+    tenant owns its block, its decode engine (its own K/V arenas over
+    the shared page numbering), its model version, its admission
+    token-bucket, and its weighted-fair credit state (credits are only
+    ever touched by the scheduler thread)."""
+
+    __slots__ = ("name", "block", "slo_class", "priority", "weight",
+                 "slo_s", "bucket", "engine", "engine_version",
+                 "model_version", "credit", "dcredit", "warm_sigs",
+                 "n_requests", "n_shed", "n_preempted", "n_tokens")
+
+    def __init__(self, name, block, slo_class, priority, weight, slo_s,
+                 bucket):
+        self.name = name
+        self.block = block
+        self.slo_class = slo_class
+        self.priority = int(priority)
+        self.weight = float(weight)
+        self.slo_s = float(slo_s)
+        self.bucket = bucket            # TokenBucket or None
+        self.engine = None
+        self.engine_version = -1
+        self.model_version = 0
+        self.credit = 0.0               # weighted-fair classify pick
+        self.dcredit = 0.0              # weighted-fair decode slots
+        self.warm_sigs = set()          # sigs THIS tenant has served
+        self.n_requests = 0
+        self.n_shed = 0
+        self.n_preempted = 0            # streams evicted FROM this tenant
+        self.n_tokens = 0
 
 # every running server, for the test-suite leak guard: a test that leaves
 # a scheduler (or watcher) thread running would tax every later test
@@ -65,11 +113,12 @@ def live_servers():
 
 class _Request:
     __slots__ = ("sample", "shape_key", "future", "t_enqueue", "deadline",
-                 "trace", "span", "own_trace")
+                 "trace", "span", "own_trace", "tenant")
 
-    def __init__(self, sample, shape_key, deadline_s):
+    def __init__(self, sample, shape_key, deadline_s, tenant=None):
         self.sample = sample
         self.shape_key = shape_key
+        self.tenant = tenant
         self.future = Future()
         self.t_enqueue = time.perf_counter()
         self.deadline = self.t_enqueue + deadline_s
@@ -144,12 +193,17 @@ class GenerateHandle:
 class _GenRequest:
     __slots__ = ("prompt", "max_new", "handle", "pages", "length",
                  "generated", "t_submit", "t_last", "deadline", "trace",
-                 "span", "own_trace", "len_bucket", "model_version")
+                 "span", "own_trace", "len_bucket", "model_version",
+                 "tenant", "priority", "seq")
 
-    def __init__(self, prompt, max_new, handle, deadline_s):
+    def __init__(self, prompt, max_new, handle, deadline_s, tenant=None,
+                 priority=0, seq=0):
         self.prompt = prompt                 # 1-D int32 token array
         self.max_new = int(max_new)
         self.handle = handle
+        self.tenant = tenant
+        self.priority = int(priority)        # preemption rank
+        self.seq = int(seq)                  # stream id (preempt events)
         self.pages = None                    # page list once admitted
         self.length = len(prompt)            # tokens written OR known
         self.generated: list = []
@@ -213,7 +267,11 @@ class Server:
                  batch_timeout_ms: Optional[float] = None,
                  decode_pages: Optional[int] = None, page_size: int = 16,
                  len_buckets=None,
-                 max_generate_tokens: Optional[int] = None):
+                 max_generate_tokens: Optional[int] = None,
+                 slo_class: str = "standard", priority: int = 0,
+                 weight: float = 1.0, rate_limit: Optional[float] = None,
+                 burst: Optional[float] = None,
+                 defrag_threshold: Optional[float] = 0.25):
         if slo_ms <= 0:
             raise MXNetError(f"slo_ms must be > 0, got {slo_ms}")
         if close_margin_ms < 0 or close_margin_ms >= slo_ms:
@@ -246,10 +304,7 @@ class Server:
                     f"({decode_pages} pages x {page_size}, scratch "
                     "page excluded)")
         self._pool: Optional[PagePool] = None
-        self._engine = None
-        self._engine_version = -1
         self._gen_table_w = 0
-        self._gen_pending: list = []
         self._gen_active: list = []
         self.n_tokens = 0
         self.slo_s = slo_ms / 1e3
@@ -261,10 +316,36 @@ class Server:
         self.ctx = ctx
         self.name = name or f"server_{id(self):x}"
         self._warmup = bool(warmup)
-        self._model = block
         self._model_lock = threading.Lock()
         self._cond = threading.Condition()
-        self._queue: list = []
+        # multi-tenant registry: the constructor block IS tenant
+        # "default" (single-tenant callers never see the registry);
+        # register_model() adds tenants sharing this replica. Per-tenant
+        # queues so one tenant's burst cannot push another's requests
+        # back in a shared FIFO.
+        self._tenants: Dict[str, _Tenant] = {}
+        self._queues: Dict[str, list] = {}
+        self._gen_pending: Dict[str, list] = {}
+        self._seq = itertools.count()       # stream ids (preempt events)
+        if weight <= 0:
+            raise MXNetError(f"weight must be > 0, got {weight}")
+        bucket = (TokenBucket(rate_limit, burst)
+                  if rate_limit is not None else None)
+        t0 = _Tenant(DEFAULT_MODEL, block, str(slo_class), priority,
+                     weight, self.slo_s, bucket)
+        self._tenants[DEFAULT_MODEL] = t0
+        self._queues[DEFAULT_MODEL] = []
+        self._gen_pending[DEFAULT_MODEL] = []
+        # automatic defrag trigger: pack the pool when free holes below
+        # its high-water mark exceed this many pages (None disables)
+        self._defrag_min_pages: Optional[int] = None
+        if defrag_threshold is not None and decode_pages is not None:
+            if not 0 < float(defrag_threshold) <= 1:
+                raise MXNetError(
+                    f"defrag_threshold must be in (0, 1] or None, got "
+                    f"{defrag_threshold}")
+            self._defrag_min_pages = max(
+                2, int(float(defrag_threshold) * (int(decode_pages) - 1)))
         self._drain = True
         self._running = False
         self._thread: Optional[threading.Thread] = None
@@ -280,18 +361,107 @@ class Server:
         # patiently filling a batch toward its deadline close.
         self.hb = Heartbeat()
         self.loaded_step: Optional[int] = None
-        # monotonic model-version counter: bumps on every swap_model /
-        # reload; a rolling-upgrade rollback restores the OLD number so
-        # fleet version agreement is observable (Router/controller read
-        # it, never write it)
-        self.model_version = 0
         # signatures actually compiled/used — the reload warmup manifest
+        # (union across tenants; each tenant also tracks its own)
         self._warm_sigs = set()
         # always-on light counters (telemetry covers the full story)
         self.n_requests = 0
         self.n_batches = 0
         self.n_errors = 0
         self.n_reloads = 0
+        self.n_preemptions = 0
+        self.n_defrags = 0
+
+    # -- single-tenant compat: the default tenant's block/version are
+    # the server's (tests, controller and chaos gates read these) ------
+    @property
+    def _model(self):
+        return self._tenants[DEFAULT_MODEL].block
+
+    @_model.setter
+    def _model(self, block) -> None:
+        self._tenants[DEFAULT_MODEL].block = block
+
+    @property
+    def model_version(self) -> int:
+        """The DEFAULT tenant's monotonic model-version counter: bumps
+        on every swap_model / reload; a rolling-upgrade rollback
+        restores the OLD number so fleet version agreement is
+        observable (Router/controller read it, never write it).
+        Per-tenant versions: :meth:`model_versions`."""
+        return self._tenants[DEFAULT_MODEL].model_version
+
+    @model_version.setter
+    def model_version(self, v: int) -> None:
+        self._tenants[DEFAULT_MODEL].model_version = int(v)
+
+    def model_versions(self) -> Dict[str, int]:
+        """Per-tenant model versions (upgrading tenant A never touches
+        tenant B's number — the per-model rolling-upgrade contract)."""
+        with self._model_lock:
+            return {n: t.model_version for n, t in self._tenants.items()}
+
+    def models(self):
+        """Registered tenant names (``"default"`` always present)."""
+        return sorted(self._tenants)
+
+    def _tenant(self, model) -> _Tenant:
+        name = DEFAULT_MODEL if model is None else str(model)
+        t = self._tenants.get(name)
+        if t is None:
+            raise MXNetError(
+                f"{self.name}: unknown model {name!r} (registered: "
+                f"{sorted(self._tenants)})")
+        return t
+
+    def register_model(self, name: str, block, slo_class: str = "standard",
+                       priority: int = 0, weight: float = 1.0,
+                       slo_ms: Optional[float] = None,
+                       rate_limit: Optional[float] = None,
+                       burst: Optional[float] = None) -> "_Tenant":
+        """Register a second (third, ...) model to serve from THIS
+        replica. Tenants share the scheduler, the bucket grid and — when
+        decode is on — the one page pool; through the compilation
+        service's signature-keyed executable table an identical-config
+        tenant costs a warmup, not a second fleet.
+
+        ``slo_class`` is a label carried into telemetry/trace spans;
+        ``priority`` orders preemption (higher preempts lower when the
+        page pool is full); ``weight`` sets this tenant's weighted-fair
+        share of batch-close picks and decode slots; ``rate_limit``
+        (requests/second, with ``burst``) arms a per-tenant admission
+        token bucket — an empty bucket sheds synchronously with
+        :class:`TenantThrottled`. ``slo_ms`` overrides the server SLO
+        for this tenant's default deadline."""
+        name = str(name)
+        if not name:
+            raise MXNetError("tenant name must be non-empty")
+        if weight <= 0:
+            raise MXNetError(f"weight must be > 0, got {weight}")
+        if name in self._tenants:
+            raise MXNetError(
+                f"{self.name}: model {name!r} is already registered")
+        bucket = (TokenBucket(rate_limit, burst)
+                  if rate_limit is not None else None)
+        t = _Tenant(name, block, str(slo_class), priority, weight,
+                    slo_ms / 1e3 if slo_ms is not None else self.slo_s,
+                    bucket)
+        if self.is_running:
+            # warm + build the decode engine BEFORE the tenant is
+            # visible to submitters: its first request must not retrace
+            self._warm_block(block, prime=True)
+            if self._decode_pages is not None:
+                t.engine = self._make_engine(block)
+                t.engine_version = t.model_version
+        with self._cond:
+            if name in self._tenants:
+                raise MXNetError(
+                    f"{self.name}: model {name!r} is already registered")
+            self._tenants[name] = t
+            self._queues[name] = []
+            self._gen_pending[name] = []
+            self._cond.notify_all()
+        return t
 
     # -- lifecycle -----------------------------------------------------
     @property
@@ -299,27 +469,32 @@ class Server:
         return self._running or (self._thread is not None
                                  and self._thread.is_alive())
 
+    def _make_engine(self, block):
+        """Build ``block``'s decode engine over the SHARED page pool.
+        The engine dtype is the KV/compute dtype, not the request I/O
+        dtype: token servers run dtype="int32" but the cache must hold
+        floats (bf16/f32 servers keep their precision)."""
+        if not hasattr(block, "decode_engine"):
+            raise MXNetError(
+                f"{self.name}: decode_pages set but the model has no "
+                "decode_engine() seam (paged-KV generate needs a "
+                "decode-capable model)")
+        eng_dt = (self.dtype
+                  if np.issubdtype(np.dtype(self.dtype), np.floating)
+                  else "float32")
+        return block.decode_engine(self._pool, dtype=eng_dt)
+
     def start(self) -> "Server":
         """Warm the bucket grid and start the scheduler thread."""
         if self.is_running:
             raise MXNetError(f"{self.name}: already running")
-        self._warm_block(self._model, prime=True)
+        for t in self._tenants.values():
+            self._warm_block(t.block, prime=True)
         if self._decode_pages is not None:
-            if not hasattr(self._model, "decode_engine"):
-                raise MXNetError(
-                    f"{self.name}: decode_pages set but the model has no "
-                    "decode_engine() seam (paged-KV generate needs a "
-                    "decode-capable model)")
             self._pool = PagePool(self._decode_pages, self._page_size)
-            # the engine dtype is the KV/compute dtype, not the request
-            # I/O dtype: token servers run dtype="int32" but the cache
-            # must hold floats (bf16/f32 servers keep their precision)
-            eng_dt = (self.dtype
-                      if np.issubdtype(np.dtype(self.dtype), np.floating)
-                      else "float32")
-            self._engine = self._model.decode_engine(self._pool,
-                                                     dtype=eng_dt)
-            self._engine_version = self.model_version
+            for t in self._tenants.values():
+                t.engine = self._make_engine(t.block)
+                t.engine_version = t.model_version
             self._gen_table_w = self._pool.pages_for(self._max_gen_tokens)
         self._running = True
         self._thread = threading.Thread(
@@ -337,14 +512,17 @@ class Server:
             self._running = False
             self._drain = bool(drain)
             if not drain:
-                pending, self._queue = self._queue, []
+                pending = [r for q in self._queues.values() for r in q]
+                for q in self._queues.values():
+                    del q[:]
                 for r in pending:
                     if not r.future.set_running_or_notify_cancel():
                         continue        # caller already cancelled it
                     r.future.set_exception(
                         MXNetError(f"{self.name}: server stopped before "
                                    "this request was dispatched"))
-                    self._count_request(outcome="rejected")
+                    self._count_request(outcome="rejected",
+                                        tenant=r.tenant)
                     self._end_trace_rejected(r)
             self._cond.notify_all()
         if self._watcher is not None:
@@ -366,21 +544,45 @@ class Server:
         self.stop(drain=not any(exc))
 
     # -- ingress -------------------------------------------------------
-    def submit(self, sample, deadline_ms: Optional[float] = None) -> Future:
+    def _throttle(self, t: _Tenant) -> None:
+        """Per-tenant token-bucket admission: raises
+        :class:`TenantThrottled` (synchronous, typed, scoped to ONE
+        tenant) when ``t``'s bucket is empty."""
+        if t.bucket is None or t.bucket.take():
+            return
+        t.n_shed += 1
+        self._count_request(outcome="rejected", tenant=t)
+        if _telemetry_state.enabled:
+            telemetry.record_serving_shed("throttled", model=t.name)
+        raise TenantThrottled(
+            f"{self.name}: tenant {t.name!r} over its admission rate "
+            f"({t.bucket.rate:g}/s, burst {t.bucket.burst:g})")
+
+    def submit(self, sample, deadline_ms: Optional[float] = None,
+               model: Optional[str] = None,
+               priority: Optional[int] = None) -> Future:
         """Enqueue one sample (NO batch dimension); returns a Future that
         resolves to the model output for that sample (numpy leaves).
         Thread-safe. Raises :class:`MXNetError` immediately when the
         server is not running, the queue is full, or no shape bucket
         fits the sample — rejection is synchronous, never a hung future.
+
+        ``model=`` selects the tenant (default: the constructor block);
+        its SLO class sets the default deadline and its token bucket
+        (if armed) may shed with :class:`TenantThrottled`. ``priority``
+        is accepted for wire symmetry (classify requests are never
+        preempted — only generate streams hold pages).
         """
+        t = self._tenant(model)
+        self._throttle(t)
         arr = sample.asnumpy() if hasattr(sample, "asnumpy") \
             else np.asarray(sample)
         arr = np.ascontiguousarray(arr, dtype=self.dtype)
         bucket = self.grid.bucket_shape(arr.shape)   # raises if none fits
         arr = self.grid.pad_sample(arr, bucket)
         deadline_s = (deadline_ms / 1e3 if deadline_ms is not None
-                      else self.slo_s)
-        req = _Request(arr, bucket, deadline_s)
+                      else t.slo_s)
+        req = _Request(arr, bucket, deadline_s, tenant=t)
         if _tracing_state.enabled:
             # the span must exist BEFORE the queue append: the scheduler
             # may batch-close this request before submit returns
@@ -388,32 +590,41 @@ class Server:
             if amb is not None:
                 req.trace = amb[0]
                 req.span = req.trace.begin(
-                    "batch.wait", parent=amb[1], replica=self.name)
+                    "batch.wait", parent=amb[1], replica=self.name,
+                    model=t.name, slo_class=t.slo_class)
             else:
-                req.trace = tracing.new_trace("request", replica=self.name)
+                req.trace = tracing.new_trace(
+                    "request", replica=self.name, model=t.name,
+                    slo_class=t.slo_class)
                 req.own_trace = True
-                req.span = req.trace.begin("batch.wait", replica=self.name)
+                req.span = req.trace.begin(
+                    "batch.wait", replica=self.name, model=t.name,
+                    slo_class=t.slo_class)
         with self._cond:
             if not self._running:
-                self._count_request(outcome="rejected")
+                self._count_request(outcome="rejected", tenant=t)
                 self._end_trace_rejected(req)
                 raise MXNetError(f"{self.name}: server is not running")
-            if len(self._queue) >= self.max_queue:
-                self._count_request(outcome="rejected")
+            q = self._queues[t.name]
+            if len(q) >= self.max_queue:
+                self._count_request(outcome="rejected", tenant=t)
                 self._end_trace_rejected(req)
                 raise MXNetError(
-                    f"{self.name}: submission queue full "
-                    f"({self.max_queue} requests)")
-            self._queue.append(req)
-            depth = len(self._queue)
+                    f"{self.name}: submission queue full for model "
+                    f"{t.name!r} ({self.max_queue} requests)")
+            q.append(req)
+            depth = sum(len(x) for x in self._queues.values())
+            tenant_depth = len(q)
             self._cond.notify_all()
         if _telemetry_state.enabled:
             telemetry.set_serving_queue_depth(depth)
+            telemetry.set_tenant_queue_depth(tenant_depth, t.name)
         return req.future
 
     def submit_generate(self, prompt, max_new_tokens: int,
                         deadline_ms: Optional[float] = None,
-                        on_token=None) -> GenerateHandle:
+                        on_token=None, model: Optional[str] = None,
+                        priority: Optional[int] = None) -> GenerateHandle:
         """Enqueue one autoregressive generate request: ``prompt`` is a
         1-D int32 token array, ``max_new_tokens`` the completion budget
         (greedy decode). Returns a :class:`GenerateHandle` streaming
@@ -428,10 +639,18 @@ class Server:
 
         ``deadline_ms`` bounds the WHOLE completion (default: none —
         generates outlive the per-request SLO by design).
+
+        ``model=`` selects the tenant; ``priority`` overrides the
+        tenant's preemption rank for this stream (higher-priority
+        arrivals may reclaim a lower-priority stream's pages — the
+        victim resolves typed :class:`~.kvcache.Preempted` with a
+        sealed clean-prefix stream).
         """
         if self._decode_pages is None:
             raise MXNetError(f"{self.name}: decode is not enabled "
                              "(construct the server with decode_pages=)")
+        t = self._tenant(model)
+        self._throttle(t)
         arr = prompt.asnumpy() if hasattr(prompt, "asnumpy") \
             else np.asarray(prompt)
         arr = np.ascontiguousarray(arr, dtype=np.int32).reshape(-1)
@@ -443,8 +662,10 @@ class Server:
         len_bucket = self.grid.prefill_bucket(arr.size)  # raises: no fit
         total = arr.size + int(max_new_tokens)
         if total > self._max_gen_tokens:
+            t.n_shed += 1
             if _telemetry_state.enabled:
-                telemetry.record_serving_shed("kvcache_full")
+                telemetry.record_serving_shed("kvcache_full",
+                                              model=t.name)
             raise CacheFull(
                 f"{self.name}: prompt {arr.size} + max_new_tokens "
                 f"{max_new_tokens} exceeds the {self._max_gen_tokens}-"
@@ -452,33 +673,42 @@ class Server:
         handle = GenerateHandle(on_token)
         req = _GenRequest(arr, max_new_tokens, handle,
                           deadline_ms / 1e3 if deadline_ms is not None
-                          else None)
+                          else None, tenant=t,
+                          priority=(t.priority if priority is None
+                                    else priority),
+                          seq=next(self._seq))
         req.len_bucket = len_bucket
         if _tracing_state.enabled:
             amb = tracing.ambient()
             if amb is not None:
                 req.trace = amb[0]
                 req.span = req.trace.begin("gen.queue", parent=amb[1],
-                                           replica=self.name)
+                                           replica=self.name,
+                                           model=t.name,
+                                           slo_class=t.slo_class)
             else:
                 req.trace = tracing.new_trace(
                     "generate", replica=self.name,
                     prompt_len=int(arr.size),
-                    max_new=int(max_new_tokens))
+                    max_new=int(max_new_tokens), model=t.name,
+                    slo_class=t.slo_class)
                 req.own_trace = True
-                req.span = req.trace.begin("gen.queue", replica=self.name)
+                req.span = req.trace.begin("gen.queue", replica=self.name,
+                                           model=t.name,
+                                           slo_class=t.slo_class)
         with self._cond:
             if not self._running:
-                self._count_request(outcome="rejected")
+                self._count_request(outcome="rejected", tenant=t)
                 self._end_gen_rejected(req)
                 raise MXNetError(f"{self.name}: server is not running")
-            if len(self._gen_pending) >= self.max_queue:
-                self._count_request(outcome="rejected")
+            q = self._gen_pending[t.name]
+            if len(q) >= self.max_queue:
+                self._count_request(outcome="rejected", tenant=t)
                 self._end_gen_rejected(req)
                 raise MXNetError(
-                    f"{self.name}: generate queue full "
-                    f"({self.max_queue} requests)")
-            self._gen_pending.append(req)
+                    f"{self.name}: generate queue full for model "
+                    f"{t.name!r} ({self.max_queue} requests)")
+            q.append(req)
             self._cond.notify_all()
         return handle
 
@@ -494,25 +724,107 @@ class Server:
             req.trace.finish(status)
 
     # -- decode phase (continuous batching) ----------------------------
+    @staticmethod
+    def _wrr_pick(tenants, field: str = "credit") -> _Tenant:
+        """Smooth weighted round-robin over ``tenants``: every pick adds
+        each tenant's weight to its credit, takes the max, and charges
+        the winner the total — long-run pick shares converge to the
+        configured weights (scheduler thread only)."""
+        total = 0.0
+        for t in tenants:
+            total += t.weight
+            setattr(t, field, getattr(t, field) + t.weight)
+        best = max(tenants, key=lambda t: getattr(t, field))
+        setattr(best, field, getattr(best, field) - total)
+        return best
+
+    def _preempt(self, victim: "_GenRequest",
+                 beneficiary: "_GenRequest") -> None:
+        """Evict ``victim`` for a higher-priority arrival — AT a decode
+        step boundary, so every token it streamed is a clean, sealed
+        prefix (never a torn token). The handle resolves typed
+        :class:`~.kvcache.Preempted`; the flight recorder names victim
+        and beneficiary."""
+        victim.tenant.n_preempted += 1
+        self.n_preemptions += 1
+        if _telemetry_state.enabled:
+            telemetry.record_preemption(victim.tenant.name,
+                                        beneficiary.tenant.name)
+        if _tracing_state.enabled:
+            tracing.record_event(
+                "preempted", replica=self.name,
+                victim=victim.seq, beneficiary=beneficiary.seq,
+                victim_model=victim.tenant.name,
+                beneficiary_model=beneficiary.tenant.name,
+                victim_priority=victim.priority,
+                beneficiary_priority=beneficiary.priority,
+                victim_tokens=len(victim.generated))
+        self._finalize_gen(victim, error=Preempted(
+            f"{self.name}: stream preempted at token "
+            f"{len(victim.generated)}/{victim.max_new}: pages reclaimed "
+            f"for higher-priority {beneficiary.tenant.name!r} arrival "
+            f"(priority {beneficiary.priority} > {victim.priority})"))
+
+    def _admit_pages(self, g: "_GenRequest", active: list):
+        """All-or-nothing page allocation for ``g``, preempting
+        lower-priority active streams (lowest priority first, then the
+        one with the least progress to waste) until it fits. Victims
+        are removed from ``active`` in place. Raises
+        :class:`~.kvcache.CacheFull` when ``g`` cannot fit even with
+        every lower-priority stream evicted."""
+        while True:
+            try:
+                return self._pool.alloc(g, g.length + g.max_new)
+            except CacheFull:
+                lower = [v for v in active if v.priority < g.priority]
+                if not lower:
+                    raise
+                # evict nobody unless eviction actually admits g: a
+                # too-big arrival must not waste victims' work
+                need = self._pool.pages_for(g.length + g.max_new)
+                avail = (self._pool.stats()["free"]
+                         + sum(len(self._pool.owned(v)) for v in lower))
+                if need > avail:
+                    raise
+                victim = min(lower,
+                             key=lambda v: (v.priority, len(v.generated)))
+                self._preempt(victim, beneficiary=g)
+                active.remove(victim)
+
     def _decode_tick(self) -> bool:
         """One continuous-batching turn: admit pending generates
-        (prefill), then run ONE decode step for every active request.
+        (prefill), then run ONE decode step round for active requests.
         Requests join and leave the decode batch at any step boundary.
-        Returns False when nothing could move (scheduler backs off)."""
+        Multi-tenant: admission interleaves per-tenant pending queues
+        weighted-fair, a full pool preempts the lowest-priority active
+        stream for a higher-priority arrival, and decode slots are
+        assigned weighted-fair per round. Returns False when nothing
+        could move (scheduler backs off)."""
         progressed = False
         now = time.perf_counter()
         with self._cond:
             active = list(self._gen_active)
-            pending = list(self._gen_pending)
-        # deferred weight swap: a completion runs entirely on ONE model
-        # version, so a hot reload only reaches the decode engine
-        # between completions — never mid-request
-        if not active and self._engine_version != self.model_version:
-            self._engine.refresh_params(self._model)
-            self._engine_version = self.model_version
-        # -- admission: all-or-nothing page allocation per request
-        admitted = []
-        for g in pending:
+            pending = {n: list(q) for n, q in self._gen_pending.items()
+                       if q}
+        # deferred per-tenant weight swap: a completion runs entirely on
+        # ONE model version, so a hot reload reaches a tenant's decode
+        # engine only while that tenant has no active completions —
+        # never mid-request (and never another tenant's swap)
+        for t in self._tenants.values():
+            if (t.engine is not None
+                    and t.engine_version != t.model_version
+                    and not any(g.tenant is t for g in active)):
+                t.engine.refresh_params(t.block)
+                t.engine_version = t.model_version
+        # -- admission: weighted-fair across tenants, all-or-nothing
+        #    page allocation per request, preemption on a full pool
+        admitted: list = []
+        while pending and len(admitted) < self.grid.max_batch:
+            t = self._wrr_pick([self._tenants[n] for n in pending])
+            queue = pending[t.name]
+            g = queue.pop(0)
+            if not queue:
+                del pending[t.name]
             if g.deadline is not None and now > g.deadline:
                 self._remove_pending(g)
                 self._finalize_gen(g, error=MXNetError(
@@ -520,31 +832,36 @@ class Server:
                     "prefill (cache/backlog starvation)"))
                 progressed = True
                 continue
-            if len(admitted) >= self.grid.max_batch:
-                break
             try:
-                g.pages = self._pool.alloc(g, g.length + g.max_new)
+                g.pages = self._admit_pages(g, active)
             except CacheFull as e:
                 if not active and not admitted:
                     # nothing holds pages and it STILL does not fit:
                     # waiting cannot help — shed typed, never wedge
+                    t.n_shed += 1
                     if _telemetry_state.enabled:
-                        telemetry.record_serving_shed("kvcache_full")
+                        telemetry.record_serving_shed("kvcache_full",
+                                                      model=t.name)
                     self._remove_pending(g)
                     self._finalize_gen(g, error=e)
                     progressed = True
                     continue
-                break       # actives will free pages; retry next tick
+                # this tenant's head is blocked until actives free
+                # pages; other tenants keep admitting this tick
+                pending.pop(t.name, None)
+                continue
             self._remove_pending(g)
             admitted.append(g)
         if admitted:
             groups: dict = {}
             for g in admitted:
-                groups.setdefault(g.len_bucket, []).append(g)
-            for lb in sorted(groups):
-                self._prefill_batch(groups[lb], lb)
+                groups.setdefault((g.tenant.name, g.len_bucket),
+                                  []).append(g)
+            for key in sorted(groups):
+                self._prefill_batch(groups[key], key[1])
             progressed = True
-        # -- one decode step per active request (chunked to the grid)
+        # -- decode step round (chunked to the grid, never mixing
+        #    tenants in one dispatch)
         with self._cond:
             active = list(self._gen_active)
         expired = [g for g in active
@@ -554,22 +871,103 @@ class Server:
                 f"{self.name}: generate deadline expired at token "
                 f"{len(g.generated)}/{g.max_new}"))
         active = [g for g in active if g not in expired]
-        cap = self.grid.max_batch
-        for i in range(0, len(active), cap):
-            self._decode_batch(active[i:i + cap])
+        if active:
+            self._decode_round(active)
+        if self._pool is not None:
+            self._maybe_defrag()
         return progressed or bool(active) or bool(expired)
+
+    def _decode_round(self, active: list) -> None:
+        """One decode step for active streams. Single-tenant: every
+        stream steps, chunked to the grid (the legacy path). Multiple
+        tenants resident: ``grid.max_batch`` decode slots per round are
+        assigned weighted-fair across tenants with live streams, each
+        tenant's picks step as its OWN batch (a dispatch runs one
+        tenant's executable), and stepped streams rotate to the back of
+        the active list so no stream starves within its tenant."""
+        by_tenant: dict = {}
+        for g in active:
+            by_tenant.setdefault(g.tenant.name, []).append(g)
+        if len(by_tenant) == 1:
+            cap = self.grid.max_batch
+            for i in range(0, len(active), cap):
+                self._decode_batch(active[i:i + cap])
+            return
+        tenants = [self._tenants[n] for n in by_tenant]
+        remaining = {t.name: len(by_tenant[t.name]) for t in tenants}
+        share = {t.name: 0 for t in tenants}
+        slots = min(self.grid.max_batch, len(active))
+        for _ in range(slots):
+            elig = [t for t in tenants if remaining[t.name] > 0]
+            if not elig:
+                break
+            t = self._wrr_pick(elig, field="dcredit")
+            share[t.name] += 1
+            remaining[t.name] -= 1
+        for t in tenants:
+            n = share[t.name]
+            if n == 0:
+                continue
+            streams = by_tenant[t.name]
+            self._decode_batch(streams[:n])
+            if n < len(streams):
+                # rotate the stepped streams behind the unstepped ones
+                with self._cond:
+                    for g in streams[:n]:
+                        try:
+                            self._gen_active.remove(g)
+                        except ValueError:
+                            continue    # finalized during the step
+                        self._gen_active.append(g)
+
+    def _maybe_defrag(self) -> None:
+        """Automatic defrag, checked between decode steps: when the
+        free holes below the pool's high-water mark exceed the
+        configured threshold, pack live pages down, replay the
+        permutation onto EVERY tenant's arenas, and refresh every
+        active stream's page snapshot (``defrag`` renumbers the pool in
+        place — a ``g.pages`` list taken at admission is stale the
+        moment the pool packs)."""
+        if self._defrag_min_pages is None:
+            return
+        n_live, span = self._pool.frag_info()
+        if n_live == 0 or span - n_live < self._defrag_min_pages:
+            return
+        engines = [t.engine for t in self._tenants.values()
+                   if t.engine is not None]
+        if not engines or not all(hasattr(e, "apply_defrag")
+                                  for e in engines):
+            return      # an engine cannot replay moves: never corrupt
+        moves = self._pool.defrag()
+        if not moves:
+            return
+        for e in engines:
+            e.apply_defrag(moves)
+        with self._cond:
+            for g in self._gen_active:
+                g.pages = self._pool.owned(g)
+        self.n_defrags += 1
+        if _telemetry_state.enabled:
+            telemetry.record_kvcache_defrag(len(moves))
+        if _tracing_state.enabled:
+            tracing.record_event("kvcache.defrag", replica=self.name,
+                                 moves=len(moves), live_pages=n_live)
 
     def _remove_pending(self, g) -> None:
         with self._cond:
-            try:
-                self._gen_pending.remove(g)
-            except ValueError:
-                pass
+            q = self._gen_pending.get(g.tenant.name)
+            if q is not None:
+                try:
+                    q.remove(g)
+                except ValueError:
+                    pass
 
     def _prefill_batch(self, group, len_bucket: int) -> None:
         """Prefill one len-bucket group: write the prompts' K/V into
         their pages and emit each request's FIRST token (the
         time-to-first-token dispatch)."""
+        tenant = group[0].tenant
+        engine = tenant.engine
         cap = self.grid.batch_bucket(len(group))
         w = self._gen_table_w
         tokens = np.zeros((cap, len_bucket), dtype=np.int32)
@@ -579,11 +977,13 @@ class Server:
             tokens[i, :g.prompt.size] = g.prompt
             lengths[i] = g.prompt.size
             table[i, :len(g.pages)] = g.pages
-            g.model_version = self._engine_version
+            g.model_version = tenant.engine_version
             if g.span is not None:          # gen.queue ends here
                 g.span.end(outcome="ok")
             g.span = (g.trace.begin("prefill", replica=self.name,
-                                    len_bucket=len_bucket)
+                                    len_bucket=len_bucket,
+                                    model=tenant.name,
+                                    slo_class=tenant.slo_class)
                       if g.trace is not None else None)
         sig = (cap, len_bucket)
 
@@ -594,7 +994,7 @@ class Server:
             if _fault_state.enabled:
                 fault.check("serving.dispatch",
                             f"{self.name} prefill={sig}")
-            return self._engine.prefill(tokens, lengths, table)
+            return engine.prefill(tokens, lengths, table)
 
         try:
             logits = fault.retry_call("serving.dispatch", run,
@@ -617,8 +1017,11 @@ class Server:
             self._emit_token(g, int(np.argmax(logits[i])), t_now)
 
     def _decode_batch(self, chunk) -> None:
-        """ONE decode step for up to max_batch active requests — the
-        (batch, 1) executable, whatever depth each request is at."""
+        """ONE decode step for up to max_batch active requests of ONE
+        tenant — the (batch, 1) executable, whatever depth each request
+        is at."""
+        tenant = chunk[0].tenant
+        engine = tenant.engine
         cap = self.grid.batch_bucket(len(chunk))
         w = self._gen_table_w
         tokens = np.zeros((cap,), dtype=np.int32)
@@ -630,7 +1033,8 @@ class Server:
             lengths[i] = g.length
             table[i, :len(g.pages)] = g.pages
             spans.append(g.trace.begin("decode.step", replica=self.name,
-                                       token=len(g.generated))
+                                       token=len(g.generated),
+                                       model=tenant.name)
                          if g.trace is not None else None)
         sig = (cap, 1)
 
@@ -640,7 +1044,7 @@ class Server:
                 hook(sig)
             if _fault_state.enabled:
                 fault.check("serving.dispatch", f"{self.name} decode={sig}")
-            return self._engine.decode_step(tokens, lengths, table)
+            return engine.decode_step(tokens, lengths, table)
 
         try:
             logits = fault.retry_call("serving.dispatch", run,
@@ -654,7 +1058,7 @@ class Server:
                 self._finalize_gen(g, error=e)
             return
         if _telemetry_state.enabled:
-            telemetry.record_decode_step(len(chunk))
+            telemetry.record_decode_step(len(chunk), model=tenant.name)
         t_now = time.perf_counter()
         for i, (g, sp) in enumerate(zip(chunk, spans)):
             if sp is not None:
@@ -665,8 +1069,9 @@ class Server:
         g.generated.append(token)
         g.length += 1
         self.n_tokens += 1
+        g.tenant.n_tokens += 1
         if _telemetry_state.enabled:
-            telemetry.record_token(t_now - g.t_last)
+            telemetry.record_token(t_now - g.t_last, model=g.tenant.name)
         g.t_last = t_now
         g.handle._push(token)
         if len(g.generated) >= g.max_new:
@@ -697,7 +1102,8 @@ class Server:
         self._count_request(
             outcome="ok" if error is None else "error",
             t_enqueue=g.t_submit,
-            trace_id=g.trace.trace_id if g.trace is not None else None)
+            trace_id=g.trace.trace_id if g.trace is not None else None,
+            tenant=g.tenant)
         if g.span is not None:
             g.span.end(outcome="ok" if error is None else "error")
             g.span = None
@@ -707,8 +1113,10 @@ class Server:
 
     def _fail_generates(self, exc: Exception) -> None:
         with self._cond:
-            doomed = self._gen_pending + self._gen_active
-            self._gen_pending = []
+            doomed = [g for q in self._gen_pending.values() for g in q]
+            doomed += self._gen_active
+            for q in self._gen_pending.values():
+                del q[:]
         for g in doomed:
             self._finalize_gen(g, error=exc)
 
@@ -738,7 +1146,9 @@ class Server:
             # fail everything queued
             with self._cond:
                 self._running = False
-                pending, self._queue = self._queue, []
+                pending = [r for q in self._queues.values() for r in q]
+                for q in self._queues.values():
+                    del q[:]
             for r in pending:
                 if r.future.set_running_or_notify_cancel():
                     r.future.set_exception(MXNetError(
@@ -752,12 +1162,21 @@ class Server:
         """Block until a batch should close; returns (requests, reason),
         ``([], "decode")`` when decode work should run NOW (continuous
         batching never parks the scheduler while generates are live),
-        or (None, None) on shutdown with nothing left to serve."""
+        or (None, None) on shutdown with nothing left to serve.
+
+        Multi-tenant: every non-empty tenant queue is evaluated with
+        the single-tenant close rules (full / drain / timeout /
+        deadline) against ITS OWN requests, so one tenant's burst never
+        advances or delays another tenant's close time; when several
+        tenants are closeable at once the pick is smooth weighted
+        round-robin, and a closed batch never mixes tenants."""
         with self._cond:
             while True:
                 self.hb.touch()
-                gen_work = bool(self._gen_pending or self._gen_active)
-                if not self._queue:
+                gen_work = (any(self._gen_pending.values())
+                            or bool(self._gen_active))
+                nonempty = [n for n in self._queues if self._queues[n]]
+                if not nonempty:
                     if not self._running:
                         if gen_work and self._drain:
                             return [], "decode"
@@ -766,53 +1185,80 @@ class Server:
                         return [], "decode"
                     self._cond.wait(0.1)
                     continue
-                head = self._queue[0]
-                key = head.shape_key
                 cap = self.grid.max_batch
-                matching = sum(1 for r in self._queue
-                               if r.shape_key == key)
                 now = time.perf_counter()
-                # close on the TIGHTEST deadline in the queue, not just
-                # the head's: a short-deadline request behind a lazy head
-                # (same key: it rides this batch; different key: it is
-                # served right after) must not wait out the head's SLO
-                deadline_at = min(r.deadline for r in self._queue) \
-                    - self.margin_s
-                # batch timeout: the head is the oldest enqueue (submit
-                # order is FIFO even when deadline_ms overrides are not)
-                # — cap its co-batching wait independently of the SLO
-                timeout_at = (head.t_enqueue + self.batch_timeout_s
-                              if self.batch_timeout_s is not None
-                              else None)
-                close_at = deadline_at if timeout_at is None \
-                    else min(deadline_at, timeout_at)
-                if matching >= cap:
+                full, closeable = [], []
+                min_close_at = None
+                for name in nonempty:
+                    q = self._queues[name]
+                    head = q[0]
+                    key = head.shape_key
+                    matching = sum(1 for r in q if r.shape_key == key)
+                    if matching >= cap:
+                        full.append(name)
+                        continue
+                    # close on the TIGHTEST deadline in this tenant's
+                    # queue, not just the head's: a short-deadline
+                    # request behind a lazy head (same key: it rides
+                    # this batch; different key: it is served right
+                    # after) must not wait out the head's SLO
+                    deadline_at = min(r.deadline for r in q) \
+                        - self.margin_s
+                    # batch timeout: the head is the oldest enqueue
+                    # (submit order is FIFO within a tenant) — cap its
+                    # co-batching wait independently of the SLO
+                    timeout_at = (head.t_enqueue + self.batch_timeout_s
+                                  if self.batch_timeout_s is not None
+                                  else None)
+                    close_at = deadline_at if timeout_at is None \
+                        else min(deadline_at, timeout_at)
+                    if now >= close_at:
+                        reason = ("timeout" if timeout_at is not None
+                                  and timeout_at <= close_at + 1e-9
+                                  and now < deadline_at else "deadline")
+                        closeable.append((name, reason))
+                    elif min_close_at is None or close_at < min_close_at:
+                        min_close_at = close_at
+                if full:
+                    picked = self._wrr_pick(
+                        [self._tenants[n] for n in full]).name
                     reason = "full"
                 elif not self._running:
+                    # drain: oldest head across tenants goes first
+                    picked = min(
+                        nonempty,
+                        key=lambda n: self._queues[n][0].t_enqueue)
                     reason = "drain"
-                elif now >= close_at:
-                    reason = ("timeout" if timeout_at is not None
-                              and timeout_at <= close_at + 1e-9
-                              and now < deadline_at else "deadline")
+                elif closeable:
+                    if len(closeable) == 1:
+                        picked, reason = closeable[0]
+                    else:
+                        picked = self._wrr_pick(
+                            [self._tenants[n] for n, _ in closeable]).name
+                        reason = dict(closeable)[picked]
                 else:
                     if gen_work:
                         # decode steps interleave with the batch fill:
                         # the classic batch keeps its SLO patience, the
                         # scheduler just doesn't SLEEP through it
                         return [], "decode"
-                    # fill otherwise: sleep until the head's close time
-                    # or the next submit, whichever is first
-                    self._cond.wait(min(close_at - now, 0.1))
+                    # fill otherwise: sleep until the earliest close
+                    # time or the next submit, whichever is first
+                    self._cond.wait(min(min_close_at - now, 0.1))
                     continue
+                q = self._queues[picked]
+                key = q[0].shape_key
                 taken, rest = [], []
-                for r in self._queue:
+                for r in q:
                     if len(taken) < cap and r.shape_key == key:
                         taken.append(r)
                     else:
                         rest.append(r)
-                self._queue = rest
+                self._queues[picked] = rest
                 if _telemetry_state.enabled:
-                    telemetry.set_serving_queue_depth(len(rest))
+                    telemetry.set_serving_queue_depth(
+                        sum(len(x) for x in self._queues.values()))
+                    telemetry.set_tenant_queue_depth(len(rest), picked)
                 return taken, reason
 
     def _dispatch(self, batch, reason: str) -> None:
@@ -829,11 +1275,12 @@ class Server:
             return
         n = len(batch)
         key = batch[0].shape_key
+        tenant = batch[0].tenant
         cap = self.grid.batch_bucket(n)
         payload = np.zeros((cap,) + key, dtype=self.dtype)
         for i, r in enumerate(batch):
             payload[i] = r.sample
-        model = self._model          # reload swaps the attribute, not us
+        model = tenant.block         # reload swaps the attribute, not us
         sig = (cap,) + key
 
         bsp = None
@@ -845,7 +1292,8 @@ class Server:
                 # the ONE dispatch span that serves them all)
                 bsp = tracing.begin_batch(
                     traced, wait_tags={"close_reason": reason},
-                    replica=self.name, sig=str(sig), reason=reason)
+                    replica=self.name, sig=str(sig), reason=reason,
+                    model=tenant.name)
 
         def run():
             hook = self._pre_dispatch
@@ -875,7 +1323,7 @@ class Server:
                 self._count_request(
                     outcome="error", t_enqueue=r.t_enqueue,
                     trace_id=r.trace.trace_id if r.trace is not None
-                    else None)
+                    else None, tenant=tenant)
                 if r.own_trace:
                     r.trace.finish(type(e).__name__)
             return
@@ -892,6 +1340,7 @@ class Server:
                 telemetry.record_serving_queue_time(t_start - r.t_enqueue)
         with self._model_lock:      # the reload warmup copies this set
             self._warm_sigs.add(sig)
+            tenant.warm_sigs.add(sig)
         from ..gluon.block import nested_unflatten_nd
 
         try:
@@ -903,7 +1352,7 @@ class Server:
                 self._count_request(
                     outcome="ok", t_enqueue=r.t_enqueue,
                     trace_id=r.trace.trace_id if r.trace is not None
-                    else None)
+                    else None, tenant=tenant)
                 if r.own_trace:
                     r.trace.finish("ok")
         except Exception as e:  # noqa: BLE001 - e.g. non-batch-major leaf
@@ -912,7 +1361,8 @@ class Server:
                 if not r.future.done():
                     r.future.set_exception(e)
                     self._count_request(outcome="error",
-                                        t_enqueue=r.t_enqueue)
+                                        t_enqueue=r.t_enqueue,
+                                        tenant=tenant)
                 if r.own_trace:
                     r.trace.finish(type(e).__name__)
 
@@ -926,13 +1376,17 @@ class Server:
         return [leaf.asnumpy() for leaf in flat], tree
 
     def _count_request(self, outcome: str, t_enqueue: Optional[float] = None,
-                       trace_id: Optional[str] = None) -> None:
+                       trace_id: Optional[str] = None,
+                       tenant: Optional[_Tenant] = None) -> None:
         self.n_requests += 1
+        if tenant is not None:
+            tenant.n_requests += 1
         if _telemetry_state.enabled:
             lat = (time.perf_counter() - t_enqueue
                    if t_enqueue is not None else 0.0)
-            telemetry.record_serving_request(lat, outcome,
-                                             trace_id=trace_id)
+            telemetry.record_serving_request(
+                lat, outcome, trace_id=trace_id,
+                model=tenant.name if tenant is not None else None)
 
     @staticmethod
     def _end_trace_rejected(req: _Request, status: str = "rejected") -> None:
@@ -979,22 +1433,26 @@ class Server:
             block.hybridize()
         return block.warmup(sorted(sigs), dtype=self.dtype, ctx=self.ctx)
 
-    def current_model(self):
-        """The block currently being served (the rolling-upgrade
-        machinery keeps it for rollback)."""
-        return self._model
+    def current_model(self, model: Optional[str] = None):
+        """The block currently being served for ``model`` (default
+        tenant when None; the rolling-upgrade machinery keeps it for
+        rollback)."""
+        return self._tenant(model).block
 
-    def swap_model(self, block, version: Optional[int] = None) -> None:
-        """Atomically replace the served model with ``block``, warming it
-        for every signature in live use first — requests dispatched
-        during the warmup keep hitting the old graph. ``version``
-        overrides the monotonic bump (a rollback restores the old
-        number)."""
+    def swap_model(self, block, version: Optional[int] = None,
+                   model: Optional[str] = None) -> None:
+        """Atomically replace ONE tenant's served model with ``block``,
+        warming it for every signature in live use first — requests
+        dispatched during the warmup keep hitting the old graph, and
+        other tenants' blocks/versions are untouched (the per-model
+        upgrade contract). ``version`` overrides the monotonic bump (a
+        rollback restores the old number)."""
+        t = self._tenant(model)
         self._warm_block(block, prime=True)
         with self._model_lock:
-            self._model = block
-            self.model_version = (self.model_version + 1
-                                  if version is None else int(version))
+            t.block = block
+            t.model_version = (t.model_version + 1
+                               if version is None else int(version))
         self.n_reloads += 1
 
     def reload(self, manager, model_factory, step: Optional[int] = None
@@ -1049,16 +1507,27 @@ class Server:
     def stats(self) -> dict:
         """Light always-on counters (telemetry has the full story)."""
         with self._cond:
-            depth = len(self._queue)
-            gen_pending = len(self._gen_pending)
+            depth = sum(len(q) for q in self._queues.values())
+            gen_pending = sum(len(q)
+                              for q in self._gen_pending.values())
             gen_active = len(self._gen_active)
+            models = {
+                n: {"slo_class": t.slo_class, "priority": t.priority,
+                    "weight": t.weight, "version": t.model_version,
+                    "requests": t.n_requests, "shed": t.n_shed,
+                    "preempted": t.n_preempted, "tokens": t.n_tokens,
+                    "queue_depth": len(self._queues[n]),
+                    "generates_pending": len(self._gen_pending[n])}
+                for n, t in self._tenants.items()}
         out = {"requests": self.n_requests, "batches": self.n_batches,
                "errors": self.n_errors, "reloads": self.n_reloads,
                "queue_depth": depth, "loaded_step": self.loaded_step,
                "model_version": self.model_version,
-               "running": self.is_running}
+               "running": self.is_running, "models": models,
+               "preemptions": self.n_preemptions}
         if self._decode_pages is not None:
             out.update(tokens=self.n_tokens, generates_pending=gen_pending,
                        generates_active=gen_active,
+                       defrags=self.n_defrags,
                        kvcache=self._pool.stats() if self._pool else None)
         return out

@@ -431,14 +431,17 @@ def _error_registry():
     # the full serving package, and router imports server — keep the
     # import graph shallow until an error actually crosses the wire
     from ..fault import FaultInjected
-    from .kvcache import CacheFull
+    from .kvcache import CacheFull, Preempted
     from .router import FailoverExhausted, ServerOverloaded
+    from .server import TenantThrottled
 
     return {
         "overloaded": ServerOverloaded,
         "failover_exhausted": FailoverExhausted,
         "fault_injected": FaultInjected,
+        "preempted": Preempted,
         "kvcache_full": CacheFull,
+        "throttled": TenantThrottled,
         "mxnet_error": MXNetError,
     }
 
@@ -448,7 +451,7 @@ def encode_error(exc: BaseException) -> Tuple[str, str]:
     registered type wins, anything unknown degrades to ``internal``."""
     reg = _error_registry()
     for name in ("overloaded", "failover_exhausted", "fault_injected",
-                 "kvcache_full"):
+                 "preempted", "kvcache_full", "throttled"):
         if isinstance(exc, reg[name]):
             return name, str(exc)
     if isinstance(exc, MXNetError):

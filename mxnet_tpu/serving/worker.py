@@ -310,16 +310,17 @@ def main(argv=None) -> int:
                     # router-side trace (absent/malformed = untraced)
                     tr = tracing.adopt(frame.get("trace"),
                                        worker=args.name)
+                # absent model/priority header fields = default tenant
+                # (old peers interoperate — the tracing-header contract)
+                kw = {"deadline_ms": frame.get("deadline_ms"),
+                      "model": frame.get("model"),
+                      "priority": frame.get("priority")}
                 try:
                     if tr is not None:
                         with tracing.active(tr, tr.remote_parent):
-                            fut = server.submit(
-                                frame["sample"],
-                                deadline_ms=frame.get("deadline_ms"))
+                            fut = server.submit(frame["sample"], **kw)
                     else:
-                        fut = server.submit(frame["sample"],
-                                            deadline_ms=frame.get(
-                                                "deadline_ms"))
+                        fut = server.submit(frame["sample"], **kw)
                 except Exception as e:  # noqa: BLE001 - sync refusal
                     etype, msg = wire.encode_error(e)
                     res = {"kind": "result", "id": req_id,
@@ -345,20 +346,20 @@ def main(argv=None) -> int:
                 if _tracing_state.enabled:
                     tr = tracing.adopt(frame.get("trace"),
                                        worker=args.name)
+                kw = {"deadline_ms": frame.get("deadline_ms"),
+                      "on_token": token_sender(req_id),
+                      "model": frame.get("model"),
+                      "priority": frame.get("priority")}
                 try:
                     if tr is not None:
                         with tracing.active(tr, tr.remote_parent):
                             handle = server.submit_generate(
                                 frame["prompt"],
-                                int(frame["max_new_tokens"]),
-                                deadline_ms=frame.get("deadline_ms"),
-                                on_token=token_sender(req_id))
+                                int(frame["max_new_tokens"]), **kw)
                     else:
                         handle = server.submit_generate(
                             frame["prompt"],
-                            int(frame["max_new_tokens"]),
-                            deadline_ms=frame.get("deadline_ms"),
-                            on_token=token_sender(req_id))
+                            int(frame["max_new_tokens"]), **kw)
                 except Exception as e:  # noqa: BLE001 - sync refusal
                     etype, msg = wire.encode_error(e)
                     res = {"kind": "gen_done", "id": req_id,
@@ -376,6 +377,36 @@ def main(argv=None) -> int:
                     continue
                 handle.future.add_done_callback(
                     lambda f, i=req_id, t=tr: on_gen_done(i, f, t))
+            elif kind == "register_model":
+                # tenant registration across the process boundary: the
+                # block arrives as a factory SPEC (mod:fn + kwargs),
+                # the same spec-not-closure contract as --factory
+                try:
+                    tfac = load_factory(frame["factory"],
+                                        frame.get("paths", ()))
+                    tblock = tfac(**frame.get("factory_kwargs", {}))
+                    server.register_model(
+                        frame["name"], tblock,
+                        slo_class=frame.get("slo_class", "standard"),
+                        priority=frame.get("priority", 0),
+                        weight=frame.get("weight", 1.0),
+                        slo_ms=frame.get("slo_ms"),
+                        rate_limit=frame.get("rate_limit"),
+                        burst=frame.get("burst"))
+                except Exception as e:  # noqa: BLE001 - typed reply
+                    etype, msg = wire.encode_error(e)
+                    res = {"kind": "registered", "id": frame.get("id"),
+                           "name": frame.get("name"), "ok": False,
+                           "etype": etype, "error": msg}
+                else:
+                    res = {"kind": "registered", "id": frame.get("id"),
+                           "name": frame["name"], "ok": True}
+                try:
+                    send(res)
+                except (OSError, wire.ConnectionClosed):
+                    tracing.maybe_dump("orphaned")
+                    server.stop(drain=False, timeout=10)
+                    return 0
             elif kind == "stop":
                 try:
                     server.stop(drain=bool(frame.get("drain", True)),

@@ -1146,19 +1146,28 @@ def record_images_decoded(n: int) -> None:
 
 
 def record_serving_request(seconds: float, outcome: str = "ok",
-                           trace_id: Optional[str] = None) -> None:
+                           trace_id: Optional[str] = None,
+                           model: Optional[str] = None) -> None:
     """One served request, end-to-end (submit -> future resolved).
     ``outcome``: ``ok``, ``error`` (dispatch failed after retries) or
     ``rejected`` (queue full / server stopped — no latency recorded).
     p50/p99 come from the histogram quantiles. ``trace_id`` (when the
     request was traced) becomes an OpenMetrics exemplar on the latency
     bucket it lands in — the jump from "p99 is slow" to THE trace that
-    explains it."""
+    explains it. ``model`` (multi-tenant serving) additionally counts
+    the request into the per-tenant family
+    ``mxnet_serving_tenant_requests_total{model,outcome}`` — the
+    unlabeled family stays the fleet total, so existing dashboards and
+    label sets are untouched."""
     if not _state.enabled:
         return
     counter("mxnet_serving_requests_total",
             "Serving requests by outcome (ok/error/rejected).",
             ("outcome",)).labels(outcome).inc()
+    if model is not None:
+        counter("mxnet_serving_tenant_requests_total",
+                "Serving requests per tenant model, by outcome.",
+                ("model", "outcome")).labels(model, outcome).inc()
     if outcome != "rejected":
         histogram("mxnet_serving_request_seconds",
                   "End-to-end request latency (submit to future "
@@ -1242,25 +1251,36 @@ def record_router_request(seconds: float, outcome: str = "ok",
                       if trace_id is not None else None))
 
 
-def record_serving_shed(reason: str) -> None:
-    """One request shed by the Router's admission control. ``reason``:
+def record_serving_shed(reason: str, model: Optional[str] = None) -> None:
+    """One request shed by admission control. ``reason``:
     ``queue_full`` (bounded queue at capacity), ``predicted_wait``
     (predicted queue wait exceeds the request's deadline), ``expired``
-    (deadline blew while queued — the in-queue safety net) or
+    (deadline blew while queued — the in-queue safety net),
     ``kvcache_full`` (a generate request that cannot fit the paged
-    KV-cache budget)."""
+    KV-cache budget) or ``throttled`` (a tenant's admission token
+    bucket is empty). ``model`` additionally counts into
+    ``mxnet_serving_tenant_shed_total{model,reason}`` — the isolation
+    witness: under one tenant's overload, shed increments stay
+    confined to that tenant's label."""
     if not _state.enabled:
         return
     counter("mxnet_serving_shed_total",
             "Requests shed by router admission control, by reason "
-            "(queue_full/predicted_wait/expired/kvcache_full).",
+            "(queue_full/predicted_wait/expired/kvcache_full/"
+            "throttled).",
             ("reason",)).labels(reason).inc()
+    if model is not None:
+        counter("mxnet_serving_tenant_shed_total",
+                "Requests shed per tenant model, by reason.",
+                ("model", "reason")).labels(model, reason).inc()
 
 
-def record_decode_step(n_requests: int) -> None:
+def record_decode_step(n_requests: int,
+                       model: Optional[str] = None) -> None:
     """One continuous-batching decode step: a single (batch, 1)
     executable advancing ``n_requests`` co-batched completions by one
-    token each."""
+    token each. ``model`` counts the step into the per-tenant family
+    ``mxnet_serving_tenant_decode_steps_total{model}``."""
     if not _state.enabled:
         return
     counter("mxnet_serving_decode_steps_total",
@@ -1269,11 +1289,17 @@ def record_decode_step(n_requests: int) -> None:
     histogram("mxnet_serving_decode_batch_width",
               "Active completions co-batched per decode step.",
               buckets=(1, 2, 4, 8, 16, 32, 64)).observe(n_requests)
+    if model is not None:
+        counter("mxnet_serving_tenant_decode_steps_total",
+                "Decode steps dispatched per tenant model.",
+                ("model",)).labels(model).inc()
 
 
-def record_token(seconds: float) -> None:
+def record_token(seconds: float, model: Optional[str] = None) -> None:
     """One emitted token's inter-token latency (prefill first token:
-    submit -> first token, i.e. TTFT)."""
+    submit -> first token, i.e. TTFT). ``model`` counts the token into
+    ``mxnet_serving_tenant_tokens_total{model}`` — per-tenant token
+    share is the weighted-fairness witness."""
     if not _state.enabled:
         return
     counter("mxnet_serving_tokens_total",
@@ -1283,6 +1309,50 @@ def record_token(seconds: float) -> None:
               "Per-token latency: time since the previous token of the "
               "same completion (first token: since submit — TTFT).",
               buckets=SERVING_BUCKETS).observe(seconds)
+    if model is not None:
+        counter("mxnet_serving_tenant_tokens_total",
+                "Tokens emitted per tenant model.",
+                ("model",)).labels(model).inc()
+
+
+def set_tenant_queue_depth(depth: int, model: str,
+                           router: str = "") -> None:
+    """Requests currently queued for ONE tenant model (replica level
+    when ``router`` is empty, router level otherwise). Scraped into
+    :class:`~.serving.controller.ScrapeFleetSignals` so the autoscaler
+    sees per-tenant backlog, not just the fleet total."""
+    if not _state.enabled:
+        return
+    gauge("mxnet_serving_tenant_queue_depth",
+          "Requests waiting per tenant model (replica queues when "
+          "router label is empty, router queue otherwise).",
+          ("model", "router")).labels(model, router).set(depth)
+
+
+def record_preemption(victim: str, beneficiary: str) -> None:
+    """One priority preemption: ``victim``'s stream had its KV-cache
+    pages reclaimed (between decode steps) for a higher-priority
+    ``beneficiary`` arrival. Both are tenant model names — the counter
+    answers "who preempted whom"."""
+    if not _state.enabled:
+        return
+    counter("mxnet_serving_preempted_total",
+            "Generate streams preempted, by victim and beneficiary "
+            "tenant model.",
+            ("victim", "beneficiary")).labels(victim, beneficiary).inc()
+
+
+def record_kvcache_defrag(n_moves: int) -> None:
+    """One automatic KV-cache defrag pass (pages packed between decode
+    steps when fragmentation crossed the server's threshold)."""
+    if not _state.enabled:
+        return
+    counter("mxnet_serving_kvcache_defrag_total",
+            "Automatic KV-cache defrag passes.").inc()
+    if n_moves > 0:
+        counter("mxnet_serving_kvcache_defrag_moves_total",
+                "Pages moved by automatic KV-cache defrag passes."
+                ).inc(n_moves)
 
 
 def set_kvcache_pages(free: int, used: int, reserved: int = 0) -> None:

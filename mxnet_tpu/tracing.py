@@ -57,7 +57,7 @@ __all__ = [
     "Trace", "Span", "new_trace", "adopt",
     "active", "ambient", "note",
     "begin_batch", "end_batch",
-    "record_event", "recorder", "FlightRecorder",
+    "record_event", "events", "recorder", "FlightRecorder",
     "dump", "dump_jsonl", "maybe_dump", "dump_path",
     "chrome_trace_events", "set_process_name", "now_us",
 ]
@@ -502,6 +502,17 @@ def record_event(kind: str, **fields) -> None:
     if not _state.enabled:
         return
     _recorder.record_event(kind, **fields)
+
+
+def events(kind: Optional[str] = None) -> List[dict]:
+    """The flight recorder's event ring (optionally filtered to one
+    ``kind``) — the in-process read side of :func:`record_event`, e.g.
+    ``tracing.events("preempted")`` to find who preempted whom without
+    round-tripping a JSONL dump."""
+    evs = _recorder.events()
+    if kind is None:
+        return evs
+    return [e for e in evs if e.get("event") == kind]
 
 
 def dump_jsonl() -> str:

@@ -127,10 +127,21 @@ def serving_leak_guard():
     if mod is not None:
         leaked = mod.live_servers()
         if leaked:
+            # name the leaked server's TENANT REGISTRY too: a
+            # multi-tenant server pins every registered block (and its
+            # decode engine/arenas), not just the constructor model —
+            # "which tenants' state survived" is the first question
+            # when a later test's memory or executables look haunted
+            def _tenants(s):
+                try:
+                    return ",".join(sorted(s.models()))
+                except Exception:  # noqa: BLE001 - diagnostics only
+                    return "?"
             problems.append(
                 f"test left serving Server(s) running: "
-                f"{[s.name for s in leaked]}; call stop() in teardown "
-                "or use the Server context manager")
+                f"{[f'{s.name}[{_tenants(s)}]' for s in leaked]}; "
+                "call stop() in teardown or use the Server context "
+                "manager")
             for s in leaked:
                 s.stop(drain=False)
     wmod = sys.modules.get("mxnet_tpu.serving.remote")

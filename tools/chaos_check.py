@@ -1332,6 +1332,140 @@ def generate_gate(summary):
         router.stop(drain=False, timeout=60)
 
 
+def multitenant_gate(summary):
+    """Gate 10: priority preemption at the decode-step boundary.
+
+    Two tenants share ONE replica (one KV-cache pool, one executable
+    table): the default tenant (priority 0) saturates the pool with
+    long generates, then premium (priority 10) arrivals land. Gates:
+    every premium arrival is ADMITTED (never shed by the squatters)
+    and completes bit-identical to a single-tenant oracle; preemption
+    victims resolve typed :class:`Preempted` with a sealed clean-prefix
+    stream (never a torn token); zero lost futures across both
+    tenants; and the flight recorder holds the preemption event naming
+    victim and beneficiary."""
+    import time as _time
+
+    import numpy as np
+
+    from mxnet_tpu import serving, tracing
+    from mxnet_tpu.base import MXNetError
+    from mxnet_tpu.serving import Preempted
+
+    prompt = np.array([3, 1, 4, 1, 5], np.int32)
+    low_new, prem_new = 40, 8
+    net_low, net_prem = _decode_net(seed=7), _decode_net(seed=11)
+    oracle_low = _decode_oracle(net_low, prompt, low_new)
+    oracle_prem = _decode_oracle(net_prem, prompt, prem_new)
+
+    # pool geometry: 39 usable pages; 3 low streams x 12 pages = 36,
+    # a premium arrival needs 4 — only preemption can admit it
+    srv = serving.Server(
+        net_low, batch_buckets=(1, 2), shape_buckets=[(8,)],
+        slo_ms=60000.0, dtype="int32", warmup=False, decode_pages=40,
+        page_size=4, len_buckets=(8, 16, 32, 64), name="mt0",
+        priority=0, weight=1.0)
+    srv.register_model("premium", net_prem, slo_class="premium",
+                       priority=10, weight=3.0)
+    tracing.reset()
+    tracing.enable()
+    srv.start()
+    checks = {}
+    try:
+        # warm both tenants' decode paths so arrivals land in
+        # steady-state decode, not in a compile
+        srv.submit_generate(prompt, 2).result(timeout=300)
+        srv.submit_generate(prompt, 2, model="premium").result(
+            timeout=300)
+
+        low = [srv.submit_generate(prompt, low_new) for _ in range(3)]
+        # wait until all three squatters hold pages (free < a premium
+        # arrival's need) — a fixed sleep races 40-token completions
+        deadline = _time.monotonic() + 60
+        while _time.monotonic() < deadline:
+            st = srv.stats()
+            if st.get("generates_active", 0) >= 3:
+                break
+            _time.sleep(0.005)
+        else:
+            raise RuntimeError("low-tier streams never saturated pool")
+
+        prem, shed = [], 0
+        for _ in range(4):
+            try:
+                prem.append(srv.submit_generate(prompt, prem_new,
+                                                model="premium"))
+            except MXNetError:
+                shed += 1
+            _time.sleep(0.05)
+
+        n_prem_ok = n_prem_bad = 0
+        for h in prem:
+            try:
+                out = h.result(timeout=300)
+            except MXNetError:
+                n_prem_bad += 1
+                continue
+            if list(out) == oracle_prem:
+                n_prem_ok += 1
+            else:
+                n_prem_bad += 1
+
+        n_done = n_preempted = n_torn = unsealed = n_lost = 0
+        for h in low:
+            try:
+                out = h.result(timeout=300)
+                n_done += 1
+                if list(out) != oracle_low:
+                    n_torn += 1
+            except Preempted:
+                n_preempted += 1
+                got = h.tokens()
+                if got != oracle_low[:len(got)]:
+                    n_torn += 1     # a torn token, not a clean prefix
+                if h.next_token(len(got), timeout=2) is not None:
+                    unsealed += 1
+            except Exception:   # noqa: BLE001 - untyped = lost
+                n_lost += 1
+        undone = sum(1 for h in low + prem if not h.future.done())
+
+        evs = tracing.events("preempted")
+        ev_named = all(
+            e.get("victim_model") == "default"
+            and e.get("beneficiary_model") == "premium"
+            and e.get("victim") is not None
+            and e.get("beneficiary") is not None for e in evs)
+
+        checks["premium_all_admitted"] = shed == 0 and len(prem) == 4
+        checks["premium_bit_identical"] = (n_prem_ok == len(prem)
+                                           and n_prem_bad == 0)
+        checks["victims_typed_preempted"] = n_preempted >= 1
+        checks["victim_streams_clean_prefix"] = n_torn == 0
+        checks["victim_streams_sealed"] = unsealed == 0
+        checks["zero_lost_futures"] = n_lost == 0 and undone == 0
+        checks["flight_recorder_names_both"] = (len(evs) >= 1
+                                                and ev_named)
+        checks["stats_count_preemptions"] = (
+            srv.stats()["preemptions"] == n_preempted
+            and srv.stats()["models"]["default"]["preempted"]
+            == n_preempted)
+        ok = all(checks.values())
+        summary["gates"]["multitenant_priority_preemption"] = {
+            "pass": ok, "checks": checks, "premium": len(prem),
+            "preempted": n_preempted, "completed_low": n_done,
+            "preempt_events": len(evs)}
+        print(f"[chaos] multitenant: {len(prem)} premium admitted "
+              f"({shed} shed), {n_preempted} victims preempted, "
+              f"{n_done} low completed, {len(evs)} recorder events")
+        for name, v in checks.items():
+            print(f"[chaos]   multitenant {name}: {v}")
+        return ok
+    finally:
+        srv.stop(drain=False, timeout=60)
+        tracing.disable()
+        tracing.reset()
+
+
 def main():
     ap = argparse.ArgumentParser(description=__doc__.split("\n")[0])
     ap.add_argument("--steps", type=int, default=24)
@@ -1356,6 +1490,10 @@ def main():
                     help="skip the out-of-process worker gate (SIGKILL "
                     "a replica worker process under ingress traffic + "
                     "scrape-fed fleet scaling)")
+    ap.add_argument("--skip-multitenant", action="store_true",
+                    help="skip the multi-tenant gate (priority "
+                         "preemption at the decode-step boundary, "
+                         "two tenants on one replica)")
     ap.add_argument("--skip-generate", action="store_true",
                     help="skip the generate gate (SIGKILL a replica "
                     "mid-completion; typed resolution of streaming "
@@ -1454,6 +1592,11 @@ def main():
     #    the streaming handles, survivor keeps completing ---------------
     if not args.skip_generate:
         ok = generate_gate(summary) and ok
+
+    # -- gate 10: two tenants on one fleet — weighted admission and
+    #    priority preemption between decode steps --------------------
+    if not args.skip_multitenant:
+        ok = multitenant_gate(summary) and ok
 
     retry_counters = {}
     for s in telemetry.snapshot()["metrics"].get(

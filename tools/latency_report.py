@@ -30,6 +30,16 @@ needed.
 Stage spans may overlap (``router.attempt`` contains the replica-side
 spans), so shares are reported against the root request span, not
 summed to 100%.
+
+Multi-tenant dumps additionally get a **per-tenant rollup** — spans
+are tagged ``model`` + ``slo_class`` at every seam, so the report
+groups traces by tenant and prints one table per model (request
+p50/p99, TTFT and per-token percentiles for generate traces, shed
+counts by reason) plus a preemption rollup pairing beneficiary with
+victim ("who preempted whom", with the victim's clean-prefix length).
+That answers the multi-tenant question the aggregate table cannot:
+WHOSE p99 is slow, and at whose expense. Traces with no ``model`` tag
+are the default tenant — absent field = default, same as the wire.
 """
 from __future__ import annotations
 
@@ -145,6 +155,93 @@ def decode_rollup(traces) -> Dict:
     }
 
 
+def _trace_tenant(t) -> tuple:
+    """(model, slo_class) for one trace. Tenant tags ride several
+    spans (server root, ``batch.wait``, ``router.generate``); the
+    first occurrence wins. No tag anywhere = the default tenant,
+    mirroring the wire contract (absent field = default)."""
+    model = slo = None
+    for s in t.get("spans", []):
+        tags = s.get("tags")
+        if not isinstance(tags, dict):
+            continue
+        if model is None and isinstance(tags.get("model"), str):
+            model = tags["model"]
+        if slo is None and isinstance(tags.get("slo_class"), str):
+            slo = tags["slo_class"]
+        if model is not None and slo is not None:
+            break
+    return model or "default", slo or "standard"
+
+
+def tenant_rollup(traces, events) -> List[Dict]:
+    """One row per tenant: request p50/p99 off the root span, decode
+    percentiles for generate traces, shed counts by reason from the
+    recorder's ``shed`` events."""
+    groups: Dict[str, Dict] = {}
+    for t in traces:
+        model, slo = _trace_tenant(t)
+        g = groups.setdefault(model, {"slo_class": slo, "traces": []})
+        g["traces"].append(t)
+    sheds: Dict[str, Dict[str, int]] = {}
+    for e in events:
+        if e.get("event") != "shed":
+            continue
+        m = str(e.get("model", "default"))
+        reason = str(e.get("reason", "?"))
+        sheds.setdefault(m, {})[reason] = \
+            sheds.get(m, {}).get(reason, 0) + 1
+    rows = []
+    for model in sorted(set(groups) | set(sheds)):
+        g = groups.get(model, {"slo_class": "standard", "traces": []})
+        ts = g["traces"]
+        stages = stage_latencies(ts)
+        roots = stages.get("request", []) + stages.get("generate", [])
+        statuses: Dict[str, int] = {}
+        for t in ts:
+            st = t.get("status", "open")
+            statuses[st] = statuses.get(st, 0) + 1
+        row = {
+            "model": model, "slo_class": g["slo_class"],
+            "traces": len(ts), "statuses": statuses,
+            "request_p50_ms": round(_pctl(roots, 0.50), 3),
+            "request_p99_ms": round(_pctl(roots, 0.99), 3),
+            "sheds": sheds.get(model, {}),
+        }
+        dec = decode_rollup(ts)
+        if dec:
+            row["decode"] = dec
+        rows.append(row)
+    return rows
+
+
+def preemption_rollup(events) -> Dict:
+    """Pair beneficiary with victim across the recorder's
+    ``preempted`` events: who preempted whom, how often, and how long
+    the victims' sealed clean prefixes were when the pages were
+    taken."""
+    pre = [e for e in events if e.get("event") == "preempted"]
+    if not pre:
+        return {}
+    pairs: Dict[str, Dict] = {}
+    for e in pre:
+        key = (f"{e.get('beneficiary_model', '?')} preempted "
+               f"{e.get('victim_model', '?')}")
+        p = pairs.setdefault(key, {"count": 0, "victim_tokens": []})
+        p["count"] += 1
+        vt = e.get("victim_tokens")
+        if isinstance(vt, (int, float)):
+            p["victim_tokens"].append(float(vt))
+    out = {"events": len(pre), "pairs": {}}
+    for key, p in sorted(pairs.items()):
+        out["pairs"][key] = {
+            "count": p["count"],
+            "victim_clean_prefix_p50_tokens":
+                round(_pctl(p["victim_tokens"], 0.50), 1),
+        }
+    return out
+
+
 def report(traces, events) -> Dict:
     stages = stage_latencies(traces)
     roots = stages.get("request", [])
@@ -193,6 +290,16 @@ def report(traces, events) -> Dict:
     dec = decode_rollup(traces)
     if dec:
         rep["decode"] = dec
+    tenants = tenant_rollup(traces, events)
+    # the per-tenant table earns its ink only when there IS more than
+    # one tenant (or sheds/preemptions name one): a single-tenant dump
+    # reads the same as the aggregate table above
+    if (len(tenants) > 1 or any(t["sheds"] for t in tenants)
+            or any(t["model"] != "default" for t in tenants)):
+        rep["tenants"] = tenants
+    pre = preemption_rollup(events)
+    if pre:
+        rep["preemptions"] = pre
     return rep
 
 
@@ -224,6 +331,34 @@ def _print_table(rep: Dict) -> None:
               f"p99 {dec['ttft_p99_ms']:.3f} ms")
         print(f"  per-token   p50 {dec['per_token_p50_ms']:.3f} ms   "
               f"p99 {dec['per_token_p99_ms']:.3f} ms")
+    tenants = rep.get("tenants")
+    if tenants:
+        print()
+        print("per-tenant rollup (whose p99):")
+        hdr = (f"  {'model':<12}{'slo class':<10}{'n':>6}"
+               f"{'p50 ms':>10}{'p99 ms':>10}  sheds")
+        print(hdr)
+        print("  " + "-" * (len(hdr) - 2))
+        for row in tenants:
+            shed = ", ".join(f"{k}={v}"
+                             for k, v in sorted(row["sheds"].items()))
+            print(f"  {row['model']:<12}{row['slo_class']:<10}"
+                  f"{row['traces']:>6}{row['request_p50_ms']:>10.3f}"
+                  f"{row['request_p99_ms']:>10.3f}  {shed or '-'}")
+            dec = row.get("decode")
+            if dec:
+                print(f"  {'':<12}TTFT p50 {dec['ttft_p50_ms']:.3f} / "
+                      f"p99 {dec['ttft_p99_ms']:.3f} ms; per-token "
+                      f"p50 {dec['per_token_p50_ms']:.3f} / "
+                      f"p99 {dec['per_token_p99_ms']:.3f} ms")
+    pre = rep.get("preemptions")
+    if pre:
+        print()
+        print(f"preemptions ({pre['events']} event(s), "
+              "who preempted whom):")
+        for key, p in pre["pairs"].items():
+            print(f"  {key}: {p['count']}x, victim clean prefix p50 "
+                  f"{p['victim_clean_prefix_p50_tokens']:g} tokens")
 
 
 def main(argv=None) -> int:
