@@ -58,6 +58,10 @@ _PARAMS_FILE = "params.params"
 _STATES_FILE = "trainer.states"
 _RNG_FILE = "rng.pkl"
 _META_FILE = "meta.json"
+# partition-plan manifest of a ZeRO-sharded trainer.states: names the
+# mode / world size / rank / bucket layout so rejoin tooling can decide
+# which rank bundles to gather BEFORE unpickling any tensor payload
+_ZERO_FILE = "zero.json"
 
 
 def _fsync_dir(path: str) -> None:
@@ -365,6 +369,13 @@ class CheckpointManager:
             if trainer is not None:
                 trainer.save_states(os.path.join(tmp, _STATES_FILE))
                 written.append(_STATES_FILE)
+                zman = trainer.partition_manifest() \
+                    if hasattr(trainer, "partition_manifest") else None
+                if zman is not None:
+                    atomic_write(
+                        os.path.join(tmp, _ZERO_FILE),
+                        json.dumps(zman, indent=1).encode("utf-8"))
+                    written.append(_ZERO_FILE)
             from . import random_state
 
             atomic_write(os.path.join(tmp, _RNG_FILE),
@@ -471,7 +482,26 @@ class CheckpointManager:
                             os.path.join(root, _RNG_FILE))
             with open(os.path.join(root, _RNG_FILE), "rb") as f:
                 out["rng"] = pickle.loads(f.read())
+        out["zero"] = self.partition_manifest(step)
         return out
+
+    def partition_manifest(self, step: int) -> Optional[Dict]:
+        """The bundle's ZeRO partition-plan manifest (``zero.json``), or
+        None for a replicated (unpartitioned) bundle. Step must name an
+        existing bundle; no checksum pass is run here — callers on the
+        restore path already validated."""
+        p = os.path.join(self.path(step), _ZERO_FILE)
+        try:
+            with open(p, "rb") as f:
+                return json.loads(f.read().decode("utf-8"))
+        except (OSError, ValueError, UnicodeDecodeError):
+            return None
+
+    def states_path(self, step: int) -> str:
+        """Path of the bundle's ``trainer.states`` payload (the per-rank
+        sharded state file under ZeRO) — the unit
+        ``Trainer.load_states_resharded`` gathers across rank bundles."""
+        return os.path.join(self.path(step), _STATES_FILE)
 
     def restore(self, block=None, trainer=None, restore_rng: bool = True,
                 step: Optional[int] = None) -> Dict:

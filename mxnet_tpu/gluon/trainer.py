@@ -15,6 +15,7 @@ from __future__ import annotations
 
 import logging
 import os
+import warnings
 from typing import Dict, List, Optional
 
 from .. import optimizer as opt
@@ -39,7 +40,8 @@ class Trainer:
     def __init__(self, params, optimizer, optimizer_params=None,
                  kvstore="device", compression_params=None,
                  update_on_kvstore=None, check_nonfinite=None,
-                 overlap_comms=None):
+                 overlap_comms=None, partition=None,
+                 partition_rank=None, partition_world=None):
         if isinstance(params, (dict, ParameterDict)):
             params = list(params.values())
         if not isinstance(params, (list, tuple)):
@@ -75,6 +77,16 @@ class Trainer:
         self._overlap_comms = bool(overlap_comms)
         self._overlap = None
         self.last_overlap_stats = None
+        # ZeRO state partitioning (optimizer/zero.py): carve the fused
+        # optimizer sweep's flat buckets into per-rank shards —
+        # reduce-scatter + shard update + allgather, bit-identical to
+        # the replicated path
+        if partition is None:
+            partition = os.environ.get("MXNET_ZERO_PARTITION") or None
+        self._partition = partition
+        self._partition_rank = partition_rank
+        self._partition_world = partition_world
+        self._zero = None
 
     def _init_optimizer(self, optimizer, optimizer_params):
         param_dict = {i: p for i, p in enumerate(self._params)}
@@ -137,8 +149,66 @@ class Trainer:
         self._updaters = [opt.get_updater(self._optimizer)
                           for _ in self._contexts]
         self._kv_initialized = True
+        if self._partition is not None:
+            self._init_partition()
         if self._overlap_comms:
-            self._setup_overlap()
+            if self._zero is not None:
+                # the grad-ready hooks dispatch full-bucket pushpulls;
+                # ZeRO members must NOT be pre-reduced (the engine owns
+                # their reduce-scatter), so the two modes are exclusive
+                warnings.warn(
+                    "overlap_comms is disabled under partition="
+                    f"{self._partition!r}: the ZeRO engine owns the "
+                    "gradient collective for sharded params",
+                    stacklevel=2)
+            else:
+                self._setup_overlap()
+
+    def _init_partition(self):
+        from ..optimizer import zero as _zero
+
+        if self._update_on_kvstore:
+            raise MXNetError(
+                f"partition={self._partition!r} requires a worker-side "
+                "optimizer (update_on_kvstore=False) — the sharded "
+                "sweep runs on the workers' device mesh")
+        if _zero.supported_family(self._optimizer) is None:
+            n = sum(1 for p in self._params if p.grad_req != "null")
+            telemetry.record_kv_bucket_fallback(_zero.FALLBACK_FAMILY, n)
+            warnings.warn(
+                f"partition={self._partition!r} ignored: optimizer "
+                f"{type(self._optimizer).__name__} is outside the "
+                "sharded sweep families (sgd/adam/adamw) — training "
+                "continues replicated", stacklevel=2)
+            return
+        self._zero = _zero.ZeroEngine(
+            self, self._partition, rank=self._partition_rank,
+            world=self._partition_world)
+        self._zero.ensure_ready()
+
+    @property
+    def partition(self) -> Optional[str]:
+        """The active ZeRO partition mode ('zero1'/'zero2'), or None."""
+        return self._zero.mode if self._zero is not None else None
+
+    def partition_manifest(self) -> Optional[dict]:
+        """Plan metadata (mode/world/rank/bucket layout, no tensors)
+        for checkpoint manifests; None when unpartitioned."""
+        if not self._kv_initialized:
+            self._init_kvstore()
+        if self._zero is None:
+            return None
+        return self._zero.partition_manifest()
+
+    def zero_reconfigure(self, rank, world):
+        """Adopt a new (rank, world) partition identity — the elastic
+        rejoin hook; see :meth:`ZeroEngine.reconfigure`."""
+        if not self._kv_initialized:
+            self._init_kvstore()
+        if self._zero is None:
+            raise MXNetError(
+                "zero_reconfigure requires an active partition= mode")
+        self._zero.reconfigure(rank, world)
 
     # -- backward-overlapped comms -------------------------------------
     def _setup_overlap(self):
@@ -194,9 +264,14 @@ class Trainer:
         ag.watch_grad_ready(arrays, self._on_grad_ready)
 
     def _grad_exchange_args(self):
+        # ZeRO members are excluded: the engine reduces them itself
+        # (psum_scatter inside the sharded sweep) — a kvstore pushpull
+        # first would double-reduce
+        zero_keys = set(self._zero.eligible_indices()) \
+            if self._zero is not None else ()
         keys, grads, priorities = [], [], []
         for i, p in enumerate(self._params):
-            if p.grad_req == "null":
+            if p.grad_req == "null" or i in zero_keys:
                 continue
             keys.append(i)
             grads.append(p.list_grad())
@@ -403,14 +478,37 @@ class Trainer:
                     continue
                 self._kvstore.pull(i, p.list_data(), priority=-i)
             return
+        if self._zero is not None:
+            # sharded sweep for the partitioned members; leftovers
+            # (sparse / multi-precision) keep the per-param path — their
+            # gradients DID go through the kvstore exchange above
+            self._zero.step()
+            zero_keys = set(self._zero.eligible_indices())
+            for i, p in enumerate(self._params):
+                if p.grad_req == "null" or i in zero_keys:
+                    continue
+                for ci, (upd, arr, grad) in enumerate(
+                        zip(self._updaters, p.list_data(), p.list_grad())):
+                    self._optimizer._set_current_context(ci)
+                    telemetry.record_optimizer_dispatch("per_param")
+                    upd(i, grad, arr)
+            self._optimizer._set_current_context(0)
+            return
         if self._fused_update():
             return
+        # each context updates on its OWN count stream: a param updated
+        # on N devices advances t once per step per device, so the
+        # replicas (post-allreduce grads are identical) stay identical
+        # under t-dependent updates (Adam bias correction)
         for i, p in enumerate(self._params):
             if p.grad_req == "null":
                 continue
-            for upd, arr, grad in zip(self._updaters, p.list_data(), p.list_grad()):
+            for ci, (upd, arr, grad) in enumerate(
+                    zip(self._updaters, p.list_data(), p.list_grad())):
+                self._optimizer._set_current_context(ci)
                 telemetry.record_optimizer_dispatch("per_param")
                 upd(i, grad, arr)
+        self._optimizer._set_current_context(0)
 
     def _fused_update(self) -> bool:
         """The horizontally-fused optimizer phase: pack every dense
@@ -453,14 +551,19 @@ class Trainer:
                  for upd, items in zip(self._updaters, per_ctx_items)]
         if any(p is None for p in plans):
             return False    # unfusable state layout: per-param loop
-        for plan, items in zip(plans, per_ctx_items):
+        # per-context count streams (see _update): each context's sweep
+        # advances its own clock so every replica sees the same t
+        for ci, (plan, items) in enumerate(zip(plans, per_ctx_items)):
+            self._optimizer._set_current_context(ci)
             mt.apply_eager_plan(self._optimizer, plan, items)
         for i in sparse:
             p = self._params[i]
-            for upd, arr, grad in zip(self._updaters, p.list_data(),
-                                      p.list_grad()):
+            for ci, (upd, arr, grad) in enumerate(
+                    zip(self._updaters, p.list_data(), p.list_grad())):
+                self._optimizer._set_current_context(ci)
                 telemetry.record_optimizer_dispatch("per_param")
                 upd(i, grad, arr)
+        self._optimizer._set_current_context(0)
         return True
 
     # ------------------------------------------------------------------
@@ -482,12 +585,19 @@ class Trainer:
         blob = self._updaters[0].get_states(dump_optimizer=False)
         comp = getattr(self._kvstore, "_compression", None) \
             if self._kvstore is not None else None
-        if comp is not None:
+        if comp is not None or self._zero is not None:
             import pickle
 
-            blob = pickle.dumps({self._STATES_ENVELOPE: 1,
-                                 "updater": blob,
-                                 "compression": comp.get_state()})
+            env = {self._STATES_ENVELOPE: 1, "updater": blob}
+            if comp is not None:
+                env["compression"] = comp.get_state()
+            if self._zero is not None:
+                # the sharded payload names its partition plan + world
+                # size; load_states refuses a mismatched plan with a
+                # typed PartitionMismatchError instead of restoring
+                # garbage
+                env["zero"] = self._zero.export_state()
+            blob = pickle.dumps(env)
         atomic_write(fname, blob)
 
     def load_states(self, fname):
@@ -502,6 +612,7 @@ class Trainer:
 
         def _apply(blob):
             comp_state = None
+            zero_blob = None
             try:
                 import pickle
 
@@ -510,7 +621,30 @@ class Trainer:
                 obj = None
             if isinstance(obj, dict) and obj.get(self._STATES_ENVELOPE):
                 comp_state = obj.get("compression")
+                zero_blob = obj.get("zero")
                 blob = obj["updater"]
+            from ..optimizer.zero import PartitionMismatchError
+
+            if self._zero is not None:
+                if zero_blob is None:
+                    raise PartitionMismatchError(
+                        f"{fname!r} holds replicated (unpartitioned) "
+                        f"trainer state but this trainer runs partition "
+                        f"plan [{self._zero.describe()}] — save under "
+                        "the same partition mode or load into an "
+                        "unpartitioned trainer")
+                self._zero.check_compatible(zero_blob)
+            elif zero_blob is not None:
+                from ..optimizer.zero import _plan_digest
+
+                src = _plan_digest(zero_blob.get("plan", []),
+                                   zero_blob.get("mode"),
+                                   zero_blob.get("world"))
+                raise PartitionMismatchError(
+                    f"{fname!r} holds sharded optimizer state (plan "
+                    f"[{src}]) but this trainer is unpartitioned — "
+                    "construct the Trainer with the matching "
+                    "partition= mode to restore it")
             comp = getattr(self._kvstore, "_compression", None) \
                 if self._kvstore is not None else None
             if comp_state is not None:
@@ -534,8 +668,72 @@ class Trainer:
                     # re-pointing, or the Adam bias-correction clock the
                     # v2 state format preserves would be silently lost
                     self._optimizer.num_update = upd.optimizer.num_update
-                    self._optimizer._index_update_count = dict(
+                    self._optimizer._restore_update_counts(
                         upd.optimizer._index_update_count)
                 upd.optimizer = self._optimizer
+            if self._zero is not None:
+                self._zero.import_state([zero_blob])
 
         apply_state_bytes(states, _apply, fname, "Trainer.load_states")
+
+    def load_states_resharded(self, fnames):
+        """Gather per-rank sharded state files — possibly saved at a
+        DIFFERENT world size or bucket layout — and re-shard them into
+        this trainer's partition plan (the elastic N→M rejoin path).
+
+        ``fnames`` must cover every rank of the source world (each file
+        an envelope from a partitioned :meth:`save_states`); a missing
+        rank raises a typed
+        :class:`~mxnet_tpu.optimizer.zero.PartitionMismatchError`.
+        Updater (leftover-param) and compression state are taken from
+        the first file — under the synchronous contract every rank
+        holds the same replicated copy of those.
+        """
+        if not self._kv_initialized:
+            self._init_kvstore()
+        if self._zero is None:
+            raise MXNetError(
+                "load_states_resharded requires an active partition= "
+                "mode; use load_states for replicated trainer state")
+        from ..checkpoint import apply_state_bytes, read_state_bytes
+        from ..optimizer.zero import PartitionMismatchError
+
+        fnames = list(fnames)
+        if not fnames:
+            raise MXNetError("load_states_resharded: no state files")
+        payloads = []
+        head_updater = None
+        head_comp = None
+        for fname in fnames:
+            states = read_state_bytes(fname,
+                                      "Trainer.load_states_resharded")
+
+            def _parse(blob, _fname=fname):
+                import pickle
+
+                obj = pickle.loads(blob)
+                if not (isinstance(obj, dict)
+                        and obj.get(self._STATES_ENVELOPE)
+                        and obj.get("zero") is not None):
+                    raise PartitionMismatchError(
+                        f"{_fname!r} does not hold sharded trainer "
+                        "state (no partition envelope) — it cannot "
+                        "join a re-shard")
+                return obj
+
+            box = []
+            apply_state_bytes(states, lambda b: box.append(_parse(b)),
+                              fname, "Trainer.load_states_resharded")
+            obj = box[0]
+            payloads.append(obj["zero"])
+            if head_updater is None:
+                head_updater = obj["updater"]
+                head_comp = obj.get("compression")
+        comp = getattr(self._kvstore, "_compression", None) \
+            if self._kvstore is not None else None
+        if comp is not None:
+            comp.set_state(head_comp or {})
+        for upd in self._updaters:
+            upd.set_states(head_updater)
+            upd.optimizer = self._optimizer
+        self._zero.import_state(payloads)

@@ -24,7 +24,8 @@ leave through a bounded exit barrier; and
 epoch-versioned membership with bit-exact checkpoint hand-off, so a
 SIGKILLed worker rejoins and the loss stays bit-identical.
 """
-from .bucketing import Bucket, bucket_cap_bytes, plan_buckets  # noqa: F401
+from .bucketing import (Bucket, PARTITION_MODES, ShardPlan,  # noqa: F401
+                        bucket_cap_bytes, plan_buckets, shard_layout)
 from .kvstore import (BarrierTimeoutError, KVStore,  # noqa: F401
                       KVStoreDistAsyncEmu, KVStoreLocal,
                       KVStoreTPUSync, create, reset_barrier_epoch)

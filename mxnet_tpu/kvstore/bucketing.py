@@ -26,16 +26,70 @@ operand order, same reduction arity) each member would see in its own
 per-key collective — so the bucketed *uncompressed* exchange is
 bit-identical to the per-key path, which the tests and
 ``tools/comms_bench.py`` assert.
+
+ZeRO partitioning (``partition="zero1"|"zero2"``) is a *layout* the
+planner can attach to every bucket: the flat buffer, zero-padded to a
+multiple of ``world``, is carved into ``world`` equal contiguous
+per-rank shards (:class:`ShardPlan`). Rank ``r`` reduces only elements
+``[r*shard_len, (r+1)*shard_len)`` (reduce-scatter), updates its shard,
+and the updated weights are allgathered back. The carve is pure
+indexing — it never crosses the reduction, so the sharded exchange
+stays bit-identical to the fused allreduce (asserted by
+``tests/test_zero.py`` and comms_bench stage 5).
 """
 from __future__ import annotations
 
 import os
-from typing import Dict, List, Sequence, Tuple
+from typing import Dict, List, NamedTuple, Optional, Sequence, Tuple
 
-__all__ = ["Bucket", "bucket_cap_bytes", "pack", "plan_buckets",
-           "unpacker"]
+__all__ = ["Bucket", "PARTITION_MODES", "ShardPlan", "bucket_cap_bytes",
+           "pack", "plan_buckets", "shard_layout", "unpacker"]
 
 DEFAULT_BUCKET_MB = 25.0  # PyTorch DDP's default gradient-bucket size
+
+# the ZeRO stages the planner knows how to lay out: "zero1" shards
+# optimizer state only (full gradients still materialize on every
+# rank), "zero2" also leaves gradients reduce-scattered (each rank
+# keeps only its reduced shard)
+PARTITION_MODES = ("zero1", "zero2")
+
+
+class ShardPlan(NamedTuple):
+    """Per-rank carve of one flat bucket under ZeRO partitioning.
+
+    ``total``: unpadded flat element count; ``padded``: total rounded up
+    to a multiple of ``world`` (the tail is zero-filled — zeros are
+    inert through sum-reduction and are dropped before scatter);
+    ``shard_len``: ``padded // world`` elements owned per rank.
+    """
+
+    mode: str
+    world: int
+    total: int
+    padded: int
+    shard_len: int
+
+    def shard_range(self, rank: int) -> Tuple[int, int]:
+        """[start, stop) of ``rank``'s shard in the padded flat buffer."""
+        if not (0 <= rank < self.world):
+            raise ValueError(
+                f"rank {rank} outside partition world {self.world}")
+        return rank * self.shard_len, (rank + 1) * self.shard_len
+
+
+def shard_layout(mode: str, total: int, world: int) -> ShardPlan:
+    """The :class:`ShardPlan` for a flat buffer of ``total`` elements
+    partitioned across ``world`` ranks."""
+    if mode not in PARTITION_MODES:
+        raise ValueError(
+            f"unknown partition mode {mode!r}; expected one of "
+            f"{PARTITION_MODES}")
+    world = int(world)
+    if world < 1:
+        raise ValueError(f"partition world must be >= 1, got {world}")
+    shard_len = -(-int(total) // world)          # ceil div
+    return ShardPlan(mode, world, int(total), shard_len * world,
+                     shard_len)
 
 
 def bucket_cap_bytes() -> int:
@@ -48,7 +102,8 @@ class Bucket:
     """One planned bucket: member positions (indices into the caller's
     key list), their shapes, and the flat-buffer layout."""
 
-    __slots__ = ("indices", "shapes", "dtype", "nbytes", "group")
+    __slots__ = ("indices", "shapes", "dtype", "nbytes", "group",
+                 "shard_plan")
 
     def __init__(self, dtype, group):
         self.indices: List[int] = []
@@ -56,6 +111,16 @@ class Bucket:
         self.dtype = dtype
         self.group = group          # (dtype_str, nslots, slot device sig)
         self.nbytes = 0
+        self.shard_plan: Optional[ShardPlan] = None   # set by partition=
+
+    def elements(self) -> int:
+        n = 0
+        for s in self.shapes:
+            m = 1
+            for d in s:
+                m *= int(d)
+            n += m
+        return n
 
     def add(self, index: int, shape: Tuple[int, ...],
             nbytes: int) -> None:
@@ -73,7 +138,9 @@ class Bucket:
 
 def plan_buckets(entries: Sequence[Tuple[int, Tuple[int, ...], object,
                                          object, int]],
-                 cap_bytes: int) -> List[Bucket]:
+                 cap_bytes: int,
+                 partition: Optional[str] = None,
+                 world: int = 1) -> List[Bucket]:
     """Partition ``entries`` into buckets, preserving the given order.
 
     ``entries``: ``(index, shape, dtype, group, nbytes)`` tuples in
@@ -82,7 +149,16 @@ def plan_buckets(entries: Sequence[Tuple[int, Tuple[int, ...], object,
     or placements. Greedy: an entry joins the open bucket of its group
     unless that would exceed ``cap_bytes``; an entry alone larger than
     the cap still gets (and fills) its own bucket.
+
+    ``partition``: when ``"zero1"`` / ``"zero2"``, every planned bucket
+    additionally gets a :class:`ShardPlan` carving its flat buffer into
+    ``world`` per-rank shards (the reduce-scatter / shard-update /
+    allgather layout the ZeRO engine dispatches on).
     """
+    if partition is not None and partition not in PARTITION_MODES:
+        raise ValueError(
+            f"unknown partition mode {partition!r}; expected one of "
+            f"{PARTITION_MODES}")
     buckets: List[Bucket] = []
     open_by_group: Dict[object, Bucket] = {}
     for index, shape, dtype, group, nbytes in entries:
@@ -92,6 +168,9 @@ def plan_buckets(entries: Sequence[Tuple[int, Tuple[int, ...], object,
             buckets.append(b)
             open_by_group[group] = b
         b.add(index, shape, nbytes)
+    if partition is not None:
+        for b in buckets:
+            b.shard_plan = shard_layout(partition, b.elements(), world)
     return buckets
 
 

@@ -490,6 +490,9 @@ class KVStoreLocal(KVStore):
         # Mutable attribute so benches/dryruns can force the per-key
         # path on one store without touching the environment.
         self._bucket_bytes = bucket_cap_bytes()
+        # key sets already warned about falling off the fused path (one
+        # warning per distinct set, not one per step)
+        self._warned_fallback: set = set()
 
     def init(self, key, value):
         key = self._canon(key)
@@ -643,6 +646,25 @@ class KVStoreLocal(KVStore):
             # per-key path records under push+pull — the two paths'
             # byte counters must stay comparable
             total_bytes += entry[4] * (len(vals) + len(outs_i))
+        if fallback:
+            # the coverage gap is OBSERVABLE (ISSUE 19 satellite): count
+            # every per-key fallback and warn once per distinct key set —
+            # a model quietly paying O(keys) dispatches (or training
+            # un-sharded under ZeRO) should not be a mystery
+            if _tel:
+                telemetry.record_kv_bucket_fallback("row_sparse",
+                                                    len(fallback))
+            keyset = frozenset(vals_by_pos[pos][0] for pos in fallback)
+            if keyset not in self._warned_fallback:
+                self._warned_fallback.add(keyset)
+                shown = sorted(map(str, keyset))
+                more = "" if len(shown) <= 8 else f" (+{len(shown) - 8})"
+                warnings.warn(
+                    f"{len(keyset)} key(s) fell back to per-key pushpull "
+                    f"(non-default storage, e.g. row_sparse): "
+                    f"{shown[:8]}{more} — these keys are outside the "
+                    "fused-bucket (and ZeRO) path",
+                    stacklevel=3)
         buckets = plan_buckets(entries, self._bucket_bytes)
         # one dispatch plan in global priority order: a bucket is issued
         # at its FIRST member's slot, per-key fallbacks (sparse payloads)
@@ -655,7 +677,8 @@ class KVStoreLocal(KVStore):
                 reduced = self._bucket_exchange_reduce(b, vals_by_pos)
                 if _tel:
                     telemetry.record_kv_bucket(b.nbytes, len(b))
-                    telemetry.record_kv_collective("bucketed")
+                    telemetry.record_kv_collective(
+                        self._bucket_path_label(b))
                 if pending is not None:
                     self._bucket_scatter(pending[0], pending[1],
                                          vals_by_pos, outs_by_pos)
@@ -674,6 +697,13 @@ class KVStoreLocal(KVStore):
         if _tel:
             telemetry.record_kv("pushpull", total_bytes,
                                 time.perf_counter() - t0)
+
+    def _bucket_path_label(self, bucket) -> str:
+        """Telemetry ``path`` label for one fused-bucket dispatch —
+        ``bucketed`` here; ``tpu_sync`` reports ``hierarchical`` when a
+        host topology factors its mesh (the label then counts INTER-HOST
+        collectives: exactly one per bucket)."""
+        return "bucketed"
 
     @staticmethod
     def _bucket_entry(pos, vals, outs_i):
@@ -854,6 +884,15 @@ class KVStoreTPUSync(KVStoreLocal):
             _register_exit_barrier(self)
         self._mesh = None
         self._reducers: Dict = {}
+        # topology-aware hierarchical collectives: number of (virtual)
+        # hosts the mesh slots factor into, or None to resolve from
+        # MXNET_KV_HOSTS ("auto" = one host per process). When a
+        # topology is active the reduce mesh is 2-D ("dcn" x "ici") and
+        # every bucket reduction is ONE collective over the factored
+        # mesh — XLA's lowering runs the intra-host phase on ICI and
+        # crosses DCN once per host pair, and the combined-axes psum is
+        # bit-identical to the flat 1-D psum (tests/test_zero.py).
+        self._hier_hosts: Optional[int] = None
         # cross-process barrier namespace: (store creation ordinal, per-
         # site sequence). The ordinal is SPMD-consistent (every process
         # creates its stores in the same program order), and keeps two
@@ -918,6 +957,78 @@ class KVStoreTPUSync(KVStoreLocal):
         single-process mode, all global devices in multi-process mode)."""
         self._mesh = mesh
 
+    def set_topology(self, hosts) -> None:
+        """Declare the host topology for hierarchical collectives.
+
+        ``hosts``: how many (virtual) hosts the mesh slots split into —
+        the mesh becomes ``(hosts, slots_per_host)`` with axes
+        ``("dcn", "ici")`` and every bucket reduce is one psum over the
+        factored mesh. ``"auto"`` derives one host per process;
+        ``None``/``0``/``1`` restores the flat 1-D mesh. Slots group
+        contiguously in device-id order, matching how
+        ``--xla_force_host_platform_device_count`` virtualizes hosts and
+        how real pods enumerate chips per host."""
+        if hosts in (None, 0, 1):
+            self._hier_hosts = 0          # explicit flat (skip the env)
+        elif hosts == "auto":
+            import jax
+
+            self._hier_hosts = max(jax.process_count(), 1)
+        else:
+            h = int(hosts)
+            if h < 1:
+                raise MXNetError(f"set_topology: hosts must be >= 1 or "
+                                 f"'auto', got {hosts!r}")
+            self._hier_hosts = h
+        self._reducers.clear()
+
+    def _topology_hosts(self, nslots: int) -> int:
+        """Resolved host count for an ``nslots``-slot mesh; 0 = flat.
+        A topology that does not divide the slot count is rejected
+        loudly — a silently-flat mesh would fake the DCN savings."""
+        h = self._hier_hosts
+        if h is None:
+            raw = os.environ.get("MXNET_KV_HOSTS", "").strip()
+            if not raw:
+                return 0
+            if raw == "auto":
+                import jax
+
+                h = max(jax.process_count(), 1)
+            else:
+                h = int(raw)
+        if h in (0, 1) or nslots <= 1:
+            return 0
+        if nslots % h != 0:
+            raise MXNetError(
+                f"hierarchical topology: {h} hosts do not evenly divide "
+                f"{nslots} mesh slots — fix MXNET_KV_HOSTS/set_topology "
+                "or the per-key copy count")
+        return h
+
+    def _bucket_path_label(self, bucket) -> str:
+        """``hierarchical`` when this bucket's reduce ran over a factored
+        ("dcn" x "ici") mesh, else ``bucketed`` — mirrors the
+        ``_needs_collective`` gate + ``_reduce_mesh`` factoring the
+        exchange itself just used (the label is recorded after the
+        reduce, so an invalid topology has already raised)."""
+        import jax
+
+        nslots = bucket.group[1]
+        devsig = bucket.group[2]
+        needs = (jax.process_count() > 1 or self._mesh is not None
+                 or (nslots > 1 and len(set(devsig)) == nslots))
+        if not needs:
+            return "bucketed"
+        if self._mesh is not None:
+            total = int(self._mesh.devices.size)
+        elif jax.process_count() > 1:
+            total = nslots * jax.process_count()
+        else:
+            total = nslots
+        return "hierarchical" if self._topology_hosts(total) \
+            else "bucketed"
+
     @property
     def num_workers(self):
         import jax
@@ -965,9 +1076,23 @@ class KVStoreTPUSync(KVStoreLocal):
                 chosen.extend(proc_devs[:k])
             local = [d for d in chosen
                      if d.process_index == jax.process_index()]
-            return Mesh(np.array(chosen), ("kv",)), local
+            return self._mesh_over(chosen), local
         devs = [next(iter(v.data.devices())) for v in vals]
-        return Mesh(np.array(devs), ("kv",)), devs
+        return self._mesh_over(devs), devs
+
+    def _mesh_over(self, devs):
+        """Mesh over an ordered flat device list: 1-D ``("kv",)`` by
+        default; with a host topology, 2-D ``("dcn", "ici")`` — device
+        order is preserved (row-major flattening of the 2-D mesh is the
+        flat list), so the factored psum reduces the same operands."""
+        import numpy as np
+        from jax.sharding import Mesh
+
+        hosts = self._topology_hosts(len(devs))
+        if hosts:
+            arr = np.array(devs).reshape(hosts, len(devs) // hosts)
+            return Mesh(arr, ("dcn", "ici"))
+        return Mesh(np.array(devs), ("kv",))
 
     def _reducer(self, mesh, ndev, shape, dtype):
         """jit(shard_map(psum)) per (mesh, ndev, shape, dtype) — compiled
@@ -982,12 +1107,20 @@ class KVStoreTPUSync(KVStoreLocal):
             from jax.sharding import PartitionSpec as P
             from jax.experimental.shard_map import shard_map
 
+            # all mesh axes at once: on the 2-D hierarchical mesh this is
+            # ONE collective whose lowering factors into intra-host (ici)
+            # + inter-host (dcn) phases, and a combined-axes psum is
+            # bit-identical to the flat 1-D psum (sequential two-stage
+            # psums are NOT — measured ULP drift — which is why the
+            # policy factors the mesh instead of chaining collectives)
+            axes = tuple(mesh.axis_names)
+
             def allreduce(stacked):
                 # each shard is one device's (1, *shape) copy; psum over
                 # the mesh and drop the stack dim
                 red = shard_map(
-                    lambda x: jax.lax.psum(x[0], "kv"), mesh=mesh,
-                    in_specs=P("kv"), out_specs=P())
+                    lambda x: jax.lax.psum(x[0], axes), mesh=mesh,
+                    in_specs=P(axes), out_specs=P())
                 return red(stacked)
 
             fn = jax.jit(allreduce)
@@ -1028,6 +1161,7 @@ class KVStoreTPUSync(KVStoreLocal):
 
         mesh, local_devs = self._reduce_mesh(vals)
         ndev = mesh.devices.size
+        spec = P(tuple(mesh.axis_names))   # leading dim over ALL axes
         shape = tuple(vals[0].shape)
         by_dev = {next(iter(v.data.devices())): v for v in vals}
         if set(by_dev) != set(local_devs):
@@ -1043,7 +1177,7 @@ class KVStoreTPUSync(KVStoreLocal):
                 shards = [by_dev[d].reshape((1,) + shape)
                           for d in local_devs]
                 stacked = jax.make_array_from_single_device_arrays(
-                    (ndev,) + shape, NamedSharding(mesh, P("kv")), shards)
+                    (ndev,) + shape, NamedSharding(mesh, spec), shards)
                 return self._reducer(mesh, ndev, shape,
                                      vals[0].dtype)(stacked)
             raise MXNetError(
@@ -1055,7 +1189,7 @@ class KVStoreTPUSync(KVStoreLocal):
         # make_array assembles the global view from addressable shards)
         shards = [by_dev[d].data.reshape((1,) + shape) for d in local_devs]
         stacked = jax.make_array_from_single_device_arrays(
-            (ndev,) + shape, NamedSharding(mesh, P("kv")), shards)
+            (ndev,) + shape, NamedSharding(mesh, spec), shards)
         return self._reducer(mesh, ndev, shape, vals[0].dtype)(stacked)
 
     def _needs_collective(self, arrs) -> bool:

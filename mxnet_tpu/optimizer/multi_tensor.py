@@ -949,6 +949,7 @@ def apply_eager_plan(optimizer, plan, items) -> None:
     for i, _, _ in items:
         optimizer._update_count(i)
 
+    state_bytes = 0
     for b, state_nds in plan.buckets:
         ks = [items[pos][0] for pos in b.members]
         ws = [items[pos][1] for pos in b.members]
@@ -988,9 +989,13 @@ def apply_eager_plan(optimizer, plan, items) -> None:
             pos += n
         nbytes = sum(int(_np.prod(s or (1,))) for s in b.shapes) \
             * _np.dtype(b.wdtype).itemsize
+        state_bytes += len(roles) * nbytes
         telemetry.record_optimizer_dispatch(
             "fused_sweep", getattr(fn, "n_dispatches", 1))
         telemetry.record_optimizer_bucket(nbytes, len(b.members))
+    # per-rank optimizer-state footprint of the replicated sweep — the
+    # baseline the ZeRO gauge (mode="zero1"/"zero2") is compared against
+    telemetry.record_optimizer_state_bytes("replicated", state_bytes)
 
 
 # ---------------------------------------------------------------------------

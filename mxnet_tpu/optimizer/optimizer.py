@@ -58,6 +58,18 @@ class Optimizer:
         self.num_update = begin_num_update
         self.begin_num_update = begin_num_update
         self._index_update_count: Dict[int, int] = {}
+        # reference: Optimizer._all_index_update_counts — one count
+        # stream PER DEVICE, switched by _set_current_context. A param
+        # replicated over N devices must advance t once per step on
+        # each replica, not N times on a shared clock: Adam's bias
+        # correction reads t, and a shared clock hands every replica a
+        # DIFFERENT t (ctx0 sees 1,N+1,..., ctx1 sees 2,N+2,...), so
+        # the supposedly identical device copies drift apart.
+        self._all_index_update_counts: Dict[int, Dict[int, int]] = \
+            {0: self._index_update_count}
+        # seed for streams created after a restore: a rejoined device
+        # must resume the saved clock, not restart t at 1
+        self._count_baseline: Dict[int, int] = {}
         self.idx2name = dict(param_idx2name or {})
         self.param_dict = dict(param_dict or {})
         self.lr_mult: Dict[str, float] = {}
@@ -109,6 +121,23 @@ class Optimizer:
             yield
         finally:
             self._dyn = prev
+
+    def _set_current_context(self, device_id):
+        """Switch the per-index update-count stream to ``device_id``
+        (reference: Optimizer._set_current_context). New streams seed
+        from the restored-counter baseline — empty on a fresh run."""
+        if device_id not in self._all_index_update_counts:
+            self._all_index_update_counts[device_id] = \
+                dict(self._count_baseline)
+        self._index_update_count = self._all_index_update_counts[device_id]
+
+    def _restore_update_counts(self, counts):
+        """Install restored per-index counts as the clock of EVERY
+        device stream, current and future — a resumed multi-device run
+        must see the same t on every replica."""
+        self._count_baseline = dict(counts)
+        self._index_update_count = dict(counts)
+        self._all_index_update_counts = {0: self._index_update_count}
 
     def _update_count(self, index):
         if self._dyn is not None:
@@ -535,7 +564,7 @@ class Updater:
         self.states = {k: to_nd(v) for k, v in data.items()}
         if counters is not None:
             self.optimizer.num_update = counters["num_update"]
-            self.optimizer._index_update_count = dict(
+            self.optimizer._restore_update_counts(
                 counters["index_update_count"])
 
 

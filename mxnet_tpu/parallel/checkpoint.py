@@ -350,6 +350,6 @@ def restore_sharded(step, directory: str, example_data=None) -> None:
 
     opt = step.optimizer
     opt.num_update = meta["optimizer"]["num_update"]
-    opt._index_update_count = {
+    opt._restore_update_counts({
         int(k): v
-        for k, v in meta["optimizer"]["index_update_count"].items()}
+        for k, v in meta["optimizer"]["index_update_count"].items()})

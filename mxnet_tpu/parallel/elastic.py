@@ -491,6 +491,11 @@ class ElasticRunner:
         step = self.ckpt.latest_step()
         if step is not None:
             meta = self._restore()
+            # a sharded restore may have picked a DIFFERENT step than
+            # our own newest bundle (the newest step whose full shard
+            # set is still on disk — possibly a surviving peer's newer
+            # one); the schedule must follow what was actually restored
+            step = int(meta.get("step", step))
             self.start_step = step + 1
             self.resumed_from = step
             tag = (meta.get("extra") or {}).get("elastic") or {}
@@ -515,6 +520,7 @@ class ElasticRunner:
                             and committed_step != self.start_step - 1:
                         self._reconcile_to(committed_step, committed)
         self.membership = self._make_membership(epoch, alive)
+        self._adopt_partition(self.membership)
         self._last_completed = self.start_step - 1
         self._publish_epoch(epoch, self.membership.members,
                             self._last_completed)
@@ -711,18 +717,161 @@ class ElasticRunner:
         """Bit-exact restore from the newest valid bundle (or ``step``,
         or another rank's manager ``mgr`` — the join reconciliation),
         bounded retry at ``elastic.rejoin`` (restore is an idempotent
-        overwrite)."""
+        overwrite).
+
+        Under a ZeRO-partitioned trainer each rank's bundle carries only
+        its OWN optimizer-state shard, so params + RNG come from ``mgr``
+        but the sharded state is gathered from EVERY rank bundle at the
+        same step and re-sharded into the current partition identity
+        (``Trainer.load_states_resharded``) — this is what makes rejoin
+        at a *different* world size restore bit-exact."""
         mgr = self.ckpt if mgr is None else mgr
+        tr = self.trainer
+        sharded = self._is_sharded()
 
         def _do():
             if _fault_state.enabled:
                 fault.check("elastic.rejoin",
                             f"rank {self.launch_rank}")
-            return mgr.restore(block=self.params,
-                               trainer=self.trainer, step=step)
+            if not sharded:
+                return mgr.restore(block=self.params,
+                                   trainer=self.trainer, step=step)
+            pick, pick_mgr = step, mgr
+            if step is None:
+                # resume-newest under a sharded layout: "newest" is the
+                # newest step whose FULL source-world shard set is still
+                # on disk — our own newest bundle's peer shards may be
+                # gone (a peer died before saving that step, or a
+                # surviving peer's keep_last GC advanced past it while
+                # we restarted). Params and the RNG stream are
+                # replicated under dist_sync, so ANY bundle of the
+                # complete group can anchor the restore; skipping ahead
+                # to a surviving peer's newer complete step is the same
+                # adopt-the-survivors'-schedule semantics as
+                # _reconcile_to, not divergence.
+                for s in self._sharded_steps():
+                    files, anchor, complete = self._sharded_coverage(s)
+                    if complete:
+                        pick = s
+                        if anchor != f"r{self.launch_rank}":
+                            pick_mgr = CheckpointManager(
+                                self.ckpt.directory, prefix=anchor,
+                                keep_last=self.ckpt.keep_last)
+                        break
+            meta = pick_mgr.restore(block=self.params, trainer=None,
+                                    step=pick)
+            if tr is not None:
+                files = self._sharded_state_files(meta["step"])
+                if not files:
+                    # a pre-partition bundle (or foreign layout): fall
+                    # back to the strict single-file path so the typed
+                    # mismatch error names the problem
+                    tr.load_states(pick_mgr.states_path(meta["step"]))
+                else:
+                    tr.load_states_resharded(files)
+            return meta
 
         return fault.retry_call("elastic.rejoin", _do,
                                 detail=f"rank {self.launch_rank}")
+
+    def _sharded_steps(self) -> List[int]:
+        """Union of bundle steps across every rank prefix under the
+        shared checkpoint directory, newest first — the candidate resume
+        points of a sharded restore (a peer's bundle can be newer than
+        any of ours)."""
+        import re as _re
+
+        pat = _re.compile(r"^r\d+-(\d{8})$")
+        try:
+            entries = os.listdir(self.ckpt.directory)
+        except OSError:
+            entries = []
+        steps = {int(m.group(1)) for e in entries
+                 for m in (pat.match(e),) if m}
+        return sorted(steps, reverse=True)
+
+    def _sharded_coverage(
+            self, step: int) -> Tuple[List[str], Optional[str], bool]:
+        """The rank bundles' ``trainer.states`` shards at ``step`` plus
+        whether they form a COMPLETE set: a group whose ``zero.json``
+        manifests agree on one source world W and together cover ranks
+        0..W-1. A step can mix plans — a transition re-carves the
+        boundary bundle under the NEW world while dead peers' old-plan
+        bundles sit beside it — so completeness is judged per plan, not
+        per directory listing. Returns ``(files, anchor, complete)``:
+        when complete, ``files`` is exactly the covering group (rank
+        order) and ``anchor`` a member prefix (our own when present) fit
+        to anchor the params/RNG restore; otherwise every valid bundle's
+        path and ``None``."""
+        import re as _re
+
+        pat = _re.compile(r"^(r\d+)-%08d$" % int(step))
+        try:
+            entries = os.listdir(self.ckpt.directory)
+        except OSError:
+            entries = []
+        by_world: Dict[int, Dict[int, Tuple[str, str]]] = {}
+        loose: List[Tuple[str, str]] = []
+        for e in sorted(entries):
+            m = pat.match(e)
+            if not m:
+                continue
+            mgr = CheckpointManager(self.ckpt.directory,
+                                    prefix=m.group(1),
+                                    keep_last=self.ckpt.keep_last)
+            if not mgr.is_valid(step):
+                continue
+            man = mgr.partition_manifest(step)
+            item = (m.group(1), mgr.states_path(step))
+            try:
+                w, r = int(man["world"]), int(man["rank"])
+            except (TypeError, KeyError, ValueError):
+                loose.append(item)
+                continue
+            by_world.setdefault(w, {})[r] = item
+        complete = [w for w, shards in by_world.items()
+                    if set(shards) >= set(range(w))]
+        if complete:
+            # two complete groups at one step is contrived (requires
+            # disjoint prefix sets each covering a full world); prefer
+            # the smaller world — the plan a shrink transition just
+            # carved, whose full set survives the death by construction
+            w = min(complete)
+            group = [by_world[w][r] for r in range(w)]
+            prefixes = {p for p, _ in group}
+            own = f"r{self.launch_rank}"
+            anchor = own if own in prefixes else group[0][0]
+            return [path for _, path in group], anchor, True
+        files = [path for _, path in loose]
+        for shards in by_world.values():
+            files.extend(path for _, path in shards.values())
+        return sorted(files), None, False
+
+    def _sharded_state_files(self, step: int) -> List[str]:
+        """Every rank bundle's ``trainer.states`` shard at ``step``
+        under the shared checkpoint directory (the ``r<launch_rank>``
+        prefix layout every worker of the job uses) — the complete
+        covering group when one exists."""
+        return self._sharded_coverage(step)[0]
+
+    def _is_sharded(self) -> bool:
+        """True when the trainer carves per-rank ZeRO state shards into
+        its checkpoints (``partition=`` mode)."""
+        tr = self.trainer
+        return tr is not None \
+            and getattr(tr, "_partition", None) is not None
+
+    def _adopt_partition(self, m: Membership) -> None:
+        """Bind a ZeRO-partitioned trainer to this membership's (rank,
+        world) so its next checkpoint carves shards under the NEW plan.
+        No-op for replicated trainers."""
+        tr = self.trainer
+        if tr is None or getattr(tr, "_partition", None) is None:
+            return
+        if not tr._kv_initialized:
+            tr._init_kvstore()
+        if tr._zero is not None:
+            tr._zero.reconfigure(m.rank, m.world_size)
 
     # -- the epoch protocol --------------------------------------------
     def check_membership(self) -> Membership:
@@ -792,27 +941,42 @@ class ElasticRunner:
         else:
             epoch = max(old.epoch, rec_epoch) + 1
         new = self._make_membership(epoch, list(new_members))
-        # 1) survivors checkpoint BEFORE touching the collective runtime
+        # 1) adopt the new partition identity BEFORE the boundary
+        # checkpoint: the bundle must be carved under the NEW plan so
+        # the survivors' shard set is complete by construction — under
+        # the OLD plan a freshly-dead rank's shard of this step exists
+        # NOWHERE on disk (it died before saving it), and any later
+        # restore gathering at this step would fail. Safe to do early: a
+        # virtual partition holds the full state locally, so the carve
+        # is a serialization identity, not a data movement. No-op for
+        # replicated trainers.
+        self._adopt_partition(new)
+        # 2) survivors checkpoint BEFORE touching the collective runtime
         # (a crash inside the re-bootstrap must lose at most this step)
         if self._last_completed >= 0:
             self._save(self._last_completed, new)
-        # 2) publish the commit record BEFORE the blocking re-bootstrap:
+        # 3) publish the commit record BEFORE the blocking re-bootstrap:
         # a rejoining rank waits on it (_await_join_commit) to enter the
         # same rendezvous — publishing after would deadlock the join;
         # it carries our committed step so the rejoiner can skip ahead
         # to the survivors' schedule
         self._publish_epoch(epoch, new.members, self._last_completed)
-        # 3) tear down the old world's collective runtime
+        # 4) tear down the old world's collective runtime
         distributed = self._is_distributed()
         if distributed:
             (self._shutdown_fn or self._default_shutdown)()
-        # 4) re-bootstrap at the new world size
+        # 5) re-bootstrap at the new world size
         if distributed:
             (self._bootstrap_fn or self._default_bootstrap)(new)
-        # 5) restore bit-exact and continue
-        if self._last_completed >= 0:
+        # 6) restore bit-exact. Replicated trainers keep the idempotent
+        # overwrite (every survivor provably resumes from the committed
+        # bytes). A ZeRO-partitioned trainer SKIPS it: its full state is
+        # authoritative in memory and was just carved to disk under the
+        # new plan in step 2 — and a gather here would race peer
+        # survivors that have not finished their own boundary save yet
+        if self._last_completed >= 0 and not self._is_sharded():
             self._restore()
-        # 6) warm the compile caches for the new world BEFORE the next
+        # 7) warm the compile caches for the new world BEFORE the next
         # step dispatches — PR 8's teardown + re-bootstrap made every
         # membership epoch pay a cold retrace; the manifest replay turns
         # that into executable-table / disk-cache hits

@@ -839,15 +839,19 @@ def record_kv(op: str, nbytes: float, seconds: float) -> None:
 
 def record_kv_collective(path: str, n: int = 1) -> None:
     """One gradient-reduction dispatch on the comms path. ``path``:
-    ``per_key`` (one reduce/psum per parameter — the reference shape) or
-    ``bucketed`` (one collective per fused gradient bucket). The
-    per-step dispatch-reduction ratio in BENCH/PERF rounds is computed
-    from this."""
+    ``per_key`` (one reduce/psum per parameter — the reference shape),
+    ``bucketed`` (one collective per fused gradient bucket),
+    ``hierarchical`` (one topology-aware bucket collective — intra-host
+    ICI + inter-host DCN factored through the 2-D device mesh; the count
+    IS the inter-host dispatch count, exactly one per bucket), or
+    ``zero`` (one fused reduce-scatter + shard-update + allgather
+    program per ZeRO bucket). The per-step dispatch-reduction ratio in
+    BENCH/PERF rounds is computed from this."""
     if not _state.enabled:
         return
     counter("mxnet_kvstore_collective_dispatch_total",
             "Gradient-reduction collective dispatches by path "
-            "(per_key/bucketed).", ("path",)).labels(path).inc(n)
+            "(per_key/bucketed/hierarchical/zero).", ("path",)).labels(path).inc(n)
 
 
 def record_kv_bucket(nbytes: float, nkeys: int) -> None:
@@ -860,6 +864,34 @@ def record_kv_bucket(nbytes: float, nkeys: int) -> None:
     counter("mxnet_kvstore_bucketed_keys_total",
             "Parameter keys coalesced through bucketed pushpull."
             ).inc(nkeys)
+
+
+def record_kv_bucket_fallback(reason: str, nkeys: int = 1) -> None:
+    """Keys that fell OFF the fused bucketed-pushpull path back to the
+    per-key exchange. ``reason``: ``row_sparse`` (non-default storage —
+    PR 5's documented gap), ``zero_family`` (optimizer family the ZeRO
+    shard sweep cannot reproduce bit-exactly, e.g. LAMB's cross-member
+    trust-ratio norms), ``zero_multi_precision``, ``zero_sparse``.
+    Observability for coverage gaps that used to be silent."""
+    if not _state.enabled:
+        return
+    counter("mxnet_kvstore_bucket_fallback_total",
+            "Keys excluded from fused bucketed pushpull by reason.",
+            ("reason",)).labels(reason).inc(nkeys)
+
+
+def record_optimizer_state_bytes(mode: str, nbytes: float) -> None:
+    """Persistent optimizer-state bytes held by THIS rank, by layout
+    ``mode``: ``replicated`` (every rank holds the full state — the
+    reference KVStore shape), ``zero1`` / ``zero2`` (this rank's shard
+    under ZeRO partitioning). The ZeRO engine publishes BOTH its actual
+    per-rank bytes and the replicated-equivalent total, so the ~1/world
+    memory drop is read directly off the gauge pair."""
+    if not _state.enabled:
+        return
+    gauge("mxnet_optimizer_state_bytes",
+          "Per-rank persistent optimizer-state bytes by layout mode.",
+          ("mode",)).labels(mode).set(float(nbytes))
 
 
 def record_kv_compression(ratio: float, elements: int) -> None:

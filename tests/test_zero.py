@@ -1,0 +1,532 @@
+"""ZeRO-sharded optimizer state (mxnet_tpu/optimizer/zero.py).
+
+The contract under test: carving each fused optimizer bucket into
+per-rank shards (reduce-scatter -> shard-local sweep -> allgather) must
+be BIT-IDENTICAL to the replicated fused path — same losses, same
+weights, down to the last ULP — while holding ~1/world of the optimizer
+state per rank. On top of that, per-rank shard bundles saved at world N
+must re-shard into ANY world M at elastic rejoin, bit-exact.
+
+The update clock: the replicated eager path keeps one count stream PER
+DEVICE (Optimizer._set_current_context), so a param on N contexts
+advances t once per step on each replica — the same t the sharded
+sweep's single advance sees. That is what makes t-dependent updates
+(adam bias correction) bit-comparable across all of replicated, zero1
+and zero2 at any context count, and what keeps the replicated device
+copies identical to EACH OTHER (TestBitIdentity guards both).
+"""
+import json
+import os
+import time
+
+import numpy as np
+import pytest
+
+import mxnet_tpu as mx
+from mxnet_tpu import autograd, gluon, telemetry
+from mxnet_tpu.base import MXNetError
+from mxnet_tpu.checkpoint import CheckpointManager
+from mxnet_tpu.gluon import nn
+from mxnet_tpu.kvstore.bucketing import bucket_cap_bytes
+from mxnet_tpu.optimizer import zero as zero_mod
+from mxnet_tpu.parallel import elastic
+
+pytestmark = pytest.mark.zero
+
+SGD_MOM = ("sgd", {"learning_rate": 0.05, "momentum": 0.9, "wd": 0.01})
+ADAM = ("adam", {"learning_rate": 0.01})
+
+
+# ---------------------------------------------------------------------------
+# helpers
+# ---------------------------------------------------------------------------
+
+def _metric(name, **labels):
+    m = telemetry.snapshot()["metrics"].get(name)
+    if not m:
+        return 0.0
+    for s in m.get("samples", []):
+        if all(s.get("labels", {}).get(k) == v for k, v in labels.items()):
+            return s["value"]
+    return 0.0
+
+
+def _hist_count(name, **labels):
+    m = telemetry.snapshot()["metrics"].get(name)
+    if not m:
+        return 0
+    for s in m.get("samples", []):
+        if all(s.get("labels", {}).get(k) == v for k, v in labels.items()):
+            return s["count"]
+    return 0
+
+
+def make_model(seed, nctx, opt_name, opt_kw, partition=None,
+               kvstore="tpu_sync", **tkw):
+    """Two-layer net with every shape explicit (deferred init would skip
+    the seeding loop) and weights seeded by STABLE prefix-relative name
+    — gluon's global name counters differ across instances."""
+    if nctx > 1:
+        import jax
+
+        if jax.device_count() < nctx:
+            pytest.skip(
+                f"needs {nctx} virtual CPU devices "
+                "(XLA_FLAGS=--xla_force_host_platform_device_count)")
+    ctxs = [mx.cpu(i) for i in range(nctx)]
+    net = nn.HybridSequential()
+    net.add(nn.Dense(37, in_units=13))
+    net.add(nn.Dense(5, in_units=37))
+    net.initialize(ctx=ctxs)
+    rs = np.random.RandomState(seed)
+    for _, p in sorted(net._collect_params_with_prefix().items()):
+        p.set_data(mx.nd.array(
+            rs.uniform(-1, 1, p.shape).astype(np.float32)))
+    tr = gluon.Trainer(net.collect_params(), opt_name, dict(opt_kw),
+                       kvstore=kvstore, partition=partition, **tkw)
+    return net, tr, ctxs
+
+
+def _batch(step, data_seed):
+    rs = np.random.RandomState(data_seed * 1000 + step)
+    x = rs.uniform(-1, 1, (8, 13)).astype(np.float32)
+    y = rs.uniform(-1, 1, (8, 5)).astype(np.float32)
+    return x, y
+
+
+def train_step(net, tr, ctxs, step, data_seed):
+    xh, yh = _batch(step, data_seed)
+    n = len(ctxs)
+    per = 8 // n
+    loss_fn = gluon.loss.L2Loss()
+    xs = [mx.nd.array(xh[i * per:(i + 1) * per]).as_in_context(c)
+          for i, c in enumerate(ctxs)]
+    ys = [mx.nd.array(yh[i * per:(i + 1) * per]).as_in_context(c)
+          for i, c in enumerate(ctxs)]
+    with autograd.record():
+        ls = [loss_fn(net(a), b) for a, b in zip(xs, ys)]
+        for l in ls:
+            l.backward()
+    tr.step(8)
+    return sum(float(l.sum().asnumpy()) for l in ls)
+
+
+def train(net, tr, ctxs, steps, data_seed=11, start=0):
+    return [train_step(net, tr, ctxs, s, data_seed)
+            for s in range(start, start + steps)]
+
+
+def weights_of(net, ctx):
+    return {k: p.data(ctx).asnumpy()
+            for k, p in net._collect_params_with_prefix().items()}
+
+
+def assert_same(tag, losses_a, losses_b, wa, wb):
+    assert losses_a == losses_b, \
+        f"{tag}: losses diverge {losses_a} vs {losses_b}"
+    for k in wa:
+        assert np.array_equal(wa[k], wb[k]), \
+            f"{tag}: weight {k} differs by " \
+            f"{np.abs(wa[k] - wb[k]).max()}"
+
+
+# ---------------------------------------------------------------------------
+# bit-identity: sharded sweep vs replicated fused path
+# ---------------------------------------------------------------------------
+
+class TestBitIdentity:
+    def test_zero1_matches_replicated_sgd_momentum(self):
+        net0, tr0, cx0 = make_model(3, 2, *SGD_MOM)
+        net1, tr1, cx1 = make_model(3, 2, *SGD_MOM, partition="zero1")
+        l0 = train(net0, tr0, cx0, 6)
+        l1 = train(net1, tr1, cx1, 6)
+        assert tr1.partition == "zero1" and tr1._zero.world == 2
+        assert_same("zero1 vs replicated", l0, l1,
+                    weights_of(net0, cx0[0]), weights_of(net1, cx1[0]))
+
+    def test_zero2_matches_replicated_sgd_momentum(self):
+        net0, tr0, cx0 = make_model(3, 2, *SGD_MOM)
+        net2, tr2, cx2 = make_model(3, 2, *SGD_MOM, partition="zero2")
+        l0 = train(net0, tr0, cx0, 6)
+        l2 = train(net2, tr2, cx2, 6)
+        assert_same("zero2 vs replicated", l0, l2,
+                    weights_of(net0, cx0[0]), weights_of(net2, cx2[0]))
+
+    def test_zero1_matches_zero2_adam_multictx(self):
+        net1, tr1, cx1 = make_model(5, 4, *ADAM, partition="zero1")
+        net2, tr2, cx2 = make_model(5, 4, *ADAM, partition="zero2")
+        l1 = train(net1, tr1, cx1, 5)
+        l2 = train(net2, tr2, cx2, 5)
+        assert_same("zero1 vs zero2 (adam)", l1, l2,
+                    weights_of(net1, cx1[0]), weights_of(net2, cx2[0]))
+
+    def test_zero1_matches_replicated_adam_single_ctx(self):
+        net0, tr0, cx0 = make_model(7, 1, *ADAM)
+        net1, tr1, cx1 = make_model(7, 1, *ADAM, partition="zero1")
+        l0 = train(net0, tr0, cx0, 5)
+        l1 = train(net1, tr1, cx1, 5)
+        assert_same("zero1 vs replicated (adam 1ctx)", l0, l1,
+                    weights_of(net0, cx0[0]), weights_of(net1, cx1[0]))
+
+    @pytest.mark.parametrize("nctx", [2, 4])
+    def test_zero1_matches_replicated_adam_multictx(self, nctx):
+        """The t-clock case: adam's bias correction reads the per-index
+        update count, so this only holds because the replicated path
+        keeps one count stream per device (a shared clock hands ctx0
+        t=1,N+1,... and ctx1 t=2,N+2,... — replicas drift apart and
+        nothing matches the sharded sweep's once-per-step advance)."""
+        net0, tr0, cx0 = make_model(3, nctx, *ADAM)
+        net1, tr1, cx1 = make_model(3, nctx, *ADAM, partition="zero1")
+        l0 = train(net0, tr0, cx0, 6)
+        l1 = train(net1, tr1, cx1, 6)
+        assert_same(f"zero1 vs replicated (adam {nctx}ctx)", l0, l1,
+                    weights_of(net0, cx0[0]), weights_of(net1, cx1[0]))
+
+    def test_replicated_adam_device_copies_stay_identical(self):
+        """Replicated multi-device adam must agree with ITSELF: after
+        any number of steps every context holds the same bits (the
+        per-device count streams advance in lockstep)."""
+        net, tr, cxs = make_model(3, 4, *ADAM)
+        train(net, tr, cxs, 4)
+        t0 = tr._optimizer._all_index_update_counts[0]
+        assert all(v == 4 for v in t0.values()), t0
+        assert all(tr._optimizer._all_index_update_counts[ci] == t0
+                   for ci in range(1, 4))
+        w0 = weights_of(net, cxs[0])
+        for c in cxs[1:]:
+            wc = weights_of(net, c)
+            for k in w0:
+                assert np.array_equal(w0[k], wc[k]), \
+                    f"replicated copies diverged on {k} at {c}"
+
+
+# ---------------------------------------------------------------------------
+# hierarchical topology-aware dispatch
+# ---------------------------------------------------------------------------
+
+class TestHierarchical:
+    def test_bucketed_one_interhost_dispatch_per_bucket(self):
+        """With a 2-host topology every fused gradient bucket must run
+        exactly ONE inter-host collective — the 'hierarchical' dispatch
+        count equals the bucket count, with zero flat-'bucketed'
+        dispatches — and stay bit-identical to the flat mesh."""
+        netf, trf, cxf = make_model(3, 4, *SGD_MOM)
+        lf = train(netf, trf, cxf, 3)
+        neth, trh, cxh = make_model(3, 4, *SGD_MOM)
+        trh._init_kvstore()
+        trh._kvstore.set_topology(2)
+        telemetry.enable()
+        try:
+            lh = train(neth, trh, cxh, 3)
+            hier = _metric("mxnet_kvstore_collective_dispatch_total",
+                           path="hierarchical")
+            flat = _metric("mxnet_kvstore_collective_dispatch_total",
+                           path="bucketed")
+            nbuckets = _hist_count("mxnet_kvstore_bucket_bytes")
+        finally:
+            telemetry.disable()
+        assert hier > 0 and flat == 0
+        assert hier == nbuckets          # exactly one per bucket
+        assert hier % 3 == 0             # same bucket count every step
+        assert_same("hierarchical vs flat", lf, lh,
+                    weights_of(netf, cxf[0]), weights_of(neth, cxh[0]))
+
+    def test_zero1_hierarchical_matches_flat(self):
+        netf, trf, cxf = make_model(3, 4, *SGD_MOM, partition="zero1")
+        lf = train(netf, trf, cxf, 4)
+        neth, trh, cxh = make_model(3, 4, *SGD_MOM, partition="zero1")
+        trh._init_kvstore()
+        trh._kvstore.set_topology(2)
+        # engine planned over the flat mesh at init — re-plan over the
+        # factored one (what a real job sets via MXNET_KV_HOSTS before
+        # the first step)
+        trh._zero._ready = False
+        trh._zero._buckets = []
+        trh._zero.ensure_ready()
+        telemetry.enable()
+        try:
+            lh = train(neth, trh, cxh, 4)
+            nzero = _metric("mxnet_kvstore_collective_dispatch_total",
+                            path="zero")
+        finally:
+            telemetry.disable()
+        assert nzero == 4 * len(trh._zero._buckets)
+        assert_same("zero1 hierarchical vs flat", lf, lh,
+                    weights_of(netf, cxf[0]), weights_of(neth, cxh[0]))
+
+
+# ---------------------------------------------------------------------------
+# per-rank state footprint
+# ---------------------------------------------------------------------------
+
+class TestStateBytes:
+    def test_zero1_state_bytes_at_most_one_world_th(self):
+        telemetry.enable()
+        try:
+            net, tr, cxs = make_model(3, 4, *ADAM, partition="zero1")
+            tr._init_kvstore()
+            per_rank = _metric("mxnet_optimizer_state_bytes",
+                               mode="zero1")
+            replicated = _metric("mxnet_optimizer_state_bytes",
+                                 mode="replicated")
+        finally:
+            telemetry.disable()
+        world = tr._zero.world
+        assert world == 4 and per_rank > 0 and replicated > 0
+        # ceil-div sharding: per-rank holds at most 1/world of the
+        # replicated bytes plus one bucket of padding slack
+        assert per_rank <= replicated / world + bucket_cap_bytes()
+
+    def test_replicated_gauge_from_eager_plan(self):
+        net, tr, cxs = make_model(3, 1, *ADAM)
+        telemetry.enable()
+        try:
+            train(net, tr, cxs, 1)
+            replicated = _metric("mxnet_optimizer_state_bytes",
+                                 mode="replicated")
+        finally:
+            telemetry.disable()
+        # adam: exp_avg + exp_avg_sq over every fused param
+        nelem = sum(int(np.prod(p.shape))
+                    for p in net.collect_params().values())
+        assert replicated == 2 * nelem * 4
+
+
+# ---------------------------------------------------------------------------
+# fallback: families/params outside the sharded sweep
+# ---------------------------------------------------------------------------
+
+class TestFallback:
+    def test_unsupported_family_warns_and_trains_replicated(self):
+        telemetry.enable()
+        try:
+            with pytest.warns(UserWarning,
+                              match="outside the sharded sweep"):
+                net, tr, cxs = make_model(
+                    3, 1, "lamb", {"learning_rate": 0.01},
+                    partition="zero1")
+                tr._init_kvstore()
+            nfall = _metric("mxnet_kvstore_bucket_fallback_total",
+                            reason=zero_mod.FALLBACK_FAMILY)
+        finally:
+            telemetry.disable()
+        assert tr.partition is None          # engine never engaged
+        assert nfall == sum(1 for p in net.collect_params().values()
+                            if p.grad_req != "null")
+        losses = train(net, tr, cxs, 2)      # training still works
+        assert losses[1] == losses[1]        # finite
+
+    def test_sparse_grad_param_falls_back_per_param(self):
+        net, tr, cxs = make_model(3, 1, *SGD_MOM, partition="zero1")
+        params = list(net.collect_params().values())
+        params[0].grad_stype = "row_sparse"
+        telemetry.enable()
+        try:
+            with pytest.warns(UserWarning, match="ZeRO sharded sweep"):
+                tr._init_kvstore()
+            nfall = _metric("mxnet_kvstore_bucket_fallback_total",
+                            reason=zero_mod.FALLBACK_SPARSE)
+        finally:
+            telemetry.disable()
+        assert tr.partition == "zero1"       # engine active for the rest
+        assert nfall == 1
+        reasons = set(tr._zero.fallback_reasons.values())
+        assert reasons == {zero_mod.FALLBACK_SPARSE}
+        # the sparse param is NOT in the sharded buckets but still trains
+        idx = [i for i, p in enumerate(tr._params)
+               if p is params[0]][0]
+        assert idx not in tr._zero.eligible_indices()
+        before = params[0].data(cxs[0]).asnumpy().copy()
+        train(net, tr, cxs, 1)
+        assert not np.array_equal(before, params[0].data(cxs[0]).asnumpy())
+
+
+# ---------------------------------------------------------------------------
+# identity resolution + manifests
+# ---------------------------------------------------------------------------
+
+class TestIdentity:
+    def test_env_identity_and_manifest(self, monkeypatch):
+        monkeypatch.setenv("MXNET_ZERO_WORLD", "4")
+        monkeypatch.setenv("MXNET_ZERO_RANK", "2")
+        net, tr, cxs = make_model(3, 1, *ADAM, partition="zero1",
+                                  kvstore="device")
+        tr._init_kvstore()
+        assert tr._zero.world == 4 and tr._zero.rank == 2
+        man = tr.partition_manifest()
+        assert man["mode"] == "zero1" and man["world"] == 4 \
+            and man["rank"] == 2
+
+    def test_partition_env_engages_engine(self, monkeypatch):
+        monkeypatch.setenv("MXNET_ZERO_PARTITION", "zero2")
+        net, tr, cxs = make_model(3, 2, *SGD_MOM)
+        tr._init_kvstore()
+        assert tr.partition == "zero2"
+
+    def test_update_on_kvstore_conflicts(self):
+        net, tr, cxs = make_model(3, 1, *SGD_MOM, partition="zero1",
+                                  update_on_kvstore=True)
+        with pytest.raises(MXNetError, match="update_on_kvstore"):
+            tr._init_kvstore()
+
+    def test_checkpoint_bundle_carries_partition_manifest(self, tmp_path):
+        net, tr, cxs = make_model(3, 1, *ADAM, partition="zero1",
+                                  kvstore="device", partition_world=2,
+                                  partition_rank=0)
+        train(net, tr, cxs, 1)
+        mgr = CheckpointManager(str(tmp_path), prefix="r0")
+        mgr.save(0, params=net, trainer=tr)
+        man = mgr.partition_manifest(0)
+        assert man["mode"] == "zero1" and man["world"] == 2
+        assert mgr.load(0)["zero"] == man
+
+
+# ---------------------------------------------------------------------------
+# sharded serialization: strict round-trip + typed mismatches
+# ---------------------------------------------------------------------------
+
+class TestSaveLoad:
+    def test_strict_roundtrip_bit_exact(self, tmp_path):
+        net, tr, cxs = make_model(3, 2, *SGD_MOM, partition="zero1")
+        train(net, tr, cxs, 3)
+        f = str(tmp_path / "states")
+        tr.save_states(f)
+        w_at_save = weights_of(net, cxs[0])
+        cont_a = train(net, tr, cxs, 2, start=3)
+        # rewind weights + states, replay: must be bit-identical
+        for k, p in net._collect_params_with_prefix().items():
+            p.set_data(mx.nd.array(w_at_save[k]))
+        tr.load_states(f)
+        cont_b = train(net, tr, cxs, 2, start=3)
+        assert cont_a == cont_b
+
+    def test_unpartitioned_load_of_sharded_file_raises(self, tmp_path):
+        net, tr, cxs = make_model(3, 2, *SGD_MOM, partition="zero1")
+        train(net, tr, cxs, 1)
+        f = str(tmp_path / "sharded")
+        tr.save_states(f)
+        net0, tr0, cx0 = make_model(3, 2, *SGD_MOM)
+        tr0._init_kvstore()
+        with pytest.raises(MXNetError) as ei:
+            tr0.load_states(f)
+        # the error names BOTH plans
+        assert "zero1" in str(ei.value) \
+            and "unpartitioned" in str(ei.value)
+
+    def test_sharded_load_of_replicated_file_raises(self, tmp_path):
+        net0, tr0, cx0 = make_model(3, 2, *SGD_MOM)
+        train(net0, tr0, cx0, 1)
+        f = str(tmp_path / "replicated")
+        tr0.save_states(f)
+        net, tr, cxs = make_model(3, 2, *SGD_MOM, partition="zero1")
+        train(net, tr, cxs, 1)
+        with pytest.raises(MXNetError) as ei:
+            tr.load_states(f)
+        assert "zero1" in str(ei.value)
+
+    def test_missing_source_rank_raises_typed(self, tmp_path):
+        net, tr, cxs = make_model(3, 1, *ADAM, partition="zero1",
+                                  kvstore="device", partition_world=4,
+                                  partition_rank=0)
+        train(net, tr, cxs, 2)
+        f = str(tmp_path / "r0-only")
+        tr.save_states(f)
+        net2, tr2, cx2 = make_model(3, 1, *ADAM, partition="zero1",
+                                    kvstore="device", partition_world=2,
+                                    partition_rank=0)
+        tr2._init_kvstore()
+        with pytest.raises(zero_mod.PartitionMismatchError,
+                           match="rank"):
+            tr2.load_states_resharded([f])
+
+
+# ---------------------------------------------------------------------------
+# N -> M re-sharding (the elastic rejoin path)
+# ---------------------------------------------------------------------------
+
+def _virtual_model(seed, world, rank=0):
+    return make_model(seed, 1, *ADAM, partition="zero1",
+                      kvstore="device", partition_world=world,
+                      partition_rank=rank)
+
+
+def _save_rank_shards(tr, out_paths, world):
+    """One sharded-envelope state file per source rank (the engine in
+    virtual mode serializes only its OWN shard, like N real workers)."""
+    for r, f in enumerate(out_paths):
+        tr.zero_reconfigure(r, world)
+        tr.save_states(f)
+    tr.zero_reconfigure(0, world)
+
+
+class TestReshard:
+    @pytest.mark.parametrize("m", [3, 5, 1])
+    def test_world_4_reshards_bit_exact(self, tmp_path, m):
+        neta, tra, cxa = _virtual_model(3, world=4)
+        train(neta, tra, cxa, 4, data_seed=31)
+        files = [str(tmp_path / f"rank{r}") for r in range(4)]
+        _save_rank_shards(tra, files, 4)
+        wa = weights_of(neta, cxa[0])
+
+        netb, trb, cxb = _virtual_model(99, world=m, rank=min(1, m - 1))
+        for k, p in netb._collect_params_with_prefix().items():
+            p.set_data(mx.nd.array(wa[k]))
+        trb._init_kvstore()
+        trb.load_states_resharded(files)
+
+        la = train(neta, tra, cxa, 3, data_seed=31, start=4)
+        lb = train(netb, trb, cxb, 3, data_seed=31, start=4)
+        assert_same(f"reshard 4->{m}", la, lb,
+                    weights_of(neta, cxa[0]), weights_of(netb, cxb[0]))
+
+
+class TestElasticReshard:
+    """A rank that rejoins an elastic job at a DIFFERENT world size must
+    gather every old-world shard bundle and re-shard it into the new
+    plan bit-exactly — losses and weights match the uninterrupted run."""
+
+    N = 3
+
+    @pytest.mark.parametrize("m", [2, 4, 1])
+    def test_rejoin_resharded_bit_exact(self, tmp_path, m):
+        head, total = 3, 6
+        # oracle: the uninterrupted run (virtual-mode numerics are
+        # world-independent — sharding only shapes serialization)
+        neto, tro, cxo = _virtual_model(3, world=self.N)
+        oracle = train(neto, tro, cxo, total, data_seed=77)
+
+        # incarnation 1 at world N: run `head` steps, then each rank
+        # writes its bundle (params + its OWN state shard)
+        net1, tr1, cx1 = _virtual_model(3, world=self.N)
+        got = train(net1, tr1, cx1, head, data_seed=77)
+        assert got == oracle[:head]
+        ckpt_dir = os.path.join(str(tmp_path), "ckpts")
+        for r in range(self.N):
+            tr1.zero_reconfigure(r, self.N)
+            CheckpointManager(ckpt_dir, prefix=f"r{r}").save(
+                head - 1, params=net1, trainer=tr1,
+                extra={"elastic": {"epoch": 0,
+                                   "members": list(range(self.N)),
+                                   "launch_rank": r}})
+
+        # incarnation 2 at world M: WRONG init on purpose; the rejoin
+        # restore (params from r0, state re-gathered from r0..r{N-1})
+        # must win
+        net2, tr2, cx2 = _virtual_model(99, world=m)
+        board = elastic.HeartbeatBoard(str(tmp_path))
+        future = time.time() + 1e6
+        for r in range(1, m):
+            os.utime(board.register(r), (future, future))
+        runner = elastic.ElasticRunner(
+            str(tmp_path), params=net2, trainer=tr2, world_size=m,
+            rank=0, heartbeat_interval=0.05, heartbeat_timeout=60.0,
+            join_timeout=0.2, distributed=False)
+        tail = runner.run(
+            lambda step, _m: train_step(net2, tr2, cx2, step, 77),
+            total)
+        assert runner.resumed_from == head - 1
+        assert tr2._zero.world == m
+        assert tail == oracle[head:]
+        wo, w2 = weights_of(neto, cxo[0]), weights_of(net2, cx2[0])
+        for k in wo:
+            assert np.array_equal(wo[k], w2[k]), f"weight {k} diverged"

@@ -71,12 +71,22 @@ after the error), completions on the survivor stay bit-identical to
 the oracle, and the survivor keeps serving fresh generates after the
 kill.
 
+The ZERO gate (ISSUE 19) re-runs the elastic SIGKILL contract with
+ZeRO-sharded optimizer state (``partition="zero1"``): each rank's
+bundle carries only its OWN optimizer-state shard, so the survivor's
+world-shrink transition and the victim's rejoin must each GATHER every
+old-world shard bundle and re-shard it into the new (rank, world) plan
+— trajectories bit-identical to an uninterrupted sharded run, with the
+checkpoint ``zero.json`` manifests proving bundles were written under
+BOTH world sizes (the re-shard actually crossed plans).
+
   python tools/chaos_check.py                 # default spec/steps
   python tools/chaos_check.py --steps 40 --seed 11 \
       --spec 'kvstore.push=every:7;kvstore.allreduce=p:0.1' \
       --json /tmp/chaos.json
   python tools/chaos_check.py --skip-elastic  # in-process gates only
   python tools/chaos_check.py --skip-serving  # training gates only
+  python tools/chaos_check.py --skip-zero     # skip the ZeRO re-shard gate
 
 Exit code 0 = all gates pass. Runs on the CPU oracle mesh
 (JAX_PLATFORMS=cpu; the fake cluster flag is set below if absent).
@@ -86,6 +96,7 @@ from __future__ import annotations
 import argparse
 import json
 import os
+import re
 import shutil
 import sys
 import tempfile
@@ -374,6 +385,146 @@ def elastic_gate(summary, steps=30, kill_at=6):
         if not ok:
             tail = "\n".join(out_b.splitlines()[-30:])
             print(f"[chaos] elastic kill-run tail:\n{tail}")
+        return ok
+    finally:
+        shutil.rmtree(workdir, ignore_errors=True)
+
+
+# ---------------------------------------------------------------------------
+# ZeRO gate: the elastic SIGKILL/rejoin contract with SHARDED optimizer
+# state — every world transition must re-gather the old world's shard
+# bundles and re-shard them into the new plan, bit-exact.
+# ---------------------------------------------------------------------------
+
+# same worker, but the trainer partitions its optimizer state (virtual
+# ZeRO identity adopted from the elastic membership): each bundle holds
+# only this rank's state shard, so restore exercises the gather+re-shard
+# path instead of a whole-state read. Ranks share ONE seed and ONE data
+# stream: gathering shard bundles across ranks assumes dist_sync
+# replication (identical params/state on every rank), which the plain
+# elastic worker's per-rank seeds deliberately break
+_ZERO_WORKER = (
+    _ELASTIC_WORKER
+    .replace('kvstore="device")',
+             'kvstore="device",\n                        partition="zero1")')
+    .replace("mx.random.seed(1234 + rank)", "mx.random.seed(1234)")
+    .replace("rs = np.random.RandomState(100 + rank)",
+             "rs = np.random.RandomState(100)")
+    # keep every bundle: the post-run manifest audit needs the
+    # mid-outage world-1 bundles (the survivor's solo plan) to still be
+    # on disk after the regrown world-2 saves would have GC'd them
+    .replace("save_every=1,", "save_every=1, keep_last=1000,"))
+
+
+def _launch_zero(workdir, steps, kill_at=-1, kill_rank=-1,
+                 max_restarts=0):
+    return _launch_job(
+        workdir, _ZERO_WORKER,
+        {"ELASTIC_STEPS": str(steps),
+         "ELASTIC_KILL_AT": str(kill_at),
+         "ELASTIC_KILL_RANK": str(kill_rank),
+         # slow the schedule down: the victim's resume point is coupled
+         # to the survivor's progress (it rejoins at the survivor's
+         # newest complete shard group), so the survivor must still be
+         # mid-run when the respawned victim finishes importing
+         "ELASTIC_STEP_SLEEP": "0.5"},
+        # hold the respawn past the 1.5s heartbeat staleness window: a
+        # warm re-import can beat it, and a victim back on the board
+        # before the survivor's next membership check means no shrink
+        # transition ever runs — the exact path this gate exists to test
+        ["--max-restarts", str(max_restarts),
+         "--restart-backoff", "4.0"])
+
+
+def _bundle_partition_worlds(coord):
+    """World sizes named by the ``zero.json`` manifests across every
+    checkpoint bundle under ``coord`` — the evidence that bundles were
+    carved under more than one partition plan."""
+    worlds = set()
+    root = os.path.join(coord, "ckpts")
+    try:
+        entries = os.listdir(root)
+    except OSError:
+        return worlds
+    for d in entries:
+        try:
+            with open(os.path.join(root, d, "zero.json")) as f:
+                worlds.add(int(json.load(f)["world"]))
+        except (OSError, ValueError, KeyError, TypeError):
+            pass
+    return worlds
+
+
+def zero_gate(summary, steps=48, kill_at=6):
+    """SIGKILL rank 1 mid-step with ZeRO-partitioned trainers. The
+    survivor's shrink-to-world-1 transition re-carves its boundary
+    bundle under the solo plan; the victim's rejoin gathers the newest
+    COMPLETE shard group (the survivor's — its own bundles' peer shards
+    were GC'd during the outage), re-shards it into the grown world,
+    and skips ahead to the survivor's schedule. Both trajectories must
+    be bit-identical to an uninterrupted sharded 2-worker run, and the
+    bundle manifests must show plans at BOTH world sizes."""
+    workdir = tempfile.mkdtemp(prefix="chaos_zero_")
+    try:
+        a_dir = os.path.join(workdir, "a")
+        b_dir = os.path.join(workdir, "b")
+        os.makedirs(a_dir)
+        os.makedirs(b_dir)
+        rc_a, out_a, rep_a, coord_a = _launch_zero(a_dir, steps)
+        print(f"[chaos] zero baseline: rc {rc_a}, restarts "
+              f"{[w['restarts'] for w in rep_a['workers']]}")
+        rc_b, out_b, rep_b, coord_b = _launch_zero(
+            b_dir, steps, kill_at=kill_at, kill_rank=1, max_restarts=1)
+        by_rank = {w["rank"]: w for w in rep_b["workers"]}
+        w1 = by_rank.get(1, {"restarts": 0, "exits": []})
+        print(f"[chaos] zero kill run: rc {rc_b}, rank 1 restarts "
+              f"{w1['restarts']}, rank 1 exits "
+              f"{[e['signal'] or e['exit_code'] for e in w1['exits']]}")
+
+        checks = {}
+        checks["both_runs_clean"] = rc_a == 0 and rc_b == 0
+        checks["victim_sigkilled_once"] = (
+            w1["restarts"] == 1 and bool(w1["exits"])
+            and w1["exits"][0].get("signal") == "SIGKILL")
+        # the victim's resume step floats with the survivor's progress
+        # (newest complete shard group) — require evidence it restored
+        # at or past its own pre-kill bundle, never before it
+        m = re.search(r"ELASTIC_RESUME 1 (\d+)", out_b)
+        checks["resumed_from_complete_shard_group"] = \
+            m is not None and int(m.group(1)) >= kill_at
+        checks["survivor_saw_epoch_transition"] = \
+            "ELASTIC_EPOCH 0 " in out_b
+        worlds = _bundle_partition_worlds(coord_b)
+        checks["bundles_sharded_at_both_worlds"] = {1, 2} <= worlds
+
+        final_a = final_b = None
+        try:
+            a0 = _read_losses(coord_a, 0, "0")
+            b0 = _read_losses(coord_b, 0, "0")
+            checks["survivor_bit_identical"] = \
+                a0["losses"] == b0["losses"]
+            a1 = _read_losses(coord_a, 1, "0")
+            b1 = _read_losses(coord_b, 1, "1")     # resumed incarnation
+            checks["victim_tail_bit_identical"] = (
+                b1["start"] >= kill_at
+                and len(b1["losses"]) > 0
+                and b1["losses"] == a1["losses"][b1["start"]:])
+            final_a, final_b = a1["losses"][-1], b1["losses"][-1]
+        except (OSError, ValueError, IndexError, KeyError) as e:
+            checks["loss_files_complete"] = False
+            print(f"[chaos]   zero loss files incomplete: {e}")
+
+        ok = all(checks.values())
+        summary["gates"]["zero_rejoin_resharded_bit_exact"] = {
+            "pass": ok, "checks": checks,
+            "bundle_worlds": sorted(worlds),
+            "final_loss_uninterrupted": final_a,
+            "final_loss_rejoined": final_b}
+        for name, v in checks.items():
+            print(f"[chaos]   zero {name}: {v}")
+        if not ok:
+            tail = "\n".join(out_b.splitlines()[-30:])
+            print(f"[chaos] zero kill-run tail:\n{tail}")
         return ok
     finally:
         shutil.rmtree(workdir, ignore_errors=True)
@@ -1498,6 +1649,10 @@ def main():
                     help="skip the generate gate (SIGKILL a replica "
                     "mid-completion; typed resolution of streaming "
                     "handles, survivor bit-identity)")
+    ap.add_argument("--skip-zero", action="store_true",
+                    help="skip the ZeRO re-shard gate (SIGKILL under "
+                    "sharded optimizer state; rejoin at a different "
+                    "world size must re-shard bit-exact)")
     args = ap.parse_args()
 
     import numpy as np
@@ -1597,6 +1752,11 @@ def main():
     #    priority preemption between decode steps --------------------
     if not args.skip_multitenant:
         ok = multitenant_gate(summary) and ok
+
+    # -- gate 11: SIGKILL under ZeRO-sharded optimizer state — every
+    #    world transition re-gathers + re-shards the state bit-exact --
+    if not args.skip_zero:
+        ok = zero_gate(summary) and ok
 
     retry_counters = {}
     for s in telemetry.snapshot()["metrics"].get(

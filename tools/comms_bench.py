@@ -25,6 +25,13 @@ TPU (or a 30-minute bench.py run):
    their device work runs under the remaining reverse sweep via JAX
    async dispatch) and gates the overlapped run's losses/weights
    bit-identical to the per-key exchange.
+5. **ZeRO-sharded optimizer state** — the same trainer under
+   ``partition="zero1"`` / ``"zero2"`` (reduce-scatter + shard-local
+   sweep + allgather instead of allreduce + replicated sweep): gates
+   losses/weights bit-identical to the replicated fused path and
+   reports the per-rank optimizer-state bytes against the replicated
+   total (the ~1/world memory win) plus the fused ``zero`` collective
+   dispatch count.
 
 Emits bench.py's JSON contract — one flushed line per completed stage,
 monotonically enriched, ``{"metric", "value", "unit", "vs_baseline"}``
@@ -122,10 +129,21 @@ def _collective_counts():
 
     fam = telemetry.snapshot()["metrics"].get(
         "mxnet_kvstore_collective_dispatch_total")
-    out = {"per_key": 0.0, "bucketed": 0.0}
+    out = {"per_key": 0.0, "bucketed": 0.0, "hierarchical": 0.0,
+           "zero": 0.0}
     for s in (fam["samples"] if fam else ()):
         out[s["labels"]["path"]] = s["value"]
     return out
+
+
+def _gauge_value(name, **labels):
+    from mxnet_tpu import telemetry
+
+    fam = telemetry.snapshot()["metrics"].get(name)
+    for s in (fam["samples"] if fam else ()):
+        if all(s["labels"].get(k) == v for k, v in labels.items()):
+            return s["value"]
+    return 0.0
 
 
 def _exchange(store, keys, vals, outs, priorities):
@@ -166,12 +184,14 @@ def _run_variant(shapes, copies, bucket_bytes, reps, compression=None):
     return per_step / reps, t_all[len(t_all) // 2] * 1e3
 
 
-def _trainer_run(bucket_mb, steps=4, overlap=False, n_dense=1):
+def _trainer_run(bucket_mb, steps=4, overlap=False, n_dense=1,
+                 partition=None, opt_args=None, opt_name="sgd"):
     """Small 2-context data-parallel Trainer run; returns (per-step
     losses, final weights sorted by param name, per-step overlap stats).
     ``bucket_mb`` configures the store's fused-pushpull cap for the run
     (0 = per-key); ``n_dense`` > 1 stacks layers so a tiny cap yields
-    several buckets (the overlap stage needs a multi-bucket plan)."""
+    several buckets (the overlap stage needs a multi-bucket plan);
+    ``partition`` engages the ZeRO-sharded optimizer sweep."""
     import mxnet_tpu as mx
     from mxnet_tpu import autograd, gluon
     from mxnet_tpu.gluon import nn
@@ -201,9 +221,11 @@ def _trainer_run(bucket_mb, steps=4, overlap=False, n_dense=1):
                 rs.randn(*p.shape).astype(np.float32) * 0.1))
         ctxs = [mx.Context("cpu", 0), mx.Context("cpu", 1)]
         net.collect_params().reset_ctx(ctxs)
-        tr = gluon.Trainer(net.collect_params(), "sgd",
-                           {"learning_rate": 0.05}, kvstore="tpu_sync",
-                           overlap_comms=overlap)
+        tr = gluon.Trainer(net.collect_params(), opt_name,
+                           dict(opt_args) if opt_args is not None
+                           else {"learning_rate": 0.05},
+                           kvstore="tpu_sync", overlap_comms=overlap,
+                           partition=partition)
         loss_fn = L2Loss()
         rs2 = np.random.RandomState(11)
         x = rs2.randn(8, 32).astype(np.float32)
@@ -258,6 +280,51 @@ def _overlap_metrics(steps=5):
     pct = 100.0 * in_bwd / total if total else 0.0
     groups = steady[-1]["groups"] if steady else 0
     return pct, groups, identical
+
+
+def _zero_metrics(steps=4):
+    """ZeRO-sharded sweep vs the replicated fused path: bit-identity
+    over zero1 AND zero2 under adam — deliberately t-DEPENDENT, so the
+    gate also covers the per-device update-count streams that keep the
+    replicated path's bias-correction clock at one tick per step per
+    replica — per-rank vs replicated optimizer-state bytes off the
+    gauge pair, and the fused ``zero`` collective dispatch count."""
+    from mxnet_tpu import telemetry
+
+    opt = {"learning_rate": 0.01, "wd": 0.01}
+    opt_name = "adam"
+    losses_rep, w_rep, _ = _trainer_run(25, steps, n_dense=3,
+                                        opt_args=opt, opt_name=opt_name)
+    was = telemetry.enabled()
+    telemetry.enable()
+    try:
+        c0 = _collective_counts()["zero"]
+        losses_z1, w_z1, _ = _trainer_run(25, steps, n_dense=3,
+                                          opt_args=opt, opt_name=opt_name,
+                                          partition="zero1")
+        zero_dispatches = _collective_counts()["zero"] - c0
+        per_rank = _gauge_value("mxnet_optimizer_state_bytes",
+                                mode="zero1")
+        replicated = _gauge_value("mxnet_optimizer_state_bytes",
+                                  mode="replicated")
+    finally:
+        if not was:
+            telemetry.disable()
+    losses_z2, w_z2, _ = _trainer_run(25, steps, n_dense=3,
+                                      opt_args=opt, opt_name=opt_name,
+                                      partition="zero2")
+    identical = (losses_rep == losses_z1 == losses_z2
+                 and all(np.array_equal(a, b)
+                         for a, b in zip(w_rep, w_z1))
+                 and all(np.array_equal(a, b)
+                         for a, b in zip(w_rep, w_z2)))
+    return {
+        "zero_loss_bit_identical": bool(identical),
+        "zero_state_bytes_per_rank": int(per_rank),
+        "zero_state_bytes_replicated": int(replicated),
+        "zero_state_ratio": round(per_rank / max(replicated, 1.0), 4),
+        "zero_collectives_per_step": round(zero_dispatches / steps, 1),
+    }
 
 
 def main():
@@ -325,12 +392,17 @@ def main():
     })
     _emit(record)
 
+    zero = _zero_metrics()
+    record.update(zero)
+    _emit(record)
+
     if telemetry_out:
         from mxnet_tpu import telemetry
 
         telemetry.write_snapshot(telemetry_out)
     return 0 if (identical and overlap_identical
-                 and overlap_pct > 0.0) else 1
+                 and overlap_pct > 0.0
+                 and zero["zero_loss_bit_identical"]) else 1
 
 
 if __name__ == "__main__":
